@@ -1,0 +1,33 @@
+"""dragonfly2_tpu — a TPU-native rebuild of the Dragonfly2 P2P distribution system.
+
+Dragonfly2 (reference: akashhr/Dragonfly2 v2.1.0) is a P2P file-distribution and
+container-image-acceleration system whose ML trainer — the component that fits a
+peer-scoring model from scheduler-collected download records and network-topology
+probes — was left as an unimplemented stub (reference
+trainer/training/training.go:82-98).
+
+This package rebuilds the full capability surface with two planes:
+
+- **service plane** (scheduler, manager, peer daemon, CLIs): Python services over
+  gRPC/HTTP mirroring the reference's layer map (SURVEY.md §1).
+- **compute plane** (trainer): brand-new JAX/XLA construction — MLP parent
+  scorer, GraphSAGE GNN over the probe graph (sharded sparse adjacency in HBM),
+  GRU piece time-series, data-parallel training over an ICI mesh and federated
+  multi-cluster aggregation over DCN.
+
+Subpackages:
+  schema     record schemas + columnar codecs (the contract between planes)
+  models     JAX model definitions (MLP, GraphSAGE, GRU, link prediction)
+  ops        TPU compute primitives (segment ops, ring collectives, pallas)
+  parallel   mesh/sharding helpers, data parallelism, FedAvg
+  trainer    the training service: ingestion pipeline, fit loops, checkpoints
+  scheduler  resource FSMs, scheduling algorithm, evaluators, network topology
+  daemon     peer daemon: piece pipeline, storage, upload server
+  manager    control plane: DB, model registry, dynconfig, searcher
+  rpc        gRPC fabric: protos, client/server glue, balancer
+  utils      DAG, idgen, digest, cache, KV store, GC framework
+"""
+
+from dragonfly2_tpu.version import __version__
+
+__all__ = ["__version__"]

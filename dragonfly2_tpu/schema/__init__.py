@@ -1,0 +1,53 @@
+"""Record schemas + columnar codecs — the contract between the service plane
+(scheduler writes download/topology records) and the compute plane (the TPU
+trainer consumes them as feature tensors).
+
+Reference parity: scheduler/storage/types.go:26-297 defines the records;
+trainer/storage/storage.go:44-148 stores them per source host. Here the
+canonical on-disk form is columnar (npz blocks) so ingestion is a memmap +
+reshape, with CSV kept for interoperability/debugging.
+"""
+
+from dragonfly2_tpu.schema.records import (
+    MAX_DEST_HOSTS,
+    MAX_PARENTS,
+    MAX_PIECES_PER_PARENT,
+    Build,
+    CPU,
+    CPUTimes,
+    DestHost,
+    Disk,
+    DownloadRecord,
+    ErrorInfo,
+    HostRecord,
+    Memory,
+    Network,
+    NetworkTopologyRecord,
+    ParentRecord,
+    PieceRecord,
+    ProbesRecord,
+    SrcHost,
+    TaskRecord,
+)
+
+__all__ = [
+    "MAX_DEST_HOSTS",
+    "MAX_PARENTS",
+    "MAX_PIECES_PER_PARENT",
+    "Build",
+    "CPU",
+    "CPUTimes",
+    "DestHost",
+    "Disk",
+    "DownloadRecord",
+    "ErrorInfo",
+    "HostRecord",
+    "Memory",
+    "Network",
+    "NetworkTopologyRecord",
+    "ParentRecord",
+    "PieceRecord",
+    "ProbesRecord",
+    "SrcHost",
+    "TaskRecord",
+]

@@ -1,0 +1,237 @@
+"""Columnar codec + rotating writers for training records.
+
+Two on-disk forms:
+
+- **CSV** — interoperability/debugging form, same information content as the
+  reference's gocsv files (reference scheduler/storage/storage.go:412-545),
+  with size-based rotation and bounded backups
+  (reference storage.go:92-139 rotation semantics).
+- **npz blocks** — the trainer's high-throughput form: every column is one
+  contiguous numpy array per block file, so ingestion is load + reshape with
+  no per-record Python work. Nested repeated groups land as extra
+  dimensions (parents → [N, 20], pieces → [N, 20, 10]).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import re
+from pathlib import Path
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+from dragonfly2_tpu.schema import records as R
+
+# ---------------------------------------------------------------------------
+# CSV codec
+# ---------------------------------------------------------------------------
+
+
+def write_csv(path: str | os.PathLike, recs: Sequence[Any], append: bool = False) -> None:
+    if not recs:
+        return
+    cls = type(recs[0])
+    cols = R.headers(cls)
+    exists = os.path.exists(path) and os.path.getsize(path) > 0
+    mode = "a" if append else "w"
+    with open(path, mode, newline="") as f:
+        w = csv.DictWriter(f, fieldnames=cols)
+        if not (append and exists):
+            w.writeheader()
+        for rec in recs:
+            w.writerow(R.flatten(rec))
+
+
+def read_csv(path: str | os.PathLike, cls: type) -> list[Any]:
+    out = []
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            out.append(R.unflatten(cls, row))
+    return out
+
+
+class RotatingCSVWriter:
+    """Size-rotated CSV sink with bounded backups.
+
+    Reference semantics (scheduler/storage/storage.go): the active file is
+    ``<base>.csv``; on exceeding ``max_size`` bytes it rotates to
+    ``<base>-<n>.csv`` and at most ``max_backups`` rotated files are kept
+    (oldest dropped). ``buffer_size`` rows are batched per flush.
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        base: str,
+        record_cls: type,
+        max_size: int = 100 * 1024 * 1024,
+        max_backups: int = 10,
+        buffer_size: int = 64,
+    ):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.base = base
+        self.record_cls = record_cls
+        self.max_size = max_size
+        self.max_backups = max_backups
+        self.buffer_size = max(1, buffer_size)
+        self._buf: list[Any] = []
+
+    @property
+    def active_path(self) -> Path:
+        return self.dir / f"{self.base}.csv"
+
+    def create(self, *recs: Any) -> None:
+        """Queue records; flush when the buffer fills."""
+        self._buf.extend(recs)
+        if len(self._buf) >= self.buffer_size:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._buf:
+            return
+        if self.active_path.exists() and self.active_path.stat().st_size >= self.max_size:
+            self._rotate()
+        write_csv(self.active_path, self._buf, append=True)
+        self._buf.clear()
+
+    def _rotate(self) -> None:
+        nums = sorted(self._backup_numbers())
+        nxt = (nums[-1] + 1) if nums else 1
+        self.active_path.rename(self.dir / f"{self.base}-{nxt}.csv")
+        nums.append(nxt)
+        while len(nums) > self.max_backups:
+            oldest = nums.pop(0)
+            (self.dir / f"{self.base}-{oldest}.csv").unlink(missing_ok=True)
+
+    def _backup_numbers(self) -> list[int]:
+        pat = re.compile(rf"^{re.escape(self.base)}-(\d+)\.csv$")
+        out = []
+        for p in self.dir.iterdir():
+            m = pat.match(p.name)
+            if m:
+                out.append(int(m.group(1)))
+        return out
+
+    def backups(self) -> list[Path]:
+        return [self.dir / f"{self.base}-{n}.csv" for n in sorted(self._backup_numbers())]
+
+    def all_files(self) -> list[Path]:
+        files = self.backups()
+        if self.active_path.exists():
+            files.append(self.active_path)
+        return files
+
+    def read_all(self) -> list[Any]:
+        self.flush()
+        out: list[Any] = []
+        for p in self.all_files():
+            out.extend(read_csv(p, self.record_cls))
+        return out
+
+    def clear(self) -> None:
+        self._buf.clear()
+        for p in self.all_files():
+            p.unlink(missing_ok=True)
+
+
+# ---------------------------------------------------------------------------
+# Columnar (npz-block) codec
+# ---------------------------------------------------------------------------
+
+
+def records_to_columns(recs: Sequence[Any]) -> dict[str, np.ndarray]:
+    """Transpose records into one array per dotted column.
+
+    Numeric columns become float64/int64 arrays; string columns become numpy
+    unicode arrays. Repeated groups are already fixed-width after
+    ``flatten`` so every column has length N.
+    """
+    if not recs:
+        return {}
+    flats = [R.flatten(r) for r in recs]
+    cols: dict[str, np.ndarray] = {}
+    for key in flats[0]:
+        vals = [f[key] for f in flats]
+        cols[key] = np.asarray(vals)
+    return cols
+
+
+def columns_to_records(cols: dict[str, np.ndarray], cls: type) -> list[Any]:
+    n = len(next(iter(cols.values())))
+    out = []
+    for i in range(n):
+        row = {k: v[i].item() if v[i].shape == () else v[i] for k, v in cols.items()}
+        out.append(R.unflatten(cls, row))
+    return out
+
+
+def num_rows(cols: dict[str, np.ndarray]) -> int:
+    if not cols:
+        return 0
+    return len(next(iter(cols.values())))
+
+
+def save_block(path: str | os.PathLike, cols: dict[str, np.ndarray]) -> None:
+    np.savez(path, **{k.replace(".", "__"): v for k, v in cols.items()})
+
+
+def load_block(path: str | os.PathLike) -> dict[str, np.ndarray]:
+    with np.load(path, allow_pickle=False) as z:
+        return {k.replace("__", "."): z[k] for k in z.files}
+
+
+def concat_columns(blocks: Iterable[dict[str, np.ndarray]]) -> dict[str, np.ndarray]:
+    blocks = [b for b in blocks if b]
+    if not blocks:
+        return {}
+    keys = blocks[0].keys()
+    return {k: np.concatenate([b[k] for b in blocks], axis=0) for k in keys}
+
+
+class BlockWriter:
+    """Append-only block sink: ``<base>-<seq>.npz`` files of up to
+    ``rows_per_block`` rows — the shard unit the data-parallel trainer maps
+    over (one shard file ↔ one input shard, reference
+    trainer/storage/storage.go:141-148 keys files by source scheduler)."""
+
+    def __init__(self, directory: str | os.PathLike, base: str, rows_per_block: int = 1 << 16):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.base = base
+        self.rows_per_block = rows_per_block
+        self._pending: list[dict[str, np.ndarray]] = []
+        self._pending_rows = 0
+        self._seq = len(self.block_paths())
+
+    def append_columns(self, cols: dict[str, np.ndarray]) -> None:
+        if not cols:
+            return
+        self._pending.append(cols)
+        self._pending_rows += num_rows(cols)
+        while self._pending_rows >= self.rows_per_block:
+            merged = concat_columns(self._pending)
+            head = {k: v[: self.rows_per_block] for k, v in merged.items()}
+            tail = {k: v[self.rows_per_block :] for k, v in merged.items()}
+            self._write(head)
+            self._pending = [tail] if num_rows(tail) else []
+            self._pending_rows = num_rows(tail)
+
+    def flush(self) -> None:
+        if self._pending_rows:
+            self._write(concat_columns(self._pending))
+            self._pending = []
+            self._pending_rows = 0
+
+    def _write(self, cols: dict[str, np.ndarray]) -> None:
+        save_block(self.dir / f"{self.base}-{self._seq:06d}.npz", cols)
+        self._seq += 1
+
+    def block_paths(self) -> list[Path]:
+        return sorted(self.dir.glob(f"{self.base}-*.npz"))
+
+    def read_all(self) -> dict[str, np.ndarray]:
+        self.flush()
+        return concat_columns(load_block(p) for p in self.block_paths())

@@ -1,0 +1,1 @@
+"""Shared infrastructure (reference parity: pkg/ and internal/)."""

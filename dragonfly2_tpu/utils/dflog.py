@@ -1,0 +1,45 @@
+"""Structured logging (reference parity: internal/dflog).
+
+Per-subsystem loggers with host/peer context helpers. Uses stdlib logging
+with a key=value formatter so log lines stay grep-able without external
+deps.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_CONFIGURED = False
+
+_FORMAT = "%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s"
+
+
+def configure(level: int = logging.INFO, stream=None) -> None:
+    global _CONFIGURED
+    root = logging.getLogger("dragonfly2_tpu")
+    if _CONFIGURED:
+        root.setLevel(level)
+        return
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    root.addHandler(handler)
+    root.setLevel(level)
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get(subsystem: str) -> logging.LoggerAdapter:
+    """Subsystem logger: core, grpc, gc, storage, job, trainer…"""
+    return logging.LoggerAdapter(logging.getLogger(f"dragonfly2_tpu.{subsystem}"), {})
+
+
+def with_context(subsystem: str, **ctx: str) -> logging.LoggerAdapter:
+    """Logger carrying key=value context (WithPeer / WithHostnameAndIP)."""
+
+    class _Ctx(logging.LoggerAdapter):
+        def process(self, msg, kwargs):
+            prefix = " ".join(f"{k}={v}" for k, v in self.extra.items())
+            return (f"{prefix} {msg}" if prefix else msg), kwargs
+
+    return _Ctx(logging.getLogger(f"dragonfly2_tpu.{subsystem}"), ctx)

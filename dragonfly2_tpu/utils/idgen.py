@@ -1,0 +1,127 @@
+"""ID generation (reference parity: pkg/idgen).
+
+Task IDs are content-addressed (sha256 over url+meta) so every peer
+downloading the same object lands on the same task; host IDs are stable
+per (ip, hostname); peer IDs are unique per download attempt; model IDs
+key (type, ip, hostname) so a retrain replaces the same logical model.
+
+Reference semantics: pkg/idgen/task_id.go:37-95, host_id.go:26-33,
+peer_id.go:27-39, model_id.go.
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.parse
+import uuid
+from dataclasses import dataclass, field
+
+from dragonfly2_tpu.utils.digest import sha256_from_strings
+
+URL_FILTER_SEPARATOR = "&"
+
+
+@dataclass
+class URLMeta:
+    """Download metadata that participates in task identity."""
+
+    digest: str = ""
+    tag: str = ""
+    range: str = ""
+    filter: str = ""
+    application: str = ""
+    priority: int = 0
+    header: dict[str, str] = field(default_factory=dict)
+
+
+def filter_query(url: str, filters: list[str]) -> str:
+    """Strip the named query parameters from ``url`` (pkg/net/url.FilterQuery).
+
+    Used so volatile query params (signatures, timestamps) don't change task
+    identity.
+    """
+    if not filters:
+        return url
+    parsed = urllib.parse.urlsplit(url)
+    drop = set(filters)
+    kept = [
+        (k, v)
+        for k, v in urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
+        if k not in drop
+    ]
+    query = urllib.parse.urlencode(kept)
+    return urllib.parse.urlunsplit(
+        (parsed.scheme, parsed.netloc, parsed.path, query, parsed.fragment)
+    )
+
+
+def task_id_v1(url: str, meta: URLMeta | None = None) -> str:
+    return _task_id_v1(url, meta, ignore_range=False)
+
+
+def parent_task_id_v1(url: str, meta: URLMeta | None = None) -> str:
+    """Task ID ignoring the range — identifies the whole-object parent task."""
+    return _task_id_v1(url, meta, ignore_range=True)
+
+
+def _task_id_v1(url: str, meta: URLMeta | None, ignore_range: bool) -> str:
+    if meta is None:
+        return sha256_from_strings(url)
+    filters = [f for f in meta.filter.split(URL_FILTER_SEPARATOR) if f] if meta.filter.strip() else []
+    try:
+        u = filter_query(url, filters)
+    except Exception:
+        u = ""
+    data = [u]
+    if meta.digest:
+        data.append(meta.digest)
+    if not ignore_range and meta.range:
+        data.append(meta.range)
+    if meta.tag:
+        data.append(meta.tag)
+    if meta.application:
+        data.append(meta.application)
+    return sha256_from_strings(*data)
+
+
+def task_id_v2(
+    url: str,
+    digest: str = "",
+    tag: str = "",
+    application: str = "",
+    piece_length: int = 0,
+    filters: list[str] | None = None,
+) -> str:
+    try:
+        u = filter_query(url, filters or [])
+    except Exception:
+        u = ""
+    return sha256_from_strings(u, digest, tag, application, str(piece_length))
+
+
+def host_id_v1(hostname: str, port: int) -> str:
+    return f"{hostname}-{port}"
+
+
+def host_id_v2(ip: str, hostname: str) -> str:
+    return sha256_from_strings(ip, hostname)
+
+
+def peer_id_v1(ip: str) -> str:
+    return f"{ip}-{os.getpid()}-{uuid.uuid4()}"
+
+
+def seed_peer_id_v1(ip: str) -> str:
+    return f"{peer_id_v1(ip)}_Seed"
+
+
+def peer_id_v2() -> str:
+    return str(uuid.uuid4())
+
+
+def gnn_model_id_v1(ip: str, hostname: str) -> str:
+    return sha256_from_strings(ip, hostname, "gnn")
+
+
+def mlp_model_id_v1(ip: str, hostname: str) -> str:
+    return sha256_from_strings(ip, hostname, "mlp")

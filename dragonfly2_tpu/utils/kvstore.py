@@ -1,0 +1,160 @@
+"""Embedded KV store — the role Redis plays in the reference.
+
+The reference keeps the probe graph, probed-count counters and the job queue
+in Redis (reference scheduler/networktopology/network_topology.go:52-436,
+internal/job). This environment has no Redis server, so the same key schema
+runs against an in-process store with the subset of commands the system
+uses: hashes, bounded lists, counters, key scan with glob patterns, TTL.
+
+The store is process-local; multi-scheduler deployments would point this at
+a real Redis via the same interface (the methods are 1:1 with redis-py).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+from typing import Any
+
+
+class KVStore:
+    def __init__(self) -> None:
+        self._data: dict[str, Any] = {}
+        self._expires: dict[str, float] = {}
+        self._lock = threading.RLock()
+
+    # -- key management -------------------------------------------------
+    def _alive(self, key: str) -> bool:
+        exp = self._expires.get(key)
+        if exp is not None and time.monotonic() > exp:
+            self._data.pop(key, None)
+            self._expires.pop(key, None)
+            return False
+        return key in self._data
+
+    def exists(self, key: str) -> bool:
+        with self._lock:
+            return self._alive(key)
+
+    def delete(self, *keys: str) -> int:
+        with self._lock:
+            n = 0
+            for key in keys:
+                if self._data.pop(key, None) is not None:
+                    n += 1
+                self._expires.pop(key, None)
+            return n
+
+    def expire(self, key: str, ttl_seconds: float) -> bool:
+        with self._lock:
+            if not self._alive(key):
+                return False
+            self._expires[key] = time.monotonic() + ttl_seconds
+            return True
+
+    def scan_iter(self, pattern: str = "*") -> list[str]:
+        with self._lock:
+            return [k for k in list(self._data) if self._alive(k) and fnmatch.fnmatchcase(k, pattern)]
+
+    def flushall(self) -> None:
+        with self._lock:
+            self._data.clear()
+            self._expires.clear()
+
+    # -- strings / counters ---------------------------------------------
+    def set(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._data[key] = value
+
+    def get(self, key: str) -> Any:
+        with self._lock:
+            return self._data.get(key) if self._alive(key) else None
+
+    def incr(self, key: str, amount: int = 1) -> int:
+        with self._lock:
+            cur = int(self._data.get(key, 0)) if self._alive(key) else 0
+            cur += amount
+            self._data[key] = cur
+            return cur
+
+    # -- hashes ----------------------------------------------------------
+    def hset(self, key: str, mapping: dict[str, Any]) -> int:
+        with self._lock:
+            h = self._data.setdefault(key, {})
+            if not isinstance(h, dict):
+                raise TypeError(f"{key} is not a hash")
+            h.update(mapping)
+            return len(mapping)
+
+    def hget(self, key: str, field: str) -> Any:
+        with self._lock:
+            h = self._data.get(key) if self._alive(key) else None
+            return None if h is None else h.get(field)
+
+    def hgetall(self, key: str) -> dict[str, Any]:
+        with self._lock:
+            h = self._data.get(key) if self._alive(key) else None
+            return dict(h) if isinstance(h, dict) else {}
+
+    # -- lists (bounded probe queues) ------------------------------------
+    def rpush(self, key: str, *values: Any) -> int:
+        with self._lock:
+            lst = self._data.setdefault(key, [])
+            if not isinstance(lst, list):
+                raise TypeError(f"{key} is not a list")
+            lst.extend(values)
+            return len(lst)
+
+    def lpop(self, key: str) -> Any:
+        with self._lock:
+            lst = self._data.get(key) if self._alive(key) else None
+            if not lst:
+                return None
+            return lst.pop(0)
+
+    def llen(self, key: str) -> int:
+        with self._lock:
+            lst = self._data.get(key) if self._alive(key) else None
+            return len(lst) if isinstance(lst, list) else 0
+
+    def lrange(self, key: str, start: int, stop: int) -> list[Any]:
+        """Redis-style inclusive range; stop=-1 means end of list."""
+        with self._lock:
+            lst = self._data.get(key) if self._alive(key) else None
+            if not isinstance(lst, list):
+                return []
+            if stop == -1:
+                return list(lst[start:])
+            return list(lst[start : stop + 1])
+
+
+_default_store: KVStore | None = None
+_default_lock = threading.Lock()
+
+
+def default_store() -> KVStore:
+    """Process-wide singleton used when services share one process (tests)."""
+    global _default_store
+    with _default_lock:
+        if _default_store is None:
+            _default_store = KVStore()
+        return _default_store
+
+
+# -- key schema (reference parity: pkg/redis/redis.go) -------------------
+
+def make_namespace(*parts: str) -> str:
+    return ":".join(parts)
+
+
+def make_network_topology_key(src_host_id: str, dest_host_id: str) -> str:
+    return make_namespace("networktopology", src_host_id, dest_host_id)
+
+
+def make_probes_key(src_host_id: str, dest_host_id: str) -> str:
+    return make_namespace("probes", src_host_id, dest_host_id)
+
+
+def make_probed_count_key(host_id: str) -> str:
+    return make_namespace("probedcount", host_id)

@@ -1,0 +1,32 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of faking multi-node setups in-process
+(reference client/daemon/peer/peertask_manager_test.go:77-290 fakes a whole
+cluster with scripted mocks); we fake an 8-chip TPU slice with XLA host
+devices so sharding/collective code paths compile and execute in CI.
+"""
+
+import os
+import sys
+
+# Must run before the first `import jax` anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """An 8-device `dp×mp` mesh shared by sharding tests."""
+    import jax
+    from dragonfly2_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) == 8, "conftest must force 8 host devices"
+    return make_mesh(dp=4, mp=2)

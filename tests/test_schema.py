@@ -1,0 +1,187 @@
+"""Tests for record schemas, codecs and feature extraction."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.schema import (
+    MAX_DEST_HOSTS,
+    MAX_PARENTS,
+    DownloadRecord,
+    NetworkTopologyRecord,
+)
+from dragonfly2_tpu.schema import records as R
+from dragonfly2_tpu.schema import synth
+from dragonfly2_tpu.schema.columnar import (
+    BlockWriter,
+    RotatingCSVWriter,
+    concat_columns,
+    load_block,
+    num_rows,
+    read_csv,
+    records_to_columns,
+    save_block,
+    write_csv,
+)
+from dragonfly2_tpu.schema.features import (
+    MLP_FEATURE_DIM,
+    build_probe_graph,
+    extract_pair_features,
+    location_affinity,
+)
+
+
+class TestRecordRoundtrip:
+    def test_flatten_headers_stable(self):
+        h1 = R.headers(DownloadRecord)
+        h2 = R.headers(DownloadRecord)
+        assert h1 == h2
+        # fixed-width groups: 20 parents each with 10 pieces
+        assert sum(k.startswith("parents.19.") for k in h1) > 0
+        assert "parents.0.pieces.9.cost" in h1
+
+    def test_download_roundtrip(self):
+        recs = synth.make_download_records(3, seed=1)
+        for rec in recs:
+            flat = R.flatten(rec)
+            back = R.unflatten(DownloadRecord, flat)
+            assert back == rec
+
+    def test_topology_roundtrip(self):
+        recs = synth.make_topology_records(3, num_hosts=8, seed=1)
+        for rec in recs:
+            back = R.unflatten(NetworkTopologyRecord, R.flatten(rec))
+            assert back == rec
+
+
+class TestCSV:
+    def test_write_read(self, tmp_path):
+        recs = synth.make_download_records(5, seed=2)
+        p = tmp_path / "d.csv"
+        write_csv(p, recs)
+        back = read_csv(p, DownloadRecord)
+        assert back == recs
+
+    def test_append(self, tmp_path):
+        recs = synth.make_download_records(4, seed=3)
+        p = tmp_path / "d.csv"
+        write_csv(p, recs[:2])
+        write_csv(p, recs[2:], append=True)
+        assert read_csv(p, DownloadRecord) == recs
+
+    def test_rotation_and_backups(self, tmp_path):
+        w = RotatingCSVWriter(
+            tmp_path, "download", DownloadRecord, max_size=20_000, max_backups=2, buffer_size=2
+        )
+        recs = synth.make_download_records(30, seed=4, parents_per_record=2)
+        for r in recs:
+            w.create(r)
+        w.flush()
+        assert w.active_path.exists()
+        assert len(w.backups()) <= 2
+        # newest data is still readable; some early rows were dropped with old backups
+        back = w.read_all()
+        assert 0 < len(back) <= 30
+        assert back[-1] == recs[-1]
+        w.clear()
+        assert w.all_files() == []
+
+
+class TestColumnar:
+    def test_columns_roundtrip(self, tmp_path):
+        recs = synth.make_download_records(6, seed=5)
+        cols = records_to_columns(recs)
+        assert num_rows(cols) == 6
+        save_block(tmp_path / "b.npz", cols)
+        loaded = load_block(tmp_path / "b.npz")
+        assert set(loaded) == set(cols)
+        np.testing.assert_array_equal(loaded["task.total_piece_count"], cols["task.total_piece_count"])
+
+    def test_block_writer_splits(self, tmp_path):
+        recs = synth.make_topology_records(25, num_hosts=16, seed=6)
+        w = BlockWriter(tmp_path, "nt", rows_per_block=10)
+        w.append_columns(records_to_columns(recs))
+        w.flush()
+        paths = w.block_paths()
+        assert len(paths) == 3  # 10 + 10 + 5
+        allcols = w.read_all()
+        assert num_rows(allcols) == 25
+
+    def test_concat(self):
+        a = records_to_columns(synth.make_download_records(2, seed=7))
+        b = records_to_columns(synth.make_download_records(3, seed=8))
+        c = concat_columns([a, b])
+        assert num_rows(c) == 5
+
+
+class TestFeatures:
+    def test_location_affinity(self):
+        a = np.array(["as|cn|sh|dc1", "as|cn|sh|dc1", "", "eu|de"])
+        b = np.array(["as|cn|sh|dc1", "eu|de|fra|dc1", "as", "eu|de"])
+        aff = location_affinity(a, b)
+        assert aff[0] == pytest.approx(4 / 5)
+        assert aff[1] == 0.0
+        assert aff[2] == 0.0
+        assert aff[3] == pytest.approx(2 / 5)
+
+    def test_pair_features_shapes_and_ranges(self):
+        recs = synth.make_download_records(16, seed=9, parents_per_record=3)
+        cols = records_to_columns(recs)
+        pairs = extract_pair_features(cols)
+        assert pairs.features.shape == (16 * 3, MLP_FEATURE_DIM)
+        assert pairs.labels.shape == (48,)
+        assert pairs.features.dtype == np.float32
+        # bounded features stay in [0, 1]
+        for j in (0, 1, 2, 3, 4, 5, 10, 11):
+            assert pairs.features[:, j].min() >= 0.0
+            assert pairs.features[:, j].max() <= 1.0
+        assert np.all(pairs.labels > 0)  # log1p of positive ms
+        assert pairs.download_index.max() == 15
+
+    def test_pair_features_skip_invalid_parents(self):
+        recs = synth.make_download_records(4, seed=10, parents_per_record=2)
+        # strip pieces from one parent → that pair has no label and is dropped
+        recs[0].parents[0].pieces = []
+        pairs = extract_pair_features(records_to_columns(recs))
+        assert pairs.features.shape[0] == 4 * 2 - 1
+
+    def test_labels_reflect_locality_signal(self):
+        recs = synth.make_download_records(200, seed=11, parents_per_record=4)
+        pairs = extract_pair_features(records_to_columns(recs))
+        idc_match = pairs.features[:, 4] > 0.5
+        assert idc_match.any() and (~idc_match).any()
+        # same-IDC parents must be faster on average (synth ground truth)
+        assert pairs.labels[idc_match].mean() < pairs.labels[~idc_match].mean()
+
+
+class TestProbeGraph:
+    def test_build_graph(self):
+        recs = synth.make_topology_records(60, num_hosts=24, seed=12)
+        g = build_probe_graph(records_to_columns(recs), max_degree=8)
+        assert g.num_nodes <= 24
+        assert g.node_features.shape == (g.num_nodes, 7)
+        assert g.edge_src.shape == g.edge_dst.shape == g.edge_rtt_log_ms.shape
+        assert len(g.edge_src) > 0
+        assert g.neighbors.shape == (g.num_nodes, 8)
+        assert g.neighbor_mask.shape == (g.num_nodes, 8)
+        # all neighbor indices in bounds
+        assert g.neighbors.min() >= 0 and g.neighbors.max() < g.num_nodes
+        # masked slots are self-padded
+        pad = g.neighbor_mask == 0.0
+        rows = np.nonzero(pad.any(axis=1))[0]
+        for v in rows[:5]:
+            slots = np.nonzero(pad[v])[0]
+            assert np.all(g.neighbors[v, slots] == v)
+
+    def test_dedup_keeps_latest(self):
+        recs = synth.make_topology_records(10, num_hosts=4, seed=13)
+        g = build_probe_graph(records_to_columns(recs), max_degree=4)
+        pairs = set(zip(g.edge_src.tolist(), g.edge_dst.tolist()))
+        assert len(pairs) == len(g.edge_src)  # unique (src, dst)
+
+
+class TestSynthTensors:
+    def test_pair_tensor_shapes(self):
+        x, y = synth.make_pair_tensors(1000, seed=14)
+        assert x.shape == (1000, MLP_FEATURE_DIM)
+        assert y.shape == (1000,)
+        assert x.dtype == np.float32 and y.dtype == np.float32
