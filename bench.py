@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Headline benchmark: MLP parent-scorer trainer throughput (records/sec/chip).
+
+North star (BASELINE.json): train the parent scorer on 1B download records
+on a v5e-8 in <10 min ⇒ ~208,333 records/sec/chip sustained. The reference
+has no trainer to race (its fit loop is an empty stub, reference
+trainer/training/training.go:82-98); `vs_baseline` is measured against that
+derived per-chip north-star rate.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "records/sec/chip", "vs_baseline": N}
+
+Method: synthesize pair-feature tensors (the post-ingestion form of
+scheduler download records), stack into device-resident [steps, batch, F]
+minibatches, run the jitted whole-epoch lax.scan train step (the same code
+path trainer.train.train_mlp uses), discard the compile epoch, then time
+steady-state epochs.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
+    from dragonfly2_tpu.schema.synth import make_pair_tensors
+    from dragonfly2_tpu.models import mlp as mlp_mod
+    from dragonfly2_tpu.trainer import train as T
+
+    n_devices = jax.device_count()
+
+    # Dataset sized for steady-state measurement; batch tuned for one v5e
+    # chip (bf16 matmuls, [B, 12] @ [12, 256] @ [256, 256] @ [256, 1]).
+    batch = 131_072
+    steps_per_epoch = 16
+    n = batch * steps_per_epoch
+    x, y = make_pair_tensors(n, seed=0)
+
+    cfg = T.FitConfig(hidden_dims=(256, 256), batch_size=batch, epochs=1, seed=0)
+    optimizer = T._optimizer(cfg, steps_per_epoch * 8)
+
+    key = jax.random.PRNGKey(0)
+    params = mlp_mod.init_mlp(key, [MLP_FEATURE_DIM, *cfg.hidden_dims, 1])
+    params["layers"][-1]["b"] = jnp.full((1,), float(y.mean()))
+    opt_state = optimizer.init(params)
+
+    def loss_fn(p, b):
+        xb, yb = b
+        pred = mlp_mod.score_parents(p, xb)
+        return jnp.mean((pred - yb) ** 2)
+
+    epoch_fn = T.make_epoch_fn(loss_fn, optimizer)
+
+    xb = jnp.asarray(x.reshape(steps_per_epoch, batch, MLP_FEATURE_DIM))
+    yb = jnp.asarray(y.reshape(steps_per_epoch, batch))
+
+    # compile + warmup epoch (not timed)
+    params, opt_state, loss = epoch_fn(params, opt_state, (xb, yb))
+    jax.block_until_ready(loss)
+
+    timed_epochs = 5
+    t0 = time.perf_counter()
+    for _ in range(timed_epochs):
+        params, opt_state, loss = epoch_fn(params, opt_state, (xb, yb))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    records = n * timed_epochs
+    rec_per_sec = records / dt
+    rec_per_sec_per_chip = rec_per_sec / n_devices
+
+    north_star_per_chip = 1e9 / 600 / 8  # 1B records / 10 min / v5e-8
+    print(
+        json.dumps(
+            {
+                "metric": "mlp_trainer_throughput",
+                "value": round(rec_per_sec_per_chip, 1),
+                "unit": "records/sec/chip",
+                "vs_baseline": round(rec_per_sec_per_chip / north_star_per_chip, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

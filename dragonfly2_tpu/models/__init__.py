@@ -1,0 +1,12 @@
+"""Model zoo for the TPU trainer (BASELINE.json `configs`):
+
+- mlp        — parent-peer scorer on download-record pair features
+- gnn        — GraphSAGE over the probe graph (parent scoring + link prediction)
+- gru        — piece-download time-series (back-to-source predictor)
+- attention  — transformer encoder w/ ring attention for long piece sequences
+
+All models are pure functional: ``init_*`` returns a params pytree (plain
+dicts/lists of jnp arrays — trivially shardable with NamedSharding),
+``apply_*`` is jit-traceable with static shapes. Matmuls run bfloat16 with
+float32 accumulation.
+"""

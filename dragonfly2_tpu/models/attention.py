@@ -1,0 +1,106 @@
+"""Transformer encoder with ring attention for long piece sequences.
+
+Sequence-parallel alternative to the GRU for very long download histories
+(tasks with tens of thousands of pieces): the sequence is sharded over the
+mesh's `sp` axis and attention runs blockwise over the ICI ring
+(ops.ring.ring_attention), so context length scales with the number of
+chips instead of one chip's HBM.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dragonfly2_tpu.models.mlp import init_mlp
+from dragonfly2_tpu.ops.ring import local_attention
+
+Params = dict
+
+
+def init_transformer(
+    key: jax.Array,
+    in_dim: int,
+    model_dim: int,
+    num_heads: int,
+    num_layers: int,
+    mlp_ratio: int = 4,
+    dtype=jnp.float32,
+) -> Params:
+    assert model_dim % num_heads == 0
+    head_dim = model_dim // num_heads
+
+    def dense(k, fan_in, fan_out):
+        scale = jnp.sqrt(1.0 / fan_in).astype(dtype)
+        return jax.random.normal(k, (fan_in, fan_out), dtype) * scale
+
+    key, ek = jax.random.split(key)
+    params: Params = {
+        "embed": dense(ek, in_dim, model_dim),
+        "layers": [],
+        "num_heads": num_heads,
+        "head_dim": head_dim,
+    }
+    for _ in range(num_layers):
+        key, *ks = jax.random.split(key, 8)
+        params["layers"].append(
+            {
+                "wq": dense(ks[0], model_dim, model_dim),
+                "wk": dense(ks[1], model_dim, model_dim),
+                "wv": dense(ks[2], model_dim, model_dim),
+                "wo": dense(ks[3], model_dim, model_dim),
+                "ln1": {"g": jnp.ones((model_dim,), dtype), "b": jnp.zeros((model_dim,), dtype)},
+                "ln2": {"g": jnp.ones((model_dim,), dtype), "b": jnp.zeros((model_dim,), dtype)},
+                "w1": dense(ks[4], model_dim, mlp_ratio * model_dim),
+                "b1": jnp.zeros((mlp_ratio * model_dim,), dtype),
+                "w2": dense(ks[5], mlp_ratio * model_dim, model_dim),
+                "b2": jnp.zeros((model_dim,), dtype),
+            }
+        )
+    key, hk = jax.random.split(key)
+    params["head"] = init_mlp(hk, [model_dim, model_dim, 1], dtype)
+    return params
+
+
+def _layer_norm(x: jax.Array, g: jax.Array, b: jax.Array) -> jax.Array:
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * g + b
+
+
+def apply_transformer(
+    params: Params,
+    x: jax.Array,  # [B, T, F]
+    attention_fn=None,
+    causal: bool = True,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """→ [B, T, model_dim] encoded sequence.
+
+    ``attention_fn(q, k, v) -> o`` defaults to single-device
+    local_attention; pass ops.ring.make_ring_attention(mesh, 'sp') to run
+    sequence-parallel (inputs must then be sp-sharded [B, T/sp, ...]).
+    """
+    nh, hd = params["num_heads"], params["head_dim"]
+    if attention_fn is None:
+        def attention_fn(q, k, v):
+            return local_attention(q, k, v, causal=causal)
+
+    def proj(h, w):
+        return jnp.dot(
+            h.astype(compute_dtype), w.astype(compute_dtype), preferred_element_type=jnp.float32
+        )
+
+    h = proj(x, params["embed"])
+    b, t, dm = h.shape
+    for layer in params["layers"]:
+        u = _layer_norm(h, layer["ln1"]["g"], layer["ln1"]["b"])
+        q = proj(u, layer["wq"]).reshape(b, t, nh, hd).astype(compute_dtype)
+        k = proj(u, layer["wk"]).reshape(b, t, nh, hd).astype(compute_dtype)
+        v = proj(u, layer["wv"]).reshape(b, t, nh, hd).astype(compute_dtype)
+        o = attention_fn(q, k, v).reshape(b, t, dm)
+        h = h + proj(o, layer["wo"])
+        u = _layer_norm(h, layer["ln2"]["g"], layer["ln2"]["b"])
+        ff = jax.nn.gelu(proj(u, layer["w1"]) + layer["b1"].astype(jnp.float32))
+        h = h + proj(ff, layer["w2"]) + layer["b2"].astype(jnp.float32)
+    return h

@@ -1,0 +1,78 @@
+"""GRU over piece-download time series.
+
+Per-task sequence of piece outcomes (cost, length, parent switch …) →
+predicted next-piece cost / back-to-source risk (BASELINE.json config
+"GRU piece-download time-series"). The recurrence runs under `lax.scan`
+— XLA-friendly sequential control flow, no Python loops in jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dragonfly2_tpu.models.mlp import apply_mlp, init_mlp
+
+Params = dict
+
+
+def init_gru(
+    key: jax.Array, in_dim: int, hidden_dim: int, head_hidden: int = 32, dtype=jnp.float32
+) -> Params:
+    def dense(k, fan_in, fan_out):
+        scale = jnp.sqrt(1.0 / fan_in).astype(dtype)
+        return jax.random.normal(k, (fan_in, fan_out), dtype) * scale
+
+    keys = jax.random.split(key, 7)
+    params = {
+        "wz": dense(keys[0], in_dim, hidden_dim),
+        "uz": dense(keys[1], hidden_dim, hidden_dim),
+        "bz": jnp.zeros((hidden_dim,), dtype),
+        "wr": dense(keys[2], in_dim, hidden_dim),
+        "ur": dense(keys[3], hidden_dim, hidden_dim),
+        "br": jnp.zeros((hidden_dim,), dtype),
+        "wh": dense(keys[4], in_dim, hidden_dim),
+        "uh": dense(keys[5], hidden_dim, hidden_dim),
+        "bh": jnp.zeros((hidden_dim,), dtype),
+        "head": init_mlp(keys[6], [hidden_dim, head_hidden, 1], dtype),
+    }
+    return params
+
+
+def gru_cell(params: Params, h: jax.Array, x: jax.Array) -> jax.Array:
+    z = jax.nn.sigmoid(x @ params["wz"] + h @ params["uz"] + params["bz"])
+    r = jax.nn.sigmoid(x @ params["wr"] + h @ params["ur"] + params["br"])
+    n = jnp.tanh(x @ params["wh"] + (r * h) @ params["uh"] + params["bh"])
+    return (1.0 - z) * n + z * h
+
+
+def apply_gru(
+    params: Params, x: jax.Array, lengths: jax.Array | None = None
+) -> tuple[jax.Array, jax.Array]:
+    """x: [B, T, F] → (hidden states [B, T, H], final hidden [B, H]).
+
+    ``lengths`` masks padded steps: state stops updating past a sequence's
+    length so the final hidden is the last *real* step's state.
+    """
+    b, t, _ = x.shape
+    h0 = jnp.zeros((b, params["uz"].shape[0]), x.dtype)
+
+    def step(h, inp):
+        xt, keep = inp
+        h_new = gru_cell(params, h, xt)
+        h = jnp.where(keep[:, None], h_new, h)
+        return h, h
+
+    if lengths is None:
+        keep = jnp.ones((t, b), bool)
+    else:
+        keep = (jnp.arange(t)[:, None] < lengths[None, :]).astype(bool)
+    final, hs = lax.scan(step, h0, (x.transpose(1, 0, 2), keep))
+    return hs.transpose(1, 0, 2), final
+
+
+def predict_next_cost(params: Params, x: jax.Array, lengths: jax.Array | None = None) -> jax.Array:
+    """[B, T, F] piece history → [B] predicted next log piece cost."""
+    _, final = apply_gru(params, x, lengths)
+    return apply_mlp(params["head"], final)[..., 0]

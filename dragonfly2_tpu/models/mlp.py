@@ -1,0 +1,61 @@
+"""MLP parent scorer.
+
+The first real implementation of the reference's `trainMLP` stub
+(reference trainer/training/training.go:92-98): a regression MLP from the
+12 pair features (schema.features.MLP_FEATURE_NAMES) to expected log piece
+cost. The scheduler's `ml` evaluator ranks candidate parents by ascending
+predicted cost (reference evaluator.go:53's TODO algorithm).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+Params = dict
+
+
+def init_mlp(
+    key: jax.Array,
+    dims: Sequence[int],
+    dtype=jnp.float32,
+) -> Params:
+    """``dims = [in, hidden..., out]`` → {'layers': [{'w', 'b'}, ...]}."""
+    layers = []
+    for i, (fan_in, fan_out) in enumerate(zip(dims[:-1], dims[1:])):
+        key, sub = jax.random.split(key)
+        scale = jnp.sqrt(2.0 / fan_in).astype(dtype)
+        layers.append(
+            {
+                "w": jax.random.normal(sub, (fan_in, fan_out), dtype) * scale,
+                "b": jnp.zeros((fan_out,), dtype),
+            }
+        )
+    return {"layers": layers}
+
+
+def apply_mlp(
+    params: Params,
+    x: jax.Array,
+    activation=jax.nn.gelu,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Forward pass; hidden matmuls in ``compute_dtype`` (bfloat16 on the
+    MXU), accumulation and residual math in float32."""
+    h = x
+    n = len(params["layers"])
+    for i, layer in enumerate(params["layers"]):
+        w = layer["w"].astype(compute_dtype)
+        h = jnp.dot(h.astype(compute_dtype), w, preferred_element_type=jnp.float32)
+        h = h + layer["b"].astype(jnp.float32)
+        if i != n - 1:
+            h = activation(h)
+    return h
+
+
+def score_parents(params: Params, features: jax.Array) -> jax.Array:
+    """[..., F] pair features → [...] predicted log piece cost (lower is a
+    better parent)."""
+    return apply_mlp(params, features)[..., 0]

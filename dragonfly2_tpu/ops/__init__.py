@@ -1,0 +1,7 @@
+"""TPU compute primitives: segment ops, ring collectives, attention blocks.
+
+These are the building blocks the model zoo (dragonfly2_tpu.models) composes.
+Everything here is jit-traceable with static shapes, keeps matmuls in
+bfloat16 with float32 accumulation (MXU-friendly), and scales over device
+meshes via shard_map + ppermute rather than host-side loops.
+"""

@@ -1,0 +1,175 @@
+"""Ring collectives: all-gather and ring (blockwise) attention.
+
+The long-context answer for this framework (SURVEY.md §5.7): sequence /
+graph data larger than one chip's HBM is sharded over an ICI ring and
+processed blockwise, overlapping compute with `ppermute` transfers —
+ring attention for sequence models, ring gather for sharded graph
+feature tables. Written against mesh axis names; callers wrap these in
+`shard_map` over a `jax.sharding.Mesh`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _ring_perm(axis_size: int) -> list[tuple[int, int]]:
+    return [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+
+def ring_all_gather(x: jax.Array, axis_name: str) -> jax.Array:
+    """All-gather along a ring: per-device [S, ...] → [axis_size*S, ...].
+
+    Equivalent to lax.all_gather(tiled=True) but expressed as axis_size-1
+    ppermute hops so each step only moves one shard over ICI — the pattern
+    the sharded GNN gather rides.
+    """
+    axis_size = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = _ring_perm(axis_size)
+
+    shard = x
+    out = jnp.zeros((axis_size,) + x.shape, x.dtype)
+    out = lax.dynamic_update_index_in_dim(out, x, idx, 0)
+    for step in range(1, axis_size):
+        shard = lax.ppermute(shard, axis_name, perm)
+        src = (idx - step) % axis_size
+        out = lax.dynamic_update_index_in_dim(out, shard, src, 0)
+    return out.reshape((axis_size * x.shape[0],) + x.shape[1:])
+
+
+def ring_gather_rows(
+    table_shard: jax.Array, indices: jax.Array, axis_name: str
+) -> jax.Array:
+    """Gather rows of a row-sharded table by *global* index over a ring.
+
+    table_shard: [S, F] — this device's rows ``[idx*S, (idx+1)*S)`` of a
+    global [axis_size*S, F] table. indices: any int shape, global row ids.
+    Rotates table shards around the ring; each device picks up the rows
+    whose global id falls in the visiting shard. Memory stays O(S + |idx|)
+    per device — never materializes the full table (the moral equivalent
+    of ring attention for graph neighbor lookup; SURVEY.md §5.7).
+    """
+    axis_size = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    s = table_shard.shape[0]
+    perm = _ring_perm(axis_size)
+
+    out = jnp.zeros(indices.shape + table_shard.shape[1:], table_shard.dtype)
+    shard = table_shard
+    for step in range(axis_size):
+        src = (idx - step) % axis_size  # owner of the shard currently visiting
+        local = indices - src * s
+        hit = (local >= 0) & (local < s)
+        rows = jnp.take(shard, jnp.clip(local, 0, s - 1), axis=0)
+        out = jnp.where(hit[..., None], rows, out)
+        if step != axis_size - 1:
+            shard = lax.ppermute(shard, axis_name, perm)
+    return out
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Blockwise ring attention over a sequence-sharded axis.
+
+    Per-device shards: q [B, Tq, H, D], k/v [B, Tk, H, D] — the global
+    sequence is the concatenation of shards in ring order. K/V blocks
+    rotate around the ring while a flash-style online softmax accumulates
+    (running max + normalizer), so the full [T, T] score matrix never
+    exists and HBM stays O(T/axis_size) per device.
+
+    Matmuls run in the input dtype (use bfloat16 shards) with float32
+    accumulation.
+    """
+    axis_size = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    perm = _ring_perm(axis_size)
+
+    q_pos = my * tq + jnp.arange(tq)  # global query positions
+
+    m = jnp.full((b, h, tq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, tq), jnp.float32)
+    o = jnp.zeros((b, h, tq, d), jnp.float32)
+
+    kb, vb = k, v
+    for step in range(axis_size):
+        src = (my - step) % axis_size  # ring owner of the visiting block
+        s_blk = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, kb, preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            k_pos = src * tk + jnp.arange(tk)
+            mask = q_pos[:, None] >= k_pos[None, :]  # [tq, tk]
+            s_blk = jnp.where(mask[None, None], s_blk, -jnp.inf)
+
+        m_blk = s_blk.max(axis=-1)  # [b, h, tq]
+        m_new = jnp.maximum(m, m_blk)
+        # guard fully-masked blocks (all -inf) against NaNs
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s_blk - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(s_blk), p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l = l * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32)
+        )
+        m = m_new
+        if step != axis_size - 1:
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
+
+    o = o / jnp.maximum(l[..., None], 1e-30)
+    return o.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, Tq, H, D]
+
+
+def local_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+) -> jax.Array:
+    """Single-device reference attention — the correctness oracle the ring
+    implementation is tested against."""
+    b, tq, h, d = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s / (d**0.5)
+    if causal:
+        tk = k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return o.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def make_ring_attention(mesh, axis_name: str, causal: bool = False):
+    """shard_map-wrapped ring attention over ``mesh[axis_name]`` (sequence
+    axis sharded, batch/head/depth replicated in layout, batch may also be
+    sharded by an outer axis)."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    spec = P(None, axis_name, None, None)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    def _ring(q, k, v):
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+
+    return _ring

@@ -1,0 +1,55 @@
+"""Segment/gather aggregation ops for graph neural networks.
+
+The probe graph is sparse; TPU wants dense tiles. Two aggregation forms:
+
+- **fixed-degree gather** (`gather_neighbors` + masked mean): the [N, K]
+  sampled-neighbor table from schema.features turns aggregation into a
+  dense gather + reduction — static shapes, MXU-tileable, no dynamic
+  sparsity inside jit.
+- **segment ops** over edge lists: for exact (non-sampled) aggregation,
+  used by evaluation paths where sampling noise is unwanted.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gather_neighbors(features: jax.Array, neighbors: jax.Array) -> jax.Array:
+    """[N, F] features + [N, K] int neighbor table → [N, K, F]."""
+    return jnp.take(features, neighbors, axis=0)
+
+
+def masked_mean(values: jax.Array, mask: jax.Array, axis: int = 1) -> jax.Array:
+    """Mean over ``axis`` counting only mask==1 slots; zero where empty.
+
+    values: [..., K, F]; mask: [..., K].
+    """
+    mask = mask.astype(values.dtype)
+    weighted = values * mask[..., None]
+    total = weighted.sum(axis=axis)
+    count = mask.sum(axis=axis)[..., None]
+    return total / jnp.maximum(count, 1.0)
+
+
+def aggregate_neighbors(
+    features: jax.Array, neighbors: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Masked-mean GraphSAGE aggregation: [N,F], [N,K], [N,K] → [N,F]."""
+    return masked_mean(gather_neighbors(features, neighbors), mask, axis=1)
+
+
+def segment_sum(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_mean(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    totals = segment_sum(data, segment_ids, num_segments)
+    ones = jnp.ones((data.shape[0],) + (1,) * (data.ndim - 1), dtype=data.dtype)
+    counts = segment_sum(ones, segment_ids, num_segments)
+    return totals / jnp.maximum(counts, 1.0)
+
+
+def segment_max(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    return jax.ops.segment_max(data, segment_ids, num_segments=num_segments)

@@ -1,0 +1,29 @@
+"""Mesh/sharding utilities: how the trainer scales.
+
+Axes (SURVEY.md §7 design):
+  dp — data parallel over record shards (ICI all-reduce of gradients)
+  mp — model/tensor parallel (hidden dims, node-sharded graph tables)
+  sp — sequence parallel (ring attention over piece time series)
+  fed — federated cluster axis (FedAvg over DCN between trainer replicas)
+
+The reference has no in-process parallelism to port (its trainer is a
+stub; its "parallelism" is N schedulers behind consistent hashing) — this
+plane is new construction per BASELINE.json's north star.
+"""
+
+from dragonfly2_tpu.parallel.mesh import make_mesh, mesh_shape
+from dragonfly2_tpu.parallel.sharding import (
+    batch_sharding,
+    replicate,
+    shard_batch,
+    tree_sharding,
+)
+
+__all__ = [
+    "make_mesh",
+    "mesh_shape",
+    "batch_sharding",
+    "replicate",
+    "shard_batch",
+    "tree_sharding",
+]

@@ -1,0 +1,59 @@
+"""Federated multi-cluster aggregation (FedAvg).
+
+Each scheduler cluster trains on its own record shard (its CSV/block files,
+reference trainer/storage/storage.go:141-148 keys data by source host);
+cluster models are combined by example-weighted parameter averaging.
+
+Two operating modes:
+- **in-mesh** (`fedavg_psum`): cluster replicas live on one mesh axis
+  (`fed`) — a DCN-mapped axis on multi-pod deployments — and average via
+  psum inside shard_map/jit.
+- **host-side** (`fedavg_trees`): cluster models arrive as separate
+  checkpoints (the cross-datacenter case where clusters are different
+  jobs); averaging happens on host arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def fedavg_trees(params_list: Sequence[Any], weights: Sequence[float] | None = None) -> Any:
+    """Example-weighted average of N parameter pytrees."""
+    if not params_list:
+        raise ValueError("no models to aggregate")
+    n = len(params_list)
+    if weights is None:
+        w = [1.0 / n] * n
+    else:
+        total = float(sum(weights))
+        if total <= 0:
+            raise ValueError("weights must sum to a positive value")
+        w = [float(x) / total for x in weights]
+
+    def avg(*leaves):
+        out = leaves[0] * w[0]
+        for leaf, wi in zip(leaves[1:], w[1:]):
+            out = out + leaf * wi
+        return out
+
+    return jax.tree_util.tree_map(avg, *params_list)
+
+
+def fedavg_psum(params: Any, num_examples: jax.Array, axis_name: str = "fed") -> Any:
+    """In-mesh FedAvg: call inside shard_map over the `fed` axis.
+
+    ``params`` is this cluster-replica's model, ``num_examples`` its local
+    example count; returns the example-weighted average, identical on all
+    replicas.
+    """
+    n = num_examples.astype(jnp.float32)
+    total = lax.psum(n, axis_name)
+    scale = n / jnp.maximum(total, 1.0)
+    return jax.tree_util.tree_map(
+        lambda p: lax.psum(p * scale.astype(p.dtype), axis_name), params
+    )

@@ -1,0 +1,40 @@
+"""Device mesh construction."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(devices=None, **axes: int) -> Mesh:
+    """Build a named mesh, e.g. ``make_mesh(dp=4, mp=2)``.
+
+    One axis may be -1 to absorb the remaining devices. Defaults to a pure
+    data-parallel mesh over every addressable device when no axes given.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if not axes:
+        axes = {"dp": n}
+    names = list(axes)
+    sizes = list(axes.values())
+    unknown = [i for i, s in enumerate(sizes) if s == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one axis may be -1")
+    if unknown:
+        known = int(np.prod([s for s in sizes if s != -1])) if len(sizes) > 1 else 1
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[unknown[0]] = n // known
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, have {n}")
+    grid = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(grid, axis_names=tuple(names))
+
+
+def mesh_shape(mesh: Mesh) -> dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -1,0 +1,75 @@
+"""NamedSharding helpers: put data/params onto the mesh declaratively and
+let XLA insert the collectives (the scaling-book recipe: pick a mesh,
+annotate shardings, let the compiler do layout)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+def batch_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Leading-dim sharding for data batches."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicate(mesh: Mesh, tree: Any) -> Any:
+    """Fully replicate a pytree across the mesh."""
+    s = NamedSharding(mesh, P())
+    return jax.device_put(tree, s)
+
+
+def shard_batch(mesh: Mesh, tree: Any, axis: str = "dp") -> Any:
+    """Shard every leaf's leading dim over ``axis``; pads are the caller's
+    job (leading dims must divide the axis size)."""
+    s = batch_sharding(mesh, axis)
+    return jax.device_put(tree, s)
+
+
+def tree_sharding(mesh: Mesh, tree: Any, spec_fn) -> Any:
+    """device_put with a per-leaf PartitionSpec from ``spec_fn(path, leaf)``."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    placed = [
+        jax.device_put(leaf, NamedSharding(mesh, spec_fn(path, leaf)))
+        for path, leaf in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
+def pad_to_multiple(x, multiple: int, axis: int = 0):
+    """Pad ``x`` along ``axis`` to a multiple; returns (padded, real_len).
+
+    Static-shape–friendly batching for uneven shards: the mask math uses
+    ``real_len`` to ignore padded rows.
+    """
+    import numpy as np
+
+    n = x.shape[axis]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return x, n
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, target - n)
+    return np.pad(x, widths), n
+
+
+def mlp_param_spec(path, leaf) -> P:
+    """Tensor-parallel spec for models.mlp params: alternate hidden-dim
+    sharding over `mp` (layer 0 output-sharded, layer 1 input-sharded, …)
+    so consecutive matmuls chain with one reduce-scatter/all-gather pair
+    inserted by XLA."""
+    keys = [getattr(p, "key", getattr(p, "idx", None)) for p in path]
+    if "layers" in keys:
+        layer_idx = next(k for k in keys if isinstance(k, int))
+        if keys[-1] == "w" and leaf.ndim == 2:
+            if layer_idx % 2 == 0:
+                # output-sharded — skip tiny head dims that can't split
+                return P(None, "mp") if leaf.shape[1] > 1 else P()
+            return P("mp", None) if leaf.shape[0] > 1 else P()
+        if keys[-1] == "b" and layer_idx % 2 == 0 and leaf.shape[0] > 1:
+            return P("mp")
+    return P()

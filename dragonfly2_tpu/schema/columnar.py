@@ -230,7 +230,8 @@ class BlockWriter:
         self._seq += 1
 
     def block_paths(self) -> list[Path]:
-        return sorted(self.dir.glob(f"{self.base}-*.npz"))
+        pat = re.compile(rf"^{re.escape(self.base)}-(\d+)\.npz$")
+        return sorted(p for p in self.dir.iterdir() if pat.match(p.name))
 
     def read_all(self) -> dict[str, np.ndarray]:
         self.flush()

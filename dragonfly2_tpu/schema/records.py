@@ -289,7 +289,10 @@ def _coerce(hint: Any, raw: Any) -> Any:
     if raw == "" or raw is None:
         return hint()
     if hint is int:
-        return int(float(raw))
+        try:
+            return int(raw)  # exact for >2^53 (nanosecond timestamps)
+        except ValueError:
+            return int(float(raw))  # "3.0"-style strings
     if hint is float:
         return float(raw)
     return hint(raw)

@@ -1,0 +1,12 @@
+"""The TPU trainer — the compute plane.
+
+Fills the reference's empty training core (reference
+trainer/training/training.go:33-98: `Train` runs `trainGNN` + `trainMLP`,
+both TODO-only) with real JAX/XLA fit loops:
+
+  train.py       fit loops (MLP pair scorer, GraphSAGE edge-RTT, GRU)
+  pipeline.py    record shards → device-resident batch tensors
+  checkpoint.py  orbax save/restore of model+optimizer state
+  service.py     the `Train` client-stream RPC service (rpc plane)
+  storage.py     per-source-host dataset files (trainer/storage parity)
+"""

@@ -62,10 +62,19 @@ class KVStore:
             self._data.clear()
             self._expires.clear()
 
+    def _prepare_write(self, key: str) -> None:
+        """Drop expired state before writing (redis semantics: a write to an
+        expired key starts fresh, never merges into stale data)."""
+        exp = self._expires.get(key)
+        if exp is not None and time.monotonic() > exp:
+            self._data.pop(key, None)
+            self._expires.pop(key, None)
+
     # -- strings / counters ---------------------------------------------
     def set(self, key: str, value: Any) -> None:
         with self._lock:
             self._data[key] = value
+            self._expires.pop(key, None)  # redis SET clears TTL
 
     def get(self, key: str) -> Any:
         with self._lock:
@@ -73,7 +82,8 @@ class KVStore:
 
     def incr(self, key: str, amount: int = 1) -> int:
         with self._lock:
-            cur = int(self._data.get(key, 0)) if self._alive(key) else 0
+            self._prepare_write(key)
+            cur = int(self._data.get(key, 0))
             cur += amount
             self._data[key] = cur
             return cur
@@ -81,6 +91,7 @@ class KVStore:
     # -- hashes ----------------------------------------------------------
     def hset(self, key: str, mapping: dict[str, Any]) -> int:
         with self._lock:
+            self._prepare_write(key)
             h = self._data.setdefault(key, {})
             if not isinstance(h, dict):
                 raise TypeError(f"{key} is not a hash")
@@ -100,6 +111,7 @@ class KVStore:
     # -- lists (bounded probe queues) ------------------------------------
     def rpush(self, key: str, *values: Any) -> int:
         with self._lock:
+            self._prepare_write(key)
             lst = self._data.setdefault(key, [])
             if not isinstance(lst, list):
                 raise TypeError(f"{key} is not a list")

@@ -9,13 +9,20 @@ devices so sharding/collective code paths compile and execute in CI.
 import os
 import sys
 
-# Must run before the first `import jax` anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must run before the first jax backend initialization. The container's
+# sitecustomize registers the real-TPU (axon) backend at interpreter start
+# and forces the platform, so an env var alone isn't enough — override the
+# config after import, before any device query.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

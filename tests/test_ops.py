@@ -1,0 +1,112 @@
+"""Ring collectives + segment ops, verified against single-device oracles
+on the virtual 8-device mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from dragonfly2_tpu.ops.ring import (
+    local_attention,
+    make_ring_attention,
+    ring_all_gather,
+    ring_gather_rows,
+)
+from dragonfly2_tpu.ops.segment import (
+    aggregate_neighbors,
+    masked_mean,
+    segment_mean,
+    segment_sum,
+)
+from dragonfly2_tpu.parallel.mesh import make_mesh
+
+
+class TestSegment:
+    def test_masked_mean(self):
+        v = jnp.arange(24, dtype=jnp.float32).reshape(2, 3, 4)
+        m = jnp.array([[1, 1, 0], [0, 0, 0]], jnp.float32)
+        out = masked_mean(v, m)
+        np.testing.assert_allclose(out[0], v[0, :2].mean(0))
+        np.testing.assert_allclose(out[1], jnp.zeros(4))
+
+    def test_aggregate_neighbors(self):
+        feats = jnp.eye(4, dtype=jnp.float32)
+        nbrs = jnp.array([[1, 2], [0, 0], [3, 2], [0, 1]], jnp.int32)
+        mask = jnp.array([[1, 1], [1, 0], [1, 0], [0, 0]], jnp.float32)
+        agg = aggregate_neighbors(feats, nbrs, mask)
+        np.testing.assert_allclose(agg[0], (feats[1] + feats[2]) / 2)
+        np.testing.assert_allclose(agg[1], feats[0])
+        np.testing.assert_allclose(agg[3], jnp.zeros(4))
+
+    def test_segment_ops(self):
+        data = jnp.array([1.0, 2.0, 3.0, 4.0])
+        seg = jnp.array([0, 0, 2, 2])
+        np.testing.assert_allclose(segment_sum(data, seg, 3), [3.0, 0.0, 7.0])
+        np.testing.assert_allclose(segment_mean(data, seg, 3), [1.5, 0.0, 3.5])
+
+
+@pytest.fixture(scope="module")
+def ring_mesh():
+    return make_mesh(sp=8)
+
+
+class TestRingCollectives:
+    def test_ring_all_gather(self, ring_mesh):
+        x = jnp.arange(32, dtype=jnp.float32).reshape(32, 1)
+
+        gathered = shard_map(
+            lambda s: ring_all_gather(s, "sp"),
+            mesh=ring_mesh,
+            in_specs=P("sp", None),
+            out_specs=P(None, None),
+            check_vma=False,
+        )(x)
+        # every device reconstructs the full array in ring order
+        np.testing.assert_allclose(np.asarray(gathered), np.asarray(x))
+
+    def test_ring_gather_rows(self, ring_mesh):
+        table = jnp.arange(64, dtype=jnp.float32).reshape(32, 2)
+        idx = jnp.array([0, 5, 31, 17, 8, 8, 30, 2], jnp.int32)
+
+        out = shard_map(
+            lambda t, i: ring_gather_rows(t, i, "sp"),
+            mesh=ring_mesh,
+            in_specs=(P("sp", None), P(None)),
+            out_specs=P(None, None),
+            check_vma=False,
+        )(table, idx)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(table)[np.asarray(idx)])
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ring_attention_matches_local(self, ring_mesh, causal):
+        key = jax.random.PRNGKey(0)
+        b, t, h, d = 2, 64, 4, 16
+        q, k, v = (
+            jax.random.normal(kk, (b, t, h, d), jnp.float32)
+            for kk in jax.random.split(key, 3)
+        )
+        want = local_attention(q, k, v, causal=causal)
+
+        ring = make_ring_attention(ring_mesh, "sp", causal=causal)
+        spec = NamedSharding(ring_mesh, P(None, "sp", None, None))
+        got = ring(jax.device_put(q, spec), jax.device_put(k, spec), jax.device_put(v, spec))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_ring_attention_bf16(self, ring_mesh):
+        key = jax.random.PRNGKey(1)
+        b, t, h, d = 1, 32, 2, 8
+        q, k, v = (
+            jax.random.normal(kk, (b, t, h, d), jnp.bfloat16)
+            for kk in jax.random.split(key, 3)
+        )
+        want = local_attention(q, k, v, causal=True)
+        ring = make_ring_attention(ring_mesh, "sp", causal=True)
+        spec = NamedSharding(ring_mesh, P(None, "sp", None, None))
+        got = ring(jax.device_put(q, spec), jax.device_put(k, spec), jax.device_put(v, spec))
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2
+        )

@@ -1,0 +1,131 @@
+"""Trainer fit loops: the models actually learn, data-parallel and
+federated paths run on the 8-device mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from dragonfly2_tpu.parallel.fedavg import fedavg_psum, fedavg_trees
+from dragonfly2_tpu.parallel.mesh import make_mesh, mesh_shape
+from dragonfly2_tpu.schema import synth
+from dragonfly2_tpu.schema.columnar import records_to_columns
+from dragonfly2_tpu.schema.features import build_probe_graph, extract_pair_features
+from dragonfly2_tpu.trainer.train import (
+    FitConfig,
+    GNNFitConfig,
+    evaluate_mlp,
+    train_gnn,
+    train_gru,
+    train_mlp,
+)
+
+
+class TestMeshUtils:
+    def test_make_mesh(self):
+        m = make_mesh(dp=4, mp=2)
+        assert mesh_shape(m) == {"dp": 4, "mp": 2}
+        m2 = make_mesh(dp=-1, mp=2)
+        assert mesh_shape(m2) == {"dp": 4, "mp": 2}
+        with pytest.raises(ValueError):
+            make_mesh(dp=16)
+
+    def test_default_dp(self):
+        assert mesh_shape(make_mesh()) == {"dp": 8}
+
+
+class TestTrainMLP:
+    def test_learns_synthetic_function(self):
+        x, y = synth.make_pair_tensors(20_000, seed=0)
+        cfg = FitConfig(hidden_dims=(64, 64), batch_size=1024, epochs=5, seed=0)
+        res = train_mlp(x, y, config=cfg)
+        assert res.history[-1] < res.history[0] * 0.5
+        assert res.metrics["mse"] < np.var(y) * 0.2  # ≥80% variance explained
+        assert res.metrics["mae"] > 0
+
+    def test_learns_from_real_records(self):
+        recs = synth.make_download_records(300, seed=1, parents_per_record=4)
+        pairs = extract_pair_features(records_to_columns(recs))
+        cfg = FitConfig(hidden_dims=(32, 32), batch_size=256, epochs=20, seed=0, eval_fraction=0.2)
+        res = train_mlp(pairs.features, pairs.labels, config=cfg)
+        base = float(np.var(pairs.labels))  # predict-the-mean baseline
+        assert res.metrics["mse"] < base * 0.6
+
+    def test_dp_sharded_training_matches(self):
+        mesh = make_mesh(dp=8)
+        x, y = synth.make_pair_tensors(8192, seed=2)
+        cfg = FitConfig(hidden_dims=(32,), batch_size=512, epochs=2, seed=0)
+        res = train_mlp(x, y, mesh=mesh, config=cfg)
+        res_local = train_mlp(x, y, mesh=None, config=cfg)
+        # same data+seed → numerically close loss trajectories
+        np.testing.assert_allclose(res.history, res_local.history, rtol=1e-3)
+
+
+class TestTrainGNN:
+    def test_learns_probe_graph(self):
+        recs = synth.make_topology_records(2000, num_hosts=64, seed=3)
+        g = build_probe_graph(records_to_columns(recs), max_degree=8)
+        cfg = GNNFitConfig(
+            hidden_dims=(32, 16), batch_size=512, epochs=100, learning_rate=3e-2, seed=0
+        )
+        res = train_gnn(g, config=cfg)
+        assert res.history[-1] < res.history[0] * 0.3
+        for k in ("mse", "mae", "precision", "recall", "f1"):
+            assert k in res.metrics
+        assert res.metrics["f1"] > 0.85  # RTT is a function of latent coords — learnable
+        assert res.metrics["mse"] < 0.3 * float(np.var(g.edge_rtt_log_ms))
+
+    def test_empty_graph_raises(self):
+        from dragonfly2_tpu.schema.features import build_probe_graph as bpg
+
+        g = bpg(records_to_columns([]), max_degree=4)
+        with pytest.raises(ValueError):
+            train_gnn(g)
+
+
+class TestTrainGRU:
+    def test_runs_and_learns(self):
+        rng = np.random.default_rng(0)
+        n, t, f = 2000, 12, 4
+        x = rng.normal(size=(n, t, f)).astype(np.float32)
+        # target: mean of feature-0 trajectory (requires temporal integration)
+        y = x[:, :, 0].mean(axis=1).astype(np.float32)
+        cfg = FitConfig(hidden_dims=(32,), batch_size=256, epochs=10, seed=0)
+        res = train_gru(x, y, config=cfg)
+        assert res.history[-1] < res.history[0] * 0.5
+        assert res.metrics["mse"] < float(np.var(y)) * 0.5
+
+
+class TestFedAvg:
+    def test_tree_average_weighted(self):
+        a = {"w": jnp.ones((2, 2)), "b": jnp.zeros(2)}
+        b = {"w": jnp.zeros((2, 2)), "b": jnp.ones(2) * 4}
+        avg = fedavg_trees([a, b], weights=[3, 1])
+        np.testing.assert_allclose(np.asarray(avg["w"]), 0.75)
+        np.testing.assert_allclose(np.asarray(avg["b"]), 1.0)
+
+    def test_rejects_bad_weights(self):
+        a = {"w": jnp.ones(2)}
+        with pytest.raises(ValueError):
+            fedavg_trees([a, a], weights=[0, 0])
+        with pytest.raises(ValueError):
+            fedavg_trees([])
+
+    def test_psum_fedavg_on_mesh(self):
+        mesh = make_mesh(fed=8)
+        # each "cluster" holds params equal to its index, example counts 1..8
+        params = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+        counts = jnp.arange(1, 9, dtype=jnp.float32).reshape(8, 1)
+
+        out = shard_map(
+            lambda p, c: fedavg_psum({"w": p}, c[0], axis_name="fed")["w"],
+            mesh=mesh,
+            in_specs=(P("fed", None), P("fed", None)),
+            out_specs=P("fed", None),
+            check_vma=False,
+        )(params, counts)
+        want = float((np.arange(8) * np.arange(1, 9)).sum() / np.arange(1, 9).sum())
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
