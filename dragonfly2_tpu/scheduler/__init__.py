@@ -1,0 +1,7 @@
+"""Scheduler service — the per-cluster brain.
+
+Picks parent peers for each downloading peer (scheduling + evaluator over
+the resource FSMs), collects download records and network-topology probes,
+and feeds them to the TPU trainer (reference scheduler/ package tree,
+SURVEY.md §2.2).
+"""

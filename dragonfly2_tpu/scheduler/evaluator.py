@@ -1,0 +1,226 @@
+"""Parent evaluators: rank candidate parents for a downloading peer.
+
+- ``BaseEvaluator`` — the hand-tuned linear score (reference
+  evaluator_base.go:32-104: weights piece 0.2, upload-success 0.2,
+  free-upload 0.15, host-type 0.15, IDC 0.15, location 0.15) plus the
+  statistical bad-node detector (mean×20 for n<30, mean+3σ otherwise,
+  reference evaluator_base.go:211-247).
+- ``MLEvaluator`` — the algorithm the reference left TODO (reference
+  evaluator.go:53): ranks parents by the TPU-trained MLP's predicted piece
+  cost, built from the same live resource state the linear score reads.
+  Falls back to the base score when no model is loaded or inference fails.
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+from typing import Protocol
+
+import numpy as np
+
+from dragonfly2_tpu.scheduler.resource import (
+    PEER_STATE_BACK_TO_SOURCE,
+    PEER_STATE_FAILED,
+    PEER_STATE_LEAVE,
+    PEER_STATE_PENDING,
+    PEER_STATE_RECEIVED_EMPTY,
+    PEER_STATE_RECEIVED_NORMAL,
+    PEER_STATE_RECEIVED_SMALL,
+    PEER_STATE_RECEIVED_TINY,
+    PEER_STATE_RUNNING,
+    PEER_STATE_SUCCEEDED,
+    HostType,
+    Peer,
+)
+
+# feature weights (reference evaluator_base.go:32-50)
+FINISHED_PIECE_WEIGHT = 0.2
+UPLOAD_SUCCESS_WEIGHT = 0.2
+FREE_UPLOAD_WEIGHT = 0.15
+HOST_TYPE_WEIGHT = 0.15
+IDC_AFFINITY_WEIGHT = 0.15
+LOCATION_AFFINITY_WEIGHT = 0.15
+
+MAX_SCORE = 1.0
+MIN_SCORE = 0.0
+
+NORMAL_DISTRIBUTION_LEN = 30
+MIN_AVAILABLE_COST_LEN = 2
+MAX_ELEMENT_LEN = 5
+AFFINITY_SEPARATOR = "|"
+
+_BAD_STATES = (
+    PEER_STATE_FAILED,
+    PEER_STATE_LEAVE,
+    PEER_STATE_PENDING,
+    PEER_STATE_RECEIVED_TINY,
+    PEER_STATE_RECEIVED_SMALL,
+    PEER_STATE_RECEIVED_NORMAL,
+    PEER_STATE_RECEIVED_EMPTY,
+)
+
+
+class Evaluator(Protocol):
+    def evaluate_parents(
+        self, parents: list[Peer], child: Peer, total_piece_count: int
+    ) -> list[Peer]: ...
+
+    def is_bad_node(self, peer: Peer) -> bool: ...
+
+
+def piece_score(parent: Peer, child: Peer, total_piece_count: int) -> float:
+    if total_piece_count > 0:
+        return parent.finished_piece_count() / total_piece_count
+    return float(parent.finished_piece_count() - child.finished_piece_count())
+
+
+def upload_success_score(parent: Peer) -> float:
+    uploads = parent.host.upload_count
+    failed = parent.host.upload_failed_count
+    if uploads < failed:
+        return MIN_SCORE
+    if uploads == 0 and failed == 0:
+        return MAX_SCORE  # never scheduled → try it first
+    return (uploads - failed) / uploads
+
+
+def free_upload_score(parent: Peer) -> float:
+    limit = parent.host.concurrent_upload_limit
+    free = parent.host.free_upload_count()
+    if limit > 0 and free > 0:
+        return free / limit
+    return MIN_SCORE
+
+
+def host_type_score(parent: Peer) -> float:
+    """Seed peers win for first-time downloads; steady-state favors
+    dfdaemon peers (reference evaluator_base.go:calculateHostTypeScore)."""
+    if parent.host.type is not HostType.NORMAL:
+        if parent.fsm.is_state(PEER_STATE_RECEIVED_NORMAL, PEER_STATE_RUNNING):
+            return MAX_SCORE
+        return MIN_SCORE
+    return MAX_SCORE * 0.5
+
+
+def idc_affinity_score(dst: str, src: str) -> float:
+    if not dst or not src:
+        return MIN_SCORE
+    return MAX_SCORE if dst.lower() == src.lower() else MIN_SCORE
+
+
+def location_affinity_score(dst: str, src: str) -> float:
+    if not dst or not src:
+        return MIN_SCORE
+    if dst.lower() == src.lower():
+        return MAX_SCORE
+    de = dst.split(AFFINITY_SEPARATOR)
+    se = src.split(AFFINITY_SEPARATOR)
+    n = min(len(de), len(se), MAX_ELEMENT_LEN)
+    score = 0
+    for i in range(n):
+        if de[i].lower() != se[i].lower():
+            break
+        score += 1
+    return score / MAX_ELEMENT_LEN
+
+
+class BaseEvaluator:
+    def evaluate(self, parent: Peer, child: Peer, total_piece_count: int) -> float:
+        return (
+            FINISHED_PIECE_WEIGHT * piece_score(parent, child, total_piece_count)
+            + UPLOAD_SUCCESS_WEIGHT * upload_success_score(parent)
+            + FREE_UPLOAD_WEIGHT * free_upload_score(parent)
+            + HOST_TYPE_WEIGHT * host_type_score(parent)
+            + IDC_AFFINITY_WEIGHT
+            * idc_affinity_score(parent.host.network.idc, child.host.network.idc)
+            + LOCATION_AFFINITY_WEIGHT
+            * location_affinity_score(
+                parent.host.network.location, child.host.network.location
+            )
+        )
+
+    def evaluate_parents(
+        self, parents: list[Peer], child: Peer, total_piece_count: int
+    ) -> list[Peer]:
+        return sorted(
+            parents,
+            key=lambda p: self.evaluate(p, child, total_piece_count),
+            reverse=True,
+        )
+
+    def is_bad_node(self, peer: Peer) -> bool:
+        if peer.fsm.is_state(*_BAD_STATES):
+            return True
+        costs = peer.piece_costs()
+        n = len(costs)
+        if n < MIN_AVAILABLE_COST_LEN:
+            return False
+        last = costs[-1]
+        mean = sum(costs[:-1]) / (n - 1)
+        if n < NORMAL_DISTRIBUTION_LEN:
+            return last > mean * 20
+        stdev = statistics.pstdev(costs[:-1])
+        return last > mean + 3 * stdev
+
+
+class MLEvaluator(BaseEvaluator):
+    """Ranks parents by the trained MLP's predicted piece cost — lower
+    predicted cost sorts first. Shares IsBadNode with the base."""
+
+    def __init__(self, model=None):
+        self._model = model  # ml.scorer.MLPScorer-compatible
+        super().__init__()
+
+    def set_model(self, model) -> None:
+        self._model = model
+
+    def evaluate_parents(
+        self, parents: list[Peer], child: Peer, total_piece_count: int
+    ) -> list[Peer]:
+        if self._model is None or not parents:
+            return super().evaluate_parents(parents, child, total_piece_count)
+        try:
+            feats = np.stack(
+                [pair_features(p, child, total_piece_count) for p in parents]
+            )
+            costs = self._model.predict(feats)  # [P] predicted log piece cost
+            order = np.argsort(costs, kind="stable")
+            return [parents[int(i)] for i in order]
+        except Exception:
+            # degraded mode: never fail scheduling because of the model
+            return super().evaluate_parents(parents, child, total_piece_count)
+
+
+def pair_features(parent: Peer, child: Peer, total_piece_count: int) -> np.ndarray:
+    """Live (child, parent) features in schema.features.MLP_FEATURE_NAMES
+    order — must stay in lockstep with the offline extraction the model was
+    trained on (schema/features.py)."""
+    h = parent.host
+    uploads, failed = h.upload_count, h.upload_failed_count
+    return np.array(
+        [
+            min(max(piece_score(parent, child, total_piece_count), 0.0), 1.0),
+            (uploads - failed) / uploads if uploads > 0 else 1.0,
+            min(max(h.free_upload_count() / h.concurrent_upload_limit, 0.0), 1.0)
+            if h.concurrent_upload_limit > 0
+            else 0.0,
+            0.0 if h.type is HostType.NORMAL else 1.0,
+            idc_affinity_score(h.network.idc, child.host.network.idc),
+            location_affinity_score(h.network.location, child.host.network.location),
+            h.cpu.percent / 100.0,
+            h.memory.used_percent / 100.0,
+            math.log1p(h.network.tcp_connection_count) / 10.0,
+            math.log1p(h.network.upload_tcp_connection_count) / 10.0,
+            h.disk.used_percent / 100.0,
+            1.0 if parent.fsm.is_state(PEER_STATE_SUCCEEDED) else 0.0,
+        ],
+        dtype=np.float32,
+    )
+
+
+def new_evaluator(algorithm: str = "default", model=None) -> Evaluator:
+    """Factory (reference evaluator.go:26-59: default | ml | plugin)."""
+    if algorithm == "ml":
+        return MLEvaluator(model)
+    return BaseEvaluator()

@@ -1,0 +1,197 @@
+"""Network topology: the probe graph the GNN trains on.
+
+KV-backed (Redis role) store of host→host probe measurements (reference
+scheduler/networktopology/network_topology.go:52-436, probes.go:37-383):
+
+- ``networktopology:src:dest`` hash — averageRTT + created/updated times
+- ``probes:src:dest`` list — bounded queue (len 5) of raw probes
+- ``probedcount:host`` counter — fairness signal for probe target choice
+
+EWMA: averageRTT = 0.1·old + 0.9·new (old-average weight 0.1 — nearly
+last-sample; reference probes.go:195-196). ``find_probed_hosts`` picks ≤50
+random candidate hosts and returns the 5 least-probed. ``snapshot`` walks
+the store and appends NetworkTopologyRecord rows to scheduler storage
+every collect interval (default 2h).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from dragonfly2_tpu.schema import records as R
+from dragonfly2_tpu.scheduler.resource import Host, HostManager
+from dragonfly2_tpu.scheduler.storage import Storage
+from dragonfly2_tpu.utils.kvstore import (
+    KVStore,
+    make_network_topology_key,
+    make_probed_count_key,
+    make_probes_key,
+)
+
+# defaults (reference scheduler/config/constants.go:176-189,
+# network_topology.go:48-49)
+DEFAULT_PROBE_QUEUE_LENGTH = 5
+DEFAULT_PROBE_COUNT = 5  # hosts probed per sync round
+DEFAULT_CANDIDATE_HOSTS = 50  # random candidate pool per request
+DEFAULT_COLLECT_INTERVAL = 2 * 3600.0
+EWMA_OLD_WEIGHT = 0.1  # averageRTT = 0.1*old + 0.9*new
+
+NS_PER_S = 1_000_000_000
+
+
+@dataclass
+class Probe:
+    host_id: str
+    rtt_ns: int
+    created_at: float = field(default_factory=time.time)
+
+
+class NetworkTopology:
+    def __init__(
+        self,
+        kv: KVStore,
+        host_manager: HostManager,
+        storage: Storage | None = None,
+        queue_length: int = DEFAULT_PROBE_QUEUE_LENGTH,
+        probe_count: int = DEFAULT_PROBE_COUNT,
+        candidate_hosts: int = DEFAULT_CANDIDATE_HOSTS,
+    ):
+        self.kv = kv
+        self.host_manager = host_manager
+        self.storage = storage
+        self.queue_length = queue_length
+        self.probe_count = probe_count
+        self.candidate_hosts = candidate_hosts
+
+    # -- probe ingestion (SyncProbes server side) -------------------------
+    def has_edge(self, src: str, dest: str) -> bool:
+        return self.kv.exists(make_network_topology_key(src, dest))
+
+    def store_edge(self, src: str, dest: str) -> None:
+        """Create the edge hash on first probe between a pair."""
+        key = make_network_topology_key(src, dest)
+        if not self.kv.exists(key):
+            now_ns = int(time.time() * NS_PER_S)
+            self.kv.hset(key, {"averageRTT": 0, "createdAt": now_ns, "updatedAt": now_ns})
+
+    def enqueue_probe(self, src: str, probe: Probe) -> None:
+        """Append a raw probe, maintain the bounded queue and the EWMA
+        (reference probes.go:145-222)."""
+        dest = probe.host_id
+        self.store_edge(src, dest)
+        qkey = make_probes_key(src, dest)
+        if self.kv.llen(qkey) >= self.queue_length:
+            self.kv.lpop(qkey)
+        self.kv.rpush(qkey, {"rtt": probe.rtt_ns, "createdAt": probe.created_at})
+
+        ekey = make_network_topology_key(src, dest)
+        old = self.kv.hget(ekey, "averageRTT") or 0
+        if old == 0:
+            avg = probe.rtt_ns
+        else:
+            avg = int(EWMA_OLD_WEIGHT * old + (1 - EWMA_OLD_WEIGHT) * probe.rtt_ns)
+        self.kv.hset(
+            ekey,
+            {"averageRTT": avg, "updatedAt": int(probe.created_at * NS_PER_S)},
+        )
+        self.kv.incr(make_probed_count_key(dest))
+
+    def average_rtt(self, src: str, dest: str) -> int | None:
+        v = self.kv.hget(make_network_topology_key(src, dest), "averageRTT")
+        return int(v) if v is not None else None
+
+    def probes(self, src: str, dest: str) -> list[dict]:
+        return self.kv.lrange(make_probes_key(src, dest), 0, -1)
+
+    def probed_count(self, host_id: str) -> int:
+        return int(self.kv.get(make_probed_count_key(host_id)) or 0)
+
+    # -- probe target selection ------------------------------------------
+    def find_probed_hosts(self, src_host_id: str) -> list[Host]:
+        """≤candidate_hosts random hosts (excluding src) → the probe_count
+        least-probed (reference network_topology.go:183-250)."""
+        hosts = [h for h in self.host_manager.all() if h.id != src_host_id]
+        if not hosts:
+            return []
+        if len(hosts) > self.candidate_hosts:
+            hosts = random.sample(hosts, self.candidate_hosts)
+        hosts.sort(key=lambda h: self.probed_count(h.id))
+        return hosts[: self.probe_count]
+
+    # -- lifecycle --------------------------------------------------------
+    def delete_host(self, host_id: str) -> None:
+        """Purge all probe state touching a departed host (reference
+        network_topology.go:253-291)."""
+        keys = (
+            self.kv.scan_iter(f"networktopology:{host_id}:*")
+            + self.kv.scan_iter(f"networktopology:*:{host_id}")
+            + self.kv.scan_iter(f"probes:{host_id}:*")
+            + self.kv.scan_iter(f"probes:*:{host_id}")
+            + [make_probed_count_key(host_id)]
+        )
+        if keys:
+            self.kv.delete(*keys)
+
+    # -- snapshot (training-data export) ----------------------------------
+    def snapshot(self) -> int:
+        """Walk the probe graph and append one NetworkTopologyRecord per
+        source host (up to 5 dest hosts each, reference
+        network_topology.go:325-436). Returns rows written."""
+        if self.storage is None:
+            return 0
+        by_src: dict[str, list[str]] = {}
+        for key in self.kv.scan_iter("networktopology:*:*"):
+            _, src, dest = key.split(":", 2)
+            by_src.setdefault(src, []).append(dest)
+
+        rows = 0
+        now_ns = int(time.time() * NS_PER_S)
+        for src, dests in by_src.items():
+            sh = self.host_manager.load(src)
+            if sh is None:
+                continue
+            dest_hosts: list[R.DestHost] = []
+            for dest in dests[: R.MAX_DEST_HOSTS]:
+                dh = self.host_manager.load(dest)
+                if dh is None:
+                    continue
+                edge = self.kv.hgetall(make_network_topology_key(src, dest))
+                if not edge:
+                    continue
+                dest_hosts.append(
+                    R.DestHost(
+                        id=dh.id,
+                        type=dh.type.value,
+                        hostname=dh.hostname,
+                        ip=dh.ip,
+                        port=dh.port,
+                        network=dh.network,
+                        probes=R.ProbesRecord(
+                            average_rtt=int(edge.get("averageRTT", 0)),
+                            created_at=int(edge.get("createdAt", 0)),
+                            updated_at=int(edge.get("updatedAt", 0)),
+                        ),
+                    )
+                )
+            if not dest_hosts:
+                continue
+            self.storage.create_network_topology(
+                R.NetworkTopologyRecord(
+                    id=str(uuid.uuid4()),
+                    host=R.SrcHost(
+                        id=sh.id,
+                        type=sh.type.value,
+                        hostname=sh.hostname,
+                        ip=sh.ip,
+                        port=sh.port,
+                        network=sh.network,
+                    ),
+                    dest_hosts=dest_hosts,
+                    created_at=now_ns,
+                )
+            )
+            rows += 1
+        return rows

@@ -1,0 +1,218 @@
+"""Scheduling algorithm: assign candidate parents to downloading peers.
+
+Semantics track the reference's v2 path (reference
+scheduler/scheduling/scheduling.go:85-213 ScheduleCandidateParents,
+:383-424 FindCandidateParents, :500-571 filterCandidateParents) — the
+retry loop with back-to-source decisions, and the six filter rules:
+blocklist, DAG-edge feasibility, same-host exclusion, bad-node, the
+in-degree/seed "parent must itself be fed" rule, and free upload slots.
+
+Decisions are pushed to the peer's stored stream handle (installed by the
+RPC layer); responses are plain dataclasses so the algorithm is
+transport-independent and testable in-process, the same way the reference
+tests drive it against scripted mocks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from dragonfly2_tpu.scheduler.evaluator import Evaluator
+from dragonfly2_tpu.scheduler.resource import (
+    PEER_STATE_BACK_TO_SOURCE,
+    PEER_STATE_RECEIVED_NORMAL,
+    PEER_STATE_RUNNING,
+    PEER_STATE_SUCCEEDED,
+    HostType,
+    Peer,
+)
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("scheduling")
+
+# defaults (reference scheduler/config/constants.go)
+DEFAULT_RETRY_LIMIT = 5
+DEFAULT_RETRY_BACK_TO_SOURCE_LIMIT = 3
+DEFAULT_RETRY_INTERVAL = 0.05
+DEFAULT_FILTER_PARENT_LIMIT = 15
+DEFAULT_CANDIDATE_PARENT_LIMIT = 4
+
+
+@dataclass
+class SchedulingConfig:
+    retry_limit: int = DEFAULT_RETRY_LIMIT
+    retry_back_to_source_limit: int = DEFAULT_RETRY_BACK_TO_SOURCE_LIMIT
+    retry_interval: float = DEFAULT_RETRY_INTERVAL
+    filter_parent_limit: int = DEFAULT_FILTER_PARENT_LIMIT
+    candidate_parent_limit: int = DEFAULT_CANDIDATE_PARENT_LIMIT
+
+
+# -- responses pushed to the peer's stream ----------------------------------
+
+
+@dataclass
+class NormalTaskResponse:
+    candidate_parents: list[Peer]
+
+
+@dataclass
+class NeedBackToSourceResponse:
+    description: str
+
+
+class SchedulingError(Exception):
+    pass
+
+
+class Scheduling:
+    def __init__(
+        self,
+        evaluator: Evaluator,
+        config: SchedulingConfig | None = None,
+        dynconfig=None,  # optional provider of live candidate/filter limits
+    ):
+        self.evaluator = evaluator
+        self.config = config or SchedulingConfig()
+        self.dynconfig = dynconfig
+
+    # -- limits (dynconfig-overridable, reference scheduling.go:405-413) --
+    def _candidate_parent_limit(self) -> int:
+        if self.dynconfig is not None:
+            v = getattr(self.dynconfig, "candidate_parent_limit", 0)
+            if v and v > 0:
+                return int(v)
+        return self.config.candidate_parent_limit
+
+    def _filter_parent_limit(self) -> int:
+        if self.dynconfig is not None:
+            v = getattr(self.dynconfig, "filter_parent_limit", 0)
+            if v and v > 0:
+                return int(v)
+        return self.config.filter_parent_limit
+
+    # -- v2 entrypoint ----------------------------------------------------
+    def schedule_candidate_parents(
+        self, peer: Peer, blocklist: set[str] | None = None, cancelled=None
+    ) -> None:
+        """Retry loop: find candidates and push NormalTaskResponse, or
+        decide back-to-source (peer demand or retry exhaustion) and push
+        NeedBackToSourceResponse. Raises SchedulingError when the retry
+        limit is exhausted and back-to-source isn't possible."""
+        blocklist = blocklist or set()
+        n = 0
+        while True:
+            if cancelled is not None and cancelled():
+                return
+
+            if peer.task.can_back_to_source():
+                if peer.need_back_to_source:
+                    self._send(
+                        peer,
+                        NeedBackToSourceResponse("peer's NeedBackToSource is true"),
+                    )
+                    return
+                if n >= self.config.retry_back_to_source_limit:
+                    self._send(
+                        peer,
+                        NeedBackToSourceResponse(
+                            "scheduling exceeded RetryBackToSourceLimit"
+                        ),
+                    )
+                    return
+
+            if n >= self.config.retry_limit:
+                raise SchedulingError(
+                    f"scheduling exceeded RetryLimit {self.config.retry_limit}"
+                )
+
+            # re-schedule from a clean slate: drop existing parent edges
+            peer.task.delete_peer_in_edges(peer.id)
+
+            candidate_parents, found = self.find_candidate_parents(peer, blocklist)
+            if not found:
+                n += 1
+                time.sleep(self.config.retry_interval)
+                continue
+
+            self._send(peer, NormalTaskResponse(candidate_parents))
+
+            for parent in candidate_parents:
+                try:
+                    peer.task.add_peer_edge(parent, peer)
+                except Exception as e:
+                    logger.warning("peer %s add edge failed: %s", peer.id, e)
+            return
+
+    # -- finders ----------------------------------------------------------
+    def find_candidate_parents(
+        self, peer: Peer, blocklist: set[str] | None = None
+    ) -> tuple[list[Peer], bool]:
+        blocklist = blocklist or set()
+        # only ReceivedNormal/Running peers reschedule; other states
+        # (incl. BackToSource) are already placed
+        if not peer.fsm.is_state(PEER_STATE_RECEIVED_NORMAL, PEER_STATE_RUNNING):
+            return [], False
+
+        candidates = self._filter_candidate_parents(peer, blocklist)
+        if not candidates:
+            return [], False
+
+        total = peer.task.total_piece_count
+        candidates = self.evaluator.evaluate_parents(candidates, peer, total)
+        limit = self._candidate_parent_limit()
+        return candidates[:limit], True
+
+    def find_success_parent(
+        self, peer: Peer, blocklist: set[str] | None = None
+    ) -> Peer | None:
+        if not peer.fsm.is_state(PEER_STATE_RUNNING):
+            return None
+        candidates = self._filter_candidate_parents(peer, blocklist or set())
+        succeeded = [c for c in candidates if c.fsm.is_state(PEER_STATE_SUCCEEDED)]
+        if not succeeded:
+            return None
+        total = peer.task.total_piece_count
+        return self.evaluator.evaluate_parents(succeeded, peer, total)[0]
+
+    def _filter_candidate_parents(self, peer: Peer, blocklist: set[str]) -> list[Peer]:
+        """The six filter rules (reference scheduling.go:500-571)."""
+        out = []
+        for cand in peer.task.load_random_peers(self._filter_parent_limit()):
+            if cand.id in blocklist:
+                continue
+            # peer-side blocks (reported bad parents) are also respected
+            if cand.id in peer.block_parents:
+                continue
+            if not peer.task.can_add_peer_edge(cand.id, peer.id):
+                continue
+            # two daemons on one host would download from each other
+            if peer.host.id == cand.host.id:
+                continue
+            if self.evaluator.is_bad_node(cand):
+                continue
+            try:
+                in_degree = peer.task.peer_in_degree(cand.id)
+            except Exception:
+                continue
+            # a normal-host parent must itself be fed: have a parent, or be
+            # back-to-source, or have finished
+            if (
+                cand.host.type is HostType.NORMAL
+                and in_degree == 0
+                and not cand.fsm.is_state(PEER_STATE_BACK_TO_SOURCE)
+                and not cand.fsm.is_state(PEER_STATE_SUCCEEDED)
+            ):
+                continue
+            if cand.host.free_upload_count() <= 0:
+                continue
+            out.append(cand)
+        return out
+
+    @staticmethod
+    def _send(peer: Peer, response) -> None:
+        stream = peer.load_stream()
+        if stream is None:
+            raise SchedulingError(f"peer {peer.id}: load stream failed")
+        stream.send(response)

@@ -1,0 +1,218 @@
+"""Scheduler storage — the training-data sink.
+
+On every finished (or failed) download the service layer builds a
+``DownloadRecord`` from live resource state and appends it here (reference
+service_v1.go:1418-1632 createDownloadRecord → storage.CreateDownload);
+the topology snapshotter appends ``NetworkTopologyRecord`` rows. Files
+rotate by size with bounded backups (reference
+scheduler/storage/storage.go:92-139) and are what the announcer uploads to
+the trainer.
+
+Dual sink: CSV (reference-compatible information content) + npz columnar
+blocks (the TPU ingestion fast path).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+
+from dragonfly2_tpu.schema import records as R
+from dragonfly2_tpu.schema.columnar import (
+    BlockWriter,
+    RotatingCSVWriter,
+    records_to_columns,
+)
+from dragonfly2_tpu.scheduler.resource import Peer
+from dragonfly2_tpu.scheduler.resource.host import Host
+from dragonfly2_tpu.scheduler.resource.task import Task
+
+NS_PER_S = 1_000_000_000
+
+
+class Storage:
+    def __init__(
+        self,
+        directory: str | Path,
+        max_size: int = 100 * 1024 * 1024,
+        max_backups: int = 10,
+        buffer_size: int = 64,
+        write_blocks: bool = True,
+    ):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._download = RotatingCSVWriter(
+            self.dir, "download", R.DownloadRecord, max_size, max_backups, buffer_size
+        )
+        self._topology = RotatingCSVWriter(
+            self.dir,
+            "networktopology",
+            R.NetworkTopologyRecord,
+            max_size,
+            max_backups,
+            buffer_size,
+        )
+        self._blocks_download = (
+            BlockWriter(self.dir / "blocks", "download") if write_blocks else None
+        )
+        self._blocks_topology = (
+            BlockWriter(self.dir / "blocks", "networktopology") if write_blocks else None
+        )
+        self._lock = threading.Lock()
+
+    # -- writes ----------------------------------------------------------
+    def create_download(self, rec: R.DownloadRecord) -> None:
+        with self._lock:
+            self._download.create(rec)
+            if self._blocks_download is not None:
+                self._blocks_download.append_columns(records_to_columns([rec]))
+
+    def create_network_topology(self, rec: R.NetworkTopologyRecord) -> None:
+        with self._lock:
+            self._topology.create(rec)
+            if self._blocks_topology is not None:
+                self._blocks_topology.append_columns(records_to_columns([rec]))
+
+    def flush(self) -> None:
+        with self._lock:
+            self._download.flush()
+            self._topology.flush()
+            if self._blocks_download is not None:
+                self._blocks_download.flush()
+            if self._blocks_topology is not None:
+                self._blocks_topology.flush()
+
+    # -- reads (trainer upload path) --------------------------------------
+    def list_download(self) -> list[R.DownloadRecord]:
+        with self._lock:
+            return self._download.read_all()
+
+    def list_network_topology(self) -> list[R.NetworkTopologyRecord]:
+        with self._lock:
+            return self._topology.read_all()
+
+    def open_download_files(self) -> list[Path]:
+        with self._lock:
+            self._download.flush()
+            return self._download.all_files()
+
+    def open_network_topology_files(self) -> list[Path]:
+        with self._lock:
+            self._topology.flush()
+            return self._topology.all_files()
+
+    def clear_download(self) -> None:
+        with self._lock:
+            self._download.clear()
+
+    def clear_network_topology(self) -> None:
+        with self._lock:
+            self._topology.clear()
+
+
+# ---------------------------------------------------------------------------
+# Record construction from live resource state
+# ---------------------------------------------------------------------------
+
+
+def host_record(h: Host) -> R.HostRecord:
+    return R.HostRecord(
+        id=h.id,
+        type=h.type.value,
+        hostname=h.hostname,
+        ip=h.ip,
+        port=h.port,
+        download_port=h.download_port,
+        os=h.os,
+        platform=h.platform,
+        platform_family=h.platform_family,
+        platform_version=h.platform_version,
+        kernel_version=h.kernel_version,
+        concurrent_upload_limit=h.concurrent_upload_limit,
+        concurrent_upload_count=h.concurrent_upload_count,
+        upload_count=h.upload_count,
+        upload_failed_count=h.upload_failed_count,
+        cpu=h.cpu,
+        memory=h.memory,
+        network=h.network,
+        disk=h.disk,
+        build=h.build,
+        scheduler_cluster_id=h.scheduler_cluster_id,
+        created_at=int(h.created_at * NS_PER_S),
+        updated_at=int(h.updated_at * NS_PER_S),
+    )
+
+
+def task_record(t: Task) -> R.TaskRecord:
+    return R.TaskRecord(
+        id=t.id,
+        url=t.url,
+        type=t.type.value,
+        content_length=t.content_length,
+        total_piece_count=t.total_piece_count,
+        back_to_source_limit=t.back_to_source_limit,
+        back_to_source_peer_count=len(t.back_to_source_peers),
+        state=t.fsm.current,
+        created_at=int(t.created_at * NS_PER_S),
+        updated_at=int(t.updated_at * NS_PER_S),
+    )
+
+
+def build_download_record(
+    peer: Peer, error_code: str = "", error_message: str = ""
+) -> R.DownloadRecord:
+    """Snapshot a finished/failed peer into the MLP training schema
+    (reference service_v1.go:1418-1632): the peer itself, its task and
+    host, and up to 20 parents each with up to 10 per-piece costs."""
+    task = peer.task
+    parents: list[R.ParentRecord] = []
+    for parent in task.peer_parents(peer.id)[: R.MAX_PARENTS]:
+        pieces = [
+            R.PieceRecord(
+                length=pc.length,
+                cost=int(pc.cost_ms * 1e6),
+                created_at=int(pc.created_at * NS_PER_S) if pc.created_at else 0,
+            )
+            for pc in _parent_pieces(peer, parent.id)[: R.MAX_PIECES_PER_PARENT]
+        ]
+        parents.append(
+            R.ParentRecord(
+                id=parent.id,
+                tag=parent.tag,
+                application=parent.application,
+                state=parent.fsm.current,
+                cost=parent.cost_ns,
+                upload_piece_count=len(pieces),
+                finished_piece_count=parent.finished_piece_count(),
+                host=host_record(parent.host),
+                pieces=pieces,
+                created_at=int(parent.created_at * NS_PER_S),
+                updated_at=int(parent.updated_at * NS_PER_S),
+            )
+        )
+    return R.DownloadRecord(
+        id=peer.id,
+        tag=peer.tag,
+        application=peer.application,
+        state=peer.fsm.current,
+        error=R.ErrorInfo(code=error_code, message=error_message),
+        cost=peer.cost_ns,
+        finished_piece_count=peer.finished_piece_count(),
+        task=task_record(task),
+        host=host_record(peer.host),
+        parents=parents,
+        created_at=int(peer.created_at * NS_PER_S),
+        updated_at=int(peer.updated_at * NS_PER_S),
+    )
+
+
+def _parent_pieces(peer: Peer, parent_id: str):
+    """Pieces this child downloaded from this specific parent (piece
+    provenance lives on the downloading peer)."""
+    out = []
+    for number in sorted(peer.finished_pieces):
+        piece = peer.pieces.get(number)
+        if piece is not None and piece.parent_id == parent_id:
+            out.append(piece)
+    return out

@@ -1,0 +1,97 @@
+"""Model serving: the consumption side of the train→serve loop.
+
+The reference declared (but never wired) Triton serving for trained models
+(reference manager/types/model.go:36-37 `tensorrt_plan` configs, the
+undialed inference client pkg/rpc/inference/client/client_v1.go). Here the
+equivalent is in-process XLA serving: the scheduler's ml evaluator loads
+the params pytree the trainer uploaded and scores candidate parents with a
+jitted forward — no sidecar, no extra hop, same XLA compiler on CPU or
+chip.
+
+Serialization: flat ``{dotted/path: ndarray}`` npz — same trick as the
+columnar codec, readable anywhere numpy exists.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any
+
+import numpy as np
+
+
+def serialize_params(params: Any) -> bytes:
+    """Parameter pytree (dicts/lists of arrays) → npz bytes."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    arrays = {}
+    for path, leaf in flat:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        arrays[key] = np.asarray(leaf)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def deserialize_params(blob: bytes, like: Any) -> Any:
+    """npz bytes → pytree with the structure of ``like``."""
+    import jax
+
+    with np.load(io.BytesIO(blob)) as z:
+        flat_like, treedef = jax.tree_util.tree_flatten_with_path(like)
+        leaves = []
+        for path, leaf in flat_like:
+            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            leaves.append(z[key])
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class MLPScorer:
+    """Jitted parent scorer around trained MLP params — the object the
+    scheduler's MLEvaluator calls ``predict`` on."""
+
+    def __init__(self, params: Any):
+        import jax
+
+        from dragonfly2_tpu.models.mlp import score_parents
+
+        self._params = params
+        self._fn = jax.jit(score_parents)
+
+    def predict(self, features: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        return np.asarray(self._fn(self._params, jnp.asarray(features)))
+
+
+class GNNScorer:
+    """Edge-RTT predictor over a fixed probe graph: scores (src, dst) host
+    pairs by predicted RTT (for seed placement / cross-host ranking)."""
+
+    def __init__(self, params: Any, graph):
+        import jax
+        import jax.numpy as jnp
+
+        from dragonfly2_tpu.models.gnn import apply_graphsage, predict_edge
+
+        self._params = params
+        self._node_index = {hid: i for i, hid in enumerate(graph.node_ids)}
+        emb = jax.jit(apply_graphsage)(
+            params,
+            jnp.asarray(graph.node_features),
+            jnp.asarray(graph.neighbors),
+            jnp.asarray(graph.neighbor_mask),
+        )
+        self._emb = emb
+        self._predict = jax.jit(predict_edge)
+
+    def has_host(self, host_id: str) -> bool:
+        return host_id in self._node_index
+
+    def predict_rtt_log_ms(self, src_ids: list[str], dst_ids: list[str]) -> np.ndarray:
+        import jax.numpy as jnp
+
+        src = jnp.asarray([self._node_index[s] for s in src_ids], jnp.int32)
+        dst = jnp.asarray([self._node_index[d] for d in dst_ids], jnp.int32)
+        return np.asarray(self._predict(self._params, self._emb, src, dst))

@@ -1,0 +1,73 @@
+"""Trainer storage: per-source-host dataset files (reference
+trainer/storage/storage.go:44-148).
+
+The Train stream appends raw CSV chunks under the uploading scheduler's
+hostID — ``download_<hostID>.csv`` / ``networktopology_<hostID>.csv`` —
+and the fit loops list them back as records. Per-host keying is what makes
+multi-cluster federation natural: one host's files = one FedAvg shard.
+"""
+
+from __future__ import annotations
+
+import threading
+from pathlib import Path
+
+from dragonfly2_tpu.schema import records as R
+from dragonfly2_tpu.schema.columnar import read_csv
+
+
+class TrainerStorage:
+    def __init__(self, directory: str | Path):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def download_path(self, host_id: str) -> Path:
+        return self.dir / f"download_{host_id}.csv"
+
+    def network_topology_path(self, host_id: str) -> Path:
+        return self.dir / f"networktopology_{host_id}.csv"
+
+    # -- stream append (Train RPC demux target) ---------------------------
+    def append_download(self, host_id: str, chunk: bytes) -> None:
+        with self._lock, open(self.download_path(host_id), "ab") as f:
+            f.write(chunk)
+
+    def append_network_topology(self, host_id: str, chunk: bytes) -> None:
+        with self._lock, open(self.network_topology_path(host_id), "ab") as f:
+            f.write(chunk)
+
+    # -- reads ------------------------------------------------------------
+    def list_download(self, host_id: str) -> list[R.DownloadRecord]:
+        p = self.download_path(host_id)
+        if not p.exists():
+            return []
+        return read_csv(p, R.DownloadRecord)
+
+    def list_network_topology(self, host_id: str) -> list[R.NetworkTopologyRecord]:
+        p = self.network_topology_path(host_id)
+        if not p.exists():
+            return []
+        return read_csv(p, R.NetworkTopologyRecord)
+
+    def host_ids(self) -> list[str]:
+        """Every host with at least one dataset file (the FedAvg shards)."""
+        ids = set()
+        for p in self.dir.glob("download_*.csv"):
+            ids.add(p.stem.removeprefix("download_"))
+        for p in self.dir.glob("networktopology_*.csv"):
+            ids.add(p.stem.removeprefix("networktopology_"))
+        return sorted(ids)
+
+    # -- cleanup ----------------------------------------------------------
+    def clear_download(self, host_id: str) -> None:
+        self.download_path(host_id).unlink(missing_ok=True)
+
+    def clear_network_topology(self, host_id: str) -> None:
+        self.network_topology_path(host_id).unlink(missing_ok=True)
+
+    def clear(self) -> None:
+        for p in list(self.dir.glob("download_*.csv")) + list(
+            self.dir.glob("networktopology_*.csv")
+        ):
+            p.unlink(missing_ok=True)

@@ -74,9 +74,15 @@ def _shard_arrays(mesh, *arrays, axis: str = "dp"):
     return tuple(jax.device_put(a, s) for a in arrays)
 
 
-def _batch_steps(n: int, batch: int) -> tuple[int, int]:
+def _batch_steps(n: int, batch: int) -> tuple[int, int, int]:
+    """→ (steps, rows_used, batch) with batch clamped to the training-set
+    size. Shared by every fit loop so small per-host datasets and the
+    empty case behave identically everywhere."""
+    if n <= 0:
+        raise ValueError("no training examples (empty dataset after eval split)")
+    batch = min(batch, n)
     steps = max(1, n // batch)
-    return steps, steps * batch
+    return steps, steps * batch, batch
 
 
 def make_epoch_fn(
@@ -119,6 +125,7 @@ def train_mlp(
     cfg = config or FitConfig()
     n, f = features.shape
     train_idx, eval_idx = _split_eval(n, cfg.eval_fraction, cfg.seed)
+    steps, used, batch = _batch_steps(len(train_idx), cfg.batch_size)
 
     key = jax.random.PRNGKey(cfg.seed)
     params = mlp_mod.init_mlp(key, [f, *cfg.hidden_dims, 1])
@@ -130,7 +137,6 @@ def train_mlp(
 
         params = replicate(mesh, params)
 
-    steps, used = _batch_steps(len(train_idx), cfg.batch_size)
     total_steps = steps * cfg.epochs
     optimizer = _optimizer(cfg, total_steps)
     opt_state = optimizer.init(params)
@@ -146,8 +152,8 @@ def train_mlp(
     rng = np.random.default_rng(cfg.seed + 1)
     for _ in range(cfg.epochs):
         order = train_idx[rng.permutation(len(train_idx))][:used]
-        xb = features[order].reshape(steps, cfg.batch_size, f)
-        yb = labels[order].reshape(steps, cfg.batch_size)
+        xb = features[order].reshape(steps, batch, f)
+        yb = labels[order].reshape(steps, batch)
         xb, yb = _shard_arrays(mesh, xb, yb)
         params, opt_state, mean_loss = epoch_fn(params, opt_state, (xb, yb))
         history.append(float(mean_loss))
@@ -210,8 +216,7 @@ def train_gnn(
     neighbors = jnp.asarray(graph.neighbors)
     neighbor_mask = jnp.asarray(graph.neighbor_mask)
 
-    batch = min(cfg.batch_size, len(train_idx))
-    steps, used = _batch_steps(len(train_idx), batch)
+    steps, used, batch = _batch_steps(len(train_idx), cfg.batch_size)
     optimizer = _optimizer(cfg, steps * cfg.epochs)
     opt_state = optimizer.init(params)
 
@@ -296,8 +301,7 @@ def train_gru(
 
         params = replicate(mesh, params)
 
-    batch = min(cfg.batch_size, len(train_idx))
-    steps, used = _batch_steps(len(train_idx), batch)
+    steps, used, batch = _batch_steps(len(train_idx), cfg.batch_size)
     optimizer = _optimizer(cfg, steps * cfg.epochs)
     opt_state = optimizer.init(params)
 

@@ -1,0 +1,157 @@
+"""Training orchestration — the component the reference shipped as a TODO
+stub (reference trainer/training/training.go:33-98).
+
+``Training.train(ip, hostname)`` runs the flow the reference's comments
+promise: load the uploading scheduler's dataset from storage → preprocess
+into tensors → fit (MLP on download records, GraphSAGE on the probe
+graph, concurrently like the reference's errgroup) → upload both models
+with their evaluation metrics to the manager (CreateModel) → clear the
+consumed dataset.
+
+A failed fit must never poison serving: models upload as inactive and the
+manager's activation step gates rollout (reference
+manager/models/model.go:20-26 state machine).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from dataclasses import dataclass, field
+from typing import Any, Protocol
+
+import numpy as np
+
+from dragonfly2_tpu.schema.columnar import records_to_columns
+from dragonfly2_tpu.schema.features import build_probe_graph, extract_pair_features
+from dragonfly2_tpu.trainer.storage import TrainerStorage
+from dragonfly2_tpu.trainer.train import FitConfig, GNNFitConfig, train_gnn, train_mlp
+from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils.idgen import gnn_model_id_v1, host_id_v2, mlp_model_id_v1
+
+logger = dflog.get("trainer")
+
+
+class ManagerClient(Protocol):
+    """The slice of the manager API the trainer needs (CreateModel,
+    reference manager_server_v1.go:800-899)."""
+
+    def create_model(
+        self,
+        model_id: str,
+        model_type: str,  # "mlp" | "gnn"
+        ip: str,
+        hostname: str,
+        params: Any,  # parameter pytree (serialized by the client)
+        evaluation: dict[str, float],
+    ) -> None: ...
+
+
+@dataclass
+class TrainingConfig:
+    mlp: FitConfig = field(default_factory=FitConfig)
+    gnn: GNNFitConfig = field(default_factory=GNNFitConfig)
+    gnn_max_degree: int = 16
+    min_download_records: int = 1
+    min_topology_records: int = 1
+    clear_after_train: bool = True
+
+
+@dataclass
+class TrainingOutcome:
+    mlp_metrics: dict[str, float] | None = None
+    gnn_metrics: dict[str, float] | None = None
+    mlp_error: str | None = None
+    gnn_error: str | None = None
+
+    @property
+    def ok(self) -> bool:
+        return self.mlp_error is None and self.gnn_error is None
+
+
+class Training:
+    def __init__(
+        self,
+        storage: TrainerStorage,
+        manager_client: ManagerClient | None = None,
+        config: TrainingConfig | None = None,
+        mesh=None,
+    ):
+        self.storage = storage
+        self.manager_client = manager_client
+        self.config = config or TrainingConfig()
+        self.mesh = mesh
+
+    def train(self, ip: str, hostname: str) -> TrainingOutcome:
+        """Fit MLP + GNN for one uploading scheduler host, concurrently
+        (reference training.go:60-78 errgroup)."""
+        host_id = host_id_v2(ip, hostname)
+        outcome = TrainingOutcome()
+        with concurrent.futures.ThreadPoolExecutor(max_workers=2) as pool:
+            f_mlp = pool.submit(self._train_mlp, host_id, ip, hostname)
+            f_gnn = pool.submit(self._train_gnn, host_id, ip, hostname)
+            try:
+                outcome.mlp_metrics = f_mlp.result()
+            except Exception as e:
+                logger.exception("trainMLP failed for %s", host_id)
+                outcome.mlp_error = str(e)
+            try:
+                outcome.gnn_metrics = f_gnn.result()
+            except Exception as e:
+                logger.exception("trainGNN failed for %s", host_id)
+                outcome.gnn_error = str(e)
+
+        if self.config.clear_after_train:
+            # the reference retrains from scratch each round and drops
+            # consumed uploads (trainer/trainer.go:156-161)
+            if outcome.mlp_error is None:
+                self.storage.clear_download(host_id)
+            if outcome.gnn_error is None:
+                self.storage.clear_network_topology(host_id)
+        return outcome
+
+    # -- trainMLP (reference training.go:92-98) ---------------------------
+    def _train_mlp(self, host_id: str, ip: str, hostname: str) -> dict[str, float]:
+        recs = self.storage.list_download(host_id)
+        if len(recs) < self.config.min_download_records:
+            raise ValueError(f"no download records for host {host_id}")
+        pairs = extract_pair_features(records_to_columns(recs))
+        if pairs.features.shape[0] == 0:
+            raise ValueError("no trainable (download, parent) pairs")
+        result = train_mlp(pairs.features, pairs.labels, mesh=self.mesh, config=self.config.mlp)
+        if self.manager_client is not None:
+            self.manager_client.create_model(
+                model_id=mlp_model_id_v1(ip, hostname),
+                model_type="mlp",
+                ip=ip,
+                hostname=hostname,
+                params=_to_host(result.params),
+                evaluation=result.metrics,
+            )
+        return result.metrics
+
+    # -- trainGNN (reference training.go:82-88) ---------------------------
+    def _train_gnn(self, host_id: str, ip: str, hostname: str) -> dict[str, float]:
+        recs = self.storage.list_network_topology(host_id)
+        if len(recs) < self.config.min_topology_records:
+            raise ValueError(f"no network topology records for host {host_id}")
+        graph = build_probe_graph(
+            records_to_columns(recs), max_degree=self.config.gnn_max_degree
+        )
+        result = train_gnn(graph, mesh=self.mesh, config=self.config.gnn)
+        if self.manager_client is not None:
+            self.manager_client.create_model(
+                model_id=gnn_model_id_v1(ip, hostname),
+                model_type="gnn",
+                ip=ip,
+                hostname=hostname,
+                params=_to_host(result.params),
+                evaluation=result.metrics,
+            )
+        return result.metrics
+
+
+def _to_host(params) -> Any:
+    """Device → host numpy pytree (for serialization/upload)."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), params)

@@ -1,0 +1,92 @@
+"""Network topology probe graph: EWMA, bounded queues, target selection,
+snapshot export feeding the GNN pipeline."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.scheduler.networktopology import (
+    EWMA_OLD_WEIGHT,
+    NetworkTopology,
+    Probe,
+)
+from dragonfly2_tpu.scheduler.resource import Host, HostManager, HostType
+from dragonfly2_tpu.scheduler.storage import Storage
+from dragonfly2_tpu.schema.records import Network
+from dragonfly2_tpu.utils.kvstore import KVStore
+
+
+@pytest.fixture
+def hosts():
+    hm = HostManager()
+    for i in range(10):
+        h = Host(id=f"h{i}", hostname=f"host{i}", ip=f"10.0.0.{i}", port=8002)
+        h.network = Network(idc="idc-a", location="as|cn|sh|dc1")
+        hm.store(h)
+    return hm
+
+
+@pytest.fixture
+def nt(hosts, tmp_path):
+    return NetworkTopology(KVStore(), hosts, Storage(tmp_path, buffer_size=1))
+
+
+class TestProbes:
+    def test_enqueue_creates_edge_and_ewma(self, nt):
+        nt.enqueue_probe("h0", Probe("h1", rtt_ns=10_000_000))
+        assert nt.has_edge("h0", "h1")
+        assert nt.average_rtt("h0", "h1") == 10_000_000  # first probe = raw
+        nt.enqueue_probe("h0", Probe("h1", rtt_ns=20_000_000))
+        want = int(EWMA_OLD_WEIGHT * 10_000_000 + (1 - EWMA_OLD_WEIGHT) * 20_000_000)
+        assert nt.average_rtt("h0", "h1") == want
+        assert nt.probed_count("h1") == 2
+
+    def test_queue_bounded(self, nt):
+        for i in range(9):
+            nt.enqueue_probe("h0", Probe("h1", rtt_ns=1000 + i))
+        q = nt.probes("h0", "h1")
+        assert len(q) == nt.queue_length == 5
+        assert q[-1]["rtt"] == 1008  # newest kept, oldest dropped
+
+    def test_find_probed_hosts_least_probed_first(self, nt):
+        # h1 heavily probed; everyone else fresh
+        for _ in range(10):
+            nt.enqueue_probe("h0", Probe("h1", rtt_ns=1000))
+        got = nt.find_probed_hosts("h0")
+        assert len(got) == nt.probe_count == 5
+        ids = [h.id for h in got]
+        assert "h0" not in ids  # excludes self
+        assert "h1" not in ids  # most-probed host not selected
+
+    def test_delete_host_purges(self, nt):
+        nt.enqueue_probe("h0", Probe("h1", rtt_ns=1000))
+        nt.enqueue_probe("h1", Probe("h2", rtt_ns=1000))
+        nt.delete_host("h1")
+        assert not nt.has_edge("h0", "h1")
+        assert not nt.has_edge("h1", "h2")
+        assert nt.probed_count("h1") == 0
+        assert len(nt.probes("h0", "h1")) == 0
+
+
+class TestSnapshot:
+    def test_snapshot_rows_feed_gnn(self, nt):
+        rng = np.random.default_rng(0)
+        for s in range(8):
+            for d in range(8):
+                if s != d:
+                    nt.enqueue_probe(f"h{s}", Probe(f"h{d}", rtt_ns=int(rng.uniform(1, 50) * 1e6)))
+        rows = nt.snapshot()
+        assert rows == 8
+        recs = nt.storage.list_network_topology()
+        assert len(recs) == 8
+        assert all(len(r.dest_hosts) == 5 for r in recs)  # capped at 5
+
+        from dragonfly2_tpu.schema.columnar import records_to_columns
+        from dragonfly2_tpu.schema.features import build_probe_graph
+
+        g = build_probe_graph(records_to_columns(recs), max_degree=4)
+        assert g.num_nodes == 8
+        assert len(g.edge_src) > 0
+
+    def test_snapshot_skips_unknown_hosts(self, nt):
+        nt.enqueue_probe("h0", Probe("ghost", rtt_ns=1000))
+        assert nt.snapshot() == 0

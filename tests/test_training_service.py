@@ -1,0 +1,119 @@
+"""Trainer storage + orchestration + serving round trip: the reference's
+TODO stub, end-to-end — CSV uploads in, evaluated models out, scheduler-side
+scoring with the result."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.schema import synth
+from dragonfly2_tpu.schema.columnar import write_csv
+from dragonfly2_tpu.trainer.serving import (
+    MLPScorer,
+    deserialize_params,
+    serialize_params,
+)
+from dragonfly2_tpu.trainer.storage import TrainerStorage
+from dragonfly2_tpu.trainer.train import FitConfig, GNNFitConfig
+from dragonfly2_tpu.trainer.training import Training, TrainingConfig
+from dragonfly2_tpu.utils.idgen import host_id_v2
+
+
+def _upload_csv(storage, host_id, recs, kind):
+    """Simulate the Train stream: records → CSV bytes → chunked appends."""
+    import io
+
+    buf = io.StringIO()
+    import csv as _csv
+
+    from dragonfly2_tpu.schema import records as R
+
+    cols = R.headers(type(recs[0]))
+    w = _csv.DictWriter(buf, fieldnames=cols)
+    w.writeheader()
+    for r in recs:
+        w.writerow(R.flatten(r))
+    data = buf.getvalue().encode()
+    append = storage.append_download if kind == "download" else storage.append_network_topology
+    for i in range(0, len(data), 1 << 16):  # 64 KiB chunks
+        append(host_id, data[i : i + (1 << 16)])
+
+
+class RecordingManager:
+    def __init__(self):
+        self.models = {}
+
+    def create_model(self, model_id, model_type, ip, hostname, params, evaluation):
+        self.models[model_type] = {
+            "id": model_id,
+            "ip": ip,
+            "hostname": hostname,
+            "params": params,
+            "evaluation": evaluation,
+        }
+
+
+class TestTrainerStorage:
+    def test_per_host_files_and_listing(self, tmp_path):
+        s = TrainerStorage(tmp_path)
+        hid = host_id_v2("10.0.0.1", "sched-1")
+        recs = synth.make_download_records(5, seed=0)
+        _upload_csv(s, hid, recs, "download")
+        assert s.list_download(hid) == recs
+        assert s.list_network_topology(hid) == []
+        assert s.host_ids() == [hid]
+        s.clear_download(hid)
+        assert s.list_download(hid) == []
+
+
+class TestTrainingOrchestration:
+    @pytest.fixture
+    def setup(self, tmp_path):
+        storage = TrainerStorage(tmp_path)
+        ip, hostname = "10.0.0.1", "sched-1"
+        hid = host_id_v2(ip, hostname)
+        _upload_csv(storage, hid, synth.make_download_records(150, seed=1), "download")
+        _upload_csv(
+            storage, hid, synth.make_topology_records(400, num_hosts=32, seed=2), "topology"
+        )
+        manager = RecordingManager()
+        cfg = TrainingConfig(
+            mlp=FitConfig(hidden_dims=(32,), batch_size=128, epochs=5, seed=0),
+            gnn=GNNFitConfig(hidden_dims=(16,), batch_size=256, epochs=20, seed=0),
+        )
+        return storage, manager, cfg, ip, hostname, hid
+
+    def test_full_round(self, setup):
+        storage, manager, cfg, ip, hostname, hid = setup
+        outcome = Training(storage, manager, cfg).train(ip, hostname)
+        assert outcome.ok, (outcome.mlp_error, outcome.gnn_error)
+        assert set(manager.models) == {"mlp", "gnn"}
+        assert "mse" in manager.models["mlp"]["evaluation"]
+        assert "f1" in manager.models["gnn"]["evaluation"]
+        # consumed datasets cleared (reference retrains from scratch each round)
+        assert storage.list_download(hid) == []
+        assert storage.list_network_topology(hid) == []
+
+        # serve the uploaded MLP the way the scheduler's ml evaluator will
+        blob = serialize_params(manager.models["mlp"]["params"])
+        params = deserialize_params(blob, manager.models["mlp"]["params"])
+        scorer = MLPScorer(params)
+        from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
+
+        pred = scorer.predict(np.random.default_rng(0).uniform(0, 1, (7, MLP_FEATURE_DIM)).astype(np.float32))
+        assert pred.shape == (7,)
+        assert np.isfinite(pred).all()
+
+    def test_partial_failure_keeps_other_side(self, tmp_path):
+        storage = TrainerStorage(tmp_path)
+        ip, hostname = "10.0.0.2", "sched-2"
+        hid = host_id_v2(ip, hostname)
+        _upload_csv(storage, hid, synth.make_download_records(80, seed=3), "download")
+        # no topology upload → GNN must fail, MLP must succeed
+        manager = RecordingManager()
+        cfg = TrainingConfig(mlp=FitConfig(hidden_dims=(16,), batch_size=64, epochs=3, seed=0))
+        outcome = Training(storage, manager, cfg).train(ip, hostname)
+        assert outcome.mlp_error is None
+        assert outcome.gnn_error is not None
+        assert "mlp" in manager.models and "gnn" not in manager.models
+        # failed side's (absent) data untouched, successful side cleared
+        assert storage.list_download(hid) == []
