@@ -1,0 +1,3 @@
+"""Manager — the control plane (reference manager/, SURVEY.md §2.4):
+cluster registry, dynamic config serving, model registry with
+inactive→active versioning, searcher, object storage."""

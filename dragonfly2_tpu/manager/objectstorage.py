@@ -1,0 +1,70 @@
+"""Object storage behind one interface (role parity: reference
+pkg/objectstorage — S3/OSS drivers). The filesystem driver is the
+in-cluster default here (no cloud credentials in this environment); the
+interface is the S3 verb set so a real driver drops in."""
+
+from __future__ import annotations
+
+import shutil
+from pathlib import Path
+from typing import Iterator, Protocol
+
+
+class ObjectStorage(Protocol):
+    def put_object(self, bucket: str, key: str, data: bytes) -> None: ...
+
+    def get_object(self, bucket: str, key: str) -> bytes: ...
+
+    def head_object(self, bucket: str, key: str) -> bool: ...
+
+    def delete_object(self, bucket: str, key: str) -> None: ...
+
+    def list_objects(self, bucket: str, prefix: str = "") -> list[str]: ...
+
+    def create_bucket(self, bucket: str) -> None: ...
+
+
+class FSObjectStorage:
+    def __init__(self, root: str | Path):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, bucket: str, key: str = "") -> Path:
+        p = (self.root / bucket / key).resolve()
+        if not str(p).startswith(str(self.root.resolve())):
+            raise ValueError(f"object key escapes storage root: {key}")
+        return p
+
+    def create_bucket(self, bucket: str) -> None:
+        self._path(bucket).mkdir(parents=True, exist_ok=True)
+
+    def put_object(self, bucket: str, key: str, data: bytes) -> None:
+        p = self._path(bucket, key)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.with_suffix(p.suffix + ".tmp")
+        tmp.write_bytes(data)
+        tmp.replace(p)  # atomic publish
+
+    def get_object(self, bucket: str, key: str) -> bytes:
+        return self._path(bucket, key).read_bytes()
+
+    def head_object(self, bucket: str, key: str) -> bool:
+        return self._path(bucket, key).is_file()
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self._path(bucket, key).unlink(missing_ok=True)
+
+    def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
+        base = self._path(bucket)
+        if not base.exists():
+            return []
+        out = []
+        for p in base.rglob("*"):
+            if p.is_file() and not p.name.endswith(".tmp"):
+                key = str(p.relative_to(base))
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
+
+    def delete_bucket(self, bucket: str) -> None:
+        shutil.rmtree(self._path(bucket), ignore_errors=True)

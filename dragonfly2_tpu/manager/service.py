@@ -1,0 +1,257 @@
+"""Manager gRPC service (reference manager/rpcserver/manager_server_v1.go
++ v2): scheduler/seed-peer registry, keepalive, dynconfig serving, and the
+model registry RPCs the trainer and scheduler consume."""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import manager_pb2  # noqa: E402
+
+from dragonfly2_tpu.manager.database import Database
+from dragonfly2_tpu.manager.models_registry import ModelRegistry
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("manager.rpc")
+
+SERVICE_NAME = "dragonfly2_tpu.manager.Manager"
+
+# schedulers silent longer than this flip to inactive (reference keepalive)
+KEEPALIVE_TIMEOUT = 60.0
+
+
+class ManagerService:
+    def __init__(self, db: Database, models: ModelRegistry):
+        self.db = db
+        self.models = models
+        self.default_cluster_id = db.ensure_default_cluster()
+
+    # -- scheduler registry ------------------------------------------------
+    def UpdateScheduler(self, request, context):
+        now = time.time()
+        cluster_id = request.scheduler_cluster_id or self.default_cluster_id
+        self.db.execute(
+            "INSERT INTO schedulers (hostname, ip, port, idc, location, state,"
+            " scheduler_cluster_id, last_keepalive, created_at, updated_at)"
+            " VALUES (?, ?, ?, ?, ?, 'active', ?, ?, ?, ?)"
+            " ON CONFLICT(hostname, ip, scheduler_cluster_id) DO UPDATE SET"
+            " port = excluded.port, idc = excluded.idc, location = excluded.location,"
+            " state = 'active', last_keepalive = excluded.last_keepalive,"
+            " updated_at = excluded.updated_at",
+            (request.hostname, request.ip, request.port, request.idc,
+             request.location, cluster_id, now, now, now),
+        )
+        return self._scheduler(request.hostname, request.ip, cluster_id, context)
+
+    def GetScheduler(self, request, context):
+        cluster_id = request.scheduler_cluster_id or self.default_cluster_id
+        return self._scheduler(request.hostname, request.ip, cluster_id, context)
+
+    def _scheduler(self, hostname, ip, cluster_id, context):
+        r = self.db.query_one(
+            "SELECT * FROM schedulers WHERE hostname = ? AND ip = ? AND scheduler_cluster_id = ?",
+            (hostname, ip, cluster_id),
+        )
+        if r is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"scheduler {hostname}/{ip} not found")
+        return manager_pb2.Scheduler(
+            id=r["id"], hostname=r["hostname"], ip=r["ip"], port=r["port"],
+            idc=r["idc"], location=r["location"], state=r["state"],
+            scheduler_cluster_id=r["scheduler_cluster_id"],
+        )
+
+    def ListSchedulers(self, request, context):
+        self._expire_stale()
+        rows = self.db.query("SELECT * FROM schedulers WHERE state = 'active'")
+        return manager_pb2.ListSchedulersResponse(
+            schedulers=[
+                manager_pb2.Scheduler(
+                    id=r["id"], hostname=r["hostname"], ip=r["ip"], port=r["port"],
+                    idc=r["idc"], location=r["location"], state=r["state"],
+                    scheduler_cluster_id=r["scheduler_cluster_id"],
+                )
+                for r in rows
+            ]
+        )
+
+    def _expire_stale(self) -> None:
+        cutoff = time.time() - KEEPALIVE_TIMEOUT
+        self.db.execute(
+            "UPDATE schedulers SET state = 'inactive' WHERE last_keepalive < ? AND state = 'active'",
+            (cutoff,),
+        )
+        self.db.execute(
+            "UPDATE seed_peers SET state = 'inactive' WHERE last_keepalive < ? AND state = 'active'",
+            (cutoff,),
+        )
+
+    # -- seed peers --------------------------------------------------------
+    def UpdateSeedPeer(self, request, context):
+        now = time.time()
+        cluster_id = request.seed_peer_cluster_id or 1
+        self.db.execute(
+            "INSERT OR IGNORE INTO seed_peer_clusters (id, name, created_at, updated_at)"
+            " VALUES (?, ?, ?, ?)",
+            (cluster_id, f"cluster-{cluster_id}", now, now),
+        )
+        self.db.execute(
+            "INSERT INTO seed_peers (hostname, ip, port, download_port, type, idc,"
+            " location, state, seed_peer_cluster_id, last_keepalive, created_at, updated_at)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, 'active', ?, ?, ?, ?)"
+            " ON CONFLICT(hostname, ip, seed_peer_cluster_id) DO UPDATE SET"
+            " port = excluded.port, download_port = excluded.download_port,"
+            " type = excluded.type, state = 'active',"
+            " last_keepalive = excluded.last_keepalive, updated_at = excluded.updated_at",
+            (request.hostname, request.ip, request.port, request.download_port,
+             request.type or "super", request.idc, request.location, cluster_id, now, now, now),
+        )
+        r = self.db.query_one(
+            "SELECT * FROM seed_peers WHERE hostname = ? AND ip = ? AND seed_peer_cluster_id = ?",
+            (request.hostname, request.ip, cluster_id),
+        )
+        return manager_pb2.SeedPeer(
+            id=r["id"], hostname=r["hostname"], ip=r["ip"], port=r["port"],
+            download_port=r["download_port"], type=r["type"], idc=r["idc"],
+            location=r["location"], seed_peer_cluster_id=r["seed_peer_cluster_id"],
+        )
+
+    # -- keepalive ---------------------------------------------------------
+    def KeepAlive(self, request_iterator, context):
+        for req in request_iterator:
+            now = time.time()
+            if req.source_type == "scheduler":
+                self.db.execute(
+                    "UPDATE schedulers SET last_keepalive = ?, state = 'active'"
+                    " WHERE hostname = ? AND ip = ?",
+                    (now, req.hostname, req.ip),
+                )
+            elif req.source_type == "seed_peer":
+                self.db.execute(
+                    "UPDATE seed_peers SET last_keepalive = ?, state = 'active'"
+                    " WHERE hostname = ? AND ip = ?",
+                    (now, req.hostname, req.ip),
+                )
+        return manager_pb2.Empty()
+
+    # -- dynconfig ---------------------------------------------------------
+    def GetSchedulerClusterConfig(self, request, context):
+        cluster_id = request.scheduler_cluster_id or self.default_cluster_id
+        r = self.db.query_one(
+            "SELECT config FROM scheduler_clusters WHERE id = ?", (cluster_id,)
+        )
+        if r is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"cluster {cluster_id} not found")
+        cfg = Database.loads(r["config"])
+        return manager_pb2.SchedulerClusterConfig(
+            candidate_parent_limit=int(cfg.get("candidate_parent_limit", 0)),
+            filter_parent_limit=int(cfg.get("filter_parent_limit", 0)),
+            json=r["config"],
+        )
+
+    # -- model registry ----------------------------------------------------
+    def CreateModel(self, request, context):
+        evaluation = {
+            "precision": request.evaluation.precision,
+            "recall": request.evaluation.recall,
+            "f1": request.evaluation.f1,
+            "mse": request.evaluation.mse,
+            "mae": request.evaluation.mae,
+        }
+        row = self.models.create(
+            model_id=request.model_id,
+            model_type=request.type,
+            weights=request.weights,
+            evaluation=evaluation,
+            ip=request.ip,
+            hostname=request.hostname,
+            scheduler_cluster_id=request.scheduler_cluster_id or self.default_cluster_id,
+        )
+        return self._model(row)
+
+    def GetModel(self, request, context):
+        row = self.models.get(request.model_id, request.version)
+        if row is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"model {request.model_id} v{request.version} not found",
+            )
+        return self._model(row)
+
+    def ListModels(self, request, context):
+        rows = self.models.list(request.scheduler_cluster_id or None)
+        return manager_pb2.ListModelsResponse(models=[self._model(r) for r in rows])
+
+    def UpdateModel(self, request, context):
+        if request.state == "active":
+            try:
+                row = self.models.activate(request.model_id, request.version)
+            except KeyError as e:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            return self._model(row)
+        row = self.models.get(request.model_id, request.version)
+        if row is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"model {request.model_id} not found")
+        return self._model(row)
+
+    @staticmethod
+    def _model(row) -> manager_pb2.Model:
+        ev = row.evaluation
+        return manager_pb2.Model(
+            model_id=row.model_id,
+            type=row.type,
+            version=row.version,
+            state=row.state,
+            evaluation=manager_pb2.ModelEvaluation(
+                precision=ev.get("precision", 0.0),
+                recall=ev.get("recall", 0.0),
+                f1=ev.get("f1", 0.0),
+                mse=ev.get("mse", 0.0),
+                mae=ev.get("mae", 0.0),
+            ),
+            object_key=row.object_key,
+            created_at_ns=int(row.created_at * 1e9),
+        )
+
+
+class ManagerGrpcClientAdapter:
+    """Adapts the trainer's ManagerClient protocol onto the gRPC client —
+    serializes params and fills CreateModelRequest."""
+
+    def __init__(self, channel):
+        from dragonfly2_tpu.rpc.glue import ServiceClient
+
+        self._client = ServiceClient(channel, SERVICE_NAME)
+
+    def create_model(self, model_id, model_type, ip, hostname, params, evaluation):
+        from dragonfly2_tpu.trainer.serving import serialize_params
+
+        self._client.CreateModel(
+            manager_pb2.CreateModelRequest(
+                model_id=model_id,
+                type=model_type,
+                ip=ip,
+                hostname=hostname,
+                weights=serialize_params(params),
+                evaluation=manager_pb2.ModelEvaluation(
+                    precision=evaluation.get("precision", 0.0),
+                    recall=evaluation.get("recall", 0.0),
+                    f1=evaluation.get("f1", 0.0),
+                    mse=evaluation.get("mse", 0.0),
+                    mae=evaluation.get("mae", 0.0),
+                ),
+            )
+        )
+
+    def keepalive(self, source_type, hostname, ip, cluster_id=""):
+        self._client.KeepAlive(
+            iter(
+                [
+                    manager_pb2.KeepAliveRequest(
+                        source_type=source_type, hostname=hostname, ip=ip
+                    )
+                ]
+            )
+        )

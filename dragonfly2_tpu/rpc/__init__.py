@@ -1,0 +1,7 @@
+"""gRPC fabric: protos, generated code, client/server glue (reference
+pkg/rpc/, SURVEY.md §2.5).
+
+Proto sources live in ``protos/``; regenerate with ``hack/genproto.sh``
+(protoc --python_out only — the gRPC method stubs are hand-written in
+``glue.py`` against method paths, since grpc_tools isn't in this image).
+"""

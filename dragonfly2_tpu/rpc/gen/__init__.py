@@ -1,0 +1,9 @@
+"""protoc-generated modules (flat imports — protoc emits `import x_pb2`,
+so the package dir joins sys.path)."""
+
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+if _here not in sys.path:
+    sys.path.insert(0, _here)

@@ -1,0 +1,140 @@
+"""Scheduler announcer: keepalive to the manager + periodic dataset upload
+to the trainer (reference scheduler/announcer/announcer.go:44-235).
+
+Every train interval (default 7 days, reference
+scheduler/config/constants.go:196-197) the announcer opens a `Train`
+client-stream and ships both CSV datasets in chunks (default 128 MiB,
+reference announcer.go:39-41): downloads as TrainMlpRequest, topology as
+TrainGnnRequest.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+
+import grpc
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import trainer_pb2  # noqa: E402
+
+from dragonfly2_tpu.rpc.glue import ServiceClient
+from dragonfly2_tpu.scheduler.storage import Storage
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("announcer")
+
+DEFAULT_TRAIN_INTERVAL = 7 * 24 * 3600.0
+DEFAULT_UPLOAD_CHUNK = 128 * 1024 * 1024
+
+
+class Announcer:
+    def __init__(
+        self,
+        storage: Storage,
+        ip: str,
+        hostname: str,
+        trainer_channel: grpc.Channel | None = None,
+        manager_client=None,
+        cluster_id: str = "",
+        train_interval: float = DEFAULT_TRAIN_INTERVAL,
+        upload_chunk: int = DEFAULT_UPLOAD_CHUNK,
+        keepalive_interval: float = 30.0,
+    ):
+        self.storage = storage
+        self.ip = ip
+        self.hostname = hostname
+        self.cluster_id = cluster_id
+        self.train_interval = train_interval
+        self.upload_chunk = upload_chunk
+        self.keepalive_interval = keepalive_interval
+        self.manager_client = manager_client
+        self._trainer = (
+            ServiceClient(trainer_channel, "dragonfly2_tpu.trainer.Trainer")
+            if trainer_channel is not None
+            else None
+        )
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- trainer upload ----------------------------------------------------
+    def train_once(self) -> bool:
+        """One upload round: stream both datasets, EOF triggers the fit.
+        Returns False when there's no trainer or no data."""
+        if self._trainer is None:
+            return False
+        download_files = self.storage.open_download_files()
+        topology_files = self.storage.open_network_topology_files()
+        if not download_files and not topology_files:
+            logger.info("no datasets to upload")
+            return False
+
+        def requests():
+            for path in download_files:
+                for chunk in self._chunks(path):
+                    yield trainer_pb2.TrainRequest(
+                        ip=self.ip,
+                        hostname=self.hostname,
+                        cluster_id=self.cluster_id,
+                        train_mlp=trainer_pb2.TrainMlpRequest(dataset=chunk),
+                    )
+            for path in topology_files:
+                for chunk in self._chunks(path):
+                    yield trainer_pb2.TrainRequest(
+                        ip=self.ip,
+                        hostname=self.hostname,
+                        cluster_id=self.cluster_id,
+                        train_gnn=trainer_pb2.TrainGnnRequest(dataset=chunk),
+                    )
+
+        self._trainer.Train(requests(), timeout=3600)
+        # uploaded datasets are consumed; clear local copies like the
+        # reference's post-upload lifecycle
+        self.storage.clear_download()
+        self.storage.clear_network_topology()
+        return True
+
+    def _chunks(self, path: Path):
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(self.upload_chunk)
+                if not chunk:
+                    return
+                yield chunk
+
+    # -- background loops --------------------------------------------------
+    def serve(self) -> None:
+        t = threading.Thread(target=self._train_loop, name="announcer-train", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.manager_client is not None:
+            k = threading.Thread(
+                target=self._keepalive_loop, name="announcer-keepalive", daemon=True
+            )
+            k.start()
+            self._threads.append(k)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=1.0)
+
+    def _train_loop(self) -> None:
+        while not self._stop.wait(self.train_interval):
+            try:
+                self.train_once()
+            except Exception:
+                logger.exception("dataset upload failed")
+
+    def _keepalive_loop(self) -> None:
+        while not self._stop.wait(self.keepalive_interval):
+            try:
+                self.manager_client.keepalive(
+                    source_type="scheduler",
+                    hostname=self.hostname,
+                    ip=self.ip,
+                    cluster_id=self.cluster_id,
+                )
+            except Exception:
+                logger.exception("manager keepalive failed")

@@ -198,16 +198,29 @@ def pair_features(parent: Peer, child: Peer, total_piece_count: int) -> np.ndarr
     trained on (schema/features.py)."""
     h = parent.host
     uploads, failed = h.upload_count, h.upload_failed_count
+    child_idc, parent_idc = child.host.network.idc, h.network.idc
+    child_loc, parent_loc = child.host.network.location, h.network.location
+    # NB: these must match schema/features.extract_pair_features exactly
+    # (the offline training regime): upload_success uses max(uploads, 1)
+    # (fresh host → 0.0) and idc/location compare case-SENSITIVELY —
+    # unlike the BaseEvaluator's hand-tuned score above.
+    from dragonfly2_tpu.schema.features import (
+        location_affinity as offline_location_affinity,
+    )
+
+    loc_aff = float(
+        offline_location_affinity(np.array([child_loc]), np.array([parent_loc]))[0]
+    )
     return np.array(
         [
             min(max(piece_score(parent, child, total_piece_count), 0.0), 1.0),
-            (uploads - failed) / uploads if uploads > 0 else 1.0,
+            (uploads - failed) / max(uploads, 1),
             min(max(h.free_upload_count() / h.concurrent_upload_limit, 0.0), 1.0)
             if h.concurrent_upload_limit > 0
             else 0.0,
             0.0 if h.type is HostType.NORMAL else 1.0,
-            idc_affinity_score(h.network.idc, child.host.network.idc),
-            location_affinity_score(h.network.location, child.host.network.location),
+            1.0 if (child_idc == parent_idc and parent_idc != "") else 0.0,
+            loc_aff,
             h.cpu.percent / 100.0,
             h.memory.used_percent / 100.0,
             math.log1p(h.network.tcp_connection_count) / 10.0,

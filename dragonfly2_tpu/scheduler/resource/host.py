@@ -96,12 +96,19 @@ class Host:
         with self._lock:
             self.concurrent_upload_count += 1
 
-    def release_upload(self, success: bool) -> None:
+    def record_upload(self, success: bool) -> None:
+        """Per-piece upload outcome accounting (success counters only;
+        concurrent slots are tracked by edge add/remove)."""
         with self._lock:
-            self.concurrent_upload_count = max(0, self.concurrent_upload_count - 1)
             self.upload_count += 1
             if not success:
                 self.upload_failed_count += 1
+
+    def release_upload(self) -> None:
+        """Free one concurrent upload slot (edge removed). Outcome counters
+        are per-piece via record_upload, not per-slot."""
+        with self._lock:
+            self.concurrent_upload_count = max(0, self.concurrent_upload_count - 1)
 
     def touch(self) -> None:
         self.updated_at = time.time()

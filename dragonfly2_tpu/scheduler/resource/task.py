@@ -136,6 +136,11 @@ class Task:
 
     def delete_peer(self, peer_id: str) -> None:
         with self._lock:
+            # release upload slots held by this peer's edges before the
+            # vertex vanishes — otherwise parents leak concurrent capacity
+            if peer_id in self._dag:
+                self.delete_peer_in_edges(peer_id)
+                self.delete_peer_out_edges(peer_id)
             self._peers.pop(peer_id, None)
             self._dag.delete_vertex(peer_id)
             self.back_to_source_peers.discard(peer_id)
@@ -167,7 +172,7 @@ class Task:
             for pid in list(v.parents):
                 p = self._peers.get(pid)
                 if p is not None:
-                    p.host.release_upload(success=True)
+                    p.host.release_upload()
             self._dag.delete_vertex_in_edges(peer_id)
 
     def delete_peer_out_edges(self, peer_id: str) -> None:
@@ -178,7 +183,7 @@ class Task:
             host = self._peers[peer_id].host if peer_id in self._peers else None
             for _ in range(len(v.children)):
                 if host is not None:
-                    host.release_upload(success=True)
+                    host.release_upload()
             self._dag.delete_vertex_out_edges(peer_id)
 
     def can_add_peer_edge(self, from_id: str, to_id: str) -> bool:

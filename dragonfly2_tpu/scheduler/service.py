@@ -1,0 +1,414 @@
+"""Scheduler gRPC service (v2 shape): AnnouncePeer bidi stream + host and
+probe RPCs (reference scheduler/service/service_v2.go:89-1387).
+
+The AnnouncePeer stream demuxes register / started / piece / finished /
+failed / reschedule events into FSM transitions and scheduling calls; the
+response side of the stream carries scheduling decisions pushed through
+the peer's stored stream handle. On DownloadPeerFinished/Failed the
+download record is written to storage — v2 keeps the record sink the
+reference only wired into v1 (reference service_v1.go:1629), because the
+records are the whole point of the TPU rebuild.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import grpc
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import common_pb2  # noqa: E402
+import scheduler_pb2  # noqa: E402
+
+from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.networktopology import NetworkTopology, Probe
+from dragonfly2_tpu.scheduler.scheduling import (
+    NeedBackToSourceResponse,
+    NormalTaskResponse,
+    Scheduling,
+    SchedulingError,
+)
+from dragonfly2_tpu.scheduler.storage import Storage, build_download_record
+from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
+
+logger = dflog.get("scheduler.rpc")
+
+SERVICE_NAME = "dragonfly2_tpu.scheduler.Scheduler"
+
+
+class _StreamAdapter:
+    """Bridges scheduling decisions onto the gRPC response stream: the
+    algorithm pushes dataclasses; this translates them to protos and
+    queues them for the stream generator."""
+
+    def __init__(self):
+        self.out: "queue.Queue[scheduler_pb2.AnnouncePeerResponse | None]" = queue.Queue()
+
+    def send(self, decision) -> None:
+        if isinstance(decision, NormalTaskResponse):
+            resp = scheduler_pb2.AnnouncePeerResponse(
+                normal_task=scheduler_pb2.NormalTaskResponse(
+                    candidate_parents=[_candidate_parent(p) for p in decision.candidate_parents]
+                )
+            )
+        elif isinstance(decision, NeedBackToSourceResponse):
+            resp = scheduler_pb2.AnnouncePeerResponse(
+                need_back_to_source=scheduler_pb2.NeedBackToSourceResponse(
+                    description=decision.description
+                )
+            )
+        else:
+            resp = decision  # already a proto (empty/tiny/small task)
+        self.out.put(resp)
+
+    def close(self) -> None:
+        self.out.put(None)
+
+
+def _candidate_parent(p: res.Peer) -> scheduler_pb2.CandidateParent:
+    return scheduler_pb2.CandidateParent(
+        peer_id=p.id,
+        host=_host_info(p.host),
+        finished_pieces=sorted(p.finished_pieces),
+        task_content_length=p.task.content_length,
+        task_total_piece_count=p.task.total_piece_count,
+        task_piece_length=p.task.piece_length,
+    )
+
+
+def _host_info(h: res.Host) -> common_pb2.HostInfo:
+    return common_pb2.HostInfo(
+        id=h.id,
+        type=h.type.value,
+        hostname=h.hostname,
+        ip=h.ip,
+        port=h.port,
+        download_port=h.download_port,
+        os=h.os,
+        concurrent_upload_limit=h.concurrent_upload_limit,
+        network=common_pb2.NetworkStat(
+            tcp_connection_count=h.network.tcp_connection_count,
+            upload_tcp_connection_count=h.network.upload_tcp_connection_count,
+            location=h.network.location,
+            idc=h.network.idc,
+        ),
+        cpu=common_pb2.CpuStat(percent=h.cpu.percent),
+        memory=common_pb2.MemoryStat(used_percent=h.memory.used_percent),
+        disk=common_pb2.DiskStat(used_percent=h.disk.used_percent),
+        scheduler_cluster_id=h.scheduler_cluster_id,
+    )
+
+
+def _host_from_info(info: common_pb2.HostInfo) -> res.Host:
+    h = res.Host(
+        id=info.id,
+        type=res.HostType(info.type) if info.type else res.HostType.NORMAL,
+        hostname=info.hostname,
+        ip=info.ip,
+        port=info.port,
+        download_port=info.download_port,
+        os=info.os,
+        concurrent_upload_limit=info.concurrent_upload_limit
+        or res.DEFAULT_CONCURRENT_UPLOAD_LIMIT,
+        scheduler_cluster_id=info.scheduler_cluster_id,
+    )
+    h.cpu.percent = info.cpu.percent
+    h.memory.used_percent = info.memory.used_percent
+    h.disk.used_percent = info.disk.used_percent
+    h.network.tcp_connection_count = info.network.tcp_connection_count
+    h.network.upload_tcp_connection_count = info.network.upload_tcp_connection_count
+    h.network.location = info.network.location
+    h.network.idc = info.network.idc
+    return h
+
+
+class SchedulerService:
+    def __init__(
+        self,
+        resource: res.Resource,
+        scheduling: Scheduling,
+        storage: Storage | None = None,
+        networktopology: NetworkTopology | None = None,
+    ):
+        self.resource = resource
+        self.scheduling = scheduling
+        self.storage = storage
+        self.networktopology = networktopology
+
+    # ------------------------------------------------------------------
+    # AnnouncePeer bidi stream
+    # ------------------------------------------------------------------
+    def AnnouncePeer(self, request_iterator, context):
+        adapter = _StreamAdapter()
+        state: dict = {"peer": None}
+
+        def pump():
+            try:
+                for req in request_iterator:
+                    self._handle_announce(req, adapter, state)
+            except grpc.RpcError:
+                pass  # client hung up — normal stream teardown
+            except Exception:
+                logger.exception("announce stream failed")
+            finally:
+                peer = state.get("peer")
+                if peer is not None:
+                    peer.delete_stream()
+                adapter.close()
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        while True:
+            resp = adapter.out.get()
+            if resp is None:
+                return
+            yield resp
+
+    def _handle_announce(self, req, adapter: _StreamAdapter, state: dict) -> None:
+        which = req.WhichOneof("request")
+        if which == "register_peer":
+            state["peer"] = self._register_peer(req, adapter)
+            return
+        peer = state.get("peer") or self.resource.peer_manager.load(req.peer_id)
+        if peer is None:
+            logger.warning("event %s for unknown peer %s", which, req.peer_id)
+            return
+        state["peer"] = peer
+
+        if which == "download_peer_started":
+            if peer.fsm.can(res.PEER_EVENT_DOWNLOAD):
+                peer.fsm.event(res.PEER_EVENT_DOWNLOAD)
+            if peer.task.fsm.can(res.TASK_EVENT_DOWNLOAD):
+                peer.task.fsm.event(res.TASK_EVENT_DOWNLOAD)
+        elif which == "download_peer_back_to_source_started":
+            if peer.fsm.can(res.PEER_EVENT_DOWNLOAD_BACK_TO_SOURCE):
+                peer.fsm.event(res.PEER_EVENT_DOWNLOAD_BACK_TO_SOURCE)
+                peer.task.back_to_source_peers.add(peer.id)
+            if peer.task.fsm.can(res.TASK_EVENT_DOWNLOAD):
+                peer.task.fsm.event(res.TASK_EVENT_DOWNLOAD)
+        elif which == "reschedule":
+            for pid in req.reschedule.blocked_parent_ids:
+                peer.block_parents.add(pid)
+            self._schedule(peer, adapter)
+        elif which == "download_piece_finished":
+            self._piece_finished(peer, req.download_piece_finished.piece)
+        elif which == "download_piece_failed":
+            parent_id = req.download_piece_failed.parent_id
+            if parent_id:
+                peer.block_parents.add(parent_id)
+                parent = self.resource.peer_manager.load(parent_id)
+                if parent is not None:
+                    parent.host.record_upload(success=False)
+        elif which == "download_peer_finished":
+            fin = req.download_peer_finished
+            peer.cost_ns = fin.cost_ns
+            if peer.fsm.can(res.PEER_EVENT_DOWNLOAD_SUCCEEDED):
+                peer.fsm.event(res.PEER_EVENT_DOWNLOAD_SUCCEEDED)
+            if fin.content_length and peer.task.content_length < 0:
+                peer.task.content_length = fin.content_length
+            if fin.piece_count and peer.task.total_piece_count < 0:
+                peer.task.total_piece_count = fin.piece_count
+            if peer.task.fsm.can(res.TASK_EVENT_DOWNLOAD_SUCCEEDED):
+                peer.task.fsm.event(res.TASK_EVENT_DOWNLOAD_SUCCEEDED)
+            self._write_download_record(peer)
+        elif which == "download_peer_failed":
+            if peer.fsm.can(res.PEER_EVENT_DOWNLOAD_FAILED):
+                peer.fsm.event(res.PEER_EVENT_DOWNLOAD_FAILED)
+            if peer.task.fsm.can(res.TASK_EVENT_DOWNLOAD_FAILED):
+                peer.task.fsm.event(res.TASK_EVENT_DOWNLOAD_FAILED)
+            self._write_download_record(
+                peer, error_code="download_failed",
+                error_message=req.download_peer_failed.description,
+            )
+
+    def _register_peer(self, req, adapter: _StreamAdapter) -> res.Peer | None:
+        reg = req.register_peer
+        host = self.resource.host_manager.load(req.host_id)
+        if host is None:
+            logger.warning("register from unannounced host %s", req.host_id)
+            host = res.Host(id=req.host_id)
+            self.resource.host_manager.store(host)
+
+        meta = URLMeta(
+            digest=reg.url_meta.digest,
+            tag=reg.url_meta.tag,
+            range=reg.url_meta.range,
+            filter=reg.url_meta.filter,
+            application=reg.url_meta.application,
+        )
+        task_id = reg.task_id or task_id_v1(reg.url, meta)
+        task = self.resource.task_manager.load(task_id)
+        if task is None:
+            task_type = {
+                common_pb2.TASK_TYPE_DFSTORE: res.TaskType.DFSTORE,
+                common_pb2.TASK_TYPE_DFCACHE: res.TaskType.DFCACHE,
+            }.get(reg.task_type, res.TaskType.STANDARD)
+            task = res.Task(
+                task_id, url=reg.url, task_type=task_type,
+                digest=meta.digest, tag=meta.tag, application=meta.application,
+            )
+            self.resource.task_manager.store(task)
+
+        peer = res.Peer(
+            reg.peer_id, task, host, tag=meta.tag, application=meta.application
+        )
+        peer, existed = self.resource.peer_manager.load_or_store(peer)
+        peer.store_stream(adapter)
+        peer.need_back_to_source = reg.need_back_to_source
+
+        if existed and not peer.fsm.is_state(res.PEER_STATE_PENDING):
+            # reconnect with the same peer_id: don't re-fire register
+            # events (illegal transition); re-dispatch by current state
+            if peer.fsm.is_state(res.PEER_STATE_RECEIVED_NORMAL, res.PEER_STATE_RUNNING):
+                self._schedule(peer, adapter)
+            return peer
+
+        # size-scope dispatch (reference service_v2.go:820-920 /
+        # service_v1.go:1005-1110)
+        scope = task.size_scope()
+        if scope is res.SizeScope.EMPTY:
+            peer.fsm.event(res.PEER_EVENT_REGISTER_EMPTY)
+            adapter.send(
+                scheduler_pb2.AnnouncePeerResponse(
+                    empty_task=scheduler_pb2.EmptyTaskResponse()
+                )
+            )
+        elif scope is res.SizeScope.TINY and task.can_reuse_direct_piece():
+            peer.fsm.event(res.PEER_EVENT_REGISTER_TINY)
+            adapter.send(
+                scheduler_pb2.AnnouncePeerResponse(
+                    tiny_task=scheduler_pb2.TinyTaskResponse(content=task.direct_piece)
+                )
+            )
+        else:
+            peer.fsm.event(res.PEER_EVENT_REGISTER_NORMAL)
+            self._schedule(peer, adapter)
+        return peer
+
+    def _schedule(self, peer: res.Peer, adapter: _StreamAdapter) -> None:
+        try:
+            self.scheduling.schedule_candidate_parents(peer, set(peer.block_parents))
+        except SchedulingError as e:
+            logger.warning("scheduling peer %s failed: %s", peer.id, e)
+
+    def _piece_finished(self, peer: res.Peer, piece: common_pb2.PieceInfo) -> None:
+        cost_ms = piece.cost_ns / 1e6
+        peer.finish_piece(
+            piece.number,
+            cost_ms=cost_ms,
+            piece=res.Piece(
+                number=piece.number,
+                parent_id=piece.parent_id,
+                offset=piece.offset,
+                length=piece.length,
+                digest=piece.digest,
+                traffic_type=piece.traffic_type,
+                cost_ms=cost_ms,
+                created_at=piece.created_at_ns / 1e9 if piece.created_at_ns else time.time(),
+            ),
+        )
+        if piece.parent_id:
+            parent = self.resource.peer_manager.load(piece.parent_id)
+            if parent is not None:
+                parent.host.record_upload(success=True)
+
+    def _write_download_record(self, peer: res.Peer, error_code: str = "", error_message: str = "") -> None:
+        if self.storage is None:
+            return
+        try:
+            self.storage.create_download(
+                build_download_record(peer, error_code, error_message)
+            )
+        except Exception:
+            logger.exception("write download record failed for %s", peer.id)
+
+    # ------------------------------------------------------------------
+    # unary RPCs
+    # ------------------------------------------------------------------
+    def StatPeer(self, request, context):
+        peer = self.resource.peer_manager.load(request.peer_id)
+        if peer is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"peer {request.peer_id} not found")
+        return scheduler_pb2.PeerStat(
+            id=peer.id,
+            state=peer.fsm.current,
+            finished_piece_count=peer.finished_piece_count(),
+            cost_ns=peer.cost_ns,
+        )
+
+    def LeavePeer(self, request, context):
+        peer = self.resource.peer_manager.load(request.peer_id)
+        if peer is not None:
+            if peer.fsm.can(res.PEER_EVENT_LEAVE):
+                peer.fsm.event(res.PEER_EVENT_LEAVE)
+            peer.task.delete_peer_in_edges(peer.id)
+            peer.task.delete_peer_out_edges(peer.id)
+        return scheduler_pb2.Empty()
+
+    def StatTask(self, request, context):
+        task = self.resource.task_manager.load(request.task_id)
+        if task is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"task {request.task_id} not found")
+        return scheduler_pb2.TaskStat(
+            id=task.id,
+            state=task.fsm.current,
+            content_length=task.content_length,
+            total_piece_count=task.total_piece_count,
+            peer_count=task.peer_count(),
+            has_available_peer=task.has_available_peer(),
+        )
+
+    def AnnounceHost(self, request, context):
+        host = _host_from_info(request.host)
+        existing = self.resource.host_manager.load(host.id)
+        if existing is None:
+            self.resource.host_manager.store(host)
+        else:
+            # refresh stats in place, keep identity + peer ownership
+            existing.cpu = host.cpu
+            existing.memory = host.memory
+            existing.network = host.network
+            existing.disk = host.disk
+            existing.concurrent_upload_limit = host.concurrent_upload_limit
+            existing.touch()
+        return scheduler_pb2.Empty()
+
+    def LeaveHost(self, request, context):
+        host = self.resource.host_manager.load(request.host_id)
+        if host is not None:
+            host.leave_peers()
+            self.resource.host_manager.delete(request.host_id)
+        if self.networktopology is not None:
+            self.networktopology.delete_host(request.host_id)
+        return scheduler_pb2.Empty()
+
+    # ------------------------------------------------------------------
+    # SyncProbes bidi stream (reference service_v1.go:688-778)
+    # ------------------------------------------------------------------
+    def SyncProbes(self, request_iterator, context):
+        for req in request_iterator:
+            which = req.WhichOneof("request")
+            src_id = req.host.id
+            if which == "probe_started":
+                if self.networktopology is None:
+                    return
+                hosts = self.networktopology.find_probed_hosts(src_id)
+                yield scheduler_pb2.SyncProbesResponse(
+                    hosts=[scheduler_pb2.ProbeHost(host=_host_info(h)) for h in hosts]
+                )
+            elif which == "probe_finished" and self.networktopology is not None:
+                for probe in req.probe_finished.probes:
+                    self.networktopology.enqueue_probe(
+                        src_id,
+                        Probe(
+                            probe.host_id,
+                            rtt_ns=probe.rtt_ns,
+                            created_at=probe.created_at_ns / 1e9
+                            if probe.created_at_ns
+                            else time.time(),
+                        ),
+                    )
+            # probe_failed: nothing to record

@@ -1,0 +1,71 @@
+"""Trainer RPC service: the `Train` client-stream endpoint (reference
+trainer/service/service_v1.go:59-162).
+
+First message keys the uploading scheduler (hostID = sha256(ip,hostname),
+reference :87); each TrainMlpRequest chunk appends to that host's download
+CSV, TrainGnnRequest to its topology CSV (:126-145); on EOF the fit runs
+asynchronously (:155-159) so the stream ack isn't held for minutes of
+training.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import trainer_pb2  # noqa: E402
+
+from dragonfly2_tpu.trainer.storage import TrainerStorage
+from dragonfly2_tpu.trainer.training import Training
+from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils.idgen import host_id_v2
+
+logger = dflog.get("trainer.rpc")
+
+SERVICE_NAME = "dragonfly2_tpu.trainer.Trainer"
+
+
+class TrainerService:
+    def __init__(self, storage: TrainerStorage, training: Training, synchronous: bool = False):
+        self.storage = storage
+        self.training = training
+        # synchronous=True runs the fit inline (tests); production forks
+        self.synchronous = synchronous
+        self.train_total = 0
+        self.train_failure_total = 0
+
+    def Train(self, request_iterator, context):
+        ip = hostname = None
+        host_id = None
+        self.train_total += 1
+        try:
+            for req in request_iterator:
+                if host_id is None:
+                    ip, hostname = req.ip, req.hostname
+                    host_id = host_id_v2(ip, hostname)
+                which = req.WhichOneof("request")
+                if which == "train_mlp":
+                    self.storage.append_download(host_id, req.train_mlp.dataset)
+                elif which == "train_gnn":
+                    self.storage.append_network_topology(host_id, req.train_gnn.dataset)
+        except Exception:
+            self.train_failure_total += 1
+            raise
+
+        if host_id is not None:
+            if self.synchronous:
+                self.training.train(ip, hostname)
+            else:
+                threading.Thread(
+                    target=self._train_safely, args=(ip, hostname), daemon=True
+                ).start()
+        return trainer_pb2.TrainResponse()
+
+    def _train_safely(self, ip: str, hostname: str) -> None:
+        try:
+            outcome = self.training.train(ip, hostname)
+            if not outcome.ok:
+                self.train_failure_total += 1
+        except Exception:
+            self.train_failure_total += 1
+            logger.exception("training run failed for %s/%s", ip, hostname)

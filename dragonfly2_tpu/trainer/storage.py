@@ -12,8 +12,9 @@ from __future__ import annotations
 import threading
 from pathlib import Path
 
+import csv
+
 from dragonfly2_tpu.schema import records as R
-from dragonfly2_tpu.schema.columnar import read_csv
 
 
 class TrainerStorage:
@@ -39,16 +40,33 @@ class TrainerStorage:
 
     # -- reads ------------------------------------------------------------
     def list_download(self, host_id: str) -> list[R.DownloadRecord]:
-        p = self.download_path(host_id)
-        if not p.exists():
-            return []
-        return read_csv(p, R.DownloadRecord)
+        return self._read_concatenated(self.download_path(host_id), R.DownloadRecord)
 
     def list_network_topology(self, host_id: str) -> list[R.NetworkTopologyRecord]:
-        p = self.network_topology_path(host_id)
-        if not p.exists():
+        return self._read_concatenated(
+            self.network_topology_path(host_id), R.NetworkTopologyRecord
+        )
+
+    @staticmethod
+    def _read_concatenated(path: Path, cls: type) -> list:
+        """Parse a file made of appended CSV uploads: every upload round
+        (and every rotated backup within a round) starts with its own
+        header line, so embedded headers must be skipped, not parsed as
+        data rows."""
+        if not path.exists():
             return []
-        return read_csv(p, R.NetworkTopologyRecord)
+        out = []
+        with open(path, newline="") as f:
+            reader = csv.reader(f)
+            header: list[str] | None = None
+            for row in reader:
+                if header is None:
+                    header = row
+                    continue
+                if row == header:
+                    continue  # embedded header from a later upload/backup
+                out.append(R.unflatten(cls, dict(zip(header, row))))
+        return out
 
     def host_ids(self) -> list[str]:
         """Every host with at least one dataset file (the FedAvg shards)."""

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Regenerate protobuf message modules (messages only; gRPC method stubs are
+# hand-written in dragonfly2_tpu/rpc/glue.py).
+set -euo pipefail
+cd "$(dirname "$0")/../dragonfly2_tpu/rpc"
+protoc -I protos --python_out=gen \
+  protos/common.proto protos/scheduler.proto protos/trainer.proto \
+  protos/manager.proto protos/dfdaemon.proto
+echo "generated: $(ls gen/*_pb2.py)"

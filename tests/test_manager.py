@@ -1,0 +1,178 @@
+"""Manager control plane: registry, keepalive expiry, dynconfig, model
+versioning/activation, searcher scoring — over real gRPC."""
+
+import time
+
+import numpy as np
+import pytest
+
+import grpc
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import manager_pb2
+
+from dragonfly2_tpu.manager.database import Database
+from dragonfly2_tpu.manager.models_registry import ModelRegistry
+from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+from dragonfly2_tpu.manager.searcher import (
+    Cluster,
+    ClusterScope,
+    PeerInfo,
+    Searcher,
+    cidr_affinity,
+)
+from dragonfly2_tpu.manager.service import SERVICE_NAME, ManagerService
+from dragonfly2_tpu.rpc.glue import ServiceClient, dial, serve
+
+
+@pytest.fixture
+def manager(tmp_path):
+    db = Database(tmp_path / "manager.db")
+    registry = ModelRegistry(db, FSObjectStorage(tmp_path / "objects"))
+    service = ManagerService(db, registry)
+    server, port = serve({SERVICE_NAME: service})
+    channel = dial(f"127.0.0.1:{port}")
+    client = ServiceClient(channel, SERVICE_NAME)
+    yield client, service, db, registry
+    channel.close()
+    server.stop(0)
+
+
+class TestSchedulerRegistry:
+    def test_register_get_list(self, manager):
+        client, service, db, _ = manager
+        s = client.UpdateScheduler(
+            manager_pb2.UpdateSchedulerRequest(hostname="sched-1", ip="10.0.0.1", port=8002, idc="idc-a")
+        )
+        assert s.id > 0 and s.state == "active"
+        got = client.GetScheduler(manager_pb2.GetSchedulerRequest(hostname="sched-1", ip="10.0.0.1"))
+        assert got.id == s.id
+        lst = client.ListSchedulers(manager_pb2.ListSchedulersRequest())
+        assert [x.hostname for x in lst.schedulers] == ["sched-1"]
+
+    def test_keepalive_expiry(self, manager):
+        client, service, db, _ = manager
+        client.UpdateScheduler(
+            manager_pb2.UpdateSchedulerRequest(hostname="sched-1", ip="10.0.0.1", port=8002)
+        )
+        # silence: backdate last_keepalive past the timeout
+        db.execute("UPDATE schedulers SET last_keepalive = ?", (time.time() - 3600,))
+        lst = client.ListSchedulers(manager_pb2.ListSchedulersRequest())
+        assert lst.schedulers == []
+        # keepalive revives
+        client.KeepAlive(
+            iter([manager_pb2.KeepAliveRequest(source_type="scheduler", hostname="sched-1", ip="10.0.0.1")])
+        )
+        lst = client.ListSchedulers(manager_pb2.ListSchedulersRequest())
+        assert len(lst.schedulers) == 1
+
+    def test_seed_peer_register(self, manager):
+        client, *_ = manager
+        sp = client.UpdateSeedPeer(
+            manager_pb2.UpdateSeedPeerRequest(
+                hostname="seed-1", ip="10.0.0.9", port=8002, download_port=8001, seed_peer_cluster_id=1
+            )
+        )
+        assert sp.id > 0 and sp.type == "super"
+
+
+class TestDynconfig:
+    def test_cluster_config_roundtrip(self, manager):
+        client, service, db, _ = manager
+        db.execute(
+            "UPDATE scheduler_clusters SET config = ? WHERE id = ?",
+            (Database.dumps({"candidate_parent_limit": 6, "filter_parent_limit": 30}), service.default_cluster_id),
+        )
+        cfg = client.GetSchedulerClusterConfig(manager_pb2.GetSchedulerClusterConfigRequest())
+        assert cfg.candidate_parent_limit == 6
+        assert cfg.filter_parent_limit == 30
+
+
+class TestModelRegistry:
+    def test_versioning_and_activation(self, manager):
+        client, *_ = manager
+        for i in range(3):
+            m = client.CreateModel(
+                manager_pb2.CreateModelRequest(
+                    model_id="m1",
+                    type="mlp",
+                    ip="10.0.0.1",
+                    hostname="sched-1",
+                    weights=f"blob-{i}".encode(),
+                    evaluation=manager_pb2.ModelEvaluation(mse=0.1 * (i + 1)),
+                )
+            )
+            assert m.version == i + 1 and m.state == "inactive"
+
+        # no active version yet
+        with pytest.raises(grpc.RpcError):
+            client.GetModel(manager_pb2.GetModelRequest(model_id="m1", version=0))
+
+        act = client.UpdateModel(
+            manager_pb2.UpdateModelRequest(model_id="m1", version=2, state="active")
+        )
+        assert act.state == "active"
+        active = client.GetModel(manager_pb2.GetModelRequest(model_id="m1", version=0))
+        assert active.version == 2
+        # activating another flips the old one off
+        client.UpdateModel(manager_pb2.UpdateModelRequest(model_id="m1", version=3, state="active"))
+        lst = client.ListModels(manager_pb2.ListModelsRequest())
+        states = {m.version: m.state for m in lst.models}
+        assert states == {1: "inactive", 2: "inactive", 3: "active"}
+
+    def test_weights_blob_round_trip(self, manager):
+        client, service, db, registry = manager
+        client.CreateModel(
+            manager_pb2.CreateModelRequest(
+                model_id="m2", type="gnn", weights=b"\x01\x02\x03",
+                evaluation=manager_pb2.ModelEvaluation(f1=0.9),
+            )
+        )
+        assert registry.load_weights("m2", 1) == b"\x01\x02\x03"
+
+    def test_serialized_params_round_trip_through_registry(self, manager):
+        client, service, db, registry = manager
+        import jax
+
+        from dragonfly2_tpu.models.mlp import init_mlp
+        from dragonfly2_tpu.trainer.serving import (
+            MLPScorer,
+            deserialize_params,
+            serialize_params,
+        )
+
+        params = init_mlp(jax.random.PRNGKey(0), [12, 16, 1])
+        client.CreateModel(
+            manager_pb2.CreateModelRequest(
+                model_id="m3", type="mlp", weights=serialize_params(params),
+                evaluation=manager_pb2.ModelEvaluation(mse=0.05),
+            )
+        )
+        blob = registry.load_weights("m3", 1)
+        restored = deserialize_params(blob, params)
+        scorer = MLPScorer(restored)
+        x = np.random.default_rng(0).uniform(size=(4, 12)).astype(np.float32)
+        a = scorer.predict(x)
+        b = MLPScorer(params).predict(x)
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+class TestSearcher:
+    def test_cidr(self):
+        assert cidr_affinity("10.1.2.3", ["10.1.0.0/16"]) == 1.0
+        assert cidr_affinity("192.168.0.1", ["10.1.0.0/16"]) == 0.0
+        assert cidr_affinity("bogus", ["10.1.0.0/16"]) == 0.0
+
+    def test_cluster_selection(self):
+        clusters = [
+            Cluster(1, "default", ClusterScope(), is_default=True),
+            Cluster(2, "cn", ClusterScope(idc="idc-a|idc-b", location="as|cn", cidrs=["10.0.0.0/8"])),
+            Cluster(3, "eu", ClusterScope(idc="idc-z", location="eu|de", cidrs=["172.16.0.0/12"])),
+        ]
+        s = Searcher()
+        peer = PeerInfo(ip="10.5.5.5", idc="idc-b", location="as|cn|sh")
+        assert s.find_matching_cluster(clusters, peer).id == 2
+        eu_peer = PeerInfo(ip="172.16.1.1", idc="idc-z", location="eu|de|fra")
+        assert s.find_matching_cluster(clusters, eu_peer).id == 3
+        nowhere = PeerInfo(ip="8.8.8.8")
+        assert s.find_matching_cluster(clusters, nowhere).id == 1  # default bonus
