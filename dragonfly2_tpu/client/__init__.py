@@ -1,0 +1,10 @@
+"""Client plane: peer daemon (piece store, P2P piece pipeline, upload
+server, gRPC surface) + thin CLIs (dfget/dfcache/dfstore).
+
+Role parity: reference client/ tree — daemon assembly
+(client/daemon/daemon.go), conductor hot path
+(client/daemon/peer/peertask_conductor.go), piece disk store
+(client/daemon/storage/storage_manager.go), upload server
+(client/daemon/upload/upload_manager.go), CLIs (client/dfget,
+client/dfcache, client/dfstore).
+"""

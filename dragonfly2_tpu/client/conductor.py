@@ -1,0 +1,467 @@
+"""Peer task conductor — one per (task, peer): the client hot path.
+
+Role parity: reference client/daemon/peer/peertask_conductor.go:68-1584 —
+register with the scheduler (:249), ingest parent assignments from the
+announce stream (:659-774), fan piece downloads across workers
+(:976-1108), fall back to the origin when told to (:485-523), and report
+every piece + the final result back up the stream (which is what produces
+the scheduler's Download training records).
+
+The v2 AnnouncePeer bidi stream replaces the reference's v1
+RegisterPeerTask/ReportPieceResult pair; piece *bytes* still ride HTTP
+from the parent's upload server.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import common_pb2  # noqa: E402
+import scheduler_pb2  # noqa: E402
+
+from dragonfly2_tpu.client.downloader import PieceDownloadError
+from dragonfly2_tpu.client.piece_manager import (
+    ParentInfo,
+    PieceDispatcher,
+    PieceManager,
+    PieceResult,
+    TRAFFIC_REMOTE_PEER,
+)
+from dragonfly2_tpu.client.pieces import PieceRange, piece_ranges
+from dragonfly2_tpu.client.storage import StorageManager
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("client.conductor")
+
+
+@dataclass
+class Progress:
+    completed_length: int = 0
+    content_length: int = -1
+    done: bool = False
+    error: str = ""
+
+
+@dataclass
+class ConductorOptions:
+    piece_workers: int = 4
+    schedule_timeout: float = 10.0
+    piece_retry: int = 3
+    disable_back_source: bool = False
+    piece_length: int = 0  # 0 = derive from content length
+
+
+class PeerTaskConductor:
+    """Drives one peer's download of one task end to end."""
+
+    def __init__(
+        self,
+        task_id: str,
+        peer_id: str,
+        host_id: str,
+        url: str,
+        url_meta: common_pb2.UrlMeta,
+        storage: StorageManager,
+        scheduler_client,
+        piece_manager: PieceManager | None = None,
+        options: ConductorOptions | None = None,
+        task_type: int = 0,
+        headers: dict | None = None,
+        on_done=None,
+    ):
+        self.task_id = task_id
+        self.peer_id = peer_id
+        self.host_id = host_id
+        self.url = url
+        self.url_meta = url_meta
+        self.storage = storage
+        self.scheduler = scheduler_client
+        self.pm = piece_manager or PieceManager()
+        self.opts = options or ConductorOptions()
+        self.task_type = task_type
+        self.headers = headers or {}
+        self.on_done = on_done
+
+        self.ts = storage.register_task(
+            task_id,
+            peer_id,
+            url=url,
+            piece_length=self.opts.piece_length,
+            tag=url_meta.tag,
+            application=url_meta.application,
+        )
+        self._requests: "queue.Queue[scheduler_pb2.AnnouncePeerRequest | None]" = queue.Queue()
+        self._decisions: "queue.Queue[object]" = queue.Queue()
+        self._progress_subs: list["queue.Queue[Progress]"] = []
+        self._lock = threading.Lock()
+        self._completed = 0
+        self._blocked_parents: set[str] = set()
+        self._done = threading.Event()
+        self._error: str | None = None
+        self._started_at = 0.0
+        self._stream_thread: threading.Thread | None = None
+        self._run_thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._started_at = time.monotonic()
+        self._stream_thread = threading.Thread(
+            target=self._stream_loop, name=f"announce-{self.peer_id[:8]}", daemon=True
+        )
+        self._stream_thread.start()
+        self._run_thread = threading.Thread(
+            target=self._run, name=f"conductor-{self.peer_id[:8]}", daemon=True
+        )
+        self._run_thread.start()
+
+    def wait(self, timeout: float | None = None) -> Progress:
+        self._done.wait(timeout)
+        return self.progress()
+
+    def progress(self) -> Progress:
+        with self._lock:
+            return Progress(
+                completed_length=self._completed,
+                content_length=self.ts.meta.content_length,
+                done=self._done.is_set() and self._error is None,
+                error=self._error or "",
+            )
+
+    def subscribe(self) -> "queue.Queue[Progress]":
+        q: "queue.Queue[Progress]" = queue.Queue()
+        with self._lock:
+            self._progress_subs.append(q)
+        if self._done.is_set():  # already finished — deliver terminal state
+            q.put(self.progress())
+        return q
+
+    def _publish(self) -> None:
+        p = self.progress()
+        with self._lock:
+            subs = list(self._progress_subs)
+        for q in subs:
+            q.put(p)
+
+    # ------------------------------------------------------------------
+    # announce stream plumbing
+    # ------------------------------------------------------------------
+    def _req_iter(self):
+        while True:
+            r = self._requests.get()
+            if r is None:
+                return
+            yield r
+
+    def _send(self, **kwargs) -> None:
+        self._requests.put(
+            scheduler_pb2.AnnouncePeerRequest(
+                host_id=self.host_id, task_id=self.task_id, peer_id=self.peer_id, **kwargs
+            )
+        )
+
+    def _stream_loop(self) -> None:
+        """Own thread: consumes scheduler responses, queues decisions for
+        the run loop (reference receivePeerPacket :659)."""
+        try:
+            responses = self.scheduler.AnnouncePeer(self._req_iter())
+            for resp in responses:
+                which = resp.WhichOneof("response")
+                self._decisions.put((which, getattr(resp, which)))
+        except Exception as e:  # stream teardown or scheduler gone
+            if not self._done.is_set():
+                logger.warning("announce stream for %s ended: %s", self.peer_id, e)
+                self._decisions.put(("stream_error", str(e)))
+
+    # ------------------------------------------------------------------
+    # main run loop
+    # ------------------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            self._send(
+                register_peer=scheduler_pb2.RegisterPeerRequest(
+                    task_id=self.task_id,
+                    peer_id=self.peer_id,
+                    url=self.url,
+                    url_meta=self.url_meta,
+                    task_type=self.task_type,
+                    need_back_to_source=False,
+                )
+            )
+            self._drive()
+        except Exception as e:
+            logger.exception("conductor %s failed", self.peer_id)
+            self._fail(str(e))
+        finally:
+            self._requests.put(None)
+
+    def _drive(self) -> None:
+        while not self._done.is_set():
+            try:
+                which, body = self._decisions.get(timeout=self.opts.schedule_timeout)
+            except queue.Empty:
+                # No decision in time: back-source if allowed, else fail
+                # (reference needBackSource fallback :485-523).
+                if self.opts.disable_back_source:
+                    self._fail("schedule timeout and back-to-source disabled")
+                else:
+                    self._back_to_source()
+                return
+
+            if which == "empty_task":
+                self.ts.meta.piece_length = self.ts.meta.piece_length or 1
+                self.ts.mark_done(0)
+                self._finish(piece_count=0)
+                return
+            if which == "tiny_task":
+                content = body.content
+                self.ts.meta.piece_length = max(len(content), 1)
+                t0 = time.monotonic()
+                pm = self.ts.write_piece(
+                    0, 0, content, traffic_type=TRAFFIC_REMOTE_PEER,
+                    cost_ns=int((time.monotonic() - t0) * 1e9),
+                )
+                self._piece_done(PieceResult(pm.number, pm.offset, pm.length, pm.digest, pm.traffic_type, pm.cost_ns, ""))
+                self.ts.mark_done(len(content))
+                self._finish(piece_count=1)
+                return
+            if which == "need_back_to_source":
+                if self.opts.disable_back_source:
+                    self._fail(f"need back-to-source but disabled: {body.description}")
+                    return
+                self._back_to_source()
+                return
+            if which in ("normal_task", "small_task"):
+                parents = (
+                    list(body.candidate_parents)
+                    if which == "normal_task"
+                    else [body.candidate_parent]
+                )
+                if self._download_from_parents(parents):
+                    return
+                continue  # rescheduled — wait for next decision
+            if which == "stream_error":
+                if self.opts.disable_back_source:
+                    self._fail(f"announce stream error: {body}")
+                else:
+                    self._back_to_source()
+                return
+
+    # ------------------------------------------------------------------
+    def _back_to_source(self) -> None:
+        self._send(
+            download_peer_back_to_source_started=scheduler_pb2.DownloadPeerBackToSourceStartedRequest(
+                description="falling back to origin"
+            )
+        )
+        try:
+            n = self.pm.download_source(
+                self.ts, self.url, headers=self.headers, on_piece=self._piece_done
+            )
+        except Exception as e:
+            self._fail(f"back-to-source failed: {e}")
+            return
+        self._finish(piece_count=len(self.ts.meta.pieces), content_length=n)
+
+    # ------------------------------------------------------------------
+    def _download_from_parents(self, candidates) -> bool:
+        """Pull all pieces from candidate parents; True when the task
+        finished (success or failure), False to wait for a reschedule."""
+        # adopt task geometry from the first parent that knows it
+        content_length = self.ts.meta.content_length
+        piece_length = self.ts.meta.piece_length
+        for c in candidates:
+            if c.task_content_length > 0 and content_length < 0:
+                content_length = c.task_content_length
+            if c.task_piece_length > 0 and not piece_length:
+                piece_length = c.task_piece_length
+        if content_length < 0 or not piece_length:
+            # ask a parent daemon directly for the piece inventory
+            # (reference piece-metadata sync between daemons,
+            # peertask_piecetask_synchronizer.go)
+            content_length, piece_length = self._fetch_task_geometry(
+                candidates, content_length, piece_length
+            )
+        if content_length < 0 or not piece_length:
+            self._reschedule([c.peer_id for c in candidates], "parents lack task metadata")
+            return False
+        self.ts.meta.content_length = content_length
+        self.ts.meta.piece_length = piece_length
+
+        parents = [
+            ParentInfo(
+                peer_id=c.peer_id,
+                upload_addr=f"{c.host.ip}:{c.host.download_port}",
+                finished_pieces=set(c.finished_pieces),
+            )
+            for c in candidates
+            if c.peer_id not in self._blocked_parents
+        ]
+        if not parents:
+            self._reschedule([], "all candidate parents blocked")
+            return False
+
+        self._send(download_peer_started=scheduler_pb2.DownloadPeerStartedRequest())
+        dispatcher = PieceDispatcher()
+        todo = [
+            pr for pr in piece_ranges(content_length, piece_length)
+            if pr.number not in self.ts.meta.pieces
+        ]
+        # account pieces already on disk (resume)
+        with self._lock:
+            self._completed = sum(p.length for p in self.ts.meta.pieces.values())
+
+        failed: list[PieceRange] = []
+        lock = threading.Lock()
+
+        def work(pr: PieceRange) -> None:
+            last_err: Exception | None = None
+            for _ in range(self.opts.piece_retry):
+                with lock:
+                    live = [p for p in parents if p.peer_id not in self._blocked_parents]
+                parent = dispatcher.pick(live, pr.number)
+                if parent is None:
+                    break
+                try:
+                    result = self.pm.download_piece_from_parent(
+                        self.ts, parent, pr, self.peer_id
+                    )
+                    self._piece_done(result)
+                    return
+                except PieceDownloadError as e:
+                    last_err = e
+                    self._send(
+                        download_piece_failed=scheduler_pb2.DownloadPieceFailedRequest(
+                            piece_number=pr.number, parent_id=parent.peer_id, temporary=True
+                        )
+                    )
+                    with lock:
+                        self._blocked_parents.add(parent.peer_id)
+            logger.warning("piece %d failed from all parents: %s", pr.number, last_err)
+            with lock:
+                failed.append(pr)
+
+        with ThreadPoolExecutor(max_workers=self.opts.piece_workers) as pool:
+            list(pool.map(work, todo))
+
+        if not failed:
+            self.ts.mark_done(content_length)
+            self._finish(piece_count=len(self.ts.meta.pieces), content_length=content_length)
+            return True
+
+        # some pieces failed everywhere → reschedule with blocklist;
+        # scheduler may answer with fresh parents or back-to-source
+        self._reschedule(sorted(self._blocked_parents), f"{len(failed)} pieces failed")
+        return False
+
+    def _fetch_task_geometry(
+        self, candidates, content_length: int, piece_length: int
+    ) -> tuple[int, int]:
+        """GetPieceTasks against candidate parents' daemon gRPC ports to
+        learn (content_length, piece_length)."""
+        from dragonfly2_tpu.rpc import glue
+        import dfdaemon_pb2  # noqa: E402 — flat proto import
+
+        for c in candidates:
+            if not c.host.port:
+                continue
+            try:
+                channel = glue.dial(f"{c.host.ip}:{c.host.port}", retries=1)
+                try:
+                    parent = glue.ServiceClient(
+                        channel, "dragonfly2_tpu.dfdaemon.Dfdaemon"
+                    )
+                    packet = parent.GetPieceTasks(
+                        dfdaemon_pb2.PieceTaskRequest(
+                            task_id=self.task_id,
+                            src_peer_id=self.peer_id,
+                            dst_peer_id=c.peer_id,
+                            limit=1,
+                        )
+                    )
+                finally:
+                    channel.close()
+            except Exception as e:
+                logger.debug("GetPieceTasks from %s failed: %s", c.peer_id, e)
+                continue
+            if packet.content_length >= 0 and packet.piece_infos:
+                if content_length < 0:
+                    content_length = packet.content_length
+                if not piece_length:
+                    piece_length = packet.piece_infos[0].length
+                return content_length, piece_length
+        return content_length, piece_length
+
+    def _reschedule(self, blocked: list[str], description: str) -> None:
+        self._send(
+            reschedule=scheduler_pb2.RescheduleRequest(
+                blocked_parent_ids=blocked, description=description
+            )
+        )
+
+    # ------------------------------------------------------------------
+    def _piece_done(self, r: PieceResult) -> None:
+        with self._lock:
+            self._completed += r.length
+        self._send(
+            download_piece_finished=scheduler_pb2.DownloadPieceFinishedRequest(
+                piece=common_pb2.PieceInfo(
+                    number=r.number,
+                    parent_id=r.parent_id,
+                    offset=r.offset,
+                    length=r.length,
+                    digest=r.digest,
+                    traffic_type=r.traffic_type,
+                    cost_ns=r.cost_ns,
+                    created_at_ns=time.time_ns(),
+                )
+            )
+        )
+        self._publish()
+
+    def _finish(self, piece_count: int, content_length: int | None = None) -> None:
+        cost_ns = int((time.monotonic() - self._started_at) * 1e9)
+        self._send(
+            download_peer_finished=scheduler_pb2.DownloadPeerFinishedRequest(
+                content_length=(
+                    content_length
+                    if content_length is not None
+                    else max(self.ts.meta.content_length, 0)
+                ),
+                piece_count=piece_count,
+                cost_ns=cost_ns,
+            )
+        )
+        self._drain_stream()
+        self._done.set()
+        self._publish()
+        if self.on_done:
+            self.on_done(self)
+
+    def _fail(self, description: str) -> None:
+        self._error = description
+        self._send(
+            download_peer_failed=scheduler_pb2.DownloadPeerFailedRequest(
+                description=description
+            )
+        )
+        self._drain_stream()
+        self._done.set()
+        self._publish()
+        if self.on_done:
+            self.on_done(self)
+
+    def _drain_stream(self) -> None:
+        """Close the request side and wait for the server to close the
+        response side — the server handles requests in order, so when the
+        stream ends the final peer event (and its Download record) has
+        been processed."""
+        self._requests.put(None)
+        if self._stream_thread is not None and self._stream_thread is not threading.current_thread():
+            self._stream_thread.join(timeout=5.0)

@@ -1,0 +1,251 @@
+"""Peer daemon assembly: wires storage, piece pipeline, upload server,
+gRPC surface, announcer, prober, and GC into one process.
+
+Role parity: reference client/daemon/daemon.go:86-899 (assembly),
+client/daemon/announcer/announcer.go:45-337 (host announce),
+client/daemon/networktopology/network_topology.go:39-203 (prober),
+client/daemon/gc/gc.go (storage GC runner).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import common_pb2  # noqa: E402
+import scheduler_pb2  # noqa: E402
+
+from dragonfly2_tpu.rpc import glue
+from dragonfly2_tpu.client.conductor import ConductorOptions
+from dragonfly2_tpu.client.peertask import TaskManager
+from dragonfly2_tpu.client.piece_manager import PieceManager
+from dragonfly2_tpu.client.rpcserver import SERVICE_NAME as DFDAEMON_SERVICE, DfdaemonService
+from dragonfly2_tpu.client.storage import StorageManager
+from dragonfly2_tpu.client.uploader import UploadServer
+from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils.gc import GC, GCTask
+from dragonfly2_tpu.utils.idgen import host_id_v2
+
+logger = dflog.get("client.daemon")
+
+SCHEDULER_SERVICE = "dragonfly2_tpu.scheduler.Scheduler"
+
+
+@dataclass
+class DaemonConfig:
+    data_dir: str
+    scheduler_address: str
+    hostname: str = field(default_factory=socket.gethostname)
+    ip: str = "127.0.0.1"
+    listen: str = "127.0.0.1:0"  # daemon gRPC
+    upload_host: str = "127.0.0.1"
+    upload_port: int = 0
+    host_type: str = "normal"  # "normal" | "super" (seed peer)
+    location: str = ""
+    idc: str = ""
+    storage_max_bytes: int = 0
+    gc_interval: float = 60.0
+    announce_interval: float = 30.0
+    probe_interval: float = 0.0  # 0 = prober disabled
+    piece_workers: int = 4
+    piece_length: int = 0  # 0 = derive from content length
+    schedule_timeout: float = 10.0
+    concurrent_upload_limit: int = 50
+    scheduler_cluster_id: int = 1
+
+
+class Daemon:
+    """One peer host: piece store + upload server + dfdaemon gRPC +
+    scheduler announce/probe loops."""
+
+    def __init__(self, config: DaemonConfig):
+        self.cfg = config
+        self.host_id = host_id_v2(config.ip, config.hostname)
+        self.storage = StorageManager(config.data_dir, max_bytes=config.storage_max_bytes)
+        self.upload = UploadServer(
+            self.storage, host=config.upload_host, port=config.upload_port
+        )
+        self._channel = None
+        self._scheduler = None
+        self._server = None
+        self.port = 0
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.gc = GC()
+        self.task_manager: TaskManager | None = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self.upload.start()
+        self._channel = glue.dial(self.cfg.scheduler_address)
+        self._scheduler = glue.ServiceClient(self._channel, SCHEDULER_SERVICE)
+
+        self.task_manager = TaskManager(
+            host_id=self.host_id,
+            storage=self.storage,
+            scheduler_client=self._scheduler,
+            piece_manager=PieceManager(concurrent_pieces=self.cfg.piece_workers),
+            options=ConductorOptions(
+                piece_workers=self.cfg.piece_workers,
+                schedule_timeout=self.cfg.schedule_timeout,
+                piece_length=self.cfg.piece_length,
+            ),
+        )
+        service = DfdaemonService(
+            task_manager=self.task_manager,
+            storage=self.storage,
+            upload_addr=self.upload.address,
+        )
+        self._server, self.port = glue.serve(
+            {DFDAEMON_SERVICE: service}, address=self.cfg.listen
+        )
+
+        self.announce_host()
+        self._spawn(self._announce_loop, "announcer")
+        if self.cfg.probe_interval > 0:
+            self._spawn(self._probe_loop, "prober")
+
+        self.gc.add(
+            GCTask(
+                "storage",
+                interval=self.cfg.gc_interval,
+                timeout=30.0,
+                runner=self.storage.reclaim,
+            )
+        )
+        self.gc.start()
+        logger.info(
+            "daemon up: host=%s grpc=:%d upload=%s", self.host_id, self.port, self.upload.address
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._scheduler.LeaveHost(scheduler_pb2.LeaveHostRequest(host_id=self.host_id))
+        except Exception:
+            pass
+        self.gc.stop()
+        if self._server is not None:
+            self._server.stop(grace=1).wait()
+        self.upload.stop()
+        if self._channel is not None:
+            self._channel.close()
+
+    def _spawn(self, fn, name: str) -> None:
+        t = threading.Thread(target=fn, name=name, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # ------------------------------------------------------------------
+    # host announce (reference client/daemon/announcer/announcer.go:158-303)
+    # ------------------------------------------------------------------
+    def host_info(self) -> common_pb2.HostInfo:
+        return common_pb2.HostInfo(
+            id=self.host_id,
+            type=self.cfg.host_type,
+            hostname=self.cfg.hostname,
+            ip=self.cfg.ip,
+            port=self.port,
+            download_port=self.upload.port,
+            os="linux",
+            concurrent_upload_limit=self.cfg.concurrent_upload_limit,
+            network=common_pb2.NetworkStat(
+                location=self.cfg.location, idc=self.cfg.idc
+            ),
+            scheduler_cluster_id=self.cfg.scheduler_cluster_id,
+        )
+
+    def announce_host(self) -> None:
+        self._scheduler.AnnounceHost(
+            scheduler_pb2.AnnounceHostRequest(host=self.host_info())
+        )
+
+    def _announce_loop(self) -> None:
+        while not self._stop.wait(self.cfg.announce_interval):
+            try:
+                self.announce_host()
+            except Exception as e:
+                logger.warning("announce host failed: %s", e)
+
+    # ------------------------------------------------------------------
+    # prober (reference client/daemon/networktopology/network_topology.go:71-203)
+    #
+    # ICMP needs raw sockets; as an unprivileged stand-in the probe RTT
+    # is a TCP connect round-trip to the target's upload port — same
+    # signal shape (latency to the host), no privileges needed.
+    # ------------------------------------------------------------------
+    def probe_once(self) -> int:
+        """One SyncProbes round; returns number of hosts probed. The
+        request side is queue-fed so the response iterator is only read
+        from this thread (reading it from inside the request generator
+        races gRPC's send loop)."""
+        import queue as _queue
+
+        me = self.host_info()
+        q: "_queue.Queue[scheduler_pb2.SyncProbesRequest | None]" = _queue.Queue()
+        q.put(
+            scheduler_pb2.SyncProbesRequest(
+                host=me, probe_started=scheduler_pb2.ProbeStartedRequest()
+            )
+        )
+        responses = self._scheduler.SyncProbes(iter(q.get, None))
+        probed = 0
+        try:
+            resp = next(responses, None)
+            if resp is not None and resp.hosts:
+                probes, failed = [], []
+                for ph in resp.hosts:
+                    rtt = self._tcp_ping(ph.host.ip, ph.host.download_port or ph.host.port)
+                    if rtt is None:
+                        failed.append(
+                            scheduler_pb2.FailedProbeResult(
+                                host_id=ph.host.id, description="unreachable"
+                            )
+                        )
+                    else:
+                        probes.append(
+                            scheduler_pb2.ProbeResult(
+                                host_id=ph.host.id,
+                                rtt_ns=int(rtt * 1e9),
+                                created_at_ns=time.time_ns(),
+                            )
+                        )
+                if probes:
+                    q.put(
+                        scheduler_pb2.SyncProbesRequest(
+                            host=me,
+                            probe_finished=scheduler_pb2.ProbeFinishedRequest(probes=probes),
+                        )
+                    )
+                if failed:
+                    q.put(
+                        scheduler_pb2.SyncProbesRequest(
+                            host=me,
+                            probe_failed=scheduler_pb2.ProbeFailedRequest(probes=failed),
+                        )
+                    )
+                probed = len(probes)
+        finally:
+            q.put(None)
+            for _ in responses:  # drain until the server closes
+                pass
+        return probed
+
+    @staticmethod
+    def _tcp_ping(ip: str, port: int, timeout: float = 2.0) -> float | None:
+        t0 = time.monotonic()
+        try:
+            with socket.create_connection((ip, port), timeout=timeout):
+                return time.monotonic() - t0
+        except OSError:
+            return None
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.cfg.probe_interval):
+            try:
+                self.probe_once()
+            except Exception as e:
+                logger.warning("probe round failed: %s", e)

@@ -1,0 +1,36 @@
+"""HTTP piece downloader — the child side of piece transfer.
+
+Role parity: reference client/daemon/peer/piece_downloader.go:165-204 —
+``GET parent:uploadPort/download/<task>?peerId=&number=`` fetches one
+piece's bytes from the parent's upload server.
+"""
+
+from __future__ import annotations
+
+import urllib.error
+import urllib.request
+
+
+class PieceDownloadError(Exception):
+    pass
+
+
+def download_piece(
+    parent_addr: str,
+    task_id: str,
+    number: int,
+    peer_id: str = "",
+    timeout: float = 30.0,
+) -> tuple[bytes, str]:
+    """Fetch piece ``number`` of ``task_id`` from a parent upload server
+    at ``host:port``; returns (bytes, digest)."""
+    url = f"http://{parent_addr}/download/{task_id}?number={number}&peerId={peer_id}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            data = resp.read()
+            digest = resp.headers.get("X-Dragonfly-Piece-Digest", "")
+            return data, digest
+    except urllib.error.HTTPError as e:
+        raise PieceDownloadError(f"piece {number} from {parent_addr}: HTTP {e.code}") from e
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        raise PieceDownloadError(f"piece {number} from {parent_addr}: {e}") from e

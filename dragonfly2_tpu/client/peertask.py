@@ -1,0 +1,130 @@
+"""Peer task manager: conductor dedup + completed-task reuse.
+
+Role parity: reference client/daemon/peer/peertask_manager.go:47-505 —
+StartFileTask/StartStreamTask with one conductor per task (concurrent
+requests for the same task share it) and reuse of completed local tasks
+(reference peertask_reuse.go).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import common_pb2  # noqa: E402
+
+from dragonfly2_tpu.client.conductor import ConductorOptions, PeerTaskConductor, Progress
+from dragonfly2_tpu.client.piece_manager import PieceManager
+from dragonfly2_tpu.client.storage import StorageManager
+from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils.idgen import URLMeta, peer_id_v2, task_id_v1
+
+logger = dflog.get("client.peertask")
+
+
+@dataclass
+class FileTaskRequest:
+    url: str
+    output: str = ""  # empty = leave in the piece store (stream use)
+    url_meta: common_pb2.UrlMeta | None = None
+    disable_back_source: bool = False
+    task_type: int = 0
+    headers: dict | None = None
+
+
+class TaskManager:
+    def __init__(
+        self,
+        host_id: str,
+        storage: StorageManager,
+        scheduler_client,
+        piece_manager: PieceManager | None = None,
+        options: ConductorOptions | None = None,
+    ):
+        self.host_id = host_id
+        self.storage = storage
+        self.scheduler = scheduler_client
+        self.pm = piece_manager or PieceManager()
+        self.options = options or ConductorOptions()
+        self.conductors: dict[str, PeerTaskConductor] = {}
+        self.lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def task_id_for(self, url: str, url_meta: common_pb2.UrlMeta | None) -> str:
+        meta = None
+        if url_meta is not None:
+            meta = URLMeta(
+                digest=url_meta.digest,
+                tag=url_meta.tag,
+                range=url_meta.range,
+                filter=url_meta.filter,
+                application=url_meta.application,
+            )
+        return task_id_v1(url, meta)
+
+    def start_file_task(self, req: FileTaskRequest) -> tuple[str, str, PeerTaskConductor | None]:
+        """Returns (task_id, peer_id, conductor|None). None conductor =
+        served from completed local storage (reuse path)."""
+        url_meta = req.url_meta or common_pb2.UrlMeta()
+        task_id = self.task_id_for(req.url, url_meta)
+
+        done = self.storage.find_completed_task(task_id)
+        if done is not None:
+            logger.info("task %s reused from local storage", task_id[:16])
+            if req.output:
+                done.store(req.output)
+            return task_id, done.meta.peer_id, None
+
+        with self.lock:
+            conductor = self.conductors.get(task_id)
+            if conductor is not None and not conductor.progress().error:
+                return task_id, conductor.peer_id, conductor
+            peer_id = peer_id_v2()
+            opts = ConductorOptions(
+                piece_workers=self.options.piece_workers,
+                schedule_timeout=self.options.schedule_timeout,
+                piece_retry=self.options.piece_retry,
+                disable_back_source=req.disable_back_source or self.options.disable_back_source,
+                piece_length=self.options.piece_length,
+            )
+            conductor = PeerTaskConductor(
+                task_id=task_id,
+                peer_id=peer_id,
+                host_id=self.host_id,
+                url=req.url,
+                url_meta=url_meta,
+                storage=self.storage,
+                scheduler_client=self.scheduler,
+                piece_manager=self.pm,
+                options=opts,
+                task_type=req.task_type,
+                headers=req.headers,
+                on_done=self._forget,
+            )
+            self.conductors[task_id] = conductor
+            conductor.start()
+        return task_id, peer_id, conductor
+
+    def _forget(self, conductor: PeerTaskConductor) -> None:
+        """Completion callback: drop the finished conductor so the dict
+        doesn't grow unboundedly and a failed task can be retried. A
+        timed-out waiter must NOT pop — the conductor is still running
+        and concurrent requests should keep sharing it."""
+        with self.lock:
+            if self.conductors.get(conductor.task_id) is conductor:
+                self.conductors.pop(conductor.task_id)
+
+    def wait_file_task(self, req: FileTaskRequest, timeout: float | None = None) -> tuple[str, str, Progress]:
+        task_id, peer_id, conductor = self.start_file_task(req)
+        if conductor is None:
+            ts = self.storage.load(task_id)
+            return task_id, peer_id, Progress(
+                completed_length=ts.meta.content_length,
+                content_length=ts.meta.content_length,
+                done=True,
+            )
+        progress = conductor.wait(timeout)
+        if progress.done and req.output:
+            self.storage.load(task_id).store(req.output)
+        return task_id, peer_id, progress

@@ -1,0 +1,214 @@
+"""Piece manager: fetch pieces from parents or the origin into storage.
+
+Role parity: reference client/daemon/peer/piece_manager.go —
+``download_piece`` from a parent (:170) and ``download_source`` whole-file
+from origin with optional concurrent ranged piece downloads
+(:139-166,303-373). The parent dispatcher keeps a per-parent latency
+EWMA with randomized tie-breaking (reference piece_dispatcher.go:103-149).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from dragonfly2_tpu.client import downloader, source
+from dragonfly2_tpu.client.pieces import PieceRange, compute_piece_length, piece_ranges
+from dragonfly2_tpu.client.storage import TaskStorage
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("client.piece")
+
+TRAFFIC_BACK_TO_SOURCE = "back_to_source"
+TRAFFIC_REMOTE_PEER = "remote_peer"
+
+
+@dataclass
+class ParentInfo:
+    peer_id: str
+    upload_addr: str  # host:port of the parent's HTTP upload server
+    finished_pieces: set[int] = field(default_factory=set)
+    # latency EWMA (seconds) for dispatcher scoring
+    latency: float = 0.0
+
+    def observe(self, dt: float) -> None:
+        self.latency = dt if self.latency == 0 else 0.8 * self.latency + 0.2 * dt
+
+
+class PieceDispatcher:
+    """Scores parents by observed latency with randomization so one fast
+    parent doesn't absorb every piece (reference
+    piece_dispatcher.go:103-149)."""
+
+    def __init__(self, rand: random.Random | None = None):
+        self.rand = rand or random.Random(0)
+
+    def pick(self, parents: list[ParentInfo], piece_number: int) -> ParentInfo | None:
+        eligible = [p for p in parents if piece_number in p.finished_pieces]
+        if not eligible:
+            # parents that may have the piece soon: any parent
+            eligible = list(parents)
+        if not eligible:
+            return None
+        # weight ∝ 1/(latency+ε), jittered
+        weights = [
+            (1.0 / (p.latency + 1e-3)) * (0.75 + 0.5 * self.rand.random())
+            for p in eligible
+        ]
+        return eligible[max(range(len(eligible)), key=lambda i: weights[i])]
+
+
+class PieceManager:
+    def __init__(
+        self,
+        concurrent_pieces: int = 4,
+        source_concurrency: int = 4,
+        source_concurrency_threshold: int = 32 * 1024 * 1024,
+    ):
+        self.concurrent_pieces = concurrent_pieces
+        self.source_concurrency = source_concurrency
+        self.source_concurrency_threshold = source_concurrency_threshold
+
+    # ------------------------------------------------------------------
+    def download_piece_from_parent(
+        self,
+        ts: TaskStorage,
+        parent: ParentInfo,
+        pr: PieceRange,
+        peer_id: str,
+    ) -> "PieceResult":
+        t0 = time.monotonic()
+        data, digest = downloader.download_piece(
+            parent.upload_addr, ts.meta.task_id, pr.number, peer_id=peer_id
+        )
+        dt = time.monotonic() - t0
+        parent.observe(dt)
+        if len(data) != pr.length:
+            raise downloader.PieceDownloadError(
+                f"piece {pr.number}: want {pr.length}B got {len(data)}B"
+            )
+        pm = ts.write_piece(
+            pr.number,
+            pr.offset,
+            data,
+            digest=digest,
+            traffic_type=TRAFFIC_REMOTE_PEER,
+            cost_ns=int(dt * 1e9),
+            parent_id=parent.peer_id,
+        )
+        return PieceResult(pm.number, pm.offset, pm.length, pm.digest, pm.traffic_type, pm.cost_ns, parent.peer_id)
+
+    # ------------------------------------------------------------------
+    def download_source(
+        self,
+        ts: TaskStorage,
+        url: str,
+        headers: dict | None = None,
+        on_piece=None,
+    ) -> int:
+        """Whole-file origin download: ranged concurrent pieces when the
+        origin supports Range and the file is big enough, else one
+        sequential stream chunked into pieces (reference
+        piece_manager.go:303-373). Returns content length."""
+        client = source.client_for(url)
+        meta = client.metadata(url, headers)
+        content_length = meta.content_length
+
+        if content_length >= 0 and ts.meta.content_length < 0:
+            ts.meta.content_length = content_length
+        if not ts.meta.piece_length:
+            ts.meta.piece_length = compute_piece_length(content_length)
+
+        use_concurrent = (
+            meta.support_range
+            and content_length >= self.source_concurrency_threshold
+            and self.source_concurrency > 1
+        )
+        if use_concurrent:
+            ranges = piece_ranges(content_length, ts.meta.piece_length)
+
+            def fetch(pr: PieceRange):
+                t0 = time.monotonic()
+                data = b"".join(client.download(url, headers, pr.offset, pr.length))
+                dt = time.monotonic() - t0
+                pm = ts.write_piece(
+                    pr.number, pr.offset, data,
+                    traffic_type=TRAFFIC_BACK_TO_SOURCE, cost_ns=int(dt * 1e9),
+                )
+                if on_piece:
+                    on_piece(PieceResult(pm.number, pm.offset, pm.length, pm.digest, pm.traffic_type, pm.cost_ns, ""))
+
+            with ThreadPoolExecutor(max_workers=self.source_concurrency) as pool:
+                list(pool.map(fetch, ranges))
+            ts.mark_done(content_length)
+            return content_length
+
+        # sequential stream → pieces
+        number, offset, buf = 0, 0, b""
+        pl = ts.meta.piece_length
+        t0 = time.monotonic()
+        for chunk in client.download(url, headers):
+            buf += chunk
+            while len(buf) >= pl:
+                piece, buf = buf[:pl], buf[pl:]
+                dt = time.monotonic() - t0
+                pm = ts.write_piece(
+                    number, offset, piece,
+                    traffic_type=TRAFFIC_BACK_TO_SOURCE, cost_ns=int(dt * 1e9),
+                )
+                if on_piece:
+                    on_piece(PieceResult(pm.number, pm.offset, pm.length, pm.digest, pm.traffic_type, pm.cost_ns, ""))
+                number += 1
+                offset += len(piece)
+                t0 = time.monotonic()
+        if buf or number == 0:
+            dt = time.monotonic() - t0
+            pm = ts.write_piece(
+                number, offset, buf,
+                traffic_type=TRAFFIC_BACK_TO_SOURCE, cost_ns=int(dt * 1e9),
+            )
+            if on_piece:
+                on_piece(PieceResult(pm.number, pm.offset, pm.length, pm.digest, pm.traffic_type, pm.cost_ns, ""))
+            offset += len(buf)
+        ts.mark_done(offset)
+        return offset
+
+
+@dataclass
+class PieceResult:
+    number: int
+    offset: int
+    length: int
+    digest: str
+    traffic_type: str
+    cost_ns: int
+    parent_id: str
+
+
+class RateLimiter:
+    """Token-bucket byte-rate limiter shared across tasks (role parity:
+    reference client/daemon/peer/traffic_shaper.go:36-175 sampling
+    shaper — one global budget re-allocated across active tasks)."""
+
+    def __init__(self, rate_bytes_per_s: float):
+        self.rate = rate_bytes_per_s
+        self.tokens = rate_bytes_per_s
+        self.last = time.monotonic()
+        self.lock = threading.Lock()
+
+    def acquire(self, n: int) -> None:
+        if self.rate <= 0:
+            return
+        while True:
+            with self.lock:
+                now = time.monotonic()
+                self.tokens = min(self.rate, self.tokens + (now - self.last) * self.rate)
+                self.last = now
+                if self.tokens >= n:
+                    self.tokens -= n
+                    return
+                wait = (n - self.tokens) / self.rate
+            time.sleep(min(wait, 0.5))

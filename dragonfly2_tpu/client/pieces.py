@@ -1,0 +1,47 @@
+"""Piece geometry helpers.
+
+Role parity: reference pkg/source piece sizing + client piece math —
+pieces are fixed-length slices of the object; the last piece may be
+short. Default 4 MiB, scaled up for very large objects so piece count
+stays bounded (reference util.ComputePieceSize behavior).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+DEFAULT_PIECE_LENGTH = 4 * 1024 * 1024
+MAX_PIECE_COUNT = 2048
+
+
+def compute_piece_length(content_length: int) -> int:
+    """Default piece size, doubled until piece count ≤ MAX_PIECE_COUNT."""
+    if content_length <= 0:
+        return DEFAULT_PIECE_LENGTH
+    pl = DEFAULT_PIECE_LENGTH
+    while content_length / pl > MAX_PIECE_COUNT:
+        pl *= 2
+    return pl
+
+
+def piece_count(content_length: int, piece_length: int) -> int:
+    if content_length <= 0:
+        return 0
+    return (content_length + piece_length - 1) // piece_length
+
+
+@dataclass(frozen=True)
+class PieceRange:
+    number: int
+    offset: int
+    length: int
+
+
+def piece_ranges(content_length: int, piece_length: int) -> list[PieceRange]:
+    out = []
+    for n in range(piece_count(content_length, piece_length)):
+        off = n * piece_length
+        out.append(
+            PieceRange(number=n, offset=off, length=min(piece_length, content_length - off))
+        )
+    return out

@@ -1,0 +1,183 @@
+"""Dfdaemon gRPC service: the daemon's RPC surface.
+
+Role parity: reference client/daemon/rpcserver/rpcserver.go:129-1123 —
+``Download`` server-stream for dfget (:379-401), ``GetPieceTasks``
+(:151), ``SyncPieceTasks`` bidi (:268), ``StatTask`` / ``ImportTask`` /
+``ExportTask`` / ``DeleteTask`` (dfcache ops).
+"""
+
+from __future__ import annotations
+
+import os
+
+import grpc
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import common_pb2  # noqa: E402
+import dfdaemon_pb2  # noqa: E402
+
+from dragonfly2_tpu.client.peertask import FileTaskRequest, TaskManager
+from dragonfly2_tpu.client.pieces import compute_piece_length
+from dragonfly2_tpu.client.storage import StorageManager
+from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils.idgen import peer_id_v2
+
+logger = dflog.get("client.rpc")
+
+SERVICE_NAME = "dragonfly2_tpu.dfdaemon.Dfdaemon"
+
+
+class DfdaemonService:
+    def __init__(
+        self,
+        task_manager: TaskManager,
+        storage: StorageManager,
+        upload_addr: str,
+    ):
+        self.tasks = task_manager
+        self.storage = storage
+        self.upload_addr = upload_addr
+
+    # ------------------------------------------------------------------
+    def Download(self, request, context):
+        """Server-stream of progress results for dfget
+        (reference rpcserver.go:379-401)."""
+        req = FileTaskRequest(
+            url=request.url,
+            output=request.output,
+            url_meta=request.url_meta,
+            disable_back_source=request.disable_back_source,
+        )
+        task_id, peer_id, conductor = self.tasks.start_file_task(req)
+        if conductor is None:  # reuse path — one terminal result
+            ts = self.storage.load(task_id)
+            if request.output:
+                ts.store(request.output)
+            yield dfdaemon_pb2.DownloadResult(
+                task_id=task_id,
+                peer_id=peer_id,
+                done=True,
+                completed_length=ts.meta.content_length,
+                content_length=ts.meta.content_length,
+                output=request.output,
+            )
+            return
+
+        sub = conductor.subscribe()
+        while True:
+            p = sub.get()
+            if p.error:
+                context.abort(grpc.StatusCode.INTERNAL, p.error)
+            if p.done and request.output:
+                # write the output before the terminal result goes out —
+                # the client treats done=True as "bytes are on disk"
+                self.storage.load(task_id).store(request.output)
+            yield dfdaemon_pb2.DownloadResult(
+                task_id=task_id,
+                peer_id=peer_id,
+                done=p.done,
+                completed_length=p.completed_length,
+                content_length=p.content_length,
+                output=request.output,
+            )
+            if p.done:
+                return
+
+    # ------------------------------------------------------------------
+    def GetPieceTasks(self, request, context):
+        return self._piece_packet(request)
+
+    def SyncPieceTasks(self, request_iterator, context):
+        """Bidi metadata sync between daemons (reference
+        peertask_piecetask_synchronizer.go): each request is answered
+        with the current piece inventory."""
+        for req in request_iterator:
+            yield self._piece_packet(req)
+
+    def _piece_packet(self, request) -> dfdaemon_pb2.PiecePacket:
+        ts = self.storage.load(request.task_id)
+        if ts is None:
+            return dfdaemon_pb2.PiecePacket(
+                task_id=request.task_id, dst_addr=self.upload_addr
+            )
+        start = request.start_num or 0
+        limit = request.limit or 64
+        infos = []
+        for n in sorted(ts.meta.pieces):
+            if n < start or len(infos) >= limit:
+                continue
+            pm = ts.meta.pieces[n]
+            infos.append(
+                common_pb2.PieceInfo(
+                    number=pm.number,
+                    offset=pm.offset,
+                    length=pm.length,
+                    digest=pm.digest,
+                    traffic_type=pm.traffic_type,
+                    cost_ns=pm.cost_ns,
+                )
+            )
+        return dfdaemon_pb2.PiecePacket(
+            task_id=request.task_id,
+            dst_peer_id=ts.meta.peer_id,
+            dst_addr=self.upload_addr,
+            piece_infos=infos,
+            content_length=ts.meta.content_length,
+            total_piece_count=ts.meta.total_piece_count,
+            piece_md5_sign_ok=True,
+        )
+
+    # ------------------------------------------------------------------
+    def StatTask(self, request, context):
+        task_id = self.tasks.task_id_for(request.url, request.url_meta)
+        ts = self.storage.find_completed_task(task_id)
+        if ts is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"task {task_id} not cached")
+        return dfdaemon_pb2.Empty()
+
+    def ImportTask(self, request, context):
+        """Load a local file into the piece store as a completed task
+        (dfcache import, reference rpcserver.go ImportTask)."""
+        task_id = self.tasks.task_id_for(request.url, request.url_meta)
+        if self.storage.find_completed_task(task_id) is not None:
+            return dfdaemon_pb2.Empty()
+        try:
+            size = os.path.getsize(request.path)
+        except OSError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        pl = compute_piece_length(size)
+        ts = self.storage.register_task(
+            task_id, peer_id_v2(), url=request.url, piece_length=pl, content_length=size
+        )
+        with open(request.path, "rb") as f:
+            number = 0
+            while True:
+                chunk = f.read(pl)
+                if not chunk and number > 0:
+                    break
+                ts.write_piece(number, number * pl, chunk, traffic_type="local_peer")
+                number += 1
+                if len(chunk) < pl:
+                    break
+        ts.mark_done(size)
+        return dfdaemon_pb2.Empty()
+
+    def ExportTask(self, request, context):
+        task_id = self.tasks.task_id_for(request.url, request.url_meta)
+        ts = self.storage.find_completed_task(task_id)
+        if ts is None:
+            if request.local_only:
+                context.abort(grpc.StatusCode.NOT_FOUND, f"task {task_id} not cached")
+            _, _, progress = self.tasks.wait_file_task(
+                FileTaskRequest(url=request.url, output=request.output, url_meta=request.url_meta)
+            )
+            if not progress.done:
+                context.abort(grpc.StatusCode.INTERNAL, progress.error)
+            return dfdaemon_pb2.Empty()
+        ts.store(request.output)
+        return dfdaemon_pb2.Empty()
+
+    def DeleteTask(self, request, context):
+        task_id = self.tasks.task_id_for(request.url, request.url_meta)
+        self.storage.delete_task(task_id)
+        return dfdaemon_pb2.Empty()

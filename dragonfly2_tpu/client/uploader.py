@@ -1,0 +1,120 @@
+"""HTTP upload server — the parent side of piece transfer.
+
+Role parity: reference client/daemon/upload/upload_manager.go:59-196 —
+``GET /download/<task_id>?peerId=&number=`` serves piece bytes out of the
+local piece store, with Range support for arbitrary byte windows. Piece
+bytes ride HTTP between daemons (the gRPC plane carries only piece
+*metadata*), exactly like the reference (upload_manager.go:149-196).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from dragonfly2_tpu.client.storage import StorageManager
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("client.upload")
+
+_RANGE_RE = re.compile(r"bytes=(\d+)-(\d*)")
+
+
+class UploadServer:
+    """Serves pieces to child peers over HTTP."""
+
+    def __init__(self, storage: StorageManager, host: str = "127.0.0.1", port: int = 0):
+        self.storage = storage
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route to dflog, not stderr
+                logger.debug("upload: " + fmt % args)
+
+            def do_GET(self):
+                outer._handle(self)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        return f"{self._server.server_address[0]}:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="upload-server", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------------
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urlparse(req.path)
+        parts = parsed.path.strip("/").split("/")
+        if len(parts) != 2 or parts[0] != "download":
+            req.send_error(404, "unknown path")
+            return
+        task_id = parts[1]
+        qs = parse_qs(parsed.query)
+        ts = self.storage.load(task_id)
+        if ts is None:
+            req.send_error(404, f"task {task_id} not found")
+            return
+
+        number = qs.get("number", [None])[0]
+        if number is not None:
+            # piece fetch by number
+            try:
+                data = ts.read_piece(int(number))
+            except Exception as e:
+                req.send_error(404, str(e))
+                return
+            pm = ts.meta.pieces[int(number)]
+            req.send_response(200)
+            req.send_header("Content-Length", str(len(data)))
+            req.send_header("X-Dragonfly-Piece-Digest", pm.digest)
+            req.end_headers()
+            req.wfile.write(data)
+            return
+
+        rng = req.headers.get("Range")
+        if rng:
+            m = _RANGE_RE.match(rng)
+            if not m:
+                req.send_error(416, "bad range")
+                return
+            start = int(m.group(1))
+            total = ts.meta.content_length
+            end = int(m.group(2)) if m.group(2) else (total - 1 if total >= 0 else -1)
+            if end < start:
+                req.send_error(416, "bad range")
+                return
+            data = ts.read_range(start, end - start + 1)
+            req.send_response(206)
+            req.send_header("Content-Length", str(len(data)))
+            req.send_header(
+                "Content-Range", f"bytes {start}-{start + len(data) - 1}/{total}"
+            )
+            req.end_headers()
+            req.wfile.write(data)
+            return
+
+        # whole object (requires completion)
+        try:
+            data = ts.read_all()
+        except Exception as e:
+            req.send_error(409, str(e))
+            return
+        req.send_response(200)
+        req.send_header("Content-Length", str(len(data)))
+        req.end_headers()
+        req.wfile.write(data)

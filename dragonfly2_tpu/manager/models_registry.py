@@ -43,6 +43,9 @@ class ModelRegistry:
         self.db = db
         self.storage = storage
         self.storage.create_bucket(MODELS_BUCKET)
+        import threading
+
+        self._lock = threading.Lock()  # version allocation + state flips
 
     def create(
         self,
@@ -54,30 +57,34 @@ class ModelRegistry:
         hostname: str = "",
         scheduler_cluster_id: int = 0,
     ) -> ModelRow:
-        """New inactive version: weights → object storage, row → DB."""
-        row = self.db.query_one(
-            "SELECT MAX(version) AS v FROM models WHERE model_id = ?", (model_id,)
-        )
-        version = (row["v"] or 0) + 1
-        key = f"{model_id}/{version}/model.npz"
-        self.storage.put_object(MODELS_BUCKET, key, weights)
-        self.db.execute(
-            "INSERT INTO models (model_id, type, version, state, evaluation,"
-            " object_key, ip, hostname, scheduler_cluster_id, created_at)"
-            " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
-            (
-                model_id,
-                model_type,
-                version,
-                STATE_INACTIVE,
-                Database.dumps(evaluation),
-                key,
-                ip,
-                hostname,
-                scheduler_cluster_id,
-                time.time(),
-            ),
-        )
+        """New inactive version: weights → object storage, row → DB.
+        MAX(version)+1 and the INSERT happen under one lock so two
+        concurrent uploads of the same model can't collide on
+        UNIQUE(model_id, version)."""
+        with self._lock:
+            row = self.db.query_one(
+                "SELECT MAX(version) AS v FROM models WHERE model_id = ?", (model_id,)
+            )
+            version = (row["v"] or 0) + 1
+            key = f"{model_id}/{version}/model.npz"
+            self.storage.put_object(MODELS_BUCKET, key, weights)
+            self.db.execute(
+                "INSERT INTO models (model_id, type, version, state, evaluation,"
+                " object_key, ip, hostname, scheduler_cluster_id, created_at)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                (
+                    model_id,
+                    model_type,
+                    version,
+                    STATE_INACTIVE,
+                    Database.dumps(evaluation),
+                    key,
+                    ip,
+                    hostname,
+                    scheduler_cluster_id,
+                    time.time(),
+                ),
+            )
         return self.get(model_id, version)
 
     def get(self, model_id: str, version: int = 0) -> ModelRow | None:
@@ -106,17 +113,23 @@ class ModelRegistry:
 
     def activate(self, model_id: str, version: int) -> ModelRow:
         """Flip one version active, everything else inactive (reference
-        manager/service/model.go:109 updateModelStateToActive)."""
+        manager/service/model.go:109 updateModelStateToActive).
+        ``version=0`` (proto3 default for an unset field) means "the
+        currently active version" — resolve it to a concrete version
+        first, else the deactivate-all would strand the model with no
+        active version."""
         target = self.get(model_id, version)
         if target is None:
             raise KeyError(f"model {model_id} version {version} not found")
-        self.db.execute(
-            "UPDATE models SET state = ? WHERE model_id = ?", (STATE_INACTIVE, model_id)
-        )
-        self.db.execute(
-            "UPDATE models SET state = ? WHERE model_id = ? AND version = ?",
-            (STATE_ACTIVE, model_id, version),
-        )
+        version = target.version
+        with self._lock:
+            self.db.execute(
+                "UPDATE models SET state = ? WHERE model_id = ?", (STATE_INACTIVE, model_id)
+            )
+            self.db.execute(
+                "UPDATE models SET state = ? WHERE model_id = ? AND version = ?",
+                (STATE_ACTIVE, model_id, version),
+            )
         return self.get(model_id, version)
 
     def delete(self, model_id: str, version: int) -> None:

@@ -122,18 +122,30 @@ class ManagerService:
     def KeepAlive(self, request_iterator, context):
         for req in request_iterator:
             now = time.time()
+            # cluster-scoped: the same hostname/ip may be registered in
+            # several clusters (UNIQUE(hostname, ip, cluster_id)); a
+            # keepalive must only revive its own cluster's row.
+            # cluster_id 0 (unset) keeps the legacy any-cluster match.
             if req.source_type == "scheduler":
-                self.db.execute(
+                sql = (
                     "UPDATE schedulers SET last_keepalive = ?, state = 'active'"
-                    " WHERE hostname = ? AND ip = ?",
-                    (now, req.hostname, req.ip),
+                    " WHERE hostname = ? AND ip = ?"
                 )
+                args: tuple = (now, req.hostname, req.ip)
+                if req.cluster_id:
+                    sql += " AND scheduler_cluster_id = ?"
+                    args += (req.cluster_id,)
+                self.db.execute(sql, args)
             elif req.source_type == "seed_peer":
-                self.db.execute(
+                sql = (
                     "UPDATE seed_peers SET last_keepalive = ?, state = 'active'"
-                    " WHERE hostname = ? AND ip = ?",
-                    (now, req.hostname, req.ip),
+                    " WHERE hostname = ? AND ip = ?"
                 )
+                args = (now, req.hostname, req.ip)
+                if req.cluster_id:
+                    sql += " AND seed_peer_cluster_id = ?"
+                    args += (req.cluster_id,)
+                self.db.execute(sql, args)
         return manager_pb2.Empty()
 
     # -- dynconfig ---------------------------------------------------------
@@ -245,12 +257,15 @@ class ManagerGrpcClientAdapter:
             )
         )
 
-    def keepalive(self, source_type, hostname, ip, cluster_id=""):
+    def keepalive(self, source_type, hostname, ip, cluster_id=0):
         self._client.KeepAlive(
             iter(
                 [
                     manager_pb2.KeepAliveRequest(
-                        source_type=source_type, hostname=hostname, ip=ip
+                        source_type=source_type,
+                        hostname=hostname,
+                        ip=ip,
+                        cluster_id=int(cluster_id or 0),
                     )
                 ]
             )

@@ -165,6 +165,7 @@ def dial(address: str, retries: int = 3, backoff: float = 0.2) -> grpc.Channel:
             return channel
         except Exception as e:  # pragma: no cover - network timing
             last = e
+            channel.close()  # else the failed channel keeps reconnect threads alive
             time.sleep(backoff * (2**attempt))
     raise ConnectionError(f"failed to dial {address}: {last}")
 

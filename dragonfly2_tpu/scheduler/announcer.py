@@ -64,8 +64,10 @@ class Announcer:
         Returns False when there's no trainer or no data."""
         if self._trainer is None:
             return False
-        download_files = self.storage.open_download_files()
-        topology_files = self.storage.open_network_topology_files()
+        # snapshot moves the files aside: records that arrive during the
+        # (potentially long) Train stream keep accumulating in fresh
+        # files and are uploaded next round instead of being destroyed
+        download_files, topology_files = self.storage.snapshot_for_upload()
         if not download_files and not topology_files:
             logger.info("no datasets to upload")
             return False
@@ -89,10 +91,9 @@ class Announcer:
                     )
 
         self._trainer.Train(requests(), timeout=3600)
-        # uploaded datasets are consumed; clear local copies like the
-        # reference's post-upload lifecycle
-        self.storage.clear_download()
-        self.storage.clear_network_topology()
+        # uploaded datasets are consumed; on failure the snapshot files
+        # stay in the pending dir and ride along with the next round
+        self.storage.discard_uploaded(download_files + topology_files)
         return True
 
     def _chunks(self, path: Path):

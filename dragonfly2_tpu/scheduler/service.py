@@ -295,6 +295,11 @@ class SchedulerService:
             logger.warning("scheduling peer %s failed: %s", peer.id, e)
 
     def _piece_finished(self, peer: res.Peer, piece: common_pb2.PieceInfo) -> None:
+        # adopt task geometry from the first reported piece, so candidate
+        # parents can advertise it to children (reference task metadata
+        # updates in AnnouncePeer piece handling, service_v2.go:1102)
+        if piece.number == 0 and piece.length:
+            peer.task.piece_length = piece.length
         cost_ms = piece.cost_ns / 1e6
         peer.finish_piece(
             piece.number,

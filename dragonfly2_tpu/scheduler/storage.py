@@ -102,6 +102,22 @@ class Storage:
             self._topology.flush()
             return self._topology.all_files()
 
+    def snapshot_for_upload(self) -> tuple[list[Path], list[Path]]:
+        """Atomically move the current download/topology files into a
+        pending-upload dir and return them (any leftovers from a prior
+        failed upload are included for retry). Records written during the
+        subsequent slow Train stream go to fresh files and survive —
+        unlike a clear()-after-upload, which would destroy them."""
+        with self._lock:
+            pending = self.dir / "upload-pending"
+            d = self._download.snapshot(pending / "download")
+            t = self._topology.snapshot(pending / "networktopology")
+            return d, t
+
+    def discard_uploaded(self, files: list[Path]) -> None:
+        for p in files:
+            p.unlink(missing_ok=True)
+
     def clear_download(self) -> None:
         with self._lock:
             self._download.clear()

@@ -131,6 +131,26 @@ class RotatingCSVWriter:
             out.extend(read_csv(p, self.record_cls))
         return out
 
+    def snapshot(self, dest_dir: str | os.PathLike) -> list[Path]:
+        """Move every current file into ``dest_dir`` and start fresh.
+
+        Records written after this call land in a new active file, so an
+        upload consuming the snapshot can't race (and then destroy)
+        records appended during a slow transfer. Files are renamed with a
+        unique prefix so repeated snapshots into the same pending dir
+        (retry after a failed upload) never collide.
+        """
+        self.flush()
+        dest = Path(dest_dir)
+        dest.mkdir(parents=True, exist_ok=True)
+        existing = len(list(dest.iterdir()))
+        moved: list[Path] = []
+        for i, p in enumerate(self.all_files()):
+            target = dest / f"{existing + i:06d}-{p.name}"
+            p.rename(target)
+            moved.append(target)
+        return sorted(dest.iterdir())
+
     def clear(self) -> None:
         self._buf.clear()
         for p in self.all_files():

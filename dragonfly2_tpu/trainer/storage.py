@@ -63,8 +63,14 @@ class TrainerStorage:
                 if header is None:
                     header = row
                     continue
-                if row == header:
-                    continue  # embedded header from a later upload/backup
+                # embedded header from a later upload/backup — match on
+                # the first column name, not the whole row, so a header
+                # that drifted between scheduler versions is re-adopted
+                # instead of being parsed as a data row against stale
+                # column positions
+                if row and header and row[0] == header[0]:
+                    header = row
+                    continue
                 out.append(R.unflatten(cls, dict(zip(header, row))))
         return out
 

@@ -1,0 +1,165 @@
+"""E2E slice: dfget → daemon → scheduler → parent peer → bytes on disk,
+with Download training records written — the full minimum end-to-end
+path of SURVEY.md §7 stage 3, run in-process the way the reference fakes
+clusters (reference client/daemon/peer/peertask_manager_test.go:77-290).
+
+Daemon A fetches from the origin (back-to-source), daemon B then fetches
+the same task and must receive A as a candidate parent and pull pieces
+over A's HTTP upload server (remote_peer traffic).
+"""
+
+import os
+
+import pytest
+
+from dragonfly2_tpu.client import dfcache, dfget
+from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+from dragonfly2_tpu.client.piece_manager import TRAFFIC_BACK_TO_SOURCE, TRAFFIC_REMOTE_PEER
+from dragonfly2_tpu.rpc.glue import serve
+from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.networktopology import NetworkTopology
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import SERVICE_NAME as SCHED_SERVICE
+from dragonfly2_tpu.scheduler.service import SchedulerService
+from dragonfly2_tpu.scheduler.storage import Storage
+from dragonfly2_tpu.utils.kvstore import KVStore
+
+PIECE = 64 * 1024
+PAYLOAD = os.urandom(300 * 1024)  # 5 pieces at 64 KiB
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """Scheduler + two daemons, all real servers on localhost."""
+    resource = res.Resource()
+    storage = Storage(tmp_path / "sched", buffer_size=1)
+    nt = NetworkTopology(KVStore(), resource.host_manager, storage)
+    service = SchedulerService(
+        resource,
+        Scheduling(
+            BaseEvaluator(),
+            SchedulingConfig(retry_interval=0.0, retry_back_to_source_limit=1),
+        ),
+        storage=storage,
+        networktopology=nt,
+    )
+    server, port = serve({SCHED_SERVICE: service})
+    sched_addr = f"127.0.0.1:{port}"
+
+    daemons = []
+    for name in ("a", "b"):
+        d = Daemon(
+            DaemonConfig(
+                data_dir=str(tmp_path / f"daemon-{name}"),
+                scheduler_address=sched_addr,
+                hostname=f"host-{name}",
+                ip="127.0.0.1",
+                piece_length=PIECE,
+                schedule_timeout=5.0,
+                announce_interval=60.0,
+            )
+        )
+        d.start()
+        daemons.append(d)
+
+    origin = tmp_path / "origin.bin"
+    origin.write_bytes(PAYLOAD)
+
+    yield {
+        "resource": resource,
+        "storage": storage,
+        "daemons": daemons,
+        "url": f"file://{origin}",
+        "tmp": tmp_path,
+    }
+    for d in daemons:
+        d.stop()
+    server.stop(0)
+
+
+def test_p2p_download_slice(cluster):
+    da, db = cluster["daemons"]
+    url = cluster["url"]
+    tmp = cluster["tmp"]
+
+    # ---- daemon A: no parents exist → back-to-source from origin ----
+    out_a = tmp / "out-a.bin"
+    paths = dfget.download(f"127.0.0.1:{da.port}", url, str(out_a))
+    assert paths == [str(out_a)]
+    assert out_a.read_bytes() == PAYLOAD
+
+    task_id = da.task_manager.task_id_for(url, None)
+    ts_a = da.storage.find_completed_task(task_id)
+    assert ts_a is not None
+    assert len(ts_a.meta.pieces) == 5
+    assert all(p.traffic_type == TRAFFIC_BACK_TO_SOURCE for p in ts_a.meta.pieces.values())
+
+    # ---- daemon B: must be scheduled onto A and pull over HTTP ----
+    out_b = tmp / "out-b.bin"
+    dfget.download(f"127.0.0.1:{db.port}", url, str(out_b))
+    assert out_b.read_bytes() == PAYLOAD
+
+    ts_b = db.storage.find_completed_task(task_id)
+    assert ts_b is not None
+    traffic = {p.traffic_type for p in ts_b.meta.pieces.values()}
+    assert traffic == {TRAFFIC_REMOTE_PEER}, f"expected pure P2P transfer, got {traffic}"
+    parents = {p.parent_id for p in ts_b.meta.pieces.values()}
+    assert parents == {ts_a.meta.peer_id}
+
+    # ---- training records landed in scheduler storage ----
+    records = list(cluster["storage"].list_download())
+    assert len(records) >= 2, "download records must be written for the trainer"
+
+    # ---- task state on the scheduler reflects the swarm ----
+    task = cluster["resource"].task_manager.load(task_id)
+    assert task is not None
+    assert task.content_length == len(PAYLOAD)
+
+
+def test_reuse_completed_task(cluster):
+    da, _ = cluster["daemons"]
+    url = cluster["url"]
+    tmp = cluster["tmp"]
+    out1 = tmp / "r1.bin"
+    out2 = tmp / "r2.bin"
+    dfget.download(f"127.0.0.1:{da.port}", url, str(out1))
+    # second download of the same url is served from the local piece
+    # store without a new conductor (reference peertask_reuse.go)
+    dfget.download(f"127.0.0.1:{da.port}", url, str(out2))
+    assert out2.read_bytes() == PAYLOAD
+
+
+def test_dfcache_import_stat_export_delete(cluster, tmp_path):
+    da, db = cluster["daemons"]
+    blob = tmp_path / "blob.bin"
+    blob.write_bytes(b"cached-bytes" * 1000)
+    url = "d7y://cache/blob-1"
+    addr_a = f"127.0.0.1:{da.port}"
+
+    assert not dfcache.stat(addr_a, url)
+    dfcache.import_file(addr_a, str(blob), url)
+    assert dfcache.stat(addr_a, url)
+
+    out = tmp_path / "exported.bin"
+    dfcache.export_file(addr_a, url, str(out), local_only=True)
+    assert out.read_bytes() == blob.read_bytes()
+
+    dfcache.delete(addr_a, url)
+    assert not dfcache.stat(addr_a, url)
+
+
+def test_recursive_download(cluster, tmp_path):
+    da, _ = cluster["daemons"]
+    src = tmp_path / "tree"
+    (src / "sub").mkdir(parents=True)
+    (src / "one.bin").write_bytes(b"one")
+    (src / "sub" / "two.bin").write_bytes(b"two")
+
+    dest = tmp_path / "tree-out"
+    written = dfget.download(
+        f"127.0.0.1:{da.port}", f"file://{src}", str(dest), recursive=True
+    )
+    assert len(written) == 2
+    assert (dest / "one.bin").read_bytes() == b"one"
+    assert (dest / "sub" / "two.bin").read_bytes() == b"two"
