@@ -52,6 +52,11 @@ class ConductorOptions:
     piece_workers: int = 4
     schedule_timeout: float = 10.0
     piece_retry: int = 3
+    # consecutive hard failures before a parent is blocked for the task —
+    # one transient timeout must not escalate to back-to-source
+    parent_fail_limit: int = 3
+    # wait between retries when a parent 404s a piece it may write soon
+    not_found_backoff: float = 0.05
     disable_back_source: bool = False
     piece_length: int = 0  # 0 = derive from content length
 
@@ -101,6 +106,7 @@ class PeerTaskConductor:
         self._lock = threading.Lock()
         self._completed = 0
         self._blocked_parents: set[str] = set()
+        self._parent_failures: dict[str, int] = {}
         self._done = threading.Event()
         self._error: str | None = None
         self._started_at = 0.0
@@ -322,27 +328,45 @@ class PeerTaskConductor:
 
         def work(pr: PieceRange) -> None:
             last_err: Exception | None = None
+            failed_here: set[str] = set()
             for _ in range(self.opts.piece_retry):
                 with lock:
                     live = [p for p in parents if p.peer_id not in self._blocked_parents]
-                parent = dispatcher.pick(live, pr.number)
+                parent = dispatcher.pick(live, pr.number, exclude=failed_here)
                 if parent is None:
                     break
                 try:
                     result = self.pm.download_piece_from_parent(
                         self.ts, parent, pr, self.peer_id
                     )
+                    with lock:
+                        self._parent_failures[parent.peer_id] = 0
                     self._piece_done(result)
                     return
                 except PieceDownloadError as e:
                     last_err = e
+                    if e.not_found and pr.number not in parent.finished_pieces:
+                        # optimistic probe of an in-progress parent that
+                        # never claimed the piece — wait for it to appear,
+                        # don't penalize the parent
+                        time.sleep(self.opts.not_found_backoff)
+                        continue
+                    # hard failure — including a 404 on a piece the parent
+                    # *advertised*: its inventory lies (evicted piece), so
+                    # deprioritize it or it wins every retry on EWMA weight
+                    failed_here.add(parent.peer_id)
                     self._send(
                         download_piece_failed=scheduler_pb2.DownloadPieceFailedRequest(
                             piece_number=pr.number, parent_id=parent.peer_id, temporary=True
                         )
                     )
+                    # block only after repeated hard failures — one transient
+                    # timeout must not knock the parent out of the swarm
                     with lock:
-                        self._blocked_parents.add(parent.peer_id)
+                        n = self._parent_failures.get(parent.peer_id, 0) + 1
+                        self._parent_failures[parent.peer_id] = n
+                        if n >= self.opts.parent_fail_limit:
+                            self._blocked_parents.add(parent.peer_id)
             logger.warning("piece %d failed from all parents: %s", pr.number, last_err)
             with lock:
                 failed.append(pr)
@@ -374,9 +398,7 @@ class PeerTaskConductor:
             try:
                 channel = glue.dial(f"{c.host.ip}:{c.host.port}", retries=1)
                 try:
-                    parent = glue.ServiceClient(
-                        channel, "dragonfly2_tpu.dfdaemon.Dfdaemon"
-                    )
+                    parent = glue.ServiceClient(channel, glue.DFDAEMON_SERVICE)
                     packet = parent.GetPieceTasks(
                         dfdaemon_pb2.PieceTaskRequest(
                             task_id=self.task_id,

@@ -31,7 +31,7 @@ from dragonfly2_tpu.utils.idgen import host_id_v2
 
 logger = dflog.get("client.daemon")
 
-SCHEDULER_SERVICE = "dragonfly2_tpu.scheduler.Scheduler"
+from dragonfly2_tpu.rpc.glue import SCHEDULER_SERVICE
 
 
 @dataclass

@@ -18,7 +18,7 @@ import grpc
 
 from dragonfly2_tpu.rpc import glue
 
-DFDAEMON_SERVICE = "dragonfly2_tpu.dfdaemon.Dfdaemon"
+from dragonfly2_tpu.rpc.glue import DFDAEMON_SERVICE
 
 
 def _client(daemon_address: str) -> glue.ServiceClient:

@@ -18,7 +18,7 @@ import dfdaemon_pb2  # noqa: E402
 from dragonfly2_tpu.client import source
 from dragonfly2_tpu.rpc import glue
 
-DFDAEMON_SERVICE = "dragonfly2_tpu.dfdaemon.Dfdaemon"
+from dragonfly2_tpu.rpc.glue import DFDAEMON_SERVICE
 
 
 def download(

@@ -12,7 +12,13 @@ import urllib.request
 
 
 class PieceDownloadError(Exception):
-    pass
+    """Piece fetch failed. ``not_found`` marks an HTTP 404 — the parent is
+    healthy but hasn't written the piece yet (in-progress peer), which
+    callers treat as retryable rather than as a bad parent."""
+
+    def __init__(self, msg: str, not_found: bool = False):
+        super().__init__(msg)
+        self.not_found = not_found
 
 
 def download_piece(
@@ -31,6 +37,8 @@ def download_piece(
             digest = resp.headers.get("X-Dragonfly-Piece-Digest", "")
             return data, digest
     except urllib.error.HTTPError as e:
-        raise PieceDownloadError(f"piece {number} from {parent_addr}: HTTP {e.code}") from e
+        raise PieceDownloadError(
+            f"piece {number} from {parent_addr}: HTTP {e.code}", not_found=e.code == 404
+        ) from e
     except (urllib.error.URLError, OSError, TimeoutError) as e:
         raise PieceDownloadError(f"piece {number} from {parent_addr}: {e}") from e

@@ -8,6 +8,7 @@ requests for the same task share it) and reuse of completed local tasks
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 from dataclasses import dataclass
 
@@ -81,12 +82,9 @@ class TaskManager:
             if conductor is not None and not conductor.progress().error:
                 return task_id, conductor.peer_id, conductor
             peer_id = peer_id_v2()
-            opts = ConductorOptions(
-                piece_workers=self.options.piece_workers,
-                schedule_timeout=self.options.schedule_timeout,
-                piece_retry=self.options.piece_retry,
+            opts = dataclasses.replace(
+                self.options,
                 disable_back_source=req.disable_back_source or self.options.disable_back_source,
-                piece_length=self.options.piece_length,
             )
             conductor = PeerTaskConductor(
                 task_id=task_id,

@@ -46,7 +46,22 @@ class PieceDispatcher:
     def __init__(self, rand: random.Random | None = None):
         self.rand = rand or random.Random(0)
 
-    def pick(self, parents: list[ParentInfo], piece_number: int) -> ParentInfo | None:
+    def pick(
+        self,
+        parents: list[ParentInfo],
+        piece_number: int,
+        exclude: set[str] | None = None,
+    ) -> ParentInfo | None:
+        """Pick a parent for ``piece_number``. Parents advertising the piece
+        win; otherwise any parent may be probed optimistically (an
+        in-progress parent's finished_pieces snapshot goes stale the moment
+        it downloads more — a 404 there is retryable, not disqualifying).
+        ``exclude`` deprioritizes just-failed parents when alternatives
+        exist."""
+        if exclude:
+            preferred = [p for p in parents if p.peer_id not in exclude]
+            if preferred:
+                parents = preferred
         eligible = [p for p in parents if piece_number in p.finished_pieces]
         if not eligible:
             # parents that may have the piece soon: any parent

@@ -24,7 +24,7 @@ from dragonfly2_tpu.utils.idgen import peer_id_v2
 
 logger = dflog.get("client.rpc")
 
-SERVICE_NAME = "dragonfly2_tpu.dfdaemon.Dfdaemon"
+from dragonfly2_tpu.rpc.glue import DFDAEMON_SERVICE as SERVICE_NAME
 
 
 class DfdaemonService:
@@ -49,10 +49,8 @@ class DfdaemonService:
             disable_back_source=request.disable_back_source,
         )
         task_id, peer_id, conductor = self.tasks.start_file_task(req)
-        if conductor is None:  # reuse path — one terminal result
+        if conductor is None:  # reuse path — start_file_task already stored
             ts = self.storage.load(task_id)
-            if request.output:
-                ts.store(request.output)
             yield dfdaemon_pb2.DownloadResult(
                 task_id=task_id,
                 peer_id=peer_id,

@@ -46,6 +46,7 @@ class ModelRegistry:
         import threading
 
         self._lock = threading.Lock()  # version allocation + state flips
+        self._reserved: dict[str, int] = {}  # model_id → highest reserved version
 
     def create(
         self,
@@ -58,16 +59,21 @@ class ModelRegistry:
         scheduler_cluster_id: int = 0,
     ) -> ModelRow:
         """New inactive version: weights → object storage, row → DB.
-        MAX(version)+1 and the INSERT happen under one lock so two
-        concurrent uploads of the same model can't collide on
-        UNIQUE(model_id, version)."""
+        The version number is *reserved* under the lock, but the (possibly
+        slow) weight upload happens outside it so concurrent uploads of
+        unrelated models don't serialize behind the slowest put_object;
+        the row is only inserted once the blob exists, so an inserted
+        version is always loadable. A failed upload just skips a version
+        number."""
         with self._lock:
             row = self.db.query_one(
                 "SELECT MAX(version) AS v FROM models WHERE model_id = ?", (model_id,)
             )
-            version = (row["v"] or 0) + 1
-            key = f"{model_id}/{version}/model.npz"
-            self.storage.put_object(MODELS_BUCKET, key, weights)
+            version = max(row["v"] or 0, self._reserved.get(model_id, 0)) + 1
+            self._reserved[model_id] = version
+        key = f"{model_id}/{version}/model.npz"
+        self.storage.put_object(MODELS_BUCKET, key, weights)
+        with self._lock:
             self.db.execute(
                 "INSERT INTO models (model_id, type, version, state, evaluation,"
                 " object_key, ip, hostname, scheduler_cluster_id, created_at)"

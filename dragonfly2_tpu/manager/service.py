@@ -17,7 +17,7 @@ from dragonfly2_tpu.utils import dflog
 
 logger = dflog.get("manager.rpc")
 
-SERVICE_NAME = "dragonfly2_tpu.manager.Manager"
+from dragonfly2_tpu.rpc.glue import MANAGER_SERVICE as SERVICE_NAME
 
 # schedulers silent longer than this flip to inactive (reference keepalive)
 KEEPALIVE_TIMEOUT = 60.0

@@ -23,6 +23,13 @@ import manager_pb2  # noqa: E402
 import scheduler_pb2  # noqa: E402
 import trainer_pb2  # noqa: E402
 
+# Canonical service names — every client/server refers to these, so a
+# rename can never leave a client dialing a service no server registers.
+SCHEDULER_SERVICE = "dragonfly2_tpu.scheduler.Scheduler"
+TRAINER_SERVICE = "dragonfly2_tpu.trainer.Trainer"
+MANAGER_SERVICE = "dragonfly2_tpu.manager.Manager"
+DFDAEMON_SERVICE = "dragonfly2_tpu.dfdaemon.Dfdaemon"
+
 UNARY = "unary_unary"
 UNARY_STREAM = "unary_stream"
 STREAM_UNARY = "stream_unary"
@@ -37,7 +44,7 @@ class Method:
 
 
 SERVICES: dict[str, dict[str, Method]] = {
-    "dragonfly2_tpu.scheduler.Scheduler": {
+    SCHEDULER_SERVICE: {
         "AnnouncePeer": Method(
             STREAM_STREAM,
             scheduler_pb2.AnnouncePeerRequest,
@@ -54,10 +61,10 @@ SERVICES: dict[str, dict[str, Method]] = {
             scheduler_pb2.SyncProbesResponse,
         ),
     },
-    "dragonfly2_tpu.trainer.Trainer": {
+    TRAINER_SERVICE: {
         "Train": Method(STREAM_UNARY, trainer_pb2.TrainRequest, trainer_pb2.TrainResponse),
     },
-    "dragonfly2_tpu.manager.Manager": {
+    MANAGER_SERVICE: {
         "GetScheduler": Method(UNARY, manager_pb2.GetSchedulerRequest, manager_pb2.Scheduler),
         "ListSchedulers": Method(
             UNARY, manager_pb2.ListSchedulersRequest, manager_pb2.ListSchedulersResponse
@@ -77,7 +84,7 @@ SERVICES: dict[str, dict[str, Method]] = {
         "ListModels": Method(UNARY, manager_pb2.ListModelsRequest, manager_pb2.ListModelsResponse),
         "UpdateModel": Method(UNARY, manager_pb2.UpdateModelRequest, manager_pb2.Model),
     },
-    "dragonfly2_tpu.dfdaemon.Dfdaemon": {
+    DFDAEMON_SERVICE: {
         "Download": Method(
             UNARY_STREAM, dfdaemon_pb2.DownloadRequest, dfdaemon_pb2.DownloadResult
         ),

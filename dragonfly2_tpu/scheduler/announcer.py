@@ -19,7 +19,7 @@ import grpc
 from dragonfly2_tpu.rpc import gen  # noqa: F401
 import trainer_pb2  # noqa: E402
 
-from dragonfly2_tpu.rpc.glue import ServiceClient
+from dragonfly2_tpu.rpc.glue import TRAINER_SERVICE, ServiceClient
 from dragonfly2_tpu.scheduler.storage import Storage
 from dragonfly2_tpu.utils import dflog
 
@@ -51,7 +51,7 @@ class Announcer:
         self.keepalive_interval = keepalive_interval
         self.manager_client = manager_client
         self._trainer = (
-            ServiceClient(trainer_channel, "dragonfly2_tpu.trainer.Trainer")
+            ServiceClient(trainer_channel, TRAINER_SERVICE)
             if trainer_channel is not None
             else None
         )

@@ -36,7 +36,7 @@ from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
 
 logger = dflog.get("scheduler.rpc")
 
-SERVICE_NAME = "dragonfly2_tpu.scheduler.Scheduler"
+from dragonfly2_tpu.rpc.glue import SCHEDULER_SERVICE as SERVICE_NAME
 
 
 class _StreamAdapter:

@@ -22,7 +22,7 @@ from dragonfly2_tpu.utils.idgen import host_id_v2
 
 logger = dflog.get("trainer.rpc")
 
-SERVICE_NAME = "dragonfly2_tpu.trainer.Trainer"
+from dragonfly2_tpu.rpc.glue import TRAINER_SERVICE as SERVICE_NAME
 
 
 class TrainerService:
