@@ -85,6 +85,7 @@ class PairExamples:
     features: np.ndarray  # [M, MLP_FEATURE_DIM] float32
     labels: np.ndarray  # [M] float32 — log1p(mean piece cost, ms)
     download_index: np.ndarray  # [M] int32 — row in the source batch
+    num_downloads: int = 0  # source download-record count (for min-record gates)
 
 
 def extract_pair_features(cols: dict[str, np.ndarray]) -> PairExamples:
@@ -94,6 +95,7 @@ def extract_pair_features(cols: dict[str, np.ndarray]) -> PairExamples:
             features=np.zeros((0, MLP_FEATURE_DIM), dtype=np.float32),
             labels=np.zeros((0,), dtype=np.float32),
             download_index=np.zeros((0,), dtype=np.int32),
+            num_downloads=0,
         )
     n = cols["id"].shape[0]
     P = MAX_PARENTS
@@ -175,6 +177,7 @@ def extract_pair_features(cols: dict[str, np.ndarray]) -> PairExamples:
         features=feats[rows, slots],
         labels=label[rows, slots],
         download_index=rows.astype(np.int32),
+        num_downloads=n,
     )
 
 
@@ -205,6 +208,7 @@ class ProbeGraph:
     edge_rtt_log_ms: np.ndarray  # [E] float32
     neighbors: np.ndarray  # [N, K] int32 — sampled in-edge sources, self-padded
     neighbor_mask: np.ndarray  # [N, K] float32
+    num_records: int = 0  # source topology-record count (for min-record gates)
 
     @property
     def num_nodes(self) -> int:
@@ -231,6 +235,7 @@ def build_probe_graph(
             edge_rtt_log_ms=np.zeros((0,), dtype=np.float32),
             neighbors=np.zeros((0, max_degree), dtype=np.int32),
             neighbor_mask=np.zeros((0, max_degree), dtype=np.float32),
+            num_records=0,
         )
     n = cols["id"].shape[0]
     D = MAX_DEST_HOSTS
@@ -313,6 +318,7 @@ def build_probe_graph(
         edge_rtt_log_ms=rtt_log,
         neighbors=neighbors,
         neighbor_mask=mask,
+        num_records=n,
     )
 
 

@@ -1,0 +1,249 @@
+"""ctypes binding for the native ingestion library (native/dfnative.cc).
+
+The TPU trainer's ingestion edge — concatenated-CSV dataset files fed by
+the Train stream (reference trainer/storage/storage.go:44-148) — must
+sustain ~1.7M records/s for the 1B-records-in-10-min north star. The
+native decoder fuses CSV parse + feature extraction in C++; this module
+loads it (building on first use when a toolchain is present) and falls
+back to the numpy path (schema/features.py) when it can't.
+
+Both paths produce identical tensors: tests assert elementwise equality,
+so the fallback is a semantic spec for the native code.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+from dragonfly2_tpu.schema.features import (
+    GNN_NODE_FEATURE_DIM,
+    MLP_FEATURE_DIM,
+    NS_PER_MS,
+    PairExamples,
+    ProbeGraph,
+    sample_neighbors,
+)
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("schema.native")
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_NATIVE_DIR = _REPO_ROOT / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "libdfnative.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _build() -> bool:
+    """make the shared library; True on success."""
+    try:
+        proc = subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("native build unavailable: %s", e)
+        return False
+    if proc.returncode != 0:
+        logger.warning("native build failed:\n%s", proc.stderr[-2000:])
+        return False
+    return True
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_char_p = ctypes.c_char_p
+    c_long = ctypes.c_long
+    c_void_p = ctypes.c_void_p
+    f32_p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    i32_p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    f64_p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+
+    lib.df_pairs_new.restype = c_void_p
+    lib.df_pairs_free.argtypes = [c_void_p]
+    lib.df_pairs_feed.argtypes = [c_void_p, c_char_p, c_long]
+    lib.df_pairs_feed.restype = c_long
+    lib.df_pairs_finish.argtypes = [c_void_p]
+    lib.df_pairs_count.argtypes = [c_void_p]
+    lib.df_pairs_count.restype = c_long
+    lib.df_pairs_rows.argtypes = [c_void_p]
+    lib.df_pairs_rows.restype = c_long
+    lib.df_pairs_errors.argtypes = [c_void_p]
+    lib.df_pairs_errors.restype = c_long
+    lib.df_pairs_export.argtypes = [c_void_p, f32_p, f32_p, i32_p]
+    lib.df_topo_rows.argtypes = [c_void_p]
+    lib.df_topo_rows.restype = c_long
+
+    lib.df_topo_new.restype = c_void_p
+    lib.df_topo_free.argtypes = [c_void_p]
+    lib.df_topo_feed.argtypes = [c_void_p, c_char_p, c_long]
+    lib.df_topo_feed.restype = c_long
+    lib.df_topo_finish.argtypes = [c_void_p]
+    lib.df_topo_num_nodes.argtypes = [c_void_p]
+    lib.df_topo_num_nodes.restype = c_long
+    lib.df_topo_num_edges.argtypes = [c_void_p]
+    lib.df_topo_num_edges.restype = c_long
+    lib.df_topo_errors.argtypes = [c_void_p]
+    lib.df_topo_errors.restype = c_long
+    lib.df_topo_node_ids_size.argtypes = [c_void_p]
+    lib.df_topo_node_ids_size.restype = c_long
+    lib.df_topo_export_nodes.argtypes = [c_void_p, c_char_p, f32_p, f32_p, f32_p]
+    lib.df_topo_export_edges.argtypes = [c_void_p, i32_p, i32_p, f64_p]
+    return lib
+
+
+def load() -> ctypes.CDLL | None:
+    """The native library, building it on first use; None when
+    unavailable (callers fall back to the numpy path)."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed or os.environ.get("DF_NO_NATIVE"):
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        override = os.environ.get("DF_NATIVE_LIB")
+        path = Path(override) if override else _LIB_PATH
+        if not override:
+            # only the repo's default build is ours to (re)build; an
+            # explicit override is loaded as-is
+            src = _NATIVE_DIR / "dfnative.cc"
+            stale = (
+                not path.exists()
+                or (src.exists() and src.stat().st_mtime > path.stat().st_mtime)
+            )
+            if stale and not _build():
+                _load_failed = True
+                return None
+        try:
+            _lib = _bind(ctypes.CDLL(str(path)))
+        except OSError as e:
+            logger.warning("native library load failed: %s", e)
+            _load_failed = True
+            return None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+_CHUNK = 8 * 1024 * 1024
+
+
+def _feed_file(lib, handle, feed, finish, path: str | Path) -> None:
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_CHUNK)
+            if not chunk:
+                break
+            feed(handle, chunk, len(chunk))
+    finish(handle)
+
+
+def decode_pairs_file(path: str | Path) -> PairExamples | None:
+    """Download-record CSV file → MLP training pairs via the native
+    decoder; None when the library is unavailable (caller falls back to
+    read_csv + extract_pair_features)."""
+    lib = load()
+    if lib is None or not Path(path).exists():
+        return None
+    handle = lib.df_pairs_new()
+    try:
+        _feed_file(lib, handle, lib.df_pairs_feed, lib.df_pairs_finish, path)
+        m = lib.df_pairs_count(handle)
+        feats = np.empty((m, MLP_FEATURE_DIM), dtype=np.float32)
+        labels = np.empty((m,), dtype=np.float32)
+        idx = np.empty((m,), dtype=np.int32)
+        if m:
+            lib.df_pairs_export(handle, feats, labels, idx)
+        nerr = lib.df_pairs_errors(handle)
+        if nerr:
+            logger.warning("native pair decode: %d malformed lines skipped", nerr)
+        return PairExamples(
+            features=feats,
+            labels=labels,
+            download_index=idx,
+            num_downloads=int(lib.df_pairs_rows(handle)),
+        )
+    finally:
+        lib.df_pairs_free(handle)
+
+
+def build_probe_graph_file(
+    path: str | Path, max_degree: int = 16, seed: int = 0
+) -> ProbeGraph | None:
+    """Topology CSV file → ProbeGraph via the native decoder; None when
+    unavailable. Node interning and last-write-wins edge RTT match
+    features.build_probe_graph; degree/RTT node aggregates and neighbor
+    sampling run in numpy over the (small) edge arrays."""
+    lib = load()
+    if lib is None or not Path(path).exists():
+        return None
+    handle = lib.df_topo_new()
+    try:
+        _feed_file(lib, handle, lib.df_topo_feed, lib.df_topo_finish, path)
+        n = lib.df_topo_num_nodes(handle)
+        e = lib.df_topo_num_edges(handle)
+        ids_size = lib.df_topo_node_ids_size(handle)
+        ids_buf = ctypes.create_string_buffer(max(ids_size, 1))
+        is_seed = np.empty((max(n, 1),), dtype=np.float32)
+        tcp = np.empty((max(n, 1),), dtype=np.float32)
+        utcp = np.empty((max(n, 1),), dtype=np.float32)
+        lib.df_topo_export_nodes(handle, ids_buf, is_seed, tcp, utcp)
+        src = np.empty((max(e, 1),), dtype=np.int32)
+        dst = np.empty((max(e, 1),), dtype=np.int32)
+        rtt_ns = np.empty((max(e, 1),), dtype=np.float64)
+        lib.df_topo_export_edges(handle, src, dst, rtt_ns)
+        num_records = int(lib.df_topo_rows(handle))
+        nerr = lib.df_topo_errors(handle)
+        if nerr:
+            logger.warning("native topo decode: %d malformed lines skipped", nerr)
+    finally:
+        lib.df_topo_free(handle)
+
+    node_ids = (
+        ids_buf.raw[:ids_size].decode("utf-8").split("\n")[:-1] if n else []
+    )
+    is_seed, tcp, utcp = is_seed[:n], tcp[:n], utcp[:n]
+    src, dst, rtt_ns = src[:e], dst[:e], rtt_ns[:e]
+
+    rtt_log = np.log1p(rtt_ns / NS_PER_MS).astype(np.float32)
+    out_deg = np.bincount(src, minlength=n).astype(np.float64)
+    in_deg = np.bincount(dst, minlength=n).astype(np.float64)
+    out_rtt = np.bincount(src, weights=rtt_log, minlength=n) / np.maximum(out_deg, 1)
+    in_rtt = np.bincount(dst, weights=rtt_log, minlength=n) / np.maximum(in_deg, 1)
+    node_feats = np.stack(
+        [
+            is_seed.astype(np.float64),
+            np.log1p(tcp.astype(np.float64)) / 10.0,
+            np.log1p(utcp.astype(np.float64)) / 10.0,
+            np.log1p(out_deg),
+            np.log1p(in_deg),
+            out_rtt,
+            in_rtt,
+        ],
+        axis=-1,
+    ).astype(np.float32)
+    assert node_feats.shape[1] == GNN_NODE_FEATURE_DIM
+    neighbors, mask = sample_neighbors(src, dst, n, max_degree, seed)
+    return ProbeGraph(
+        node_ids=node_ids,
+        node_features=node_feats,
+        edge_src=src,
+        edge_dst=dst,
+        edge_rtt_log_ms=rtt_log,
+        neighbors=neighbors,
+        neighbor_mask=mask,
+        num_records=num_records,
+    )

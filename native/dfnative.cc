@@ -1,0 +1,635 @@
+// Native ingestion hot path: fused CSV decode + feature extraction.
+//
+// The reference left its training core a stub, so its ingestion edge is a
+// 128MiB-chunk gRPC upload into CSV files (reference
+// trainer/storage/storage.go:44-148); the TPU rebuild's north star (1B
+// download records in <10min ⇒ ~1.7M rec/s sustained) makes the Python
+// csv/numpy decode the bottleneck. This library streams the trainer's
+// concatenated-CSV dataset files and emits training tensors directly:
+//
+//  - DfPairs: download records → (download,parent) pair features [M,12]
+//    + log-cost labels, byte-identical semantics to
+//    schema/features.extract_pair_features (the Python fallback).
+//  - DfTopo: networktopology records → interned host nodes + probe edge
+//    list, matching schema/features.build_probe_graph's interning and
+//    last-write-wins edge semantics.
+//
+// CSV dialect: RFC4180 quotes (python csv.writer). Embedded header lines
+// (every upload round re-sends one, trainer service demux) are detected by
+// first-column == first header column and re-resolve the column mapping,
+// so schema drift between scheduler versions is tolerated per-chunk.
+//
+// C ABI only — bound from Python via ctypes (schema/native.py).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxParents = 20;     // schema/records.py MAX_PARENTS
+constexpr int kMaxPieces = 10;      // MAX_PIECES_PER_PARENT
+constexpr int kMaxDestHosts = 5;    // MAX_DEST_HOSTS
+constexpr int kFeatureDim = 12;     // features.MLP_FEATURE_DIM
+constexpr int kMaxLocationDepth = 5;
+constexpr double kNsPerMs = 1e6;
+
+// ---------------------------------------------------------------------------
+// CSV line splitter (RFC4180: quoted fields, "" escapes). Fields are
+// returned as string_views into a scratch buffer owned by the caller; the
+// unquote path rewrites in place.
+// ---------------------------------------------------------------------------
+
+struct FieldRef {
+  const char* data;
+  size_t len;
+  std::string view() const { return std::string(data, len); }
+  bool empty() const { return len == 0; }
+  bool eq(const char* s) const {
+    size_t n = strlen(s);
+    return len == n && memcmp(data, s, n) == 0;
+  }
+};
+
+// Splits one line (excluding trailing \n / \r\n) into fields. `scratch`
+// backs unescaped quoted fields. Returns false on malformed quoting.
+bool split_csv_line(const char* line, size_t len, std::vector<FieldRef>& out,
+                    std::string& scratch) {
+  out.clear();
+  scratch.clear();
+  // Reserve so scratch never reallocates mid-parse (FieldRefs point into it).
+  scratch.reserve(len + 1);
+  size_t i = 0;
+  while (true) {
+    if (i < len && line[i] == '"') {
+      // quoted field → unescape into scratch
+      size_t start = scratch.size();
+      ++i;
+      while (i < len) {
+        if (line[i] == '"') {
+          if (i + 1 < len && line[i + 1] == '"') {
+            scratch.push_back('"');
+            i += 2;
+          } else {
+            ++i;
+            break;
+          }
+        } else {
+          scratch.push_back(line[i++]);
+        }
+      }
+      out.push_back({scratch.data() + start, scratch.size() - start});
+      if (i < len) {
+        if (line[i] != ',') return false;
+        ++i;
+        continue;
+      }
+      break;
+    }
+    size_t start = i;
+    while (i < len && line[i] != ',') ++i;
+    out.push_back({line + start, i - start});
+    if (i < len) {
+      ++i;  // skip comma
+      continue;
+    }
+    break;
+  }
+  return true;
+}
+
+double to_num(const FieldRef& f) {
+  if (f.len == 0) return 0.0;
+  char buf[64];
+  size_t n = f.len < sizeof(buf) - 1 ? f.len : sizeof(buf) - 1;
+  memcpy(buf, f.data, n);
+  buf[n] = '\0';
+  return strtod(buf, nullptr);
+}
+
+// Shared leading "|"-separated path depth / kMaxLocationDepth
+// (features.location_affinity).
+double location_affinity(const std::string& a, const std::string& b) {
+  if (a.empty() || b.empty()) return 0.0;
+  int depth = 0;
+  size_t ia = 0, ib = 0;
+  for (int d = 0; d < kMaxLocationDepth; ++d) {
+    if (ia > a.size() || ib > b.size()) break;
+    size_t ea = a.find('|', ia);
+    size_t eb = b.find('|', ib);
+    size_t la = (ea == std::string::npos ? a.size() : ea) - ia;
+    size_t lb = (eb == std::string::npos ? b.size() : eb) - ib;
+    if (la != lb || memcmp(a.data() + ia, b.data() + ib, la) != 0) break;
+    ++depth;
+    if (ea == std::string::npos || eb == std::string::npos) break;
+    ia = ea + 1;
+    ib = eb + 1;
+  }
+  return double(depth) / kMaxLocationDepth;
+}
+
+// ---------------------------------------------------------------------------
+// Streaming record feeder: buffers partial records across feed() chunks.
+// A newline inside an RFC4180 quoted field is data, not a record break, so
+// quote parity is tracked across chunks (csv.writer quotes any field
+// containing the quote char, so parity toggling on every '"' is exact for
+// writer-produced files).
+// ---------------------------------------------------------------------------
+
+template <typename RowFn>
+void feed_lines(std::string& carry, bool& in_quotes, const char* buf, long len,
+                RowFn&& on_line) {
+  long start = 0;
+  for (long i = 0; i < len; ++i) {
+    const char ch = buf[i];
+    if (ch == '"') {
+      in_quotes = !in_quotes;
+    } else if (ch == '\n' && !in_quotes) {
+      if (!carry.empty()) {
+        carry.append(buf + start, i - start);
+        size_t L = carry.size();
+        if (L && carry[L - 1] == '\r') --L;
+        on_line(carry.data(), L);
+        carry.clear();
+      } else {
+        size_t L = i - start;
+        if (L && buf[i - 1] == '\r') --L;
+        on_line(buf + start, L);
+      }
+      start = i + 1;
+    }
+  }
+  if (start < len) carry.append(buf + start, len - start);
+}
+
+// ---------------------------------------------------------------------------
+// Download-record pair decoder
+// ---------------------------------------------------------------------------
+
+enum PairCol : uint8_t {
+  C_IGNORE = 0,
+  C_TOTAL_PIECES,
+  C_CHILD_IDC,
+  C_CHILD_LOC,
+  P_ID,
+  P_STATE,
+  P_FIN,
+  P_UPLOAD_COUNT,
+  P_UPLOAD_FAILED,
+  P_CUL,
+  P_CUC,
+  P_TYPE,
+  P_IDC,
+  P_LOC,
+  P_CPU,
+  P_MEM,
+  P_TCP,
+  P_UTCP,
+  P_DISK,
+  P_PIECE_COST,
+};
+
+struct ColAction {
+  uint8_t kind = C_IGNORE;
+  uint8_t parent = 0;
+  uint8_t piece = 0;
+};
+
+struct ParentScratch {
+  bool has_id = false;
+  bool succeeded = false;
+  bool is_seed = false;
+  std::string idc, loc;
+  double fin = 0, upload_count = 0, upload_failed = 0, cul = 0, cuc = 0;
+  double cpu = 0, mem = 0, tcp = 0, utcp = 0, disk = 0;
+  double piece_cost[kMaxPieces];
+  void reset() {
+    has_id = succeeded = is_seed = false;
+    idc.clear();
+    loc.clear();
+    fin = upload_count = upload_failed = cul = cuc = 0;
+    cpu = mem = tcp = utcp = disk = 0;
+    memset(piece_cost, 0, sizeof(piece_cost));
+  }
+};
+
+struct DfPairs {
+  std::vector<ColAction> colmap;
+  std::string header_col0;
+  std::string carry;        // partial record across feed() chunks
+  bool in_quotes = false;   // RFC4180 quote parity across chunks
+  std::string scratch;      // unquote buffer
+  std::vector<FieldRef> fields;
+  ParentScratch parents[kMaxParents];
+  std::string child_idc, child_loc;
+  double total_pieces = 0;
+  int64_t row = 0;  // download-record counter (not counting headers)
+  int64_t errors = 0;
+
+  std::vector<float> feat;    // M * kFeatureDim
+  std::vector<float> label;   // M
+  std::vector<int32_t> index; // M — source download row
+
+  void resolve_header(const std::vector<FieldRef>& hs) {
+    colmap.assign(hs.size(), ColAction{});
+    header_col0 = hs.empty() ? "" : hs[0].view();
+    for (size_t c = 0; c < hs.size(); ++c) {
+      std::string name = hs[c].view();
+      ColAction a;
+      if (name == "task.total_piece_count") {
+        a.kind = C_TOTAL_PIECES;
+      } else if (name == "host.network.idc") {
+        a.kind = C_CHILD_IDC;
+      } else if (name == "host.network.location") {
+        a.kind = C_CHILD_LOC;
+      } else if (name.rfind("parents.", 0) == 0) {
+        const char* p = name.c_str() + 8;
+        char* end;
+        long slot = strtol(p, &end, 10);
+        if (end == p || *end != '.' || slot < 0 || slot >= kMaxParents) {
+          colmap[c] = a;
+          continue;
+        }
+        std::string rest(end + 1);
+        a.parent = uint8_t(slot);
+        if (rest == "id") a.kind = P_ID;
+        else if (rest == "state") a.kind = P_STATE;
+        else if (rest == "finished_piece_count") a.kind = P_FIN;
+        else if (rest == "host.upload_count") a.kind = P_UPLOAD_COUNT;
+        else if (rest == "host.upload_failed_count") a.kind = P_UPLOAD_FAILED;
+        else if (rest == "host.concurrent_upload_limit") a.kind = P_CUL;
+        else if (rest == "host.concurrent_upload_count") a.kind = P_CUC;
+        else if (rest == "host.type") a.kind = P_TYPE;
+        else if (rest == "host.network.idc") a.kind = P_IDC;
+        else if (rest == "host.network.location") a.kind = P_LOC;
+        else if (rest == "host.cpu.percent") a.kind = P_CPU;
+        else if (rest == "host.memory.used_percent") a.kind = P_MEM;
+        else if (rest == "host.network.tcp_connection_count") a.kind = P_TCP;
+        else if (rest == "host.network.upload_tcp_connection_count") a.kind = P_UTCP;
+        else if (rest == "host.disk.used_percent") a.kind = P_DISK;
+        else if (rest.rfind("pieces.", 0) == 0) {
+          const char* q = rest.c_str() + 7;
+          long pj = strtol(q, &end, 10);
+          if (end != q && strcmp(end, ".cost") == 0 && pj >= 0 && pj < kMaxPieces) {
+            a.kind = P_PIECE_COST;
+            a.piece = uint8_t(pj);
+          }
+        }
+      }
+      colmap[c] = a;
+    }
+  }
+
+  void on_line(const char* line, size_t len) {
+    if (len == 0) return;
+    if (!split_csv_line(line, len, fields, scratch)) {
+      ++errors;
+      return;
+    }
+    // Header detection: no mapping yet, or first column repeats the
+    // header's first column name (embedded header of a later upload).
+    if (colmap.empty() || (!fields.empty() && !header_col0.empty() &&
+                           fields[0].eq(header_col0.c_str()))) {
+      resolve_header(fields);
+      return;
+    }
+    total_pieces = 0;
+    child_idc.clear();
+    child_loc.clear();
+    for (auto& p : parents) p.reset();
+
+    size_t n = fields.size() < colmap.size() ? fields.size() : colmap.size();
+    for (size_t c = 0; c < n; ++c) {
+      const ColAction a = colmap[c];
+      if (a.kind == C_IGNORE) continue;
+      const FieldRef& f = fields[c];
+      ParentScratch& ps = parents[a.parent];
+      switch (a.kind) {
+        case C_TOTAL_PIECES: total_pieces = to_num(f); break;
+        case C_CHILD_IDC: child_idc = f.view(); break;
+        case C_CHILD_LOC: child_loc = f.view(); break;
+        case P_ID: ps.has_id = !f.empty(); break;
+        case P_STATE: ps.succeeded = f.eq("Succeeded"); break;
+        case P_FIN: ps.fin = to_num(f); break;
+        case P_UPLOAD_COUNT: ps.upload_count = to_num(f); break;
+        case P_UPLOAD_FAILED: ps.upload_failed = to_num(f); break;
+        case P_CUL: ps.cul = to_num(f); break;
+        case P_CUC: ps.cuc = to_num(f); break;
+        case P_TYPE: ps.is_seed = !f.empty() && !f.eq("normal"); break;
+        case P_IDC: ps.idc = f.view(); break;
+        case P_LOC: ps.loc = f.view(); break;
+        case P_CPU: ps.cpu = to_num(f); break;
+        case P_MEM: ps.mem = to_num(f); break;
+        case P_TCP: ps.tcp = to_num(f); break;
+        case P_UTCP: ps.utcp = to_num(f); break;
+        case P_DISK: ps.disk = to_num(f); break;
+        case P_PIECE_COST: ps.piece_cost[a.piece] = to_num(f); break;
+        default: break;
+      }
+    }
+    emit_row();
+    ++row;
+  }
+
+  void emit_row() {
+    double total = total_pieces > 1.0 ? total_pieces : 1.0;
+    for (int s = 0; s < kMaxParents; ++s) {
+      ParentScratch& p = parents[s];
+      if (!p.has_id) continue;
+      double cost_sum = 0;
+      int cost_cnt = 0;
+      for (double c : p.piece_cost)
+        if (c > 0) {
+          cost_sum += c;
+          ++cost_cnt;
+        }
+      if (cost_cnt == 0) continue;  // mask: valid_parent & (cost_cnt > 0)
+
+      double finished_ratio = p.fin / total;
+      if (finished_ratio < 0) finished_ratio = 0;
+      if (finished_ratio > 1) finished_ratio = 1;
+      double upc = p.upload_count > 1.0 ? p.upload_count : 1.0;
+      double upload_success = (p.upload_count - p.upload_failed) / upc;
+      double cul = p.cul > 1.0 ? p.cul : 1.0;
+      double free_upload = 1.0 - p.cuc / cul;
+      if (free_upload < 0) free_upload = 0;
+      if (free_upload > 1) free_upload = 1;
+      bool idc_match = !p.idc.empty() && p.idc == child_idc;
+
+      const double f[kFeatureDim] = {
+          finished_ratio,
+          upload_success,
+          free_upload,
+          p.is_seed ? 1.0 : 0.0,
+          idc_match ? 1.0 : 0.0,
+          location_affinity(child_loc, p.loc),
+          p.cpu / 100.0,
+          p.mem / 100.0,
+          log1p(p.tcp) / 10.0,
+          log1p(p.utcp) / 10.0,
+          p.disk / 100.0,
+          p.succeeded ? 1.0 : 0.0,
+      };
+      for (double v : f) feat.push_back(float(v));
+      double mean_cost_ms = cost_sum / cost_cnt / kNsPerMs;
+      label.push_back(float(log1p(mean_cost_ms)));
+      index.push_back(int32_t(row));
+    }
+  }
+
+  void finish() {
+    if (!carry.empty()) {
+      std::string tail;
+      tail.swap(carry);
+      size_t L = tail.size();
+      if (L && tail[L - 1] == '\r') --L;
+      on_line(tail.data(), L);
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Network-topology graph decoder
+// ---------------------------------------------------------------------------
+
+enum TopoCol : uint8_t {
+  T_IGNORE = 0,
+  T_SRC_ID,
+  T_SRC_TYPE,
+  T_SRC_TCP,
+  T_SRC_UTCP,
+  D_ID,
+  D_TYPE,
+  D_TCP,
+  D_UTCP,
+  D_RTT,
+};
+
+struct TopoColAction {
+  uint8_t kind = T_IGNORE;
+  uint8_t dest = 0;
+};
+
+struct DestScratch {
+  std::string id;
+  bool is_seed = false;
+  double tcp = 0, utcp = 0, rtt = 0;
+  void reset() {
+    id.clear();
+    is_seed = false;
+    tcp = utcp = rtt = 0;
+  }
+};
+
+struct DfTopo {
+  std::vector<TopoColAction> colmap;
+  std::string header_col0;
+  std::string carry, scratch;
+  bool in_quotes = false;   // RFC4180 quote parity across chunks
+  std::vector<FieldRef> fields;
+  int64_t errors = 0;
+  int64_t row = 0;          // topology-record counter (not counting headers)
+
+  // interned nodes (first-appearance order, like the Python dict)
+  std::unordered_map<std::string, int32_t> index;
+  std::vector<std::string> node_ids;
+  std::vector<float> is_seed, tcp, utcp;
+
+  // edges, insertion-ordered with last-write-wins RTT
+  std::unordered_map<uint64_t, size_t> edge_index;
+  std::vector<int32_t> src, dst;
+  std::vector<double> rtt_ns;
+
+  std::string src_id, src_type;
+  double src_tcp = 0, src_utcp = 0;
+  DestScratch dests[kMaxDestHosts];
+
+  int32_t intern(const std::string& hid, bool seed, double t, double u) {
+    auto it = index.find(hid);
+    if (it == index.end()) {
+      int32_t idx = int32_t(node_ids.size());
+      index.emplace(hid, idx);
+      node_ids.push_back(hid);
+      is_seed.push_back(seed ? 1.0f : 0.0f);
+      tcp.push_back(float(t));
+      utcp.push_back(float(u));
+      return idx;
+    }
+    // refresh load stats, last write wins (features.build_probe_graph)
+    tcp[it->second] = float(t);
+    utcp[it->second] = float(u);
+    return it->second;
+  }
+
+  void resolve_header(const std::vector<FieldRef>& hs) {
+    colmap.assign(hs.size(), TopoColAction{});
+    header_col0 = hs.empty() ? "" : hs[0].view();
+    for (size_t c = 0; c < hs.size(); ++c) {
+      std::string name = hs[c].view();
+      TopoColAction a;
+      if (name == "host.id") a.kind = T_SRC_ID;
+      else if (name == "host.type") a.kind = T_SRC_TYPE;
+      else if (name == "host.network.tcp_connection_count") a.kind = T_SRC_TCP;
+      else if (name == "host.network.upload_tcp_connection_count") a.kind = T_SRC_UTCP;
+      else if (name.rfind("dest_hosts.", 0) == 0) {
+        const char* p = name.c_str() + 11;
+        char* end;
+        long slot = strtol(p, &end, 10);
+        if (end == p || *end != '.' || slot < 0 || slot >= kMaxDestHosts) {
+          colmap[c] = a;
+          continue;
+        }
+        std::string rest(end + 1);
+        a.dest = uint8_t(slot);
+        if (rest == "id") a.kind = D_ID;
+        else if (rest == "type") a.kind = D_TYPE;
+        else if (rest == "network.tcp_connection_count") a.kind = D_TCP;
+        else if (rest == "network.upload_tcp_connection_count") a.kind = D_UTCP;
+        else if (rest == "probes.average_rtt") a.kind = D_RTT;
+      }
+      colmap[c] = a;
+    }
+  }
+
+  void on_line(const char* line, size_t len) {
+    if (len == 0) return;
+    if (!split_csv_line(line, len, fields, scratch)) {
+      ++errors;
+      return;
+    }
+    if (colmap.empty() || (!fields.empty() && !header_col0.empty() &&
+                           fields[0].eq(header_col0.c_str()))) {
+      resolve_header(fields);
+      return;
+    }
+    src_id.clear();
+    src_type.clear();
+    src_tcp = src_utcp = 0;
+    for (auto& d : dests) d.reset();
+
+    size_t n = fields.size() < colmap.size() ? fields.size() : colmap.size();
+    for (size_t c = 0; c < n; ++c) {
+      const TopoColAction a = colmap[c];
+      if (a.kind == T_IGNORE) continue;
+      const FieldRef& f = fields[c];
+      DestScratch& d = dests[a.dest];
+      switch (a.kind) {
+        case T_SRC_ID: src_id = f.view(); break;
+        case T_SRC_TYPE: src_type = f.view(); break;
+        case T_SRC_TCP: src_tcp = to_num(f); break;
+        case T_SRC_UTCP: src_utcp = to_num(f); break;
+        case D_ID: d.id = f.view(); break;
+        case D_TYPE: d.is_seed = !f.empty() && !f.eq("normal"); break;
+        case D_TCP: d.tcp = to_num(f); break;
+        case D_UTCP: d.utcp = to_num(f); break;
+        case D_RTT: d.rtt = to_num(f); break;
+        default: break;
+      }
+    }
+    ++row;
+    if (src_id.empty()) return;
+    bool src_seed = !src_type.empty() && src_type != "normal";
+    int32_t s = intern(src_id, src_seed, src_tcp, src_utcp);
+    for (auto& d : dests) {
+      if (d.id.empty()) continue;
+      int32_t t = intern(d.id, d.is_seed, d.tcp, d.utcp);
+      if (d.rtt > 0) {
+        uint64_t key = (uint64_t(uint32_t(s)) << 32) | uint32_t(t);
+        auto it = edge_index.find(key);
+        if (it == edge_index.end()) {
+          edge_index.emplace(key, src.size());
+          src.push_back(s);
+          dst.push_back(t);
+          rtt_ns.push_back(d.rtt);
+        } else {
+          rtt_ns[it->second] = d.rtt;
+        }
+      }
+    }
+  }
+
+  void finish() {
+    if (!carry.empty()) {
+      std::string tail;
+      tail.swap(carry);
+      size_t L = tail.size();
+      if (L && tail[L - 1] == '\r') --L;
+      on_line(tail.data(), L);
+    }
+  }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+DfPairs* df_pairs_new() { return new DfPairs(); }
+void df_pairs_free(DfPairs* d) { delete d; }
+
+long df_pairs_feed(DfPairs* d, const char* buf, long len) {
+  feed_lines(d->carry, d->in_quotes, buf, len,
+             [d](const char* line, size_t L) { d->on_line(line, L); });
+  return long(d->label.size());
+}
+
+void df_pairs_finish(DfPairs* d) { d->finish(); }
+long df_pairs_count(DfPairs* d) { return long(d->label.size()); }
+long df_pairs_rows(DfPairs* d) { return long(d->row); }
+long df_pairs_errors(DfPairs* d) { return long(d->errors); }
+
+void df_pairs_export(DfPairs* d, float* feat, float* label, int32_t* idx) {
+  memcpy(feat, d->feat.data(), d->feat.size() * sizeof(float));
+  memcpy(label, d->label.data(), d->label.size() * sizeof(float));
+  memcpy(idx, d->index.data(), d->index.size() * sizeof(int32_t));
+}
+
+DfTopo* df_topo_new() { return new DfTopo(); }
+void df_topo_free(DfTopo* d) { delete d; }
+
+long df_topo_feed(DfTopo* d, const char* buf, long len) {
+  feed_lines(d->carry, d->in_quotes, buf, len,
+             [d](const char* line, size_t L) { d->on_line(line, L); });
+  return long(d->src.size());
+}
+
+void df_topo_finish(DfTopo* d) { d->finish(); }
+long df_topo_rows(DfTopo* d) { return long(d->row); }
+long df_topo_num_nodes(DfTopo* d) { return long(d->node_ids.size()); }
+long df_topo_num_edges(DfTopo* d) { return long(d->src.size()); }
+long df_topo_errors(DfTopo* d) { return long(d->errors); }
+
+long df_topo_node_ids_size(DfTopo* d) {
+  long n = 0;
+  for (const auto& s : d->node_ids) n += long(s.size()) + 1;  // '\n'-joined
+  return n;
+}
+
+void df_topo_export_nodes(DfTopo* d, char* ids, float* is_seed, float* tcp,
+                          float* utcp) {
+  char* p = ids;
+  for (const auto& s : d->node_ids) {
+    memcpy(p, s.data(), s.size());
+    p += s.size();
+    *p++ = '\n';
+  }
+  memcpy(is_seed, d->is_seed.data(), d->is_seed.size() * sizeof(float));
+  memcpy(tcp, d->tcp.data(), d->tcp.size() * sizeof(float));
+  memcpy(utcp, d->utcp.data(), d->utcp.size() * sizeof(float));
+}
+
+void df_topo_export_edges(DfTopo* d, int32_t* src, int32_t* dst,
+                          double* rtt_ns) {
+  memcpy(src, d->src.data(), d->src.size() * sizeof(int32_t));
+  memcpy(dst, d->dst.data(), d->dst.size() * sizeof(int32_t));
+  memcpy(rtt_ns, d->rtt_ns.data(), d->rtt_ns.size() * sizeof(double));
+}
+
+}  // extern "C"

@@ -1,0 +1,165 @@
+"""Native ingestion decoder vs the numpy reference path.
+
+The Python pipeline (schema/features.py) is the semantic spec; the C++
+decoder (native/dfnative.cc) must produce elementwise-identical tensors,
+including across embedded header lines (every trainer upload round
+re-sends a CSV header, reference trainer/service demux) and quoted CSV
+fields.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.schema import native
+from dragonfly2_tpu.schema.columnar import records_to_columns, write_csv
+from dragonfly2_tpu.schema.features import build_probe_graph, extract_pair_features
+from dragonfly2_tpu.schema.synth import make_download_records, make_topology_records
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no toolchain)"
+)
+
+
+def _concat_uploads(path, *rec_lists, tmp_path):
+    """Build a trainer dataset file the way the Train stream does: each
+    upload round is a complete CSV (with its own header line) appended
+    byte-wise, so the result contains embedded headers."""
+    with open(path, "wb") as out:
+        for i, recs in enumerate(rec_lists):
+            part = tmp_path / f"part{i}.csv"
+            write_csv(part, recs)
+            out.write(part.read_bytes())
+
+
+@pytest.fixture
+def download_csv(tmp_path):
+    """Two appended upload rounds — the second re-sends its header."""
+    recs1 = make_download_records(60, seed=1)
+    recs2 = make_download_records(40, seed=2)
+    path = tmp_path / "download_h.csv"
+    _concat_uploads(path, recs1, recs2, tmp_path=tmp_path)
+    assert path.read_bytes().count(b"id,tag,application") == 2  # embedded header
+    return path, recs1 + recs2
+
+
+def test_pairs_match_python_path(download_csv):
+    path, recs = download_csv
+    got = native.decode_pairs_file(path)
+    want = extract_pair_features(records_to_columns(recs))
+    assert got.features.shape == want.features.shape
+    np.testing.assert_array_equal(got.download_index, want.download_index)
+    np.testing.assert_allclose(got.features, want.features, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(got.labels, want.labels, rtol=1e-6, atol=1e-7)
+
+
+def test_pairs_quoted_fields(tmp_path):
+    """Location strings with commas/quotes survive RFC4180 round-trip."""
+    recs = make_download_records(5, seed=3)
+    recs[0].host.network.location = 'dc|rack,1|"edge"'
+    recs[0].parents[0].host.network.location = 'dc|rack,1|"edge"'
+    path = tmp_path / "dl.csv"
+    write_csv(path, recs)
+    got = native.decode_pairs_file(path)
+    want = extract_pair_features(records_to_columns(recs))
+    np.testing.assert_allclose(got.features, want.features, rtol=1e-6, atol=1e-7)
+
+
+def test_pairs_missing_file(tmp_path):
+    assert native.decode_pairs_file(tmp_path / "nope.csv") is None
+
+
+def test_pairs_quoted_newline(tmp_path):
+    """A newline inside a quoted field is data, not a record break."""
+    recs = make_download_records(6, seed=9)
+    recs[0].host.network.location = "dc|row\nrack|x"
+    recs[2].parents[0].host.network.location = "a\nb"
+    path = tmp_path / "dl.csv"
+    write_csv(path, recs)
+    got = native.decode_pairs_file(path)
+    want = extract_pair_features(records_to_columns(recs))
+    assert got.num_downloads == want.num_downloads == 6
+    np.testing.assert_array_equal(got.download_index, want.download_index)
+    np.testing.assert_allclose(got.features, want.features, rtol=1e-6, atol=1e-7)
+
+
+def test_min_record_gates_apply_on_native_path(tmp_path):
+    """min_download_records applies even when the native decoder is used."""
+    from dragonfly2_tpu.trainer.storage import TrainerStorage
+    from dragonfly2_tpu.trainer.training import Training, TrainingConfig
+
+    storage = TrainerStorage(tmp_path / "store")
+    recs = make_download_records(3, seed=11)
+    src = tmp_path / "src.csv"
+    write_csv(src, recs)
+    storage.append_download("h", src.read_bytes())
+    training = Training(storage, config=TrainingConfig(min_download_records=100))
+    with pytest.raises(ValueError, match="< min 100"):
+        training._train_mlp("h", "ip", "host")
+
+
+def test_topology_match_python_path(tmp_path):
+    t1 = make_topology_records(80, num_hosts=24, seed=3)
+    t2 = make_topology_records(50, num_hosts=24, seed=4)
+    path = tmp_path / "topo.csv"
+    _concat_uploads(path, t1, t2, tmp_path=tmp_path)
+    got = native.build_probe_graph_file(path, max_degree=8, seed=0)
+    want = build_probe_graph(records_to_columns(t1 + t2), max_degree=8, seed=0)
+    assert got.node_ids == want.node_ids
+    np.testing.assert_array_equal(got.edge_src, want.edge_src)
+    np.testing.assert_array_equal(got.edge_dst, want.edge_dst)
+    np.testing.assert_allclose(got.edge_rtt_log_ms, want.edge_rtt_log_ms, rtol=1e-6)
+    np.testing.assert_allclose(got.node_features, want.node_features, rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(got.neighbors, want.neighbors)
+    np.testing.assert_array_equal(got.neighbor_mask, want.neighbor_mask)
+
+
+def test_chunked_feed_boundary(tmp_path):
+    """Chunk boundaries mid-line must not corrupt rows: feed byte-by-byte
+    tiny chunks and compare."""
+    recs = make_download_records(8, seed=5)
+    path = tmp_path / "dl.csv"
+    write_csv(path, recs)
+    lib = native.load()
+    data = path.read_bytes()
+    handle = lib.df_pairs_new()
+    try:
+        for i in range(0, len(data), 97):  # prime-sized chunks split lines
+            chunk = data[i : i + 97]
+            lib.df_pairs_feed(handle, chunk, len(chunk))
+        lib.df_pairs_finish(handle)
+        m = lib.df_pairs_count(handle)
+    finally:
+        lib.df_pairs_free(handle)
+    want = extract_pair_features(records_to_columns(recs))
+    assert m == want.features.shape[0]
+
+
+def test_training_uses_native(tmp_path, monkeypatch):
+    """Training._train_mlp goes through the native decoder when present."""
+    from dragonfly2_tpu.trainer.storage import TrainerStorage
+    from dragonfly2_tpu.trainer.training import Training, TrainingConfig
+    from dragonfly2_tpu.trainer.train import FitConfig
+
+    storage = TrainerStorage(tmp_path)
+    recs = make_download_records(50, seed=7)
+    csv_path = tmp_path / "dl_src.csv"
+    write_csv(csv_path, recs)
+    storage.append_download("ip_host", csv_path.read_bytes())
+
+    called = {}
+    orig = native.decode_pairs_file
+
+    def spy(path):
+        called["path"] = str(path)
+        return orig(path)
+
+    monkeypatch.setattr(native, "decode_pairs_file", spy)
+    training = Training(
+        storage,
+        config=TrainingConfig(mlp=FitConfig(epochs=1, batch_size=256)),
+    )
+    metrics = training._train_mlp("ip_host", "ip", "host")
+    assert "mse" in metrics
+    assert called["path"].endswith("download_ip_host.csv")
