@@ -1,0 +1,169 @@
+"""Graph-parallel GraphSAGE: node tables sharded over an ICI mesh axis.
+
+SURVEY.md §5.7's scaling problem: a probe graph with O(hosts²) edges and
+its per-node embedding table don't fit one chip's HBM at fleet scale. The
+answer mirrors ring attention — shard the node feature/embedding tables
+row-wise over a mesh axis and rotate shards around the ICI ring
+(ops.ring.ring_gather_rows) for the two places a device needs non-local
+rows: neighbor aggregation and edge-endpoint lookup. Per-device memory is
+O(N/devices + E/devices); the full tables never materialize.
+
+Semantics match models.gnn.forward_edge_rtt exactly (tested elementwise
+in float32): same masked-mean aggregation, same bf16 matmul policy, same
+L2-normalized embeddings and pairwise head.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from dragonfly2_tpu.models.mlp import apply_mlp
+from dragonfly2_tpu.ops.ring import ring_gather_rows
+from dragonfly2_tpu.ops.segment import masked_mean
+
+
+def pad_rows(a: np.ndarray, multiple: int) -> np.ndarray:
+    """Pad axis 0 up to a multiple so row-sharding divides evenly."""
+    from dragonfly2_tpu.parallel.sharding import pad_to_multiple
+
+    padded, _ = pad_to_multiple(a, multiple)
+    return padded
+
+
+def pad_graph(graph, num_shards: int):
+    """ProbeGraph → padded arrays sharding-ready over ``num_shards``.
+
+    Padded nodes self-neighbor with zero mask (inert under masked mean);
+    padded edges point at node 0 with zero weight in the loss mask.
+    Returns (node_features, neighbors, neighbor_mask, edge_src, edge_dst,
+    edge_y, edge_w) as numpy arrays.
+    """
+    nf = pad_rows(graph.node_features.astype(np.float32), num_shards)
+    n_pad = nf.shape[0]
+    neighbors = pad_rows(graph.neighbors.astype(np.int32), num_shards)
+    # padded nodes' neighbor slots must stay in-bounds: self-index
+    if n_pad > graph.num_nodes:
+        pad_ids = np.arange(graph.num_nodes, n_pad, dtype=np.int32)
+        neighbors[graph.num_nodes :] = pad_ids[:, None]
+    mask = pad_rows(graph.neighbor_mask.astype(np.float32), num_shards)
+
+    src = pad_rows(graph.edge_src.astype(np.int32), num_shards)
+    dst = pad_rows(graph.edge_dst.astype(np.int32), num_shards)
+    y = pad_rows(graph.edge_rtt_log_ms.astype(np.float32), num_shards)
+    w = pad_rows(np.ones(len(graph.edge_src), np.float32), num_shards)
+    return nf, neighbors, mask, src, dst, y, w
+
+
+def _forward_local(
+    dense: dict,
+    embed_shard: jax.Array | None,  # [S, E] or None
+    feat_shard: jax.Array,  # [S, F]
+    nbr_shard: jax.Array,  # [S, K] global ids
+    mask_shard: jax.Array,  # [S, K]
+    src_blk: jax.Array,  # [Eb] global ids
+    dst_blk: jax.Array,  # [Eb]
+    axis: str,
+    compute_dtype,
+) -> jax.Array:
+    """Per-device body under shard_map → per-edge log-RTT for this
+    device's edge block."""
+    h = feat_shard
+    if embed_shard is not None:
+        h = jnp.concatenate([h, embed_shard], axis=-1)
+    for layer in dense["sage"]:
+        nbr_feats = ring_gather_rows(h, nbr_shard, axis)  # [S, K, F]
+        agg = masked_mean(nbr_feats, mask_shard)
+        z = jnp.dot(
+            h.astype(compute_dtype),
+            layer["w_self"].astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        ) + jnp.dot(
+            agg.astype(compute_dtype),
+            layer["w_nbr"].astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+        h = jax.nn.relu(z + layer["b"].astype(jnp.float32))
+    norm = jnp.linalg.norm(h, axis=-1, keepdims=True)
+    h = h / jnp.maximum(norm, 1e-6)
+
+    # one ring rotation serves both endpoints — stacked indices halve the
+    # ppermute volume of the hottest collective in the loop
+    ends = ring_gather_rows(h, jnp.stack([src_blk, dst_blk]), axis)  # [2, Eb, H]
+    hs, hd = ends[0], ends[1]
+    pair = jnp.concatenate([hs, hd, hs * hd], axis=-1)
+    return apply_mlp(dense["head"], pair)[..., 0]
+
+
+def make_sharded_forward(mesh, axis: str = "gp", compute_dtype=jnp.bfloat16):
+    """→ fn(dense, embed, node_features, neighbors, mask, src, dst) with
+    node tables and edge blocks sharded over ``mesh[axis]``; returns
+    per-edge predictions (edge-sharded)."""
+    row = P(axis)
+    row2 = P(axis, None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), row2, row2, row2, row2, row, row),
+        out_specs=row,
+        check_vma=False,
+    )
+    def fwd(dense, embed, feats, nbrs, mask, src, dst):
+        return _forward_local(
+            dense, embed, feats, nbrs, mask, src, dst, axis, compute_dtype
+        )
+
+    def apply(dense, embed, feats, nbrs, mask, src, dst):
+        if embed is None:
+            # shard_map specs are positional — substitute an empty table
+            embed = jnp.zeros((feats.shape[0], 0), feats.dtype)
+        return fwd(dense, embed, feats, nbrs, mask, src, dst)
+
+    return apply
+
+
+def make_sharded_loss(mesh, axis: str = "gp", compute_dtype=jnp.bfloat16):
+    """→ loss(dense, embed, graph arrays, src, dst, y, w): weighted MSE
+    over valid edges, psum-reduced across the axis so every device sees
+    the global mean."""
+    row = P(axis)
+    row2 = P(axis, None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), row2, row2, row2, row2, row, row, row, row),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def loss(dense, embed, feats, nbrs, mask, src, dst, y, w):
+        pred = _forward_local(
+            dense, embed, feats, nbrs, mask, src, dst, axis, compute_dtype
+        )
+        se = w * (pred - y) ** 2
+        total = lax.psum(se.sum(), axis)
+        count = lax.psum(w.sum(), axis)
+        return total / jnp.maximum(count, 1.0)
+
+    def apply(dense, embed, feats, nbrs, mask, src, dst, y, w):
+        if embed is None:
+            embed = jnp.zeros((feats.shape[0], 0), feats.dtype)
+        return loss(dense, embed, feats, nbrs, mask, src, dst, y, w)
+
+    return apply
+
+
+def shard_graph_arrays(mesh, axis: str, *arrays):
+    """device_put each array row-sharded over ``mesh[axis]``."""
+    out = []
+    for a in arrays:
+        spec = P(axis) if a.ndim == 1 else P(axis, *([None] * (a.ndim - 1)))
+        out.append(jax.device_put(jnp.asarray(a), NamedSharding(mesh, spec)))
+    return out

@@ -181,6 +181,22 @@ class GNNFitConfig(FitConfig):
     learning_rate: float = 2e-2
 
 
+def _init_gnn(graph, cfg: GNNFitConfig):
+    """Shared GraphSAGE init for the single-device and sharded fits: same
+    seed, same embedding table, same head-bias warm start — the sharded
+    path's semantics must match train_gnn's."""
+    if len(graph.edge_src) == 0:
+        raise ValueError("probe graph has no edges to train on")
+    key = jax.random.PRNGKey(cfg.seed)
+    params = gnn_mod.init_graphsage(
+        key, graph.node_features.shape[1], cfg.hidden_dims, num_nodes=graph.num_nodes
+    )
+    params["head"]["layers"][-1]["b"] = jnp.full(
+        (1,), float(graph.edge_rtt_log_ms.mean())
+    )
+    return params
+
+
 def train_gnn(
     graph,
     mesh=None,
@@ -196,17 +212,8 @@ def train_gnn(
     """
     cfg = config or GNNFitConfig()
     e = len(graph.edge_src)
-    if e == 0:
-        raise ValueError("probe graph has no edges to train on")
     train_idx, eval_idx = _split_eval(e, cfg.eval_fraction, cfg.seed)
-
-    key = jax.random.PRNGKey(cfg.seed)
-    params = gnn_mod.init_graphsage(
-        key, graph.node_features.shape[1], cfg.hidden_dims, num_nodes=graph.num_nodes
-    )
-    params["head"]["layers"][-1]["b"] = jnp.full(
-        (1,), float(graph.edge_rtt_log_ms.mean())
-    )
+    params = _init_gnn(graph, cfg)
     if mesh is not None:
         from dragonfly2_tpu.parallel.sharding import replicate
 
@@ -243,20 +250,87 @@ def train_gnn(
     return FitResult(params=params, metrics=metrics, history=history)
 
 
-def evaluate_gnn(params, graph, edge_idx: np.ndarray) -> dict[str, float]:
-    pred = np.asarray(
-        jax.jit(gnn_mod.forward_edge_rtt)(
-            params,
-            jnp.asarray(graph.node_features),
-            jnp.asarray(graph.neighbors),
-            jnp.asarray(graph.neighbor_mask),
-            jnp.asarray(graph.edge_src[edge_idx]),
-            jnp.asarray(graph.edge_dst[edge_idx]),
-        )
+def train_gnn_sharded(
+    graph,
+    mesh,
+    axis: str = "gp",
+    config: GNNFitConfig | None = None,
+) -> FitResult:
+    """Graph-parallel GraphSAGE fit: node feature/embedding tables and
+    edge blocks row-sharded over ``mesh[axis]``, neighbor and endpoint
+    gathers riding the ICI ring (models.gnn_sharded). Per-device HBM is
+    O(N/devices) — the path for probe graphs too large for one chip;
+    semantics (loss, params) match train_gnn's full-batch limit.
+    """
+    from dragonfly2_tpu.models import gnn_sharded as gs
+
+    cfg = config or GNNFitConfig()
+    e = len(graph.edge_src)
+    shards = mesh.shape[axis]
+    _, eval_idx = _split_eval(e, cfg.eval_fraction, cfg.seed)
+    params = _init_gnn(graph, cfg)
+
+    nf, nbrs, mask, src_all, dst_all, y_all, w_all = gs.pad_graph(graph, shards)
+    # hold out the eval edges by zeroing their loss weight — shapes stay
+    # static, sharding stays even
+    w_all[eval_idx] = 0.0
+
+    # node embedding table sharded over the axis; dense weights replicated
+    embed = params.pop("node_embed", None)
+    if embed is not None:
+        embed = jnp.asarray(gs.pad_rows(np.asarray(embed), shards))
+    from dragonfly2_tpu.parallel.sharding import replicate
+
+    dense = replicate(mesh, params)
+    if embed is not None:
+        embed = jax.device_put(embed, NamedSharding(mesh, P(axis, None)))
+    nf_d, nbrs_d, mask_d, src_d, dst_d, y_d, w_d = gs.shard_graph_arrays(
+        mesh, axis, nf, nbrs, mask, src_all, dst_all, y_all, w_all
     )
-    y = graph.edge_rtt_log_ms[edge_idx]
+
+    loss_fn = gs.make_sharded_loss(mesh, axis)
+    optimizer = _optimizer(cfg, cfg.epochs)
+    opt_state = optimizer.init((dense, embed))
+
+    @jax.jit
+    def step(dense, embed, opt_state):
+        def wrapped(de):
+            d, em = de
+            return loss_fn(d, em, nf_d, nbrs_d, mask_d, src_d, dst_d, y_d, w_d)
+
+        loss, grads = jax.value_and_grad(wrapped)((dense, embed))
+        updates, opt_state2 = optimizer.update(grads, opt_state, (dense, embed))
+        dense2, embed2 = optax.apply_updates((dense, embed), updates)
+        return dense2, embed2, opt_state2, loss
+
+    history: list[float] = []
+    for _ in range(cfg.epochs):
+        dense, embed, opt_state, loss = step(dense, embed, opt_state)
+        history.append(float(loss))
+
+    metrics: dict[str, float] = {}
+    if len(eval_idx):
+        # eval through the sharded forward too — the whole point of this
+        # path is that the graph doesn't fit one chip
+        fwd = gs.make_sharded_forward(mesh, axis)
+        pred = np.asarray(
+            jax.jit(fwd)(dense, embed, nf_d, nbrs_d, mask_d, src_d, dst_d)
+        )[:e][eval_idx]
+        metrics = _edge_metrics(
+            pred, graph.edge_rtt_log_ms[eval_idx], float(np.median(graph.edge_rtt_log_ms))
+        )
+
+    out_params = jax.tree_util.tree_map(np.asarray, dense)
+    if embed is not None:
+        out_params["node_embed"] = np.asarray(embed)[: graph.num_nodes]
+    return FitResult(params=out_params, metrics=metrics, history=history)
+
+
+def _edge_metrics(pred: np.ndarray, y: np.ndarray, thresh: float) -> dict[str, float]:
+    """MSE/MAE + precision/recall/f1 on "edge faster than median RTT" —
+    the evaluation tuple the manager stores with a GNN upload (reference
+    manager_server_v1.go CreateModel GNN evaluation fields)."""
     err = pred - y
-    thresh = float(np.median(graph.edge_rtt_log_ms))
     actual_fast = y < thresh
     pred_fast = pred < thresh
     tp = float(np.sum(pred_fast & actual_fast))
@@ -272,6 +346,22 @@ def evaluate_gnn(params, graph, edge_idx: np.ndarray) -> dict[str, float]:
         "recall": recall,
         "f1": f1,
     }
+
+
+def evaluate_gnn(params, graph, edge_idx: np.ndarray) -> dict[str, float]:
+    pred = np.asarray(
+        jax.jit(gnn_mod.forward_edge_rtt)(
+            params,
+            jnp.asarray(graph.node_features),
+            jnp.asarray(graph.neighbors),
+            jnp.asarray(graph.neighbor_mask),
+            jnp.asarray(graph.edge_src[edge_idx]),
+            jnp.asarray(graph.edge_dst[edge_idx]),
+        )
+    )
+    return _edge_metrics(
+        pred, graph.edge_rtt_log_ms[edge_idx], float(np.median(graph.edge_rtt_log_ms))
+    )
 
 
 # ---------------------------------------------------------------------------
