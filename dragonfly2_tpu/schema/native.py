@@ -141,8 +141,10 @@ def available() -> bool:
 _CHUNK = 8 * 1024 * 1024
 
 
-def _feed_file(lib, handle, feed, finish, path: str | Path) -> None:
+def _feed_file(lib, handle, feed, finish, path: str | Path, offset: int = 0) -> None:
     with open(path, "rb") as f:
+        if offset:
+            f.seek(offset)
         while True:
             chunk = f.read(_CHUNK)
             if not chunk:
@@ -151,16 +153,22 @@ def _feed_file(lib, handle, feed, finish, path: str | Path) -> None:
     finish(handle)
 
 
-def decode_pairs_file(path: str | Path) -> PairExamples | None:
+def decode_pairs_file(path: str | Path, offset: int = 0) -> PairExamples | None:
     """Download-record CSV file → MLP training pairs via the native
     decoder; None when the library is unavailable (caller falls back to
-    read_csv + extract_pair_features)."""
+    read_csv + extract_pair_features). ``offset`` starts mid-file at an
+    upload-round boundary (each round begins with its own header line —
+    the decoder re-keys on it)."""
     lib = load()
     if lib is None or not Path(path).exists():
         return None
+    if offset > Path(path).stat().st_size:
+        # file was cleared/recreated smaller than a stale committed offset
+        # — decode from the top rather than reading nothing forever
+        offset = 0
     handle = lib.df_pairs_new()
     try:
-        _feed_file(lib, handle, lib.df_pairs_feed, lib.df_pairs_finish, path)
+        _feed_file(lib, handle, lib.df_pairs_feed, lib.df_pairs_finish, path, offset)
         m = lib.df_pairs_count(handle)
         feats = np.empty((m, MLP_FEATURE_DIM), dtype=np.float32)
         labels = np.empty((m,), dtype=np.float32)

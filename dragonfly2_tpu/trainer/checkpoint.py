@@ -1,0 +1,139 @@
+"""Trainer checkpoint/resume (orbax) — elastic restart for fit loops.
+
+The reference has no trainer checkpoints at all: its fit is a stub and
+each round retrains from the uploaded CSVs, deleting storage on shutdown
+(reference trainer/trainer.go:156-161, SURVEY.md §5.4). At TPU scale a
+1B-record round is minutes of work worth protecting: fit loops snapshot
+(params, opt_state, epoch) every epoch through an orbax CheckpointManager
+and resume from the latest snapshot after a crash — same rng schedule,
+so an interrupted-and-resumed fit reproduces the uninterrupted one.
+
+Also here: resumable ingestion offsets. When a trainer runs incremental
+rounds (clear_after_train=False), the byte offset consumed per dataset
+file is committed after a successful fit, so the next round decodes only
+newly appended upload rounds (each upload is a complete CSV whose header
+re-keys the native decoder mid-stream).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("trainer.ckpt")
+
+
+class FitCheckpointer:
+    """Orbax-backed (params, opt_state, epoch) snapshots for one fit run.
+
+    Layout: ``<dir>/<step>/...`` managed by ocp.CheckpointManager with
+    bounded retention. `restore_latest` needs the abstract structure of
+    the state (a like-tree), which fit loops have by construction.
+    """
+
+    def __init__(self, directory: str | Path, max_to_keep: int = 2):
+        import orbax.checkpoint as ocp
+
+        self._dir = Path(directory).resolve()
+        self._dir.mkdir(parents=True, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+        self._ocp = ocp
+
+    def save(self, epoch: int, state: Any) -> None:
+        """Snapshot state after ``epoch`` (blocking — fit epochs are long
+        compared to a snapshot write)."""
+        self._mgr.save(epoch, args=self._ocp.args.StandardSave(state))
+        self._mgr.wait_until_finished()
+
+    def latest_epoch(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, like: Any) -> tuple[int, Any] | None:
+        """→ (epoch, state) of the newest snapshot, or None. ``like`` is a
+        matching pytree of arrays providing structure/shape/dtype."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        import jax
+
+        abstract = jax.tree.map(self._ocp.tree.to_shape_dtype_struct, like)
+        state = self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore(abstract)
+        )
+        return int(step), state
+
+    def clear(self) -> None:
+        """Delete every snapshot — called on successful fit completion so
+        the next round trains fresh instead of resuming into zero epochs."""
+        for step in list(self._mgr.all_steps()):
+            self._mgr.delete(step)
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Resumable ingestion offsets
+# ---------------------------------------------------------------------------
+
+
+class OffsetLedger:
+    """Byte offsets consumed per dataset file, committed only after a
+    successful fit — a crashed round re-decodes from the previous commit
+    (at-least-once ingestion; training is idempotent over a round)."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self._lock = threading.Lock()
+        self._offsets: dict[str, int] = {}
+        if self.path.exists():
+            try:
+                self._offsets = json.loads(self.path.read_text())
+            except (OSError, json.JSONDecodeError) as e:
+                logger.warning("offset ledger unreadable, starting fresh: %s", e)
+
+    def get(self, key: str) -> int:
+        with self._lock:
+            return int(self._offsets.get(key, 0))
+
+    def commit(self, key: str, offset: int) -> None:
+        with self._lock:
+            self._offsets[key] = int(offset)
+            tmp = self.path.with_suffix(".tmp")
+            tmp.write_text(json.dumps(self._offsets, indent=0, sort_keys=True))
+            tmp.replace(self.path)
+
+    def reset(self, key: str) -> None:
+        """Drop a file's offset (after the file itself is cleared)."""
+        with self._lock:
+            if key in self._offsets:
+                del self._offsets[key]
+                tmp = self.path.with_suffix(".tmp")
+                tmp.write_text(json.dumps(self._offsets, indent=0, sort_keys=True))
+                tmp.replace(self.path)
+
+
+def params_equal(a: Any, b: Any, atol: float = 0.0) -> bool:
+    """Structural + numeric equality of two parameter pytrees (test/debug
+    helper for resume-reproducibility checks)."""
+    import jax
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    if ta != tb or len(la) != len(lb):
+        return False
+    for x, y in zip(la, lb):
+        if not np.allclose(np.asarray(x), np.asarray(y), atol=atol):
+            return False
+    return True

@@ -53,6 +53,9 @@ class TrainerService:
             raise
 
         if host_id is not None:
+            # stream complete: everything appended so far is whole rounds —
+            # mark the byte boundary incremental offsets may commit up to
+            self.storage.mark_download_round(host_id)
             if self.synchronous:
                 self.training.train(ip, hostname)
             else:

@@ -15,6 +15,7 @@ from pathlib import Path
 import csv
 
 from dragonfly2_tpu.schema import records as R
+from dragonfly2_tpu.trainer.checkpoint import OffsetLedger
 
 
 class TrainerStorage:
@@ -22,6 +23,12 @@ class TrainerStorage:
         self.dir = Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self._lock = threading.Lock()
+        # byte offsets consumed per dataset file (incremental rounds)
+        self.offsets = OffsetLedger(self.dir / "offsets.json")
+        # last complete upload-round boundary per file (marked by the Train
+        # service at stream EOF, read under the same lock appends hold) —
+        # offsets committed here can never land mid-record
+        self._round_boundaries: dict[str, int] = {}
 
     def download_path(self, host_id: str) -> Path:
         return self.dir / f"download_{host_id}.csv"
@@ -83,15 +90,43 @@ class TrainerStorage:
             ids.add(p.stem.removeprefix("networktopology_"))
         return sorted(ids)
 
+    # -- resumable ingestion offsets --------------------------------------
+    def download_offset(self, host_id: str) -> int:
+        return self.offsets.get(f"download_{host_id}")
+
+    def commit_download_offset(self, host_id: str, offset: int) -> None:
+        self.offsets.commit(f"download_{host_id}", offset)
+
+    def mark_download_round(self, host_id: str) -> int:
+        """Record (and return) the current download-file size as a round
+        boundary — called by the Train service once a stream finishes, so
+        the boundary always sits between complete uploads."""
+        with self._lock:
+            path = self.download_path(host_id)
+            size = path.stat().st_size if path.exists() else 0
+            self._round_boundaries[f"download_{host_id}"] = size
+            return size
+
+    def download_round_boundary(self, host_id: str) -> int:
+        """Last marked round boundary; falls back to a locked size stat
+        (direct-API callers that never interleave appends with training)."""
+        with self._lock:
+            key = f"download_{host_id}"
+            if key in self._round_boundaries:
+                return self._round_boundaries[key]
+            path = self.download_path(host_id)
+            return path.stat().st_size if path.exists() else 0
+
     # -- cleanup ----------------------------------------------------------
     def clear_download(self, host_id: str) -> None:
         self.download_path(host_id).unlink(missing_ok=True)
+        self.offsets.reset(f"download_{host_id}")
 
     def clear_network_topology(self, host_id: str) -> None:
         self.network_topology_path(host_id).unlink(missing_ok=True)
+        self.offsets.reset(f"networktopology_{host_id}")
 
     def clear(self) -> None:
-        for p in list(self.dir.glob("download_*.csv")) + list(
-            self.dir.glob("networktopology_*.csv")
-        ):
-            p.unlink(missing_ok=True)
+        for host_id in self.host_ids():
+            self.clear_download(host_id)
+            self.clear_network_topology(host_id)

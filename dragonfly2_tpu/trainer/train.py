@@ -41,6 +41,10 @@ class FitConfig:
     eval_fraction: float = 0.1
     seed: int = 0
     compute_dtype: Any = jnp.bfloat16
+    # elastic restart: snapshot (params, opt_state) every N epochs here
+    # and resume from the latest snapshot (trainer.checkpoint)
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 1
 
 
 @dataclass
@@ -148,18 +152,59 @@ def train_mlp(
 
     epoch_fn = make_epoch_fn(loss_fn, optimizer)
 
-    history: list[float] = []
-    rng = np.random.default_rng(cfg.seed + 1)
-    for _ in range(cfg.epochs):
-        order = train_idx[rng.permutation(len(train_idx))][:used]
-        xb = features[order].reshape(steps, batch, f)
-        yb = labels[order].reshape(steps, batch)
-        xb, yb = _shard_arrays(mesh, xb, yb)
-        params, opt_state, mean_loss = epoch_fn(params, opt_state, (xb, yb))
-        history.append(float(mean_loss))
+    ckpt, start_epoch = _open_checkpoint(cfg)
+    try:
+        if ckpt is not None and start_epoch > 0:
+            restored = ckpt.restore_latest({"params": params, "opt_state": opt_state})
+            if restored is not None:
+                _, state = restored
+                params, opt_state = state["params"], state["opt_state"]
 
-    metrics = evaluate_mlp(params, features[eval_idx], labels[eval_idx]) if len(eval_idx) else {}
-    return FitResult(params=params, metrics=metrics, history=history)
+        history: list[float] = []
+        for epoch in range(start_epoch, cfg.epochs):
+            # per-epoch rng: a resumed run replays the exact shuffle schedule
+            rng = np.random.default_rng(cfg.seed + 1 + epoch)
+            order = train_idx[rng.permutation(len(train_idx))][:used]
+            xb = features[order].reshape(steps, batch, f)
+            yb = labels[order].reshape(steps, batch)
+            xb, yb = _shard_arrays(mesh, xb, yb)
+            params, opt_state, mean_loss = epoch_fn(params, opt_state, (xb, yb))
+            history.append(float(mean_loss))
+            _maybe_save_tree(ckpt, cfg, epoch, {"params": params, "opt_state": opt_state})
+
+        metrics = evaluate_mlp(params, features[eval_idx], labels[eval_idx]) if len(eval_idx) else {}
+        _finish_checkpoint(ckpt)
+        ckpt = None
+        return FitResult(params=params, metrics=metrics, history=history)
+    finally:
+        if ckpt is not None:
+            ckpt.close()
+
+
+def _open_checkpoint(cfg: FitConfig):
+    """→ (FitCheckpointer | None, start_epoch). Epoch ``k`` snapshots are
+    taken *after* epoch k runs, so resume starts at latest+1."""
+    if not cfg.checkpoint_dir:
+        return None, 0
+    from dragonfly2_tpu.trainer.checkpoint import FitCheckpointer
+
+    ckpt = FitCheckpointer(cfg.checkpoint_dir)
+    latest = ckpt.latest_epoch()
+    return ckpt, (latest + 1 if latest is not None else 0)
+
+
+def _maybe_save_tree(ckpt, cfg: FitConfig, epoch: int, state) -> None:
+    if ckpt is not None and (epoch + 1) % max(cfg.checkpoint_every, 1) == 0:
+        ckpt.save(epoch, state)
+
+
+def _finish_checkpoint(ckpt) -> None:
+    """Successful completion: drop the run's snapshots (the next round
+    must train fresh, not resume into zero epochs) and release the
+    manager's background resources."""
+    if ckpt is not None:
+        ckpt.clear()
+        ckpt.close()
 
 
 def evaluate_mlp(params, features: np.ndarray, labels: np.ndarray) -> dict[str, float]:
@@ -234,20 +279,34 @@ def train_gnn(
 
     epoch_fn = make_epoch_fn(loss_fn, optimizer)
 
-    history: list[float] = []
-    rng = np.random.default_rng(cfg.seed + 1)
-    for _ in range(cfg.epochs):
-        order = train_idx[rng.permutation(len(train_idx))][:used]
-        sb = graph.edge_src[order].reshape(steps, batch)
-        db = graph.edge_dst[order].reshape(steps, batch)
-        yb = graph.edge_rtt_log_ms[order].reshape(steps, batch)
-        params, opt_state, mean_loss = epoch_fn(params, opt_state, (jnp.asarray(sb), jnp.asarray(db), jnp.asarray(yb)))
-        history.append(float(mean_loss))
+    ckpt, start_epoch = _open_checkpoint(cfg)
+    try:
+        if ckpt is not None and start_epoch > 0:
+            restored = ckpt.restore_latest({"params": params, "opt_state": opt_state})
+            if restored is not None:
+                _, state = restored
+                params, opt_state = state["params"], state["opt_state"]
 
-    metrics: dict[str, float] = {}
-    if len(eval_idx):
-        metrics = evaluate_gnn(params, graph, eval_idx)
-    return FitResult(params=params, metrics=metrics, history=history)
+        history: list[float] = []
+        for epoch in range(start_epoch, cfg.epochs):
+            rng = np.random.default_rng(cfg.seed + 1 + epoch)
+            order = train_idx[rng.permutation(len(train_idx))][:used]
+            sb = graph.edge_src[order].reshape(steps, batch)
+            db = graph.edge_dst[order].reshape(steps, batch)
+            yb = graph.edge_rtt_log_ms[order].reshape(steps, batch)
+            params, opt_state, mean_loss = epoch_fn(params, opt_state, (jnp.asarray(sb), jnp.asarray(db), jnp.asarray(yb)))
+            history.append(float(mean_loss))
+            _maybe_save_tree(ckpt, cfg, epoch, {"params": params, "opt_state": opt_state})
+
+        metrics: dict[str, float] = {}
+        if len(eval_idx):
+            metrics = evaluate_gnn(params, graph, eval_idx)
+        _finish_checkpoint(ckpt)
+        ckpt = None
+        return FitResult(params=params, metrics=metrics, history=history)
+    finally:
+        if ckpt is not None:
+            ckpt.close()
 
 
 def train_gnn_sharded(
@@ -303,10 +362,23 @@ def train_gnn_sharded(
         dense2, embed2 = optax.apply_updates((dense, embed), updates)
         return dense2, embed2, opt_state2, loss
 
+    ckpt, start_epoch = _open_checkpoint(cfg)
+    if ckpt is not None and start_epoch > 0:
+        restored = ckpt.restore_latest(
+            {"dense": dense, "embed": embed, "opt_state": opt_state}
+        )
+        if restored is not None:
+            _, state = restored
+            dense, embed, opt_state = state["dense"], state["embed"], state["opt_state"]
+
     history: list[float] = []
-    for _ in range(cfg.epochs):
+    for epoch in range(start_epoch, cfg.epochs):
         dense, embed, opt_state, loss = step(dense, embed, opt_state)
         history.append(float(loss))
+        _maybe_save_tree(
+            ckpt, cfg, epoch, {"dense": dense, "embed": embed, "opt_state": opt_state}
+        )
+    _finish_checkpoint(ckpt)
 
     metrics: dict[str, float] = {}
     if len(eval_idx):

@@ -55,6 +55,10 @@ class TrainingConfig:
     min_download_records: int = 1
     min_topology_records: int = 1
     clear_after_train: bool = True
+    # incremental rounds: keep dataset files, commit consumed byte offsets
+    # after each successful fit and decode only newly appended uploads
+    # next round (implies clear_after_train=False; needs native decode)
+    incremental: bool = False
 
 
 @dataclass
@@ -101,7 +105,7 @@ class Training:
                 logger.exception("trainGNN failed for %s", host_id)
                 outcome.gnn_error = str(e)
 
-        if self.config.clear_after_train:
+        if self.config.clear_after_train and not self.config.incremental:
             # the reference retrains from scratch each round and drops
             # consumed uploads (trainer/trainer.go:156-161)
             if outcome.mlp_error is None:
@@ -114,7 +118,12 @@ class Training:
     def _train_mlp(self, host_id: str, ip: str, hostname: str) -> dict[str, float]:
         # native fused decode+featurize (1000x the numpy path); fall back
         # to the Python pipeline when the library is unavailable
-        pairs = native.decode_pairs_file(self.storage.download_path(host_id))
+        path = self.storage.download_path(host_id)
+        offset = self.storage.download_offset(host_id) if self.config.incremental else 0
+        # the boundary is marked by the Train service at stream EOF (locked
+        # against appends), so the committed offset never lands mid-record
+        boundary = self.storage.download_round_boundary(host_id)
+        pairs = native.decode_pairs_file(path, offset=offset)
         if pairs is None:
             recs = self.storage.list_download(host_id)
             pairs = extract_pair_features(records_to_columns(recs))
@@ -135,10 +144,17 @@ class Training:
                 params=_to_host(result.params),
                 evaluation=result.metrics,
             )
+        if self.config.incremental:
+            # commit only after a fully successful round (incl. upload) —
+            # a crashed round re-decodes from the previous offset
+            self.storage.commit_download_offset(host_id, boundary)
         return result.metrics
 
     # -- trainGNN (reference training.go:82-88) ---------------------------
     def _train_gnn(self, host_id: str, ip: str, hostname: str) -> dict[str, float]:
+        # the probe graph is cumulative state (EWMA RTT edges), so the GNN
+        # always rebuilds from the whole file — no offset decode here; the
+        # incremental win is on the (much larger) download stream
         graph = native.build_probe_graph_file(
             self.storage.network_topology_path(host_id),
             max_degree=self.config.gnn_max_degree,

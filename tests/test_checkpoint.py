@@ -1,0 +1,124 @@
+"""Trainer checkpoint/resume + resumable ingestion offsets.
+
+The resume contract: an interrupted-and-resumed fit reproduces the
+uninterrupted run (same rng schedule per epoch, state snapshot after
+every epoch); ingestion offsets commit only after a successful round so
+a crash re-decodes from the last commit.
+"""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.schema.columnar import write_csv
+from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
+from dragonfly2_tpu.schema.synth import make_download_records, make_pair_tensors
+from dragonfly2_tpu.trainer.checkpoint import FitCheckpointer, OffsetLedger, params_equal
+from dragonfly2_tpu.trainer.train import FitConfig, train_mlp
+
+
+def test_fit_checkpointer_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    state = {"params": {"w": jnp.arange(6.0).reshape(2, 3)}, "epoch_loss": jnp.float32(1.5)}
+    ckpt = FitCheckpointer(tmp_path / "ckpt")
+    assert ckpt.latest_epoch() is None
+    ckpt.save(0, state)
+    ckpt.save(1, state)
+    assert ckpt.latest_epoch() == 1
+    epoch, restored = ckpt.restore_latest(state)
+    assert epoch == 1
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]), np.arange(6.0).reshape(2, 3))
+    ckpt.close()
+
+
+def test_train_mlp_resume_reproduces_uninterrupted(tmp_path, monkeypatch):
+    from dragonfly2_tpu.trainer import train as T
+
+    x, y = make_pair_tensors(2048, seed=0)
+    base = dict(hidden_dims=(32,), batch_size=256, eval_fraction=0.1, seed=3)
+
+    full = train_mlp(x, y, config=FitConfig(epochs=4, **base))
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg = FitConfig(epochs=4, checkpoint_dir=ckpt_dir, **base)
+
+    # crash the run right after epoch 1's snapshot lands — the LR schedule
+    # and shuffle sequence are those of the full 4-epoch run
+    orig = T._maybe_save_tree
+
+    class Crash(RuntimeError):
+        pass
+
+    def crashing(ckpt, cfg_, epoch, state):
+        orig(ckpt, cfg_, epoch, state)
+        if epoch == 1:
+            raise Crash()
+
+    monkeypatch.setattr(T, "_maybe_save_tree", crashing)
+    with pytest.raises(Crash):
+        train_mlp(x, y, config=cfg)
+    monkeypatch.setattr(T, "_maybe_save_tree", orig)
+
+    resumed = train_mlp(x, y, config=cfg)
+    assert len(resumed.history) == 2  # only epochs 2,3 ran on resume
+    assert params_equal(full.params, resumed.params, atol=1e-6)
+    assert abs(full.metrics["mse"] - resumed.metrics["mse"]) < 1e-5
+
+    # successful completion clears snapshots: the next round trains fresh
+    # instead of resuming into zero epochs and re-uploading stale params
+    fresh = train_mlp(x, y, config=cfg)
+    assert len(fresh.history) == 4
+
+
+def test_offset_ledger_roundtrip(tmp_path):
+    path = tmp_path / "offsets.json"
+    ledger = OffsetLedger(path)
+    assert ledger.get("download_h") == 0
+    ledger.commit("download_h", 1234)
+    assert OffsetLedger(path).get("download_h") == 1234  # persisted
+    ledger.reset("download_h")
+    assert OffsetLedger(path).get("download_h") == 0
+
+
+def test_incremental_round_consumes_only_new_uploads(tmp_path):
+    from dragonfly2_tpu.schema import native
+    from dragonfly2_tpu.trainer.storage import TrainerStorage
+    from dragonfly2_tpu.trainer.training import Training, TrainingConfig
+
+    if not native.available():
+        pytest.skip("incremental decode needs the native library")
+
+    storage = TrainerStorage(tmp_path / "store")
+    cfg = TrainingConfig(
+        mlp=FitConfig(hidden_dims=(16,), epochs=1, batch_size=128),
+        incremental=True,
+    )
+    training = Training(storage, config=cfg)
+
+    def upload(n, seed):
+        src = tmp_path / f"u{seed}.csv"
+        write_csv(src, make_download_records(n, seed=seed))
+        storage.append_download("h", src.read_bytes())
+
+    upload(40, seed=1)
+    training._train_mlp("h", "ip", "host")
+    size1 = storage.download_path("h").stat().st_size
+    assert storage.download_offset("h") == size1  # committed after success
+
+    # second round: only the new upload's records are decoded
+    upload(25, seed=2)
+    pairs = native.decode_pairs_file(
+        storage.download_path("h"), offset=storage.download_offset("h")
+    )
+    assert pairs.num_downloads == 25
+
+    training._train_mlp("h", "ip", "host")
+    assert storage.download_offset("h") == storage.download_path("h").stat().st_size
+
+    # a third round with nothing new fails the min-records gate
+    with pytest.raises(ValueError, match="< min"):
+        training._train_mlp("h", "ip", "host")
+
+    # clearing drops the offset with the file
+    storage.clear_download("h")
+    assert storage.download_offset("h") == 0

@@ -151,9 +151,9 @@ def test_training_uses_native(tmp_path, monkeypatch):
     called = {}
     orig = native.decode_pairs_file
 
-    def spy(path):
+    def spy(path, offset=0):
         called["path"] = str(path)
-        return orig(path)
+        return orig(path, offset=offset)
 
     monkeypatch.setattr(native, "decode_pairs_file", spy)
     training = Training(
