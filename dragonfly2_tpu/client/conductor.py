@@ -77,6 +77,7 @@ class PeerTaskConductor:
         options: ConductorOptions | None = None,
         task_type: int = 0,
         headers: dict | None = None,
+        need_back_to_source: bool = False,
         on_done=None,
     ):
         self.task_id = task_id
@@ -90,6 +91,7 @@ class PeerTaskConductor:
         self.opts = options or ConductorOptions()
         self.task_type = task_type
         self.headers = headers or {}
+        self.need_back_to_source = need_back_to_source
         self.on_done = on_done
 
         self.ts = storage.register_task(
@@ -197,7 +199,7 @@ class PeerTaskConductor:
                     url=self.url,
                     url_meta=self.url_meta,
                     task_type=self.task_type,
-                    need_back_to_source=False,
+                    need_back_to_source=self.need_back_to_source,
                 )
             )
             self._drive()

@@ -30,6 +30,9 @@ class FileTaskRequest:
     output: str = ""  # empty = leave in the piece store (stream use)
     url_meta: common_pb2.UrlMeta | None = None
     disable_back_source: bool = False
+    # origin-first: tell the scheduler to send this peer straight to the
+    # source (seed-trigger path, reference seed_peer.go ObtainSeeds)
+    need_back_to_source: bool = False
     task_type: int = 0
     headers: dict | None = None
 
@@ -98,6 +101,7 @@ class TaskManager:
                 options=opts,
                 task_type=req.task_type,
                 headers=req.headers,
+                need_back_to_source=req.need_back_to_source,
                 on_done=self._forget,
             )
             self.conductors[task_id] = conductor

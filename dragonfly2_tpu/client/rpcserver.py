@@ -47,6 +47,7 @@ class DfdaemonService:
             output=request.output,
             url_meta=request.url_meta,
             disable_back_source=request.disable_back_source,
+            need_back_to_source=request.need_back_to_source,
         )
         task_id, peer_id, conductor = self.tasks.start_file_task(req)
         if conductor is None:  # reuse path — start_file_task already stored

@@ -78,6 +78,17 @@ CREATE TABLE IF NOT EXISTS models (
   created_at REAL NOT NULL,
   UNIQUE(model_id, version)
 );
+CREATE TABLE IF NOT EXISTS jobs (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  type TEXT NOT NULL,
+  state TEXT NOT NULL DEFAULT 'queued',
+  args TEXT NOT NULL DEFAULT '{}',
+  result TEXT NOT NULL DEFAULT '{}',
+  scheduler_cluster_id INTEGER NOT NULL DEFAULT 0,
+  leased_by TEXT NOT NULL DEFAULT '',
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL
+);
 CREATE TABLE IF NOT EXISTS applications (
   id INTEGER PRIMARY KEY AUTOINCREMENT,
   name TEXT UNIQUE NOT NULL,
@@ -101,6 +112,11 @@ class Database:
             cur = self._conn.execute(sql, params)
             self._conn.commit()
             return cur
+
+    def transaction(self):
+        """Hold the DB lock across several statements (e.g. job leasing's
+        select-then-update must be atomic against other workers)."""
+        return self._lock
 
     def query(self, sql: str, params: tuple = ()) -> list[dict[str, Any]]:
         with self._lock:

@@ -163,6 +163,84 @@ class ManagerService:
             json=r["config"],
         )
 
+    # -- async jobs (manager is the queue of record; scheduler workers
+    # poll ListPendingJobs — reference internal/job machinery on Redis) --
+    def CreateJob(self, request, context):
+        if request.type not in ("preheat", "sync_peers"):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"unknown job type {request.type}")
+        now = time.time()
+        cur = self.db.execute(
+            "INSERT INTO jobs (type, state, args, scheduler_cluster_id, created_at, updated_at)"
+            " VALUES (?, 'queued', ?, ?, ?, ?)",
+            (
+                request.type,
+                request.args_json or "{}",
+                request.scheduler_cluster_id or self.default_cluster_id,
+                now,
+                now,
+            ),
+        )
+        return self._job(self.db.query_one("SELECT * FROM jobs WHERE id = ?", (cur.lastrowid,)))
+
+    def GetJob(self, request, context):
+        r = self.db.query_one("SELECT * FROM jobs WHERE id = ?", (request.id,))
+        if r is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"job {request.id} not found")
+        return self._job(r)
+
+    # a worker that leased a job but never posted a result is presumed
+    # dead after this long; the job is re-leased to the next poller
+    JOB_LEASE_TIMEOUT = 300.0
+
+    def ListPendingJobs(self, request, context):
+        """Lease queued jobs (and expired running leases) to the polling
+        worker atomically so two workers can't both execute one."""
+        cluster_id = request.scheduler_cluster_id or self.default_cluster_id
+        worker = f"{request.ip}_{request.hostname}"
+        now = time.time()
+        stale = now - self.JOB_LEASE_TIMEOUT
+        with self.db.transaction():
+            rows = self.db.query(
+                "SELECT * FROM jobs WHERE scheduler_cluster_id = ? AND"
+                " (state = 'queued' OR (state = 'running' AND updated_at < ?))"
+                " ORDER BY id LIMIT 16",
+                (cluster_id, stale),
+            )
+            if rows:
+                ids = [r["id"] for r in rows]
+                self.db.execute(
+                    "UPDATE jobs SET state = 'running', leased_by = ?, updated_at = ?"
+                    f" WHERE id IN ({','.join('?' * len(ids))})",
+                    (worker, now, *ids),
+                )
+                for r in rows:
+                    r["state"] = "running"
+        return manager_pb2.ListPendingJobsResponse(jobs=[self._job(r) for r in rows])
+
+    def UpdateJobResult(self, request, context):
+        if request.state not in ("succeeded", "failed"):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad job state {request.state}")
+        self.db.execute(
+            "UPDATE jobs SET state = ?, result = ?, updated_at = ? WHERE id = ?",
+            (request.state, request.result_json or "{}", time.time(), request.id),
+        )
+        r = self.db.query_one("SELECT * FROM jobs WHERE id = ?", (request.id,))
+        if r is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"job {request.id} not found")
+        return self._job(r)
+
+    @staticmethod
+    def _job(r) -> manager_pb2.Job:
+        return manager_pb2.Job(
+            id=r["id"],
+            type=r["type"],
+            state=r["state"],
+            args_json=r["args"],
+            result_json=r["result"],
+            scheduler_cluster_id=r["scheduler_cluster_id"],
+            created_at_ns=int(r["created_at"] * 1e9),
+        )
+
     # -- model registry ----------------------------------------------------
     def CreateModel(self, request, context):
         evaluation = {

@@ -79,6 +79,14 @@ SERVICES: dict[str, dict[str, Method]] = {
             manager_pb2.GetSchedulerClusterConfigRequest,
             manager_pb2.SchedulerClusterConfig,
         ),
+        "CreateJob": Method(UNARY, manager_pb2.CreateJobRequest, manager_pb2.Job),
+        "GetJob": Method(UNARY, manager_pb2.GetJobRequest, manager_pb2.Job),
+        "ListPendingJobs": Method(
+            UNARY, manager_pb2.ListPendingJobsRequest, manager_pb2.ListPendingJobsResponse
+        ),
+        "UpdateJobResult": Method(
+            UNARY, manager_pb2.UpdateJobResultRequest, manager_pb2.Job
+        ),
         "CreateModel": Method(UNARY, manager_pb2.CreateModelRequest, manager_pb2.Model),
         "GetModel": Method(UNARY, manager_pb2.GetModelRequest, manager_pb2.Model),
         "ListModels": Method(UNARY, manager_pb2.ListModelsRequest, manager_pb2.ListModelsResponse),

@@ -1,0 +1,138 @@
+"""Scheduler job worker: executes manager-queued async jobs.
+
+Role parity: reference scheduler/job/job.go — a machinery (Redis) worker
+consuming `preheat` (:109-152, trigger a seed-peer download of each URL)
+and `syncPeers` (:224, report the live peer/host view to the manager).
+Here the manager itself is the queue of record and the worker leases jobs
+over gRPC (ListPendingJobs → execute → UpdateJobResult), so no Redis
+deployment is required for the job plane.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import manager_pb2  # noqa: E402
+
+from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils.idgen import task_id_v1, URLMeta
+
+logger = dflog.get("scheduler.job")
+
+DEFAULT_POLL_INTERVAL = 5.0
+
+
+class JobWorker:
+    def __init__(
+        self,
+        manager_client,  # glue.ServiceClient of the manager service
+        resource,
+        seed_client=None,  # resource.seed_peer.SeedPeerClient
+        hostname: str = "",
+        ip: str = "",
+        cluster_id: int = 0,
+        poll_interval: float = DEFAULT_POLL_INTERVAL,
+    ):
+        self.manager = manager_client
+        self.resource = resource
+        self.seed_client = seed_client
+        self.hostname = hostname
+        self.ip = ip
+        self.cluster_id = cluster_id
+        self.poll_interval = poll_interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, name="job-worker", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.poll_once()
+            except Exception as e:
+                logger.warning("job poll failed: %s", e)
+
+    # ------------------------------------------------------------------
+    def poll_once(self) -> int:
+        """Lease pending jobs from the manager and execute them; returns
+        the number executed (also the test entrypoint)."""
+        resp = self.manager.ListPendingJobs(
+            manager_pb2.ListPendingJobsRequest(
+                hostname=self.hostname, ip=self.ip, scheduler_cluster_id=self.cluster_id
+            )
+        )
+        for job in resp.jobs:
+            state, result = self._execute(job)
+            try:
+                self.manager.UpdateJobResult(
+                    manager_pb2.UpdateJobResultRequest(
+                        id=job.id, state=state, result_json=json.dumps(result)
+                    )
+                )
+            except Exception as e:
+                # one failed result post must not strand the rest of the
+                # leased batch; the manager's lease timeout re-queues this
+                # job for a later worker
+                logger.warning("posting result for job %d failed: %s", job.id, e)
+        return len(resp.jobs)
+
+    def _execute(self, job) -> tuple[str, dict]:
+        try:
+            args = json.loads(job.args_json or "{}")
+        except json.JSONDecodeError as e:
+            return "failed", {"error": f"bad args: {e}"}
+        try:
+            if job.type == "preheat":
+                return self._preheat(args)
+            if job.type == "sync_peers":
+                return self._sync_peers(args)
+            return "failed", {"error": f"unknown job type {job.type}"}
+        except Exception as e:  # job errors must not kill the worker
+            logger.exception("job %d (%s) failed", job.id, job.type)
+            return "failed", {"error": str(e)}
+
+    # -- preheat (reference scheduler/job preheat → seed download) ------
+    def _preheat(self, args: dict) -> tuple[str, dict]:
+        urls = args.get("urls") or ([args["url"]] if args.get("url") else [])
+        if not urls:
+            return "failed", {"error": "preheat needs urls"}
+        if self.seed_client is None or not self.seed_client.seed_hosts():
+            return "failed", {"error": "no seed peers available"}
+        tag = args.get("tag", "")
+        application = args.get("application", "")
+        triggered = []
+        for url in urls:
+            task_id = task_id_v1(url, URLMeta(tag=tag, application=application))
+            if self.seed_client.trigger(task_id, url, tag=tag, application=application):
+                triggered.append(task_id)
+        return "succeeded", {"triggered": triggered, "count": len(triggered)}
+
+    # -- sync_peers (reference scheduler/job syncPeers) -----------------
+    def _sync_peers(self, args: dict) -> tuple[str, dict]:
+        hosts = []
+        for h in self.resource.host_manager.all():
+            hosts.append(
+                {
+                    "id": h.id,
+                    "hostname": h.hostname,
+                    "ip": h.ip,
+                    "type": h.type.value,
+                    "peer_count": h.peer_count(),
+                    "upload_count": h.upload_count,
+                }
+            )
+        peers = [
+            {"id": p.id, "task_id": p.task.id, "state": p.fsm.current}
+            for p in self.resource.peer_manager.all()
+        ]
+        return "succeeded", {"hosts": hosts, "peers": peers}

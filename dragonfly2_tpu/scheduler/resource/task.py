@@ -96,6 +96,7 @@ class Task:
         tag: str = "",
         application: str = "",
         filters: list[str] | None = None,
+        url_range: str = "",
         headers: dict[str, str] | None = None,
         piece_length: int = 4 * 1024 * 1024,
         back_to_source_limit: int = 3,
@@ -107,6 +108,7 @@ class Task:
         self.tag = tag
         self.application = application
         self.filters = filters or []
+        self.url_range = url_range
         self.headers = headers or {}
         self.piece_length = piece_length
         self.content_length = -1

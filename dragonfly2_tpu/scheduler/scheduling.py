@@ -72,10 +72,12 @@ class Scheduling:
         evaluator: Evaluator,
         config: SchedulingConfig | None = None,
         dynconfig=None,  # optional provider of live candidate/filter limits
+        seed_client=None,  # optional resource.seed_peer.SeedPeerClient
     ):
         self.evaluator = evaluator
         self.config = config or SchedulingConfig()
         self.dynconfig = dynconfig
+        self.seed_client = seed_client
 
     # -- limits (dynconfig-overridable, reference scheduling.go:405-413) --
     def _candidate_parent_limit(self) -> int:
@@ -106,13 +108,24 @@ class Scheduling:
             if cancelled is not None and cancelled():
                 return
 
-            if peer.task.can_back_to_source():
-                if peer.need_back_to_source:
-                    self._send(
-                        peer,
-                        NeedBackToSourceResponse("peer's NeedBackToSource is true"),
-                    )
-                    return
+            # while a seed download is in flight for this task, don't send
+            # the child to the origin and don't burn its retry budget — the
+            # whole point of the seed is that origin traffic happens once
+            seeding = (
+                self.seed_client is not None
+                and self.seed_client.is_inflight(peer.task.id)
+            )
+
+            # explicit demand wins even while seeding — the demanding peer
+            # IS the seed (its registration carries need_back_to_source)
+            if peer.need_back_to_source and peer.task.can_back_to_source():
+                self._send(
+                    peer,
+                    NeedBackToSourceResponse("peer's NeedBackToSource is true"),
+                )
+                return
+
+            if not seeding and peer.task.can_back_to_source():
                 if n >= self.config.retry_back_to_source_limit:
                     self._send(
                         peer,
@@ -122,7 +135,7 @@ class Scheduling:
                     )
                     return
 
-            if n >= self.config.retry_limit:
+            if not seeding and n >= self.config.retry_limit:
                 raise SchedulingError(
                     f"scheduling exceeded RetryLimit {self.config.retry_limit}"
                 )
@@ -132,6 +145,23 @@ class Scheduling:
 
             candidate_parents, found = self.find_candidate_parents(peer, blocklist)
             if not found:
+                if n == 0 and self.seed_client is not None:
+                    # cold task with no feedable parents: ask a seed peer
+                    # to fetch it (reference seed_peer.go:92-213 trigger);
+                    # the retry loop then finds the seed as first parent.
+                    # The full UrlMeta rides along — filter/range are part
+                    # of the task id, so dropping them would make the seed
+                    # register a different task entirely
+                    task = peer.task
+                    self.seed_client.trigger(
+                        task.id,
+                        task.url,
+                        tag=task.tag,
+                        application=task.application,
+                        digest=task.digest,
+                        url_filter="&".join(task.filters),
+                        url_range=task.url_range,
+                    )
                 n += 1
                 time.sleep(self.config.retry_interval)
                 continue

@@ -249,6 +249,8 @@ class SchedulerService:
             task = res.Task(
                 task_id, url=reg.url, task_type=task_type,
                 digest=meta.digest, tag=meta.tag, application=meta.application,
+                filters=[f for f in meta.filter.split("&") if f] if meta.filter else [],
+                url_range=meta.range,
             )
             self.resource.task_manager.store(task)
 

@@ -1,0 +1,210 @@
+"""Seed-peer mode + async jobs (preheat / sync_peers).
+
+Seed flow (reference scheduler/resource/seed_peer.go): a cold task with
+no parents triggers a seed download on a seed-type host; waiting children
+then pull from the seed over P2P without touching the origin themselves.
+
+Job flow (reference internal/job + scheduler/job): manager queues jobs,
+the scheduler worker leases them over gRPC, executes, posts results.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from dragonfly2_tpu.client import dfget
+from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+from dragonfly2_tpu.client.piece_manager import TRAFFIC_REMOTE_PEER
+from dragonfly2_tpu.manager.database import Database
+from dragonfly2_tpu.manager.models_registry import ModelRegistry
+from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+from dragonfly2_tpu.manager.service import ManagerService
+from dragonfly2_tpu.rpc import glue
+from dragonfly2_tpu.rpc.glue import MANAGER_SERVICE, SCHEDULER_SERVICE, serve
+from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.job import JobWorker
+from dragonfly2_tpu.scheduler.resource.seed_peer import SeedPeerClient
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import SchedulerService
+from dragonfly2_tpu.scheduler.storage import Storage
+
+import manager_pb2  # noqa: E402
+
+PIECE = 32 * 1024
+PAYLOAD = os.urandom(3 * PIECE)
+
+
+@pytest.fixture
+def seed_cluster(tmp_path):
+    """Scheduler (seed-aware) + a seed daemon + a normal daemon."""
+    resource = res.Resource()
+    seed_client = SeedPeerClient(resource.host_manager)
+    storage = Storage(tmp_path / "sched", buffer_size=1)
+    service = SchedulerService(
+        resource,
+        Scheduling(
+            BaseEvaluator(),
+            # keep retrying while the seed downloads; never push the child
+            # to the origin
+            SchedulingConfig(
+                retry_interval=0.1, retry_limit=100, retry_back_to_source_limit=100
+            ),
+            seed_client=seed_client,
+        ),
+        storage=storage,
+    )
+    server, port = serve({SCHEDULER_SERVICE: service})
+    sched_addr = f"127.0.0.1:{port}"
+
+    daemons = {}
+    for name, host_type in (("seed", "super"), ("child", "normal")):
+        d = Daemon(
+            DaemonConfig(
+                data_dir=str(tmp_path / f"daemon-{name}"),
+                scheduler_address=sched_addr,
+                hostname=f"host-{name}",
+                ip="127.0.0.1",
+                host_type=host_type,
+                piece_length=PIECE,
+                schedule_timeout=20.0,
+                announce_interval=60.0,
+            )
+        )
+        d.start()
+        daemons[name] = d
+
+    origin = tmp_path / "origin.bin"
+    origin.write_bytes(PAYLOAD)
+
+    yield {
+        "resource": resource,
+        "seed_client": seed_client,
+        "daemons": daemons,
+        "url": f"file://{origin}",
+        "tmp": tmp_path,
+    }
+    for d in daemons.values():
+        d.stop()
+    server.stop(0)
+
+
+def test_cold_task_is_seeded_not_back_to_source(seed_cluster):
+    """Child downloads a cold task: the seed fetches the origin, the
+    child pulls everything from the seed over P2P."""
+    child = seed_cluster["daemons"]["child"]
+    seed = seed_cluster["daemons"]["seed"]
+    url = seed_cluster["url"]
+    out = seed_cluster["tmp"] / "out.bin"
+
+    assert len(seed_cluster["seed_client"].seed_hosts()) == 1
+
+    dfget.download(f"127.0.0.1:{child.port}", url, str(out))
+    assert out.read_bytes() == PAYLOAD
+
+    task_id = child.task_manager.task_id_for(url, None)
+    ts_child = child.storage.find_completed_task(task_id)
+    traffic = {p.traffic_type for p in ts_child.meta.pieces.values()}
+    assert traffic == {TRAFFIC_REMOTE_PEER}, f"child must not hit origin, got {traffic}"
+
+    ts_seed = seed.storage.find_completed_task(task_id)
+    assert ts_seed is not None, "seed daemon must hold the task"
+    parents = {p.parent_id for p in ts_child.meta.pieces.values()}
+    assert parents == {ts_seed.meta.peer_id}
+
+
+@pytest.fixture
+def manager_env(tmp_path):
+    db = Database(tmp_path / "manager.db")
+    cluster_id = db.ensure_default_cluster()
+    models = ModelRegistry(db, FSObjectStorage(tmp_path / "objects"))
+    service = ManagerService(db, models)
+    server, port = serve({MANAGER_SERVICE: service})
+    channel = glue.dial(f"127.0.0.1:{port}")
+    client = glue.ServiceClient(channel, MANAGER_SERVICE)
+    yield {"client": client, "db": db, "cluster_id": cluster_id}
+    channel.close()
+    server.stop(0)
+
+
+def test_job_queue_roundtrip(manager_env):
+    client = manager_env["client"]
+    job = client.CreateJob(
+        manager_pb2.CreateJobRequest(type="sync_peers", args_json="{}")
+    )
+    assert job.state == "queued"
+
+    resource = res.Resource()
+    resource.host_manager.store(res.Host(id="h1", hostname="a", ip="1.2.3.4"))
+    worker = JobWorker(client, resource, hostname="sched", ip="127.0.0.1")
+    n = worker.poll_once()
+    assert n == 1
+
+    done = client.GetJob(manager_pb2.GetJobRequest(id=job.id))
+    assert done.state == "succeeded"
+    result = json.loads(done.result_json)
+    assert result["hosts"][0]["id"] == "h1"
+
+    # leased jobs aren't handed out twice
+    assert worker.poll_once() == 0
+
+
+def test_preheat_job_triggers_seed(manager_env, seed_cluster):
+    client = manager_env["client"]
+    url = seed_cluster["url"]
+    job = client.CreateJob(
+        manager_pb2.CreateJobRequest(
+            type="preheat", args_json=json.dumps({"urls": [url]})
+        )
+    )
+    worker = JobWorker(
+        client,
+        seed_cluster["resource"],
+        seed_client=seed_cluster["seed_client"],
+        hostname="sched",
+        ip="127.0.0.1",
+    )
+    assert worker.poll_once() == 1
+    done = client.GetJob(manager_pb2.GetJobRequest(id=job.id))
+    assert done.state == "succeeded"
+    assert json.loads(done.result_json)["count"] == 1
+
+    # the seed daemon ends up holding the task without any child download
+    seed = seed_cluster["daemons"]["seed"]
+    task_id = seed.task_manager.task_id_for(url, None)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if seed.storage.find_completed_task(task_id) is not None:
+            break
+        time.sleep(0.2)
+    ts = seed.storage.find_completed_task(task_id)
+    assert ts is not None and len(ts.meta.pieces) == 3
+
+
+def test_unknown_job_type_rejected(manager_env):
+    import grpc
+
+    with pytest.raises(grpc.RpcError):
+        manager_env["client"].CreateJob(manager_pb2.CreateJobRequest(type="nope"))
+
+
+def test_preheat_without_seeds_fails(manager_env):
+    client = manager_env["client"]
+    job = client.CreateJob(
+        manager_pb2.CreateJobRequest(
+            type="preheat", args_json=json.dumps({"urls": ["file:///x"]})
+        )
+    )
+    worker = JobWorker(
+        client,
+        res.Resource(),
+        seed_client=SeedPeerClient(res.Resource().host_manager),
+        hostname="s",
+        ip="1.1.1.1",
+    )
+    worker.poll_once()
+    done = client.GetJob(manager_pb2.GetJobRequest(id=job.id))
+    assert done.state == "failed"
+    assert "no seed peers" in json.loads(done.result_json)["error"]
