@@ -55,6 +55,12 @@ class DaemonConfig:
     schedule_timeout: float = 10.0
     concurrent_upload_limit: int = 50
     scheduler_cluster_id: int = 1
+    # HTTP proxy (registry acceleration): -1 = disabled, 0 = ephemeral
+    # port; rules are transport.ProxyRule instances or kwargs dicts
+    # ({"regex": ..., "direct": ..., "use_https": ..., "redirect": ...})
+    proxy_port: int = -1
+    proxy_rules: list = field(default_factory=list)
+    registry_mirror: str = ""
 
 
 class Daemon:
@@ -76,6 +82,7 @@ class Daemon:
         self._threads: list[threading.Thread] = []
         self.gc = GC()
         self.task_manager: TaskManager | None = None
+        self.proxy = None
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -103,6 +110,21 @@ class Daemon:
             {DFDAEMON_SERVICE: service}, address=self.cfg.listen
         )
 
+        if self.cfg.proxy_port >= 0:
+            from dragonfly2_tpu.client.proxy import ProxyServer, RegistryMirror
+            from dragonfly2_tpu.client.transport import P2PTransport, ProxyRule
+
+            rules = [
+                r if isinstance(r, ProxyRule) else ProxyRule(**r)
+                for r in self.cfg.proxy_rules
+            ]
+            self.proxy = ProxyServer(
+                P2PTransport(self.task_manager, rules=rules),
+                mirror=RegistryMirror(self.cfg.registry_mirror),
+                port=self.cfg.proxy_port,
+            )
+            self.proxy.start()
+
         self.announce_host()
         self._spawn(self._announce_loop, "announcer")
         if self.cfg.probe_interval > 0:
@@ -128,6 +150,8 @@ class Daemon:
         except Exception:
             pass
         self.gc.stop()
+        if self.proxy is not None:
+            self.proxy.stop()
         if self._server is not None:
             self._server.stop(grace=1).wait()
         self.upload.stop()

@@ -1,0 +1,184 @@
+"""HTTP proxy with P2P redirection — registry/artifact acceleration.
+
+Role parity: reference client/daemon/proxy/proxy.go:268-766 — an HTTP
+proxy in front of container registries / artifact stores: plain-HTTP
+requests matching the configured rules are converted into peer tasks
+(P2P swarm with back-to-source), everything else passes through;
+``CONNECT`` is tunneled raw (the reference can also MITM TLS with a
+spoofed CA — here CONNECT bytes are relayed opaquely, so HTTPS rules
+belong on the registry-mirror path instead). A registry mirror rewrites
+request URLs onto the mirror remote before routing, which is how blob
+and layer GETs become shared P2P downloads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import select
+import socket
+import threading
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlsplit, urlunsplit
+
+from dragonfly2_tpu.client.transport import P2PTransport, ProxyRule
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("client.proxy")
+
+_HOP_HEADERS = {
+    "connection",
+    "proxy-connection",
+    "keep-alive",
+    "te",
+    "trailers",
+    "transfer-encoding",
+    "upgrade",
+    "host",
+}
+
+
+@dataclass
+class RegistryMirror:
+    """Rewrites proxied registry requests onto a mirror remote
+    (reference proxy config registryMirror.url)."""
+
+    remote: str = ""  # e.g. "https://mirror.example.com"
+
+    def rewrite(self, url: str) -> str:
+        if not self.remote:
+            return url
+        remote = urlsplit(self.remote)
+        parts = urlsplit(url)
+        return urlunsplit(
+            (remote.scheme, remote.netloc, parts.path, parts.query, parts.fragment)
+        )
+
+
+class ProxyServer:
+    """Threaded HTTP proxy; GETs matching the transport's rules ride P2P."""
+
+    def __init__(
+        self,
+        transport: P2PTransport,
+        mirror: RegistryMirror | None = None,
+        address: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.transport = transport
+        self.mirror = mirror or RegistryMirror()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route into our logger
+                logger.debug("proxy: " + fmt, *args)
+
+            def do_GET(self):
+                outer._handle_get(self)
+
+            def do_HEAD(self):
+                outer._handle_get(self, head=True)
+
+            def do_CONNECT(self):
+                outer._handle_connect(self)
+
+        self._server = ThreadingHTTPServer((address, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="proxy", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------------
+    def _handle_get(self, handler: BaseHTTPRequestHandler, head: bool = False) -> None:
+        url = handler.path
+        if not url.startswith(("http://", "https://")):
+            # non-absolute URI: treat as mirror-relative (registry mirror
+            # mode fronting one remote)
+            if not self.mirror.remote:
+                handler.send_error(400, "absolute URI required")
+                return
+            url = self.mirror.remote.rstrip("/") + url
+        else:
+            url = self.mirror.rewrite(url)
+
+        headers = {
+            k: v for k, v in handler.headers.items() if k.lower() not in _HOP_HEADERS
+        }
+        try:
+            result = self.transport.round_trip(url, headers, head=head)
+        except Exception as e:
+            handler.send_error(502, f"upstream fetch failed: {e}")
+            return
+        handler.send_response(result.status)
+        # forward upstream headers (Content-Type matters to registry
+        # clients); hop-by-hop and length/encoding are re-derived here
+        for k, v in result.headers.items():
+            if k.lower() not in _HOP_HEADERS and k.lower() not in (
+                "content-length",
+                "content-encoding",
+            ):
+                handler.send_header(k, v)
+        if result.content_length >= 0:
+            handler.send_header("Content-Length", str(result.content_length))
+        else:
+            # unknown length: fall back to buffering this response
+            body = result.read_all()
+            result = dataclasses.replace(
+                result, body=iter([body]), content_length=len(body)
+            )
+            handler.send_header("Content-Length", str(len(body)))
+        handler.send_header("X-Dragonfly-Via-P2P", "1" if result.via_p2p else "0")
+        if result.task_id:
+            handler.send_header("X-Dragonfly-Task-Id", result.task_id)
+        handler.end_headers()
+        if not head:
+            # stream chunk-by-chunk — a multi-GB layer must not be
+            # buffered whole per request
+            for chunk in result.body:
+                handler.wfile.write(chunk)
+
+    # ------------------------------------------------------------------
+    def _handle_connect(self, handler: BaseHTTPRequestHandler) -> None:
+        """Opaque CONNECT tunnel: relay bytes both ways until either side
+        closes (no TLS interception)."""
+        try:
+            host, _, port_s = handler.path.partition(":")
+            upstream = socket.create_connection((host, int(port_s or 443)), timeout=10)
+        except OSError as e:
+            handler.send_error(502, f"CONNECT failed: {e}")
+            return
+        handler.send_response(200, "Connection Established")
+        handler.end_headers()
+        client = handler.connection
+        try:
+            self._relay(client, upstream)
+        finally:
+            upstream.close()
+
+    @staticmethod
+    def _relay(a: socket.socket, b: socket.socket) -> None:
+        sockets = [a, b]
+        while True:
+            readable, _, _ = select.select(sockets, [], [], 60)
+            if not readable:
+                return  # idle timeout
+            for s in readable:
+                try:
+                    data = s.recv(65536)
+                except OSError:
+                    return
+                if not data:
+                    return
+                (b if s is a else a).sendall(data)

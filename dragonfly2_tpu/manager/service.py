@@ -220,13 +220,22 @@ class ManagerService:
     def UpdateJobResult(self, request, context):
         if request.state not in ("succeeded", "failed"):
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad job state {request.state}")
-        self.db.execute(
-            "UPDATE jobs SET state = ?, result = ?, updated_at = ? WHERE id = ?",
-            (request.state, request.result_json or "{}", time.time(), request.id),
+        worker = f"{request.ip}_{request.hostname}"
+        cur = self.db.execute(
+            "UPDATE jobs SET state = ?, result = ?, updated_at = ?"
+            " WHERE id = ? AND state = 'running' AND leased_by = ?",
+            (request.state, request.result_json or "{}", time.time(), request.id, worker),
         )
         r = self.db.query_one("SELECT * FROM jobs WHERE id = ?", (request.id,))
         if r is None:
             context.abort(grpc.StatusCode.NOT_FOUND, f"job {request.id} not found")
+        if cur.rowcount == 0:
+            # lease lost (timed out and re-leased) — the poster's result
+            # is stale; report the authoritative row instead of writing
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"job {request.id} lease not held by {worker} (state {r['state']})",
+            )
         return self._job(r)
 
     @staticmethod

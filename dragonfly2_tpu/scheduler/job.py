@@ -76,7 +76,11 @@ class JobWorker:
             try:
                 self.manager.UpdateJobResult(
                     manager_pb2.UpdateJobResultRequest(
-                        id=job.id, state=state, result_json=json.dumps(result)
+                        id=job.id,
+                        state=state,
+                        result_json=json.dumps(result),
+                        hostname=self.hostname,
+                        ip=self.ip,
                     )
                 )
             except Exception as e:
@@ -110,10 +114,30 @@ class JobWorker:
             return "failed", {"error": "no seed peers available"}
         tag = args.get("tag", "")
         application = args.get("application", "")
+        url_filter = args.get("filter", "")
+        url_range = args.get("range", "")
+        digest = args.get("digest", "")
         triggered = []
         for url in urls:
-            task_id = task_id_v1(url, URLMeta(tag=tag, application=application))
-            if self.seed_client.trigger(task_id, url, tag=tag, application=application):
+            # the full meta participates in the task id — a preheat that
+            # dropped filter/range would seed a task no client ever matches
+            meta = URLMeta(
+                tag=tag,
+                application=application,
+                filter=url_filter,
+                range=url_range,
+                digest=digest,
+            )
+            task_id = task_id_v1(url, meta)
+            if self.seed_client.trigger(
+                task_id,
+                url,
+                tag=tag,
+                application=application,
+                digest=digest,
+                url_filter=url_filter,
+                url_range=url_range,
+            ):
                 triggered.append(task_id)
         return "succeeded", {"triggered": triggered, "count": len(triggered)}
 

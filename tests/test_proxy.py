@@ -1,0 +1,221 @@
+"""Proxy + transport: registry acceleration through the P2P pipeline.
+
+Requests matching proxy rules must ride peer tasks (and be shared across
+daemons); non-matching requests pass through directly; the registry
+mirror rewrites mirror-relative paths onto the remote.
+"""
+
+import http.server
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+from dragonfly2_tpu.client.piece_manager import TRAFFIC_REMOTE_PEER
+from dragonfly2_tpu.client.transport import P2PTransport, ProxyRule, TransportResult
+from dragonfly2_tpu.rpc.glue import SCHEDULER_SERVICE, serve
+from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import SchedulerService
+from dragonfly2_tpu.scheduler.storage import Storage
+
+PIECE = 32 * 1024
+BLOB = os.urandom(2 * PIECE + 100)
+
+
+@pytest.fixture
+def origin_server(tmp_path):
+    """Tiny HTTP origin standing in for a registry blob store."""
+    root = tmp_path / "www"
+    root.mkdir()
+    (root / "blob.bin").write_bytes(BLOB)
+    (root / "manifest.json").write_bytes(b'{"layers": []}')
+
+    class Handler(http.server.SimpleHTTPRequestHandler):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, directory=str(root), **kw)
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            # minimal Range support (SimpleHTTPRequestHandler ignores it)
+            rng = self.headers.get("Range", "")
+            path = root / self.path.lstrip("/")
+            if rng.startswith("bytes=") and path.is_file():
+                start_s, _, end_s = rng[6:].partition("-")
+                data = path.read_bytes()
+                start = int(start_s or 0)
+                end = int(end_s) if end_s else len(data) - 1
+                chunk = data[start : end + 1]
+                self.send_response(206)
+                self.send_header("Content-Length", str(len(chunk)))
+                self.send_header(
+                    "Content-Range", f"bytes {start}-{end}/{len(data)}"
+                )
+                self.end_headers()
+                self.wfile.write(chunk)
+                return
+            super().do_GET()
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+    server.server_close()
+
+
+@pytest.fixture
+def proxy_cluster(tmp_path, origin_server):
+    resource = res.Resource()
+    storage = Storage(tmp_path / "sched", buffer_size=1)
+    service = SchedulerService(
+        resource,
+        Scheduling(
+            BaseEvaluator(),
+            SchedulingConfig(retry_interval=0.0, retry_back_to_source_limit=1),
+        ),
+        storage=storage,
+    )
+    server, port = serve({SCHEDULER_SERVICE: service})
+    daemons = []
+    for name in ("a", "b"):
+        d = Daemon(
+            DaemonConfig(
+                data_dir=str(tmp_path / f"daemon-{name}"),
+                scheduler_address=f"127.0.0.1:{port}",
+                hostname=f"host-{name}",
+                ip="127.0.0.1",
+                piece_length=PIECE,
+                schedule_timeout=5.0,
+                announce_interval=60.0,
+                proxy_port=0,
+                proxy_rules=[{"regex": r"blob\.bin"}],
+            )
+        )
+        d.start()
+        daemons.append(d)
+    yield {"daemons": daemons, "origin": origin_server}
+    for d in daemons:
+        d.stop()
+    server.stop(0)
+
+
+def _proxy_get(proxy_port: int, url: str):
+    req = urllib.request.Request(url)
+    req.set_proxy(f"127.0.0.1:{proxy_port}", "http")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.read(), dict(resp.headers)
+
+
+def test_matching_request_rides_p2p(proxy_cluster):
+    da, db = proxy_cluster["daemons"]
+    url = proxy_cluster["origin"] + "/blob.bin"
+
+    body, headers = _proxy_get(da.proxy.port, url)
+    assert body == BLOB
+    assert headers["X-Dragonfly-Via-P2P"] == "1"
+
+    # second daemon's proxy shares the swarm: its pieces come from A
+    body_b, headers_b = _proxy_get(db.proxy.port, url)
+    assert body_b == BLOB
+    assert headers_b["X-Dragonfly-Via-P2P"] == "1"
+    task_id = headers_b["X-Dragonfly-Task-Id"]
+    ts = db.storage.find_completed_task(task_id)
+    assert {p.traffic_type for p in ts.meta.pieces.values()} == {TRAFFIC_REMOTE_PEER}
+
+
+def test_non_matching_request_passes_through(proxy_cluster):
+    da = proxy_cluster["daemons"][0]
+    url = proxy_cluster["origin"] + "/manifest.json"
+    body, headers = _proxy_get(da.proxy.port, url)
+    assert body == b'{"layers": []}'
+    assert headers["X-Dragonfly-Via-P2P"] == "0"
+
+
+def test_transport_rule_matching():
+    rules = [
+        ProxyRule(regex=r"/v2/.*/blobs/", direct=False),
+        ProxyRule(regex=r"\.json$", direct=True),
+    ]
+    t = P2PTransport(task_manager=None, rules=rules)
+    assert t.match_rule("http://r/v2/lib/nginx/blobs/sha256:x") is rules[0]
+    assert t.match_rule("http://r/manifest.json") is rules[1]
+    assert t.match_rule("http://r/other") is None
+
+
+def test_transport_p2p_failure_falls_back_direct(origin_server, monkeypatch):
+    rule = ProxyRule(regex=r"blob\.bin")
+    t = P2PTransport(task_manager=None, rules=[rule])
+
+    def boom(url, headers):
+        raise RuntimeError("swarm unavailable")
+
+    monkeypatch.setattr(t, "_via_p2p", boom)
+    result = t.round_trip(origin_server + "/blob.bin")
+    assert isinstance(result, TransportResult)
+    assert result.read_all() == BLOB
+    assert result.status == 200
+    assert not result.via_p2p
+
+
+def test_registry_mirror_relative_paths(tmp_path, origin_server):
+    """Mirror mode: a non-absolute request path is resolved against the
+    mirror remote (container engines speak to the proxy like a host)."""
+    from dragonfly2_tpu.client.proxy import ProxyServer, RegistryMirror
+
+    transport = P2PTransport(task_manager=None, rules=[])  # all direct
+    proxy = ProxyServer(
+        transport, mirror=RegistryMirror(remote=origin_server), port=0
+    )
+    proxy.start()
+    try:
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", proxy.port, timeout=10)
+        conn.request("GET", "/manifest.json")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.read() == b'{"layers": []}'
+    finally:
+        proxy.stop()
+
+
+def test_upstream_404_passes_through(proxy_cluster):
+    """A registry blob-existence probe's 404 is an answer, not a 502."""
+    da = proxy_cluster["daemons"][0]
+    url = proxy_cluster["origin"] + "/missing.json"
+    import urllib.error
+
+    req = urllib.request.Request(url)
+    req.set_proxy(f"127.0.0.1:{da.proxy.port}", "http")
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(req, timeout=10)
+    assert exc_info.value.code == 404
+
+
+def test_ranged_request_bypasses_swarm(proxy_cluster):
+    """Range requests are a different byte stream than the task blob —
+    they go direct and keep the upstream 206."""
+    da = proxy_cluster["daemons"][0]
+    url = proxy_cluster["origin"] + "/blob.bin"
+    req = urllib.request.Request(url, headers={"Range": "bytes=0-99"})
+    req.set_proxy(f"127.0.0.1:{da.proxy.port}", "http")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        body = resp.read()
+        assert resp.status == 206
+        assert resp.headers["X-Dragonfly-Via-P2P"] == "0"
+    assert body == BLOB[:100]
+
+
+def test_head_reports_length_without_body(proxy_cluster):
+    da = proxy_cluster["daemons"][0]
+    url = proxy_cluster["origin"] + "/blob.bin"
+    req = urllib.request.Request(url, method="HEAD")
+    req.set_proxy(f"127.0.0.1:{da.proxy.port}", "http")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert int(resp.headers["Content-Length"]) == len(BLOB)
+        assert resp.read() == b""

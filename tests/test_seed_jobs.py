@@ -208,3 +208,33 @@ def test_preheat_without_seeds_fails(manager_env):
     done = client.GetJob(manager_pb2.GetJobRequest(id=job.id))
     assert done.state == "failed"
     assert "no seed peers" in json.loads(done.result_json)["error"]
+
+
+def test_stale_lease_result_rejected(manager_env):
+    """A worker that lost its lease cannot clobber the re-leased worker's
+    outcome."""
+    import grpc
+
+    client = manager_env["client"]
+    job = client.CreateJob(manager_pb2.CreateJobRequest(type="sync_peers"))
+    # worker A leases...
+    client.ListPendingJobs(
+        manager_pb2.ListPendingJobsRequest(hostname="a", ip="1.1.1.1")
+    )
+    # ...but worker B posts with a different identity → rejected
+    with pytest.raises(grpc.RpcError) as exc_info:
+        client.UpdateJobResult(
+            manager_pb2.UpdateJobResultRequest(
+                id=job.id, state="succeeded", result_json="{}",
+                hostname="b", ip="2.2.2.2",
+            )
+        )
+    assert exc_info.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+    # the rightful leaseholder's post lands
+    done = client.UpdateJobResult(
+        manager_pb2.UpdateJobResultRequest(
+            id=job.id, state="succeeded", result_json="{}",
+            hostname="a", ip="1.1.1.1",
+        )
+    )
+    assert done.state == "succeeded"
