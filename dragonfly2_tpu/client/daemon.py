@@ -61,6 +61,11 @@ class DaemonConfig:
     proxy_port: int = -1
     proxy_rules: list = field(default_factory=list)
     registry_mirror: str = ""
+    # object-storage gateway: -1 = disabled, 0 = ephemeral port; the
+    # backend dir is the bucket store (shared across daemons — NFS/S3
+    # mount in production, a shared tmp dir in tests)
+    object_storage_port: int = -1
+    object_storage_dir: str = ""
 
 
 class Daemon:
@@ -83,6 +88,7 @@ class Daemon:
         self.gc = GC()
         self.task_manager: TaskManager | None = None
         self.proxy = None
+        self.object_gateway = None
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -125,6 +131,29 @@ class Daemon:
             )
             self.proxy.start()
 
+        if self.cfg.object_storage_port >= 0 and self.cfg.object_storage_dir:
+            import re as _re
+
+            from dragonfly2_tpu.client.objectstorage import ObjectStorageGateway
+            from dragonfly2_tpu.client.transport import P2PTransport, ProxyRule
+            from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+
+            backend_root = str(self.cfg.object_storage_dir)
+            backend = FSObjectStorage(backend_root)
+            # gateway GETs always ride P2P: one rule covering the backend
+            transport = P2PTransport(
+                self.task_manager,
+                rules=[ProxyRule(regex=_re.escape(f"file://{backend_root}"))],
+            )
+            self.object_gateway = ObjectStorageGateway(
+                backend,
+                transport=transport,
+                importer=self._import_object,
+                url_for=lambda bucket, key: f"file://{backend_root}/{bucket}/{key}",
+                port=self.cfg.object_storage_port,
+            )
+            self.object_gateway.start()
+
         self.announce_host()
         self._spawn(self._announce_loop, "announcer")
         if self.cfg.probe_interval > 0:
@@ -152,11 +181,35 @@ class Daemon:
         self.gc.stop()
         if self.proxy is not None:
             self.proxy.stop()
+        if self.object_gateway is not None:
+            self.object_gateway.stop()
         if self._server is not None:
             self._server.stop(grace=1).wait()
         self.upload.stop()
         if self._channel is not None:
             self._channel.close()
+
+    def _import_object(self, url: str, data: bytes, digest: str = "") -> None:
+        """Register object bytes as a completed local task so this daemon
+        P2P-serves it without a backend fetch (the gateway's seed-on-write
+        replication mode). The digest is part of the task id, so an
+        overwrite seeds a fresh task instead of colliding with the old
+        content's swarm."""
+        from dragonfly2_tpu.client.pieces import compute_piece_length
+        from dragonfly2_tpu.utils.idgen import URLMeta, peer_id_v2, task_id_v1
+
+        task_id = task_id_v1(url, URLMeta(digest=digest))
+        if self.storage.find_completed_task(task_id) is not None:
+            return
+        pl = self.cfg.piece_length or compute_piece_length(len(data))
+        ts = self.storage.register_task(
+            task_id, peer_id_v2(), url=url, piece_length=pl, content_length=len(data)
+        )
+        number = 0
+        for off in range(0, max(len(data), 1), pl):
+            ts.write_piece(number, off, data[off : off + pl], traffic_type="local_peer")
+            number += 1
+        ts.mark_done(len(data))
 
     def _spawn(self, fn, name: str) -> None:
         t = threading.Thread(target=fn, name=name, daemon=True)

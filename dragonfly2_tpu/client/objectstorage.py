@@ -1,0 +1,222 @@
+"""Object-storage gateway: the daemon's HTTP front for bucket/object ops.
+
+Role parity: reference client/daemon/objectstorage/objectstorage.go:138-724
+— a gin HTTP server on the daemon: GET/HEAD/PUT/DELETE object + create
+bucket; GETs ride the P2P pipeline (shared swarm across daemons that
+front the same backend), PUTs fan out by replication mode. The backend is
+any pkg-style ObjectStorage driver (manager.objectstorage — filesystem in
+this environment, S3-shaped interface).
+
+API (dfstore speaks this):
+  PUT    /buckets/<bucket>                       create bucket
+  GET    /buckets/<bucket>/objects/<key>         fetch (via P2P)
+  HEAD   /buckets/<bucket>/objects/<key>         existence/length
+  PUT    /buckets/<bucket>/objects/<key>?mode=N  store (0=backend only,
+                                                 1=also import locally as
+                                                 a completed task: the
+                                                 writing daemon becomes
+                                                 the object's first seed)
+  DELETE /buckets/<bucket>/objects/<key>         delete from backend
+  GET    /buckets/<bucket>/objects?prefix=       list keys (JSON)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from dragonfly2_tpu.manager.objectstorage import ObjectStorage
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("client.objectstorage")
+
+# replication modes (reference objectstorage.go WriteBack / AsyncWriteBack)
+MODE_BACKEND_ONLY = 0
+MODE_IMPORT_LOCAL = 1
+
+# content-digest sidecar suffix: the digest participates in the P2P task
+# id, so an overwritten object gets a fresh task identity instead of the
+# swarm serving stale cached bytes forever
+DIGEST_SUFFIX = ".df-digest"
+
+
+def _sha256(data: bytes) -> str:
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+class ObjectStorageGateway:
+    """HTTP gateway bound to a daemon: backend + P2P transport."""
+
+    def __init__(
+        self,
+        backend: ObjectStorage,
+        transport=None,  # client.transport.P2PTransport; None = direct reads
+        importer=None,  # callable(url, data) registering a local seed copy
+        url_for=None,  # callable(bucket, key) -> origin URL for P2P fetch
+        address: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.backend = backend
+        self.transport = transport
+        self.importer = importer
+        self.url_for = url_for
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                logger.debug("gateway: " + fmt, *args)
+
+            def do_PUT(self):
+                outer._route(self, "PUT")
+
+            def do_GET(self):
+                outer._route(self, "GET")
+
+            def do_HEAD(self):
+                outer._route(self, "HEAD")
+
+            def do_DELETE(self):
+                outer._route(self, "DELETE")
+
+        self._server = ThreadingHTTPServer((address, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="os-gateway", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------------
+    def _route(self, h: BaseHTTPRequestHandler, method: str) -> None:
+        try:
+            parts = urllib.parse.urlsplit(h.path)
+            segs = [s for s in parts.path.split("/") if s]
+            query = dict(urllib.parse.parse_qsl(parts.query))
+            if len(segs) >= 1 and segs[0] == "buckets":
+                if len(segs) == 2 and method == "PUT":
+                    return self._create_bucket(h, segs[1])
+                if len(segs) == 3 and segs[2] == "objects" and method == "GET":
+                    return self._list_objects(h, segs[1], query.get("prefix", ""))
+                if len(segs) >= 4 and segs[2] == "objects":
+                    key = "/".join(segs[3:])
+                    if method == "PUT":
+                        return self._put_object(h, segs[1], key, query)
+                    if method == "GET":
+                        return self._get_object(h, segs[1], key)
+                    if method == "HEAD":
+                        return self._head_object(h, segs[1], key)
+                    if method == "DELETE":
+                        return self._delete_object(h, segs[1], key)
+            h.send_error(404, "no such route")
+        except FileNotFoundError:
+            h.send_error(404, "object not found")
+        except Exception as e:
+            logger.exception("gateway %s %s failed", method, h.path)
+            try:
+                h.send_error(500, str(e))
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    def _create_bucket(self, h, bucket: str) -> None:
+        self.backend.create_bucket(bucket)
+        h.send_response(201)
+        h.send_header("Content-Length", "0")
+        h.end_headers()
+
+    def _put_object(self, h, bucket: str, key: str, query: dict) -> None:
+        if "chunked" in h.headers.get("Transfer-Encoding", "").lower():
+            # reading a chunked body as length-0 would silently store an
+            # empty object with a 201
+            h.send_error(411, "Content-Length required (chunked not supported)")
+            return
+        length = int(h.headers.get("Content-Length", 0))
+        data = h.rfile.read(length)
+        digest = _sha256(data)
+        self.backend.put_object(bucket, key, data)
+        self.backend.put_object(bucket, key + DIGEST_SUFFIX, digest.encode())
+        mode = int(query.get("mode", MODE_BACKEND_ONLY))
+        if mode == MODE_IMPORT_LOCAL and self.importer is not None and self.url_for:
+            # writing daemon becomes the first P2P seed of the object
+            try:
+                self.importer(self.url_for(bucket, key), data, digest)
+            except Exception:
+                logger.exception("local import of %s/%s failed", bucket, key)
+        h.send_response(201)
+        h.send_header("Content-Length", "0")
+        h.end_headers()
+
+    def _digest_of(self, bucket: str, key: str) -> str:
+        try:
+            return self.backend.get_object(bucket, key + DIGEST_SUFFIX).decode()
+        except FileNotFoundError:
+            return ""
+
+    def _get_object(self, h, bucket: str, key: str) -> None:
+        if not self.backend.head_object(bucket, key):
+            raise FileNotFoundError(key)
+        if self.transport is not None and self.url_for is not None:
+            result = self.transport.round_trip(
+                self.url_for(bucket, key), digest=self._digest_of(bucket, key)
+            )
+            if result.status == 404:
+                raise FileNotFoundError(key)
+            length = result.content_length
+            if length < 0:
+                length = self.backend.stat_object(bucket, key)
+            h.send_response(200)
+            h.send_header("Content-Length", str(length))
+            h.send_header("X-Dragonfly-Via-P2P", "1" if result.via_p2p else "0")
+            if result.task_id:
+                h.send_header("X-Dragonfly-Task-Id", result.task_id)
+            h.end_headers()
+            # stream — multi-GB objects must not be buffered per request
+            for chunk in result.body:
+                h.wfile.write(chunk)
+            return
+        body = self.backend.get_object(bucket, key)
+        h.send_response(200)
+        h.send_header("Content-Length", str(len(body)))
+        h.send_header("X-Dragonfly-Via-P2P", "0")
+        h.end_headers()
+        h.wfile.write(body)
+
+    def _head_object(self, h, bucket: str, key: str) -> None:
+        if not self.backend.head_object(bucket, key):
+            h.send_error(404, "object not found")
+            return
+        h.send_response(200)
+        h.send_header("Content-Length", str(self.backend.stat_object(bucket, key)))
+        h.end_headers()
+
+    def _delete_object(self, h, bucket: str, key: str) -> None:
+        self.backend.delete_object(bucket, key)
+        self.backend.delete_object(bucket, key + DIGEST_SUFFIX)
+        h.send_response(204)
+        h.send_header("Content-Length", "0")
+        h.end_headers()
+
+    def _list_objects(self, h, bucket: str, prefix: str) -> None:
+        keys = [
+            k
+            for k in self.backend.list_objects(bucket, prefix)
+            if not k.endswith(DIGEST_SUFFIX)
+        ]
+        body = json.dumps({"keys": keys}).encode()
+        h.send_response(200)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)

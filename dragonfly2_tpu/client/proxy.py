@@ -27,6 +27,9 @@ from dragonfly2_tpu.utils import dflog
 logger = dflog.get("client.proxy")
 
 _HOP_HEADERS = {
+    # accept-encoding is stripped so origins reply identity-encoded — the
+    # proxy streams bodies as-is and must not re-label compressed bytes
+    "accept-encoding",
     "connection",
     "proxy-connection",
     "keep-alive",
@@ -50,8 +53,11 @@ class RegistryMirror:
             return url
         remote = urlsplit(self.remote)
         parts = urlsplit(url)
+        # keep the mirror remote's own path prefix (e.g. /registry) — the
+        # mirror-relative branch does, so absolute URIs must too
+        path = remote.path.rstrip("/") + parts.path
         return urlunsplit(
-            (remote.scheme, remote.netloc, parts.path, parts.query, parts.fragment)
+            (remote.scheme, remote.netloc, path, parts.query, parts.fragment)
         )
 
 
@@ -125,10 +131,7 @@ class ProxyServer:
         # forward upstream headers (Content-Type matters to registry
         # clients); hop-by-hop and length/encoding are re-derived here
         for k, v in result.headers.items():
-            if k.lower() not in _HOP_HEADERS and k.lower() not in (
-                "content-length",
-                "content-encoding",
-            ):
+            if k.lower() not in _HOP_HEADERS and k.lower() != "content-length":
                 handler.send_header(k, v)
         if result.content_length >= 0:
             handler.send_header("Content-Length", str(result.content_length))
@@ -166,6 +169,9 @@ class ProxyServer:
             self._relay(client, upstream)
         finally:
             upstream.close()
+            # the socket carried opaque TLS bytes — never loop back into
+            # HTTP parsing on it (a cleartext 400 mid-TLS breaks clients)
+            handler.close_connection = True
 
     @staticmethod
     def _relay(a: socket.socket, b: socket.socket) -> None:

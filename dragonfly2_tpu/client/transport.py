@@ -91,7 +91,11 @@ class P2PTransport:
         return None
 
     def round_trip(
-        self, url: str, headers: dict | None = None, head: bool = False
+        self,
+        url: str,
+        headers: dict | None = None,
+        head: bool = False,
+        digest: str = "",
     ) -> TransportResult:
         rule = self.match_rule(url)
         if rule is None or rule.direct:
@@ -103,7 +107,7 @@ class P2PTransport:
         if head or any(k.lower() == "range" for k in (headers or {})):
             return self._direct(target, headers, head)
         try:
-            return self._via_p2p(target, headers)
+            return self._via_p2p(target, headers, digest)
         except Exception as e:
             # P2P failure degrades to a direct fetch, never a user error
             # (reference transport.go back-source fallback)
@@ -111,10 +115,12 @@ class P2PTransport:
             return self._direct(target, headers, head)
 
     # ------------------------------------------------------------------
-    def _via_p2p(self, url: str, headers: dict | None) -> TransportResult:
+    def _via_p2p(self, url: str, headers: dict | None, digest: str = "") -> TransportResult:
+        # the digest participates in the task id: rewritten content gets a
+        # fresh task identity instead of serving stale cached bytes
         req = FileTaskRequest(
             url=url,
-            url_meta=common_pb2.UrlMeta(tag=self.default_tag),
+            url_meta=common_pb2.UrlMeta(tag=self.default_tag, digest=digest),
             headers=dict(headers or {}),
         )
         task_id, _, progress = self.tasks.wait_file_task(req, timeout=self.timeout)

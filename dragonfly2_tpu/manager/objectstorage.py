@@ -17,6 +17,8 @@ class ObjectStorage(Protocol):
 
     def head_object(self, bucket: str, key: str) -> bool: ...
 
+    def stat_object(self, bucket: str, key: str) -> int: ...
+
     def delete_object(self, bucket: str, key: str) -> None: ...
 
     def list_objects(self, bucket: str, prefix: str = "") -> list[str]: ...
@@ -50,6 +52,10 @@ class FSObjectStorage:
 
     def head_object(self, bucket: str, key: str) -> bool:
         return self._path(bucket, key).is_file()
+
+    def stat_object(self, bucket: str, key: str) -> int:
+        """Object size without reading the bytes."""
+        return self._path(bucket, key).stat().st_size
 
     def delete_object(self, bucket: str, key: str) -> None:
         self._path(bucket, key).unlink(missing_ok=True)
