@@ -1,0 +1,160 @@
+"""Dynconfig engine: cache, disk fallback, observers, service wrappers."""
+
+import json
+import time
+
+import pytest
+
+from dragonfly2_tpu.utils.dynconfig import Dynconfig, SchedulerDynconfig
+
+
+def test_caches_within_interval():
+    calls = []
+
+    def fetch():
+        calls.append(1)
+        return {"v": len(calls)}
+
+    dc = Dynconfig(fetch, refresh_interval=60.0)
+    assert dc.get() == {"v": 1}
+    assert dc.get() == {"v": 1}  # cached — no second fetch
+    assert len(calls) == 1
+
+
+def test_refresh_after_expiry():
+    calls = []
+
+    def fetch():
+        calls.append(1)
+        return {"v": len(calls)}
+
+    dc = Dynconfig(fetch, refresh_interval=0.0)
+    assert dc.get() == {"v": 1}
+    assert dc.get() == {"v": 2}
+
+
+def test_fetch_failure_falls_back_to_memory_then_disk(tmp_path):
+    cache = tmp_path / "dyn.json"
+    state = {"fail": False}
+
+    def fetch():
+        if state["fail"]:
+            raise ConnectionError("manager down")
+        return {"limit": 7}
+
+    dc = Dynconfig(fetch, cache_path=cache, refresh_interval=0.0)
+    assert dc.get() == {"limit": 7}
+    assert json.loads(cache.read_text()) == {"limit": 7}  # mirrored to disk
+
+    state["fail"] = True
+    assert dc.get() == {"limit": 7}  # previous value survives the outage
+
+    # a fresh process with a dead manager boots from the disk cache
+    dc2 = Dynconfig(fetch, cache_path=cache, refresh_interval=0.0)
+    assert dc2.get() == {"limit": 7}
+
+
+def test_observer_fires_on_change_only():
+    values = [{"a": 1}, {"a": 1}, {"a": 2}]
+    it = iter(values)
+    seen = []
+
+    dc = Dynconfig(lambda: next(it), refresh_interval=0.0)
+    dc.register(seen.append)
+    dc.refresh()
+    dc.refresh()  # same data — no notify
+    dc.refresh()
+    assert seen == [{"a": 1}, {"a": 2}]
+
+
+def test_register_delivers_current_data():
+    dc = Dynconfig(lambda: {"x": 1}, refresh_interval=60.0)
+    dc.refresh()
+    seen = []
+    dc.register(seen.append)
+    assert seen == [{"x": 1}]
+
+
+def test_background_refresh_loop():
+    calls = []
+    dc = Dynconfig(lambda: calls.append(1) or {"n": len(calls)}, refresh_interval=0.05)
+    dc.start()
+    try:
+        deadline = time.time() + 2
+        while len(calls) < 3 and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        dc.stop()
+    assert len(calls) >= 3
+
+
+def test_scheduler_dynconfig_feeds_scheduling(tmp_path):
+    """End to end: manager cluster config → SchedulerDynconfig →
+    Scheduling's live candidate limit."""
+    from dragonfly2_tpu.manager.database import Database
+    from dragonfly2_tpu.manager.models_registry import ModelRegistry
+    from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+    from dragonfly2_tpu.manager.service import ManagerService
+    from dragonfly2_tpu.rpc import glue
+    from dragonfly2_tpu.rpc.glue import MANAGER_SERVICE, serve
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+    from dragonfly2_tpu.scheduler.scheduling import Scheduling
+
+    db = Database(tmp_path / "m.db")
+    cluster_id = db.ensure_default_cluster()
+    db.execute(
+        "UPDATE scheduler_clusters SET config = ? WHERE id = ?",
+        (json.dumps({"candidate_parent_limit": 9, "filter_parent_limit": 33}), cluster_id),
+    )
+    service = ManagerService(db, ModelRegistry(db, FSObjectStorage(tmp_path / "obj")))
+    server, port = serve({MANAGER_SERVICE: service})
+    channel = glue.dial(f"127.0.0.1:{port}")
+    try:
+        client = glue.ServiceClient(channel, MANAGER_SERVICE)
+        dyn = SchedulerDynconfig(
+            client, cluster_id=cluster_id, cache_path=tmp_path / "dyn.json",
+            refresh_interval=0.0,
+        )
+        assert dyn.candidate_parent_limit == 9
+        assert dyn.filter_parent_limit == 33
+
+        scheduling = Scheduling(BaseEvaluator(), dynconfig=dyn)
+        assert scheduling._candidate_parent_limit() == 9
+        assert scheduling._filter_parent_limit() == 33
+
+        # live update: operator changes the cluster config
+        db.execute(
+            "UPDATE scheduler_clusters SET config = ? WHERE id = ?",
+            (json.dumps({"candidate_parent_limit": 2}), cluster_id),
+        )
+        assert scheduling._candidate_parent_limit() == 2
+    finally:
+        channel.close()
+        server.stop(0)
+
+
+def test_daemon_dynconfig_scheduler_list(tmp_path):
+    from dragonfly2_tpu.manager.database import Database
+    from dragonfly2_tpu.manager.models_registry import ModelRegistry
+    from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+    from dragonfly2_tpu.manager.service import ManagerService
+    from dragonfly2_tpu.rpc import glue
+    from dragonfly2_tpu.rpc.glue import MANAGER_SERVICE, serve
+    from dragonfly2_tpu.utils.dynconfig import DaemonDynconfig
+
+    import manager_pb2  # noqa: E402
+
+    db = Database(tmp_path / "m.db")
+    service = ManagerService(db, ModelRegistry(db, FSObjectStorage(tmp_path / "obj")))
+    server, port = serve({MANAGER_SERVICE: service})
+    channel = glue.dial(f"127.0.0.1:{port}")
+    try:
+        client = glue.ServiceClient(channel, MANAGER_SERVICE)
+        client.UpdateScheduler(
+            manager_pb2.UpdateSchedulerRequest(hostname="s1", ip="10.0.0.1", port=7001)
+        )
+        dyn = DaemonDynconfig(client, refresh_interval=0.0)
+        assert dyn.scheduler_addresses() == ["10.0.0.1:7001"]
+    finally:
+        channel.close()
+        server.stop(0)
