@@ -57,6 +57,10 @@ class ConductorOptions:
     parent_fail_limit: int = 3
     # wait between retries when a parent 404s a piece it may write soon
     not_found_backoff: float = 0.05
+    # total time budget to wait for an in-progress parent to produce an
+    # unadvertised piece — separate from piece_retry, so a slightly-slow
+    # swarm doesn't force a full reschedule round-trip every ~150ms
+    wait_piece_timeout: float = 5.0
     disable_back_source: bool = False
     piece_length: int = 0  # 0 = derive from content length
 
@@ -281,13 +285,16 @@ class PeerTaskConductor:
     def _download_from_parents(self, candidates) -> bool:
         """Pull all pieces from candidate parents; True when the task
         finished (success or failure), False to wait for a reschedule."""
-        # adopt task geometry from the first parent that knows it
+        # adopt task geometry from the first parent that knows it — the
+        # task's piece grid was fixed by whoever wrote the first piece, so
+        # an advertised piece_length overrides the local config default
+        # (which only governs this peer's own back-to-source writes)
         content_length = self.ts.meta.content_length
         piece_length = self.ts.meta.piece_length
         for c in candidates:
             if c.task_content_length > 0 and content_length < 0:
                 content_length = c.task_content_length
-            if c.task_piece_length > 0 and not piece_length:
+            if c.task_piece_length > 0 and not self.ts.meta.pieces:
                 piece_length = c.task_piece_length
         if content_length < 0 or not piece_length:
             # ask a parent daemon directly for the piece inventory
@@ -331,7 +338,12 @@ class PeerTaskConductor:
         def work(pr: PieceRange) -> None:
             last_err: Exception | None = None
             failed_here: set[str] = set()
-            for _ in range(self.opts.piece_retry):
+            hard_failures = 0
+            # one wait budget per parent — a stalled parent exhausting its
+            # deadline must not instantly hard-fail the other parents'
+            # optimistic probes
+            wait_deadlines: dict[str, float] = {}
+            while hard_failures < self.opts.piece_retry:
                 with lock:
                     live = [p for p in parents if p.peer_id not in self._blocked_parents]
                 parent = dispatcher.pick(live, pr.number, exclude=failed_here)
@@ -349,13 +361,23 @@ class PeerTaskConductor:
                     last_err = e
                     if e.not_found and pr.number not in parent.finished_pieces:
                         # optimistic probe of an in-progress parent that
-                        # never claimed the piece — wait for it to appear,
-                        # don't penalize the parent
-                        time.sleep(self.opts.not_found_backoff)
-                        continue
+                        # never claimed the piece — wait for it to appear
+                        # on its own deadline, don't penalize the parent
+                        # or burn the hard-failure retry budget
+                        now = time.monotonic()
+                        deadline = wait_deadlines.setdefault(
+                            parent.peer_id, now + self.opts.wait_piece_timeout
+                        )
+                        if now < deadline:
+                            time.sleep(self.opts.not_found_backoff)
+                            continue
+                        # waited out the piece — fall through as a hard
+                        # failure so the task reschedules instead of
+                        # spinning forever on a stalled parent
                     # hard failure — including a 404 on a piece the parent
                     # *advertised*: its inventory lies (evicted piece), so
                     # deprioritize it or it wins every retry on EWMA weight
+                    hard_failures += 1
                     failed_here.add(parent.peer_id)
                     self._send(
                         download_piece_failed=scheduler_pb2.DownloadPieceFailedRequest(

@@ -115,6 +115,9 @@ class Daemon:
         self._server, self.port = glue.serve(
             {DFDAEMON_SERVICE: service}, address=self.cfg.listen
         )
+        # announce before the proxy/gateway open for business: a gateway
+        # PUT may AnnounceTask immediately, which requires a known host
+        self.announce_host()
 
         if self.cfg.proxy_port >= 0:
             from dragonfly2_tpu.client.proxy import ProxyServer, RegistryMirror
@@ -154,7 +157,6 @@ class Daemon:
             )
             self.object_gateway.start()
 
-        self.announce_host()
         self._spawn(self._announce_loop, "announcer")
         if self.cfg.probe_interval > 0:
             self._spawn(self._probe_loop, "prober")
@@ -210,6 +212,14 @@ class Daemon:
             ts.write_piece(number, off, data[off : off + pl], traffic_type="local_peer")
             number += 1
         ts.mark_done(len(data))
+        # announce to the scheduler so the writing daemon is the first
+        # parent for this object (seed-on-write replication)
+        try:
+            self.task_manager.announce_completed_task(
+                ts, task_type=common_pb2.TASK_TYPE_DFSTORE
+            )
+        except Exception as e:
+            logger.warning("announce imported object %s failed: %s", task_id[:16], e)
 
     def _spawn(self, fn, name: str) -> None:
         t = threading.Thread(target=fn, name=name, daemon=True)

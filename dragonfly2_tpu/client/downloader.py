@@ -27,15 +27,16 @@ def download_piece(
     number: int,
     peer_id: str = "",
     timeout: float = 30.0,
-) -> tuple[bytes, str]:
+) -> tuple[bytes, str, str]:
     """Fetch piece ``number`` of ``task_id`` from a parent upload server
-    at ``host:port``; returns (bytes, digest)."""
+    at ``host:port``; returns (bytes, digest, origin_content_type)."""
     url = f"http://{parent_addr}/download/{task_id}?number={number}&peerId={peer_id}"
     try:
         with urllib.request.urlopen(url, timeout=timeout) as resp:
             data = resp.read()
             digest = resp.headers.get("X-Dragonfly-Piece-Digest", "")
-            return data, digest
+            content_type = resp.headers.get("X-Dragonfly-Origin-Content-Type", "")
+            return data, digest, content_type
     except urllib.error.HTTPError as e:
         raise PieceDownloadError(
             f"piece {number} from {parent_addr}: HTTP {e.code}", not_found=e.code == 404

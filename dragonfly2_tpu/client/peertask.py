@@ -117,6 +117,37 @@ class TaskManager:
             if self.conductors.get(conductor.task_id) is conductor:
                 self.conductors.pop(conductor.task_id)
 
+    def announce_completed_task(self, ts, task_type: int = 0) -> None:
+        """Tell the scheduler this daemon holds the complete task (dfcache
+        import / gateway seed-on-write) so it becomes the first candidate
+        parent instead of every other peer back-sourcing (reference
+        client/daemon/rpcserver announcePeerTask → scheduler AnnounceTask)."""
+        import scheduler_pb2  # noqa: E402 — flat proto import
+
+        self.scheduler.AnnounceTask(
+            scheduler_pb2.AnnounceTaskRequest(
+                host_id=self.host_id,
+                task_id=ts.meta.task_id,
+                peer_id=ts.meta.peer_id,
+                url=ts.meta.url,
+                url_meta=common_pb2.UrlMeta(tag=ts.meta.tag, application=ts.meta.application),
+                task_type=task_type,
+                content_length=ts.meta.content_length,
+                piece_length=ts.meta.piece_length,
+                pieces=[
+                    common_pb2.PieceInfo(
+                        number=p.number,
+                        offset=p.offset,
+                        length=p.length,
+                        digest=p.digest,
+                        traffic_type=p.traffic_type,
+                        cost_ns=p.cost_ns,
+                    )
+                    for _, p in sorted(ts.meta.pieces.items())
+                ],
+            )
+        )
+
     def wait_file_task(self, req: FileTaskRequest, timeout: float | None = None) -> tuple[str, str, Progress]:
         task_id, peer_id, conductor = self.start_file_task(req)
         if conductor is None:

@@ -96,11 +96,13 @@ class PieceManager:
         peer_id: str,
     ) -> "PieceResult":
         t0 = time.monotonic()
-        data, digest = downloader.download_piece(
+        data, digest, content_type = downloader.download_piece(
             parent.upload_addr, ts.meta.task_id, pr.number, peer_id=peer_id
         )
         dt = time.monotonic() - t0
         parent.observe(dt)
+        if content_type and "Content-Type" not in ts.meta.headers:
+            ts.meta.headers["Content-Type"] = content_type
         if len(data) != pr.length:
             raise downloader.PieceDownloadError(
                 f"piece {pr.number}: want {pr.length}B got {len(data)}B"
@@ -132,6 +134,8 @@ class PieceManager:
         meta = client.metadata(url, headers)
         content_length = meta.content_length
 
+        if meta.content_type:
+            ts.meta.headers["Content-Type"] = meta.content_type
         if content_length >= 0 and ts.meta.content_length < 0:
             ts.meta.content_length = content_length
         if not ts.meta.piece_length:

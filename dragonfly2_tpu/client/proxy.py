@@ -43,21 +43,22 @@ _HOP_HEADERS = {
 
 @dataclass
 class RegistryMirror:
-    """Rewrites proxied registry requests onto a mirror remote
-    (reference proxy config registryMirror.url)."""
+    """Resolves mirror-relative request paths onto a mirror remote
+    (reference proxy config registryMirror.url). Scope matches the
+    reference (client/daemon/proxy/proxy.go): the mirror serves requests
+    addressed *to the proxy as a host* (non-absolute URIs, the container
+    engine's registry-mirror mode); absolute-URI proxy requests are routed
+    by rules, never silently redirected onto the mirror."""
 
     remote: str = ""  # e.g. "https://mirror.example.com"
 
-    def rewrite(self, url: str) -> str:
-        if not self.remote:
-            return url
+    def resolve(self, path: str) -> str:
         remote = urlsplit(self.remote)
-        parts = urlsplit(url)
-        # keep the mirror remote's own path prefix (e.g. /registry) — the
-        # mirror-relative branch does, so absolute URIs must too
-        path = remote.path.rstrip("/") + parts.path
+        parts = urlsplit(path)
+        # keep the mirror remote's own path prefix (e.g. /registry)
+        full = remote.path.rstrip("/") + parts.path
         return urlunsplit(
-            (remote.scheme, remote.netloc, path, parts.query, parts.fragment)
+            (remote.scheme, remote.netloc, full, parts.query, parts.fragment)
         )
 
 
@@ -115,9 +116,7 @@ class ProxyServer:
             if not self.mirror.remote:
                 handler.send_error(400, "absolute URI required")
                 return
-            url = self.mirror.remote.rstrip("/") + url
-        else:
-            url = self.mirror.rewrite(url)
+            url = self.mirror.resolve(url)
 
         headers = {
             k: v for k, v in handler.headers.items() if k.lower() not in _HOP_HEADERS

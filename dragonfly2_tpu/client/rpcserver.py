@@ -159,6 +159,12 @@ class DfdaemonService:
                 if len(chunk) < pl:
                     break
         ts.mark_done(size)
+        # make the importer discoverable as the first parent — otherwise
+        # other daemons registering this task find no peers and back-source
+        try:
+            self.tasks.announce_completed_task(ts, task_type=common_pb2.TASK_TYPE_DFCACHE)
+        except Exception as e:
+            logger.warning("announce imported task %s failed: %s", task_id[:16], e)
         return dfdaemon_pb2.Empty()
 
     def ExportTask(self, request, context):

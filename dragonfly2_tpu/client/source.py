@@ -13,6 +13,7 @@ silent fallthrough.
 from __future__ import annotations
 
 import email.utils
+import mimetypes
 import os
 import urllib.error
 import urllib.parse
@@ -33,6 +34,7 @@ class Metadata:
     support_range: bool = False
     last_modified: float = 0.0
     etag: str = ""
+    content_type: str = ""
 
 
 @dataclass
@@ -93,6 +95,7 @@ class HTTPSourceClient(SourceClient):
                     support_range=h.get("Accept-Ranges", "") == "bytes",
                     last_modified=lm,
                     etag=h.get("ETag", ""),
+                    content_type=h.get("Content-Type", ""),
                 )
         except urllib.error.HTTPError as e:
             raise SourceError(f"HEAD {url}: {e.code}") from e
@@ -141,7 +144,10 @@ class FileSourceClient(SourceClient):
             raise SourceError(f"no such file: {p}")
         st = os.stat(p)
         return Metadata(
-            content_length=st.st_size, support_range=True, last_modified=st.st_mtime
+            content_length=st.st_size,
+            support_range=True,
+            last_modified=st.st_mtime,
+            content_type=mimetypes.guess_type(p)[0] or "",
         )
 
     def download(

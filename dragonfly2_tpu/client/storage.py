@@ -50,6 +50,9 @@ class TaskMeta:
     piece_length: int = 0
     done: bool = False
     access_time: float = field(default_factory=time.time)
+    # minimal origin response headers (Content-Type at least), replayed
+    # by the P2P transport so proxy clients see proper metadata
+    headers: dict[str, str] = field(default_factory=dict)
     pieces: dict[int, PieceMeta] = field(default_factory=dict)
 
     def to_json(self) -> dict:

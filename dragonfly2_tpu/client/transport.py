@@ -134,7 +134,9 @@ class P2PTransport:
 
         return TransportResult(
             status=200,
-            headers={},
+            # replay persisted origin headers (Content-Type) so registry
+            # clients get proper metadata on P2P-served responses
+            headers=dict(ts.meta.headers),
             body=pieces(),
             content_length=ts.meta.content_length,
             via_p2p=True,

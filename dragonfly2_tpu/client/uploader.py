@@ -82,6 +82,11 @@ class UploadServer:
             req.send_response(200)
             req.send_header("Content-Length", str(len(data)))
             req.send_header("X-Dragonfly-Piece-Digest", pm.digest)
+            # origin response metadata travels with the pieces so every
+            # peer in the swarm can replay it (transport Content-Type)
+            ct = ts.meta.headers.get("Content-Type", "")
+            if ct:
+                req.send_header("X-Dragonfly-Origin-Content-Type", ct)
             req.end_headers()
             req.wfile.write(data)
             return

@@ -33,7 +33,9 @@ class FSObjectStorage:
 
     def _path(self, bucket: str, key: str = "") -> Path:
         p = (self.root / bucket / key).resolve()
-        if not str(p).startswith(str(self.root.resolve())):
+        # component-wise check — a string-prefix test would accept sibling
+        # dirs sharing the root's name as a prefix (/data/backend-x)
+        if not p.is_relative_to(self.root.resolve()):
             raise ValueError(f"object key escapes storage root: {key}")
         return p
 

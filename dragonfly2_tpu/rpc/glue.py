@@ -55,6 +55,7 @@ SERVICES: dict[str, dict[str, Method]] = {
         "StatTask": Method(UNARY, scheduler_pb2.StatTaskRequest, scheduler_pb2.TaskStat),
         "AnnounceHost": Method(UNARY, scheduler_pb2.AnnounceHostRequest, scheduler_pb2.Empty),
         "LeaveHost": Method(UNARY, scheduler_pb2.LeaveHostRequest, scheduler_pb2.Empty),
+        "AnnounceTask": Method(UNARY, scheduler_pb2.AnnounceTaskRequest, scheduler_pb2.Empty),
         "SyncProbes": Method(
             STREAM_STREAM,
             scheduler_pb2.SyncProbesRequest,

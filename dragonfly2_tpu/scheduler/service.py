@@ -383,6 +383,67 @@ class SchedulerService:
             existing.touch()
         return scheduler_pb2.Empty()
 
+    def AnnounceTask(self, request, context):
+        """Register an already-completed local task: the announcing peer
+        lands in Succeeded with all pieces finished, so the scheduler can
+        hand it out as a candidate parent (reference
+        scheduler/service/service_v1.go AnnounceTask — dfcache import and
+        the object gateway's seed-on-write path)."""
+        host = self.resource.host_manager.load(request.host_id)
+        if host is None:
+            # an unannounced host has no ip/ports — registering it would
+            # hand children a permanently unreachable parent (reference
+            # AnnounceTask returns NotFound for unknown hosts)
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"host {request.host_id} has not announced",
+            )
+
+        meta = URLMeta(
+            digest=request.url_meta.digest,
+            tag=request.url_meta.tag,
+            range=request.url_meta.range,
+            filter=request.url_meta.filter,
+            application=request.url_meta.application,
+        )
+        task_id = request.task_id or task_id_v1(request.url, meta)
+        task = self.resource.task_manager.load(task_id)
+        if task is None:
+            task_type = {
+                common_pb2.TASK_TYPE_DFSTORE: res.TaskType.DFSTORE,
+                common_pb2.TASK_TYPE_DFCACHE: res.TaskType.DFCACHE,
+            }.get(request.task_type, res.TaskType.STANDARD)
+            task = res.Task(
+                task_id, url=request.url, task_type=task_type,
+                digest=meta.digest, tag=meta.tag, application=meta.application,
+            )
+            # a fresh task adopts the announced grid outright —
+            # Task.piece_length defaults to a truthy 4 MiB, so a
+            # "not set" check can never fire here
+            if request.piece_length:
+                task.piece_length = request.piece_length
+            self.resource.task_manager.store(task)
+        if request.content_length >= 0 and task.content_length < 0:
+            task.content_length = request.content_length
+        if request.pieces and task.total_piece_count < 0:
+            task.total_piece_count = len(request.pieces)
+
+        peer = res.Peer(request.peer_id, task, host, tag=meta.tag, application=meta.application)
+        peer, _ = self.resource.peer_manager.load_or_store(peer)
+        if peer.fsm.is_state(res.PEER_STATE_PENDING):
+            peer.fsm.event(res.PEER_EVENT_REGISTER_NORMAL)
+        if peer.fsm.can(res.PEER_EVENT_DOWNLOAD):
+            peer.fsm.event(res.PEER_EVENT_DOWNLOAD)
+        for piece in request.pieces:
+            self._piece_finished(peer, piece)
+        if peer.fsm.can(res.PEER_EVENT_DOWNLOAD_SUCCEEDED):
+            peer.fsm.event(res.PEER_EVENT_DOWNLOAD_SUCCEEDED)
+        if task.fsm.can(res.TASK_EVENT_DOWNLOAD):
+            task.fsm.event(res.TASK_EVENT_DOWNLOAD)
+        if task.fsm.can(res.TASK_EVENT_DOWNLOAD_SUCCEEDED):
+            task.fsm.event(res.TASK_EVENT_DOWNLOAD_SUCCEEDED)
+        return scheduler_pb2.Empty()
+
     def LeaveHost(self, request, context):
         host = self.resource.host_manager.load(request.host_id)
         if host is not None:

@@ -163,3 +163,34 @@ def test_recursive_download(cluster, tmp_path):
     assert len(written) == 2
     assert (dest / "one.bin").read_bytes() == b"one"
     assert (dest / "sub" / "two.bin").read_bytes() == b"two"
+
+
+def test_import_announce_seeds_swarm(cluster, tmp_path):
+    """dfcache import on daemon A announces the completed task to the
+    scheduler, so daemon B finds A as a parent instead of back-sourcing
+    (reference rpcserver announcePeerTask → scheduler AnnounceTask)."""
+    da, db = cluster["daemons"]
+    tmp = cluster["tmp"]
+
+    blob = os.urandom(3 * PIECE)
+    src = tmp / "imported.bin"
+    src.write_bytes(blob)
+    # the url is a cache key only — it resolves to nothing, so any
+    # back-to-source attempt from B would fail the download
+    url = "file:///nonexistent/cache-key-object"
+    dfcache.import_file(f"127.0.0.1:{da.port}", str(src), url)
+
+    task_id = da.task_manager.task_id_for(url, None)
+    peer = None
+    for p in cluster["resource"].peer_manager.all():
+        if p.task.id == task_id:
+            peer = p
+    assert peer is not None, "import must announce a peer to the scheduler"
+    assert peer.fsm.current == res.PEER_STATE_SUCCEEDED
+
+    out_b = tmp / "imported-out.bin"
+    dfget.download(f"127.0.0.1:{db.port}", url, str(out_b))
+    assert out_b.read_bytes() == blob
+    ts_b = db.storage.find_completed_task(task_id)
+    traffic = {p.traffic_type for p in ts_b.meta.pieces.values()}
+    assert traffic == {TRAFFIC_REMOTE_PEER}, f"expected pure P2P, got {traffic}"

@@ -182,7 +182,7 @@ def test_upload_download_piece_roundtrip(tmp_path):
     server = UploadServer(sm)
     server.start()
     try:
-        data, digest = download_piece(server.address, "f" * 64, 1, peer_id="child")
+        data, digest, _ = download_piece(server.address, "f" * 64, 1, peer_id="child")
         assert data == payload[8:16]
         assert digest.startswith("md5:")
         with pytest.raises(PieceDownloadError):

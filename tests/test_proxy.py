@@ -219,3 +219,42 @@ def test_head_reports_length_without_body(proxy_cluster):
     with urllib.request.urlopen(req, timeout=10) as resp:
         assert int(resp.headers["Content-Length"]) == len(BLOB)
         assert resp.read() == b""
+
+
+def test_p2p_response_preserves_content_type(proxy_cluster):
+    """P2P-served responses replay the origin's Content-Type persisted
+    with the task metadata (registry clients need it on blobs) — both on
+    the daemon that back-sourced and on one that downloaded pure-P2P
+    (the header rides the piece transfer between daemons)."""
+    da, db = proxy_cluster["daemons"]
+    url = proxy_cluster["origin"] + "/blob.bin"
+    _, headers = _proxy_get(da.proxy.port, url)
+    assert headers["X-Dragonfly-Via-P2P"] == "1"
+    assert headers.get("Content-Type") == "application/octet-stream"
+
+    _, headers_b = _proxy_get(db.proxy.port, url)
+    assert headers_b["X-Dragonfly-Via-P2P"] == "1"
+    assert headers_b.get("Content-Type") == "application/octet-stream"
+    task_id = headers_b["X-Dragonfly-Task-Id"]
+    ts = db.storage.find_completed_task(task_id)
+    assert {p.traffic_type for p in ts.meta.pieces.values()} == {TRAFFIC_REMOTE_PEER}
+
+
+def test_mirror_does_not_capture_absolute_uris(origin_server):
+    """A configured registry mirror must NOT swallow absolute-URI proxied
+    requests for arbitrary hosts — those route by rules/direct; only
+    mirror-relative paths resolve against the mirror remote."""
+    from dragonfly2_tpu.client.proxy import ProxyServer, RegistryMirror
+
+    transport = P2PTransport(task_manager=None, rules=[])  # all direct
+    # a dead mirror: if absolute URIs were rewritten onto it, this GET
+    # would 502 instead of reaching the real origin
+    proxy = ProxyServer(
+        transport, mirror=RegistryMirror(remote="http://127.0.0.1:9"), port=0
+    )
+    proxy.start()
+    try:
+        body, headers = _proxy_get(proxy.port, origin_server + "/manifest.json")
+        assert body == b'{"layers": []}'
+    finally:
+        proxy.stop()
