@@ -19,6 +19,7 @@ import common_pb2  # noqa: E402
 import scheduler_pb2  # noqa: E402
 
 from dragonfly2_tpu.rpc import glue
+from dragonfly2_tpu.client import hostinfo
 from dragonfly2_tpu.client.conductor import ConductorOptions
 from dragonfly2_tpu.client.peertask import TaskManager
 from dragonfly2_tpu.client.piece_manager import PieceManager
@@ -66,6 +67,13 @@ class DaemonConfig:
     # mount in production, a shared tmp dir in tests)
     object_storage_port: int = -1
     object_storage_dir: str = ""
+    # host stat collection (reference announcer.go:158-303). Overrides
+    # replace sampled values — the A/B harness and tests use them to model
+    # synthetic hosts; keys are dotted stat paths ("cpu.percent": 90.0)
+    collect_host_stats: bool = True
+    host_stats_override: dict = field(default_factory=dict)
+    # synthetic per-piece upload latency (A/B harness models slow hosts)
+    upload_delay_s: float = 0.0
 
 
 class Daemon:
@@ -77,7 +85,10 @@ class Daemon:
         self.host_id = host_id_v2(config.ip, config.hostname)
         self.storage = StorageManager(config.data_dir, max_bytes=config.storage_max_bytes)
         self.upload = UploadServer(
-            self.storage, host=config.upload_host, port=config.upload_port
+            self.storage,
+            host=config.upload_host,
+            port=config.upload_port,
+            delay_s=config.upload_delay_s,
         )
         self._channel = None
         self._scheduler = None
@@ -197,29 +208,21 @@ class Daemon:
         replication mode). The digest is part of the task id, so an
         overwrite seeds a fresh task instead of colliding with the old
         content's swarm."""
-        from dragonfly2_tpu.client.pieces import compute_piece_length
-        from dragonfly2_tpu.utils.idgen import URLMeta, peer_id_v2, task_id_v1
+        import io
+
+        from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
 
         task_id = task_id_v1(url, URLMeta(digest=digest))
         if self.storage.find_completed_task(task_id) is not None:
             return
-        pl = self.cfg.piece_length or compute_piece_length(len(data))
-        ts = self.storage.register_task(
-            task_id, peer_id_v2(), url=url, piece_length=pl, content_length=len(data)
+        self.task_manager.import_completed_task(
+            task_id,
+            url,
+            io.BytesIO(data).read,
+            len(data),
+            piece_length=self.cfg.piece_length,
+            task_type=common_pb2.TASK_TYPE_DFSTORE,
         )
-        number = 0
-        for off in range(0, max(len(data), 1), pl):
-            ts.write_piece(number, off, data[off : off + pl], traffic_type="local_peer")
-            number += 1
-        ts.mark_done(len(data))
-        # announce to the scheduler so the writing daemon is the first
-        # parent for this object (seed-on-write replication)
-        try:
-            self.task_manager.announce_completed_task(
-                ts, task_type=common_pb2.TASK_TYPE_DFSTORE
-            )
-        except Exception as e:
-            logger.warning("announce imported object %s failed: %s", task_id[:16], e)
 
     def _spawn(self, fn, name: str) -> None:
         t = threading.Thread(target=fn, name=name, daemon=True)
@@ -229,7 +232,23 @@ class Daemon:
     # ------------------------------------------------------------------
     # host announce (reference client/daemon/announcer/announcer.go:158-303)
     # ------------------------------------------------------------------
+    def host_stats(self) -> hostinfo.HostStats:
+        """Sample live host stats, then apply configured overrides (the
+        harness models synthetic hosts; production runs sample-only)."""
+        if self.cfg.collect_host_stats:
+            stats = hostinfo.collect(
+                data_dir=self.cfg.data_dir,
+                upload_ports=(self.upload.port, self.port),
+            )
+        else:
+            stats = hostinfo.HostStats()
+        for path, value in self.cfg.host_stats_override.items():
+            group, _, attr = path.partition(".")
+            setattr(getattr(stats, group), attr, value)
+        return stats
+
     def host_info(self) -> common_pb2.HostInfo:
+        s = self.host_stats()
         return common_pb2.HostInfo(
             id=self.host_id,
             type=self.cfg.host_type,
@@ -239,8 +258,33 @@ class Daemon:
             download_port=self.upload.port,
             os="linux",
             concurrent_upload_limit=self.cfg.concurrent_upload_limit,
+            cpu=common_pb2.CpuStat(
+                logical_count=s.cpu.logical_count,
+                physical_count=s.cpu.physical_count,
+                percent=s.cpu.percent,
+                process_percent=s.cpu.process_percent,
+            ),
+            memory=common_pb2.MemoryStat(
+                total=s.memory.total,
+                available=s.memory.available,
+                used=s.memory.used,
+                used_percent=s.memory.used_percent,
+                process_used_percent=s.memory.process_used_percent,
+                free=s.memory.free,
+            ),
             network=common_pb2.NetworkStat(
-                location=self.cfg.location, idc=self.cfg.idc
+                tcp_connection_count=s.network.tcp_connection_count,
+                upload_tcp_connection_count=s.network.upload_tcp_connection_count,
+                location=self.cfg.location,
+                idc=self.cfg.idc,
+            ),
+            disk=common_pb2.DiskStat(
+                total=s.disk.total,
+                free=s.disk.free,
+                used=s.disk.used,
+                used_percent=s.disk.used_percent,
+                inodes_total=s.disk.inodes_total,
+                inodes_used=s.disk.inodes_used,
             ),
             scheduler_cluster_id=self.cfg.scheduler_cluster_id,
         )

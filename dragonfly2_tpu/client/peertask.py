@@ -117,6 +117,42 @@ class TaskManager:
             if self.conductors.get(conductor.task_id) is conductor:
                 self.conductors.pop(conductor.task_id)
 
+    def import_completed_task(
+        self,
+        task_id: str,
+        url: str,
+        read_chunk,
+        size: int,
+        piece_length: int = 0,
+        task_type: int = 0,
+    ) -> None:
+        """Seed local bytes as a completed task and announce it: shared by
+        dfcache ImportTask and the gateway's seed-on-write path (reference
+        rpcserver.go ImportTask → announcePeerTask). ``read_chunk(n)``
+        yields up to n bytes per call (file handle or BytesIO reader).
+        The announce is best-effort — a scheduler outage must not fail a
+        local import."""
+        from dragonfly2_tpu.client.pieces import compute_piece_length
+
+        pl = piece_length or compute_piece_length(size)
+        ts = self.storage.register_task(
+            task_id, peer_id_v2(), url=url, piece_length=pl, content_length=size
+        )
+        number = 0
+        while True:
+            chunk = read_chunk(pl)
+            if not chunk and number > 0:
+                break
+            ts.write_piece(number, number * pl, chunk, traffic_type="local_peer")
+            number += 1
+            if len(chunk) < pl:
+                break
+        ts.mark_done(size)
+        try:
+            self.announce_completed_task(ts, task_type=task_type)
+        except Exception as e:
+            logger.warning("announce imported task %s failed: %s", task_id[:16], e)
+
     def announce_completed_task(self, ts, task_type: int = 0) -> None:
         """Tell the scheduler this daemon holds the complete task (dfcache
         import / gateway seed-on-write) so it becomes the first candidate

@@ -17,10 +17,8 @@ import common_pb2  # noqa: E402
 import dfdaemon_pb2  # noqa: E402
 
 from dragonfly2_tpu.client.peertask import FileTaskRequest, TaskManager
-from dragonfly2_tpu.client.pieces import compute_piece_length
 from dragonfly2_tpu.client.storage import StorageManager
 from dragonfly2_tpu.utils import dflog
-from dragonfly2_tpu.utils.idgen import peer_id_v2
 
 logger = dflog.get("client.rpc")
 
@@ -135,7 +133,8 @@ class DfdaemonService:
         return dfdaemon_pb2.Empty()
 
     def ImportTask(self, request, context):
-        """Load a local file into the piece store as a completed task
+        """Load a local file into the piece store as a completed task and
+        announce it so the importer is discoverable as the first parent
         (dfcache import, reference rpcserver.go ImportTask)."""
         task_id = self.tasks.task_id_for(request.url, request.url_meta)
         if self.storage.find_completed_task(task_id) is not None:
@@ -144,27 +143,11 @@ class DfdaemonService:
             size = os.path.getsize(request.path)
         except OSError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-        pl = compute_piece_length(size)
-        ts = self.storage.register_task(
-            task_id, peer_id_v2(), url=request.url, piece_length=pl, content_length=size
-        )
         with open(request.path, "rb") as f:
-            number = 0
-            while True:
-                chunk = f.read(pl)
-                if not chunk and number > 0:
-                    break
-                ts.write_piece(number, number * pl, chunk, traffic_type="local_peer")
-                number += 1
-                if len(chunk) < pl:
-                    break
-        ts.mark_done(size)
-        # make the importer discoverable as the first parent — otherwise
-        # other daemons registering this task find no peers and back-source
-        try:
-            self.tasks.announce_completed_task(ts, task_type=common_pb2.TASK_TYPE_DFCACHE)
-        except Exception as e:
-            logger.warning("announce imported task %s failed: %s", task_id[:16], e)
+            self.tasks.import_completed_task(
+                task_id, request.url, f.read, size,
+                task_type=common_pb2.TASK_TYPE_DFCACHE,
+            )
         return dfdaemon_pb2.Empty()
 
     def ExportTask(self, request, context):

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -25,8 +26,17 @@ _RANGE_RE = re.compile(r"bytes=(\d+)-(\d*)")
 class UploadServer:
     """Serves pieces to child peers over HTTP."""
 
-    def __init__(self, storage: StorageManager, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        storage: StorageManager,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        delay_s: float = 0.0,
+    ):
         self.storage = storage
+        # synthetic per-piece serving latency — benchmarking/AB-harness
+        # knob to model slow hosts; 0 in production
+        self.delay_s = delay_s
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -70,6 +80,8 @@ class UploadServer:
             req.send_error(404, f"task {task_id} not found")
             return
 
+        if self.delay_s > 0:
+            time.sleep(self.delay_s)
         number = qs.get("number", [None])[0]
         if number is not None:
             # piece fetch by number

@@ -279,6 +279,29 @@ class ManagerService:
             )
         return self._model(row)
 
+    def GetModelWeights(self, request, context):
+        """Weights blob for the serving side (scheduler ml evaluator).
+        version 0 = the active version (reference: the scheduler's
+        would-be Triton ModelInfer hop — here weights come down once and
+        inference runs in-process, manager/service/model.go:109 activation
+        gating applies via the version-0 lookup)."""
+        row = self.models.get(request.model_id, request.version)
+        if row is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"model {request.model_id} v{request.version} not found",
+            )
+        try:
+            weights = self.models.load_weights(request.model_id, row.version)
+        except (KeyError, OSError) as e:
+            context.abort(grpc.StatusCode.INTERNAL, f"weights load failed: {e}")
+        return manager_pb2.ModelWeights(
+            model_id=row.model_id,
+            version=row.version,
+            type=row.type,
+            weights=weights,
+        )
+
     def ListModels(self, request, context):
         rows = self.models.list(request.scheduler_cluster_id or None)
         return manager_pb2.ListModelsResponse(models=[self._model(r) for r in rows])

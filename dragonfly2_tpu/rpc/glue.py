@@ -90,6 +90,9 @@ SERVICES: dict[str, dict[str, Method]] = {
         ),
         "CreateModel": Method(UNARY, manager_pb2.CreateModelRequest, manager_pb2.Model),
         "GetModel": Method(UNARY, manager_pb2.GetModelRequest, manager_pb2.Model),
+        "GetModelWeights": Method(
+            UNARY, manager_pb2.GetModelRequest, manager_pb2.ModelWeights
+        ),
         "ListModels": Method(UNARY, manager_pb2.ListModelsRequest, manager_pb2.ListModelsResponse),
         "UpdateModel": Method(UNARY, manager_pb2.UpdateModelRequest, manager_pb2.Model),
     },

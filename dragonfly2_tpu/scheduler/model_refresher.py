@@ -1,0 +1,122 @@
+"""Model refresher: the last hop of the train→serve loop.
+
+The reference designed — but never wired — the consumption side of its
+model registry: the `ml` evaluator algorithm is a TODO that falls back to
+the base score (reference scheduler/scheduling/evaluator/evaluator.go:53)
+and would have called Triton ModelInfer against the model the manager
+activates (reference manager/service/model.go:109). This component closes
+that loop TPU-style: poll the manager for the *active* MLP model version,
+download the weights once on version change, rebuild the in-process XLA
+scorer, and install it into the running MLEvaluator. Any failure leaves
+the previous scorer (or the base fallback) serving — a bad fit can never
+poison scheduling, matching the reference's inactive-until-activated
+state machine (manager/models/model.go:20-26).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import manager_pb2  # noqa: E402
+
+from dragonfly2_tpu.scheduler.evaluator import MLEvaluator
+from dragonfly2_tpu.trainer.serving import MLPScorer, deserialize_params_auto
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("scheduler.model_refresher")
+
+
+class ModelRefresher:
+    """Polls the manager model registry and installs the active MLP model
+    into the evaluator; keeps serving the previous model on any error."""
+
+    def __init__(
+        self,
+        manager_client,
+        evaluator: MLEvaluator,
+        scheduler_cluster_id: int = 1,
+        interval: float = 60.0,
+    ):
+        self.manager = manager_client
+        self.evaluator = evaluator
+        self.cluster_id = scheduler_cluster_id
+        self.interval = interval
+        self.loaded_version: tuple[str, int] | None = None  # (model_id, version)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------
+    def refresh_once(self) -> bool:
+        """One poll round; returns True when a new model was installed."""
+        try:
+            resp = self.manager.ListModels(
+                manager_pb2.ListModelsRequest(scheduler_cluster_id=self.cluster_id)
+            )
+        except Exception as e:
+            logger.warning("model list poll failed: %s", e)
+            return False
+
+        active = [
+            m for m in resp.models if m.state == "active" and m.type == "mlp"
+        ]
+        if not active:
+            # no active model → serve the base fallback (never uninstall a
+            # model *on error*, but an explicit deactivation is an operator
+            # decision and must take effect)
+            if self.loaded_version is not None:
+                logger.info("active model withdrawn; falling back to base evaluator")
+                self.evaluator.set_model(None)
+                self.loaded_version = None
+            return False
+
+        # newest activation wins if several MLP models are active (e.g.
+        # per-source-host model ids)
+        m = max(active, key=lambda m: m.created_at_ns)
+        key = (m.model_id, m.version)
+        if key == self.loaded_version:
+            return False
+
+        try:
+            w = self.manager.GetModelWeights(
+                manager_pb2.GetModelRequest(model_id=m.model_id, version=m.version)
+            )
+            params = deserialize_params_auto(w.weights)
+            scorer = MLPScorer(params)
+            # compile + sanity-check before install: a scorer that cannot
+            # run must never reach the scheduling hot path
+            import numpy as np
+
+            from dragonfly2_tpu.schema.features import MLP_FEATURE_NAMES
+
+            scorer.predict(np.zeros((1, len(MLP_FEATURE_NAMES)), np.float32))
+        except Exception as e:
+            logger.warning(
+                "loading model %s v%d failed (%s); keeping previous", m.model_id, m.version, e
+            )
+            return False
+
+        self.evaluator.set_model(scorer)
+        self.loaded_version = key
+        logger.info("installed model %s v%d into ml evaluator", m.model_id, m.version)
+        return True
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self.refresh_once()
+        self._thread = threading.Thread(
+            target=self._loop, name="model-refresher", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.refresh_once()
+            except Exception:
+                logger.exception("model refresh round failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

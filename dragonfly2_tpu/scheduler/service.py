@@ -115,9 +115,22 @@ def _host_from_info(info: common_pb2.HostInfo) -> res.Host:
         or res.DEFAULT_CONCURRENT_UPLOAD_LIMIT,
         scheduler_cluster_id=info.scheduler_cluster_id,
     )
+    h.cpu.logical_count = info.cpu.logical_count
+    h.cpu.physical_count = info.cpu.physical_count
     h.cpu.percent = info.cpu.percent
+    h.cpu.process_percent = info.cpu.process_percent
+    h.memory.total = info.memory.total
+    h.memory.available = info.memory.available
+    h.memory.used = info.memory.used
     h.memory.used_percent = info.memory.used_percent
+    h.memory.process_used_percent = info.memory.process_used_percent
+    h.memory.free = info.memory.free
+    h.disk.total = info.disk.total
+    h.disk.free = info.disk.free
+    h.disk.used = info.disk.used
     h.disk.used_percent = info.disk.used_percent
+    h.disk.inodes_total = info.disk.inodes_total
+    h.disk.inodes_used = info.disk.inodes_used
     h.network.tcp_connection_count = info.network.tcp_connection_count
     h.network.upload_tcp_connection_count = info.network.upload_tcp_connection_count
     h.network.location = info.network.location

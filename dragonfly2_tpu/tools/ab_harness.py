@@ -1,0 +1,290 @@
+"""North-star A/B harness: default evaluator vs TPU-trained ml evaluator.
+
+BASELINE.md's e2e quality metric is "beat the default evaluator's p50
+piece-RTT on a P2P cluster". This harness measures it with the REAL
+pipeline, in-process: a scheduler + N daemons on localhost where half the
+hosts are slow (synthetic upload latency, correlated with announced
+cpu/memory pressure, as loaded hosts are in production). Phase 1 runs the
+workload under the default linear evaluator and trains an MLP on the
+Download records it produced (the production data path: records →
+trainer → manager model registry → activation → ModelRefresher →
+MLEvaluator). Phase 2 replays the identical workload under the installed
+model. Output: p50 piece-RTT per phase; the ml evaluator wins by steering
+children away from loaded parents the linear score cannot see (its
+weights ignore cpu/memory — reference evaluator_base.go:32-50).
+
+Run: ``python -m dragonfly2_tpu.tools.ab_harness``
+Prints one JSON line: {"p50_default_ms": ..., "p50_ml_ms": ..., ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import tempfile
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("tools.ab")
+
+
+@dataclass
+class ABConfig:
+    n_daemons: int = 10
+    n_slow: int = 5
+    n_tasks: int = 6
+    piece_length: int = 16 * 1024
+    pieces_per_task: int = 4
+    slow_delay_s: float = 0.040  # per-piece serving latency on loaded hosts
+    fast_delay_s: float = 0.002
+    # scheduler hands out this many candidates — small enough that the
+    # evaluator's ranking (not the client dispatcher) decides outcomes
+    candidate_parent_limit: int = 2
+    seed: int = 7
+    # loaded hosts announce this much cpu/memory pressure
+    slow_stats: dict = field(
+        default_factory=lambda: {"cpu.percent": 92.0, "memory.used_percent": 85.0}
+    )
+    fast_stats: dict = field(
+        default_factory=lambda: {"cpu.percent": 8.0, "memory.used_percent": 22.0}
+    )
+
+
+@dataclass
+class PhaseResult:
+    p50_ms: float
+    p90_ms: float
+    mean_ms: float
+    piece_count: int
+    slow_parent_fraction: float
+
+
+def _percentile(xs: list[float], q: float) -> float:
+    return float(np.percentile(np.asarray(xs), q)) if xs else 0.0
+
+
+class _Cluster:
+    """One phase's scheduler + daemons (fresh state, same topology)."""
+
+    def __init__(self, cfg: ABConfig, evaluator, workdir: str):
+        from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+        from dragonfly2_tpu.rpc.glue import serve
+        from dragonfly2_tpu.scheduler import resource as res
+        from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+        from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
+        from dragonfly2_tpu.scheduler.storage import Storage
+
+        self.cfg = cfg
+        self.resource = res.Resource()
+        self.storage = Storage(os.path.join(workdir, "sched"), buffer_size=1)
+        self.evaluator = evaluator
+        self.service = SchedulerService(
+            self.resource,
+            Scheduling(
+                evaluator,
+                SchedulingConfig(
+                    retry_interval=0.0,
+                    retry_back_to_source_limit=1,
+                    candidate_parent_limit=cfg.candidate_parent_limit,
+                ),
+            ),
+            storage=self.storage,
+        )
+        self.server, self.port = serve({SERVICE_NAME: self.service})
+
+        self.daemons = []
+        self.slow_ids: set[str] = set()
+        for i in range(cfg.n_daemons):
+            slow = i < cfg.n_slow
+            d = Daemon(
+                DaemonConfig(
+                    data_dir=os.path.join(workdir, f"daemon-{i}"),
+                    scheduler_address=f"127.0.0.1:{self.port}",
+                    hostname=f"ab-host-{i}",
+                    ip="127.0.0.1",
+                    piece_length=cfg.piece_length,
+                    schedule_timeout=10.0,
+                    announce_interval=60.0,
+                    upload_delay_s=cfg.slow_delay_s if slow else cfg.fast_delay_s,
+                    collect_host_stats=False,
+                    host_stats_override=dict(
+                        cfg.slow_stats if slow else cfg.fast_stats
+                    ),
+                )
+            )
+            d.start()
+            self.daemons.append(d)
+            if slow:
+                self.slow_ids.add(d.host_id)
+
+    def stop(self) -> None:
+        for d in self.daemons:
+            d.stop()
+        self.server.stop(0)
+
+
+def _run_workload(cluster: _Cluster, cfg: ABConfig, origins: list[str]) -> PhaseResult:
+    """Same deterministic workload each phase: for each task, one seeder
+    back-sources, then every other daemon downloads in seeded order.
+    Measures client-observed remote-peer piece cost."""
+    from dragonfly2_tpu.client import dfget
+    from dragonfly2_tpu.client.piece_manager import TRAFFIC_REMOTE_PEER
+
+    rng = random.Random(cfg.seed)
+    peer_host: dict[str, str] = {}  # peer_id -> host_id for parent attribution
+    costs_ms: list[float] = []
+    slow_pulls = total_pulls = 0
+
+    for t, url in enumerate(origins):
+        order = list(range(cfg.n_daemons))
+        rng.shuffle(order)
+        seeder, children = order[0], order[1:]
+        sd = cluster.daemons[seeder]
+        dfget.download(f"127.0.0.1:{sd.port}", url, f"{sd.cfg.data_dir}/seed-{t}.bin")
+        task_id = sd.task_manager.task_id_for(url, None)
+        ts = sd.storage.find_completed_task(task_id)
+        peer_host[ts.meta.peer_id] = sd.host_id
+
+        for c in children:
+            cd = cluster.daemons[c]
+            out = f"{cd.cfg.data_dir}/out-{t}.bin"
+            dfget.download(f"127.0.0.1:{cd.port}", url, out)
+            ts_c = cd.storage.find_completed_task(task_id)
+            peer_host[ts_c.meta.peer_id] = cd.host_id
+            for p in ts_c.meta.pieces.values():
+                if p.traffic_type != TRAFFIC_REMOTE_PEER:
+                    continue
+                costs_ms.append(p.cost_ns / 1e6)
+                total_pulls += 1
+                if peer_host.get(p.parent_id) in cluster.slow_ids:
+                    slow_pulls += 1
+
+    return PhaseResult(
+        p50_ms=_percentile(costs_ms, 50),
+        p90_ms=_percentile(costs_ms, 90),
+        mean_ms=float(np.mean(costs_ms)) if costs_ms else 0.0,
+        piece_count=len(costs_ms),
+        slow_parent_fraction=slow_pulls / total_pulls if total_pulls else 0.0,
+    )
+
+
+def _train_and_activate(cluster: _Cluster, workdir: str):
+    """Records → MLP fit → manager registry → activation; returns the
+    manager client (the serving loop's source of truth)."""
+    from dragonfly2_tpu.manager.database import Database
+    from dragonfly2_tpu.manager.models_registry import ModelRegistry
+    from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+    from dragonfly2_tpu.manager.service import (
+        SERVICE_NAME as MANAGER_SERVICE,
+        ManagerGrpcClientAdapter,
+        ManagerService,
+    )
+    from dragonfly2_tpu.rpc.glue import ServiceClient, dial, serve
+    from dragonfly2_tpu.schema.columnar import records_to_columns
+    from dragonfly2_tpu.schema.features import extract_pair_features
+    from dragonfly2_tpu.trainer.train import FitConfig, train_mlp
+    import manager_pb2  # noqa: E402
+
+    os.makedirs(workdir, exist_ok=True)
+    records = list(cluster.storage.list_download())
+    pairs = extract_pair_features(records_to_columns(records))
+    logger.info(
+        "training on %d records -> %d pairs", len(records), pairs.features.shape[0]
+    )
+    result = train_mlp(
+        pairs.features,
+        pairs.labels,
+        config=FitConfig(hidden_dims=(64, 64), batch_size=256, epochs=60, eval_fraction=0.15),
+    )
+
+    db = Database(os.path.join(workdir, "manager.db"))
+    registry = ModelRegistry(db, FSObjectStorage(os.path.join(workdir, "objects")))
+    service = ManagerService(db, registry)
+    server, port = serve({MANAGER_SERVICE: service})
+    channel = dial(f"127.0.0.1:{port}")
+    client = ServiceClient(channel, MANAGER_SERVICE)
+
+    adapter = ManagerGrpcClientAdapter(channel)
+    adapter.create_model(
+        model_id="ab-mlp",
+        model_type="mlp",
+        ip="127.0.0.1",
+        hostname="ab-trainer",
+        params=result.params,
+        evaluation=result.metrics,
+    )
+    client.UpdateModel(
+        manager_pb2.UpdateModelRequest(model_id="ab-mlp", version=1, state="active")
+    )
+    return client, server, channel, result.metrics
+
+
+def run_ab(cfg: ABConfig | None = None, workdir: str | None = None) -> dict:
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator, MLEvaluator
+    from dragonfly2_tpu.scheduler.model_refresher import ModelRefresher
+
+    cfg = cfg or ABConfig()
+    workdir = workdir or tempfile.mkdtemp(prefix="dragonfly-ab-")
+    rng = random.Random(cfg.seed)
+
+    # shared origin payloads — identical workload in both phases
+    origins = []
+    origin_dir = os.path.join(workdir, "origin")
+    os.makedirs(origin_dir, exist_ok=True)
+    for t in range(cfg.n_tasks):
+        path = os.path.join(origin_dir, f"task-{t}.bin")
+        with open(path, "wb") as f:
+            f.write(rng.randbytes(cfg.piece_length * cfg.pieces_per_task))
+        origins.append(f"file://{path}")
+
+    # ---- phase 1: default evaluator (also produces training data) ----
+    logger.info("phase 1: default evaluator, %d daemons", cfg.n_daemons)
+    c1 = _Cluster(cfg, BaseEvaluator(), os.path.join(workdir, "phase-default"))
+    try:
+        default_result = _run_workload(c1, cfg, origins)
+        client, mgr_server, mgr_channel, metrics = _train_and_activate(
+            c1, os.path.join(workdir, "manager")
+        )
+    finally:
+        c1.stop()
+
+    # ---- phase 2: ml evaluator fed through the real serving loop ----
+    logger.info("phase 2: ml evaluator (model via manager registry)")
+    evaluator = MLEvaluator()
+    refresher = ModelRefresher(client, evaluator, scheduler_cluster_id=1)
+    installed = refresher.refresh_once()
+    if not installed:
+        raise RuntimeError("model refresh failed — serving loop not closed")
+    c2 = _Cluster(cfg, evaluator, os.path.join(workdir, "phase-ml"))
+    try:
+        ml_result = _run_workload(c2, cfg, origins)
+    finally:
+        c2.stop()
+        mgr_channel.close()
+        mgr_server.stop(0)
+
+    out = {
+        "p50_default_ms": round(default_result.p50_ms, 3),
+        "p50_ml_ms": round(ml_result.p50_ms, 3),
+        "p90_default_ms": round(default_result.p90_ms, 3),
+        "p90_ml_ms": round(ml_result.p90_ms, 3),
+        "slow_parent_fraction_default": round(default_result.slow_parent_fraction, 3),
+        "slow_parent_fraction_ml": round(ml_result.slow_parent_fraction, 3),
+        "pieces_default": default_result.piece_count,
+        "pieces_ml": ml_result.piece_count,
+        "mlp_eval_mse": round(metrics.get("mse", 0.0), 4),
+        "ml_wins": ml_result.p50_ms < default_result.p50_ms,
+    }
+    return out
+
+
+def main() -> None:
+    print(json.dumps(run_ab()))
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,30 @@ def deserialize_params(blob: bytes, like: Any) -> Any:
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def deserialize_params_auto(blob: bytes) -> Any:
+    """npz bytes → pytree, structure reconstructed from the flat keys
+    alone (all-integer dict levels become lists). The serving side needs
+    this because a downloaded model's layer count/dims aren't known until
+    the weights arrive."""
+    with np.load(io.BytesIO(blob)) as z:
+        tree: dict = {}
+        for key in z.files:
+            node = tree
+            parts = key.split("/")
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = z[key]
+
+    def listify(node):
+        if not isinstance(node, dict):
+            return node
+        if node and all(k.isdigit() for k in node):
+            return [listify(node[k]) for k in sorted(node, key=int)]
+        return {k: listify(v) for k, v in node.items()}
+
+    return listify(tree)
+
+
 class MLPScorer:
     """Jitted parent scorer around trained MLP params — the object the
     scheduler's MLEvaluator calls ``predict`` on."""

@@ -1,0 +1,30 @@
+"""North-star quality metric (BASELINE.md): the TPU-trained ml evaluator
+must beat the default evaluator's p50 piece-RTT on a skewed swarm, with
+the model arriving through the full serving loop (records → trainer →
+manager registry → activation → ModelRefresher → MLEvaluator).
+
+A compact version of ``python -m dragonfly2_tpu.tools.ab_harness`` (which
+runs the full 10-daemon measurement).
+"""
+
+import pytest
+
+from dragonfly2_tpu.tools.ab_harness import ABConfig, run_ab
+
+
+@pytest.mark.slow
+def test_ml_evaluator_beats_default_p50(tmp_path):
+    cfg = ABConfig(
+        n_daemons=6,
+        n_slow=3,
+        n_tasks=3,
+        pieces_per_task=3,
+        slow_delay_s=0.030,
+        fast_delay_s=0.001,
+    )
+    out = run_ab(cfg, workdir=str(tmp_path))
+    assert out["pieces_default"] == out["pieces_ml"] > 0
+    # the ml evaluator must steer children away from loaded parents...
+    assert out["slow_parent_fraction_ml"] < out["slow_parent_fraction_default"]
+    # ...and win the headline metric
+    assert out["p50_ml_ms"] < out["p50_default_ms"], out

@@ -194,3 +194,21 @@ def test_import_announce_seeds_swarm(cluster, tmp_path):
     ts_b = db.storage.find_completed_task(task_id)
     traffic = {p.traffic_type for p in ts_b.meta.pieces.values()}
     assert traffic == {TRAFFIC_REMOTE_PEER}, f"expected pure P2P, got {traffic}"
+
+
+def test_host_stats_flow_into_download_records(cluster):
+    """The features the MLP trains on (host cpu/mem/disk/tcp columns)
+    must be alive in written Download records, end to end: daemon sampling
+    → AnnounceHost → resource.Host → record (VERDICT r1 weak #2)."""
+    da, _ = cluster["daemons"]
+    url = cluster["url"]
+    tmp = cluster["tmp"]
+    dfget.download(f"127.0.0.1:{da.port}", url, str(tmp / "stats-out.bin"))
+
+    records = list(cluster["storage"].list_download())
+    assert records
+    host = records[-1].host
+    assert host.memory.used_percent > 0
+    assert host.memory.total > 0
+    assert host.disk.total > 0
+    assert host.cpu.logical_count > 0

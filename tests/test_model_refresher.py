@@ -1,0 +1,119 @@
+"""The train→serve loop's last hop: manager-activated models must reach a
+running scheduler's MLEvaluator (reference designed this flow but left it
+TODO at evaluator.go:53 / model.go:109 — see scheduler/model_refresher.py).
+"""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import manager_pb2  # noqa: E402
+
+from dragonfly2_tpu.manager.database import Database
+from dragonfly2_tpu.manager.models_registry import ModelRegistry
+from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+from dragonfly2_tpu.manager.service import SERVICE_NAME, ManagerService
+from dragonfly2_tpu.rpc.glue import ServiceClient, dial, serve
+from dragonfly2_tpu.scheduler.evaluator import MLEvaluator
+from dragonfly2_tpu.scheduler.model_refresher import ModelRefresher
+from dragonfly2_tpu.schema.features import MLP_FEATURE_NAMES
+from dragonfly2_tpu.trainer.serving import serialize_params
+
+
+@pytest.fixture
+def manager(tmp_path):
+    db = Database(tmp_path / "manager.db")
+    registry = ModelRegistry(db, FSObjectStorage(tmp_path / "objects"))
+    service = ManagerService(db, registry)
+    server, port = serve({SERVICE_NAME: service})
+    channel = dial(f"127.0.0.1:{port}")
+    client = ServiceClient(channel, SERVICE_NAME)
+    yield client
+    channel.close()
+    server.stop(0)
+
+
+def _mlp_params(seed: int = 0):
+    import jax
+
+    from dragonfly2_tpu.models.mlp import init_mlp
+
+    return init_mlp(jax.random.PRNGKey(seed), [len(MLP_FEATURE_NAMES), 16, 1])
+
+
+def _upload(client, params, model_id="mlp-model", cluster_id=1):
+    client.CreateModel(
+        manager_pb2.CreateModelRequest(
+            model_id=model_id,
+            type="mlp",
+            ip="10.0.0.1",
+            hostname="trainer-host",
+            weights=serialize_params(params),
+            evaluation=manager_pb2.ModelEvaluation(mse=0.1, mae=0.2),
+            scheduler_cluster_id=cluster_id,
+        )
+    )
+
+
+def test_refresher_installs_active_model(manager):
+    evaluator = MLEvaluator()
+    refresher = ModelRefresher(manager, evaluator, scheduler_cluster_id=1)
+
+    # upload v1 but do NOT activate: refresher must not install it
+    params = _mlp_params()
+    _upload(manager, params)
+    assert not refresher.refresh_once()
+    assert evaluator._model is None
+
+    # activate → install
+    manager.UpdateModel(
+        manager_pb2.UpdateModelRequest(model_id="mlp-model", version=1, state="active")
+    )
+    assert refresher.refresh_once()
+    assert refresher.loaded_version == ("mlp-model", 1)
+    scorer = evaluator._model
+    assert scorer is not None
+
+    # the installed scorer must agree with direct application of the
+    # uploaded params (weights round-tripped through npz + auto-structure)
+    from dragonfly2_tpu.models.mlp import score_parents
+
+    feats = np.random.default_rng(0).random((4, len(MLP_FEATURE_NAMES))).astype(np.float32)
+    want = np.asarray(score_parents(params, feats))
+    np.testing.assert_allclose(scorer.predict(feats), want, rtol=1e-5)
+
+    # same version again: no reinstall
+    assert not refresher.refresh_once()
+
+
+def test_refresher_upgrades_and_withdraws(manager):
+    evaluator = MLEvaluator()
+    refresher = ModelRefresher(manager, evaluator, scheduler_cluster_id=1)
+
+    _upload(manager, _mlp_params(0))
+    manager.UpdateModel(
+        manager_pb2.UpdateModelRequest(model_id="mlp-model", version=1, state="active")
+    )
+    assert refresher.refresh_once()
+
+    # v2 activation flips serving to the new version
+    _upload(manager, _mlp_params(1))
+    manager.UpdateModel(
+        manager_pb2.UpdateModelRequest(model_id="mlp-model", version=2, state="active")
+    )
+    assert refresher.refresh_once()
+    assert refresher.loaded_version == ("mlp-model", 2)
+
+    # corrupt v3: refresher must keep serving v2
+    manager.CreateModel(
+        manager_pb2.CreateModelRequest(
+            model_id="mlp-model", type="mlp", weights=b"not-an-npz",
+            evaluation=manager_pb2.ModelEvaluation(), scheduler_cluster_id=1,
+        )
+    )
+    manager.UpdateModel(
+        manager_pb2.UpdateModelRequest(model_id="mlp-model", version=3, state="active")
+    )
+    assert not refresher.refresh_once()
+    assert refresher.loaded_version == ("mlp-model", 2)
+    assert evaluator._model is not None
