@@ -80,6 +80,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.df_pairs_errors.argtypes = [c_void_p]
     lib.df_pairs_errors.restype = c_long
     lib.df_pairs_export.argtypes = [c_void_p, f32_p, f32_p, i32_p]
+    lib.df_pairs_take.argtypes = [c_void_p, f32_p, f32_p, i32_p]
+    lib.df_pairs_take.restype = c_long
     lib.df_topo_rows.argtypes = [c_void_p]
     lib.df_topo_rows.restype = c_long
 
@@ -186,6 +188,59 @@ def decode_pairs_file(path: str | Path, offset: int = 0) -> PairExamples | None:
         )
     finally:
         lib.df_pairs_free(handle)
+
+
+def stream_pairs_file(
+    paths,
+    passes: int = 1,
+    chunk_bytes: int = _CHUNK,
+    max_records: int | None = None,
+):
+    """Stream-decode download-record CSV file(s) into (features, labels)
+    numpy shards — one shard per fed chunk — in bounded memory (the
+    accumulated pairs are taken out of the native parser after every
+    chunk). Yields ``(feats [m, F], labels [m], cumulative_download_rows)``.
+    ``passes`` re-reads the file list (benchmark loops); ``max_records``
+    stops after that many download records. Raises RuntimeError when the
+    native library is unavailable (callers needing a fallback use
+    decode_pairs_file)."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native ingestion library unavailable")
+    if isinstance(paths, (str, Path)):
+        paths = [paths]
+    handle = lib.df_pairs_new()
+    decoded_rows = 0
+    try:
+        for _ in range(passes):
+            for path in paths:
+                with open(path, "rb") as f:
+                    while True:
+                        chunk = f.read(chunk_bytes)
+                        if not chunk:
+                            break
+                        lib.df_pairs_feed(handle, chunk, len(chunk))
+                        yield _take(lib, handle)
+                        if max_records is not None:
+                            decoded_rows = lib.df_pairs_rows(handle)
+                            if decoded_rows >= max_records:
+                                lib.df_pairs_finish(handle)
+                                yield _take(lib, handle)
+                                return
+        lib.df_pairs_finish(handle)
+        yield _take(lib, handle)
+    finally:
+        lib.df_pairs_free(handle)
+
+
+def _take(lib, handle):
+    m = lib.df_pairs_count(handle)
+    feats = np.empty((m, MLP_FEATURE_DIM), dtype=np.float32)
+    labels = np.empty((m,), dtype=np.float32)
+    idx = np.empty((m,), dtype=np.int32)
+    if m:
+        lib.df_pairs_take(handle, feats, labels, idx)
+    return feats, labels, int(lib.df_pairs_rows(handle))
 
 
 def build_probe_graph_file(

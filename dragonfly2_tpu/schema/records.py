@@ -16,6 +16,7 @@ high-throughput trainer path.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from dataclasses import dataclass, field, fields
 from typing import Any, get_args, get_origin, get_type_hints
 
@@ -231,22 +232,49 @@ def _is_record(t: Any) -> bool:
     return dataclasses.is_dataclass(t) and isinstance(t, type)
 
 
+@functools.lru_cache(maxsize=None)
+def _hints(cls: type) -> dict[str, Any]:
+    """get_type_hints re-evaluates annotations on every call — far too
+    slow for the per-record hot path; one resolution per class."""
+    return get_type_hints(cls)
+
+
+@functools.lru_cache(maxsize=None)
+def _flat_plan(cls: type) -> tuple:
+    """Compiled flatten schedule per record class: (name, kind, extra)
+    rows, with the flat form of a default-constructed list element
+    precomputed so padding costs a dict-update, not an object graph."""
+    plan = []
+    hints = _hints(cls)
+    for f in fields(cls):
+        hint = hints[f.name]
+        if get_origin(hint) is list:
+            width, elem_cls = _LIST_WIDTHS[(cls, f.name)]
+            empty_flat = tuple(flatten(elem_cls()).items())
+            plan.append((f.name, "list", (width, empty_flat)))
+        elif _is_record(hint):
+            plan.append((f.name, "record", None))
+        else:
+            plan.append((f.name, "scalar", None))
+    return tuple(plan)
+
+
 def flatten(rec: Any, prefix: str = "") -> dict[str, Any]:
     """Flatten a record into dotted keys; fixed-width lists are padded with
     default-constructed elements so every row has identical columns."""
     out: dict[str, Any] = {}
-    cls = type(rec)
-    hints = get_type_hints(cls)
-    for f in fields(rec):
-        key = f"{prefix}{f.name}"
-        value = getattr(rec, f.name)
-        hint = hints[f.name]
-        if get_origin(hint) is list:
-            width, elem_cls = _LIST_WIDTHS[(cls, f.name)]
-            items = list(value[:width]) + [elem_cls() for _ in range(width - len(value))]
-            for i, item in enumerate(items):
+    for name, kind, extra in _flat_plan(type(rec)):
+        key = f"{prefix}{name}"
+        value = getattr(rec, name)
+        if kind == "list":
+            width, empty_flat = extra
+            for i, item in enumerate(value[:width]):
                 out.update(flatten(item, prefix=f"{key}.{i}."))
-        elif _is_record(hint):
+            for i in range(len(value), width):
+                p = f"{key}.{i}."
+                for k, v in empty_flat:
+                    out[p + k] = v
+        elif kind == "record":
             out.update(flatten(value, prefix=f"{key}."))
         else:
             out[key] = value
@@ -256,7 +284,7 @@ def flatten(rec: Any, prefix: str = "") -> dict[str, Any]:
 def unflatten(cls: type, row: dict[str, Any], prefix: str = "") -> Any:
     """Rebuild a record from dotted keys, coercing strings from CSV."""
     kwargs: dict[str, Any] = {}
-    hints = get_type_hints(cls)
+    hints = _hints(cls)
     for f in fields(cls):
         key = f"{prefix}{f.name}"
         hint = hints[f.name]

@@ -1,0 +1,156 @@
+"""Streaming ingestion: bytes-on-disk → native decode → device feed →
+trained params, in bounded host memory with decode/transfer/compute
+overlapped.
+
+This is the hard part of the 1B-records-in-10-min north star (SURVEY §7:
+~1.7M records/s sustained): the reference's Train stream lands CSV files
+on the trainer's disk (reference trainer/storage/storage.go:44-148,
+announcer 128 MiB-chunk upload announcer.go:39-41); from there this
+module drives the fused C++ CSV→tensor decoder (native/dfnative.cc) in a
+producer thread, packs pair shards into fixed-size minibatches, and feeds
+the jitted train step — the decode of chunk k+1 overlaps the device step
+on batch k (ctypes releases the GIL during native parsing; XLA dispatch
+is async).
+
+Memory bound: the shard queue holds ≤ ``queue_depth`` chunks of decoded
+pairs (~chunk_bytes of CSV each) plus one packing buffer — independent of
+file size.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
+from dragonfly2_tpu.schema import native
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("trainer.ingest")
+
+
+@dataclass
+class StreamStats:
+    download_records: int = 0
+    pairs: int = 0
+    steps: int = 0
+    wall_s: float = 0.0
+    decode_wait_s: float = 0.0  # consumer time blocked on the decoder
+    losses: list = field(default_factory=list)
+
+    @property
+    def records_per_s(self) -> float:
+        return self.download_records / self.wall_s if self.wall_s else 0.0
+
+
+def stream_shards(
+    paths,
+    passes: int = 1,
+    max_records: int | None = None,
+    queue_depth: int = 4,
+    chunk_bytes: int = 8 * 1024 * 1024,
+):
+    """Generator of (feats, labels, cumulative_rows) shards, decoded by a
+    background producer thread through a bounded queue."""
+    q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
+    error: list[BaseException] = []
+
+    def produce():
+        try:
+            for shard in native.stream_pairs_file(
+                paths, passes=passes, chunk_bytes=chunk_bytes, max_records=max_records
+            ):
+                q.put(shard)
+        except BaseException as e:  # surfaced to the consumer
+            error.append(e)
+        finally:
+            q.put(None)
+
+    t = threading.Thread(target=produce, name="ingest-decode", daemon=True)
+    t.start()
+    while True:
+        shard = q.get()
+        if shard is None:
+            break
+        yield shard
+    t.join()
+    if error:
+        raise error[0]
+
+
+def stream_train_mlp(
+    paths,
+    passes: int = 1,
+    max_records: int | None = None,
+    batch_size: int = 65_536,
+    hidden_dims: tuple[int, ...] = (256, 256),
+    learning_rate: float = 3e-3,
+    queue_depth: int = 4,
+    params=None,
+) -> tuple[object, StreamStats]:
+    """Fit the MLP parent scorer directly off disk bytes. Returns
+    (params, StreamStats). Partial trailing batches are dropped (static
+    shapes keep one XLA executable hot)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dragonfly2_tpu.models import mlp as mlp_mod
+
+    optimizer = optax.adamw(learning_rate, weight_decay=1e-4)
+    if params is None:
+        params = mlp_mod.init_mlp(
+            jax.random.PRNGKey(0), [MLP_FEATURE_DIM, *hidden_dims, 1]
+        )
+    opt_state = optimizer.init(params)
+
+    def loss_fn(p, xb, yb):
+        pred = mlp_mod.score_parents(p, xb)
+        return jnp.mean((pred - yb) ** 2)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    stats = StreamStats()
+    # packing buffer: fixed [batch_size, F], filled from variable shards
+    xbuf = np.empty((batch_size, MLP_FEATURE_DIM), np.float32)
+    ybuf = np.empty((batch_size,), np.float32)
+    fill = 0
+    pending_loss = None
+    t0 = time.perf_counter()
+
+    for feats, labels, rows in stream_shards(
+        paths,
+        passes=passes,
+        max_records=max_records,
+        queue_depth=queue_depth,
+    ):
+        stats.download_records = rows
+        stats.pairs += feats.shape[0]
+        off = 0
+        while off < feats.shape[0]:
+            take = min(batch_size - fill, feats.shape[0] - off)
+            xbuf[fill : fill + take] = feats[off : off + take]
+            ybuf[fill : fill + take] = labels[off : off + take]
+            fill += take
+            off += take
+            if fill == batch_size:
+                # async dispatch: the host returns to decoding while the
+                # chip trains this batch
+                params, opt_state, pending_loss = step(
+                    params, opt_state, jnp.asarray(xbuf), jnp.asarray(ybuf)
+                )
+                stats.steps += 1
+                fill = 0
+    if pending_loss is not None:
+        stats.losses.append(float(jax.block_until_ready(pending_loss)))
+    stats.wall_s = time.perf_counter() - t0
+    return params, stats

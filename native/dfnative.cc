@@ -29,6 +29,10 @@
 #include <unordered_map>
 #include <vector>
 
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
 namespace {
 
 constexpr int kMaxParents = 20;     // schema/records.py MAX_PARENTS
@@ -102,14 +106,56 @@ bool split_csv_line(const char* line, size_t len, std::vector<FieldRef>& out,
   return true;
 }
 
-double to_num(const FieldRef& f) {
-  if (f.len == 0) return 0.0;
+double to_num_slow(const char* p, size_t n) {
   char buf[64];
-  size_t n = f.len < sizeof(buf) - 1 ? f.len : sizeof(buf) - 1;
-  memcpy(buf, f.data, n);
-  buf[n] = '\0';
+  size_t m = n < sizeof(buf) - 1 ? n : sizeof(buf) - 1;
+  memcpy(buf, p, m);
+  buf[m] = '\0';
   return strtod(buf, nullptr);
 }
+
+// Fast decimal parse for the hot path: [-]digits[.digits]; anything else
+// (exponents, >18 digits, inf/nan) falls back to strtod. CSV numbers here
+// are short host stats and ns costs, so the fast path covers ~all fields.
+double parse_num(const char* p, size_t n) {
+  if (n == 0) return 0.0;
+  static const double kPow10[] = {1.0,    1e1,  1e2,  1e3,  1e4,  1e5,  1e6,
+                                  1e7,    1e8,  1e9,  1e10, 1e11, 1e12, 1e13,
+                                  1e14,   1e15, 1e16, 1e17, 1e18};
+  size_t i = 0;
+  bool neg = false;
+  if (p[0] == '-') {
+    neg = true;
+    i = 1;
+  }
+  uint64_t ip = 0;
+  size_t digits = 0;
+  for (; i < n; ++i) {
+    unsigned d = unsigned(p[i]) - '0';
+    if (d > 9) break;
+    ip = ip * 10 + d;
+    if (++digits > 18) return to_num_slow(p, n);
+  }
+  if (digits == 0) return to_num_slow(p, n);
+  if (i == n) return neg ? -double(ip) : double(ip);
+  if (p[i] != '.') return to_num_slow(p, n);
+  ++i;
+  uint64_t fp = 0;
+  size_t fd = 0;
+  for (; i < n; ++i) {
+    unsigned d = unsigned(p[i]) - '0';
+    if (d > 9) break;
+    if (fd < 18) {
+      fp = fp * 10 + d;
+      ++fd;
+    }
+  }
+  if (i != n) return to_num_slow(p, n);
+  double v = double(ip) + double(fp) / kPow10[fd];
+  return neg ? -v : v;
+}
+
+double to_num(const FieldRef& f) { return parse_num(f.data, f.len); }
 
 // Shared leading "|"-separated path depth / kMaxLocationDepth
 // (features.location_affinity).
@@ -143,27 +189,45 @@ double location_affinity(const std::string& a, const std::string& b) {
 template <typename RowFn>
 void feed_lines(std::string& carry, bool& in_quotes, const char* buf, long len,
                 RowFn&& on_line) {
-  long start = 0;
-  for (long i = 0; i < len; ++i) {
-    const char ch = buf[i];
-    if (ch == '"') {
-      in_quotes = !in_quotes;
-    } else if (ch == '\n' && !in_quotes) {
-      if (!carry.empty()) {
-        carry.append(buf + start, i - start);
-        size_t L = carry.size();
-        if (L && carry[L - 1] == '\r') --L;
-        on_line(carry.data(), L);
-        carry.clear();
-      } else {
-        size_t L = i - start;
-        if (L && buf[i - 1] == '\r') --L;
-        on_line(buf + start, L);
-      }
-      start = i + 1;
+  long pos = 0;
+  while (pos < len) {
+    const char* nl =
+        static_cast<const char*>(memchr(buf + pos, '\n', size_t(len - pos)));
+    long end = nl ? long(nl - buf) : len;
+    // quote parity over [pos, end): all segment quotes precede the
+    // newline, so parity-after tells whether the newline is data
+    long q = pos;
+    long quotes = 0;
+    while (q < end) {
+      const char* qp =
+          static_cast<const char*>(memchr(buf + q, '"', size_t(end - q)));
+      if (!qp) break;
+      ++quotes;
+      q = long(qp - buf) + 1;
     }
+    if (quotes & 1) in_quotes = !in_quotes;
+    if (!nl) {  // chunk ends mid-record
+      carry.append(buf + pos, size_t(len - pos));
+      return;
+    }
+    if (in_quotes) {  // newline inside a quoted field is data
+      carry.append(buf + pos, size_t(end - pos + 1));
+      pos = end + 1;
+      continue;
+    }
+    if (!carry.empty()) {
+      carry.append(buf + pos, size_t(end - pos));
+      size_t L = carry.size();
+      if (L && carry[L - 1] == '\r') --L;
+      on_line(carry.data(), L);
+      carry.clear();
+    } else {
+      size_t L = size_t(end - pos);
+      if (L && buf[end - 1] == '\r') --L;
+      on_line(buf + pos, L);
+    }
+    pos = end + 1;
   }
-  if (start < len) carry.append(buf + start, len - start);
 }
 
 // ---------------------------------------------------------------------------
@@ -219,6 +283,8 @@ struct ParentScratch {
 
 struct DfPairs {
   std::vector<ColAction> colmap;
+  std::vector<uint32_t> hot_cols;  // ascending indices of non-ignored columns
+  std::vector<uint32_t> skip_on_empty;  // hot-index jump when a P_ID is empty
   std::string header_col0;
   std::string carry;        // partial record across feed() chunks
   bool in_quotes = false;   // RFC4180 quote parity across chunks
@@ -282,10 +348,86 @@ struct DfPairs {
       }
       colmap[c] = a;
     }
+    hot_cols.clear();
+    for (size_t c = 0; c < colmap.size(); ++c)
+      if (colmap[c].kind != C_IGNORE) hot_cols.push_back(uint32_t(c));
+    // Empty-slot fast-forward: when a parent's id column is empty the
+    // whole slot is padding, so the scan can jump to the first hot column
+    // NOT belonging to that parent. This is what keeps 20-slot padded
+    // rows near the cost of their populated prefix.
+    skip_on_empty.assign(hot_cols.size(), 0);
+    for (size_t hi = 0; hi < hot_cols.size(); ++hi) {
+      const ColAction a = colmap[hot_cols[hi]];
+      if (a.kind != P_ID) continue;
+      size_t hj = hi + 1;
+      while (hj < hot_cols.size()) {
+        const ColAction b = colmap[hot_cols[hj]];
+        const bool same_parent = b.kind >= P_ID && b.parent == a.parent;
+        if (!same_parent) break;
+        ++hj;
+      }
+      skip_on_empty[hi] = uint32_t(hj);
+    }
+  }
+
+  inline void dispatch(const ColAction a, const char* p, size_t n) {
+    // empty fields (padding parent slots) keep their reset() defaults —
+    // skipping them is what makes padded 20-slot rows cheap
+    if (n == 0) return;
+    const FieldRef f{p, n};
+    ParentScratch& ps = parents[a.parent];
+    switch (a.kind) {
+      case C_TOTAL_PIECES: total_pieces = to_num(f); break;
+      case C_CHILD_IDC: child_idc.assign(p, n); break;
+      case C_CHILD_LOC: child_loc.assign(p, n); break;
+      case P_ID: ps.has_id = true; break;
+      case P_STATE: ps.succeeded = f.eq("Succeeded"); break;
+      case P_FIN: ps.fin = to_num(f); break;
+      case P_UPLOAD_COUNT: ps.upload_count = to_num(f); break;
+      case P_UPLOAD_FAILED: ps.upload_failed = to_num(f); break;
+      case P_CUL: ps.cul = to_num(f); break;
+      case P_CUC: ps.cuc = to_num(f); break;
+      case P_TYPE: ps.is_seed = !f.eq("normal"); break;
+      case P_IDC: ps.idc.assign(p, n); break;
+      case P_LOC: ps.loc.assign(p, n); break;
+      case P_CPU: ps.cpu = to_num(f); break;
+      case P_MEM: ps.mem = to_num(f); break;
+      case P_TCP: ps.tcp = to_num(f); break;
+      case P_UTCP: ps.utcp = to_num(f); break;
+      case P_DISK: ps.disk = to_num(f); break;
+      case P_PIECE_COST: ps.piece_cost[a.piece] = to_num(f); break;
+      default: break;
+    }
+  }
+
+  void reset_scratch() {
+    total_pieces = 0;
+    child_idc.clear();
+    child_loc.clear();
+    for (auto& p : parents) p.reset();
+  }
+
+  bool looks_like_header(const char* line, size_t len) const {
+    const size_t h = header_col0.size();
+    return h && len >= h && memcmp(line, header_col0.data(), h) == 0 &&
+           (len == h || line[h] == ',');
   }
 
   void on_line(const char* line, size_t len) {
     if (len == 0) return;
+    if (colmap.empty() || looks_like_header(line, len) ||
+        memchr(line, '"', len) != nullptr) {
+      on_line_slow(line, len);
+      return;
+    }
+    reset_scratch();
+    scan_row_fast(line, len);
+    emit_row();
+    ++row;
+  }
+
+  // Header lines and RFC4180-quoted rows: full split + mapped walk.
+  void on_line_slow(const char* line, size_t len) {
     if (!split_csv_line(line, len, fields, scratch)) {
       ++errors;
       return;
@@ -297,42 +439,89 @@ struct DfPairs {
       resolve_header(fields);
       return;
     }
-    total_pieces = 0;
-    child_idc.clear();
-    child_loc.clear();
-    for (auto& p : parents) p.reset();
-
+    reset_scratch();
     size_t n = fields.size() < colmap.size() ? fields.size() : colmap.size();
     for (size_t c = 0; c < n; ++c) {
       const ColAction a = colmap[c];
       if (a.kind == C_IGNORE) continue;
-      const FieldRef& f = fields[c];
-      ParentScratch& ps = parents[a.parent];
-      switch (a.kind) {
-        case C_TOTAL_PIECES: total_pieces = to_num(f); break;
-        case C_CHILD_IDC: child_idc = f.view(); break;
-        case C_CHILD_LOC: child_loc = f.view(); break;
-        case P_ID: ps.has_id = !f.empty(); break;
-        case P_STATE: ps.succeeded = f.eq("Succeeded"); break;
-        case P_FIN: ps.fin = to_num(f); break;
-        case P_UPLOAD_COUNT: ps.upload_count = to_num(f); break;
-        case P_UPLOAD_FAILED: ps.upload_failed = to_num(f); break;
-        case P_CUL: ps.cul = to_num(f); break;
-        case P_CUC: ps.cuc = to_num(f); break;
-        case P_TYPE: ps.is_seed = !f.empty() && !f.eq("normal"); break;
-        case P_IDC: ps.idc = f.view(); break;
-        case P_LOC: ps.loc = f.view(); break;
-        case P_CPU: ps.cpu = to_num(f); break;
-        case P_MEM: ps.mem = to_num(f); break;
-        case P_TCP: ps.tcp = to_num(f); break;
-        case P_UTCP: ps.utcp = to_num(f); break;
-        case P_DISK: ps.disk = to_num(f); break;
-        case P_PIECE_COST: ps.piece_cost[a.piece] = to_num(f); break;
-        default: break;
-      }
+      dispatch(a, fields[c].data, fields[c].len);
     }
     emit_row();
     ++row;
+  }
+
+  // Unquoted data rows (the overwhelmingly common case): one pass over the
+  // line, finding commas 32 bytes at a time (AVX2) and materializing only
+  // the ~hot columns the feature extractor reads. Runs of ignored columns
+  // — including the empty padding parent slots — are consumed by popcount
+  // without touching individual fields.
+  void scan_row_fast(const char* line, size_t len) {
+    const size_t nhot = hot_cols.size();
+    size_t hi = 0;
+    uint32_t next_hot = nhot ? hot_cols[0] : 0xffffffffu;
+    uint32_t c = 0;        // current column index
+    size_t field_start = 0;
+    size_t i = 0;
+#if defined(__AVX2__)
+    const __m256i commas = _mm256_set1_epi8(',');
+    while (i + 32 <= len && hi < nhot) {
+      const __m256i v =
+          _mm256_loadu_si256(reinterpret_cast<const __m256i*>(line + i));
+      uint32_t m =
+          uint32_t(_mm256_movemask_epi8(_mm256_cmpeq_epi8(v, commas)));
+      if (m == 0) {
+        i += 32;
+        continue;
+      }
+      const int cnt = __builtin_popcount(m);
+      if (c + uint32_t(cnt) < next_hot) {
+        // every comma in this block belongs to ignored columns — consume
+        // them in bulk; the in-progress field after the block starts
+        // right past the last comma
+        c += uint32_t(cnt);
+        field_start = i + size_t(31 - __builtin_clz(m)) + 1;
+        i += 32;
+        continue;
+      }
+      while (m) {
+        const uint32_t b = uint32_t(__builtin_ctz(m));
+        m &= m - 1;
+        const size_t pos = i + b;
+        if (c == next_hot) {
+          const size_t flen = pos - field_start;
+          if (flen == 0 && skip_on_empty[hi]) {
+            hi = skip_on_empty[hi];  // empty parent id → skip the slot
+          } else {
+            dispatch(colmap[c], line + field_start, flen);
+            ++hi;
+          }
+          next_hot = hi < nhot ? hot_cols[hi] : 0xffffffffu;
+        }
+        ++c;
+        field_start = pos + 1;
+        if (hi >= nhot) return;
+      }
+      i += 32;
+    }
+#endif
+    for (; i < len && hi < nhot; ++i) {
+      if (line[i] != ',') continue;
+      if (c == next_hot) {
+        const size_t flen = i - field_start;
+        if (flen == 0 && skip_on_empty[hi]) {
+          hi = skip_on_empty[hi];
+        } else {
+          dispatch(colmap[c], line + field_start, flen);
+          ++hi;
+        }
+        next_hot = hi < nhot ? hot_cols[hi] : 0xffffffffu;
+      }
+      ++c;
+      field_start = i + 1;
+    }
+    // trailing field (no comma after the last column)
+    if (hi < nhot && c == next_hot && field_start <= len)
+      dispatch(colmap[c], line + field_start, len - field_start);
   }
 
   void emit_row() {
@@ -589,6 +778,22 @@ void df_pairs_export(DfPairs* d, float* feat, float* label, int32_t* idx) {
   memcpy(feat, d->feat.data(), d->feat.size() * sizeof(float));
   memcpy(label, d->label.data(), d->label.size() * sizeof(float));
   memcpy(idx, d->index.data(), d->index.size() * sizeof(int32_t));
+}
+
+// Streaming variant: export the pairs accumulated since the last take and
+// clear the buffers, so a long decode runs in bounded memory (caller
+// sizes the output with df_pairs_count between feed and take — same
+// thread drives both). Parser state (carry, colmap) is untouched, so
+// takes interleave freely with feeds mid-stream.
+long df_pairs_take(DfPairs* d, float* feat, float* label, int32_t* idx) {
+  long m = long(d->label.size());
+  memcpy(feat, d->feat.data(), d->feat.size() * sizeof(float));
+  memcpy(label, d->label.data(), d->label.size() * sizeof(float));
+  memcpy(idx, d->index.data(), d->index.size() * sizeof(int32_t));
+  d->feat.clear();
+  d->label.clear();
+  d->index.clear();
+  return m;
 }
 
 DfTopo* df_topo_new() { return new DfTopo(); }
