@@ -1,5 +1,6 @@
 #!/usr/bin/env python3
-"""Headline benchmark: MLP parent-scorer trainer throughput (records/sec/chip).
+"""Headline benchmark: MLP parent-scorer trainer throughput, measured
+end-to-end from bytes on disk (records/sec/chip).
 
 North star (BASELINE.json): train the parent scorer on 1B download records
 on a v5e-8 in <10 min ⇒ ~208,333 records/sec/chip sustained. The reference
@@ -10,80 +11,138 @@ derived per-chip north-star rate.
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "records/sec/chip", "vs_baseline": N}
 
-Method: synthesize pair-feature tensors (the post-ingestion form of
-scheduler download records), stack into device-resident [steps, batch, F]
-minibatches, run the jitted whole-epoch lax.scan train step (the same code
-path trainer.train.train_mlp uses), discard the compile epoch, then time
-steady-state epochs.
+Method (the production ingestion path, not device-resident tensors):
+synthesize a realistic download-record CSV dataset ON DISK — the exact
+byte format the scheduler's Train-stream upload lands in trainer storage
+(reference scheduler/storage CSV schema, trainer/storage/storage.go:44-148)
+— then run trainer.ingest.stream_train_mlp over it: fused C++ CSV→tensor
+decode (native/dfnative.cc) in producer threads, overlapped with the
+jitted train step on the chip. The timed region covers decode + H2D +
+train; a short warmup run compiles the step first so steady state is
+measured, as the north star is a sustained-rate target.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
+import tempfile
 import time
 
-import numpy as np
+
+def synthesize_dataset(d: str, shards: int, shard_bytes: int) -> list:
+    """Write `shards` CSV files of ~shard_bytes each by replicating a
+    2,000-record synthetic body (per-record decode cost is content-size
+    driven, not uniqueness driven). Returns the shard paths; record
+    counts come from the decoder itself (stats.download_records)."""
+    from dragonfly2_tpu.schema.columnar import write_csv
+    from dragonfly2_tpu.schema.synth import make_download_records
+
+    base = os.path.join(d, "base.csv")
+    write_csv(base, make_download_records(2000, seed=0))
+    with open(base, "rb") as f:
+        data = f.read()
+    nl = data.index(b"\n")
+    header, body = data[: nl + 1], data[nl + 1 :]
+    reps = max(1, shard_bytes // len(body))
+    paths = []
+    for s in range(shards):
+        p = os.path.join(d, f"shard{s}.csv")
+        with open(p, "wb") as f:
+            f.write(header)
+            for _ in range(reps):
+                f.write(body)
+        paths.append(p)
+    return paths
 
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
 
-    from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
-    from dragonfly2_tpu.schema.synth import make_pair_tensors
-    from dragonfly2_tpu.models import mlp as mlp_mod
-    from dragonfly2_tpu.trainer import train as T
+    from dragonfly2_tpu.schema import native
+    from dragonfly2_tpu.trainer.ingest import stream_train_mlp
+
+    if not native.available():
+        print(
+            json.dumps(
+                {
+                    "metric": "mlp_trainer_throughput",
+                    "value": 0,
+                    "unit": "records/sec/chip",
+                    "vs_baseline": 0,
+                    "error": "native ingestion library unavailable",
+                }
+            )
+        )
+        sys.exit(0)
 
     n_devices = jax.device_count()
+    ncpu = os.cpu_count() or 1
+    # measured on the 1-core runner: 2 producers beat 1 because decode
+    # fills the gaps where the consumer blocks in the H2D transfer; on
+    # multi-core hosts decode scales with real parallelism
+    workers = min(4, ncpu) if ncpu > 1 else 2
+    batch = 65_536
+    passes = 4
 
-    # Dataset sized for steady-state measurement; batch tuned for one v5e
-    # chip (bf16 matmuls, [B, 12] @ [12, 256] @ [256, 256] @ [256, 1]).
-    batch = 131_072
-    steps_per_epoch = 16
-    n = batch * steps_per_epoch
-    x, y = make_pair_tensors(n, seed=0)
+    # the per-chip rate divides by device_count, so with >1 chip train
+    # data-parallel over a dp mesh — otherwise the division undercounts
+    mesh = None
+    if n_devices > 1:
+        from dragonfly2_tpu.parallel.mesh import make_mesh
 
-    cfg = T.FitConfig(hidden_dims=(256, 256), batch_size=batch, epochs=1, seed=0)
-    optimizer = T._optimizer(cfg, steps_per_epoch * 8)
+        mesh = make_mesh(dp=n_devices)
 
-    key = jax.random.PRNGKey(0)
-    params = mlp_mod.init_mlp(key, [MLP_FEATURE_DIM, *cfg.hidden_dims, 1])
-    params["layers"][-1]["b"] = jnp.full((1,), float(y.mean()))
-    opt_state = optimizer.init(params)
+    with tempfile.TemporaryDirectory(prefix="dfbench-") as d:
+        paths = synthesize_dataset(
+            d, shards=max(workers * 2, 4), shard_bytes=128 * 1024 * 1024
+        )
 
-    def loss_fn(p, b):
-        xb, yb = b
-        pred = mlp_mod.score_parents(p, xb)
-        return jnp.mean((pred - yb) ** 2)
+        # steady-state setup: the north star is a sustained rate, so flush
+        # writeback (the synthesized shards are freshly written — dirty-page
+        # flush would steal CPU from the timed decode), warm the page cache
+        # (first read after write goes to disk) and compile the train step
+        # (cached in ingest._step_cache — the timed run reuses the
+        # executable)
+        os.sync()
+        for p in paths:
+            with open(p, "rb") as f:
+                while f.read(1 << 24):
+                    pass
+        stream_train_mlp(
+            paths[0],
+            passes=1,
+            max_records=40_000,
+            batch_size=batch,
+            workers=1,
+            mesh=mesh,  # same sharding signature as the timed run
+        )
 
-    epoch_fn = T.make_epoch_fn(loss_fn, optimizer)
+        t0 = time.perf_counter()
+        _, stats = stream_train_mlp(
+            paths,
+            passes=passes,
+            batch_size=batch,
+            workers=workers,
+            eval_every=0,  # throughput run: every record trains
+            mesh=mesh,
+        )
+        dt = time.perf_counter() - t0
 
-    xb = jnp.asarray(x.reshape(steps_per_epoch, batch, MLP_FEATURE_DIM))
-    yb = jnp.asarray(y.reshape(steps_per_epoch, batch))
-
-    # compile + warmup epoch (not timed)
-    params, opt_state, loss = epoch_fn(params, opt_state, (xb, yb))
-    jax.block_until_ready(loss)
-
-    timed_epochs = 5
-    t0 = time.perf_counter()
-    for _ in range(timed_epochs):
-        params, opt_state, loss = epoch_fn(params, opt_state, (xb, yb))
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-
-    records = n * timed_epochs
-    rec_per_sec = records / dt
-    rec_per_sec_per_chip = rec_per_sec / n_devices
-
+    rec_per_sec_per_chip = stats.download_records / dt / n_devices
     north_star_per_chip = 1e9 / 600 / 8  # 1B records / 10 min / v5e-8
     print(
         json.dumps(
             {
-                "metric": "mlp_trainer_throughput",
+                "metric": "mlp_trainer_throughput_e2e",
                 "value": round(rec_per_sec_per_chip, 1),
                 "unit": "records/sec/chip",
                 "vs_baseline": round(rec_per_sec_per_chip / north_star_per_chip, 3),
+                "records": stats.download_records,
+                "pairs": stats.pairs,
+                "steps": stats.steps,
+                "wall_s": round(dt, 2),
             }
         )
     )

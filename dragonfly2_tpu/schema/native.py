@@ -190,45 +190,153 @@ def decode_pairs_file(path: str | Path, offset: int = 0) -> PairExamples | None:
         lib.df_pairs_free(handle)
 
 
+def split_file_spans(path: str | Path, n: int, offset: int = 0) -> list[tuple]:
+    """Split ``[offset, size)`` of a CSV file into ≤ n record-aligned
+    ``(path, start, end)`` spans for parallel decode.
+
+    Record boundaries are newlines at even RFC4180 quote parity — a
+    newline inside a quoted field is data, so boundaries are found with
+    one streaming pass that tracks cumulative quote count (bytes.count is
+    memchr-speed; the pass costs far less than the decode it parallelizes
+    and only runs when n > 1). Spans after the first get the file's
+    header line re-fed (stream_pairs_file does this), which assumes one
+    schema per file — true for trainer dataset files unless the uploading
+    scheduler changed versions mid-file."""
+    size = Path(path).stat().st_size
+    if offset > size:
+        offset = 0  # stale committed offset beyond a recreated file
+    span = size - offset
+    n = max(1, min(n, span // max(_MIN_SPAN, 1) or 1))
+    if n == 1:
+        return [(str(path), offset, size)]
+    targets = [offset + span * i // n for i in range(1, n)]
+    bounds = [offset]
+    chunk_size = 8 * 1024 * 1024
+    with open(path, "rb") as f:
+        # committed offsets are record-aligned (round boundaries), so the
+        # quote parity at `offset` is even — start the scan there instead
+        # of re-reading consumed history
+        f.seek(offset)
+        quotes = 0  # cumulative quote count over [offset, pos)
+        pos = offset
+        ti = 0
+        pending = False  # a target was passed; boundary not yet found
+        while ti < len(targets) and pos < size:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                break
+            search_from = 0
+            while ti < len(targets):
+                if not pending:
+                    if pos + len(chunk) <= targets[ti]:
+                        break  # target beyond this chunk
+                    search_from = max(search_from, targets[ti] - pos)
+                    pending = True
+                # next newline at even global parity at-or-after search_from
+                at = search_from
+                found = -1
+                while True:
+                    nl = chunk.find(b"\n", at)
+                    if nl < 0:
+                        break
+                    if (quotes + chunk.count(b'"', 0, nl)) % 2 == 0:
+                        found = nl
+                        break
+                    at = nl + 1
+                if found < 0:
+                    break  # keep scanning in the next chunk
+                b = pos + found + 1
+                if bounds[-1] < b < size:
+                    bounds.append(b)
+                pending = False
+                search_from = found + 1
+                ti += 1
+                # collapse targets already behind the found boundary
+                while ti < len(targets) and targets[ti] < b:
+                    ti += 1
+            quotes += chunk.count(b'"')
+            pos += len(chunk)
+    bounds.append(size)
+    return [(str(path), s, e) for s, e in zip(bounds, bounds[1:]) if e > s]
+
+
+_MIN_SPAN = 8 * 1024 * 1024
+
+
+def _read_header_line(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.readline()
+
+
 def stream_pairs_file(
     paths,
     passes: int = 1,
     chunk_bytes: int = _CHUNK,
     max_records: int | None = None,
+    offset: int = 0,
 ):
     """Stream-decode download-record CSV file(s) into (features, labels)
     numpy shards — one shard per fed chunk — in bounded memory (the
     accumulated pairs are taken out of the native parser after every
     chunk). Yields ``(feats [m, F], labels [m], cumulative_download_rows)``.
-    ``passes`` re-reads the file list (benchmark loops); ``max_records``
-    stops after that many download records. Raises RuntimeError when the
-    native library is unavailable (callers needing a fallback use
-    decode_pairs_file)."""
+
+    ``paths`` entries are plain paths or ``(path, start, end)`` spans
+    (split_file_spans); a span starting mid-file gets the file's header
+    line re-fed first so the column mapping resolves. ``passes`` re-reads
+    the list (benchmark loops / multi-epoch streaming); ``max_records``
+    stops after that many download records; ``offset`` seeks the first
+    plain-path entry to a committed round boundary on EVERY pass — the
+    bytes before it are consumed history and never re-trained. Each
+    file/span boundary flushes the parser (a trailing record without a
+    newline belongs to its own span, never the next one). Raises
+    RuntimeError when the native library is unavailable (callers needing
+    a fallback use decode_pairs_file)."""
     lib = load()
     if lib is None:
         raise RuntimeError("native ingestion library unavailable")
     if isinstance(paths, (str, Path)):
         paths = [paths]
+    spans = []
+    for j, p in enumerate(paths):
+        if isinstance(p, tuple):
+            spans.append(p)
+        else:
+            start = offset if j == 0 else 0
+            size = Path(p).stat().st_size
+            if start > size:
+                start = 0  # stale offset beyond a recreated file
+            spans.append((str(p), start, size))
+    headers: dict[str, bytes] = {}
     handle = lib.df_pairs_new()
-    decoded_rows = 0
     try:
         for _ in range(passes):
-            for path in paths:
+            for path, start, end in spans:
                 with open(path, "rb") as f:
-                    while True:
-                        chunk = f.read(chunk_bytes)
+                    if start:
+                        # mid-file span: re-feed the header line so the
+                        # parser keys its column mapping
+                        h = headers.get(path)
+                        if h is None:
+                            h = headers[path] = _read_header_line(path)
+                        lib.df_pairs_feed(handle, h, len(h))
+                        f.seek(start)
+                    remaining = end - start
+                    while remaining > 0:
+                        chunk = f.read(min(chunk_bytes, remaining))
                         if not chunk:
                             break
+                        remaining -= len(chunk)
                         lib.df_pairs_feed(handle, chunk, len(chunk))
                         yield _take(lib, handle)
                         if max_records is not None:
-                            decoded_rows = lib.df_pairs_rows(handle)
-                            if decoded_rows >= max_records:
+                            if lib.df_pairs_rows(handle) >= max_records:
                                 lib.df_pairs_finish(handle)
                                 yield _take(lib, handle)
                                 return
-        lib.df_pairs_finish(handle)
-        yield _take(lib, handle)
+                # per-span flush: emit the last record even when it lacks
+                # a trailing newline, and reset quote parity
+                lib.df_pairs_finish(handle)
+                yield _take(lib, handle)
     finally:
         lib.df_pairs_free(handle)
 

@@ -6,15 +6,16 @@ This is the hard part of the 1B-records-in-10-min north star (SURVEY §7:
 ~1.7M records/s sustained): the reference's Train stream lands CSV files
 on the trainer's disk (reference trainer/storage/storage.go:44-148,
 announcer 128 MiB-chunk upload announcer.go:39-41); from there this
-module drives the fused C++ CSV→tensor decoder (native/dfnative.cc) in a
-producer thread, packs pair shards into fixed-size minibatches, and feeds
-the jitted train step — the decode of chunk k+1 overlaps the device step
-on batch k (ctypes releases the GIL during native parsing; XLA dispatch
-is async).
+module drives the fused C++ CSV→tensor decoder (native/dfnative.cc) in
+producer threads, packs pair shards into fixed-size minibatches, and
+feeds the jitted train step — the decode of chunk k+1 overlaps the
+device step on batch k (ctypes releases the GIL during native parsing;
+XLA dispatch is async). Multiple dataset files decode in parallel, one
+producer thread per file shard, each with its own parser handle.
 
 Memory bound: the shard queue holds ≤ ``queue_depth`` chunks of decoded
-pairs (~chunk_bytes of CSV each) plus one packing buffer — independent of
-file size.
+pairs (~chunk_bytes of CSV each) plus one packing buffer and a capped
+eval holdout — independent of file size.
 """
 
 from __future__ import annotations
@@ -23,6 +24,7 @@ import queue
 import threading
 import time
 from dataclasses import dataclass, field
+from pathlib import Path
 
 import numpy as np
 
@@ -38,9 +40,10 @@ class StreamStats:
     download_records: int = 0
     pairs: int = 0
     steps: int = 0
+    eval_pairs: int = 0
     wall_s: float = 0.0
-    decode_wait_s: float = 0.0  # consumer time blocked on the decoder
     losses: list = field(default_factory=list)
+    metrics: dict = field(default_factory=dict)  # mse/mae on the holdout
 
     @property
     def records_per_s(self) -> float:
@@ -53,33 +56,154 @@ def stream_shards(
     max_records: int | None = None,
     queue_depth: int = 4,
     chunk_bytes: int = 8 * 1024 * 1024,
+    offset: int = 0,
+    workers: int = 1,
 ):
-    """Generator of (feats, labels, cumulative_rows) shards, decoded by a
-    background producer thread through a bounded queue."""
+    """Generator of ``(feats, labels, total_rows)`` shards, decoded by
+    background producer thread(s) through a bounded queue. ``total_rows``
+    is the CUMULATIVE download-record count across everything yielded so
+    far (per-worker deltas are summed internally), so the last yielded
+    value is the whole stream's row count.
+
+    With ``workers > 1`` the dataset is split across that many producer
+    threads, each driving its own native parser — decode scales across
+    cores because ctypes releases the GIL. Fewer files than workers is
+    fine: files are split into newline-aligned byte spans
+    (native.split_file_spans), so one big per-host dataset file decodes
+    in parallel too. Shard order is then interleaved (fine for SGD).
+    ``offset`` (a committed round boundary in the first file) is
+    excluded on every pass. Abandoning the generator (consumer breaks
+    early / errors) releases the producers: they observe the stop event
+    instead of blocking forever on a full queue.
+    """
+    if isinstance(paths, (str, Path)):
+        paths = [paths]
+    paths = list(paths)
+    # resolve to (path, start, end) spans: applies the committed offset
+    # once (so every pass skips consumed history) and gives each worker
+    # a balanced byte share even when files < workers
+    per_file = max(1, -(-workers // len(paths)))  # ceil
+    spans = []
+    for j, p in enumerate(paths):
+        spans.extend(
+            native.split_file_spans(p, per_file, offset=offset if j == 0 else 0)
+        )
+    workers = max(1, min(workers, len(spans)))
+    # queue items: per-worker rows are deltas, so interleaving is additive
     q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
-    error: list[BaseException] = []
+    stop = threading.Event()
+    errors: list[BaseException] = []
 
-    def produce():
+    def produce(worker_spans):
         try:
-            for shard in native.stream_pairs_file(
-                paths, passes=passes, chunk_bytes=chunk_bytes, max_records=max_records
+            prev_rows = 0
+            for feats, labels, rows in native.stream_pairs_file(
+                worker_spans,
+                passes=passes,
+                chunk_bytes=chunk_bytes,
+                max_records=max_records,
             ):
-                q.put(shard)
+                item = (feats, labels, rows - prev_rows)
+                prev_rows = rows
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
         except BaseException as e:  # surfaced to the consumer
-            error.append(e)
+            errors.append(e)
         finally:
-            q.put(None)
+            while not stop.is_set():
+                try:
+                    q.put(None, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
 
-    t = threading.Thread(target=produce, name="ingest-decode", daemon=True)
-    t.start()
-    while True:
-        shard = q.get()
-        if shard is None:
-            break
-        yield shard
-    t.join()
-    if error:
-        raise error[0]
+    threads = []
+    for w in range(workers):
+        t = threading.Thread(
+            target=produce,
+            args=(spans[w::workers],),
+            name=f"ingest-decode-{w}",
+            daemon=True,
+        )
+        t.start()
+        threads.append(t)
+
+    done = 0
+    total_rows = 0
+    try:
+        while done < len(threads):
+            item = q.get()
+            if item is None:
+                done += 1
+                continue
+            feats, labels, delta_rows = item
+            total_rows += delta_rows
+            yield feats, labels, total_rows
+            if max_records is not None and total_rows >= max_records:
+                break
+    finally:
+        stop.set()
+        # drain so producers blocked on put() can see the event and exit
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        for t in threads:
+            t.join(timeout=5.0)
+    if errors:
+        raise errors[0]
+
+
+_step_cache: dict = {}
+
+
+def _get_step(learning_rate: float, weight_decay: float, warmup_steps: int = 64):
+    """(optimizer, jitted step) cached per optimizer config, so repeated
+    fits (and bench warmup vs timed run) reuse one compiled executable
+    per batch shape instead of retracing a fresh closure each call.
+
+    The schedule is linear warmup → constant: the streaming horizon is
+    unknown up front (records arrive as bytes decode), so the batch
+    path's cosine decay has no defined endpoint here; warmup covers the
+    same early-drift window (train.py warmup_fraction)."""
+    key = (learning_rate, weight_decay, warmup_steps)
+    if key in _step_cache:
+        return _step_cache[key]
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dragonfly2_tpu.models import mlp as mlp_mod
+
+    schedule = optax.linear_schedule(0.0, learning_rate, max(warmup_steps, 1))
+    optimizer = optax.adamw(schedule, weight_decay=weight_decay)
+
+    def loss_fn(p, xb, yb):
+        pred = mlp_mod.score_parents(p, xb)
+        return jnp.mean((pred - yb) ** 2)
+
+    @jax.jit
+    def step(params, opt_state, xy):
+        # one fused [B, F+1] transfer per batch (features ‖ label column):
+        # H2D calls have per-call cost, and the upcast from the reduced
+        # transfer dtype is free device-side (XLA fuses it into the first
+        # matmul's bf16 cast)
+        xy = xy.astype(jnp.float32)
+        xb, yb = xy[:, :MLP_FEATURE_DIM], xy[:, MLP_FEATURE_DIM]
+        loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    _step_cache[key] = (optimizer, step)
+    return optimizer, step
 
 
 def stream_train_mlp(
@@ -89,41 +213,74 @@ def stream_train_mlp(
     batch_size: int = 65_536,
     hidden_dims: tuple[int, ...] = (256, 256),
     learning_rate: float = 3e-3,
+    weight_decay: float = 1e-4,
     queue_depth: int = 4,
+    offset: int = 0,
+    workers: int = 1,
+    eval_every: int = 10,
+    eval_max_batches: int = 16,
     params=None,
+    mesh=None,
+    transfer_dtype=np.float16,
 ) -> tuple[object, StreamStats]:
     """Fit the MLP parent scorer directly off disk bytes. Returns
-    (params, StreamStats). Partial trailing batches are dropped (static
-    shapes keep one XLA executable hot)."""
+    (params, StreamStats with holdout mse/mae in .metrics).
+
+    Holdout: with ``eval_every`` > 0, pairs whose content hash lands in
+    a 1/eval_every bucket are excluded from training on EVERY pass and
+    scored at the end (collection capped at ``eval_max_batches`` worth of
+    pairs to bound memory) — the streaming analogue of train_mlp's eval
+    split. Content hashing keeps the holdout disjoint from the training
+    set across multiple passes, which stream-position selection would
+    not. Partial trailing
+    batches are dropped when at least one full batch trained (static
+    shapes keep one XLA executable hot); a dataset smaller than one batch
+    trains a single ragged step so tiny hosts still fit. With ``mesh``,
+    batches shard over its ``dp`` axis.
+
+    ``transfer_dtype`` packs the host-side minibatch buffers (default
+    float16): features are ratios/log-scales ≤ ~8, so halving H2D bytes
+    costs ~5e-4 relative precision — upcast on device, where bf16 is the
+    compute dtype anyway. Pass np.float32 for bit-exact feeds.
+    """
     import jax
     import jax.numpy as jnp
-    import optax
 
     from dragonfly2_tpu.models import mlp as mlp_mod
 
-    optimizer = optax.adamw(learning_rate, weight_decay=1e-4)
+    optimizer, step = _get_step(learning_rate, weight_decay)
+    warm_bias = params is None  # fresh model: warm-start the output bias
     if params is None:
         params = mlp_mod.init_mlp(
             jax.random.PRNGKey(0), [MLP_FEATURE_DIM, *hidden_dims, 1]
         )
-    opt_state = optimizer.init(params)
+    if mesh is not None:
+        from dragonfly2_tpu.parallel.sharding import replicate
 
-    def loss_fn(p, xb, yb):
-        pred = mlp_mod.score_parents(p, xb)
-        return jnp.mean((pred - yb) ** 2)
+        params = replicate(mesh, params)
+    opt_state = None  # initialized at the first shard (after bias warm-start)
 
-    @jax.jit
-    def step(params, opt_state, xb, yb):
-        loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        xy_sharding = NamedSharding(mesh, P("dp", None))
+
+        def put(buf):
+            return jax.device_put(buf, xy_sharding)
+    else:
+
+        def put(buf):
+            return jnp.asarray(buf)
 
     stats = StreamStats()
-    # packing buffer: fixed [batch_size, F], filled from variable shards
-    xbuf = np.empty((batch_size, MLP_FEATURE_DIM), np.float32)
-    ybuf = np.empty((batch_size,), np.float32)
+    # packing buffer: fixed [batch_size, F+1] (features ‖ label), filled
+    # from variable shards; the f32→transfer_dtype convert rides the copy
+    buf = np.empty((batch_size, MLP_FEATURE_DIM + 1), transfer_dtype)
     fill = 0
+    eval_cap_pairs = eval_max_batches * batch_size
+    eval_x: list[np.ndarray] = []
+    eval_y: list[np.ndarray] = []
+    eval_collected = 0
     pending_loss = None
     t0 = time.perf_counter()
 
@@ -132,25 +289,76 @@ def stream_train_mlp(
         passes=passes,
         max_records=max_records,
         queue_depth=queue_depth,
+        offset=offset,
+        workers=workers,
     ):
         stats.download_records = rows
         stats.pairs += feats.shape[0]
+        if warm_bias and labels.size:
+            # warm-start the output bias at (an estimate of) the label
+            # mean so the regression head doesn't spend its first steps
+            # drifting there (train_mlp does the same with the full-data
+            # mean, train.py:137-138)
+            params["layers"][-1]["b"] = jnp.full((1,), float(labels.mean()))
+            warm_bias = False
+        if opt_state is None:
+            opt_state = optimizer.init(params)
+        if eval_every > 0 and feats.shape[0]:
+            # content-hash holdout: same pair → same bucket on every pass
+            hv = feats.view(np.uint32).sum(axis=1, dtype=np.uint64)
+            hv = (hv * np.uint64(2654435761) + labels.view(np.uint32)) & np.uint64(
+                0xFFFFFFFF
+            )
+            emask = (hv % np.uint64(eval_every)) == 0
+            if emask.any():
+                if eval_collected < eval_cap_pairs:
+                    # exclusion from training is the invariant that must
+                    # hold on every pass; collection is cap-bounded (a
+                    # later pass may re-collect a pair it already holds,
+                    # which only reweights identical content in the
+                    # metric, never leaks it into training)
+                    ef = feats[emask]
+                    eval_x.append(ef)
+                    eval_y.append(labels[emask])
+                    eval_collected += ef.shape[0]
+                feats = feats[~emask]
+                labels = labels[~emask]
         off = 0
         while off < feats.shape[0]:
             take = min(batch_size - fill, feats.shape[0] - off)
-            xbuf[fill : fill + take] = feats[off : off + take]
-            ybuf[fill : fill + take] = labels[off : off + take]
+            buf[fill : fill + take, :MLP_FEATURE_DIM] = feats[off : off + take]
+            buf[fill : fill + take, MLP_FEATURE_DIM] = labels[off : off + take]
             fill += take
             off += take
             if fill == batch_size:
                 # async dispatch: the host returns to decoding while the
                 # chip trains this batch
-                params, opt_state, pending_loss = step(
-                    params, opt_state, jnp.asarray(xbuf), jnp.asarray(ybuf)
-                )
+                params, opt_state, pending_loss = step(params, opt_state, put(buf))
                 stats.steps += 1
                 fill = 0
+    stats.eval_pairs = eval_collected
+    if stats.steps == 0 and fill > 0:
+        # tiny dataset (< one batch): one ragged step so the fit is real.
+        # Replicated (plain asarray), not dp-sharded — the ragged length
+        # rarely divides the mesh axis, and one degenerate step doesn't
+        # need data parallelism
+        if opt_state is None:
+            opt_state = optimizer.init(params)
+        params, opt_state, pending_loss = step(
+            params, opt_state, jnp.asarray(buf[:fill].copy())
+        )
+        stats.steps += 1
     if pending_loss is not None:
         stats.losses.append(float(jax.block_until_ready(pending_loss)))
     stats.wall_s = time.perf_counter() - t0
+
+    if eval_x:
+        xe = np.concatenate(eval_x)
+        ye = np.concatenate(eval_y)
+        pred = np.asarray(jax.jit(mlp_mod.score_parents)(params, jnp.asarray(xe)))
+        err = pred - ye
+        stats.metrics = {
+            "mse": float(np.mean(err**2)),
+            "mae": float(np.mean(np.abs(err))),
+        }
     return params, stats

@@ -59,6 +59,15 @@ class TrainingConfig:
     # after each successful fit and decode only newly appended uploads
     # next round (implies clear_after_train=False; needs native decode)
     incremental: bool = False
+    # streaming ingestion (trainer.ingest): decode/train overlapped in
+    # bounded memory once the dataset file crosses the threshold — the
+    # 1B-record path. Below it, the batch decode (one pass, in-memory
+    # shuffle across epochs) fits fine and trains with the full FitConfig
+    # schedule.
+    streaming: bool = True
+    streaming_threshold_bytes: int = 64 * 1024 * 1024
+    streaming_passes: int = 2
+    streaming_workers: int = 1
 
 
 @dataclass
@@ -123,6 +132,8 @@ class Training:
         # the boundary is marked by the Train service at stream EOF (locked
         # against appends), so the committed offset never lands mid-record
         boundary = self.storage.download_round_boundary(host_id)
+        if self._use_streaming(path, offset):
+            return self._train_mlp_streaming(host_id, ip, hostname, path, offset, boundary)
         pairs = native.decode_pairs_file(path, offset=offset)
         if pairs is None:
             recs = self.storage.list_download(host_id)
@@ -149,6 +160,72 @@ class Training:
             # a crashed round re-decodes from the previous offset
             self.storage.commit_download_offset(host_id, boundary)
         return result.metrics
+
+    def _use_streaming(self, path, offset: int) -> bool:
+        import os
+
+        if not (self.config.streaming and native.available()):
+            return False
+        try:
+            pending = os.path.getsize(path) - offset
+        except OSError:
+            return False
+        return pending >= self.config.streaming_threshold_bytes
+
+    def _train_mlp_streaming(
+        self, host_id: str, ip: str, hostname: str, path, offset: int, boundary: int
+    ) -> dict[str, float]:
+        """Large-dataset path: bounded-memory overlapped decode+train
+        (trainer.ingest.stream_train_mlp) instead of materializing every
+        pair in host RAM. Holdout mse/mae stands in for train_mlp's eval
+        split; the model/optimizer family is identical."""
+        from dragonfly2_tpu.trainer.ingest import stream_train_mlp
+
+        cfg = self.config.mlp
+        eval_every = (
+            max(2, round(1.0 / cfg.eval_fraction)) if cfg.eval_fraction > 0 else 0
+        )
+        params, stats = stream_train_mlp(
+            path,
+            passes=self.config.streaming_passes,
+            batch_size=max(cfg.batch_size, 1),
+            hidden_dims=cfg.hidden_dims,
+            learning_rate=cfg.learning_rate,
+            weight_decay=cfg.weight_decay,
+            offset=offset,
+            workers=self.config.streaming_workers,
+            eval_every=eval_every,
+            mesh=self.mesh,
+        )
+        # rows counted once per pass — gate on a single pass's worth
+        rows = stats.download_records // max(self.config.streaming_passes, 1)
+        if rows < self.config.min_download_records:
+            raise ValueError(
+                f"{rows} download records for host {host_id}"
+                f" < min {self.config.min_download_records}"
+            )
+        if stats.pairs == 0:
+            raise ValueError("no trainable (download, parent) pairs")
+        logger.info(
+            "streamed fit for %s: %d records, %d pairs, %d steps, %.0f rec/s",
+            host_id,
+            rows,
+            stats.pairs,
+            stats.steps,
+            stats.records_per_s,
+        )
+        if self.manager_client is not None:
+            self.manager_client.create_model(
+                model_id=mlp_model_id_v1(ip, hostname),
+                model_type="mlp",
+                ip=ip,
+                hostname=hostname,
+                params=_to_host(params),
+                evaluation=stats.metrics,
+            )
+        if self.config.incremental:
+            self.storage.commit_download_offset(host_id, boundary)
+        return stats.metrics
 
     # -- trainGNN (reference training.go:82-88) ---------------------------
     def _train_gnn(self, host_id: str, ip: str, hostname: str) -> dict[str, float]:

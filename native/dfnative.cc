@@ -190,22 +190,36 @@ template <typename RowFn>
 void feed_lines(std::string& carry, bool& in_quotes, const char* buf, long len,
                 RowFn&& on_line) {
   long pos = 0;
+  // Lazy quote tracking: quotes are rare (csv.writer only quotes fields
+  // containing separators/quotes), so instead of scanning every line for
+  // '"' we keep a cursor to the NEXT quote at-or-after `pos`. Lines that
+  // end before it need no parity work and no per-line quote memchr —
+  // the common case is then two byte passes total ('\n' here, ',' in the
+  // row scanner) instead of four.
+  long next_quote = -1;  // -1: unknown; len: none remaining
+  auto quote_at_or_after = [&](long p) -> long {
+    if (next_quote < p) {
+      const char* qp =
+          static_cast<const char*>(memchr(buf + p, '"', size_t(len - p)));
+      next_quote = qp ? long(qp - buf) : len;
+    }
+    return next_quote;
+  };
   while (pos < len) {
     const char* nl =
         static_cast<const char*>(memchr(buf + pos, '\n', size_t(len - pos)));
     long end = nl ? long(nl - buf) : len;
     // quote parity over [pos, end): all segment quotes precede the
     // newline, so parity-after tells whether the newline is data
-    long q = pos;
-    long quotes = 0;
+    long q = quote_at_or_after(pos);
+    bool has_quote = q < end;
     while (q < end) {
-      const char* qp =
-          static_cast<const char*>(memchr(buf + q, '"', size_t(end - q)));
-      if (!qp) break;
-      ++quotes;
-      q = long(qp - buf) + 1;
+      in_quotes = !in_quotes;
+      const char* qp = static_cast<const char*>(
+          memchr(buf + q + 1, '"', size_t(len - q - 1)));
+      next_quote = qp ? long(qp - buf) : len;
+      q = next_quote;
     }
-    if (quotes & 1) in_quotes = !in_quotes;
     if (!nl) {  // chunk ends mid-record
       carry.append(buf + pos, size_t(len - pos));
       return;
@@ -219,12 +233,12 @@ void feed_lines(std::string& carry, bool& in_quotes, const char* buf, long len,
       carry.append(buf + pos, size_t(end - pos));
       size_t L = carry.size();
       if (L && carry[L - 1] == '\r') --L;
-      on_line(carry.data(), L);
+      on_line(carry.data(), L, true);  // conservative: carry may hold quotes
       carry.clear();
     } else {
       size_t L = size_t(end - pos);
       if (L && buf[end - 1] == '\r') --L;
-      on_line(buf + pos, L);
+      on_line(buf + pos, L, has_quote);
     }
     pos = end + 1;
   }
@@ -413,10 +427,9 @@ struct DfPairs {
            (len == h || line[h] == ',');
   }
 
-  void on_line(const char* line, size_t len) {
+  void on_line(const char* line, size_t len, bool has_quote = true) {
     if (len == 0) return;
-    if (colmap.empty() || looks_like_header(line, len) ||
-        memchr(line, '"', len) != nullptr) {
+    if (colmap.empty() || has_quote || looks_like_header(line, len)) {
       on_line_slow(line, len);
       return;
     }
@@ -450,6 +463,44 @@ struct DfPairs {
     ++row;
   }
 
+  // Tail short-circuit: called when a parent id column is empty. If every
+  // byte from `from` up to the line's second-to-last comma is a comma,
+  // then all remaining parent columns are empty (only the trailing
+  // created_at/updated_at — never hot — carry data), so the scan can stop
+  // for the whole row. Exact for any input: a later parent that DID have
+  // data would put a non-comma byte inside the checked span (its id and
+  // any piece-cost column are never the final two fields — the schema
+  // keeps them ≥2 columns apart), failing the check and falling back to
+  // the normal scan.
+  static bool tail_is_padding(const char* line, size_t len, size_t from) {
+    long p_last = -1, p_prev = -1;
+    for (long j = long(len) - 1; j >= long(from); --j) {
+      if (line[j] == ',') {
+        if (p_last < 0) {
+          p_last = j;
+        } else {
+          p_prev = j;
+          break;
+        }
+      }
+    }
+    if (p_prev < 0) return false;
+    size_t i = from;
+#if defined(__AVX2__)
+    const __m256i commas = _mm256_set1_epi8(',');
+    for (; i + 32 <= size_t(p_prev); i += 32) {
+      const __m256i v =
+          _mm256_loadu_si256(reinterpret_cast<const __m256i*>(line + i));
+      if (uint32_t(_mm256_movemask_epi8(_mm256_cmpeq_epi8(v, commas))) !=
+          0xffffffffu)
+        return false;
+    }
+#endif
+    for (; i < size_t(p_prev); ++i)
+      if (line[i] != ',') return false;
+    return true;
+  }
+
   // Unquoted data rows (the overwhelmingly common case): one pass over the
   // line, finding commas 32 bytes at a time (AVX2) and materializing only
   // the ~hot columns the feature extractor reads. Runs of ignored columns
@@ -462,6 +513,7 @@ struct DfPairs {
     uint32_t c = 0;        // current column index
     size_t field_start = 0;
     size_t i = 0;
+    bool tried_tail = false;  // attempt the tail short-circuit once per row
 #if defined(__AVX2__)
     const __m256i commas = _mm256_set1_epi8(',');
     while (i + 32 <= len && hi < nhot) {
@@ -473,16 +525,58 @@ struct DfPairs {
         i += 32;
         continue;
       }
-      const int cnt = __builtin_popcount(m);
-      if (c + uint32_t(cnt) < next_hot) {
+      uint32_t cnt = uint32_t(__builtin_popcount(m));
+      if (c + cnt < next_hot) {
         // every comma in this block belongs to ignored columns — consume
         // them in bulk; the in-progress field after the block starts
         // right past the last comma
-        c += uint32_t(cnt);
+        c += cnt;
         field_start = i + size_t(31 - __builtin_clz(m)) + 1;
         i += 32;
         continue;
       }
+#if defined(__BMI2__)
+      // The block holds ≥1 hot-column boundary. Jump straight to each hot
+      // field's bounding commas with pdep (deposit selects the k-th set
+      // bit) instead of iterating every comma — populated rows have ~7×
+      // more commas than hot columns.
+      while (true) {
+        // next_hot's field ends at overall comma #next_hot, which is the
+        // (next_hot - c)-th comma (0-based) of the remaining mask
+        uint32_t k = next_hot - c;
+        if (k >= cnt) {  // ends beyond this block: consume the rest
+          c += cnt;
+          field_start = i + size_t(31 - __builtin_clz(m)) + 1;
+          break;
+        }
+        if (k > 0) {  // field starts after the (k-1)-th remaining comma
+          const uint32_t before = uint32_t(_pdep_u32(1u << (k - 1), m));
+          field_start = i + size_t(__builtin_ctz(before)) + 1;
+        }
+        const uint32_t at = uint32_t(_pdep_u32(1u << k, m));
+        const size_t pos = i + size_t(__builtin_ctz(at));
+        const size_t flen = pos - field_start;
+        if (flen == 0 && skip_on_empty[hi]) {
+          if (!tried_tail) {
+            tried_tail = true;
+            if (tail_is_padding(line, len, pos + 1)) return;
+          }
+          hi = skip_on_empty[hi];  // empty parent id → skip the slot
+        } else {
+          dispatch(colmap[c + k], line + field_start, flen);
+          ++hi;
+        }
+        next_hot = hi < nhot ? hot_cols[hi] : 0xffffffffu;
+        // consume commas up to and including the field-ending one
+        const uint32_t used = k + 1;
+        c += used;
+        cnt -= used;
+        field_start = pos + 1;
+        if (hi >= nhot) return;
+        if (cnt == 0) break;  // before the shift: `<< 32` would be UB
+        m = uint32_t(_pdep_u32(0xffffffffu << used, m)) & m;
+      }
+#else
       while (m) {
         const uint32_t b = uint32_t(__builtin_ctz(m));
         m &= m - 1;
@@ -490,6 +584,10 @@ struct DfPairs {
         if (c == next_hot) {
           const size_t flen = pos - field_start;
           if (flen == 0 && skip_on_empty[hi]) {
+            if (!tried_tail) {
+              tried_tail = true;
+              if (tail_is_padding(line, len, pos + 1)) return;
+            }
             hi = skip_on_empty[hi];  // empty parent id → skip the slot
           } else {
             dispatch(colmap[c], line + field_start, flen);
@@ -501,6 +599,7 @@ struct DfPairs {
         field_start = pos + 1;
         if (hi >= nhot) return;
       }
+#endif
       i += 32;
     }
 #endif
@@ -509,6 +608,10 @@ struct DfPairs {
       if (c == next_hot) {
         const size_t flen = i - field_start;
         if (flen == 0 && skip_on_empty[hi]) {
+          if (!tried_tail) {
+            tried_tail = true;
+            if (tail_is_padding(line, len, i + 1)) return;
+          }
           hi = skip_on_empty[hi];
         } else {
           dispatch(colmap[c], line + field_start, flen);
@@ -570,6 +673,10 @@ struct DfPairs {
     }
   }
 
+  // End-of-file boundary: flush a trailing record that has no newline and
+  // reset quote parity, so concatenating the next file (or pass) cannot
+  // bleed this file's tail into its first record. Safe to call once per
+  // file mid-stream — parser column mapping survives.
   void finish() {
     if (!carry.empty()) {
       std::string tail;
@@ -578,6 +685,7 @@ struct DfPairs {
       if (L && tail[L - 1] == '\r') --L;
       on_line(tail.data(), L);
     }
+    in_quotes = false;
   }
 };
 
@@ -684,7 +792,7 @@ struct DfTopo {
     }
   }
 
-  void on_line(const char* line, size_t len) {
+  void on_line(const char* line, size_t len, bool = true) {
     if (len == 0) return;
     if (!split_csv_line(line, len, fields, scratch)) {
       ++errors;
@@ -749,6 +857,7 @@ struct DfTopo {
       if (L && tail[L - 1] == '\r') --L;
       on_line(tail.data(), L);
     }
+    in_quotes = false;
   }
 };
 
@@ -765,7 +874,7 @@ void df_pairs_free(DfPairs* d) { delete d; }
 
 long df_pairs_feed(DfPairs* d, const char* buf, long len) {
   feed_lines(d->carry, d->in_quotes, buf, len,
-             [d](const char* line, size_t L) { d->on_line(line, L); });
+             [d](const char* line, size_t L, bool hq) { d->on_line(line, L, hq); });
   return long(d->label.size());
 }
 
@@ -801,7 +910,7 @@ void df_topo_free(DfTopo* d) { delete d; }
 
 long df_topo_feed(DfTopo* d, const char* buf, long len) {
   feed_lines(d->carry, d->in_quotes, buf, len,
-             [d](const char* line, size_t L) { d->on_line(line, L); });
+             [d](const char* line, size_t L, bool hq) { d->on_line(line, L, hq); });
   return long(d->src.size());
 }
 

@@ -1,0 +1,351 @@
+"""Streaming ingestion (trainer.ingest + schema.native.stream_pairs_file):
+bytes-on-disk → shards → packed batches → trained params.
+
+Parity contract: the streamed decode must produce exactly the pairs the
+batch decode (decode_pairs_file) produces — including a file whose last
+record has no trailing newline (each file boundary flushes the parser),
+and a resume offset mid-file. The producer threads must shut down when
+the consumer abandons the stream early.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.schema import native
+from dragonfly2_tpu.schema.columnar import write_csv
+from dragonfly2_tpu.schema.synth import make_download_records
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no toolchain)"
+)
+
+
+def _write_dataset(path, n, seed=0):
+    write_csv(path, make_download_records(n, seed=seed))
+    return path
+
+
+def _collect(gen):
+    feats, labels, rows = [], [], 0
+    for f, l, r in gen:
+        feats.append(f)
+        labels.append(l)
+        rows = r
+    return np.concatenate(feats), np.concatenate(labels), rows
+
+
+def test_stream_matches_batch_decode(tmp_path):
+    path = _write_dataset(tmp_path / "dl.csv", 80)
+    batch = native.decode_pairs_file(path)
+    feats, labels, rows = _collect(
+        native.stream_pairs_file(path, chunk_bytes=16 * 1024)
+    )
+    assert rows == batch.num_downloads
+    np.testing.assert_array_equal(feats, batch.features)
+    np.testing.assert_array_equal(labels, batch.labels)
+
+
+def test_stream_offset_matches_batch_decode(tmp_path):
+    path = _write_dataset(tmp_path / "dl.csv", 60)
+    size = path.stat().st_size
+    # re-append a second round (own header) and resume from the boundary
+    data = path.read_bytes()
+    part2 = tmp_path / "round2.csv"
+    _write_dataset(part2, 40, seed=7)
+    with open(path, "ab") as f:
+        f.write(part2.read_bytes())
+    assert path.stat().st_size > size
+    batch = native.decode_pairs_file(path, offset=size)
+    feats, labels, rows = _collect(native.stream_pairs_file(path, offset=size))
+    assert rows == batch.num_downloads == 40
+    np.testing.assert_array_equal(feats, batch.features)
+    del data
+
+
+def test_file_without_trailing_newline_does_not_bleed(tmp_path):
+    """Regression (round-2 ADVICE a): a file ending mid-line must flush
+    its last record at the file boundary, not merge it with the next
+    file's first line."""
+    p1 = _write_dataset(tmp_path / "a.csv", 30, seed=1)
+    p2 = _write_dataset(tmp_path / "b.csv", 30, seed=2)
+    # strip p1's trailing newline
+    raw = p1.read_bytes()
+    assert raw.endswith(b"\n")
+    p1.write_bytes(raw[:-1])
+
+    want = native.decode_pairs_file(p1)
+    want2 = native.decode_pairs_file(p2)
+    feats, labels, rows = _collect(native.stream_pairs_file([p1, p2]))
+    assert rows == want.num_downloads + want2.num_downloads == 60
+    np.testing.assert_array_equal(
+        feats, np.concatenate([want.features, want2.features])
+    )
+
+
+def test_multi_pass_no_bleed(tmp_path):
+    """passes>1 over a newline-less file must decode N full copies."""
+    p1 = _write_dataset(tmp_path / "a.csv", 20, seed=3)
+    p1.write_bytes(p1.read_bytes()[:-1])
+    one = native.decode_pairs_file(p1)
+    feats, labels, rows = _collect(native.stream_pairs_file(p1, passes=3))
+    assert rows == one.num_downloads * 3
+    assert feats.shape[0] == one.features.shape[0] * 3
+
+
+def test_offset_applies_on_every_pass(tmp_path):
+    """Regression: with passes > 1, the committed offset must be skipped
+    on EVERY pass — pass 2 must not re-decode consumed history."""
+    from dragonfly2_tpu.trainer.ingest import stream_shards
+
+    path = _write_dataset(tmp_path / "dl.csv", 60)
+    size = path.stat().st_size
+    part2 = tmp_path / "round2.csv"
+    _write_dataset(part2, 25, seed=9)
+    with open(path, "ab") as f:
+        f.write(part2.read_bytes())
+
+    feats, labels, rows = _collect(
+        stream_shards(path, passes=3, offset=size)
+    )
+    assert rows == 25 * 3  # only the new round, three times
+    one = native.decode_pairs_file(path, offset=size)
+    assert feats.shape[0] == one.features.shape[0] * 3
+
+
+def test_split_file_spans_parity(tmp_path):
+    """Ranged parallel decode of ONE file must produce exactly the pairs
+    of a sequential decode (spans are newline-aligned; mid-file spans
+    re-feed the header)."""
+    from dragonfly2_tpu.schema.native import split_file_spans
+
+    path = _write_dataset(tmp_path / "dl.csv", 100)
+    # force multiple spans despite the small file
+    import dragonfly2_tpu.schema.native as N
+
+    old = N._MIN_SPAN
+    N._MIN_SPAN = 1024
+    try:
+        spans = split_file_spans(path, 4)
+        assert len(spans) > 1
+        assert spans[0][1] == 0 and spans[-1][2] == path.stat().st_size
+        want = native.decode_pairs_file(path)
+        got_pairs = 0
+        got_rows = 0
+        for span in spans:
+            f, l, r = _collect(native.stream_pairs_file([span]))
+            got_pairs += f.shape[0]
+            got_rows += r
+        assert got_rows == want.num_downloads
+        assert got_pairs == want.features.shape[0]
+    finally:
+        N._MIN_SPAN = old
+
+
+def test_split_file_spans_quote_aware(tmp_path):
+    """Span boundaries must not land on newlines inside quoted fields —
+    a record with an embedded newline is one record, not two."""
+    import csv
+    import dragonfly2_tpu.schema.native as N
+    from dragonfly2_tpu.schema.columnar import write_csv
+    from dragonfly2_tpu.schema.records import DownloadRecord, headers
+
+    # dataset where EVERY row carries a quoted embedded newline (the url
+    # field), so a parity-blind splitter would almost surely misalign
+    recs = make_download_records(120, seed=4)
+    for i, r in enumerate(recs):
+        r.task.url = f"https://origin.example.com/a\nb/{i}"
+    path = tmp_path / "dl.csv"
+    write_csv(path, recs)
+    want = native.decode_pairs_file(path)
+    assert want.num_downloads == 120
+
+    old = N._MIN_SPAN
+    N._MIN_SPAN = 1024
+    try:
+        spans = N.split_file_spans(path, 5)
+        assert len(spans) > 1
+        got_rows = 0
+        got_pairs = 0
+        for span in spans:
+            f, l, r = _collect(native.stream_pairs_file([span]))
+            got_rows += r
+            got_pairs += f.shape[0]
+        assert got_rows == want.num_downloads
+        assert got_pairs == want.features.shape[0]
+    finally:
+        N._MIN_SPAN = old
+
+
+def test_stream_shards_workers_split_single_file(tmp_path):
+    """streaming_workers > 1 must engage even with one dataset file."""
+    import dragonfly2_tpu.schema.native as N
+    from dragonfly2_tpu.trainer.ingest import stream_shards
+
+    path = _write_dataset(tmp_path / "dl.csv", 100)
+    want = native.decode_pairs_file(path)
+    old = N._MIN_SPAN
+    N._MIN_SPAN = 1024
+    try:
+        feats, labels, rows = _collect(stream_shards(path, workers=3))
+        assert rows == want.num_downloads
+        assert feats.shape[0] == want.features.shape[0]
+    finally:
+        N._MIN_SPAN = old
+
+
+def test_abandoned_consumer_releases_producer(tmp_path):
+    """Regression (round-2 ADVICE e): breaking out of the stream early
+    must not leave the producer thread blocked on a full queue."""
+    from dragonfly2_tpu.trainer.ingest import stream_shards
+
+    path = _write_dataset(tmp_path / "dl.csv", 120)
+    before = {t.name for t in threading.enumerate()}
+    gen = stream_shards(path, passes=50, chunk_bytes=8 * 1024, queue_depth=1)
+    next(gen)  # start the producer, take one shard, walk away
+    gen.close()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        alive = [
+            t
+            for t in threading.enumerate()
+            if t.name.startswith("ingest-decode") and t.name not in before
+        ]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, f"producer threads leaked: {alive}"
+
+
+def test_stream_workers_cover_all_shards(tmp_path):
+    paths = [
+        _write_dataset(tmp_path / f"s{i}.csv", 25, seed=i) for i in range(4)
+    ]
+    want = sum(native.decode_pairs_file(p).num_downloads for p in paths)
+    pair_want = sum(native.decode_pairs_file(p).features.shape[0] for p in paths)
+    feats, labels, rows = _collect(
+        __import__(
+            "dragonfly2_tpu.trainer.ingest", fromlist=["stream_shards"]
+        ).stream_shards(paths, workers=2)
+    )
+    assert rows == want == 100
+    assert feats.shape[0] == pair_want
+
+
+def test_stream_train_mlp_fits_and_evaluates(tmp_path):
+    from dragonfly2_tpu.trainer.ingest import stream_train_mlp
+
+    path = _write_dataset(tmp_path / "dl.csv", 200)
+    params, stats = stream_train_mlp(
+        path, passes=2, batch_size=64, eval_every=5, learning_rate=1e-2
+    )
+    batch = native.decode_pairs_file(path)
+    assert stats.download_records == 400  # 2 passes
+    assert stats.pairs == batch.features.shape[0] * 2
+    assert stats.steps > 0
+    assert stats.eval_pairs > 0
+    assert set(stats.metrics) == {"mse", "mae"}
+    assert np.isfinite(stats.metrics["mse"])
+
+
+def test_eval_holdout_disjoint_from_training_across_passes(tmp_path):
+    """Regression: the holdout must be excluded from training on every
+    pass (content-hash selection), not just where it happened to sit in
+    the first pass's stream."""
+    from dragonfly2_tpu.trainer.ingest import stream_train_mlp
+
+    path = _write_dataset(tmp_path / "dl.csv", 300)
+    batch = native.decode_pairs_file(path)
+    total = batch.features.shape[0]
+    eval_every = 4
+    # the exact per-pass holdout, recomputed with the same content hash
+    hv = batch.features.view(np.uint32).sum(axis=1, dtype=np.uint64)
+    hv = (hv * np.uint64(2654435761) + batch.labels.view(np.uint32)) & np.uint64(
+        0xFFFFFFFF
+    )
+    holdout = int(((hv % np.uint64(eval_every)) == 0).sum())
+    assert 0 < holdout < total
+
+    passes = 3
+    params, stats = stream_train_mlp(
+        path, passes=passes, batch_size=32, eval_every=eval_every
+    )
+    # every pass excludes the same hash bucket, so trained pairs =
+    # passes * (total - holdout), modulo the final open batch
+    trained = stats.steps * 32
+    assert trained <= passes * (total - holdout)
+    assert trained >= passes * (total - holdout) - 32
+
+
+def test_stream_train_mlp_tiny_dataset_trains_ragged(tmp_path):
+    from dragonfly2_tpu.trainer.ingest import stream_train_mlp
+
+    path = _write_dataset(tmp_path / "dl.csv", 5)
+    params, stats = stream_train_mlp(path, batch_size=100_000, eval_every=0)
+    assert stats.steps == 1
+    assert stats.pairs > 0
+
+
+def test_training_streaming_path_uploads_model(tmp_path):
+    """Training._train_mlp routes through stream_train_mlp when the
+    dataset crosses the streaming threshold, and still uploads a model
+    with holdout metrics."""
+    from dragonfly2_tpu.trainer.storage import TrainerStorage
+    from dragonfly2_tpu.trainer.training import Training, TrainingConfig
+    from dragonfly2_tpu.trainer.train import FitConfig
+    from dragonfly2_tpu.utils.idgen import host_id_v2
+
+    storage = TrainerStorage(tmp_path / "store")
+    ip, hostname = "10.0.0.9", "sched-a"
+    host_id = host_id_v2(ip, hostname)
+    part = tmp_path / "part.csv"
+    _write_dataset(part, 150)
+    storage.append_download(host_id, part.read_bytes())
+
+    uploads = []
+
+    class Mgr:
+        def create_model(self, **kw):
+            uploads.append(kw)
+
+    cfg = TrainingConfig(
+        mlp=FitConfig(batch_size=64, eval_fraction=0.1),
+        streaming=True,
+        streaming_threshold_bytes=0,  # force the streaming path
+        min_topology_records=10**9,  # GNN side intentionally fails
+    )
+    t = Training(storage, manager_client=Mgr(), config=cfg)
+    outcome = t.train(ip, hostname)
+    assert outcome.mlp_error is None, outcome.mlp_error
+    assert outcome.mlp_metrics and "mse" in outcome.mlp_metrics
+    mlp_uploads = [u for u in uploads if u["model_type"] == "mlp"]
+    assert len(mlp_uploads) == 1
+    assert set(mlp_uploads[0]["evaluation"]) == {"mse", "mae"}
+
+
+def test_training_streaming_respects_min_records(tmp_path):
+    from dragonfly2_tpu.trainer.storage import TrainerStorage
+    from dragonfly2_tpu.trainer.training import Training, TrainingConfig
+    from dragonfly2_tpu.trainer.train import FitConfig
+    from dragonfly2_tpu.utils.idgen import host_id_v2
+
+    storage = TrainerStorage(tmp_path / "store")
+    ip, hostname = "10.0.0.9", "sched-a"
+    host_id = host_id_v2(ip, hostname)
+    part = tmp_path / "part.csv"
+    _write_dataset(part, 10)
+    storage.append_download(host_id, part.read_bytes())
+
+    cfg = TrainingConfig(
+        mlp=FitConfig(batch_size=64),
+        streaming=True,
+        streaming_threshold_bytes=0,
+        min_download_records=1000,
+        min_topology_records=10**9,
+    )
+    t = Training(storage, manager_client=None, config=cfg)
+    outcome = t.train(ip, hostname)
+    assert outcome.mlp_error is not None
+    assert "min 1000" in outcome.mlp_error
