@@ -1,0 +1,3 @@
+"""Service entrypoints: config loading and the serve/stop run loop shared
+by `python -m dragonfly2_tpu.{manager,scheduler,trainer}` and
+`python -m dragonfly2_tpu.client.daemon` (reference cmd/*/main.go)."""

@@ -380,3 +380,44 @@ class Daemon:
                 self.probe_once()
             except Exception as e:
                 logger.warning("probe round failed: %s", e)
+
+
+# ---------------------------------------------------------------------------
+# `python -m dragonfly2_tpu.client.daemon` — the dfdaemon binary
+# (reference cmd/dfdaemon; daemon assembly client/daemon/daemon.go:114,524)
+# ---------------------------------------------------------------------------
+
+
+class _DaemonRunAdapter:
+    """Adapts Daemon.start/stop onto the runner's serve/stop contract."""
+
+    def __init__(self, daemon: "Daemon"):
+        self.daemon = daemon
+
+    def serve(self) -> str:
+        self.daemon.start()
+        host = self.daemon.cfg.listen.rsplit(":", 1)[0]
+        return f"{host}:{self.daemon.port}"
+
+    def stop(self) -> None:
+        self.daemon.stop()
+
+
+def main(argv=None) -> int:
+    from dragonfly2_tpu.cli.runner import main_with_config
+
+    def build(config_path, overrides):
+        from dragonfly2_tpu.cli.config import load_config
+
+        cfg = load_config(
+            DaemonConfig, config_path, env_prefix="DF_DAEMON", overrides=overrides
+        )
+        return _DaemonRunAdapter(Daemon(cfg))
+
+    return main_with_config("daemon", build, argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

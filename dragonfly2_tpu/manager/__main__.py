@@ -1,0 +1,10 @@
+"""`python -m dragonfly2_tpu.manager` — the manager binary (reference
+cmd/manager/main.go)."""
+
+import sys
+
+from dragonfly2_tpu.cli.runner import main_with_config
+from dragonfly2_tpu.manager.server import build
+
+if __name__ == "__main__":
+    sys.exit(main_with_config("manager", build))

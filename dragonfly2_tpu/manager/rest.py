@@ -1,0 +1,343 @@
+"""Manager REST API (reference manager/router/router.go:269 +
+manager/handlers/ + manager/service/): cluster / scheduler / seed-peer /
+job / model / application CRUD over HTTP JSON, with bearer-token role
+auth standing in for the reference's casbin RBAC (admin = full access,
+guest = read-only; reference roles `root`/`guest`).
+
+Stdlib http.server — the service plane needs no framework; the threaded
+server handles the console/API concurrency a control plane sees. Model
+activation flips versions through ModelRegistry.activate, the REST
+equivalent of reference manager/service/model.go:109
+updateModelStateToActive.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from dragonfly2_tpu.manager.service import ManagerService
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("manager.rest")
+
+_ROUTES: list[tuple[str, re.Pattern, str, bool]] = []  # (method, pattern, fn, write)
+
+
+def route(method: str, pattern: str, write: bool = False):
+    rx = re.compile("^" + re.sub(r":(\w+)", r"(?P<\1>[^/]+)", pattern) + "$")
+
+    def wrap(fn):
+        _ROUTES.append((method, rx, fn.__name__, write))
+        return fn
+
+    return wrap
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+class RestApi:
+    """Route handlers; one instance per server, stateless per request."""
+
+    def __init__(self, service: ManagerService):
+        self.service = service
+        self.db = service.db
+        self.models = service.models
+
+    # -- health ----------------------------------------------------------
+    @route("GET", "/healthy")
+    def healthy(self, req):
+        return {"status": "ok"}
+
+    # -- scheduler clusters ----------------------------------------------
+    @route("GET", "/api/v1/scheduler-clusters")
+    def list_scheduler_clusters(self, req):
+        return self.db.query("SELECT * FROM scheduler_clusters ORDER BY id")
+
+    @route("POST", "/api/v1/scheduler-clusters", write=True)
+    def create_scheduler_cluster(self, req):
+        body = req["body"]
+        name = body.get("name")
+        if not name:
+            raise ApiError(400, "name is required")
+        now = time.time()
+        cur = self.db.execute(
+            "INSERT INTO scheduler_clusters (name, config, client_config, scopes,"
+            " is_default, created_at, updated_at) VALUES (?, ?, ?, ?, ?, ?, ?)",
+            (
+                name,
+                json.dumps(body.get("config", {})),
+                json.dumps(body.get("client_config", {})),
+                json.dumps(body.get("scopes", {})),
+                1 if body.get("is_default") else 0,
+                now,
+                now,
+            ),
+        )
+        return self.db.query_one(
+            "SELECT * FROM scheduler_clusters WHERE id = ?", (cur.lastrowid,)
+        )
+
+    @route("GET", "/api/v1/scheduler-clusters/:id")
+    def get_scheduler_cluster(self, req):
+        row = self.db.query_one(
+            "SELECT * FROM scheduler_clusters WHERE id = ?", (int(req["id"]),)
+        )
+        if row is None:
+            raise ApiError(404, "scheduler cluster not found")
+        return row
+
+    @route("PATCH", "/api/v1/scheduler-clusters/:id", write=True)
+    def update_scheduler_cluster(self, req):
+        body = req["body"]
+        sets, params = [], []
+        for col in ("name", "config", "client_config", "scopes"):
+            if col in body:
+                v = body[col]
+                sets.append(f"{col} = ?")
+                params.append(v if isinstance(v, str) else json.dumps(v))
+        if "is_default" in body:
+            sets.append("is_default = ?")
+            params.append(1 if body["is_default"] else 0)
+        if not sets:
+            raise ApiError(400, "no updatable fields in body")
+        sets.append("updated_at = ?")
+        params.append(time.time())
+        params.append(int(req["id"]))
+        self.db.execute(
+            f"UPDATE scheduler_clusters SET {', '.join(sets)} WHERE id = ?",
+            tuple(params),
+        )
+        return self.get_scheduler_cluster(req)
+
+    @route("DELETE", "/api/v1/scheduler-clusters/:id", write=True)
+    def delete_scheduler_cluster(self, req):
+        self.db.execute(
+            "DELETE FROM scheduler_clusters WHERE id = ?", (int(req["id"]),)
+        )
+        return {"deleted": int(req["id"])}
+
+    # -- schedulers ------------------------------------------------------
+    @route("GET", "/api/v1/schedulers")
+    def list_schedulers(self, req):
+        return self.db.query("SELECT * FROM schedulers ORDER BY id")
+
+    @route("GET", "/api/v1/schedulers/:id")
+    def get_scheduler(self, req):
+        row = self.db.query_one(
+            "SELECT * FROM schedulers WHERE id = ?", (int(req["id"]),)
+        )
+        if row is None:
+            raise ApiError(404, "scheduler not found")
+        return row
+
+    @route("DELETE", "/api/v1/schedulers/:id", write=True)
+    def delete_scheduler(self, req):
+        self.db.execute("DELETE FROM schedulers WHERE id = ?", (int(req["id"]),))
+        return {"deleted": int(req["id"])}
+
+    # -- seed peers ------------------------------------------------------
+    @route("GET", "/api/v1/seed-peers")
+    def list_seed_peers(self, req):
+        return self.db.query("SELECT * FROM seed_peers ORDER BY id")
+
+    @route("GET", "/api/v1/seed-peers/:id")
+    def get_seed_peer(self, req):
+        row = self.db.query_one(
+            "SELECT * FROM seed_peers WHERE id = ?", (int(req["id"]),)
+        )
+        if row is None:
+            raise ApiError(404, "seed peer not found")
+        return row
+
+    # -- jobs (preheat etc.) --------------------------------------------
+    @route("GET", "/api/v1/jobs")
+    def list_jobs(self, req):
+        return self.db.query("SELECT * FROM jobs ORDER BY id DESC LIMIT 100")
+
+    @route("POST", "/api/v1/jobs", write=True)
+    def create_job(self, req):
+        body = req["body"]
+        jtype = body.get("type")
+        if not jtype:
+            raise ApiError(400, "type is required")
+        now = time.time()
+        cur = self.db.execute(
+            "INSERT INTO jobs (type, state, args, scheduler_cluster_id,"
+            " created_at, updated_at) VALUES (?, 'queued', ?, ?, ?, ?)",
+            (
+                jtype,
+                json.dumps(body.get("args", {})),
+                int(body.get("scheduler_cluster_id", 0)),
+                now,
+                now,
+            ),
+        )
+        return self.db.query_one("SELECT * FROM jobs WHERE id = ?", (cur.lastrowid,))
+
+    @route("GET", "/api/v1/jobs/:id")
+    def get_job(self, req):
+        row = self.db.query_one("SELECT * FROM jobs WHERE id = ?", (int(req["id"]),))
+        if row is None:
+            raise ApiError(404, "job not found")
+        return row
+
+    # -- models (registry + activation) ----------------------------------
+    @route("GET", "/api/v1/models")
+    def list_models(self, req):
+        cluster = req["query"].get("scheduler_cluster_id")
+        rows = self.models.list(int(cluster) if cluster else None)
+        return [vars(r) for r in rows]
+
+    @route("GET", "/api/v1/models/:model_id/versions/:version")
+    def get_model(self, req):
+        row = self.models.get(req["model_id"], int(req["version"]))
+        if row is None:
+            raise ApiError(404, "model not found")
+        return vars(row)
+
+    @route("PUT", "/api/v1/models/:model_id/versions/:version/state", write=True)
+    def update_model_state(self, req):
+        state = req["body"].get("state")
+        if state not in ("active", "inactive"):
+            raise ApiError(400, "state must be 'active' or 'inactive'")
+        model_id, version = req["model_id"], int(req["version"])
+        if state == "active":
+            row = self.models.activate(model_id, version)
+            return vars(row)
+        self.db.execute(
+            "UPDATE models SET state = 'inactive' WHERE model_id = ? AND version = ?",
+            (model_id, version),
+        )
+        row = self.models.get(model_id, version)
+        if row is None:
+            raise ApiError(404, "model not found")
+        return vars(row)
+
+    @route("DELETE", "/api/v1/models/:model_id/versions/:version", write=True)
+    def delete_model(self, req):
+        self.models.delete(req["model_id"], int(req["version"]))
+        return {"deleted": req["model_id"], "version": int(req["version"])}
+
+    # -- applications ----------------------------------------------------
+    @route("GET", "/api/v1/applications")
+    def list_applications(self, req):
+        return self.db.query("SELECT * FROM applications ORDER BY id")
+
+    @route("POST", "/api/v1/applications", write=True)
+    def create_application(self, req):
+        body = req["body"]
+        if not body.get("name"):
+            raise ApiError(400, "name is required")
+        now = time.time()
+        cur = self.db.execute(
+            "INSERT INTO applications (name, url, priority, created_at, updated_at)"
+            " VALUES (?, ?, ?, ?, ?)",
+            (
+                body["name"],
+                body.get("url", ""),
+                json.dumps(body.get("priority", {})),
+                now,
+                now,
+            ),
+        )
+        return self.db.query_one(
+            "SELECT * FROM applications WHERE id = ?", (cur.lastrowid,)
+        )
+
+
+class RestServer:
+    def __init__(
+        self,
+        service: ManagerService,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        tokens: dict[str, str] | None = None,
+    ):
+        self.api = RestApi(service)
+        self.tokens = dict(tokens or {})  # token -> role ("admin"|"guest")
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------
+    def _role_for(self, auth_header: str | None) -> str | None:
+        """→ role, or None when unauthenticated. No tokens configured =
+        open admin access (dev mode, like the reference without auth)."""
+        if not self.tokens:
+            return "admin"
+        if auth_header and auth_header.startswith("Bearer "):
+            return self.tokens.get(auth_header[7:])
+        return None
+
+    def start(self) -> str:
+        api = self.api
+        role_for = self._role_for
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route to dflog, not stderr
+                logger.debug("%s " + fmt, self.client_address[0], *args)
+
+            def _dispatch(self):
+                from urllib.parse import parse_qsl, urlsplit
+
+                parts = urlsplit(self.path)
+                query = dict(parse_qsl(parts.query))
+                role = role_for(self.headers.get("Authorization"))
+                for method, rx, fname, write in _ROUTES:
+                    if method != self.command:
+                        continue
+                    m = rx.match(parts.path)
+                    if not m:
+                        continue
+                    if role is None:
+                        return self._send(401, {"error": "unauthorized"})
+                    if write and role != "admin":
+                        return self._send(403, {"error": "forbidden (read-only role)"})
+                    body = {}
+                    length = int(self.headers.get("Content-Length") or 0)
+                    if length:
+                        try:
+                            body = json.loads(self.rfile.read(length))
+                        except ValueError:
+                            return self._send(400, {"error": "invalid JSON body"})
+                    req = dict(m.groupdict(), body=body, query=query)
+                    try:
+                        return self._send(200, getattr(api, fname)(req))
+                    except ApiError as e:
+                        return self._send(e.status, {"error": str(e)})
+                    except Exception as e:  # pragma: no cover - defensive
+                        logger.exception("REST handler failed")
+                        return self._send(500, {"error": str(e)})
+                self._send(404, {"error": f"no route for {self.command} {parts.path}"})
+
+            def _send(self, status: int, payload):
+                data = json.dumps(payload, default=str).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _dispatch
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="manager-rest", daemon=True
+        )
+        self._thread.start()
+        return f"{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()

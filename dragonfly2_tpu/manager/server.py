@@ -1,0 +1,78 @@
+"""Manager server assembly (reference manager/manager.go:87-330): DB +
+object-storage-backed model registry + gRPC service, with Serve/Stop
+lifecycle. The REST API router rides the same assembly when enabled."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from dragonfly2_tpu.manager.database import Database
+from dragonfly2_tpu.manager.models_registry import ModelRegistry
+from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+from dragonfly2_tpu.manager.service import ManagerService
+from dragonfly2_tpu.rpc import glue
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("manager.server")
+
+
+@dataclass
+class ManagerServerConfig:
+    data_dir: str = "/tmp/dragonfly2-manager"
+    listen: str = "127.0.0.1:0"
+    # REST API (manager/router): -1 = disabled, 0 = ephemeral port
+    rest_port: int = -1
+    rest_host: str = "127.0.0.1"
+    # bearer tokens accepted by the REST API, role per token
+    # ({token: "admin"|"guest"}); empty = unauthenticated (dev mode)
+    rest_tokens: dict = field(default_factory=dict)
+
+
+class ManagerServer:
+    def __init__(self, config: ManagerServerConfig):
+        self.cfg = config
+        Path(config.data_dir).mkdir(parents=True, exist_ok=True)
+        self.db = Database(str(Path(config.data_dir) / "manager.db"))
+        self.object_storage = FSObjectStorage(Path(config.data_dir) / "objects")
+        self.models = ModelRegistry(self.db, self.object_storage)
+        self.service = ManagerService(self.db, self.models)
+        self._grpc = None
+        self._rest = None
+        self.rest_addr: str | None = None
+
+    def serve(self) -> str:
+        from dragonfly2_tpu.manager.service import SERVICE_NAME
+
+        self._grpc, port = glue.serve({SERVICE_NAME: self.service}, self.cfg.listen)
+        host = self.cfg.listen.rsplit(":", 1)[0]
+        addr = f"{host}:{port}"
+        if self.cfg.rest_port >= 0:
+            from dragonfly2_tpu.manager.rest import RestServer
+
+            self._rest = RestServer(
+                self.service,
+                host=self.cfg.rest_host,
+                port=self.cfg.rest_port,
+                tokens=self.cfg.rest_tokens,
+            )
+            self.rest_addr = self._rest.start()
+            logger.info("manager REST on %s", self.rest_addr)
+        logger.info("manager gRPC on %s", addr)
+        return addr
+
+    def stop(self) -> None:
+        if self._rest is not None:
+            self._rest.stop()
+        if self._grpc is not None:
+            self._grpc.stop(grace=2).wait(5)
+        self.db.close()
+
+
+def build(config_path, overrides):
+    from dragonfly2_tpu.cli.config import load_config
+
+    cfg = load_config(
+        ManagerServerConfig, config_path, env_prefix="DF_MANAGER", overrides=overrides
+    )
+    return ManagerServer(cfg)

@@ -1,0 +1,10 @@
+"""`python -m dragonfly2_tpu.scheduler` — the scheduler binary (reference
+cmd/scheduler/main.go)."""
+
+import sys
+
+from dragonfly2_tpu.cli.runner import main_with_config
+from dragonfly2_tpu.scheduler.server import build
+
+if __name__ == "__main__":
+    sys.exit(main_with_config("scheduler", build))

@@ -1,0 +1,226 @@
+"""Scheduler server assembly (reference scheduler/scheduler.go:109-462):
+wires storage → manager client → trainer client → announcer → resource →
+networktopology → scheduling/evaluator (+ model refresher) → job worker →
+gRPC server, with Serve/Stop lifecycle in the reference's order."""
+
+from __future__ import annotations
+
+import socket
+from dataclasses import dataclass
+from pathlib import Path
+
+from dragonfly2_tpu.rpc import glue
+from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.announcer import Announcer
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator, MLEvaluator
+from dragonfly2_tpu.scheduler.networktopology import NetworkTopology
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
+from dragonfly2_tpu.scheduler.storage import Storage
+from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils.gc import GC, GCTask
+from dragonfly2_tpu.utils.kvstore import KVStore
+
+logger = dflog.get("scheduler.server")
+
+
+@dataclass
+class SchedulerServerConfig:
+    data_dir: str = "/tmp/dragonfly2-scheduler"
+    listen: str = "127.0.0.1:0"
+    advertise_ip: str = "127.0.0.1"
+    hostname: str = ""
+    cluster_id: int = 1
+    idc: str = ""
+    location: str = ""
+    # upstream services; empty = run standalone (reference allows both)
+    manager_address: str = ""
+    trainer_address: str = ""
+    # evaluator algorithm: "default" (linear) or "ml" (TPU-trained model
+    # via the manager registry, base fallback; reference evaluator.go:53)
+    algorithm: str = "default"
+    model_refresh_interval: float = 60.0
+    # dataset upload cadence (reference default is 7 DAYS; operators
+    # shorten it for fast feedback loops)
+    train_interval: float = 7 * 24 * 3600.0
+    keepalive_interval: float = 30.0
+    job_poll_interval: float = 5.0
+    # record sink rotation
+    storage_max_size: int = 100 * 1024 * 1024
+    storage_buffer_size: int = 64
+    # scheduling knobs (reference scheduling config)
+    retry_limit: int = 5
+    retry_back_to_source_limit: int = 5
+    retry_interval: float = 0.05
+    candidate_parent_limit: int = 4
+    # probe-graph CSV snapshot cadence (reference CollectInterval, 2h)
+    topology_snapshot_interval: float = 2 * 3600.0
+
+
+class SchedulerServer:
+    def __init__(self, config: SchedulerServerConfig):
+        self.cfg = config
+        if not config.hostname:
+            config.hostname = socket.gethostname()
+        Path(config.data_dir).mkdir(parents=True, exist_ok=True)
+
+        self.gc = GC()
+        self.resource = res.Resource(gc=self.gc)
+        self.storage = Storage(
+            Path(config.data_dir) / "records",
+            max_size=config.storage_max_size,
+            buffer_size=config.storage_buffer_size,
+        )
+        self.kvstore = KVStore()
+        self.networktopology = NetworkTopology(
+            self.kvstore, self.resource.host_manager, self.storage
+        )
+        self.gc.add(
+            GCTask(
+                "topology-snapshot",
+                config.topology_snapshot_interval,
+                config.topology_snapshot_interval,
+                self.networktopology.snapshot,
+            )
+        )
+
+        # upstream clients
+        self._manager_channel = None
+        self._trainer_channel = None
+        self.manager_client = None
+        if config.manager_address:
+            self._manager_channel = glue.dial(config.manager_address)
+            from dragonfly2_tpu.manager.service import ManagerGrpcClientAdapter
+
+            self.manager_client = ManagerGrpcClientAdapter(self._manager_channel)
+        if config.trainer_address:
+            self._trainer_channel = glue.dial(config.trainer_address)
+
+        # evaluator (+ live model refresh when the manager serves models)
+        self.model_refresher = None
+        if config.algorithm == "ml":
+            evaluator = MLEvaluator()
+            if self._manager_channel is not None:
+                from dragonfly2_tpu.manager.service import (
+                    SERVICE_NAME as MANAGER_SERVICE,
+                )
+                from dragonfly2_tpu.scheduler.model_refresher import ModelRefresher
+
+                self.model_refresher = ModelRefresher(
+                    glue.ServiceClient(self._manager_channel, MANAGER_SERVICE),
+                    evaluator,
+                    scheduler_cluster_id=config.cluster_id,
+                    interval=config.model_refresh_interval,
+                )
+        else:
+            evaluator = BaseEvaluator()
+        self.evaluator = evaluator
+
+        self.scheduling = Scheduling(
+            evaluator,
+            SchedulingConfig(
+                retry_limit=config.retry_limit,
+                retry_back_to_source_limit=config.retry_back_to_source_limit,
+                retry_interval=config.retry_interval,
+                candidate_parent_limit=config.candidate_parent_limit,
+            ),
+        )
+        self.service = SchedulerService(
+            self.resource,
+            self.scheduling,
+            storage=self.storage,
+            networktopology=self.networktopology,
+        )
+
+        self.announcer = Announcer(
+            self.storage,
+            ip=config.advertise_ip,
+            hostname=config.hostname,
+            trainer_channel=self._trainer_channel,
+            manager_client=self.manager_client,
+            cluster_id=str(config.cluster_id),
+            train_interval=config.train_interval,
+            keepalive_interval=config.keepalive_interval,
+        )
+
+        self.job_worker = None
+        if self._manager_channel is not None:
+            from dragonfly2_tpu.manager.service import SERVICE_NAME as MANAGER_SERVICE
+            from dragonfly2_tpu.scheduler.job import JobWorker
+            from dragonfly2_tpu.scheduler.resource.seed_peer import SeedPeerClient
+
+            self.job_worker = JobWorker(
+                glue.ServiceClient(self._manager_channel, MANAGER_SERVICE),
+                self.resource,
+                seed_client=SeedPeerClient(self.resource.host_manager),
+                hostname=config.hostname,
+                ip=config.advertise_ip,
+                cluster_id=config.cluster_id,
+                poll_interval=config.job_poll_interval,
+            )
+
+        self._grpc = None
+        self.port: int | None = None
+
+    # ------------------------------------------------------------------
+    def serve(self) -> str:
+        cfg = self.cfg
+        self._grpc, self.port = glue.serve({SERVICE_NAME: self.service}, cfg.listen)
+        addr = f"{cfg.listen.rsplit(':', 1)[0]}:{self.port}"
+        if self.manager_client is not None:
+            self._register_with_manager()
+        self.announcer.serve()
+        if self.model_refresher is not None:
+            self.model_refresher.start()
+        if self.job_worker is not None:
+            self.job_worker.start()
+        self.gc.start()
+        logger.info("scheduler gRPC on %s", addr)
+        return addr
+
+    def _register_with_manager(self) -> None:
+        """Register with the manager before serving traffic (reference
+        announcer.go:85-124 UpdateScheduler at startup)."""
+        import manager_pb2
+
+        from dragonfly2_tpu.manager.service import SERVICE_NAME as MANAGER_SERVICE
+
+        client = glue.ServiceClient(self._manager_channel, MANAGER_SERVICE)
+        client.UpdateScheduler(
+            manager_pb2.UpdateSchedulerRequest(
+                hostname=self.cfg.hostname,
+                ip=self.cfg.advertise_ip,
+                port=int(self.port or 0),
+                idc=self.cfg.idc,
+                location=self.cfg.location,
+                scheduler_cluster_id=self.cfg.cluster_id,
+            )
+        )
+
+    def stop(self) -> None:
+        # reference Stop order scheduler.go:368: dynconfig → resource →
+        # storage → gc → announcer → clients → graceful grpc stop
+        if self.job_worker is not None:
+            self.job_worker.stop()
+        if self.model_refresher is not None:
+            self.model_refresher.stop()
+        self.gc.stop()
+        self.announcer.stop()
+        if self._grpc is not None:
+            self._grpc.stop(grace=2).wait(5)
+        self.storage.flush()
+        for ch in (self._manager_channel, self._trainer_channel):
+            if ch is not None:
+                ch.close()
+
+
+def build(config_path, overrides):
+    from dragonfly2_tpu.cli.config import load_config
+
+    cfg = load_config(
+        SchedulerServerConfig,
+        config_path,
+        env_prefix="DF_SCHEDULER",
+        overrides=overrides,
+    )
+    return SchedulerServer(cfg)

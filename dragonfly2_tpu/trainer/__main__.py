@@ -1,0 +1,10 @@
+"""`python -m dragonfly2_tpu.trainer` — the trainer binary (reference
+cmd/trainer/main.go)."""
+
+import sys
+
+from dragonfly2_tpu.cli.runner import main_with_config
+from dragonfly2_tpu.trainer.server import build
+
+if __name__ == "__main__":
+    sys.exit(main_with_config("trainer", build))

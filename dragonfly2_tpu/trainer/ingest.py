@@ -139,6 +139,11 @@ def stream_shards(
     try:
         while done < len(threads):
             item = q.get()
+            if errors:
+                # fail fast: one broken producer must abort the whole
+                # stream now, not after the surviving workers finish a
+                # multi-pass run whose result gets discarded anyway
+                break
             if item is None:
                 done += 1
                 continue

@@ -1,0 +1,95 @@
+"""Trainer server assembly (reference trainer/trainer.go:49-187): manager
+client + storage + training core + gRPC server, Serve/Stop lifecycle."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+
+from dragonfly2_tpu.rpc import glue
+from dragonfly2_tpu.trainer.service import SERVICE_NAME, TrainerService
+from dragonfly2_tpu.trainer.storage import TrainerStorage
+from dragonfly2_tpu.trainer.train import FitConfig, GNNFitConfig
+from dragonfly2_tpu.trainer.training import Training, TrainingConfig
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("trainer.server")
+
+
+@dataclass
+class TrainerServerConfig:
+    data_dir: str = "/tmp/dragonfly2-trainer"
+    listen: str = "127.0.0.1:0"
+    manager_address: str = ""
+    # fit knobs (subset; full control through TrainingConfig in-process)
+    mlp_epochs: int = 3
+    mlp_batch_size: int = 8192
+    gnn_epochs: int = 60
+    min_download_records: int = 1
+    min_topology_records: int = 1
+    incremental: bool = False
+    streaming: bool = True
+    streaming_workers: int = 1
+    # run fits inline with the Train RPC (tests/debug) instead of async
+    synchronous: bool = False
+
+
+class TrainerServer:
+    def __init__(self, config: TrainerServerConfig):
+        self.cfg = config
+        Path(config.data_dir).mkdir(parents=True, exist_ok=True)
+        self.storage = TrainerStorage(config.data_dir)
+
+        self._manager_channel = None
+        manager_client = None
+        if config.manager_address:
+            self._manager_channel = glue.dial(config.manager_address)
+            from dragonfly2_tpu.manager.service import ManagerGrpcClientAdapter
+
+            manager_client = ManagerGrpcClientAdapter(self._manager_channel)
+
+        self.training = Training(
+            self.storage,
+            manager_client=manager_client,
+            config=TrainingConfig(
+                mlp=FitConfig(
+                    epochs=config.mlp_epochs, batch_size=config.mlp_batch_size
+                ),
+                gnn=GNNFitConfig(epochs=config.gnn_epochs),
+                min_download_records=config.min_download_records,
+                min_topology_records=config.min_topology_records,
+                incremental=config.incremental,
+                clear_after_train=not config.incremental,
+                streaming=config.streaming,
+                streaming_workers=config.streaming_workers,
+            ),
+        )
+        self.service = TrainerService(
+            self.storage, self.training, synchronous=config.synchronous
+        )
+        self._grpc = None
+
+    def serve(self) -> str:
+        self._grpc, port = glue.serve({SERVICE_NAME: self.service}, self.cfg.listen)
+        addr = f"{self.cfg.listen.rsplit(':', 1)[0]}:{port}"
+        logger.info("trainer gRPC on %s", addr)
+        return addr
+
+    def stop(self) -> None:
+        if self._grpc is not None:
+            self._grpc.stop(grace=2).wait(5)
+        if self._manager_channel is not None:
+            self._manager_channel.close()
+        # the reference clears trainer storage on shutdown
+        # (trainer/trainer.go:156-161) unless running incremental rounds
+        if not self.cfg.incremental:
+            self.storage.clear()
+
+
+def build(config_path, overrides):
+    from dragonfly2_tpu.cli.config import load_config
+
+    cfg = load_config(
+        TrainerServerConfig, config_path, env_prefix="DF_TRAINER", overrides=overrides
+    )
+    return TrainerServer(cfg)

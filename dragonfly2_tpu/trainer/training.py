@@ -182,6 +182,21 @@ class Training:
         from dragonfly2_tpu.trainer.ingest import stream_train_mlp
 
         cfg = self.config.mlp
+        if self.config.min_download_records > 1:
+            # cheap pre-gate (batch path checks before fitting too): a
+            # bounded decode stops as soon as min records are seen, so a
+            # sparse host fails here instead of after the full multi-pass
+            # fit on the chip
+            rows = 0
+            for _, _, rows in native.stream_pairs_file(
+                path, offset=offset, max_records=self.config.min_download_records
+            ):
+                pass
+            if rows < self.config.min_download_records:
+                raise ValueError(
+                    f"{rows} download records for host {host_id}"
+                    f" < min {self.config.min_download_records}"
+                )
         eval_every = (
             max(2, round(1.0 / cfg.eval_fraction)) if cfg.eval_fraction > 0 else 0
         )

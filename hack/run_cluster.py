@@ -1,0 +1,236 @@
+#!/usr/bin/env python3
+"""Boot a full local cluster as real OS processes and drive a download
+through it (reference deploy/docker-compose bring-up + test/e2e dfget):
+
+    manager (gRPC + REST) → trainer → scheduler → 2 dfdaemons
+    → dfget back-to-source through daemon A
+    → dfget P2P through daemon B (pieces served by A)
+    → verify bytes, a Download record on the scheduler, REST visibility
+
+Exit code 0 = PASS. Used by hack/run_cluster.sh and the subprocess e2e
+test (tests/test_cluster_subprocess.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class Proc:
+    def __init__(self, name: str, args: list[str], env: dict):
+        self.name = name
+        self.proc = subprocess.Popen(
+            [sys.executable, *args],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL if os.environ.get("DF_QUIET") else None,
+            text=True,
+            env=env,
+            cwd=REPO,
+        )
+        self.addr: str | None = None
+
+    def wait_ready(self, timeout: float = 120.0) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(f"{self.name} exited rc={self.proc.returncode}")
+            line = self.proc.stdout.readline()
+            if line.startswith("READY "):
+                self.addr = line.split()[2]
+                return self.addr
+        raise TimeoutError(f"{self.name} not READY within {timeout}s")
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def main() -> int:
+    work = tempfile.mkdtemp(prefix="dfcluster-")
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        PYTHONUNBUFFERED="1",
+        DF_JAX_PLATFORM=os.environ.get("DF_JAX_PLATFORM", "cpu"),
+    )
+    procs: list[Proc] = []
+    try:
+        manager = Proc(
+            "manager",
+            [
+                "-m",
+                "dragonfly2_tpu.manager",
+                "--set",
+                f"data_dir={work}/manager",
+                "--set",
+                "rest_port=0",
+            ],
+            env,
+        )
+        procs.append(manager)
+        manager_addr = manager.wait_ready()
+
+        trainer = Proc(
+            "trainer",
+            [
+                "-m",
+                "dragonfly2_tpu.trainer",
+                "--set",
+                f"data_dir={work}/trainer",
+                "--set",
+                f"manager_address={manager_addr}",
+            ],
+            env,
+        )
+        procs.append(trainer)
+        trainer_addr = trainer.wait_ready()
+
+        scheduler = Proc(
+            "scheduler",
+            [
+                "-m",
+                "dragonfly2_tpu.scheduler",
+                "--set",
+                f"data_dir={work}/scheduler",
+                "--set",
+                f"manager_address={manager_addr}",
+                "--set",
+                f"trainer_address={trainer_addr}",
+                "--set",
+                "algorithm=ml",
+                "--set",
+                "storage_buffer_size=1",
+                "--set",
+                "hostname=sched-e2e",
+            ],
+            env,
+        )
+        procs.append(scheduler)
+        scheduler_addr = scheduler.wait_ready()
+
+        daemons = []
+        for name in ("a", "b"):
+            d = Proc(
+                f"daemon-{name}",
+                [
+                    "-m",
+                    "dragonfly2_tpu.client.daemon",
+                    "--set",
+                    f"data_dir={work}/daemon-{name}",
+                    "--set",
+                    f"scheduler_address={scheduler_addr}",
+                    "--set",
+                    f"hostname=host-{name}",
+                    "--set",
+                    "piece_length=65536",
+                    "--set",
+                    "schedule_timeout=10.0",
+                ],
+                env,
+            )
+            procs.append(d)
+            daemons.append(d)
+        daemon_addrs = [d.wait_ready() for d in daemons]
+
+        # origin file (file:// keeps the script hermetic; http origins are
+        # covered by the in-process e2e tests)
+        payload = os.urandom(300 * 1024)
+        origin = os.path.join(work, "origin.bin")
+        with open(origin, "wb") as f:
+            f.write(payload)
+        url = f"file://{origin}"
+
+        # dfget through daemon A: back-to-source
+        out_a = os.path.join(work, "out-a.bin")
+        rc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "dragonfly2_tpu.client.dfget",
+                url,
+                "-O",
+                out_a,
+                "--daemon",
+                daemon_addrs[0],
+            ],
+            env=env,
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+        )
+        assert rc.returncode == 0, f"dfget A failed: {rc.stderr[-2000:]}"
+        assert open(out_a, "rb").read() == payload, "daemon A bytes mismatch"
+        print("PASS dfget back-to-source via daemon A")
+
+        # dfget through daemon B: must pull pieces from A over P2P
+        out_b = os.path.join(work, "out-b.bin")
+        rc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "dragonfly2_tpu.client.dfget",
+                url,
+                "-O",
+                out_b,
+                "--daemon",
+                daemon_addrs[1],
+            ],
+            env=env,
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+        )
+        assert rc.returncode == 0, f"dfget B failed: {rc.stderr[-2000:]}"
+        assert open(out_b, "rb").read() == payload, "daemon B bytes mismatch"
+        print("PASS dfget P2P via daemon B")
+
+        # training records landed on the scheduler
+        records_dir = os.path.join(work, "scheduler", "records")
+        deadline = time.time() + 10
+        have_records = False
+        while time.time() < deadline and not have_records:
+            for root, _, files in os.walk(records_dir):
+                if any(f.startswith("download") and f.endswith(".csv") for f in files):
+                    have_records = True
+            time.sleep(0.2)
+        assert have_records, f"no download records under {records_dir}"
+        print("PASS download records written")
+
+        # manager sees the registered scheduler (gRPC registry; the REST
+        # surface is covered by tests/test_manager_rest.py)
+        sys.path.insert(0, REPO)
+        from dragonfly2_tpu.rpc import glue, gen  # noqa: F401
+        import manager_pb2
+        from dragonfly2_tpu.manager.service import SERVICE_NAME
+
+        ch = glue.dial(manager_addr)
+        client = glue.ServiceClient(ch, SERVICE_NAME)
+        resp = client.ListSchedulers(manager_pb2.ListSchedulersRequest())
+        names = [s.hostname for s in resp.schedulers]
+        assert "sched-e2e" in names, f"scheduler not registered: {names}"
+        ch.close()
+        print("PASS scheduler registered with manager")
+
+        print("CLUSTER E2E: ALL PASS")
+        return 0
+    finally:
+        for p in reversed(procs):
+            p.stop()
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Boot manager + trainer + scheduler + 2 dfdaemons as real processes and
+# drive dfget through the swarm (reference deploy/docker-compose +
+# test/e2e). Exit 0 = PASS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python3 hack/run_cluster.py "$@"

@@ -1,0 +1,27 @@
+"""Subprocess-level e2e: the real service binaries
+(`python -m dragonfly2_tpu.{manager,scheduler,trainer}` and
+`python -m dragonfly2_tpu.client.daemon`) boot as OS processes, a real
+dfget runs against them, and bytes + training records land — the
+reference's kind/compose e2e suite in miniature (test/e2e/dfget_test.go,
+hack/install-e2e-test.sh)."""
+
+import os
+import subprocess
+import sys
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_run_cluster_script():
+    env = dict(os.environ, DF_QUIET="1", DF_JAX_PLATFORM="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "hack", "run_cluster.py")],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=280,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-3000:]}"
+    assert "CLUSTER E2E: ALL PASS" in proc.stdout

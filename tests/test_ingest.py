@@ -349,3 +349,18 @@ def test_training_streaming_respects_min_records(tmp_path):
     outcome = t.train(ip, hostname)
     assert outcome.mlp_error is not None
     assert "min 1000" in outcome.mlp_error
+
+
+def test_failed_producer_aborts_stream_promptly(tmp_path):
+    """A worker whose span turns unreadable must abort the whole stream
+    at the next shard, not after the surviving workers drain."""
+    import dragonfly2_tpu.schema.native as N
+    from dragonfly2_tpu.trainer.ingest import stream_shards
+
+    good = _write_dataset(tmp_path / "good.csv", 40)
+    missing = tmp_path / "gone.csv"
+    _write_dataset(missing, 40)
+    missing.unlink()  # span stat will fail inside the producer... or split
+    with pytest.raises((OSError, RuntimeError)):
+        for _ in stream_shards([good, missing], passes=50, workers=2):
+            pass

@@ -1,0 +1,161 @@
+"""Manager REST API (manager/rest.py): CRUD surface, bearer-token roles,
+and model activation — the reference's manager/handlers + casbin RBAC
+shape (router.go:269, service/model.go:109)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.manager.database import Database
+from dragonfly2_tpu.manager.models_registry import ModelRegistry
+from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+from dragonfly2_tpu.manager.rest import RestServer
+from dragonfly2_tpu.manager.service import ManagerService
+
+
+@pytest.fixture
+def rest(tmp_path):
+    db = Database(tmp_path / "m.db")
+    models = ModelRegistry(db, FSObjectStorage(tmp_path / "obj"))
+    service = ManagerService(db, models)
+    server = RestServer(
+        service, tokens={"admin-tok": "admin", "guest-tok": "guest"}
+    )
+    addr = server.start()
+    yield {"addr": addr, "db": db, "models": models, "service": service}
+    server.stop()
+    db.close()
+
+
+def call(addr, method, path, body=None, token="admin-tok"):
+    req = urllib.request.Request(
+        f"http://{addr}{path}",
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Authorization": f"Bearer {token}"} if token else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_health_and_auth(rest):
+    addr = rest["addr"]
+    status, body = call(addr, "GET", "/healthy")
+    assert status == 200 and body["status"] == "ok"
+    # no token → 401
+    status, body = call(addr, "GET", "/api/v1/schedulers", token=None)
+    assert status == 401
+    # bad token → 401
+    status, body = call(addr, "GET", "/api/v1/schedulers", token="nope")
+    assert status == 401
+    # guest can read
+    status, body = call(addr, "GET", "/api/v1/schedulers", token="guest-tok")
+    assert status == 200 and body == []
+    # guest cannot write
+    status, body = call(
+        addr, "POST", "/api/v1/scheduler-clusters", {"name": "x"}, token="guest-tok"
+    )
+    assert status == 403
+
+
+def test_cluster_crud(rest):
+    addr = rest["addr"]
+    status, created = call(
+        addr,
+        "POST",
+        "/api/v1/scheduler-clusters",
+        {"name": "cluster-2", "config": {"candidate_parent_limit": 7}},
+    )
+    assert status == 200
+    cid = created["id"]
+    status, got = call(addr, "GET", f"/api/v1/scheduler-clusters/{cid}")
+    assert status == 200 and got["name"] == "cluster-2"
+    assert json.loads(got["config"])["candidate_parent_limit"] == 7
+    status, updated = call(
+        addr, "PATCH", f"/api/v1/scheduler-clusters/{cid}", {"config": {"a": 1}}
+    )
+    assert status == 200 and json.loads(updated["config"]) == {"a": 1}
+    status, _ = call(addr, "DELETE", f"/api/v1/scheduler-clusters/{cid}")
+    assert status == 200
+    status, _ = call(addr, "GET", f"/api/v1/scheduler-clusters/{cid}")
+    assert status == 404
+
+
+def test_jobs_roundtrip(rest):
+    addr = rest["addr"]
+    status, job = call(
+        addr,
+        "POST",
+        "/api/v1/jobs",
+        {"type": "preheat", "args": {"url": "https://x/blob"}, "scheduler_cluster_id": 1},
+    )
+    assert status == 200 and job["state"] == "queued"
+    status, got = call(addr, "GET", f"/api/v1/jobs/{job['id']}")
+    assert status == 200 and got["type"] == "preheat"
+    status, jobs = call(addr, "GET", "/api/v1/jobs")
+    assert status == 200 and len(jobs) == 1
+
+
+def test_model_activation_flow(rest):
+    """Upload two versions via the registry, flip activation through
+    REST, verify the previously-active version deactivates (reference
+    updateModelStateToActive version flip)."""
+    addr = rest["addr"]
+    models: ModelRegistry = rest["models"]
+    weights = np.arange(4, dtype=np.float32).tobytes()
+    models.create("mlp-host-1", "mlp", weights, {"mse": 0.5}, ip="1.2.3.4",
+                  hostname="h1", scheduler_cluster_id=1)
+    models.create("mlp-host-1", "mlp", weights, {"mse": 0.4}, ip="1.2.3.4",
+                  hostname="h1", scheduler_cluster_id=1)
+
+    status, listed = call(addr, "GET", "/api/v1/models?scheduler_cluster_id=1")
+    assert status == 200 and len(listed) == 2
+    assert all(m["state"] == "inactive" for m in listed)
+
+    status, act = call(
+        addr, "PUT", "/api/v1/models/mlp-host-1/versions/1/state", {"state": "active"}
+    )
+    assert status == 200 and act["state"] == "active"
+
+    status, act2 = call(
+        addr, "PUT", "/api/v1/models/mlp-host-1/versions/2/state", {"state": "active"}
+    )
+    assert status == 200 and act2["state"] == "active"
+    # version 1 flipped back to inactive
+    status, v1 = call(addr, "GET", "/api/v1/models/mlp-host-1/versions/1")
+    assert status == 200 and v1["state"] == "inactive"
+
+    status, _ = call(addr, "DELETE", "/api/v1/models/mlp-host-1/versions/1")
+    assert status == 200
+    status, _ = call(addr, "GET", "/api/v1/models/mlp-host-1/versions/1")
+    assert status == 404
+
+
+def test_applications(rest):
+    addr = rest["addr"]
+    status, app = call(
+        addr, "POST", "/api/v1/applications",
+        {"name": "registry", "url": "https://r.io", "priority": {"level": 3}},
+    )
+    assert status == 200
+    status, apps = call(addr, "GET", "/api/v1/applications")
+    assert status == 200 and apps[0]["name"] == "registry"
+
+
+def test_open_mode_without_tokens(tmp_path):
+    db = Database(tmp_path / "m.db")
+    service = ManagerService(db, ModelRegistry(db, FSObjectStorage(tmp_path / "o")))
+    server = RestServer(service)  # no tokens = dev mode
+    addr = server.start()
+    try:
+        status, _ = call(addr, "GET", "/api/v1/schedulers", token=None)
+        assert status == 200
+    finally:
+        server.stop()
+        db.close()

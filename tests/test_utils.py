@@ -203,3 +203,49 @@ class TestKVStore:
         t[0] = 11.0
         assert kv.get("k") is None
         assert not kv.exists("k")
+
+
+def test_cli_config_yaml_env_overrides(tmp_path, monkeypatch):
+    """Service config precedence: defaults < YAML < env < explicit
+    overrides; unknown keys fail loudly (cli/config.py)."""
+    import pytest
+
+    from dragonfly2_tpu.cli.config import ConfigError, load_config
+    from dragonfly2_tpu.scheduler.server import SchedulerServerConfig
+
+    p = tmp_path / "s.yaml"
+    p.write_text("listen: 1.2.3.4:9\nretry_limit: 7\ntrain_interval: 10.5\n")
+    cfg = load_config(SchedulerServerConfig, p)
+    assert cfg.listen == "1.2.3.4:9" and cfg.retry_limit == 7
+    assert cfg.train_interval == 10.5
+
+    monkeypatch.setenv("DF_SCHEDULER_RETRY_LIMIT", "3")
+    cfg = load_config(SchedulerServerConfig, p, env_prefix="DF_SCHEDULER")
+    assert cfg.retry_limit == 3  # env beats yaml
+
+    cfg = load_config(
+        SchedulerServerConfig, p, env_prefix="DF_SCHEDULER", overrides={"retry_limit": 1}
+    )
+    assert cfg.retry_limit == 1  # explicit beats env
+
+    p.write_text("no_such_key: 1\n")
+    with pytest.raises(ConfigError):
+        load_config(SchedulerServerConfig, p)
+
+
+def test_example_configs_parse():
+    """The shipped example YAMLs must stay loadable against the real
+    config dataclasses."""
+    import os
+
+    from dragonfly2_tpu.cli.config import load_config
+    from dragonfly2_tpu.client.daemon import DaemonConfig
+    from dragonfly2_tpu.manager.server import ManagerServerConfig
+    from dragonfly2_tpu.scheduler.server import SchedulerServerConfig
+    from dragonfly2_tpu.trainer.server import TrainerServerConfig
+
+    root = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "hack", "configs")
+    load_config(SchedulerServerConfig, os.path.join(root, "scheduler.yaml"))
+    load_config(ManagerServerConfig, os.path.join(root, "manager.yaml"))
+    load_config(TrainerServerConfig, os.path.join(root, "trainer.yaml"))
+    load_config(DaemonConfig, os.path.join(root, "daemon.yaml"))
