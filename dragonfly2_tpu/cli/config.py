@@ -72,8 +72,6 @@ def load_config(
                     ) from e
 
     for k, v in (overrides or {}).items():
-        if v is None:
-            continue
         if k not in fields:
             raise ConfigError(f"unknown config key {k!r} for {cls.__name__}")
         values[k] = v

@@ -76,12 +76,31 @@ class DaemonConfig:
     upload_delay_s: float = 0.0
 
 
+def _apply_stat_overrides(stats: "hostinfo.HostStats", overrides: dict) -> None:
+    """Apply dotted-path overrides onto a HostStats, raising on unknown
+    paths — a typo silently keeping the sampled value would poison every
+    announced record (round-2 ADVICE c). Shared by the constructor's
+    fail-fast validation and the per-announce application."""
+    for path, value in overrides.items():
+        group, _, attr = path.partition(".")
+        target = getattr(stats, group, None)
+        if target is None or not attr or not hasattr(target, attr):
+            raise ValueError(
+                f"host_stats_override: unknown stat path {path!r}"
+                f" (expected '<group>.<field>' on HostStats)"
+            )
+        setattr(target, attr, value)
+
+
 class Daemon:
     """One peer host: piece store + upload server + dfdaemon gRPC +
     scheduler announce/probe loops."""
 
     def __init__(self, config: DaemonConfig):
         self.cfg = config
+        # fail fast on typo'd stat paths — don't wait for the first
+        # announce to discover a bad config
+        _apply_stat_overrides(hostinfo.HostStats(), config.host_stats_override)
         self.host_id = host_id_v2(config.ip, config.hostname)
         self.storage = StorageManager(config.data_dir, max_bytes=config.storage_max_bytes)
         self.upload = UploadServer(
@@ -117,6 +136,7 @@ class Daemon:
                 schedule_timeout=self.cfg.schedule_timeout,
                 piece_length=self.cfg.piece_length,
             ),
+            host_info_fn=self.host_info,
         )
         service = DfdaemonService(
             task_manager=self.task_manager,
@@ -242,9 +262,7 @@ class Daemon:
             )
         else:
             stats = hostinfo.HostStats()
-        for path, value in self.cfg.host_stats_override.items():
-            group, _, attr = path.partition(".")
-            setattr(getattr(stats, group), attr, value)
+        _apply_stat_overrides(stats, self.cfg.host_stats_override)
         return stats
 
     def host_info(self) -> common_pb2.HostInfo:

@@ -45,12 +45,14 @@ class TaskManager:
         scheduler_client,
         piece_manager: PieceManager | None = None,
         options: ConductorOptions | None = None,
+        host_info_fn=None,  # () -> common_pb2.HostInfo, for AnnounceTask
     ):
         self.host_id = host_id
         self.storage = storage
         self.scheduler = scheduler_client
         self.pm = piece_manager or PieceManager()
         self.options = options or ConductorOptions()
+        self.host_info_fn = host_info_fn
         self.conductors: dict[str, PeerTaskConductor] = {}
         self.lock = threading.Lock()
 
@@ -163,6 +165,7 @@ class TaskManager:
         self.scheduler.AnnounceTask(
             scheduler_pb2.AnnounceTaskRequest(
                 host_id=self.host_id,
+                host=self.host_info_fn() if self.host_info_fn else None,
                 task_id=ts.meta.task_id,
                 peer_id=ts.meta.peer_id,
                 url=ts.meta.url,

@@ -76,6 +76,7 @@ CREATE TABLE IF NOT EXISTS models (
   hostname TEXT NOT NULL DEFAULT '',
   scheduler_cluster_id INTEGER NOT NULL DEFAULT 0,
   created_at REAL NOT NULL,
+  updated_at REAL NOT NULL DEFAULT 0,
   UNIQUE(model_id, version)
 );
 CREATE TABLE IF NOT EXISTS jobs (
@@ -105,7 +106,20 @@ class Database:
         self._conn = sqlite3.connect(str(path), check_same_thread=False)
         self._conn.row_factory = sqlite3.Row
         self._conn.executescript(_SCHEMA)
+        self._migrate()
         self._lock = threading.RLock()
+
+    def _migrate(self) -> None:
+        """Additive column migrations for databases created by earlier
+        versions (CREATE TABLE IF NOT EXISTS never alters existing
+        tables)."""
+        for table, column, decl in [
+            ("models", "updated_at", "REAL NOT NULL DEFAULT 0"),
+        ]:
+            cols = {r[1] for r in self._conn.execute(f"PRAGMA table_info({table})")}
+            if column not in cols:
+                self._conn.execute(f"ALTER TABLE {table} ADD COLUMN {column} {decl}")
+        self._conn.commit()
 
     def execute(self, sql: str, params: tuple = ()) -> sqlite3.Cursor:
         with self._lock:

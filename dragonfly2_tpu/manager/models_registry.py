@@ -36,6 +36,7 @@ class ModelRow:
     hostname: str = ""
     scheduler_cluster_id: int = 0
     created_at: float = 0.0
+    updated_at: float = 0.0  # last state flip (activation recency)
 
 
 class ModelRegistry:
@@ -76,8 +77,9 @@ class ModelRegistry:
         with self._lock:
             self.db.execute(
                 "INSERT INTO models (model_id, type, version, state, evaluation,"
-                " object_key, ip, hostname, scheduler_cluster_id, created_at)"
-                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                " object_key, ip, hostname, scheduler_cluster_id, created_at,"
+                " updated_at)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
                 (
                     model_id,
                     model_type,
@@ -88,6 +90,7 @@ class ModelRegistry:
                     ip,
                     hostname,
                     scheduler_cluster_id,
+                    time.time(),
                     time.time(),
                 ),
             )
@@ -128,15 +131,34 @@ class ModelRegistry:
         if target is None:
             raise KeyError(f"model {model_id} version {version} not found")
         version = target.version
+        now = time.time()
         with self._lock:
             self.db.execute(
                 "UPDATE models SET state = ? WHERE model_id = ?", (STATE_INACTIVE, model_id)
             )
+            # updated_at records ACTIVATION recency: the model refresher
+            # must install "most recently activated", not "most recently
+            # created" — re-activating an older model is an operator
+            # decision that has to take effect (round-2 ADVICE b)
             self.db.execute(
-                "UPDATE models SET state = ? WHERE model_id = ? AND version = ?",
-                (STATE_ACTIVE, model_id, version),
+                "UPDATE models SET state = ?, updated_at = ? WHERE model_id = ? AND version = ?",
+                (STATE_ACTIVE, now, model_id, version),
             )
         return self.get(model_id, version)
+
+    def deactivate(self, model_id: str, version: int) -> ModelRow:
+        """Explicit operator deactivation; stamps updated_at (the 'last
+        state flip' the proto documents) under the same lock as
+        activate."""
+        target = self.get(model_id, version)
+        if target is None:
+            raise KeyError(f"model {model_id} version {version} not found")
+        with self._lock:
+            self.db.execute(
+                "UPDATE models SET state = ?, updated_at = ? WHERE model_id = ? AND version = ?",
+                (STATE_INACTIVE, time.time(), model_id, target.version),
+            )
+        return self.get(model_id, target.version)
 
     def delete(self, model_id: str, version: int) -> None:
         row = self.get(model_id, version)
@@ -166,4 +188,5 @@ class ModelRegistry:
             hostname=r["hostname"],
             scheduler_cluster_id=r["scheduler_cluster_id"],
             created_at=r["created_at"],
+            updated_at=r.get("updated_at", 0.0),
         )

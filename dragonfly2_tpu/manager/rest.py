@@ -209,15 +209,12 @@ class RestApi:
         if state not in ("active", "inactive"):
             raise ApiError(400, "state must be 'active' or 'inactive'")
         model_id, version = req["model_id"], int(req["version"])
-        if state == "active":
-            row = self.models.activate(model_id, version)
-            return vars(row)
-        self.db.execute(
-            "UPDATE models SET state = 'inactive' WHERE model_id = ? AND version = ?",
-            (model_id, version),
-        )
-        row = self.models.get(model_id, version)
-        if row is None:
+        try:
+            if state == "active":
+                row = self.models.activate(model_id, version)
+            else:
+                row = self.models.deactivate(model_id, version)
+        except KeyError:
             raise ApiError(404, "model not found")
         return vars(row)
 
@@ -298,7 +295,9 @@ class RestServer:
                     m = rx.match(parts.path)
                     if not m:
                         continue
-                    if role is None:
+                    # health probes stay unauthenticated (LBs and
+                    # liveness checks don't carry tokens)
+                    if role is None and parts.path != "/healthy":
                         return self._send(401, {"error": "unauthorized"})
                     if write and role != "admin":
                         return self._send(403, {"error": "forbidden (read-only role)"})
@@ -314,6 +313,10 @@ class RestServer:
                         return self._send(200, getattr(api, fname)(req))
                     except ApiError as e:
                         return self._send(e.status, {"error": str(e)})
+                    except ValueError as e:
+                        # non-numeric path/query params etc. are client
+                        # errors, not server faults
+                        return self._send(400, {"error": str(e)})
                     except Exception as e:  # pragma: no cover - defensive
                         logger.exception("REST handler failed")
                         return self._send(500, {"error": str(e)})

@@ -335,6 +335,7 @@ class ManagerService:
             ),
             object_key=row.object_key,
             created_at_ns=int(row.created_at * 1e9),
+            updated_at_ns=int(row.updated_at * 1e9),
         )
 
 

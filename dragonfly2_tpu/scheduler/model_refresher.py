@@ -70,9 +70,11 @@ class ModelRefresher:
                 self.loaded_version = None
             return False
 
-        # newest activation wins if several MLP models are active (e.g.
-        # per-source-host model ids)
-        m = max(active, key=lambda m: m.created_at_ns)
+        # newest ACTIVATION wins if several MLP models are active (e.g.
+        # per-source-host model ids) — updated_at_ns is stamped by the
+        # manager's activate flip, so re-activating an older model takes
+        # effect; created_at_ns breaks ties for pre-migration rows
+        m = max(active, key=lambda m: (m.updated_at_ns, m.created_at_ns))
         key = (m.model_id, m.version)
         if key == self.loaded_version:
             return False

@@ -403,13 +403,19 @@ class SchedulerService:
         scheduler/service/service_v1.go AnnounceTask — dfcache import and
         the object gateway's seed-on-write path)."""
         host = self.resource.host_manager.load(request.host_id)
+        if host is None and request.HasField("host") and request.host.id:
+            # the request carries full host addressing (reference
+            # service_v1.go:349 ships PeerHost and registers it via
+            # storeHost) — a restarted scheduler re-learns the host here
+            # instead of rejecting the announce
+            host = _host_from_info(request.host)
+            self.resource.host_manager.store(host)
         if host is None:
-            # an unannounced host has no ip/ports — registering it would
-            # hand children a permanently unreachable parent (reference
-            # AnnounceTask returns NotFound for unknown hosts)
+            # no addressing at all: registering would hand children a
+            # permanently unreachable parent
             context.abort(
                 grpc.StatusCode.NOT_FOUND,
-                f"host {request.host_id} has not announced",
+                f"host {request.host_id} has not announced and carried no addressing",
             )
 
         meta = URLMeta(

@@ -39,10 +39,17 @@ class Proc:
         self.addr: str | None = None
 
     def wait_ready(self, timeout: float = 120.0) -> str:
+        import select
+
         deadline = time.time() + timeout
         while time.time() < deadline:
             if self.proc.poll() is not None:
                 raise RuntimeError(f"{self.name} exited rc={self.proc.returncode}")
+            # select keeps the deadline honest even when the child is
+            # alive but silent (readline alone would block forever)
+            ready, _, _ = select.select([self.proc.stdout], [], [], 1.0)
+            if not ready:
+                continue
             line = self.proc.stdout.readline()
             if line.startswith("READY "):
                 self.addr = line.split()[2]

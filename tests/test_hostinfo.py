@@ -49,3 +49,37 @@ def test_host_stats_override(tmp_path):
     assert info.network.tcp_connection_count == 41
     # non-overridden values still sampled live
     assert info.memory.total > 0
+
+
+def test_host_stats_override_typo_fails_fast(tmp_path):
+    """Regression (round-2 ADVICE c): a typo'd override path must raise
+    at daemon construction, not silently keep the sampled value."""
+    import pytest
+
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+
+    with pytest.raises(ValueError, match="unknown stat path"):
+        Daemon(
+            DaemonConfig(
+                data_dir=str(tmp_path / "d"),
+                scheduler_address="127.0.0.1:1",
+                host_stats_override={"cpu.percnt": 90.0},  # typo
+            )
+        )
+    with pytest.raises(ValueError, match="unknown stat path"):
+        Daemon(
+            DaemonConfig(
+                data_dir=str(tmp_path / "d2"),
+                scheduler_address="127.0.0.1:1",
+                host_stats_override={"gpu.percent": 90.0},  # no such group
+            )
+        )
+    # valid path still constructs
+    d = Daemon(
+        DaemonConfig(
+            data_dir=str(tmp_path / "d3"),
+            scheduler_address="127.0.0.1:1",
+            host_stats_override={"cpu.percent": 90.0},
+        )
+    )
+    assert d.host_stats().cpu.percent == 90.0

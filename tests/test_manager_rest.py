@@ -159,3 +159,30 @@ def test_open_mode_without_tokens(tmp_path):
     finally:
         server.stop()
         db.close()
+
+
+def test_healthy_is_unauthenticated(rest):
+    status, body = call(rest["addr"], "GET", "/healthy", token=None)
+    assert status == 200 and body["status"] == "ok"
+
+
+def test_bad_path_param_is_client_error(rest):
+    status, body = call(rest["addr"], "GET", "/api/v1/schedulers/abc")
+    assert status == 400
+
+
+def test_deactivate_stamps_updated_at(rest):
+    import numpy as np
+
+    models = rest["models"]
+    models.create("m1", "mlp", b"\x00", {"mse": 1.0}, scheduler_cluster_id=1)
+    models.activate("m1", 1)
+    before = models.get("m1", 1).updated_at
+    import time
+
+    time.sleep(0.01)
+    status, row = call(
+        rest["addr"], "PUT", "/api/v1/models/m1/versions/1/state", {"state": "inactive"}
+    )
+    assert status == 200 and row["state"] == "inactive"
+    assert models.get("m1", 1).updated_at > before

@@ -117,3 +117,53 @@ def test_refresher_upgrades_and_withdraws(manager):
     assert not refresher.refresh_once()
     assert refresher.loaded_version == ("mlp-model", 2)
     assert evaluator._model is not None
+
+
+def test_reactivating_older_model_takes_effect(tmp_path):
+    """Regression (round-2 ADVICE b): with two active-capable model ids,
+    re-activating the OLDER one must install it — selection follows
+    activation recency (updated_at), not creation time."""
+    import numpy as np
+
+    from dragonfly2_tpu.manager.database import Database
+    from dragonfly2_tpu.manager.models_registry import ModelRegistry
+    from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+    from dragonfly2_tpu.manager.service import ManagerService
+    from dragonfly2_tpu.rpc.glue import serve, dial, ServiceClient
+    from dragonfly2_tpu.rpc import gen  # noqa: F401
+    from dragonfly2_tpu.manager.service import SERVICE_NAME
+    from dragonfly2_tpu.scheduler.evaluator import MLEvaluator
+    from dragonfly2_tpu.scheduler.model_refresher import ModelRefresher
+    from dragonfly2_tpu.trainer.serving import serialize_params
+    from dragonfly2_tpu.models import mlp as mlp_mod
+    from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
+    import jax
+
+    db = Database(tmp_path / "m.db")
+    models = ModelRegistry(db, FSObjectStorage(tmp_path / "obj"))
+    service = ManagerService(db, models)
+    server, port = serve({SERVICE_NAME: service})
+    try:
+        params = mlp_mod.init_mlp(jax.random.PRNGKey(0), [MLP_FEATURE_DIM, 8, 1])
+        blob = serialize_params(
+            jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+        )
+        models.create("mlp-old", "mlp", blob, {"mse": 0.5}, scheduler_cluster_id=1)
+        models.create("mlp-new", "mlp", blob, {"mse": 0.4}, scheduler_cluster_id=1)
+        models.activate("mlp-old", 1)
+        models.activate("mlp-new", 1)
+
+        ch = dial(f"127.0.0.1:{port}")
+        ev = MLEvaluator()
+        r = ModelRefresher(ServiceClient(ch, SERVICE_NAME), ev, scheduler_cluster_id=1)
+        assert r.refresh_once()
+        assert r.loaded_version == ("mlp-new", 1)  # newest activation
+
+        # operator re-activates the OLDER model id: must take effect
+        models.activate("mlp-old", 1)
+        assert r.refresh_once()
+        assert r.loaded_version == ("mlp-old", 1)
+        ch.close()
+    finally:
+        server.stop(0)
+        db.close()

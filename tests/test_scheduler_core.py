@@ -400,3 +400,61 @@ class TestStorageSink:
         pairs = extract_pair_features(records_to_columns(back))
         assert pairs.features.shape[0] == 1
         assert pairs.labels[0] == pytest.approx(np.log1p(12.5), rel=1e-5)
+
+
+def test_announce_task_re_learns_host_from_carried_addressing():
+    """Regression (round-2 ADVICE d): a restarted scheduler must accept
+    an AnnounceTask that carries full host addressing (reference
+    service_v1.go:349 registers the shipped PeerHost) and only NotFound
+    when there is no addressing at all."""
+    import grpc
+    import pytest
+
+    from dragonfly2_tpu.rpc import gen  # noqa: F401
+    import common_pb2
+    import scheduler_pb2
+
+    from dragonfly2_tpu.scheduler import resource as res
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+    from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+    from dragonfly2_tpu.scheduler.service import SchedulerService
+
+    resource = res.Resource()
+    service = SchedulerService(resource, Scheduling(BaseEvaluator(), SchedulingConfig()))
+
+    class Ctx:
+        def abort(self, code, details):
+            raise _Abort(code, details)
+
+    class _Abort(Exception):
+        def __init__(self, code, details):
+            self.code = code
+            self.details = details
+
+    info = common_pb2.HostInfo(
+        id="host-x", type="normal", hostname="hx", ip="10.0.0.5",
+        port=65000, download_port=65001,
+    )
+    req = scheduler_pb2.AnnounceTaskRequest(
+        host_id="host-x",
+        task_id="t-1",
+        peer_id="p-1",
+        url="https://o/x",
+        content_length=100,
+        piece_length=100,
+        pieces=[common_pb2.PieceInfo(number=0, offset=0, length=100)],
+        host=info,
+    )
+    service.AnnounceTask(req, Ctx())
+    host = resource.host_manager.load("host-x")
+    assert host is not None and host.ip == "10.0.0.5"
+    peer = resource.peer_manager.load("p-1")
+    assert peer is not None and peer.fsm.is_state(res.PEER_STATE_SUCCEEDED)
+
+    # no known host, no addressing → NotFound
+    bare = scheduler_pb2.AnnounceTaskRequest(
+        host_id="host-unknown", task_id="t-2", peer_id="p-2", url="https://o/y",
+    )
+    with pytest.raises(_Abort) as e:
+        service.AnnounceTask(bare, Ctx())
+    assert e.value.code == grpc.StatusCode.NOT_FOUND
