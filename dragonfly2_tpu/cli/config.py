@@ -74,9 +74,30 @@ def load_config(
     for k, v in (overrides or {}).items():
         if k not in fields:
             raise ConfigError(f"unknown config key {k!r} for {cls.__name__}")
+        if v is None and not _allows_none(cls, k):
+            # an explicit null may clear Optional fields, but injecting
+            # None into an int/str/float field would surface later as an
+            # unrelated TypeError deep in the service
+            raise ConfigError(
+                f"config key {k!r} of {cls.__name__} cannot be null"
+            )
         values[k] = v
 
     return cls(**values)
+
+
+def _allows_none(cls, name: str) -> bool:
+    import typing
+
+    h = typing.get_type_hints(cls).get(name)
+    if h is None:
+        return True
+    if h is type(None):
+        return True
+    origin = typing.get_origin(h)
+    if origin is typing.Union:
+        return type(None) in typing.get_args(h)
+    return False
 
 
 def _hint(cls, name: str):

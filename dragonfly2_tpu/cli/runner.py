@@ -34,6 +34,9 @@ def run(name: str, server) -> int:
     except Exception:
         logger.exception("%s failed to start", name)
         return 1
+    maddr = getattr(server, "metrics_addr", None)
+    if maddr:
+        print(f"METRICS {name} {maddr}", flush=True)
     print(f"READY {name} {addr}", flush=True)
     try:
         stop_event.wait()

@@ -34,6 +34,7 @@ from dragonfly2_tpu.client.piece_manager import (
 )
 from dragonfly2_tpu.client.pieces import PieceRange, piece_ranges
 from dragonfly2_tpu.client.storage import StorageManager
+from dragonfly2_tpu.client import metrics as M
 from dragonfly2_tpu.utils import dflog
 
 logger = dflog.get("client.conductor")
@@ -123,6 +124,7 @@ class PeerTaskConductor:
     # lifecycle
     # ------------------------------------------------------------------
     def start(self) -> None:
+        M.TASK_TOTAL.labels("file").inc()
         self._started_at = time.monotonic()
         self._stream_thread = threading.Thread(
             target=self._stream_loop, name=f"announce-{self.peer_id[:8]}", daemon=True
@@ -267,6 +269,7 @@ class PeerTaskConductor:
 
     # ------------------------------------------------------------------
     def _back_to_source(self) -> None:
+        M.BACK_TO_SOURCE_TOTAL.inc()
         self._send(
             download_peer_back_to_source_started=scheduler_pb2.DownloadPeerBackToSourceStartedRequest(
                 description="falling back to origin"
@@ -491,6 +494,7 @@ class PeerTaskConductor:
             self.on_done(self)
 
     def _fail(self, description: str) -> None:
+        M.TASK_FAILURE_TOTAL.inc()
         self._error = description
         self._send(
             download_peer_failed=scheduler_pb2.DownloadPeerFailedRequest(

@@ -74,6 +74,8 @@ class DaemonConfig:
     host_stats_override: dict = field(default_factory=dict)
     # synthetic per-piece upload latency (A/B harness models slow hosts)
     upload_delay_s: float = 0.0
+    # Prometheus /metrics endpoint: -1 = disabled
+    metrics_port: int = -1
 
 
 def _apply_stat_overrides(stats: "hostinfo.HostStats", overrides: dict) -> None:
@@ -188,6 +190,14 @@ class Daemon:
             )
             self.object_gateway.start()
 
+        if self.cfg.metrics_port >= 0:
+            from dragonfly2_tpu.client import metrics  # noqa: F401
+            from dragonfly2_tpu.utils.metrics import MetricsServer, default_registry
+
+            self._metrics = MetricsServer(default_registry, port=self.cfg.metrics_port)
+            self.metrics_addr = self._metrics.start()
+            logger.info("daemon metrics on %s", self.metrics_addr)
+
         self._spawn(self._announce_loop, "announcer")
         if self.cfg.probe_interval > 0:
             self._spawn(self._probe_loop, "prober")
@@ -211,6 +221,8 @@ class Daemon:
             self._scheduler.LeaveHost(scheduler_pb2.LeaveHostRequest(host_id=self.host_id))
         except Exception:
             pass
+        if getattr(self, "_metrics", None) is not None:
+            self._metrics.stop()
         self.gc.stop()
         if self.proxy is not None:
             self.proxy.stop()

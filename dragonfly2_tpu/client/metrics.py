@@ -1,0 +1,25 @@
+"""Daemon Prometheus series (reference client daemon metrics: piece
+traffic by type, proxy requests, upload serving)."""
+
+from dragonfly2_tpu.utils.metrics import default_registry as _r
+
+PIECE_DOWNLOADED_TOTAL = _r.counter(
+    "daemon_piece_downloaded_total", "Pieces written locally", ("traffic_type",)
+)
+PIECE_TRAFFIC_BYTES = _r.counter(
+    "daemon_piece_traffic_bytes_total", "Bytes written locally", ("traffic_type",)
+)
+PIECE_UPLOADED_TOTAL = _r.counter(
+    "daemon_piece_uploaded_total", "Pieces served to children over HTTP"
+)
+PIECE_UPLOAD_BYTES = _r.counter(
+    "daemon_piece_upload_bytes_total", "Bytes served to children over HTTP"
+)
+TASK_TOTAL = _r.counter("daemon_task_total", "Peer tasks started", ("type",))
+TASK_FAILURE_TOTAL = _r.counter("daemon_task_failure_total", "Peer tasks failed")
+BACK_TO_SOURCE_TOTAL = _r.counter(
+    "daemon_back_to_source_total", "Tasks that fell back to the origin"
+)
+PROXY_REQUEST_TOTAL = _r.counter(
+    "daemon_proxy_request_total", "Proxy requests", ("route",)
+)

@@ -22,6 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlsplit, urlunsplit
 
 from dragonfly2_tpu.client.transport import P2PTransport, ProxyRule
+from dragonfly2_tpu.client import metrics as M
 from dragonfly2_tpu.utils import dflog
 
 logger = dflog.get("client.proxy")
@@ -141,6 +142,7 @@ class ProxyServer:
                 result, body=iter([body]), content_length=len(body)
             )
             handler.send_header("Content-Length", str(len(body)))
+        M.PROXY_REQUEST_TOTAL.labels("p2p" if result.via_p2p else "direct").inc()
         handler.send_header("X-Dragonfly-Via-P2P", "1" if result.via_p2p else "0")
         if result.task_id:
             handler.send_header("X-Dragonfly-Task-Id", result.task_id)

@@ -21,6 +21,7 @@ import threading
 import time
 from dataclasses import asdict, dataclass, field
 
+from dragonfly2_tpu.client import metrics as M
 from dragonfly2_tpu.utils import dflog
 from dragonfly2_tpu.utils.digest import md5_from_bytes
 
@@ -109,6 +110,8 @@ class TaskStorage:
                 )
         else:
             digest = f"md5:{md5_from_bytes(data)}"
+        M.PIECE_DOWNLOADED_TOTAL.labels(traffic_type or "unknown").inc()
+        M.PIECE_TRAFFIC_BYTES.labels(traffic_type or "unknown").inc(len(data))
         with self.lock:
             with open(self.data_path, "r+b") as f:
                 f.seek(offset)

@@ -16,6 +16,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from dragonfly2_tpu.client.storage import StorageManager
+from dragonfly2_tpu.client import metrics as M
 from dragonfly2_tpu.utils import dflog
 
 logger = dflog.get("client.upload")
@@ -91,6 +92,8 @@ class UploadServer:
                 req.send_error(404, str(e))
                 return
             pm = ts.meta.pieces[int(number)]
+            M.PIECE_UPLOADED_TOTAL.inc()
+            M.PIECE_UPLOAD_BYTES.inc(len(data))
             req.send_response(200)
             req.send_header("Content-Length", str(len(data)))
             req.send_header("X-Dragonfly-Piece-Digest", pm.digest)

@@ -1,0 +1,17 @@
+"""Manager Prometheus series (reference manager/metrics: request
+volumes on the control-plane surfaces)."""
+
+from dragonfly2_tpu.utils.metrics import default_registry as _r
+
+GRPC_REQUEST_TOTAL = _r.counter(
+    "manager_grpc_request_total", "gRPC requests", ("method",)
+)
+REST_REQUEST_TOTAL = _r.counter(
+    "manager_rest_request_total", "REST requests", ("method", "status")
+)
+KEEPALIVE_TOTAL = _r.counter(
+    "manager_keepalive_total", "Keepalive messages", ("source_type",)
+)
+MODEL_CREATED_TOTAL = _r.counter(
+    "manager_model_created_total", "Models uploaded by trainers", ("type",)
+)

@@ -323,6 +323,9 @@ class RestServer:
                 self._send(404, {"error": f"no route for {self.command} {parts.path}"})
 
             def _send(self, status: int, payload):
+                from dragonfly2_tpu.manager import metrics as M
+
+                M.REST_REQUEST_TOTAL.labels(self.command, str(status)).inc()
                 data = json.dumps(payload, default=str).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")

@@ -27,6 +27,8 @@ class ManagerServerConfig:
     # bearer tokens accepted by the REST API, role per token
     # ({token: "admin"|"guest"}); empty = unauthenticated (dev mode)
     rest_tokens: dict = field(default_factory=dict)
+    # Prometheus /metrics endpoint (reference :8000): -1 = disabled
+    metrics_port: int = -1
 
 
 class ManagerServer:
@@ -58,10 +60,19 @@ class ManagerServer:
             )
             self.rest_addr = self._rest.start()
             logger.info("manager REST on %s", self.rest_addr)
+        if self.cfg.metrics_port >= 0:
+            from dragonfly2_tpu.manager import metrics  # noqa: F401 — register series
+            from dragonfly2_tpu.utils.metrics import MetricsServer, default_registry
+
+            self._metrics = MetricsServer(default_registry, port=self.cfg.metrics_port)
+            self.metrics_addr = self._metrics.start()
+            logger.info("manager metrics on %s", self.metrics_addr)
         logger.info("manager gRPC on %s", addr)
         return addr
 
     def stop(self) -> None:
+        if getattr(self, "_metrics", None) is not None:
+            self._metrics.stop()
         if self._rest is not None:
             self._rest.stop()
         if self._grpc is not None:

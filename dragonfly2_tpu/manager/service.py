@@ -13,6 +13,7 @@ import manager_pb2  # noqa: E402
 
 from dragonfly2_tpu.manager.database import Database
 from dragonfly2_tpu.manager.models_registry import ModelRegistry
+from dragonfly2_tpu.manager import metrics as M
 from dragonfly2_tpu.utils import dflog
 
 logger = dflog.get("manager.rpc")
@@ -252,6 +253,7 @@ class ManagerService:
 
     # -- model registry ----------------------------------------------------
     def CreateModel(self, request, context):
+        M.MODEL_CREATED_TOTAL.labels(request.type or "unknown").inc()
         evaluation = {
             "precision": request.evaluation.precision,
             "recall": request.evaluation.recall,

@@ -21,6 +21,7 @@ import trainer_pb2  # noqa: E402
 
 from dragonfly2_tpu.rpc.glue import TRAINER_SERVICE, ServiceClient
 from dragonfly2_tpu.scheduler.storage import Storage
+from dragonfly2_tpu.scheduler import metrics as M
 from dragonfly2_tpu.utils import dflog
 
 logger = dflog.get("announcer")
@@ -90,7 +91,12 @@ class Announcer:
                         train_gnn=trainer_pb2.TrainGnnRequest(dataset=chunk),
                     )
 
-        self._trainer.Train(requests(), timeout=3600)
+        try:
+            self._trainer.Train(requests(), timeout=3600)
+        except Exception:
+            M.TRAIN_UPLOAD_TOTAL.labels("failure").inc()
+            raise
+        M.TRAIN_UPLOAD_TOTAL.labels("success").inc()
         # uploaded datasets are consumed; on failure the snapshot files
         # stay in the pending dir and ride along with the next round
         self.storage.discard_uploaded(download_files + topology_files)

@@ -1,0 +1,40 @@
+"""Scheduler Prometheus series (reference scheduler/metrics/metrics.go:
+46-454 — the operationally-load-bearing subset: announce/register/
+schedule traffic, piece/peer outcomes, record sink, probe sync)."""
+
+from dragonfly2_tpu.utils.metrics import default_registry as _r
+
+ANNOUNCE_PEER_TOTAL = _r.counter(
+    "scheduler_announce_peer_total", "AnnouncePeer stream events", ("event",)
+)
+REGISTER_PEER_TOTAL = _r.counter(
+    "scheduler_register_peer_total", "Peer registrations", ("size_scope",)
+)
+DOWNLOAD_PEER_FINISHED_TOTAL = _r.counter(
+    "scheduler_download_peer_finished_total", "Peers that finished downloading"
+)
+DOWNLOAD_PEER_FAILURE_TOTAL = _r.counter(
+    "scheduler_download_peer_failure_total", "Peers that failed downloading"
+)
+DOWNLOAD_PIECE_FINISHED_TOTAL = _r.counter(
+    "scheduler_download_piece_finished_total", "Piece results ingested", ("traffic_type",)
+)
+SCHEDULE_DURATION = _r.histogram(
+    "scheduler_schedule_duration_seconds", "Candidate-parent scheduling latency"
+)
+SCHEDULE_TOTAL = _r.counter(
+    "scheduler_schedule_total", "Scheduling decisions", ("outcome",)
+)
+DOWNLOAD_RECORD_TOTAL = _r.counter(
+    "scheduler_download_record_total", "Training Download records written"
+)
+SYNC_PROBES_TOTAL = _r.counter(
+    "scheduler_sync_probes_total", "SyncProbes stream messages", ("kind",)
+)
+HOST_TOTAL = _r.counter(
+    "scheduler_announce_host_total", "AnnounceHost calls"
+)
+LEAVE_HOST_TOTAL = _r.counter("scheduler_leave_host_total", "LeaveHost calls")
+TRAIN_UPLOAD_TOTAL = _r.counter(
+    "scheduler_train_upload_total", "Dataset uploads to the trainer", ("outcome",)
+)

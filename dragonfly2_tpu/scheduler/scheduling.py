@@ -28,6 +28,7 @@ from dragonfly2_tpu.scheduler.resource import (
     HostType,
     Peer,
 )
+from dragonfly2_tpu.scheduler import metrics as M
 from dragonfly2_tpu.utils import dflog
 
 logger = dflog.get("scheduling")
@@ -104,6 +105,7 @@ class Scheduling:
         limit is exhausted and back-to-source isn't possible."""
         blocklist = blocklist or set()
         n = 0
+        _t0 = time.perf_counter()
         while True:
             if cancelled is not None and cancelled():
                 return
@@ -166,6 +168,7 @@ class Scheduling:
                 time.sleep(self.config.retry_interval)
                 continue
 
+            M.SCHEDULE_DURATION.observe(time.perf_counter() - _t0)
             self._send(peer, NormalTaskResponse(candidate_parents))
 
             for parent in candidate_parents:
@@ -242,6 +245,9 @@ class Scheduling:
 
     @staticmethod
     def _send(peer: Peer, response) -> None:
+        M.SCHEDULE_TOTAL.labels(
+            "parents" if isinstance(response, NormalTaskResponse) else "back_to_source"
+        ).inc()
         stream = peer.load_stream()
         if stream is None:
             raise SchedulingError(f"peer {peer.id}: load stream failed")

@@ -55,6 +55,8 @@ class SchedulerServerConfig:
     candidate_parent_limit: int = 4
     # probe-graph CSV snapshot cadence (reference CollectInterval, 2h)
     topology_snapshot_interval: float = 2 * 3600.0
+    # Prometheus /metrics endpoint (reference :8000): -1 = disabled
+    metrics_port: int = -1
 
 
 class SchedulerServer:
@@ -175,6 +177,13 @@ class SchedulerServer:
         if self.job_worker is not None:
             self.job_worker.start()
         self.gc.start()
+        if cfg.metrics_port >= 0:
+            from dragonfly2_tpu.scheduler import metrics  # noqa: F401
+            from dragonfly2_tpu.utils.metrics import MetricsServer, default_registry
+
+            self._metrics = MetricsServer(default_registry, port=cfg.metrics_port)
+            self.metrics_addr = self._metrics.start()
+            logger.info("scheduler metrics on %s", self.metrics_addr)
         logger.info("scheduler gRPC on %s", addr)
         return addr
 
@@ -200,6 +209,8 @@ class SchedulerServer:
     def stop(self) -> None:
         # reference Stop order scheduler.go:368: dynconfig → resource →
         # storage → gc → announcer → clients → graceful grpc stop
+        if getattr(self, "_metrics", None) is not None:
+            self._metrics.stop()
         if self.job_worker is not None:
             self.job_worker.stop()
         if self.model_refresher is not None:

@@ -31,6 +31,7 @@ from dragonfly2_tpu.scheduler.scheduling import (
     SchedulingError,
 )
 from dragonfly2_tpu.scheduler.storage import Storage, build_download_record
+from dragonfly2_tpu.scheduler import metrics as M
 from dragonfly2_tpu.utils import dflog
 from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
 
@@ -182,6 +183,7 @@ class SchedulerService:
 
     def _handle_announce(self, req, adapter: _StreamAdapter, state: dict) -> None:
         which = req.WhichOneof("request")
+        M.ANNOUNCE_PEER_TOTAL.labels(which or "unknown").inc()
         if which == "register_peer":
             state["peer"] = self._register_peer(req, adapter)
             return
@@ -207,6 +209,9 @@ class SchedulerService:
                 peer.block_parents.add(pid)
             self._schedule(peer, adapter)
         elif which == "download_piece_finished":
+            M.DOWNLOAD_PIECE_FINISHED_TOTAL.labels(
+                req.download_piece_finished.piece.traffic_type or "unknown"
+            ).inc()
             self._piece_finished(peer, req.download_piece_finished.piece)
         elif which == "download_piece_failed":
             parent_id = req.download_piece_failed.parent_id
@@ -216,6 +221,7 @@ class SchedulerService:
                 if parent is not None:
                     parent.host.record_upload(success=False)
         elif which == "download_peer_finished":
+            M.DOWNLOAD_PEER_FINISHED_TOTAL.inc()
             fin = req.download_peer_finished
             peer.cost_ns = fin.cost_ns
             if peer.fsm.can(res.PEER_EVENT_DOWNLOAD_SUCCEEDED):
@@ -228,6 +234,7 @@ class SchedulerService:
                 peer.task.fsm.event(res.TASK_EVENT_DOWNLOAD_SUCCEEDED)
             self._write_download_record(peer)
         elif which == "download_peer_failed":
+            M.DOWNLOAD_PEER_FAILURE_TOTAL.inc()
             if peer.fsm.can(res.PEER_EVENT_DOWNLOAD_FAILED):
                 peer.fsm.event(res.PEER_EVENT_DOWNLOAD_FAILED)
             if peer.task.fsm.can(res.TASK_EVENT_DOWNLOAD_FAILED):
@@ -284,6 +291,7 @@ class SchedulerService:
         # size-scope dispatch (reference service_v2.go:820-920 /
         # service_v1.go:1005-1110)
         scope = task.size_scope()
+        M.REGISTER_PEER_TOTAL.labels(scope).inc()
         if scope is res.SizeScope.EMPTY:
             peer.fsm.event(res.PEER_EVENT_REGISTER_EMPTY)
             adapter.send(
@@ -339,6 +347,7 @@ class SchedulerService:
         if self.storage is None:
             return
         try:
+            M.DOWNLOAD_RECORD_TOTAL.inc()
             self.storage.create_download(
                 build_download_record(peer, error_code, error_message)
             )
@@ -382,6 +391,7 @@ class SchedulerService:
         )
 
     def AnnounceHost(self, request, context):
+        M.HOST_TOTAL.inc()
         host = _host_from_info(request.host)
         existing = self.resource.host_manager.load(host.id)
         if existing is None:
@@ -464,6 +474,7 @@ class SchedulerService:
         return scheduler_pb2.Empty()
 
     def LeaveHost(self, request, context):
+        M.LEAVE_HOST_TOTAL.inc()
         host = self.resource.host_manager.load(request.host_id)
         if host is not None:
             host.leave_peers()
@@ -479,6 +490,7 @@ class SchedulerService:
         for req in request_iterator:
             which = req.WhichOneof("request")
             src_id = req.host.id
+            M.SYNC_PROBES_TOTAL.labels(which or "unknown").inc()
             if which == "probe_started":
                 if self.networktopology is None:
                     return

@@ -30,6 +30,7 @@ import numpy as np
 
 from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
 from dragonfly2_tpu.schema import native
+from dragonfly2_tpu.trainer import metrics as M
 from dragonfly2_tpu.utils import dflog
 
 logger = dflog.get("trainer.ingest")
@@ -148,6 +149,8 @@ def stream_shards(
                 done += 1
                 continue
             feats, labels, delta_rows = item
+            if delta_rows:
+                M.INGEST_RECORDS_TOTAL.inc(delta_rows)
             total_rows += delta_rows
             yield feats, labels, total_rows
             if max_records is not None and total_rows >= max_records:

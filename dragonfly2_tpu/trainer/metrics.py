@@ -1,0 +1,20 @@
+"""Trainer Prometheus series (reference trainer/metrics/metrics.go:38-52
+plus fit-duration/ingest visibility the TPU trainer adds)."""
+
+from dragonfly2_tpu.utils.metrics import default_registry as _r
+
+TRAIN_TOTAL = _r.counter("trainer_train_total", "Train RPC streams accepted")
+TRAIN_FAILURE_TOTAL = _r.counter(
+    "trainer_train_failure_total", "Train RPC streams that failed"
+)
+FIT_TOTAL = _r.counter("trainer_fit_total", "Model fits", ("model", "outcome"))
+FIT_DURATION = _r.histogram(
+    "trainer_fit_duration_seconds", "Fit wall time", ("model",),
+    buckets=(0.1, 0.5, 1, 5, 15, 60, 300, 1200, 3600, float("inf")),
+)
+INGEST_RECORDS_TOTAL = _r.counter(
+    "trainer_ingest_records_total", "Download records decoded for training"
+)
+DATASET_BYTES_TOTAL = _r.counter(
+    "trainer_dataset_bytes_total", "Dataset bytes received on Train streams", ("kind",)
+)

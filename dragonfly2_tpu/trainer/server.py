@@ -32,6 +32,8 @@ class TrainerServerConfig:
     streaming_workers: int = 1
     # run fits inline with the Train RPC (tests/debug) instead of async
     synchronous: bool = False
+    # Prometheus /metrics endpoint (reference trainer :8000): -1 = disabled
+    metrics_port: int = -1
 
 
 class TrainerServer:
@@ -72,10 +74,19 @@ class TrainerServer:
     def serve(self) -> str:
         self._grpc, port = glue.serve({SERVICE_NAME: self.service}, self.cfg.listen)
         addr = f"{self.cfg.listen.rsplit(':', 1)[0]}:{port}"
+        if self.cfg.metrics_port >= 0:
+            from dragonfly2_tpu.trainer import metrics  # noqa: F401
+            from dragonfly2_tpu.utils.metrics import MetricsServer, default_registry
+
+            self._metrics = MetricsServer(default_registry, port=self.cfg.metrics_port)
+            self.metrics_addr = self._metrics.start()
+            logger.info("trainer metrics on %s", self.metrics_addr)
         logger.info("trainer gRPC on %s", addr)
         return addr
 
     def stop(self) -> None:
+        if getattr(self, "_metrics", None) is not None:
+            self._metrics.stop()
         if self._grpc is not None:
             self._grpc.stop(grace=2).wait(5)
         if self._manager_channel is not None:

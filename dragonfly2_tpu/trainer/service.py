@@ -17,6 +17,7 @@ import trainer_pb2  # noqa: E402
 
 from dragonfly2_tpu.trainer.storage import TrainerStorage
 from dragonfly2_tpu.trainer.training import Training
+from dragonfly2_tpu.trainer import metrics as M
 from dragonfly2_tpu.utils import dflog
 from dragonfly2_tpu.utils.idgen import host_id_v2
 
@@ -32,12 +33,13 @@ class TrainerService:
         # synchronous=True runs the fit inline (tests); production forks
         self.synchronous = synchronous
         self.train_total = 0
-        self.train_failure_total = 0
+        self.train_failure_total = 0  # mirrored into Prometheus (metrics.py)
 
     def Train(self, request_iterator, context):
         ip = hostname = None
         host_id = None
         self.train_total += 1
+        M.TRAIN_TOTAL.inc()
         try:
             for req in request_iterator:
                 if host_id is None:
@@ -45,11 +47,14 @@ class TrainerService:
                     host_id = host_id_v2(ip, hostname)
                 which = req.WhichOneof("request")
                 if which == "train_mlp":
+                    M.DATASET_BYTES_TOTAL.labels("download").inc(len(req.train_mlp.dataset))
                     self.storage.append_download(host_id, req.train_mlp.dataset)
                 elif which == "train_gnn":
+                    M.DATASET_BYTES_TOTAL.labels("topology").inc(len(req.train_gnn.dataset))
                     self.storage.append_network_topology(host_id, req.train_gnn.dataset)
         except Exception:
             self.train_failure_total += 1
+            M.TRAIN_FAILURE_TOTAL.inc()
             raise
 
         if host_id is not None:

@@ -26,6 +26,7 @@ from dragonfly2_tpu.schema.columnar import records_to_columns
 from dragonfly2_tpu.schema.features import build_probe_graph, extract_pair_features
 from dragonfly2_tpu.trainer.storage import TrainerStorage
 from dragonfly2_tpu.trainer.train import FitConfig, GNNFitConfig, train_gnn, train_mlp
+from dragonfly2_tpu.trainer import metrics as M
 from dragonfly2_tpu.utils import dflog
 from dragonfly2_tpu.utils.idgen import gnn_model_id_v1, host_id_v2, mlp_model_id_v1
 
@@ -101,8 +102,8 @@ class Training:
         host_id = host_id_v2(ip, hostname)
         outcome = TrainingOutcome()
         with concurrent.futures.ThreadPoolExecutor(max_workers=2) as pool:
-            f_mlp = pool.submit(self._train_mlp, host_id, ip, hostname)
-            f_gnn = pool.submit(self._train_gnn, host_id, ip, hostname)
+            f_mlp = pool.submit(self._timed_fit, "mlp", self._train_mlp, host_id, ip, hostname)
+            f_gnn = pool.submit(self._timed_fit, "gnn", self._train_gnn, host_id, ip, hostname)
             try:
                 outcome.mlp_metrics = f_mlp.result()
             except Exception as e:
@@ -122,6 +123,16 @@ class Training:
             if outcome.gnn_error is None:
                 self.storage.clear_network_topology(host_id)
         return outcome
+
+    def _timed_fit(self, model: str, fn, *args):
+        with M.FIT_DURATION.labels(model).time():
+            try:
+                result = fn(*args)
+            except Exception:
+                M.FIT_TOTAL.labels(model, "failure").inc()
+                raise
+        M.FIT_TOTAL.labels(model, "success").inc()
+        return result
 
     # -- trainMLP (reference training.go:92-98) ---------------------------
     def _train_mlp(self, host_id: str, ip: str, hostname: str) -> dict[str, float]:

@@ -15,10 +15,12 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import shutil
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import urllib.request
 
@@ -37,20 +39,31 @@ class Proc:
             cwd=REPO,
         )
         self.addr: str | None = None
+        self.metrics_addr: str | None = None
+        # a dedicated reader thread avoids mixing select() on the raw fd
+        # with buffered readline() (lines stranded in the TextIOWrapper
+        # buffer would make select starve)
+        self._lines: "queue.Queue[str | None]" = queue.Queue()
+        threading.Thread(target=self._pump, daemon=True).start()
+
+    def _pump(self) -> None:
+        for line in self.proc.stdout:
+            self._lines.put(line)
+        self._lines.put(None)
 
     def wait_ready(self, timeout: float = 120.0) -> str:
-        import select
-
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if self.proc.poll() is not None:
+            if self.proc.poll() is not None and self._lines.empty():
                 raise RuntimeError(f"{self.name} exited rc={self.proc.returncode}")
-            # select keeps the deadline honest even when the child is
-            # alive but silent (readline alone would block forever)
-            ready, _, _ = select.select([self.proc.stdout], [], [], 1.0)
-            if not ready:
+            try:
+                line = self._lines.get(timeout=1.0)
+            except queue.Empty:
                 continue
-            line = self.proc.stdout.readline()
+            if line is None:
+                continue
+            if line.startswith("METRICS "):
+                self.metrics_addr = line.split()[2]
             if line.startswith("READY "):
                 self.addr = line.split()[2]
                 return self.addr
@@ -122,6 +135,8 @@ def main() -> int:
                 "storage_buffer_size=1",
                 "--set",
                 "hostname=sched-e2e",
+                "--set",
+                "metrics_port=0",
             ],
             env,
         )
@@ -215,6 +230,17 @@ def main() -> int:
             time.sleep(0.2)
         assert have_records, f"no download records under {records_dir}"
         print("PASS download records written")
+
+        # scheduler /metrics scrape shows the download actually moved
+        # the instrumented series
+        assert scheduler.metrics_addr, "scheduler did not report a metrics address"
+        with urllib.request.urlopen(
+            f"http://{scheduler.metrics_addr}/metrics", timeout=5
+        ) as resp:
+            series = resp.read().decode()
+        assert "dragonfly_scheduler_announce_peer_total" in series
+        assert 'dragonfly_scheduler_register_peer_total' in series
+        print("PASS scheduler metrics scrape")
 
         # manager sees the registered scheduler (gRPC registry; the REST
         # surface is covered by tests/test_manager_rest.py)

@@ -249,3 +249,17 @@ def test_example_configs_parse():
     load_config(ManagerServerConfig, os.path.join(root, "manager.yaml"))
     load_config(TrainerServerConfig, os.path.join(root, "trainer.yaml"))
     load_config(DaemonConfig, os.path.join(root, "daemon.yaml"))
+
+
+def test_cli_config_null_override_rules():
+    """Explicit null clears Optional fields but is rejected for typed
+    non-optional fields (would crash later otherwise)."""
+    import pytest
+
+    from dragonfly2_tpu.cli.config import ConfigError, load_config
+    from dragonfly2_tpu.scheduler.server import SchedulerServerConfig
+
+    with pytest.raises(ConfigError, match="cannot be null"):
+        load_config(SchedulerServerConfig, overrides={"retry_limit": None})
+    with pytest.raises(ConfigError, match="cannot be null"):
+        load_config(SchedulerServerConfig, overrides={"manager_address": None})
