@@ -87,6 +87,7 @@ def load_config(
 
 
 def _allows_none(cls, name: str) -> bool:
+    import types
     import typing
 
     h = typing.get_type_hints(cls).get(name)
@@ -95,7 +96,8 @@ def _allows_none(cls, name: str) -> bool:
     if h is type(None):
         return True
     origin = typing.get_origin(h)
-    if origin is typing.Union:
+    # typing.Optional[X] and PEP 604 `X | None` both count
+    if origin is typing.Union or origin is types.UnionType:
         return type(None) in typing.get_args(h)
     return False
 

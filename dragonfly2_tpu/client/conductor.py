@@ -25,6 +25,7 @@ import common_pb2  # noqa: E402
 import scheduler_pb2  # noqa: E402
 
 from dragonfly2_tpu.client.downloader import PieceDownloadError
+from dragonfly2_tpu.client.synchronizer import PieceTaskSynchronizer
 from dragonfly2_tpu.client.piece_manager import (
     ParentInfo,
     PieceDispatcher,
@@ -325,6 +326,24 @@ class PeerTaskConductor:
             self._reschedule([], "all candidate parents blocked")
             return False
 
+        # live piece-metadata sync with each parent daemon (reference
+        # peertask_piecetask_synchronizer.go): keeps finished_pieces
+        # fresh while in-progress parents keep downloading, so the
+        # dispatcher stops guessing
+        daemon_addrs = {
+            c.peer_id: f"{c.host.ip}:{c.host.port}"
+            for c in candidates
+            if c.host.port
+        }
+        total_pieces = len(piece_ranges(content_length, piece_length))
+        synchronizer = PieceTaskSynchronizer(self.task_id, self.peer_id)
+        for p in parents:
+            if len(p.finished_pieces) >= total_pieces:
+                continue  # completed parent: the snapshot is already final
+            addr = daemon_addrs.get(p.peer_id)
+            if addr:
+                synchronizer.watch(p, addr)
+
         self._send(download_peer_started=scheduler_pb2.DownloadPeerStartedRequest())
         dispatcher = PieceDispatcher()
         todo = [
@@ -398,8 +417,11 @@ class PeerTaskConductor:
             with lock:
                 failed.append(pr)
 
-        with ThreadPoolExecutor(max_workers=self.opts.piece_workers) as pool:
-            list(pool.map(work, todo))
+        try:
+            with ThreadPoolExecutor(max_workers=self.opts.piece_workers) as pool:
+                list(pool.map(work, todo))
+        finally:
+            synchronizer.stop()
 
         if not failed:
             self.ts.mark_done(content_length)
@@ -475,6 +497,7 @@ class PeerTaskConductor:
         self._publish()
 
     def _finish(self, piece_count: int, content_length: int | None = None) -> None:
+        self._release_shaper()
         cost_ns = int((time.monotonic() - self._started_at) * 1e9)
         self._send(
             download_peer_finished=scheduler_pb2.DownloadPeerFinishedRequest(
@@ -493,7 +516,13 @@ class PeerTaskConductor:
         if self.on_done:
             self.on_done(self)
 
+    def _release_shaper(self) -> None:
+        shaper = getattr(self.pm, "shaper", None)
+        if shaper is not None:
+            shaper.release(self.task_id)
+
     def _fail(self, description: str) -> None:
+        self._release_shaper()
         M.TASK_FAILURE_TOTAL.inc()
         self._error = description
         self._send(

@@ -76,6 +76,10 @@ class DaemonConfig:
     upload_delay_s: float = 0.0
     # Prometheus /metrics endpoint: -1 = disabled
     metrics_port: int = -1
+    metrics_host: str = "127.0.0.1"
+    # global download budget in bytes/s shared across tasks (cross-task
+    # sampling traffic shaper, reference traffic_shaper.go); 0 = off
+    total_download_rate: float = 0.0
 
 
 def _apply_stat_overrides(stats: "hostinfo.HostStats", overrides: dict) -> None:
@@ -128,11 +132,17 @@ class Daemon:
         self._channel = glue.dial(self.cfg.scheduler_address)
         self._scheduler = glue.ServiceClient(self._channel, SCHEDULER_SERVICE)
 
+        from dragonfly2_tpu.client.piece_manager import TrafficShaper
+
+        self.shaper = TrafficShaper(self.cfg.total_download_rate)
+        self.shaper.start()
         self.task_manager = TaskManager(
             host_id=self.host_id,
             storage=self.storage,
             scheduler_client=self._scheduler,
-            piece_manager=PieceManager(concurrent_pieces=self.cfg.piece_workers),
+            piece_manager=PieceManager(
+                concurrent_pieces=self.cfg.piece_workers, shaper=self.shaper
+            ),
             options=ConductorOptions(
                 piece_workers=self.cfg.piece_workers,
                 schedule_timeout=self.cfg.schedule_timeout,
@@ -194,7 +204,7 @@ class Daemon:
             from dragonfly2_tpu.client import metrics  # noqa: F401
             from dragonfly2_tpu.utils.metrics import MetricsServer, default_registry
 
-            self._metrics = MetricsServer(default_registry, port=self.cfg.metrics_port)
+            self._metrics = MetricsServer(default_registry, host=self.cfg.metrics_host, port=self.cfg.metrics_port)
             self.metrics_addr = self._metrics.start()
             logger.info("daemon metrics on %s", self.metrics_addr)
 
@@ -223,6 +233,8 @@ class Daemon:
             pass
         if getattr(self, "_metrics", None) is not None:
             self._metrics.stop()
+        if getattr(self, "shaper", None) is not None:
+            self.shaper.stop()
         self.gc.stop()
         if self.proxy is not None:
             self.proxy.stop()

@@ -82,10 +82,12 @@ class PieceManager:
         concurrent_pieces: int = 4,
         source_concurrency: int = 4,
         source_concurrency_threshold: int = 32 * 1024 * 1024,
+        shaper: "TrafficShaper | None" = None,
     ):
         self.concurrent_pieces = concurrent_pieces
         self.source_concurrency = source_concurrency
         self.source_concurrency_threshold = source_concurrency_threshold
+        self.shaper = shaper
 
     # ------------------------------------------------------------------
     def download_piece_from_parent(
@@ -95,6 +97,10 @@ class PieceManager:
         pr: PieceRange,
         peer_id: str,
     ) -> "PieceResult":
+        if self.shaper is not None and self.shaper.enabled:
+            # debit before the fetch: the shaper paces admission, and the
+            # piece length is known from the task grid
+            self.shaper.limiter_for(ts.meta.task_id).acquire(pr.length)
         t0 = time.monotonic()
         data, digest, content_type = downloader.download_piece(
             parent.upload_addr, ts.meta.task_id, pr.number, peer_id=peer_id
@@ -150,6 +156,8 @@ class PieceManager:
             ranges = piece_ranges(content_length, ts.meta.piece_length)
 
             def fetch(pr: PieceRange):
+                if self.shaper is not None and self.shaper.enabled:
+                    self.shaper.limiter_for(ts.meta.task_id).acquire(pr.length)
                 t0 = time.monotonic()
                 data = b"".join(client.download(url, headers, pr.offset, pr.length))
                 dt = time.monotonic() - t0
@@ -208,17 +216,19 @@ class PieceResult:
 
 
 class RateLimiter:
-    """Token-bucket byte-rate limiter shared across tasks (role parity:
-    reference client/daemon/peer/traffic_shaper.go:36-175 sampling
-    shaper — one global budget re-allocated across active tasks)."""
+    """Token-bucket byte-rate limiter (one per task under the
+    TrafficShaper's global budget)."""
 
     def __init__(self, rate_bytes_per_s: float):
         self.rate = rate_bytes_per_s
         self.tokens = rate_bytes_per_s
         self.last = time.monotonic()
         self.lock = threading.Lock()
+        self.consumed = 0  # bytes since the shaper's last sample
 
     def acquire(self, n: int) -> None:
+        with self.lock:
+            self.consumed += n
         if self.rate <= 0:
             return
         while True:
@@ -226,8 +236,126 @@ class RateLimiter:
                 now = time.monotonic()
                 self.tokens = min(self.rate, self.tokens + (now - self.last) * self.rate)
                 self.last = now
-                if self.tokens >= n:
+                # debt-based: a request larger than one second's budget
+                # (bucket capacity) admits once the bucket is full and
+                # drives the balance negative — otherwise a piece bigger
+                # than the task's share would spin forever
+                need = min(float(n), self.rate)
+                if self.tokens >= need:
                     self.tokens -= n
                     return
-                wait = (n - self.tokens) / self.rate
+                wait = (need - self.tokens) / self.rate
             time.sleep(min(wait, 0.5))
+
+    def set_rate(self, rate: float) -> None:
+        with self.lock:
+            self.rate = rate
+
+    def take_usage(self) -> int:
+        with self.lock:
+            used, self.consumed = self.consumed, 0
+            return used
+
+
+class TrafficShaper:
+    """Cross-task sampling traffic shaper (reference
+    client/daemon/peer/traffic_shaper.go:126-175): one global download
+    budget, re-allocated across active tasks every sampling interval.
+
+    Allocation rule per sample: every task keeps a fair share
+    (total/N); tasks that used less than their share in the last window
+    donate the surplus, which is split among tasks that saturated theirs
+    proportionally to observed demand — a lone hot task gets the whole
+    budget, competing hot tasks converge to equal shares.
+    """
+
+    def __init__(self, total_rate: float, interval: float = 1.0):
+        self.total_rate = total_rate
+        self.interval = interval
+        self._tasks: dict[str, RateLimiter] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.total_rate > 0
+
+    def start(self) -> None:
+        if not self.enabled or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="traffic-shaper", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def limiter_for(self, task_id: str) -> RateLimiter:
+        with self._lock:
+            lim = self._tasks.get(task_id)
+            if lim is None:
+                # a joining task starts at the fair share; the next sample
+                # rebalances everyone
+                share = (
+                    self.total_rate / (len(self._tasks) + 1)
+                    if self.enabled
+                    else 0.0
+                )
+                lim = self._tasks[task_id] = RateLimiter(share)
+                if self.enabled:
+                    for other in self._tasks.values():
+                        other.set_rate(share)
+            return lim
+
+    def release(self, task_id: str) -> None:
+        with self._lock:
+            self._tasks.pop(task_id, None)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.sample_once()
+
+    def sample_once(self) -> None:
+        with self._lock:
+            tasks = list(self._tasks.values())
+        if not tasks or not self.enabled:
+            return
+        share = self.total_rate / len(tasks)
+        floor = 0.05 * self.total_rate  # a donor can always restart
+        usages = [lim.take_usage() for lim in tasks]
+        # saturated = used ≥ ~90% of its current per-window allowance
+        saturated = [
+            u >= 0.9 * lim.rate * self.interval for lim, u in zip(tasks, usages)
+        ]
+        if not any(saturated):
+            # nobody is starved: plain fair shares (and a lone task keeps
+            # the whole budget for instant ramp-up)
+            for lim in tasks:
+                lim.set_rate(share)
+            return
+        # donors are clamped near their observed demand (+20% headroom)
+        # so allocated rates SUM to ≤ total_rate — handing a donor's
+        # surplus away while it keeps its full share would over-admit;
+        # a donor that turns hot saturates its clamp within one window
+        # and gets promoted at the next sample
+        donor_rates = {
+            id(lim): min(share, max(u / self.interval * 1.2, floor))
+            for lim, u, sat in zip(tasks, usages, saturated)
+            if not sat
+        }
+        surplus = sum(share - r for r in donor_rates.values())
+        demand = sum(u for u, sat in zip(usages, saturated) if sat)
+        for lim, u, sat in zip(tasks, usages, saturated):
+            if sat and demand > 0:
+                rate = share + surplus * (u / demand)
+            elif sat:
+                rate = share + surplus / max(1, sum(saturated))
+            else:
+                rate = donor_rates[id(lim)]
+            lim.set_rate(rate)
+

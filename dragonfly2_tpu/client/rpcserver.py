@@ -98,10 +98,12 @@ class DfdaemonService:
                 task_id=request.task_id, dst_addr=self.upload_addr
             )
         start = request.start_num or 0
-        limit = request.limit or 64
+        # limit=0 = whole inventory (the synchronizer streams the full
+        # piece set; GetPieceTasks geometry probes pass limit=1)
+        limit = request.limit if request.limit else None
         infos = []
         for n in sorted(ts.meta.pieces):
-            if n < start or len(infos) >= limit:
+            if n < start or (limit is not None and len(infos) >= limit):
                 continue
             pm = ts.meta.pieces[n]
             infos.append(

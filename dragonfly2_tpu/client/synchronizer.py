@@ -1,0 +1,118 @@
+"""Daemon↔daemon piece-metadata synchronizer: live SyncPieceTasks bidi
+streams from a downloading child to each candidate parent (reference
+client/daemon/peer/peertask_piecetask_synchronizer.go, 494 LoC).
+
+The scheduler's candidate list carries a STATIC finished_pieces snapshot;
+an in-progress parent keeps finishing pieces after that snapshot. The
+synchronizer keeps each ParentInfo.finished_pieces fresh over the
+parent's dfdaemon gRPC port, so the dispatcher prefers parents that
+actually hold a piece instead of probing optimistically and eating 404s.
+
+One thread + one bidi stream per parent; failures degrade silently to
+the snapshot (the conductor's optimistic-probe fallback still works).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import dfdaemon_pb2  # noqa: E402
+
+from dragonfly2_tpu.rpc import glue
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("client.sync")
+
+
+class PieceTaskSynchronizer:
+    def __init__(
+        self,
+        task_id: str,
+        peer_id: str,
+        interval: float = 0.2,
+    ):
+        self.task_id = task_id
+        self.peer_id = peer_id
+        self.interval = interval
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        # geometry learned from the first packet that knows it
+        self.content_length = -1
+        self.total_piece_count = -1
+        self._geometry_known = threading.Event()
+
+    # ------------------------------------------------------------------
+    def watch(self, parent, daemon_addr: str) -> None:
+        """Open a sync stream to ``daemon_addr`` feeding
+        ``parent.finished_pieces`` until stop()."""
+        if not daemon_addr or daemon_addr.endswith(":0"):
+            return
+        t = threading.Thread(
+            target=self._run,
+            args=(parent, daemon_addr),
+            name=f"piece-sync-{parent.peer_id[:8]}",
+            daemon=True,
+        )
+        t.start()
+        self._threads.append(t)
+
+    def wait_geometry(self, timeout: float) -> tuple[int, int]:
+        """Block up to ``timeout`` for a packet that carried the task
+        geometry; returns (content_length, total_piece_count) — (-1, -1)
+        when nothing arrived."""
+        self._geometry_known.wait(timeout)
+        return self.content_length, self.total_piece_count
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    # ------------------------------------------------------------------
+    def _run(self, parent, daemon_addr: str) -> None:
+        try:
+            channel = glue.dial(daemon_addr, retries=1)
+        except Exception as e:
+            logger.debug("sync dial %s failed: %s", daemon_addr, e)
+            return
+        try:
+            client = glue.ServiceClient(channel, glue.DFDAEMON_SERVICE)
+            first = [True]
+
+            def requests():
+                # paced request loop: each request asks for the parent's
+                # current inventory; stop() ends the stream client-side
+                while not self._stop.wait(0 if first[0] else self.interval):
+                    first[0] = False
+                    yield dfdaemon_pb2.PieceTaskRequest(
+                        task_id=self.task_id,
+                        src_peer_id=parent.peer_id,
+                        dst_peer_id=self.peer_id,
+                        limit=0,
+                    )
+
+            for packet in client.SyncPieceTasks(requests()):
+                if self._stop.is_set():
+                    break
+                if packet.piece_infos:
+                    # set assignment is atomic enough for the dispatcher's
+                    # membership reads (CPython set under the GIL)
+                    parent.finished_pieces |= {
+                        p.number for p in packet.piece_infos
+                    }
+                # proto3 reads unset int fields as 0: a parent that GC'd
+                # the task answers an empty packet — only a packet that
+                # actually carries inventory/geometry may latch
+                if self.content_length < 0 and (
+                    packet.piece_infos or packet.total_piece_count > 0
+                ):
+                    self.content_length = packet.content_length
+                    self.total_piece_count = packet.total_piece_count
+                    self._geometry_known.set()
+        except Exception as e:
+            if not self._stop.is_set():
+                logger.debug("piece sync with %s ended: %s", parent.peer_id, e)
+        finally:
+            channel.close()

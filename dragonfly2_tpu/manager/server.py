@@ -29,6 +29,7 @@ class ManagerServerConfig:
     rest_tokens: dict = field(default_factory=dict)
     # Prometheus /metrics endpoint (reference :8000): -1 = disabled
     metrics_port: int = -1
+    metrics_host: str = "127.0.0.1"
 
 
 class ManagerServer:
@@ -64,7 +65,7 @@ class ManagerServer:
             from dragonfly2_tpu.manager import metrics  # noqa: F401 — register series
             from dragonfly2_tpu.utils.metrics import MetricsServer, default_registry
 
-            self._metrics = MetricsServer(default_registry, port=self.cfg.metrics_port)
+            self._metrics = MetricsServer(default_registry, host=self.cfg.metrics_host, port=self.cfg.metrics_port)
             self.metrics_addr = self._metrics.start()
             logger.info("manager metrics on %s", self.metrics_addr)
         logger.info("manager gRPC on %s", addr)

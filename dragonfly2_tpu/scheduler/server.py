@@ -57,6 +57,7 @@ class SchedulerServerConfig:
     topology_snapshot_interval: float = 2 * 3600.0
     # Prometheus /metrics endpoint (reference :8000): -1 = disabled
     metrics_port: int = -1
+    metrics_host: str = "127.0.0.1"
 
 
 class SchedulerServer:
@@ -181,7 +182,7 @@ class SchedulerServer:
             from dragonfly2_tpu.scheduler import metrics  # noqa: F401
             from dragonfly2_tpu.utils.metrics import MetricsServer, default_registry
 
-            self._metrics = MetricsServer(default_registry, port=cfg.metrics_port)
+            self._metrics = MetricsServer(default_registry, host=cfg.metrics_host, port=cfg.metrics_port)
             self.metrics_addr = self._metrics.start()
             logger.info("scheduler metrics on %s", self.metrics_addr)
         logger.info("scheduler gRPC on %s", addr)

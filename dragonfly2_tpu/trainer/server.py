@@ -34,6 +34,7 @@ class TrainerServerConfig:
     synchronous: bool = False
     # Prometheus /metrics endpoint (reference trainer :8000): -1 = disabled
     metrics_port: int = -1
+    metrics_host: str = "127.0.0.1"
 
 
 class TrainerServer:
@@ -78,7 +79,7 @@ class TrainerServer:
             from dragonfly2_tpu.trainer import metrics  # noqa: F401
             from dragonfly2_tpu.utils.metrics import MetricsServer, default_registry
 
-            self._metrics = MetricsServer(default_registry, port=self.cfg.metrics_port)
+            self._metrics = MetricsServer(default_registry, host=self.cfg.metrics_host, port=self.cfg.metrics_port)
             self.metrics_addr = self._metrics.start()
             logger.info("trainer metrics on %s", self.metrics_addr)
         logger.info("trainer gRPC on %s", addr)
