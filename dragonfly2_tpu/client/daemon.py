@@ -129,8 +129,10 @@ class Daemon:
     # ------------------------------------------------------------------
     def start(self) -> None:
         self.upload.start()
-        self._channel = glue.dial(self.cfg.scheduler_address)
-        self._scheduler = glue.ServiceClient(self._channel, SCHEDULER_SERVICE)
+        addresses = [a for a in self.cfg.scheduler_address.split(",") if a.strip()]
+        self._selector = glue.SchedulerSelector(addresses)
+        self._channel = None  # owned by the selector now
+        self._scheduler = self._selector.primary()
 
         from dragonfly2_tpu.client.piece_manager import TrafficShaper
 
@@ -139,7 +141,7 @@ class Daemon:
         self.task_manager = TaskManager(
             host_id=self.host_id,
             storage=self.storage,
-            scheduler_client=self._scheduler,
+            scheduler_client=self._selector,
             piece_manager=PieceManager(
                 concurrent_pieces=self.cfg.piece_workers, shaper=self.shaper
             ),
@@ -227,10 +229,11 @@ class Daemon:
 
     def stop(self) -> None:
         self._stop.set()
-        try:
-            self._scheduler.LeaveHost(scheduler_pb2.LeaveHostRequest(host_id=self.host_id))
-        except Exception:
-            pass
+        for client in self._selector.all():
+            try:
+                client.LeaveHost(scheduler_pb2.LeaveHostRequest(host_id=self.host_id))
+            except Exception:
+                pass  # best-effort; TTL GC reaps the host eventually
         if getattr(self, "_metrics", None) is not None:
             self._metrics.stop()
         if getattr(self, "shaper", None) is not None:
@@ -243,6 +246,8 @@ class Daemon:
         if self._server is not None:
             self._server.stop(grace=1).wait()
         self.upload.stop()
+        if getattr(self, "_selector", None) is not None:
+            self._selector.close()
         if self._channel is not None:
             self._channel.close()
 
@@ -332,9 +337,18 @@ class Daemon:
         )
 
     def announce_host(self) -> None:
-        self._scheduler.AnnounceHost(
-            scheduler_pb2.AnnounceHostRequest(host=self.host_info())
-        )
+        # every scheduler must know this host: tasks pin to different
+        # schedulers by consistent hash, and any of them may hand this
+        # host out as a candidate parent
+        info = self.host_info()
+        for client in self._selector.all():
+            try:
+                client.AnnounceHost(scheduler_pb2.AnnounceHostRequest(host=info))
+            except Exception as e:
+                # one dead scheduler must not starve the others of
+                # announcements — they'd expire this host and stop
+                # offering it as a parent
+                logger.warning("announce to one scheduler failed: %s", e)
 
     def _announce_loop(self) -> None:
         while not self._stop.wait(self.cfg.announce_interval):

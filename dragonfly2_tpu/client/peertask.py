@@ -56,6 +56,13 @@ class TaskManager:
         self.conductors: dict[str, PeerTaskConductor] = {}
         self.lock = threading.Lock()
 
+    def _scheduler_for(self, task_id: str):
+        """Consistent-hash task affinity when a multi-scheduler selector
+        is wired (reference pkg/balancer); a plain client passes through."""
+        if hasattr(self.scheduler, "for_task"):
+            return self.scheduler.for_task(task_id)
+        return self.scheduler
+
     # ------------------------------------------------------------------
     def task_id_for(self, url: str, url_meta: common_pb2.UrlMeta | None) -> str:
         meta = None
@@ -98,7 +105,7 @@ class TaskManager:
                 url=req.url,
                 url_meta=url_meta,
                 storage=self.storage,
-                scheduler_client=self.scheduler,
+                scheduler_client=self._scheduler_for(task_id),
                 piece_manager=self.pm,
                 options=opts,
                 task_type=req.task_type,
@@ -162,7 +169,7 @@ class TaskManager:
         client/daemon/rpcserver announcePeerTask → scheduler AnnounceTask)."""
         import scheduler_pb2  # noqa: E402 — flat proto import
 
-        self.scheduler.AnnounceTask(
+        self._scheduler_for(ts.meta.task_id).AnnounceTask(
             scheduler_pb2.AnnounceTaskRequest(
                 host_id=self.host_id,
                 host=self.host_info_fn() if self.host_info_fn else None,

@@ -97,14 +97,15 @@ class PieceManager:
         pr: PieceRange,
         peer_id: str,
     ) -> "PieceResult":
-        if self.shaper is not None and self.shaper.enabled:
-            # debit before the fetch: the shaper paces admission, and the
-            # piece length is known from the task grid
-            self.shaper.limiter_for(ts.meta.task_id).acquire(pr.length)
         t0 = time.monotonic()
         data, digest, content_type = downloader.download_piece(
             parent.upload_addr, ts.meta.task_id, pr.number, peer_id=peer_id
         )
+        if self.shaper is not None and self.shaper.enabled:
+            # debit on SUCCESS: optimistic 404 probes transfer nothing
+            # and must not burn the budget; the bucket going negative
+            # paces admission of the NEXT piece
+            self.shaper.limiter_for(ts.meta.task_id).acquire(len(data))
         dt = time.monotonic() - t0
         parent.observe(dt)
         if content_type and "Content-Type" not in ts.meta.headers:
@@ -156,10 +157,10 @@ class PieceManager:
             ranges = piece_ranges(content_length, ts.meta.piece_length)
 
             def fetch(pr: PieceRange):
-                if self.shaper is not None and self.shaper.enabled:
-                    self.shaper.limiter_for(ts.meta.task_id).acquire(pr.length)
                 t0 = time.monotonic()
                 data = b"".join(client.download(url, headers, pr.offset, pr.length))
+                if self.shaper is not None and self.shaper.enabled:
+                    self.shaper.limiter_for(ts.meta.task_id).acquire(len(data))
                 dt = time.monotonic() - t0
                 pm = ts.write_piece(
                     pr.number, pr.offset, data,

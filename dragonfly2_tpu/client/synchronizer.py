@@ -14,7 +14,6 @@ the snapshot (the conductor's optimistic-probe fallback still works).
 
 from __future__ import annotations
 
-import queue
 import threading
 
 from dragonfly2_tpu.rpc import gen  # noqa: F401
@@ -38,10 +37,7 @@ class PieceTaskSynchronizer:
         self.interval = interval
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
-        # geometry learned from the first packet that knows it
-        self.content_length = -1
-        self.total_piece_count = -1
-        self._geometry_known = threading.Event()
+        self._calls: list = []  # live stream handles, cancelled on stop
 
     # ------------------------------------------------------------------
     def watch(self, parent, daemon_addr: str) -> None:
@@ -58,15 +54,13 @@ class PieceTaskSynchronizer:
         t.start()
         self._threads.append(t)
 
-    def wait_geometry(self, timeout: float) -> tuple[int, int]:
-        """Block up to ``timeout`` for a packet that carried the task
-        geometry; returns (content_length, total_piece_count) — (-1, -1)
-        when nothing arrived."""
-        self._geometry_known.wait(timeout)
-        return self.content_length, self.total_piece_count
-
     def stop(self) -> None:
         self._stop.set()
+        for call in self._calls:
+            try:
+                call.cancel()  # unblocks a thread stuck on a hung parent
+            except Exception:
+                pass
         for t in self._threads:
             t.join(timeout=2.0)
 
@@ -81,19 +75,33 @@ class PieceTaskSynchronizer:
             client = glue.ServiceClient(channel, glue.DFDAEMON_SERVICE)
             first = [True]
 
+            def watermark() -> int:
+                # contiguous-prefix watermark: every piece below it is
+                # already known, so the parent only re-sends the tail —
+                # without this, big tasks re-transfer the whole inventory
+                # every poll
+                n = 0
+                known = parent.finished_pieces
+                while n in known:
+                    n += 1
+                return n
+
             def requests():
                 # paced request loop: each request asks for the parent's
-                # current inventory; stop() ends the stream client-side
+                # inventory above the watermark; stop() ends the stream
                 while not self._stop.wait(0 if first[0] else self.interval):
                     first[0] = False
                     yield dfdaemon_pb2.PieceTaskRequest(
                         task_id=self.task_id,
                         src_peer_id=parent.peer_id,
                         dst_peer_id=self.peer_id,
+                        start_num=watermark(),
                         limit=0,
                     )
 
-            for packet in client.SyncPieceTasks(requests()):
+            call = client.SyncPieceTasks(requests())
+            self._calls.append(call)
+            for packet in call:
                 if self._stop.is_set():
                     break
                 if packet.piece_infos:
@@ -102,15 +110,6 @@ class PieceTaskSynchronizer:
                     parent.finished_pieces |= {
                         p.number for p in packet.piece_infos
                     }
-                # proto3 reads unset int fields as 0: a parent that GC'd
-                # the task answers an empty packet — only a packet that
-                # actually carries inventory/geometry may latch
-                if self.content_length < 0 and (
-                    packet.piece_infos or packet.total_piece_count > 0
-                ):
-                    self.content_length = packet.content_length
-                    self.total_piece_count = packet.total_piece_count
-                    self._geometry_known.set()
         except Exception as e:
             if not self._stop.is_set():
                 logger.debug("piece sync with %s ended: %s", parent.peer_id, e)

@@ -11,6 +11,7 @@ code (role parity: reference pkg/rpc client/server glue).
 from __future__ import annotations
 
 import time
+import threading
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -232,3 +233,83 @@ class ConsistentHashRing:
         if i == len(self._ring):
             i = 0
         return self._ring[i][1]
+
+
+class SchedulerSelector:
+    """Multi-scheduler client set with consistent-hash task affinity
+    (reference pkg/balancer/consistent_hashing.go wired as the gRPC
+    loadBalancingPolicy; here an explicit selector the daemon drives).
+
+    ``for_task(task_id)`` pins every RPC about a task to one scheduler so
+    that scheduler sees the task's whole swarm; host-scoped calls
+    (AnnounceHost/LeaveHost) fan out to every scheduler via ``all()``.
+    A scheduler that cannot be dialed is skipped until the next use.
+    """
+
+    FAIL_COOLDOWN = 5.0  # seconds before re-dialing a failed address
+
+    def __init__(self, addresses: list[str], service: str = SCHEDULER_SERVICE):
+        self.addresses = [a.strip() for a in addresses if a.strip()]
+        if not self.addresses:
+            raise ValueError("no scheduler addresses")
+        self.service = service
+        self.ring = ConsistentHashRing(self.addresses)
+        self._channels: dict[str, grpc.Channel] = {}
+        self._clients: dict[str, ServiceClient] = {}
+        self._fail_until: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def _client(self, addr: str) -> ServiceClient:
+        with self._lock:
+            client = self._clients.get(addr)
+            if client is not None:
+                return client
+            until = self._fail_until.get(addr, 0.0)
+            if until > time.monotonic():
+                raise ConnectionError(f"{addr} in dial-failure cooldown")
+        # dial OUTSIDE the lock — a dead scheduler's connect timeout must
+        # not stall task routing to healthy, already-cached schedulers
+        try:
+            channel = dial(addr, retries=1)
+        except Exception:
+            with self._lock:
+                self._fail_until[addr] = time.monotonic() + self.FAIL_COOLDOWN
+            raise
+        with self._lock:
+            existing = self._clients.get(addr)
+            if existing is not None:
+                channel.close()  # lost the race; reuse the cached one
+                return existing
+            self._channels[addr] = channel
+            client = self._clients[addr] = ServiceClient(channel, self.service)
+            self._fail_until.pop(addr, None)
+            return client
+
+    def for_task(self, task_id: str) -> ServiceClient:
+        return self._client(self.ring.pick(task_id))
+
+    def addr_for_task(self, task_id: str) -> str:
+        return self.ring.pick(task_id)
+
+    def primary(self) -> ServiceClient:
+        return self._client(self.addresses[0])
+
+    def all(self) -> list[ServiceClient]:
+        from dragonfly2_tpu.utils import dflog
+
+        out = []
+        for addr in self.addresses:
+            try:
+                out.append(self._client(addr))
+            except Exception:
+                dflog.get("rpc.selector").warning(
+                    "scheduler %s unreachable; skipping", addr
+                )
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            for ch in self._channels.values():
+                ch.close()
+            self._channels.clear()
+            self._clients.clear()

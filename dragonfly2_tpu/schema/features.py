@@ -349,3 +349,67 @@ def sample_neighbors(
             neighbors[v, : len(nbrs)] = nbrs
             mask[v, : len(nbrs)] = 1.0
     return neighbors, mask
+
+
+# ---------------------------------------------------------------------------
+# GRU piece time-series (per-(download, parent) piece-cost sequences)
+# ---------------------------------------------------------------------------
+
+GRU_FEATURE_DIM = 2  # [log1p(cost_ms), piece position / MAX_PIECES]
+GRU_MAX_SEQ = MAX_PIECES_PER_PARENT - 1
+
+
+@dataclass
+class PieceSequences:
+    """Per-(download, parent) piece-cost history → next-cost prediction
+    examples (the GRU's supervised task; piece costs per parent come from
+    the Download record schema, reference scheduler/storage/types.go:
+    143-176 Parent.Pieces[].Cost)."""
+
+    sequences: np.ndarray  # [N, GRU_MAX_SEQ, GRU_FEATURE_DIM] float32
+    labels: np.ndarray  # [N] float32 — log1p(next piece cost, ms)
+    lengths: np.ndarray  # [N] int32 — valid prefix length per sequence
+
+
+def extract_piece_sequences(
+    cols: dict[str, np.ndarray], min_pieces: int = 2
+) -> PieceSequences:
+    """Download-record batch → piece-cost sequences: for every parent
+    with ≥ ``min_pieces`` recorded piece costs, the first k-1 costs form
+    the input sequence and the k-th is the label."""
+    empty = PieceSequences(
+        sequences=np.zeros((0, GRU_MAX_SEQ, GRU_FEATURE_DIM), np.float32),
+        labels=np.zeros((0,), np.float32),
+        lengths=np.zeros((0,), np.int32),
+    )
+    if not cols:
+        return empty
+    P = MAX_PARENTS
+    ids = stack_group(cols, "parents.{i}.id", P)  # [N, P] strings
+    costs = np.stack(
+        [
+            stack_group(cols, "parents.{i}.pieces." + str(j) + ".cost", P)
+            for j in range(MAX_PIECES_PER_PARENT)
+        ],
+        axis=-1,
+    ).astype(np.float64)  # [N, P, J]
+    valid_piece = costs > 0
+    counts = valid_piece.sum(-1)  # [N, P]
+    eligible = (ids != "") & (counts >= min_pieces)
+    n_idx, p_idx = np.nonzero(eligible)
+    if len(n_idx) == 0:
+        return empty
+
+    seqs = np.zeros((len(n_idx), GRU_MAX_SEQ, GRU_FEATURE_DIM), np.float32)
+    labels = np.zeros((len(n_idx),), np.float32)
+    lengths = np.zeros((len(n_idx),), np.int32)
+    for out_i, (n, p) in enumerate(zip(n_idx, p_idx)):
+        c = costs[n, p][valid_piece[n, p]]  # ordered piece costs, ns
+        k = len(c)
+        prefix = np.log1p(c[: k - 1] / NS_PER_MS)
+        L = min(len(prefix), GRU_MAX_SEQ)
+        seqs[out_i, :L, 0] = prefix[:L]
+        seqs[out_i, :L, 1] = (np.arange(L) + 1) / MAX_PIECES_PER_PARENT
+        labels[out_i] = np.log1p(c[k - 1] / NS_PER_MS)
+        lengths[out_i] = L
+    return PieceSequences(sequences=seqs, labels=labels, lengths=lengths)

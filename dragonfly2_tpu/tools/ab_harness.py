@@ -173,7 +173,9 @@ def _run_workload(cluster: _Cluster, cfg: ABConfig, origins: list[str]) -> Phase
 
 
 def _train_and_activate(cluster: _Cluster, workdir: str):
-    """Records → MLP fit → manager registry → activation; returns the
+    """Records → announcer Train-stream upload → trainer service fit →
+    CreateModel → activation — the PRODUCTION train path end to end
+    (SURVEY §3.3 round-trip), not an in-process shortcut. Returns the
     manager client (the serving loop's source of truth)."""
     from dragonfly2_tpu.manager.database import Database
     from dragonfly2_tpu.manager.models_registry import ModelRegistry
@@ -183,44 +185,70 @@ def _train_and_activate(cluster: _Cluster, workdir: str):
         ManagerGrpcClientAdapter,
         ManagerService,
     )
-    from dragonfly2_tpu.rpc.glue import ServiceClient, dial, serve
-    from dragonfly2_tpu.schema.columnar import records_to_columns
-    from dragonfly2_tpu.schema.features import extract_pair_features
-    from dragonfly2_tpu.trainer.train import FitConfig, train_mlp
+    from dragonfly2_tpu.rpc.glue import (
+        TRAINER_SERVICE,
+        ServiceClient,
+        dial,
+        serve,
+    )
+    from dragonfly2_tpu.scheduler.announcer import Announcer
+    from dragonfly2_tpu.trainer.service import TrainerService
+    from dragonfly2_tpu.trainer.storage import TrainerStorage
+    from dragonfly2_tpu.trainer.train import FitConfig
+    from dragonfly2_tpu.trainer.training import Training, TrainingConfig
+    from dragonfly2_tpu.utils.idgen import mlp_model_id_v1
     import manager_pb2  # noqa: E402
 
     os.makedirs(workdir, exist_ok=True)
-    records = list(cluster.storage.list_download())
-    pairs = extract_pair_features(records_to_columns(records))
-    logger.info(
-        "training on %d records -> %d pairs", len(records), pairs.features.shape[0]
-    )
-    result = train_mlp(
-        pairs.features,
-        pairs.labels,
-        config=FitConfig(hidden_dims=(64, 64), batch_size=256, epochs=60, eval_fraction=0.15),
-    )
 
+    # manager (model registry) — the serving side
     db = Database(os.path.join(workdir, "manager.db"))
     registry = ModelRegistry(db, FSObjectStorage(os.path.join(workdir, "objects")))
-    service = ManagerService(db, registry)
-    server, port = serve({MANAGER_SERVICE: service})
+    mgr_service = ManagerService(db, registry)
+    server, port = serve({MANAGER_SERVICE: mgr_service})
     channel = dial(f"127.0.0.1:{port}")
     client = ServiceClient(channel, MANAGER_SERVICE)
 
-    adapter = ManagerGrpcClientAdapter(channel)
-    adapter.create_model(
-        model_id="ab-mlp",
-        model_type="mlp",
-        ip="127.0.0.1",
-        hostname="ab-trainer",
-        params=result.params,
-        evaluation=result.metrics,
+    # trainer process surface: Train RPC → Training fit → CreateModel
+    trainer_storage = TrainerStorage(os.path.join(workdir, "trainer"))
+    training = Training(
+        trainer_storage,
+        manager_client=ManagerGrpcClientAdapter(channel),
+        config=TrainingConfig(
+            mlp=FitConfig(
+                hidden_dims=(64, 64), batch_size=256, epochs=60, eval_fraction=0.15
+            ),
+            # the harness produces no probe topology; the GNN leg is
+            # expected to report "below min records" without gating MLP
+            min_topology_records=10**9,
+        ),
     )
+    trainer_service = TrainerService(trainer_storage, training, synchronous=True)
+    t_server, t_port = serve({TRAINER_SERVICE: trainer_service})
+
+    # scheduler-side announcer streams the records it collected —
+    # the same 128MiB-chunked Train upload production runs on a timer
+    ip, hostname = "127.0.0.1", "ab-sched"
+    cluster.storage.flush()
+    trainer_channel = dial(f"127.0.0.1:{t_port}")
+    announcer = Announcer(
+        cluster.storage, ip=ip, hostname=hostname, trainer_channel=trainer_channel
+    )
+    uploaded = announcer.train_once()
+    trainer_channel.close()
+    t_server.stop(0)
+    if not uploaded:
+        raise RuntimeError("announcer had no records to upload")
+
+    model_id = mlp_model_id_v1(ip, hostname)
+    model = client.GetModel(
+        manager_pb2.GetModelRequest(model_id=model_id, version=1)
+    )
+    metrics = {"mse": model.evaluation.mse, "mae": model.evaluation.mae}
     client.UpdateModel(
-        manager_pb2.UpdateModelRequest(model_id="ab-mlp", version=1, state="active")
+        manager_pb2.UpdateModelRequest(model_id=model_id, version=1, state="active")
     )
-    return client, server, channel, result.metrics
+    return client, server, channel, metrics
 
 
 def run_ab(cfg: ABConfig | None = None, workdir: str | None = None) -> dict:

@@ -1,0 +1,108 @@
+"""Federated training round: per-host record shards → per-shard fits →
+example-weighted FedAvg merge → one global model (SURVEY §7 stage 7).
+
+The trainer's storage keys dataset files by uploading scheduler host
+(reference trainer/storage/storage.go:141-148); each host's shard is a
+cluster's view of the swarm. A merged model generalizes across clusters
+without ever pooling their raw records — the cross-datacenter shape,
+where clusters are separate jobs and only parameters cross the DCN
+(parallel/fedavg.fedavg_trees; the in-mesh psum variant rides a
+``fed`` mesh axis, exercised in __graft_entry__.dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from dragonfly2_tpu.parallel.fedavg import fedavg_trees
+from dragonfly2_tpu.schema import native
+from dragonfly2_tpu.schema.columnar import records_to_columns
+from dragonfly2_tpu.schema.features import extract_pair_features
+from dragonfly2_tpu.trainer.train import FitConfig, evaluate_mlp, train_mlp
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("trainer.federation")
+
+
+@dataclass
+class FederatedResult:
+    params: object
+    metrics: dict[str, float]
+    per_host: dict[str, dict] = field(default_factory=dict)
+    total_examples: int = 0
+
+
+def _host_pairs(storage, host_id: str):
+    pairs = native.decode_pairs_file(storage.download_path(host_id))
+    if pairs is None:
+        pairs = extract_pair_features(
+            records_to_columns(storage.list_download(host_id))
+        )
+    return pairs
+
+
+def federated_fit_mlp(
+    storage,
+    host_ids: list[str],
+    config: FitConfig | None = None,
+    mesh=None,
+    eval_fraction: float = 0.1,
+) -> FederatedResult:
+    """One federated round over the given hosts' download shards.
+
+    Per shard: an independent MLP fit (identical init seed — FedAvg of
+    one round from a common init). Merge: example-weighted parameter
+    average. Evaluation: the merged model scored on a held-out slice
+    drawn from EVERY shard, so the metric reflects cross-cluster
+    generalization, not any single cluster's distribution.
+    """
+    cfg = config or FitConfig()
+    models, weights = [], []
+    eval_x, eval_y = [], []
+    per_host: dict[str, dict] = {}
+    for host_id in host_ids:
+        pairs = _host_pairs(storage, host_id)
+        n = pairs.features.shape[0]
+        if n == 0:
+            per_host[host_id] = {"examples": 0, "skipped": True}
+            continue
+        n_eval = max(1, int(n * eval_fraction)) if n > 1 else 0
+        rng = np.random.default_rng(cfg.seed)
+        perm = rng.permutation(n)
+        ev, tr = perm[:n_eval], perm[n_eval:]
+        if len(tr) == 0:
+            per_host[host_id] = {"examples": n, "skipped": True}
+            continue
+        result = train_mlp(pairs.features[tr], pairs.labels[tr], mesh=mesh, config=cfg)
+        models.append(result.params)
+        weights.append(float(len(tr)))
+        if n_eval:
+            eval_x.append(pairs.features[ev])
+            eval_y.append(pairs.labels[ev])
+        per_host[host_id] = {
+            "examples": int(len(tr)),
+            "metrics": result.metrics,
+        }
+    if not models:
+        raise ValueError("no host shard produced trainable examples")
+
+    merged = fedavg_trees(models, weights)
+    metrics: dict[str, float] = {}
+    if eval_x:
+        metrics = evaluate_mlp(
+            merged, np.concatenate(eval_x), np.concatenate(eval_y)
+        )
+    logger.info(
+        "federated round: %d shards, %d examples, merged mse=%s",
+        len(models),
+        int(sum(weights)),
+        metrics.get("mse"),
+    )
+    return FederatedResult(
+        params=merged,
+        metrics=metrics,
+        per_host=per_host,
+        total_examples=int(sum(weights)),
+    )

@@ -69,14 +69,24 @@ class TrainingConfig:
     streaming_threshold_bytes: int = 64 * 1024 * 1024
     streaming_passes: int = 2
     streaming_workers: int = 1
+    # third model family: GRU next-piece-cost predictor over per-parent
+    # piece-cost sequences (Download records carry up to 10 piece costs
+    # per parent, reference scheduler/storage/types.go:143-176)
+    gru: bool = False
+    gru_min_sequences: int = 8
+    gru_config: FitConfig = field(
+        default_factory=lambda: FitConfig(hidden_dims=(32,), batch_size=128, epochs=10)
+    )
 
 
 @dataclass
 class TrainingOutcome:
     mlp_metrics: dict[str, float] | None = None
     gnn_metrics: dict[str, float] | None = None
+    gru_metrics: dict[str, float] | None = None
     mlp_error: str | None = None
     gnn_error: str | None = None
+    gru_error: str | None = None  # GRU is optional; never gates .ok
 
     @property
     def ok(self) -> bool:
@@ -101,9 +111,14 @@ class Training:
         (reference training.go:60-78 errgroup)."""
         host_id = host_id_v2(ip, hostname)
         outcome = TrainingOutcome()
-        with concurrent.futures.ThreadPoolExecutor(max_workers=2) as pool:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=3) as pool:
             f_mlp = pool.submit(self._timed_fit, "mlp", self._train_mlp, host_id, ip, hostname)
             f_gnn = pool.submit(self._timed_fit, "gnn", self._train_gnn, host_id, ip, hostname)
+            f_gru = (
+                pool.submit(self._timed_fit, "gru", self._train_gru, host_id, ip, hostname)
+                if self.config.gru
+                else None
+            )
             try:
                 outcome.mlp_metrics = f_mlp.result()
             except Exception as e:
@@ -114,6 +129,12 @@ class Training:
             except Exception as e:
                 logger.exception("trainGNN failed for %s", host_id)
                 outcome.gnn_error = str(e)
+            if f_gru is not None:
+                try:
+                    outcome.gru_metrics = f_gru.result()
+                except Exception as e:
+                    logger.exception("trainGRU failed for %s", host_id)
+                    outcome.gru_error = str(e)
 
         if self.config.clear_after_train and not self.config.incremental:
             # the reference retrains from scratch each round and drops
@@ -279,6 +300,67 @@ class Training:
                 model_type="gnn",
                 ip=ip,
                 hostname=hostname,
+                params=_to_host(result.params),
+                evaluation=result.metrics,
+            )
+        return result.metrics
+
+
+    # -- trainGRU (piece time-series; our addition over the reference) -----
+    def _train_gru(self, host_id: str, ip: str, hostname: str) -> dict[str, float]:
+        from dragonfly2_tpu.schema.features import extract_piece_sequences
+        from dragonfly2_tpu.trainer.train import train_gru
+        from dragonfly2_tpu.utils.idgen import gru_model_id_v1
+
+        seqs = extract_piece_sequences(
+            records_to_columns(self.storage.list_download(host_id))
+        )
+        n = seqs.sequences.shape[0]
+        if n < self.config.gru_min_sequences:
+            raise ValueError(
+                f"{n} piece sequences for host {host_id}"
+                f" < min {self.config.gru_min_sequences}"
+            )
+        result = train_gru(
+            seqs.sequences,
+            seqs.labels,
+            lengths=seqs.lengths,
+            mesh=self.mesh,
+            config=self.config.gru_config,
+        )
+        if self.manager_client is not None:
+            self.manager_client.create_model(
+                model_id=gru_model_id_v1(ip, hostname),
+                model_type="gru",
+                ip=ip,
+                hostname=hostname,
+                params=_to_host(result.params),
+                evaluation=result.metrics,
+            )
+        return result.metrics
+
+    # -- federated round over every uploading host's shard ----------------
+    def federated_round(
+        self, config: FitConfig | None = None
+    ) -> "dict[str, float]":
+        """Fit every host shard independently, FedAvg-merge, upload ONE
+        global model (trainer/federation.py). Returns the merged model's
+        cross-shard holdout metrics."""
+        from dragonfly2_tpu.trainer.federation import federated_fit_mlp
+        from dragonfly2_tpu.utils.idgen import federated_model_id_v1
+
+        host_ids = self.storage.host_ids()
+        if not host_ids:
+            raise ValueError("no host shards in trainer storage")
+        result = federated_fit_mlp(
+            self.storage, host_ids, config=config or self.config.mlp, mesh=self.mesh
+        )
+        if self.manager_client is not None:
+            self.manager_client.create_model(
+                model_id=federated_model_id_v1(),
+                model_type="mlp",
+                ip="",
+                hostname="federated",
                 params=_to_host(result.params),
                 evaluation=result.metrics,
             )

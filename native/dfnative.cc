@@ -186,9 +186,15 @@ double location_affinity(const std::string& a, const std::string& b) {
 // writer-produced files).
 // ---------------------------------------------------------------------------
 
-template <typename RowFn>
+// Bounded carry: a legitimate record is tens of KB; a multi-megabyte
+// carry means corrupt input (an unterminated quote swallowing the rest
+// of the stream). Discard it, reset quote parity, resync at the next
+// newline — corruption costs a bounded window, not the whole file.
+constexpr size_t kMaxCarry = 8 * 1024 * 1024;
+
+template <typename RowFn, typename DiscardFn>
 void feed_lines(std::string& carry, bool& in_quotes, const char* buf, long len,
-                RowFn&& on_line) {
+                RowFn&& on_line, DiscardFn&& on_discard) {
   long pos = 0;
   // Lazy quote tracking: quotes are rare (csv.writer only quotes fields
   // containing separators/quotes), so instead of scanning every line for
@@ -222,10 +228,20 @@ void feed_lines(std::string& carry, bool& in_quotes, const char* buf, long len,
     }
     if (!nl) {  // chunk ends mid-record
       carry.append(buf + pos, size_t(len - pos));
+      if (carry.size() > kMaxCarry) {
+        carry.clear();
+        in_quotes = false;
+        on_discard();
+      }
       return;
     }
     if (in_quotes) {  // newline inside a quoted field is data
       carry.append(buf + pos, size_t(end - pos + 1));
+      if (carry.size() > kMaxCarry) {
+        carry.clear();
+        in_quotes = false;
+        on_discard();
+      }
       pos = end + 1;
       continue;
     }
@@ -873,8 +889,10 @@ DfPairs* df_pairs_new() { return new DfPairs(); }
 void df_pairs_free(DfPairs* d) { delete d; }
 
 long df_pairs_feed(DfPairs* d, const char* buf, long len) {
-  feed_lines(d->carry, d->in_quotes, buf, len,
-             [d](const char* line, size_t L, bool hq) { d->on_line(line, L, hq); });
+  feed_lines(
+      d->carry, d->in_quotes, buf, len,
+      [d](const char* line, size_t L, bool hq) { d->on_line(line, L, hq); },
+      [d]() { ++d->errors; });
   return long(d->label.size());
 }
 
@@ -909,8 +927,10 @@ DfTopo* df_topo_new() { return new DfTopo(); }
 void df_topo_free(DfTopo* d) { delete d; }
 
 long df_topo_feed(DfTopo* d, const char* buf, long len) {
-  feed_lines(d->carry, d->in_quotes, buf, len,
-             [d](const char* line, size_t L, bool hq) { d->on_line(line, L, hq); });
+  feed_lines(
+      d->carry, d->in_quotes, buf, len,
+      [d](const char* line, size_t L, bool hq) { d->on_line(line, L, hq); },
+      [d]() { ++d->errors; });
   return long(d->src.size());
 }
 

@@ -1,0 +1,209 @@
+"""Fault injection (reference chaos-ish e2e fixtures, test/tools
+no-content-length server, pod restarts): a parent dying mid-task, a
+scheduler restart mid-swarm, and corrupt training data must all degrade
+gracefully, never hang or crash."""
+
+import os
+import time
+
+import pytest
+
+from dragonfly2_tpu.client import dfget
+from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+from dragonfly2_tpu.rpc.glue import serve
+from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
+from dragonfly2_tpu.scheduler.storage import Storage
+
+PIECE = 32 * 1024
+
+
+def _scheduler(tmp_path, port=0):
+    resource = res.Resource()
+    storage = Storage(tmp_path / "rec", buffer_size=1)
+    service = SchedulerService(
+        resource,
+        Scheduling(
+            BaseEvaluator(),
+            SchedulingConfig(retry_interval=0.0, retry_back_to_source_limit=2),
+        ),
+        storage=storage,
+    )
+    server, bound = serve({SERVICE_NAME: service}, address=f"127.0.0.1:{port}")
+    return {"resource": resource, "server": server, "port": bound, "storage": storage}
+
+
+def _daemon(tmp_path, name, sched_port, **kw):
+    d = Daemon(
+        DaemonConfig(
+            data_dir=str(tmp_path / f"daemon-{name}"),
+            scheduler_address=f"127.0.0.1:{sched_port}",
+            hostname=f"host-{name}",
+            piece_length=PIECE,
+            announce_interval=kw.pop("announce_interval", 60.0),
+            schedule_timeout=kw.pop("schedule_timeout", 8.0),
+            **kw,
+        )
+    )
+    d.start()
+    return d
+
+
+def test_parent_dies_mid_task_child_completes(tmp_path):
+    """Daemon A holds the task; A's upload server dies before B pulls.
+    B must fall back (reschedule → back-to-source) and still produce
+    correct bytes."""
+    s = _scheduler(tmp_path)
+    a = _daemon(tmp_path, "a", s["port"])
+    b = _daemon(tmp_path, "b", s["port"])
+    try:
+        payload = os.urandom(5 * PIECE)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        url = f"file://{origin}"
+
+        out_a = tmp_path / "a.bin"
+        dfget.download(f"127.0.0.1:{a.port}", url, str(out_a))
+        assert out_a.read_bytes() == payload
+
+        # kill A's piece-serving surface mid-swarm: children that get A
+        # as a parent see connection failures, not 404s
+        a.upload.stop()
+
+        out_b = tmp_path / "b.bin"
+        dfget.download(f"127.0.0.1:{b.port}", url, str(out_b))
+        assert out_b.read_bytes() == payload
+    finally:
+        for d in (b, a):
+            try:
+                d.stop()
+            except Exception:
+                pass
+        s["server"].stop(0)
+
+
+def test_scheduler_restart_mid_swarm_daemons_recover(tmp_path):
+    """Scheduler dies and comes back empty (fresh resource state) on the
+    same port. Daemons re-announce on their interval; new downloads must
+    work after recovery — including P2P between the old daemons."""
+    s = _scheduler(tmp_path)
+    port = s["port"]
+    a = _daemon(tmp_path, "a", port, announce_interval=0.5)
+    b = _daemon(tmp_path, "b", port, announce_interval=0.5)
+    try:
+        payload = os.urandom(4 * PIECE)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        url = f"file://{origin}"
+        dfget.download(f"127.0.0.1:{a.port}", url, str(tmp_path / "a.bin"))
+
+        # scheduler crash: all in-memory swarm state gone
+        s["server"].stop(0)
+        time.sleep(0.2)
+        s2 = _scheduler(tmp_path / "restart", port=port)
+        try:
+            # daemons re-announce within their interval
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if len(s2["resource"].host_manager.all()) >= 2:
+                    break
+                time.sleep(0.1)
+            assert len(s2["resource"].host_manager.all()) >= 2, "daemons did not re-announce"
+
+            # a NEW task still flows end-to-end through the restarted scheduler
+            payload2 = os.urandom(3 * PIECE)
+            origin2 = tmp_path / "o2.bin"
+            origin2.write_bytes(payload2)
+            out = tmp_path / "after.bin"
+            dfget.download(f"127.0.0.1:{b.port}", f"file://{origin2}", str(out))
+            assert out.read_bytes() == payload2
+        finally:
+            s2["server"].stop(0)
+    finally:
+        for d in (a, b):
+            try:
+                d.stop()
+            except Exception:
+                pass
+
+
+def test_truncated_and_corrupt_csv_rows_are_skipped(tmp_path):
+    """Trainer ingestion must skip malformed rows (counted as errors),
+    not crash, and still train on the good ones."""
+    from dragonfly2_tpu.schema import native
+    from dragonfly2_tpu.schema.columnar import write_csv
+    from dragonfly2_tpu.schema.synth import make_download_records
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+
+    path = tmp_path / "dl.csv"
+    write_csv(path, make_download_records(40, seed=1))
+    good = native.decode_pairs_file(path)
+
+    # inject: a truncated row (crash mid-write) and binary garbage —
+    # both quote-free, so recovery is exact: only the injected rows drop
+    lines = path.read_bytes().split(b"\n")
+    mid = len(lines) // 2
+    corrupted = (
+        lines[:mid]
+        + [lines[mid][: len(lines[mid]) // 3]]  # truncated row
+        + [os.urandom(64).replace(b"\n", b"x").replace(b'"', b"x")]  # garbage
+        + lines[mid:]
+    )
+    bad_path = tmp_path / "bad.csv"
+    bad_path.write_bytes(b"\n".join(corrupted))
+
+    pairs = native.decode_pairs_file(bad_path)
+    assert pairs is not None
+    # every original record decodes except the one we truncated
+    assert pairs.num_downloads >= good.num_downloads - 1
+
+    # quote corruption (an unterminated quote) cannot be resynced by ANY
+    # CSV dialect until the next quote — the contract is: no crash, the
+    # clean prefix decodes, and a fit over the file still runs
+    quote_bad = tmp_path / "quote_bad.csv"
+    quote_bad.write_bytes(
+        b"\n".join(lines[:mid] + [b'"unterminated,' + b"x" * 50] + lines[mid:])
+    )
+    prefix_pairs = native.decode_pairs_file(quote_bad)
+    assert prefix_pairs is not None
+    assert prefix_pairs.num_downloads >= mid - 2
+
+    from dragonfly2_tpu.trainer.ingest import stream_train_mlp
+
+    params, stats = stream_train_mlp(bad_path, batch_size=64, eval_every=0)
+    assert stats.steps > 0
+
+
+def test_upload_server_errors_do_not_poison_swarm(tmp_path):
+    """A parent whose storage lost the task (500s/404s on every piece)
+    must not prevent the child from completing via back-to-source."""
+    s = _scheduler(tmp_path)
+    a = _daemon(tmp_path, "a", s["port"])
+    b = _daemon(tmp_path, "b", s["port"])
+    try:
+        payload = os.urandom(4 * PIECE)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        url = f"file://{origin}"
+        dfget.download(f"127.0.0.1:{a.port}", url, str(tmp_path / "a.bin"))
+
+        # wipe A's piece store: its metadata is gone, every piece fetch 404s
+        from dragonfly2_tpu.client.peertask import TaskManager
+
+        for task_id in list(a.storage.tasks):
+            a.storage.delete_task(task_id)
+
+        out_b = tmp_path / "b.bin"
+        dfget.download(f"127.0.0.1:{b.port}", url, str(out_b))
+        assert out_b.read_bytes() == payload
+    finally:
+        for d in (b, a):
+            try:
+                d.stop()
+            except Exception:
+                pass
+        s["server"].stop(0)

@@ -1,0 +1,117 @@
+"""Multi-scheduler consistent-hash selection (reference
+pkg/balancer/consistent_hashing.go:33-38): every peer announcing task T
+talks to the same scheduler, so that scheduler sees T's whole swarm."""
+
+import os
+
+import pytest
+
+from dragonfly2_tpu.client import dfget
+from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+from dragonfly2_tpu.rpc.glue import ConsistentHashRing, SchedulerSelector, serve
+from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
+from dragonfly2_tpu.scheduler.storage import Storage
+
+
+def _scheduler(tmp_path, name):
+    resource = res.Resource()
+    storage = Storage(tmp_path / f"rec-{name}", buffer_size=1)
+    service = SchedulerService(
+        resource,
+        Scheduling(BaseEvaluator(), SchedulingConfig(retry_interval=0.0)),
+        storage=storage,
+    )
+    server, port = serve({SERVICE_NAME: service})
+    return {"resource": resource, "server": server, "port": port, "storage": storage}
+
+
+def test_ring_is_deterministic_and_balanced():
+    ring = ConsistentHashRing(["s1:1", "s2:2", "s3:3"])
+    picks = [ring.pick(f"task-{i}") for i in range(300)]
+    assert picks == [ring.pick(f"task-{i}") for i in range(300)]
+    from collections import Counter
+
+    counts = Counter(picks)
+    assert len(counts) == 3
+    assert min(counts.values()) > 40  # rough balance across 300 keys
+
+    # removing a node only remaps its own keys
+    before = {f"task-{i}": ring.pick(f"task-{i}") for i in range(300)}
+    ring.remove("s2:2")
+    moved = sum(
+        1
+        for k, v in before.items()
+        if v != "s2:2" and ring.pick(k) != v
+    )
+    assert moved == 0
+
+
+def test_task_affinity_across_two_schedulers(tmp_path):
+    """Two schedulers, two daemons: both daemons must route a given task
+    to the SAME scheduler, so the second daemon finds the first as a
+    candidate parent and pulls P2P."""
+    s1 = _scheduler(tmp_path, "one")
+    s2 = _scheduler(tmp_path, "two")
+    addrs = f"127.0.0.1:{s1['port']},127.0.0.1:{s2['port']}"
+
+    daemons = []
+    for name in ("a", "b"):
+        d = Daemon(
+            DaemonConfig(
+                data_dir=str(tmp_path / f"daemon-{name}"),
+                scheduler_address=addrs,
+                hostname=f"host-{name}",
+                piece_length=32 * 1024,
+                announce_interval=60.0,
+                schedule_timeout=5.0,
+            )
+        )
+        d.start()
+        daemons.append(d)
+    try:
+        payload = os.urandom(128 * 1024)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        url = f"file://{origin}"
+
+        out_a = tmp_path / "a.bin"
+        dfget.download(f"127.0.0.1:{daemons[0].port}", url, str(out_a))
+        assert out_a.read_bytes() == payload
+
+        out_b = tmp_path / "b.bin"
+        dfget.download(f"127.0.0.1:{daemons[1].port}", url, str(out_b))
+        assert out_b.read_bytes() == payload
+
+        # exactly one scheduler saw the task — and it saw BOTH peers
+        tasks1 = s1["resource"].task_manager.all()
+        tasks2 = s2["resource"].task_manager.all()
+        assert (len(tasks1) == 0) != (len(tasks2) == 0), (
+            "task must pin to exactly one scheduler"
+        )
+        owner = tasks1[0] if tasks1 else tasks2[0]
+        assert owner.peer_count() >= 2
+
+        # both schedulers know both hosts (announce fans out)
+        for s in (s1, s2):
+            hosts = {h.id for h in s["resource"].host_manager.all()}
+            assert len(hosts) == 2
+    finally:
+        for d in daemons:
+            d.stop()
+        s1["server"].stop(0)
+        s2["server"].stop(0)
+
+
+def test_selector_survives_one_dead_scheduler(tmp_path):
+    """announce fan-out skips an unreachable scheduler instead of
+    failing the daemon."""
+    s1 = _scheduler(tmp_path, "solo")
+    addrs = f"127.0.0.1:{s1['port']},127.0.0.1:1"
+    sel = SchedulerSelector([a for a in addrs.split(",")])
+    clients = sel.all()
+    assert len(clients) == 1  # dead address skipped
+    sel.close()
+    s1["server"].stop(0)

@@ -105,9 +105,6 @@ def test_synchronizer_tracks_parent_progress(tmp_path):
         sync = PieceTaskSynchronizer("task-sync", "peer-child", interval=0.05)
         sync.watch(parent, f"127.0.0.1:{port}")
 
-        cl, total = sync.wait_geometry(timeout=5.0)
-        assert cl == 4096 * 4
-
         # parent finishes more pieces — the child must see them appear
         ts.write_piece(1, 4096, piece, traffic_type="remote_peer")
         ts.write_piece(2, 8192, piece, traffic_type="remote_peer")
