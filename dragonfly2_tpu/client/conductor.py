@@ -126,6 +126,12 @@ class PeerTaskConductor:
     # ------------------------------------------------------------------
     def start(self) -> None:
         M.TASK_TOTAL.labels("file").inc()
+        # span per peer task (reference peertask_conductor.go:123-124)
+        from dragonfly2_tpu.utils import tracing
+
+        self._span = tracing.get("dfdaemon").start_span(
+            "peer_task", task_id=self.task_id, peer_id=self.peer_id, url=self.url
+        )
         self._started_at = time.monotonic()
         self._stream_thread = threading.Thread(
             target=self._stream_loop, name=f"announce-{self.peer_id[:8]}", daemon=True
@@ -271,6 +277,8 @@ class PeerTaskConductor:
     # ------------------------------------------------------------------
     def _back_to_source(self) -> None:
         M.BACK_TO_SOURCE_TOTAL.inc()
+        if getattr(self, "_span", None) is not None:
+            self._span.event("back_to_source")
         self._send(
             download_peer_back_to_source_started=scheduler_pb2.DownloadPeerBackToSourceStartedRequest(
                 description="falling back to origin"
@@ -497,6 +505,8 @@ class PeerTaskConductor:
         self._publish()
 
     def _finish(self, piece_count: int, content_length: int | None = None) -> None:
+        if getattr(self, "_span", None) is not None:
+            self._span.set(piece_count=piece_count).end("ok")
         self._release_shaper()
         cost_ns = int((time.monotonic() - self._started_at) * 1e9)
         self._send(
@@ -522,6 +532,8 @@ class PeerTaskConductor:
             shaper.release(self.task_id)
 
     def _fail(self, description: str) -> None:
+        if getattr(self, "_span", None) is not None:
+            self._span.set(error=description).end("error")
         self._release_shaper()
         M.TASK_FAILURE_TOTAL.inc()
         self._error = description

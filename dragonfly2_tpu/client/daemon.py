@@ -115,7 +115,7 @@ class Daemon:
             port=config.upload_port,
             delay_s=config.upload_delay_s,
         )
-        self._channel = None
+        self._selector = None
         self._scheduler = None
         self._server = None
         self.port = 0
@@ -131,7 +131,6 @@ class Daemon:
         self.upload.start()
         addresses = [a for a in self.cfg.scheduler_address.split(",") if a.strip()]
         self._selector = glue.SchedulerSelector(addresses)
-        self._channel = None  # owned by the selector now
         self._scheduler = self._selector.primary()
 
         from dragonfly2_tpu.client.piece_manager import TrafficShaper
@@ -229,11 +228,15 @@ class Daemon:
 
     def stop(self) -> None:
         self._stop.set()
-        for client in self._selector.all():
-            try:
-                client.LeaveHost(scheduler_pb2.LeaveHostRequest(host_id=self.host_id))
-            except Exception:
-                pass  # best-effort; TTL GC reaps the host eventually
+        selector = getattr(self, "_selector", None)
+        if selector is not None:
+            for client in selector.all():
+                try:
+                    client.LeaveHost(
+                        scheduler_pb2.LeaveHostRequest(host_id=self.host_id)
+                    )
+                except Exception:
+                    pass  # best-effort; TTL GC reaps the host eventually
         if getattr(self, "_metrics", None) is not None:
             self._metrics.stop()
         if getattr(self, "shaper", None) is not None:
@@ -248,8 +251,6 @@ class Daemon:
         self.upload.stop()
         if getattr(self, "_selector", None) is not None:
             self._selector.close()
-        if self._channel is not None:
-            self._channel.close()
 
     def _import_object(self, url: str, data: bytes, digest: str = "") -> None:
         """Register object bytes as a completed local task so this daemon

@@ -101,12 +101,14 @@ class PieceManager:
         data, digest, content_type = downloader.download_piece(
             parent.upload_addr, ts.meta.task_id, pr.number, peer_id=peer_id
         )
+        dt_transfer = time.monotonic() - t0
         if self.shaper is not None and self.shaper.enabled:
-            # debit on SUCCESS: optimistic 404 probes transfer nothing
-            # and must not burn the budget; the bucket going negative
-            # paces admission of the NEXT piece
+            # debit on SUCCESS, outside the measured window: optimistic
+            # 404 probes transfer nothing and must not burn the budget,
+            # and limiter stall must not poison the recorded piece cost
+            # that trains the parent-ranking models
             self.shaper.limiter_for(ts.meta.task_id).acquire(len(data))
-        dt = time.monotonic() - t0
+        dt = dt_transfer
         parent.observe(dt)
         if content_type and "Content-Type" not in ts.meta.headers:
             ts.meta.headers["Content-Type"] = content_type
@@ -159,9 +161,9 @@ class PieceManager:
             def fetch(pr: PieceRange):
                 t0 = time.monotonic()
                 data = b"".join(client.download(url, headers, pr.offset, pr.length))
+                dt = time.monotonic() - t0
                 if self.shaper is not None and self.shaper.enabled:
                     self.shaper.limiter_for(ts.meta.task_id).acquire(len(data))
-                dt = time.monotonic() - t0
                 pm = ts.write_piece(
                     pr.number, pr.offset, data,
                     traffic_type=TRAFFIC_BACK_TO_SOURCE, cost_ns=int(dt * 1e9),

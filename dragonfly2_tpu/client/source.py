@@ -5,9 +5,9 @@ content length, range support, download, metadata, recursive list) with
 clients under pkg/source/clients/{httpprotocol,...}. Scheme → client
 registry mirrors pkg/source's loader; plugins register at import time.
 
-Only http(s) and file are implemented natively; s3/oss/hdfs register as
-explicit unavailable stubs so callers get a clear error instead of a
-silent fallthrough.
+http(s) and file are implemented here; s3 (SigV4), oss, and hdfs
+(WebHDFS) live in source_cloud.py — real REST clients, no SDKs; oras
+registers as an explicit unavailable stub.
 """
 
 from __future__ import annotations
@@ -226,6 +226,8 @@ def register_client(scheme: str, client: SourceClient) -> None:
 def client_for(url: str) -> SourceClient:
     scheme = urllib.parse.urlparse(url).scheme or "file"
     client = _REGISTRY.get(scheme)
+    if client is None and scheme in _LAZY_CLOUD:
+        client = _load_cloud(scheme)
     if client is None:
         raise SourceError(f"no source client registered for scheme {scheme!r}")
     return client
@@ -234,5 +236,20 @@ def client_for(url: str) -> SourceClient:
 register_client("http", HTTPSourceClient())
 register_client("https", HTTPSourceClient())
 register_client("file", FileSourceClient())
-for _scheme in ("s3", "oss", "hdfs", "oras"):
-    register_client(_scheme, UnavailableSourceClient(_scheme))
+
+
+# cloud clients register lazily on first use — importing source_cloud
+# here would re-enter it while partially initialized when a caller
+# imports source_cloud first (it imports this module for the base types)
+_LAZY_CLOUD = {"s3": "S3SourceClient", "oss": "OSSSourceClient", "hdfs": "HDFSSourceClient"}
+
+
+def _load_cloud(scheme: str) -> SourceClient:
+    from dragonfly2_tpu.client import source_cloud as sc
+
+    client = getattr(sc, _LAZY_CLOUD[scheme])()
+    register_client(scheme, client)
+    return client
+
+
+register_client("oras", UnavailableSourceClient("oras"))

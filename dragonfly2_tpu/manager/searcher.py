@@ -93,3 +93,12 @@ class Searcher:
             * location_affinity(peer.location, cluster.scopes.location)
             + CLUSTER_TYPE_WEIGHT * (1.0 if cluster.is_default else 0.0)
         )
+
+
+def new_searcher() -> "Searcher":
+    """Factory with the plugin seam (reference manager/searcher uses
+    dfplugin to swap the cluster-scoring algorithm)."""
+    from dragonfly2_tpu.utils.dfplugin import registry
+
+    plugin = registry.searcher()
+    return plugin if plugin is not None else Searcher()

@@ -26,8 +26,11 @@ KEEPALIVE_TIMEOUT = 60.0
 
 class ManagerService:
     def __init__(self, db: Database, models: ModelRegistry):
+        from dragonfly2_tpu.manager.searcher import new_searcher
+
         self.db = db
         self.models = models
+        self.searcher = new_searcher()  # plugin seam (utils/dfplugin)
         self.default_cluster_id = db.ensure_default_cluster()
 
     # -- scheduler registry ------------------------------------------------
@@ -65,8 +68,17 @@ class ManagerService:
         )
 
     def ListSchedulers(self, request, context):
+        """Active schedulers for a joining peer. When the peer carries
+        location hints and several clusters exist, the searcher picks the
+        best-matching cluster and only its schedulers are returned
+        (reference searcher.go find-matching-cluster in ListSchedulers)."""
         self._expire_stale()
         rows = self.db.query("SELECT * FROM schedulers WHERE state = 'active'")
+        cluster = self._match_cluster(request)
+        if cluster is not None:
+            scoped = [r for r in rows if r["scheduler_cluster_id"] == cluster.id]
+            if scoped:
+                rows = scoped
         return manager_pb2.ListSchedulersResponse(
             schedulers=[
                 manager_pb2.Scheduler(
@@ -76,6 +88,34 @@ class ManagerService:
                 )
                 for r in rows
             ]
+        )
+
+    def _match_cluster(self, request):
+        if not (request.ip or request.idc or request.location):
+            return None
+        from dragonfly2_tpu.manager.searcher import Cluster, ClusterScope, PeerInfo
+
+        crows = self.db.query("SELECT * FROM scheduler_clusters ORDER BY id")
+        if len(crows) < 2:
+            return None
+        clusters = []
+        for r in crows:
+            scopes = Database.loads(r["scopes"]) or {}
+            clusters.append(
+                Cluster(
+                    id=r["id"],
+                    name=r["name"],
+                    scopes=ClusterScope(
+                        idc=scopes.get("idc", ""),
+                        location=scopes.get("location", ""),
+                        cidrs=scopes.get("cidrs", []),
+                    ),
+                    is_default=bool(r["is_default"]),
+                )
+            )
+        return self.searcher.find_matching_cluster(
+            clusters,
+            PeerInfo(ip=request.ip, idc=request.idc, location=request.location),
         )
 
     def _expire_stale(self) -> None:

@@ -292,7 +292,15 @@ class SchedulerSelector:
         return self.ring.pick(task_id)
 
     def primary(self) -> ServiceClient:
-        return self._client(self.addresses[0])
+        """First REACHABLE scheduler (probe loops etc.); raises only when
+        every address is down."""
+        last: Exception | None = None
+        for addr in self.addresses:
+            try:
+                return self._client(addr)
+            except Exception as e:
+                last = e
+        raise ConnectionError(f"no scheduler reachable: {last}")
 
     def all(self) -> list[ServiceClient]:
         from dragonfly2_tpu.utils import dflog

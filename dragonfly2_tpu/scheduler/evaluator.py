@@ -233,7 +233,16 @@ def pair_features(parent: Peer, child: Peer, total_piece_count: int) -> np.ndarr
 
 
 def new_evaluator(algorithm: str = "default", model=None) -> Evaluator:
-    """Factory (reference evaluator.go:26-59: default | ml | plugin)."""
+    """Factory (reference evaluator.go:26-59: default | ml | plugin).
+    Any other name is looked up in the plugin registry
+    (utils/dfplugin); unknown names fall back to the base evaluator,
+    mirroring the reference's fallthrough."""
     if algorithm == "ml":
         return MLEvaluator(model)
+    if algorithm not in ("", "default"):
+        from dragonfly2_tpu.utils.dfplugin import registry
+
+        plugin = registry.evaluator(algorithm)
+        if plugin is not None:
+            return plugin
     return BaseEvaluator()

@@ -103,9 +103,23 @@ class Scheduling:
         decide back-to-source (peer demand or retry exhaustion) and push
         NeedBackToSourceResponse. Raises SchedulingError when the retry
         limit is exhausted and back-to-source isn't possible."""
+        from dragonfly2_tpu.utils import tracing
+
         blocklist = blocklist or set()
         n = 0
         _t0 = time.perf_counter()
+        _span = tracing.get("scheduler").start_span(
+            "schedule", peer_id=peer.id, task_id=peer.task.id
+        )
+        try:
+            self._schedule_loop(peer, blocklist, cancelled, n, _t0, _span)
+        except BaseException:
+            _span.end("error")
+            raise
+        finally:
+            _span.end("ok")  # idempotent; attributes set at decision points
+
+    def _schedule_loop(self, peer, blocklist, cancelled, n, _t0, _span):
         while True:
             if cancelled is not None and cancelled():
                 return
@@ -121,6 +135,7 @@ class Scheduling:
             # explicit demand wins even while seeding — the demanding peer
             # IS the seed (its registration carries need_back_to_source)
             if peer.need_back_to_source and peer.task.can_back_to_source():
+                _span.set(back_to_source="peer demand", retries=n)
                 self._send(
                     peer,
                     NeedBackToSourceResponse("peer's NeedBackToSource is true"),
@@ -129,6 +144,7 @@ class Scheduling:
 
             if not seeding and peer.task.can_back_to_source():
                 if n >= self.config.retry_back_to_source_limit:
+                    _span.set(back_to_source="retry limit", retries=n)
                     self._send(
                         peer,
                         NeedBackToSourceResponse(
@@ -169,6 +185,7 @@ class Scheduling:
                 continue
 
             M.SCHEDULE_DURATION.observe(time.perf_counter() - _t0)
+            _span.set(candidates=len(candidate_parents), retries=n).end("ok")
             self._send(peer, NormalTaskResponse(candidate_parents))
 
             for parent in candidate_parents:

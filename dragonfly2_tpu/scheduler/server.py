@@ -57,6 +57,8 @@ class SchedulerServerConfig:
     topology_snapshot_interval: float = 2 * 3600.0
     # Prometheus /metrics endpoint (reference :8000): -1 = disabled
     metrics_port: int = -1
+    # df_plugin_*.py modules loaded at startup (reference internal/dfplugin)
+    plugin_dir: str = ""
     metrics_host: str = "127.0.0.1"
 
 
@@ -67,6 +69,10 @@ class SchedulerServer:
             config.hostname = socket.gethostname()
         Path(config.data_dir).mkdir(parents=True, exist_ok=True)
 
+        if config.plugin_dir:
+            from dragonfly2_tpu.utils.dfplugin import load_plugins
+
+            load_plugins(config.plugin_dir)
         self.gc = GC()
         self.resource = res.Resource(gc=self.gc)
         self.storage = Storage(
@@ -116,7 +122,9 @@ class SchedulerServer:
                     interval=config.model_refresh_interval,
                 )
         else:
-            evaluator = BaseEvaluator()
+            from dragonfly2_tpu.scheduler.evaluator import new_evaluator
+
+            evaluator = new_evaluator(config.algorithm)
         self.evaluator = evaluator
 
         self.scheduling = Scheduling(

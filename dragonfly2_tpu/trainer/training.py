@@ -77,6 +77,8 @@ class TrainingConfig:
     gru_config: FitConfig = field(
         default_factory=lambda: FitConfig(hidden_dims=(32,), batch_size=128, epochs=10)
     )
+    # jax.profiler trace dir per fit ("" = off); view with TensorBoard
+    profile_dir: str = ""
 
 
 @dataclass
@@ -146,14 +148,34 @@ class Training:
         return outcome
 
     def _timed_fit(self, model: str, fn, *args):
-        with M.FIT_DURATION.labels(model).time():
+        from dragonfly2_tpu.utils import tracing
+
+        span = tracing.get("trainer").start_span("fit", model=model)
+        profiler_cm = self._maybe_profile(model)
+        with M.FIT_DURATION.labels(model).time(), profiler_cm:
             try:
                 result = fn(*args)
             except Exception:
+                span.end("error")
                 M.FIT_TOTAL.labels(model, "failure").inc()
                 raise
+        span.end("ok")
         M.FIT_TOTAL.labels(model, "success").inc()
         return result
+
+    def _maybe_profile(self, model: str):
+        """jax.profiler trace per fit when profile_dir is set — the
+        XLA-side observability the reference's pprof flag provides for
+        Go (cmd/dependency/dependency.go:95)."""
+        import contextlib
+
+        if not self.config.profile_dir:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.profiler.trace(
+            f"{self.config.profile_dir}/{model}", create_perfetto_trace=False
+        )
 
     # -- trainMLP (reference training.go:92-98) ---------------------------
     def _train_mlp(self, host_id: str, ip: str, hostname: str) -> dict[str, float]:

@@ -1,0 +1,156 @@
+"""Lightweight distributed tracing (reference: OpenTelemetry + Jaeger
+initialized per binary, cmd/dependency/dependency.go:95-122; span per
+peer task, client/daemon/peer/peertask_conductor.go:123-124).
+
+In-process span recorder with W3C-style ids, parent links, attributes,
+events, and two sinks: a bounded in-memory ring (always on — cheap
+introspection for tests/debug) and an optional JSONL export file (one
+span per line; an OTLP forwarder is a sink swap away — the schema
+carries everything OTLP needs). The compute plane adds `jax.profiler`
+traces via trainer config (profile_dir), the XLA-side equivalent.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+_RING_SIZE = 1024
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str = ""
+    service: str = ""
+    start_ns: int = 0
+    end_ns: int = 0
+    status: str = "ok"
+    attributes: dict = field(default_factory=dict)
+    events: list = field(default_factory=list)
+    _tracer: "Tracer | None" = None
+
+    # ------------------------------------------------------------------
+    def set(self, **attrs) -> "Span":
+        self.attributes.update(attrs)
+        return self
+
+    def event(self, name: str, **attrs) -> None:
+        self.events.append({"name": name, "ts_ns": time.time_ns(), **attrs})
+
+    def end(self, status: str = "ok") -> None:
+        if self.end_ns:
+            return  # idempotent
+        self.end_ns = time.time_ns()
+        self.status = status
+        if self._tracer is not None:
+            self._tracer._record(self)
+
+    def child(self, name: str, **attrs) -> "Span":
+        if self._tracer is None:
+            return Span(name, self.trace_id, uuid.uuid4().hex[:16])
+        return self._tracer.start_span(
+            name, parent=self, **attrs
+        )
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e6 if self.end_ns else 0.0
+
+    # context-manager sugar
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.end("error" if exc_type is not None else "ok")
+        return False
+
+
+class Tracer:
+    def __init__(self, service: str, export_path: str | None = None):
+        self.service = service
+        self.export_path = export_path
+        self.finished: collections.deque[Span] = collections.deque(maxlen=_RING_SIZE)
+        self._lock = threading.Lock()
+        self._file = None
+        if export_path:
+            os.makedirs(os.path.dirname(export_path) or ".", exist_ok=True)
+            self._file = open(export_path, "a", buffering=1)
+
+    def start_span(self, name: str, parent: Span | None = None, **attrs) -> Span:
+        return Span(
+            name=name,
+            trace_id=parent.trace_id if parent else uuid.uuid4().hex,
+            span_id=uuid.uuid4().hex[:16],
+            parent_id=parent.span_id if parent else "",
+            service=self.service,
+            start_ns=time.time_ns(),
+            attributes=dict(attrs),
+            _tracer=self,
+        )
+
+    def span(self, name: str, parent: Span | None = None, **attrs) -> Span:
+        """Context-manager form: ``with tracer.span("x") as sp: ...``."""
+        return self.start_span(name, parent=parent, **attrs)
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self.finished.append(span)
+            if self._file is not None:
+                self._file.write(
+                    json.dumps(
+                        {
+                            "name": span.name,
+                            "service": span.service,
+                            "trace_id": span.trace_id,
+                            "span_id": span.span_id,
+                            "parent_id": span.parent_id,
+                            "start_ns": span.start_ns,
+                            "end_ns": span.end_ns,
+                            "status": span.status,
+                            "attributes": span.attributes,
+                            "events": span.events,
+                        },
+                        default=str,
+                    )
+                    + "\n"
+                )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+_tracers: dict[str, Tracer] = {}
+_config_lock = threading.Lock()
+_export_dir: str | None = os.environ.get("DF_TRACE_DIR") or None
+
+
+def configure(export_dir: str | None) -> None:
+    """Set the JSONL export directory for tracers created after this
+    call (one file per service); None = in-memory ring only."""
+    global _export_dir
+    with _config_lock:
+        _export_dir = export_dir
+
+
+def get(service: str) -> Tracer:
+    with _config_lock:
+        tracer = _tracers.get(service)
+        if tracer is None:
+            path = (
+                os.path.join(_export_dir, f"{service}.spans.jsonl")
+                if _export_dir
+                else None
+            )
+            tracer = _tracers[service] = Tracer(service, path)
+        return tracer

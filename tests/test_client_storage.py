@@ -117,8 +117,10 @@ def test_file_source_list(tmp_path):
 
 
 def test_unavailable_scheme_raises():
+    # s3/oss/hdfs are real clients now (source_cloud.py); oras remains a
+    # declared-unavailable stub
     with pytest.raises(source.SourceError, match="not available"):
-        source.client_for("s3://bucket/key").metadata("s3://bucket/key")
+        source.client_for("oras://registry/repo").metadata("oras://registry/repo")
 
 
 def test_http_source_roundtrip(tmp_path):

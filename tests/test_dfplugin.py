@@ -1,0 +1,62 @@
+"""Plugin loader (utils/dfplugin, reference internal/dfplugin): evaluator
+/ source-client / searcher extension points loaded from df_plugin_*.py."""
+
+import textwrap
+
+from dragonfly2_tpu.utils.dfplugin import load_plugins, registry
+
+
+def test_plugin_registers_all_three_seams(tmp_path):
+    (tmp_path / "df_plugin_demo.py").write_text(textwrap.dedent("""
+        from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+        from dragonfly2_tpu.client.source import SourceClient, Metadata
+
+        class ReverseEvaluator(BaseEvaluator):
+            def evaluate_parents(self, parents, child, total_piece_count):
+                return list(reversed(parents))
+
+        class NullClient(SourceClient):
+            def metadata(self, url, headers=None):
+                return Metadata(content_length=0)
+            def download(self, url, headers=None, offset=0, length=-1):
+                return iter(())
+            def list(self, url, headers=None):
+                return []
+
+        def dragonfly_plugin_init(registry):
+            registry.register_evaluator("reverse", lambda: ReverseEvaluator())
+            registry.register_source_client("nullproto", NullClient())
+            registry.register_searcher(lambda: "custom-searcher")
+    """))
+    loaded = load_plugins(tmp_path)
+    assert loaded == ["df_plugin_demo"]
+
+    from dragonfly2_tpu.scheduler.evaluator import new_evaluator
+
+    ev = new_evaluator("reverse")
+    assert type(ev).__name__ == "ReverseEvaluator"
+    # unknown names fall back to base
+    assert type(new_evaluator("no-such")).__name__ == "BaseEvaluator"
+
+    from dragonfly2_tpu.client import source
+
+    assert type(source.client_for("nullproto://x")).__name__ == "NullClient"
+
+    from dragonfly2_tpu.manager.searcher import new_searcher
+
+    assert new_searcher() == "custom-searcher"
+    registry.searchers.clear()  # don't leak into other tests
+    registry.evaluators.clear()
+
+
+def test_broken_plugin_is_skipped(tmp_path):
+    (tmp_path / "df_plugin_broken.py").write_text("raise RuntimeError('boom')\n")
+    (tmp_path / "df_plugin_ok.py").write_text(
+        "def dragonfly_plugin_init(registry):\n    pass\n"
+    )
+    loaded = load_plugins(tmp_path)
+    assert loaded == ["df_plugin_ok"]
+
+
+def test_missing_dir_is_noop(tmp_path):
+    assert load_plugins(tmp_path / "nope") == []

@@ -176,3 +176,49 @@ class TestSearcher:
         assert s.find_matching_cluster(clusters, eu_peer).id == 3
         nowhere = PeerInfo(ip="8.8.8.8")
         assert s.find_matching_cluster(clusters, nowhere).id == 1  # default bonus
+
+
+def test_list_schedulers_scoped_by_searcher(tmp_path):
+    """A joining peer with location hints gets the best-matching
+    cluster's schedulers only (searcher wired into ListSchedulers)."""
+    import json as _json
+    import time as _time
+
+    from dragonfly2_tpu.manager.database import Database
+    from dragonfly2_tpu.manager.models_registry import ModelRegistry
+    from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+    from dragonfly2_tpu.manager.service import ManagerService
+    from dragonfly2_tpu.rpc import gen  # noqa: F401
+    import manager_pb2
+
+    db = Database(tmp_path / "m.db")
+    service = ManagerService(db, ModelRegistry(db, FSObjectStorage(tmp_path / "o")))
+    now = _time.time()
+    # second cluster scoped to idc-b
+    db.execute(
+        "INSERT INTO scheduler_clusters (name, scopes, created_at, updated_at)"
+        " VALUES ('cluster-b', ?, ?, ?)",
+        (_json.dumps({"idc": "idc-b"}), now, now),
+    )
+    cb = db.query_one("SELECT id FROM scheduler_clusters WHERE name='cluster-b'")["id"]
+    for host, cluster in (("s-default", service.default_cluster_id), ("s-b", cb)):
+        db.execute(
+            "INSERT INTO schedulers (hostname, ip, port, state, scheduler_cluster_id,"
+            " last_keepalive, created_at, updated_at)"
+            " VALUES (?, '10.0.0.9', 8002, 'active', ?, ?, ?, ?)",
+            (host, cluster, now, now, now),
+        )
+
+    class Ctx:
+        def abort(self, *a):
+            raise AssertionError(a)
+
+    # peer in idc-b → only cluster-b's scheduler
+    resp = service.ListSchedulers(
+        manager_pb2.ListSchedulersRequest(ip="10.1.1.1", idc="idc-b"), Ctx()
+    )
+    assert [s.hostname for s in resp.schedulers] == ["s-b"]
+    # peer with no hints → everything
+    resp = service.ListSchedulers(manager_pb2.ListSchedulersRequest(), Ctx())
+    assert len(resp.schedulers) == 2
+    db.close()

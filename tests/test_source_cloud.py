@@ -1,0 +1,256 @@
+"""Cloud back-to-source clients (s3 SigV4 / oss / WebHDFS) against
+in-process fake services — the reference tests its source clients with
+mock transports the same way (pkg/source/clients/*/... tests)."""
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dragonfly2_tpu.client import source
+from dragonfly2_tpu.client.source import SourceError
+
+
+@pytest.fixture
+def fake_s3(monkeypatch):
+    """Minimal S3 REST fake: path-style GET/HEAD with Range, ListObjectsV2,
+    and SigV4 verification of the Authorization header shape."""
+    objects = {
+        ("bkt", "data/blob.bin"): os.urandom(96 * 1024),
+        ("bkt", "data/a.txt"): b"alpha",
+        ("bkt", "data/sub/b.txt"): b"beta",
+    }
+    seen = {"auth": None}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _obj(self):
+            parts = urllib.parse.urlsplit(self.path)
+            segs = parts.path.lstrip("/").split("/", 1)
+            bucket = segs[0]
+            key = urllib.parse.unquote(segs[1]) if len(segs) > 1 else ""
+            return bucket, key, urllib.parse.parse_qs(parts.query)
+
+        def do_HEAD(self):
+            bucket, key, _ = self._obj()
+            body = objects.get((bucket, key))
+            if body is None:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Content-Type", "application/octet-stream")
+            self.end_headers()
+
+        def do_GET(self):
+            seen["auth"] = self.headers.get("Authorization", "")
+            bucket, key, q = self._obj()
+            if "list-type" in q:
+                prefix = q.get("prefix", [""])[0]
+                keys = sorted(
+                    k for (b, k) in objects if b == bucket and k.startswith(prefix)
+                )
+                contents = "".join(f"<Contents><Key>{k}</Key></Contents>" for k in keys)
+                xml = f"<ListBucketResult>{contents}</ListBucketResult>".encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(xml)))
+                self.end_headers()
+                self.wfile.write(xml)
+                return
+            body = objects.get((bucket, key))
+            if body is None:
+                self.send_error(404)
+                return
+            rng = self.headers.get("Range")
+            status = 200
+            if rng:
+                spec = rng.split("=", 1)[1]
+                start_s, _, end_s = spec.partition("-")
+                start = int(start_s)
+                end = int(end_s) if end_s else len(body) - 1
+                body = body[start : end + 1]
+                status = 206
+            self.send_response(status)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    monkeypatch.setenv("DF_S3_ENDPOINT", f"http://127.0.0.1:{httpd.server_port}")
+    monkeypatch.setenv("DF_S3_ACCESS_KEY", "AKIATEST")
+    monkeypatch.setenv("DF_S3_SECRET_KEY", "secret")
+    monkeypatch.setenv("DF_S3_REGION", "us-test-1")
+    yield {"objects": objects, "seen": seen}
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_s3_metadata_download_and_range(fake_s3):
+    client = source.client_for("s3://bkt/data/blob.bin")
+    meta = client.metadata("s3://bkt/data/blob.bin")
+    body = fake_s3["objects"][("bkt", "data/blob.bin")]
+    assert meta.content_length == len(body)
+    assert meta.support_range
+
+    got = b"".join(client.download("s3://bkt/data/blob.bin"))
+    assert got == body
+
+    part = b"".join(client.download("s3://bkt/data/blob.bin", offset=1024, length=4096))
+    assert part == body[1024 : 1024 + 4096]
+
+    auth = fake_s3["seen"]["auth"]
+    assert auth.startswith("AWS4-HMAC-SHA256 Credential=AKIATEST/")
+    assert "us-test-1/s3/aws4_request" in auth
+    assert "Signature=" in auth
+
+
+def test_s3_list(fake_s3):
+    client = source.client_for("s3://bkt/data")
+    entries = client.list("s3://bkt/data")
+    names = sorted(e.name for e in entries)
+    assert "a.txt" in names and "blob.bin" in names
+
+
+def test_s3_missing_credentials(monkeypatch):
+    for var in ("DF_S3_ACCESS_KEY", "DF_S3_SECRET_KEY", "DF_S3_ENDPOINT"):
+        monkeypatch.delenv(var, raising=False)
+    client = source.client_for("s3://bkt/k")
+    with pytest.raises(SourceError, match="credentials missing"):
+        client.metadata("s3://bkt/k")
+
+
+def test_oss_download_with_signature(monkeypatch):
+    payload = os.urandom(8 * 1024)
+    seen = {}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_HEAD(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+
+        def do_GET(self):
+            seen["auth"] = self.headers.get("Authorization", "")
+            seen["date"] = self.headers.get("Date", "")
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        monkeypatch.setenv("DF_OSS_ENDPOINT", f"http://127.0.0.1:{httpd.server_port}")
+        monkeypatch.setenv("DF_OSS_ACCESS_KEY", "osskey")
+        monkeypatch.setenv("DF_OSS_SECRET_KEY", "osssecret")
+        client = source.client_for("oss://bkt/obj.bin")
+        got = b"".join(client.download("oss://bkt/obj.bin"))
+        assert got == payload
+        # verify the classic OSS signature against what we'd compute
+        to_sign = f"GET\n\n\n{seen['date']}\n/bkt/obj.bin"
+        want = base64.b64encode(
+            hmac.new(b"osssecret", to_sign.encode(), hashlib.sha1).digest()
+        ).decode()
+        assert seen["auth"] == f"OSS osskey:{want}"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_hdfs_webhdfs_roundtrip():
+    payload = os.urandom(16 * 1024)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            parts = urllib.parse.urlsplit(self.path)
+            q = urllib.parse.parse_qs(parts.query)
+            op = q["op"][0]
+            if op == "GETFILESTATUS":
+                body = json.dumps(
+                    {"FileStatus": {"length": len(payload), "type": "FILE",
+                                    "modificationTime": 1700000000000}}
+                ).encode()
+            elif op == "OPEN":
+                off = int(q.get("offset", ["0"])[0])
+                ln = int(q.get("length", [str(len(payload))])[0])
+                body = payload[off : off + ln]
+            elif op == "LISTSTATUS":
+                body = json.dumps(
+                    {"FileStatuses": {"FileStatus": [
+                        {"pathSuffix": "x.bin", "type": "FILE", "length": 3},
+                        {"pathSuffix": "sub", "type": "DIRECTORY", "length": 0},
+                    ]}}
+                ).encode()
+            else:
+                self.send_error(400)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        base = f"hdfs://127.0.0.1:{httpd.server_port}/data/file.bin"
+        client = source.client_for(base)
+        meta = client.metadata(base)
+        assert meta.content_length == len(payload)
+        got = b"".join(client.download(base))
+        assert got == payload
+        part = b"".join(client.download(base, offset=100, length=200))
+        assert part == payload[100:300]
+        entries = client.list(f"hdfs://127.0.0.1:{httpd.server_port}/data")
+        assert {e.name: e.is_dir for e in entries} == {"x.bin": False, "sub": True}
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_dfget_back_to_source_via_fake_s3(fake_s3, tmp_path):
+    """Full path: dfget → daemon → back-to-source s3 origin."""
+    from dragonfly2_tpu.client import dfget
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.rpc.glue import serve
+    from dragonfly2_tpu.scheduler import resource as res
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+    from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+    from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
+
+    resource = res.Resource()
+    service = SchedulerService(
+        resource, Scheduling(BaseEvaluator(), SchedulingConfig(retry_interval=0.0))
+    )
+    server, port = serve({SERVICE_NAME: service})
+    d = Daemon(
+        DaemonConfig(
+            data_dir=str(tmp_path / "daemon"),
+            scheduler_address=f"127.0.0.1:{port}",
+            hostname="host-s3",
+            piece_length=32 * 1024,
+            announce_interval=60.0,
+        )
+    )
+    d.start()
+    try:
+        out = tmp_path / "out.bin"
+        dfget.download(f"127.0.0.1:{d.port}", "s3://bkt/data/blob.bin", str(out))
+        assert out.read_bytes() == fake_s3["objects"][("bkt", "data/blob.bin")]
+    finally:
+        d.stop()
+        server.stop(0)

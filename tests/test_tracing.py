@@ -1,0 +1,75 @@
+"""Span tracing (utils/tracing, reference OTel-per-binary + span per
+peer task) — ids, parenting, export, and production wiring."""
+
+import json
+import os
+
+from dragonfly2_tpu.utils import tracing
+
+
+def test_span_lifecycle_and_parenting(tmp_path):
+    tr = tracing.Tracer("svc", export_path=str(tmp_path / "s.jsonl"))
+    with tr.span("root", a=1) as root:
+        root.event("hello", x=2)
+        with root.child("leaf") as leaf:
+            pass
+    assert leaf.trace_id == root.trace_id
+    assert leaf.parent_id == root.span_id
+    assert root.duration_ms >= 0
+    lines = [json.loads(l) for l in open(tmp_path / "s.jsonl")]
+    assert [l["name"] for l in lines] == ["leaf", "root"]  # leaf ends first
+    assert lines[1]["events"][0]["name"] == "hello"
+    assert lines[1]["status"] == "ok"
+    tr.close()
+
+
+def test_error_status_on_exception():
+    tr = tracing.Tracer("svc2")
+    try:
+        with tr.span("boom"):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    assert tr.finished[-1].status == "error"
+
+
+def test_download_produces_task_and_schedule_spans(tmp_path):
+    from dragonfly2_tpu.client import dfget
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.rpc.glue import serve
+    from dragonfly2_tpu.scheduler import resource as res
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+    from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+    from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
+
+    resource = res.Resource()
+    service = SchedulerService(
+        resource, Scheduling(BaseEvaluator(), SchedulingConfig(retry_interval=0.0))
+    )
+    server, port = serve({SERVICE_NAME: service})
+    d = Daemon(
+        DaemonConfig(
+            data_dir=str(tmp_path / "daemon"),
+            scheduler_address=f"127.0.0.1:{port}",
+            hostname="host-trace",
+            piece_length=32 * 1024,
+            announce_interval=60.0,
+        )
+    )
+    d.start()
+    try:
+        payload = os.urandom(64 * 1024)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        out = tmp_path / "out.bin"
+        dfget.download(f"127.0.0.1:{d.port}", f"file://{origin}", str(out))
+        assert out.read_bytes() == payload
+    finally:
+        d.stop()
+        server.stop(0)
+
+    daemon_spans = [s for s in tracing.get("dfdaemon").finished if s.name == "peer_task"]
+    assert daemon_spans and daemon_spans[-1].status == "ok"
+    assert daemon_spans[-1].attributes["piece_count"] >= 1
+    sched_spans = [s for s in tracing.get("scheduler").finished if s.name == "schedule"]
+    assert sched_spans  # at least the back-to-source decision path ran
