@@ -62,6 +62,11 @@ class DaemonConfig:
     proxy_port: int = -1
     proxy_rules: list = field(default_factory=list)
     registry_mirror: str = ""
+    # HTTPS interception: spoof per-host certs signed by a local CA
+    # persisted under data_dir/ca (clients trust ca.crt once); hosts
+    # matching proxy_mitm_hosts regexes are intercepted (empty = all)
+    proxy_mitm: bool = False
+    proxy_mitm_hosts: list = field(default_factory=list)
     # object-storage gateway: -1 = disabled, 0 = ephemeral port; the
     # backend dir is the bucket store (shared across daemons — NFS/S3
     # mount in production, a shared tmp dir in tests)
@@ -171,10 +176,15 @@ class Daemon:
                 r if isinstance(r, ProxyRule) else ProxyRule(**r)
                 for r in self.cfg.proxy_rules
             ]
+            issuer = None
+            if self.cfg.proxy_mitm:
+                issuer = self._load_spoofing_issuer()
             self.proxy = ProxyServer(
                 P2PTransport(self.task_manager, rules=rules),
                 mirror=RegistryMirror(self.cfg.registry_mirror),
                 port=self.cfg.proxy_port,
+                issuer=issuer,
+                intercept=self.cfg.proxy_mitm_hosts or None,
             )
             self.proxy.start()
 
@@ -336,6 +346,32 @@ class Daemon:
             ),
             scheduler_cluster_id=self.cfg.scheduler_cluster_id,
         )
+
+    def _load_spoofing_issuer(self):
+        """CA for HTTPS interception, persisted across restarts so
+        clients only provision trust once (reference proxy CA cert
+        config)."""
+        import os
+
+        from dragonfly2_tpu.utils.issuer import CertificateAuthority, SpoofingIssuer
+
+        ca_dir = os.path.join(self.cfg.data_dir, "ca")
+        crt, key = os.path.join(ca_dir, "ca.crt"), os.path.join(ca_dir, "ca.key")
+        if os.path.exists(crt) and os.path.exists(key):
+            with open(crt, "rb") as f1, open(key, "rb") as f2:
+                ca = CertificateAuthority.load(f1.read(), f2.read())
+        else:
+            os.makedirs(ca_dir, exist_ok=True)
+            ca = CertificateAuthority(f"dragonfly2 proxy CA ({self.cfg.hostname})")
+            with open(crt, "wb") as f:
+                f.write(ca.cert_pem)
+            # the CA key must never be world-readable, not even between
+            # create and chmod — open with the final mode
+            fd = os.open(key, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+            with os.fdopen(fd, "wb") as f:
+                f.write(ca.key_pem)
+        logger.info("proxy MITM enabled; CA at %s", crt)
+        return SpoofingIssuer(ca)
 
     def announce_host(self) -> None:
         # every scheduler must know this host: tasks pin to different

@@ -4,9 +4,10 @@ Role parity: reference client/daemon/proxy/proxy.go:268-766 — an HTTP
 proxy in front of container registries / artifact stores: plain-HTTP
 requests matching the configured rules are converted into peer tasks
 (P2P swarm with back-to-source), everything else passes through;
-``CONNECT`` is tunneled raw (the reference can also MITM TLS with a
-spoofed CA — here CONNECT bytes are relayed opaquely, so HTTPS rules
-belong on the registry-mirror path instead). A registry mirror rewrites
+``CONNECT`` is either tunneled raw or — with an issuer configured —
+TLS-intercepted with per-host spoofed certificates signed by the local
+CA (reference proxy.go cert spoofing), so HTTPS registry traffic rides
+P2P too. A registry mirror rewrites
 request URLs onto the mirror remote before routing, which is how blob
 and layer GETs become shared P2P downloads.
 """
@@ -14,8 +15,11 @@ and layer GETs become shared P2P downloads.
 from __future__ import annotations
 
 import dataclasses
+import re
 import select
 import socket
+import ssl
+import tempfile
 import threading
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -72,9 +76,15 @@ class ProxyServer:
         mirror: RegistryMirror | None = None,
         address: str = "127.0.0.1",
         port: int = 0,
+        issuer=None,  # utils.issuer.SpoofingIssuer → enables HTTPS MITM
+        intercept: list[str] | None = None,  # host regexes; None = all hosts
     ):
         self.transport = transport
         self.mirror = mirror or RegistryMirror()
+        self.issuer = issuer
+        self.intercept = [re.compile(rx) for rx in intercept] if intercept else None
+        self._ssl_ctx_cache: dict[str, ssl.SSLContext] = {}
+        self._ssl_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -154,11 +164,42 @@ class ProxyServer:
                 handler.wfile.write(chunk)
 
     # ------------------------------------------------------------------
+    def _should_intercept(self, host: str) -> bool:
+        if self.issuer is None:
+            return False
+        if self.intercept is None:
+            return True
+        return any(rx.search(host) for rx in self.intercept)
+
+    def _server_ctx(self, host: str) -> ssl.SSLContext:
+        """TLS server context presenting a spoofed cert for ``host``
+        (cached; load_cert_chain needs files, so the pair lands in a
+        private tmpdir once per host)."""
+        with self._ssl_lock:
+            ctx = self._ssl_ctx_cache.get(host)
+            if ctx is not None:
+                return ctx
+        pair = self.issuer.for_host(host)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        with tempfile.TemporaryDirectory(prefix="df-mitm-") as d:
+            cert_f, key_f = f"{d}/c.pem", f"{d}/k.pem"
+            with open(cert_f, "wb") as f:
+                f.write(pair.cert_pem)
+            with open(key_f, "wb") as f:
+                f.write(pair.key_pem)
+            ctx.load_cert_chain(cert_f, key_f)
+        with self._ssl_lock:
+            self._ssl_ctx_cache[host] = ctx
+        return ctx
+
     def _handle_connect(self, handler: BaseHTTPRequestHandler) -> None:
-        """Opaque CONNECT tunnel: relay bytes both ways until either side
-        closes (no TLS interception)."""
+        """CONNECT: TLS-intercept (issuer configured and host matches)
+        or relay the bytes opaquely."""
+        host, _, port_s = handler.path.partition(":")
+        if self._should_intercept(host):
+            self._mitm(handler, host, port_s or "443")
+            return
         try:
-            host, _, port_s = handler.path.partition(":")
             upstream = socket.create_connection((host, int(port_s or 443)), timeout=10)
         except OSError as e:
             handler.send_error(502, f"CONNECT failed: {e}")
@@ -173,6 +214,100 @@ class ProxyServer:
             # the socket carried opaque TLS bytes — never loop back into
             # HTTP parsing on it (a cleartext 400 mid-TLS breaks clients)
             handler.close_connection = True
+
+    def _mitm(self, handler: BaseHTTPRequestHandler, host: str, port: str) -> None:
+        """Terminate the client's TLS with a spoofed cert and serve the
+        decrypted requests through the normal P2P routing (reference
+        proxy.go:268-766 interceptor)."""
+        handler.send_response(200, "Connection Established")
+        handler.end_headers()
+        handler.wfile.flush()
+        outer = self
+        origin = host if port == "443" else f"{host}:{port}"
+        try:
+            tls = self._server_ctx(host).wrap_socket(
+                handler.connection, server_side=True
+            )
+        except (ssl.SSLError, OSError) as e:
+            logger.debug("mitm handshake with %s failed: %s", origin, e)
+            handler.close_connection = True
+            return
+
+        class MitmHandler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                logger.debug("mitm: " + fmt, *args)
+
+            def do_GET(self):
+                self.path = f"https://{origin}{self.path}"
+                outer._handle_get(self)
+
+            def do_HEAD(self):
+                self.path = f"https://{origin}{self.path}"
+                outer._handle_get(self, head=True)
+
+            # write/auth traffic (docker push POSTs, token exchanges)
+            # forwards to the origin untouched — only GETs ride P2P
+            def do_POST(self):
+                outer._forward_upstream(self, origin)
+
+            def do_PUT(self):
+                outer._forward_upstream(self, origin)
+
+            def do_PATCH(self):
+                outer._forward_upstream(self, origin)
+
+            def do_DELETE(self):
+                outer._forward_upstream(self, origin)
+
+        try:
+            MitmHandler(tls, handler.client_address, handler.server)
+        except (ssl.SSLError, OSError, ConnectionError) as e:
+            logger.debug("mitm session with %s ended: %s", origin, e)
+        finally:
+            try:
+                tls.close()
+            except OSError:
+                pass
+            handler.close_connection = True
+
+    def _forward_upstream(self, handler: BaseHTTPRequestHandler, origin: str) -> None:
+        """Non-GET MITM traffic: forward verbatim to the real origin and
+        stream the response back (the opaque-tunnel behavior, minus the
+        tunnel)."""
+        import urllib.error
+        import urllib.request
+
+        from dragonfly2_tpu.client.source import open_url
+
+        length = int(handler.headers.get("Content-Length") or 0)
+        body = handler.rfile.read(length) if length else None
+        headers = {
+            k: v for k, v in handler.headers.items() if k.lower() not in _HOP_HEADERS
+        }
+        req = urllib.request.Request(
+            f"https://{origin}{handler.path}",
+            data=body,
+            headers=headers,
+            method=handler.command,
+        )
+        try:
+            resp = open_url(req, 60.0)
+        except urllib.error.HTTPError as e:
+            resp = e  # upstream status passes through
+        except OSError as e:
+            handler.send_error(502, f"upstream {handler.command} failed: {e}")
+            return
+        with resp:
+            data = resp.read()
+            handler.send_response(resp.status if hasattr(resp, "status") else resp.code)
+            for k, v in resp.headers.items():
+                if k.lower() not in _HOP_HEADERS and k.lower() != "content-length":
+                    handler.send_header(k, v)
+            handler.send_header("Content-Length", str(len(data)))
+            handler.end_headers()
+            handler.wfile.write(data)
 
     @staticmethod
     def _relay(a: socket.socket, b: socket.socket) -> None:

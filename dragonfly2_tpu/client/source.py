@@ -71,6 +71,23 @@ class SourceClient:
         raise NotImplementedError
 
 
+def open_url(req, timeout: float):
+    """urlopen honoring ``DF_ORIGIN_CA``: a PEM bundle ADDED to the
+    system trust store for origins behind a private CA (internal
+    registries) — read per call so it can change at runtime (urllib's
+    default opener freezes its SSL context on first use). Shared by the
+    source clients and the daemon transport's direct route."""
+    import os as _os
+    import ssl as _ssl
+
+    ca = _os.environ.get("DF_ORIGIN_CA")
+    if ca:
+        ctx = _ssl.create_default_context()  # system roots stay trusted
+        ctx.load_verify_locations(cafile=ca)
+        return urllib.request.urlopen(req, timeout=timeout, context=ctx)
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
 class HTTPSourceClient(SourceClient):
     """http(s) origin (reference pkg/source/clients/httpprotocol)."""
 
@@ -80,7 +97,7 @@ class HTTPSourceClient(SourceClient):
     def metadata(self, url: str, headers: dict | None = None) -> Metadata:
         req = urllib.request.Request(url, method="HEAD", headers=headers or {})
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with open_url(req, self.timeout) as resp:
                 h = resp.headers
                 lm = 0.0
                 if h.get("Last-Modified"):
@@ -115,7 +132,7 @@ class HTTPSourceClient(SourceClient):
             hdrs["Range"] = f"bytes={offset}-{end}"
         req = urllib.request.Request(url, headers=hdrs)
         try:
-            resp = urllib.request.urlopen(req, timeout=self.timeout)
+            resp = open_url(req, self.timeout)
         except urllib.error.HTTPError as e:
             raise SourceError(f"GET {url}: {e.code}") from e
         except urllib.error.URLError as e:

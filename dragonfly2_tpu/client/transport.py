@@ -149,7 +149,8 @@ class P2PTransport:
                 url, headers=dict(headers or {}), method="HEAD" if head else "GET"
             )
             try:
-                resp = urllib.request.urlopen(req, timeout=self.timeout)
+                # honors DF_ORIGIN_CA for origins behind a private CA
+                resp = source.open_url(req, self.timeout)
             except urllib.error.HTTPError as e:
                 # 404 from a blob-existence probe is an answer, not a
                 # proxy failure — pass the upstream status through

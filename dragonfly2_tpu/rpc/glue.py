@@ -155,32 +155,63 @@ def serve(
     implementations: dict[str, Any],
     address: str = "127.0.0.1:0",
     max_workers: int = 16,
+    tls: "tuple[bytes, bytes] | None" = None,  # (key_pem, cert_pem)
+    client_ca: bytes | None = None,  # require client certs signed by this CA
 ) -> tuple[grpc.Server, int]:
     """Start a server hosting {service_name: implementation}; returns
-    (server, bound_port)."""
+    (server, bound_port). With ``tls`` the port is TLS-terminated using
+    the issued server cert (utils/issuer); ``client_ca`` additionally
+    enforces mTLS (reference manager-issued certs, pkg/issuer +
+    scheduler.go:179-218)."""
     from concurrent import futures
 
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     for service, impl in implementations.items():
         server.add_generic_rpc_handlers((make_handler(service, impl),))
-    port = server.add_insecure_port(address)
+    if tls is not None:
+        creds = grpc.ssl_server_credentials(
+            [tls],
+            root_certificates=client_ca,
+            require_client_auth=client_ca is not None,
+        )
+        port = server.add_secure_port(address, creds)
+    else:
+        port = server.add_insecure_port(address)
     server.start()
     return server, port
 
 
-def dial(address: str, retries: int = 3, backoff: float = 0.2) -> grpc.Channel:
-    """Insecure channel with connection wait + simple retry-on-dial
-    (reference pkg/rpc client dialing uses retry/backoff interceptors)."""
+def dial(
+    address: str,
+    retries: int = 3,
+    backoff: float = 0.2,
+    tls_ca: bytes | None = None,
+    tls_client: "tuple[bytes, bytes] | None" = None,  # (key_pem, cert_pem)
+    tls_server_name: str | None = None,
+) -> grpc.Channel:
+    """Channel with connection wait + simple retry-on-dial (reference
+    pkg/rpc client dialing uses retry/backoff interceptors). ``tls_ca``
+    switches to TLS verifying the server against that root;
+    ``tls_client`` adds the client pair for mTLS; ``tls_server_name``
+    overrides SNI/verification for certs issued to a different name."""
+    options = [
+        ("grpc.max_send_message_length", 256 * 1024 * 1024),
+        ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+    ]
+    if tls_server_name:
+        options.append(("grpc.ssl_target_name_override", tls_server_name))
     last: Exception | None = None
     for attempt in range(retries):
         try:
-            channel = grpc.insecure_channel(
-                address,
-                options=[
-                    ("grpc.max_send_message_length", 256 * 1024 * 1024),
-                    ("grpc.max_receive_message_length", 256 * 1024 * 1024),
-                ],
-            )
+            if tls_ca is not None:
+                creds = grpc.ssl_channel_credentials(
+                    root_certificates=tls_ca,
+                    private_key=tls_client[0] if tls_client else None,
+                    certificate_chain=tls_client[1] if tls_client else None,
+                )
+                channel = grpc.secure_channel(address, creds, options=options)
+            else:
+                channel = grpc.insecure_channel(address, options=options)
             grpc.channel_ready_future(channel).result(timeout=5)
             return channel
         except Exception as e:  # pragma: no cover - network timing

@@ -1,0 +1,287 @@
+"""Security plane: cert issuing (utils/issuer, reference pkg/issuer),
+TLS/mTLS gRPC (rpc/glue), and the proxy's HTTPS MITM interception
+(reference client/daemon/proxy/proxy.go:268-766 cert spoofing)."""
+
+import os
+import ssl
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dragonfly2_tpu.utils.issuer import CertificateAuthority, SpoofingIssuer
+
+
+# ---------------------------------------------------------------------------
+# issuer
+# ---------------------------------------------------------------------------
+
+
+def test_ca_issues_verifiable_leaf(tmp_path):
+    from cryptography import x509
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    ca = CertificateAuthority()
+    pair = ca.issue("svc.example", hosts=["svc.example", "127.0.0.1"])
+    leaf = x509.load_pem_x509_certificate(pair.cert_pem)
+    root = x509.load_pem_x509_certificate(ca.cert_pem)
+    # signed by the CA
+    root.public_key().verify(
+        leaf.signature, leaf.tbs_certificate_bytes,
+        padding.PKCS1v15(), leaf.signature_hash_algorithm,
+    )
+    sans = leaf.extensions.get_extension_for_class(
+        x509.SubjectAlternativeName
+    ).value
+    assert "svc.example" in sans.get_values_for_type(x509.DNSName)
+
+    # round-trips through PEM load
+    ca2 = CertificateAuthority.load(ca.cert_pem, ca.key_pem)
+    assert ca2.cert_pem == ca.cert_pem
+
+
+def test_spoofing_issuer_caches_per_host():
+    issuer = SpoofingIssuer(CertificateAuthority())
+    a1 = issuer.for_host("registry.example")
+    a2 = issuer.for_host("registry.example")
+    b = issuer.for_host("other.example")
+    assert a1 is a2
+    assert b is not a1
+
+
+# ---------------------------------------------------------------------------
+# TLS gRPC
+# ---------------------------------------------------------------------------
+
+
+def _tls_scheduler(tmp_path, client_ca=None):
+    from dragonfly2_tpu.rpc.glue import serve
+    from dragonfly2_tpu.scheduler import resource as res
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+    from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+    from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
+
+    ca = CertificateAuthority()
+    pair = ca.issue("scheduler.local", hosts=["scheduler.local", "127.0.0.1"])
+    resource = res.Resource()
+    service = SchedulerService(
+        resource, Scheduling(BaseEvaluator(), SchedulingConfig())
+    )
+    server, port = serve(
+        {SERVICE_NAME: service},
+        tls=(pair.key_pem, pair.cert_pem),
+        client_ca=client_ca,
+    )
+    return ca, resource, server, port
+
+
+def test_grpc_tls_roundtrip(tmp_path):
+    from dragonfly2_tpu.rpc import gen  # noqa: F401
+    import common_pb2
+    import scheduler_pb2
+
+    from dragonfly2_tpu.rpc.glue import SCHEDULER_SERVICE, ServiceClient, dial
+
+    ca, resource, server, port = _tls_scheduler(tmp_path)
+    try:
+        ch = dial(
+            f"127.0.0.1:{port}",
+            tls_ca=ca.cert_pem,
+            tls_server_name="scheduler.local",
+        )
+        client = ServiceClient(ch, SCHEDULER_SERVICE)
+        client.AnnounceHost(
+            scheduler_pb2.AnnounceHostRequest(
+                host=common_pb2.HostInfo(id="h-tls", ip="10.0.0.1", port=1)
+            )
+        )
+        assert resource.host_manager.load("h-tls") is not None
+        ch.close()
+
+        # a client trusting a DIFFERENT root must fail the handshake
+        other = CertificateAuthority()
+        with pytest.raises(ConnectionError):
+            dial(
+                f"127.0.0.1:{port}",
+                retries=1,
+                tls_ca=other.cert_pem,
+                tls_server_name="scheduler.local",
+            )
+    finally:
+        server.stop(0)
+
+
+def test_grpc_mtls_requires_client_cert(tmp_path):
+    from dragonfly2_tpu.rpc import gen  # noqa: F401
+    import common_pb2
+    import scheduler_pb2
+
+    from dragonfly2_tpu.rpc.glue import SCHEDULER_SERVICE, ServiceClient, dial
+
+    client_ca = CertificateAuthority("client CA")
+    ca, resource, server, port = _tls_scheduler(tmp_path, client_ca=client_ca.cert_pem)
+    try:
+        # without a client cert the handshake is rejected
+        with pytest.raises(ConnectionError):
+            dial(
+                f"127.0.0.1:{port}",
+                retries=1,
+                tls_ca=ca.cert_pem,
+                tls_server_name="scheduler.local",
+            )
+        # with an issued client pair it works
+        cpair = client_ca.issue("daemon-1")
+        ch = dial(
+            f"127.0.0.1:{port}",
+            tls_ca=ca.cert_pem,
+            tls_client=(cpair.key_pem, cpair.cert_pem),
+            tls_server_name="scheduler.local",
+        )
+        client = ServiceClient(ch, SCHEDULER_SERVICE)
+        client.AnnounceHost(
+            scheduler_pb2.AnnounceHostRequest(
+                host=common_pb2.HostInfo(id="h-mtls", ip="10.0.0.2", port=1)
+            )
+        )
+        assert resource.host_manager.load("h-mtls") is not None
+        ch.close()
+    finally:
+        server.stop(0)
+
+
+# ---------------------------------------------------------------------------
+# HTTPS MITM proxy
+# ---------------------------------------------------------------------------
+
+
+def test_proxy_mitm_intercepts_https(tmp_path, monkeypatch):
+    """An HTTPS origin behind the MITM proxy: the client CONNECTs, gets
+    the spoofed cert (trusting the proxy CA), and the decrypted GET is
+    served through the P2P transport (direct route here) with correct
+    bytes."""
+    from dragonfly2_tpu.client.proxy import ProxyServer
+    from dragonfly2_tpu.client.transport import P2PTransport
+
+    payload = os.urandom(48 * 1024)
+
+    # HTTPS origin with a cert from its own CA
+    origin_ca = CertificateAuthority("origin CA")
+    opair = origin_ca.issue("127.0.0.1", hosts=["127.0.0.1"])
+
+    class Origin(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.send_header("Content-Type", "application/octet-stream")
+            self.end_headers()
+            self.wfile.write(payload)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Origin)
+    octx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ocert = tmp_path / "origin.crt"
+    okey = tmp_path / "origin.key"
+    ocert.write_bytes(opair.cert_pem)
+    okey.write_bytes(opair.key_pem)
+    octx.load_cert_chain(str(ocert), str(okey))
+    httpd.socket = octx.wrap_socket(httpd.socket, server_side=True)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    origin_port = httpd.server_address[1]
+
+    # upstream fetches must trust the origin's CA — DF_ORIGIN_CA is the
+    # product knob for origins behind a private CA
+    ca_file = tmp_path / "origin-ca.crt"
+    ca_file.write_bytes(origin_ca.cert_pem)
+    monkeypatch.setenv("DF_ORIGIN_CA", str(ca_file))
+
+    # MITM proxy with its own spoofing CA
+    proxy_ca = CertificateAuthority("proxy CA")
+    proxy = ProxyServer(
+        P2PTransport(None, rules=[]),  # no rules -> direct route
+        issuer=SpoofingIssuer(proxy_ca),
+    )
+    proxy.start()
+    try:
+        # client trusts the PROXY CA (the spoofed leaf must verify)
+        proxy_ca_file = tmp_path / "proxy-ca.crt"
+        proxy_ca_file.write_bytes(proxy_ca.cert_pem)
+        client_ctx = ssl.create_default_context(cafile=str(proxy_ca_file))
+        opener = urllib.request.build_opener(
+            urllib.request.ProxyHandler(
+                {"https": f"http://127.0.0.1:{proxy.port}"}
+            ),
+            urllib.request.HTTPSHandler(context=client_ctx),
+        )
+        with opener.open(
+            f"https://127.0.0.1:{origin_port}/blob/layer1", timeout=15
+        ) as resp:
+            body = resp.read()
+            assert resp.headers.get("X-Dragonfly-Via-P2P") is not None
+        assert body == payload
+    finally:
+        proxy.stop()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_mitm_forwards_non_get_methods(tmp_path, monkeypatch):
+    """docker-push-style POST through an intercepted host must reach the
+    origin, not die with 501."""
+    from dragonfly2_tpu.client.proxy import ProxyServer
+    from dragonfly2_tpu.client.transport import P2PTransport
+
+    origin_ca = CertificateAuthority("origin CA")
+    opair = origin_ca.issue("127.0.0.1", hosts=["127.0.0.1"])
+    got = {}
+
+    class Origin(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            got["body"] = self.rfile.read(n)
+            got["path"] = self.path
+            self.send_response(202)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Origin)
+    octx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    (tmp_path / "o.crt").write_bytes(opair.cert_pem)
+    (tmp_path / "o.key").write_bytes(opair.key_pem)
+    octx.load_cert_chain(str(tmp_path / "o.crt"), str(tmp_path / "o.key"))
+    httpd.socket = octx.wrap_socket(httpd.socket, server_side=True)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    (tmp_path / "oca.crt").write_bytes(origin_ca.cert_pem)
+    monkeypatch.setenv("DF_ORIGIN_CA", str(tmp_path / "oca.crt"))
+
+    proxy_ca = CertificateAuthority("proxy CA")
+    proxy = ProxyServer(P2PTransport(None, rules=[]), issuer=SpoofingIssuer(proxy_ca))
+    proxy.start()
+    try:
+        (tmp_path / "pca.crt").write_bytes(proxy_ca.cert_pem)
+        ctx = ssl.create_default_context(cafile=str(tmp_path / "pca.crt"))
+        opener = urllib.request.build_opener(
+            urllib.request.ProxyHandler({"https": f"http://127.0.0.1:{proxy.port}"}),
+            urllib.request.HTTPSHandler(context=ctx),
+        )
+        req = urllib.request.Request(
+            f"https://127.0.0.1:{httpd.server_address[1]}/v2/blobs/uploads/",
+            data=b"layerdata",
+            method="POST",
+        )
+        with opener.open(req, timeout=15) as resp:
+            assert resp.status == 202
+            assert resp.read() == b"ok"
+        assert got["body"] == b"layerdata"
+        assert got["path"] == "/v2/blobs/uploads/"
+    finally:
+        proxy.stop()
+        httpd.shutdown()
+        httpd.server_close()
