@@ -85,6 +85,9 @@ class DaemonConfig:
     # global download budget in bytes/s shared across tasks (cross-task
     # sampling traffic shaper, reference traffic_shaper.go); 0 = off
     total_download_rate: float = 0.0
+    # client-side root for TLS-enabled schedulers
+    scheduler_tls_ca_file: str = ""
+    scheduler_tls_server_name: str = ""
 
 
 def _apply_stat_overrides(stats: "hostinfo.HostStats", overrides: dict) -> None:
@@ -135,7 +138,12 @@ class Daemon:
     def start(self) -> None:
         self.upload.start()
         addresses = [a for a in self.cfg.scheduler_address.split(",") if a.strip()]
-        self._selector = glue.SchedulerSelector(addresses)
+        self._selector = glue.SchedulerSelector(
+            addresses,
+            dial_kwargs=glue.dial_tls_args(
+                self.cfg.scheduler_tls_ca_file, self.cfg.scheduler_tls_server_name
+            ),
+        )
         self._scheduler = self._selector.primary()
 
         from dragonfly2_tpu.client.piece_manager import TrafficShaper

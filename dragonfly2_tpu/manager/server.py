@@ -17,6 +17,13 @@ from dragonfly2_tpu.utils import dflog
 logger = dflog.get("manager.server")
 
 
+def _tls_args(cfg):
+    return glue.serve_tls_args(
+        cfg.tls_cert_file, cfg.tls_key_file, cfg.tls_client_ca_file
+    )
+
+
+
 @dataclass
 class ManagerServerConfig:
     data_dir: str = "/tmp/dragonfly2-manager"
@@ -30,6 +37,10 @@ class ManagerServerConfig:
     # Prometheus /metrics endpoint (reference :8000): -1 = disabled
     metrics_port: int = -1
     metrics_host: str = "127.0.0.1"
+    # gRPC TLS: PEM file paths; tls_client_ca_file enforces mTLS
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
+    tls_client_ca_file: str = ""
 
 
 class ManagerServer:
@@ -47,7 +58,9 @@ class ManagerServer:
     def serve(self) -> str:
         from dragonfly2_tpu.manager.service import SERVICE_NAME
 
-        self._grpc, port = glue.serve({SERVICE_NAME: self.service}, self.cfg.listen)
+        self._grpc, port = glue.serve(
+            {SERVICE_NAME: self.service}, self.cfg.listen, **_tls_args(self.cfg)
+        )
         host = self.cfg.listen.rsplit(":", 1)[0]
         addr = f"{host}:{port}"
         if self.cfg.rest_port >= 0:

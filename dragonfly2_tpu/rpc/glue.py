@@ -266,6 +266,42 @@ class ConsistentHashRing:
         return self._ring[i][1]
 
 
+def serve_tls_args(
+    cert_file: str = "", key_file: str = "", client_ca_file: str = ""
+) -> dict:
+    """PEM file paths → glue.serve TLS kwargs, validating that the
+    config is all-or-nothing (a partially-set TLS config must fail
+    loudly, never silently serve plaintext)."""
+    if not (cert_file or key_file or client_ca_file):
+        return {}
+    if not (cert_file and key_file):
+        raise ValueError(
+            "TLS config incomplete: tls_cert_file and tls_key_file must both"
+            " be set (tls_client_ca_file is optional, for mTLS)"
+        )
+    with open(key_file, "rb") as f:
+        key = f.read()
+    with open(cert_file, "rb") as f:
+        cert = f.read()
+    client_ca = None
+    if client_ca_file:
+        with open(client_ca_file, "rb") as f:
+            client_ca = f.read()
+    return {"tls": (key, cert), "client_ca": client_ca}
+
+
+def dial_tls_args(ca_file: str = "", server_name: str = "") -> dict:
+    """CA file path → glue.dial TLS kwargs (client side)."""
+    if not ca_file:
+        return {}
+    with open(ca_file, "rb") as f:
+        ca = f.read()
+    out = {"tls_ca": ca}
+    if server_name:
+        out["tls_server_name"] = server_name
+    return out
+
+
 class SchedulerSelector:
     """Multi-scheduler client set with consistent-hash task affinity
     (reference pkg/balancer/consistent_hashing.go wired as the gRPC
@@ -279,11 +315,17 @@ class SchedulerSelector:
 
     FAIL_COOLDOWN = 5.0  # seconds before re-dialing a failed address
 
-    def __init__(self, addresses: list[str], service: str = SCHEDULER_SERVICE):
+    def __init__(
+        self,
+        addresses: list[str],
+        service: str = SCHEDULER_SERVICE,
+        dial_kwargs: dict | None = None,
+    ):
         self.addresses = [a.strip() for a in addresses if a.strip()]
         if not self.addresses:
             raise ValueError("no scheduler addresses")
         self.service = service
+        self.dial_kwargs = dial_kwargs or {}
         self.ring = ConsistentHashRing(self.addresses)
         self._channels: dict[str, grpc.Channel] = {}
         self._clients: dict[str, ServiceClient] = {}
@@ -301,7 +343,7 @@ class SchedulerSelector:
         # dial OUTSIDE the lock — a dead scheduler's connect timeout must
         # not stall task routing to healthy, already-cached schedulers
         try:
-            channel = dial(addr, retries=1)
+            channel = dial(addr, retries=1, **self.dial_kwargs)
         except Exception:
             with self._lock:
                 self._fail_until[addr] = time.monotonic() + self.FAIL_COOLDOWN

@@ -59,6 +59,15 @@ class SchedulerServerConfig:
     metrics_port: int = -1
     # df_plugin_*.py modules loaded at startup (reference internal/dfplugin)
     plugin_dir: str = ""
+    # gRPC TLS: PEM file paths; tls_client_ca_file enforces mTLS
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
+    tls_client_ca_file: str = ""
+    # client-side roots for upstream dials (TLS-enabled manager/trainer)
+    manager_tls_ca_file: str = ""
+    manager_tls_server_name: str = ""
+    trainer_tls_ca_file: str = ""
+    trainer_tls_server_name: str = ""
     metrics_host: str = "127.0.0.1"
 
 
@@ -98,12 +107,22 @@ class SchedulerServer:
         self._trainer_channel = None
         self.manager_client = None
         if config.manager_address:
-            self._manager_channel = glue.dial(config.manager_address)
+            self._manager_channel = glue.dial(
+                config.manager_address,
+                **glue.dial_tls_args(
+                    config.manager_tls_ca_file, config.manager_tls_server_name
+                ),
+            )
             from dragonfly2_tpu.manager.service import ManagerGrpcClientAdapter
 
             self.manager_client = ManagerGrpcClientAdapter(self._manager_channel)
         if config.trainer_address:
-            self._trainer_channel = glue.dial(config.trainer_address)
+            self._trainer_channel = glue.dial(
+                config.trainer_address,
+                **glue.dial_tls_args(
+                    config.trainer_tls_ca_file, config.trainer_tls_server_name
+                ),
+            )
 
         # evaluator (+ live model refresh when the manager serves models)
         self.model_refresher = None
@@ -176,7 +195,13 @@ class SchedulerServer:
     # ------------------------------------------------------------------
     def serve(self) -> str:
         cfg = self.cfg
-        self._grpc, self.port = glue.serve({SERVICE_NAME: self.service}, cfg.listen)
+        self._grpc, self.port = glue.serve(
+            {SERVICE_NAME: self.service},
+            cfg.listen,
+            **glue.serve_tls_args(
+                cfg.tls_cert_file, cfg.tls_key_file, cfg.tls_client_ca_file
+            ),
+        )
         addr = f"{cfg.listen.rsplit(':', 1)[0]}:{self.port}"
         if self.manager_client is not None:
             self._register_with_manager()

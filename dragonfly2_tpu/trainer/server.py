@@ -35,6 +35,13 @@ class TrainerServerConfig:
     # Prometheus /metrics endpoint (reference trainer :8000): -1 = disabled
     metrics_port: int = -1
     metrics_host: str = "127.0.0.1"
+    # gRPC TLS: PEM file paths; tls_client_ca_file enforces mTLS
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
+    tls_client_ca_file: str = ""
+    # client-side root for a TLS-enabled manager
+    manager_tls_ca_file: str = ""
+    manager_tls_server_name: str = ""
 
 
 class TrainerServer:
@@ -46,7 +53,12 @@ class TrainerServer:
         self._manager_channel = None
         manager_client = None
         if config.manager_address:
-            self._manager_channel = glue.dial(config.manager_address)
+            self._manager_channel = glue.dial(
+                config.manager_address,
+                **glue.dial_tls_args(
+                    config.manager_tls_ca_file, config.manager_tls_server_name
+                ),
+            )
             from dragonfly2_tpu.manager.service import ManagerGrpcClientAdapter
 
             manager_client = ManagerGrpcClientAdapter(self._manager_channel)
@@ -73,7 +85,13 @@ class TrainerServer:
         self._grpc = None
 
     def serve(self) -> str:
-        self._grpc, port = glue.serve({SERVICE_NAME: self.service}, self.cfg.listen)
+        self._grpc, port = glue.serve(
+            {SERVICE_NAME: self.service},
+            self.cfg.listen,
+            **glue.serve_tls_args(
+                self.cfg.tls_cert_file, self.cfg.tls_key_file, self.cfg.tls_client_ca_file
+            ),
+        )
         addr = f"{self.cfg.listen.rsplit(':', 1)[0]}:{port}"
         if self.cfg.metrics_port >= 0:
             from dragonfly2_tpu.trainer import metrics  # noqa: F401

@@ -285,3 +285,99 @@ def test_mitm_forwards_non_get_methods(tmp_path, monkeypatch):
         proxy.stop()
         httpd.shutdown()
         httpd.server_close()
+
+
+def test_scheduler_server_tls_via_config(tmp_path):
+    """The scheduler ASSEMBLY serves TLS from config file paths and a
+    TLS client (trusting the CA) can announce."""
+    from dragonfly2_tpu.rpc import gen  # noqa: F401
+    import common_pb2
+    import scheduler_pb2
+
+    from dragonfly2_tpu.rpc.glue import SCHEDULER_SERVICE, ServiceClient, dial
+    from dragonfly2_tpu.scheduler.server import SchedulerServer, SchedulerServerConfig
+
+    ca = CertificateAuthority()
+    pair = ca.issue("scheduler.local", hosts=["scheduler.local", "127.0.0.1"])
+    cert_f = tmp_path / "s.crt"
+    key_f = tmp_path / "s.key"
+    cert_f.write_bytes(pair.cert_pem)
+    key_f.write_bytes(pair.key_pem)
+
+    server = SchedulerServer(
+        SchedulerServerConfig(
+            data_dir=str(tmp_path / "data"),
+            tls_cert_file=str(cert_f),
+            tls_key_file=str(key_f),
+        )
+    )
+    addr = server.serve()
+    try:
+        ch = dial(addr, tls_ca=ca.cert_pem, tls_server_name="scheduler.local")
+        client = ServiceClient(ch, SCHEDULER_SERVICE)
+        client.AnnounceHost(
+            scheduler_pb2.AnnounceHostRequest(
+                host=common_pb2.HostInfo(id="h-cfg-tls", ip="10.0.0.3", port=1)
+            )
+        )
+        assert server.resource.host_manager.load("h-cfg-tls") is not None
+        ch.close()
+    finally:
+        server.stop()
+
+
+def test_daemon_dials_tls_scheduler_via_config(tmp_path):
+    """Config-only TLS cluster: scheduler serves TLS, the daemon trusts
+    the CA via scheduler_tls_ca_file, and a real download completes."""
+    from dragonfly2_tpu.client import dfget
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.scheduler.server import SchedulerServer, SchedulerServerConfig
+
+    ca = CertificateAuthority()
+    pair = ca.issue("scheduler.local", hosts=["scheduler.local", "127.0.0.1"])
+    for name, blob in (("s.crt", pair.cert_pem), ("s.key", pair.key_pem),
+                       ("ca.crt", ca.cert_pem)):
+        (tmp_path / name).write_bytes(blob)
+
+    server = SchedulerServer(
+        SchedulerServerConfig(
+            data_dir=str(tmp_path / "sched"),
+            tls_cert_file=str(tmp_path / "s.crt"),
+            tls_key_file=str(tmp_path / "s.key"),
+            retry_interval=0.0,
+        )
+    )
+    addr = server.serve()
+    d = Daemon(
+        DaemonConfig(
+            data_dir=str(tmp_path / "daemon"),
+            scheduler_address=addr,
+            scheduler_tls_ca_file=str(tmp_path / "ca.crt"),
+            scheduler_tls_server_name="scheduler.local",
+            hostname="host-tls",
+            piece_length=32 * 1024,
+            announce_interval=60.0,
+        )
+    )
+    d.start()
+    try:
+        payload = os.urandom(64 * 1024)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        out = tmp_path / "out.bin"
+        dfget.download(f"127.0.0.1:{d.port}", f"file://{origin}", str(out))
+        assert out.read_bytes() == payload
+        assert server.resource.host_manager.load(d.host_id) is not None
+    finally:
+        d.stop()
+        server.stop()
+
+
+def test_partial_tls_config_fails_loudly():
+    from dragonfly2_tpu.rpc.glue import serve_tls_args
+
+    with pytest.raises(ValueError, match="incomplete"):
+        serve_tls_args(client_ca_file="/tmp/ca.pem")
+    with pytest.raises(ValueError, match="incomplete"):
+        serve_tls_args(cert_file="/tmp/c.pem")
+    assert serve_tls_args() == {}
