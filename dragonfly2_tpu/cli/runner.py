@@ -75,6 +75,12 @@ def main_with_config(name: str, build, argv=None) -> int:
 
         jax.config.update("jax_platforms", platform)
 
+    # multi-host slice/DCN job: bring up jax.distributed before any
+    # device query (no-op without DF_JAX_COORDINATOR)
+    from dragonfly2_tpu.parallel.distributed import ensure_initialized
+
+    ensure_initialized()
+
     import yaml
 
     overrides = {}

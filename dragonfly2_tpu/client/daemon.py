@@ -85,9 +85,11 @@ class DaemonConfig:
     # global download budget in bytes/s shared across tasks (cross-task
     # sampling traffic shaper, reference traffic_shaper.go); 0 = off
     total_download_rate: float = 0.0
-    # client-side root for TLS-enabled schedulers
+    # client-side root (and optional mTLS client pair) for schedulers
     scheduler_tls_ca_file: str = ""
     scheduler_tls_server_name: str = ""
+    scheduler_tls_client_cert_file: str = ""
+    scheduler_tls_client_key_file: str = ""
 
 
 def _apply_stat_overrides(stats: "hostinfo.HostStats", overrides: dict) -> None:
@@ -141,7 +143,10 @@ class Daemon:
         self._selector = glue.SchedulerSelector(
             addresses,
             dial_kwargs=glue.dial_tls_args(
-                self.cfg.scheduler_tls_ca_file, self.cfg.scheduler_tls_server_name
+                self.cfg.scheduler_tls_ca_file,
+                self.cfg.scheduler_tls_server_name,
+                self.cfg.scheduler_tls_client_cert_file,
+                self.cfg.scheduler_tls_client_key_file,
             ),
         )
         self._scheduler = self._selector.primary()

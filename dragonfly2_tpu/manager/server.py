@@ -17,12 +17,6 @@ from dragonfly2_tpu.utils import dflog
 logger = dflog.get("manager.server")
 
 
-def _tls_args(cfg):
-    return glue.serve_tls_args(
-        cfg.tls_cert_file, cfg.tls_key_file, cfg.tls_client_ca_file
-    )
-
-
 
 @dataclass
 class ManagerServerConfig:
@@ -59,7 +53,11 @@ class ManagerServer:
         from dragonfly2_tpu.manager.service import SERVICE_NAME
 
         self._grpc, port = glue.serve(
-            {SERVICE_NAME: self.service}, self.cfg.listen, **_tls_args(self.cfg)
+            {SERVICE_NAME: self.service},
+            self.cfg.listen,
+            **glue.serve_tls_args(
+                self.cfg.tls_cert_file, self.cfg.tls_key_file, self.cfg.tls_client_ca_file
+            ),
         )
         host = self.cfg.listen.rsplit(":", 1)[0]
         addr = f"{host}:{port}"

@@ -1,0 +1,84 @@
+"""Multi-host distributed runtime bring-up (the jax.distributed analog
+of the reference's NCCL/MPI-style multi-node backend — SURVEY §5.8: the
+compute plane scales with XLA collectives over ICI within a slice and
+DCN across slices; the service plane stays on gRPC).
+
+One trainer process per host of a multi-host slice (or per slice of a
+multi-slice DCN job) calls ``ensure_initialized`` before any jax use;
+afterwards ``jax.devices()`` spans every host and the same
+``Mesh``-based code (trainer/train.py, models/gnn_sharded.py,
+parallel/fedavg.py) runs unchanged — mesh axes laid out so dp/gp ride
+ICI and the ``fed`` axis maps to DCN.
+
+Config comes from the environment (set by the launcher / k8s operator):
+    DF_JAX_COORDINATOR   host:port of process 0
+    DF_JAX_NUM_PROCESSES total process count
+    DF_JAX_PROCESS_ID    this process's index
+or explicit arguments. No-op when unset (single-host dev boxes, tests,
+the driver's virtual-device runs).
+"""
+
+from __future__ import annotations
+
+import os
+
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("parallel.distributed")
+
+_initialized = False
+
+
+def ensure_initialized(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize jax.distributed once per process; True when the
+    multi-host runtime is up, False when running single-host. Reads
+    DF_JAX_* env for unset arguments; call before the first jax device
+    query."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get("DF_JAX_COORDINATOR")
+    if not coordinator_address:
+        return False
+    num_processes = num_processes or int(os.environ.get("DF_JAX_NUM_PROCESSES", "0"))
+    process_id = (
+        process_id
+        if process_id is not None
+        else int(os.environ.get("DF_JAX_PROCESS_ID", "-1"))
+    )
+    if num_processes <= 0 or process_id < 0:
+        raise ValueError(
+            "multi-host init needs DF_JAX_NUM_PROCESSES and DF_JAX_PROCESS_ID"
+            f" (got {num_processes}, {process_id})"
+        )
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "jax.distributed up: process %d/%d via %s — %d global devices",
+        process_id,
+        num_processes,
+        coordinator_address,
+        jax.device_count(),
+    )
+    return True
+
+
+def global_mesh(**axes: int):
+    """Mesh over EVERY device in the (possibly multi-host) job. Axis
+    sizes follow parallel.mesh.make_mesh semantics (one axis may be -1).
+    Lay out so the fastest-varying axes are intra-host (ICI) and the
+    slowest (e.g. ``fed``) spans hosts (DCN) — jax device order already
+    groups by process."""
+    from dragonfly2_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(**axes)

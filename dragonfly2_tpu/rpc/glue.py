@@ -290,15 +290,33 @@ def serve_tls_args(
     return {"tls": (key, cert), "client_ca": client_ca}
 
 
-def dial_tls_args(ca_file: str = "", server_name: str = "") -> dict:
-    """CA file path → glue.dial TLS kwargs (client side)."""
+def dial_tls_args(
+    ca_file: str = "",
+    server_name: str = "",
+    client_cert_file: str = "",
+    client_key_file: str = "",
+) -> dict:
+    """CA (and optional client pair, for mTLS servers) file paths →
+    glue.dial TLS kwargs."""
     if not ca_file:
+        if client_cert_file or client_key_file:
+            raise ValueError("client cert/key need the server CA file too")
         return {}
     with open(ca_file, "rb") as f:
         ca = f.read()
     out = {"tls_ca": ca}
     if server_name:
         out["tls_server_name"] = server_name
+    if client_cert_file or client_key_file:
+        if not (client_cert_file and client_key_file):
+            raise ValueError(
+                "mTLS client config incomplete: cert and key files must both be set"
+            )
+        with open(client_key_file, "rb") as f:
+            key = f.read()
+        with open(client_cert_file, "rb") as f:
+            cert = f.read()
+        out["tls_client"] = (key, cert)
     return out
 
 

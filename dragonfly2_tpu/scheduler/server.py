@@ -63,11 +63,15 @@ class SchedulerServerConfig:
     tls_cert_file: str = ""
     tls_key_file: str = ""
     tls_client_ca_file: str = ""
-    # client-side roots for upstream dials (TLS-enabled manager/trainer)
+    # client-side roots (and optional mTLS client pair) for upstream dials
     manager_tls_ca_file: str = ""
     manager_tls_server_name: str = ""
+    manager_tls_client_cert_file: str = ""
+    manager_tls_client_key_file: str = ""
     trainer_tls_ca_file: str = ""
     trainer_tls_server_name: str = ""
+    trainer_tls_client_cert_file: str = ""
+    trainer_tls_client_key_file: str = ""
     metrics_host: str = "127.0.0.1"
 
 
@@ -110,7 +114,10 @@ class SchedulerServer:
             self._manager_channel = glue.dial(
                 config.manager_address,
                 **glue.dial_tls_args(
-                    config.manager_tls_ca_file, config.manager_tls_server_name
+                    config.manager_tls_ca_file,
+                    config.manager_tls_server_name,
+                    config.manager_tls_client_cert_file,
+                    config.manager_tls_client_key_file,
                 ),
             )
             from dragonfly2_tpu.manager.service import ManagerGrpcClientAdapter
@@ -120,7 +127,10 @@ class SchedulerServer:
             self._trainer_channel = glue.dial(
                 config.trainer_address,
                 **glue.dial_tls_args(
-                    config.trainer_tls_ca_file, config.trainer_tls_server_name
+                    config.trainer_tls_ca_file,
+                    config.trainer_tls_server_name,
+                    config.trainer_tls_client_cert_file,
+                    config.trainer_tls_client_key_file,
                 ),
             )
 

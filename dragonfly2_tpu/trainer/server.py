@@ -39,9 +39,11 @@ class TrainerServerConfig:
     tls_cert_file: str = ""
     tls_key_file: str = ""
     tls_client_ca_file: str = ""
-    # client-side root for a TLS-enabled manager
+    # client-side root (and optional mTLS client pair) for the manager
     manager_tls_ca_file: str = ""
     manager_tls_server_name: str = ""
+    manager_tls_client_cert_file: str = ""
+    manager_tls_client_key_file: str = ""
 
 
 class TrainerServer:
@@ -56,7 +58,10 @@ class TrainerServer:
             self._manager_channel = glue.dial(
                 config.manager_address,
                 **glue.dial_tls_args(
-                    config.manager_tls_ca_file, config.manager_tls_server_name
+                    config.manager_tls_ca_file,
+                    config.manager_tls_server_name,
+                    config.manager_tls_client_cert_file,
+                    config.manager_tls_client_key_file,
                 ),
             )
             from dragonfly2_tpu.manager.service import ManagerGrpcClientAdapter

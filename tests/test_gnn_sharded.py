@@ -85,3 +85,19 @@ def test_pad_graph_even_shards(graph):
     assert (nbrs[graph.num_nodes :] >= graph.num_nodes).all()
     assert w[len(graph.edge_src) :].sum() == 0
     assert (mask[graph.num_nodes :] == 0).all()
+
+
+def test_distributed_init_noop_without_coordinator(monkeypatch):
+    """Single-host boxes and CI: ensure_initialized is a clean no-op
+    (the multi-host path needs a coordinator only a launcher provides)."""
+    import dragonfly2_tpu.parallel.distributed as D
+
+    monkeypatch.delenv("DF_JAX_COORDINATOR", raising=False)
+    assert D.ensure_initialized() is False
+
+    monkeypatch.setenv("DF_JAX_COORDINATOR", "10.0.0.1:1234")
+    monkeypatch.delenv("DF_JAX_NUM_PROCESSES", raising=False)
+    import pytest
+
+    with pytest.raises(ValueError, match="DF_JAX_NUM_PROCESSES"):
+        D.ensure_initialized()

@@ -381,3 +381,57 @@ def test_partial_tls_config_fails_loudly():
     with pytest.raises(ValueError, match="incomplete"):
         serve_tls_args(cert_file="/tmp/c.pem")
     assert serve_tls_args() == {}
+
+
+def test_mtls_cluster_via_config(tmp_path):
+    """mTLS end-to-end through config: the scheduler requires client
+    certs; the daemon presents an issued pair and completes a download."""
+    from dragonfly2_tpu.client import dfget
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.scheduler.server import SchedulerServer, SchedulerServerConfig
+
+    server_ca = CertificateAuthority("server CA")
+    client_ca = CertificateAuthority("client CA")
+    spair = server_ca.issue("scheduler.local", hosts=["scheduler.local", "127.0.0.1"])
+    cpair = client_ca.issue("daemon-mtls")
+    files = {
+        "s.crt": spair.cert_pem, "s.key": spair.key_pem,
+        "server-ca.crt": server_ca.cert_pem, "client-ca.crt": client_ca.cert_pem,
+        "c.crt": cpair.cert_pem, "c.key": cpair.key_pem,
+    }
+    for name, blob in files.items():
+        (tmp_path / name).write_bytes(blob)
+
+    server = SchedulerServer(
+        SchedulerServerConfig(
+            data_dir=str(tmp_path / "sched"),
+            tls_cert_file=str(tmp_path / "s.crt"),
+            tls_key_file=str(tmp_path / "s.key"),
+            tls_client_ca_file=str(tmp_path / "client-ca.crt"),
+        )
+    )
+    addr = server.serve()
+    d = Daemon(
+        DaemonConfig(
+            data_dir=str(tmp_path / "daemon"),
+            scheduler_address=addr,
+            scheduler_tls_ca_file=str(tmp_path / "server-ca.crt"),
+            scheduler_tls_server_name="scheduler.local",
+            scheduler_tls_client_cert_file=str(tmp_path / "c.crt"),
+            scheduler_tls_client_key_file=str(tmp_path / "c.key"),
+            hostname="host-mtls",
+            piece_length=32 * 1024,
+            announce_interval=60.0,
+        )
+    )
+    d.start()
+    try:
+        payload = os.urandom(48 * 1024)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        out = tmp_path / "out.bin"
+        dfget.download(f"127.0.0.1:{d.port}", f"file://{origin}", str(out))
+        assert out.read_bytes() == payload
+    finally:
+        d.stop()
+        server.stop()
