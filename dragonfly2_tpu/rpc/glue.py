@@ -22,11 +22,13 @@ import common_pb2  # noqa: E402
 import dfdaemon_pb2  # noqa: E402
 import manager_pb2  # noqa: E402
 import scheduler_pb2  # noqa: E402
+import scheduler_v1_pb2  # noqa: E402
 import trainer_pb2  # noqa: E402
 
 # Canonical service names — every client/server refers to these, so a
 # rename can never leave a client dialing a service no server registers.
 SCHEDULER_SERVICE = "dragonfly2_tpu.scheduler.Scheduler"
+SCHEDULER_V1_SERVICE = "dragonfly2_tpu.scheduler.v1.SchedulerV1"
 TRAINER_SERVICE = "dragonfly2_tpu.trainer.Trainer"
 MANAGER_SERVICE = "dragonfly2_tpu.manager.Manager"
 DFDAEMON_SERVICE = "dragonfly2_tpu.dfdaemon.Dfdaemon"
@@ -61,6 +63,22 @@ SERVICES: dict[str, dict[str, Method]] = {
             STREAM_STREAM,
             scheduler_pb2.SyncProbesRequest,
             scheduler_pb2.SyncProbesResponse,
+        ),
+    },
+    SCHEDULER_V1_SERVICE: {
+        "RegisterPeerTask": Method(
+            UNARY, scheduler_v1_pb2.PeerTaskRequest, scheduler_v1_pb2.RegisterResult
+        ),
+        "ReportPieceResult": Method(
+            STREAM_STREAM, scheduler_v1_pb2.PieceResult, scheduler_v1_pb2.PeerPacket
+        ),
+        "ReportPeerResult": Method(
+            UNARY, scheduler_v1_pb2.PeerResult, scheduler_v1_pb2.Empty
+        ),
+        "StatTask": Method(UNARY, scheduler_v1_pb2.StatTaskRequest, scheduler_v1_pb2.Task),
+        "LeaveTask": Method(UNARY, scheduler_v1_pb2.PeerTarget, scheduler_v1_pb2.Empty),
+        "LeaveHost": Method(
+            UNARY, scheduler_v1_pb2.LeaveHostRequest, scheduler_v1_pb2.Empty
         ),
     },
     TRAINER_SERVICE: {

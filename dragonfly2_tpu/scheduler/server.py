@@ -171,6 +171,14 @@ class SchedulerServer:
             storage=self.storage,
             networktopology=self.networktopology,
         )
+        # v1 wire shape bound alongside v2, sharing domain state
+        # (reference scheduler/rpcserver/rpcserver.go:31-44 binds both
+        # generations into one grpc.Server)
+        from dragonfly2_tpu.scheduler.service_v1 import SchedulerServiceV1
+
+        self.service_v1 = SchedulerServiceV1(
+            self.resource, self.scheduling, storage=self.storage
+        )
 
         self.announcer = Announcer(
             self.storage,
@@ -205,8 +213,10 @@ class SchedulerServer:
     # ------------------------------------------------------------------
     def serve(self) -> str:
         cfg = self.cfg
+        from dragonfly2_tpu.scheduler.service_v1 import SCHEDULER_V1_SERVICE
+
         self._grpc, self.port = glue.serve(
-            {SERVICE_NAME: self.service},
+            {SERVICE_NAME: self.service, SCHEDULER_V1_SERVICE: self.service_v1},
             cfg.listen,
             **glue.serve_tls_args(
                 cfg.tls_cert_file, cfg.tls_key_file, cfg.tls_client_ca_file

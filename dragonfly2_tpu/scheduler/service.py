@@ -139,6 +139,37 @@ def _host_from_info(info: common_pb2.HostInfo) -> res.Host:
     return h
 
 
+def load_or_create_task(
+    resource: res.Resource,
+    url: str,
+    meta: URLMeta,
+    task_id: str,
+    wire_task_type: int,
+) -> res.Task:
+    """Shared task resolution for both wire generations: load by id or
+    create with meta-derived attributes (reference storeTask,
+    service_v1.go:919-1004 / service_v2.go handleRegisterPeerRequest)."""
+    task = resource.task_manager.load(task_id)
+    if task is not None:
+        return task
+    task_type = {
+        common_pb2.TASK_TYPE_DFSTORE: res.TaskType.DFSTORE,
+        common_pb2.TASK_TYPE_DFCACHE: res.TaskType.DFCACHE,
+    }.get(wire_task_type, res.TaskType.STANDARD)
+    task = res.Task(
+        task_id,
+        url=url,
+        task_type=task_type,
+        digest=meta.digest,
+        tag=meta.tag,
+        application=meta.application,
+        filters=[f for f in meta.filter.split("&") if f] if meta.filter else [],
+        url_range=meta.range,
+    )
+    resource.task_manager.store(task)
+    return task
+
+
 class SchedulerService:
     def __init__(
         self,
@@ -260,19 +291,7 @@ class SchedulerService:
             application=reg.url_meta.application,
         )
         task_id = reg.task_id or task_id_v1(reg.url, meta)
-        task = self.resource.task_manager.load(task_id)
-        if task is None:
-            task_type = {
-                common_pb2.TASK_TYPE_DFSTORE: res.TaskType.DFSTORE,
-                common_pb2.TASK_TYPE_DFCACHE: res.TaskType.DFCACHE,
-            }.get(reg.task_type, res.TaskType.STANDARD)
-            task = res.Task(
-                task_id, url=reg.url, task_type=task_type,
-                digest=meta.digest, tag=meta.tag, application=meta.application,
-                filters=[f for f in meta.filter.split("&") if f] if meta.filter else [],
-                url_range=meta.range,
-            )
-            self.resource.task_manager.store(task)
+        task = load_or_create_task(self.resource, reg.url, meta, task_id, reg.task_type)
 
         peer = res.Peer(
             reg.peer_id, task, host, tag=meta.tag, application=meta.application
@@ -436,22 +455,15 @@ class SchedulerService:
             application=request.url_meta.application,
         )
         task_id = request.task_id or task_id_v1(request.url, meta)
-        task = self.resource.task_manager.load(task_id)
-        if task is None:
-            task_type = {
-                common_pb2.TASK_TYPE_DFSTORE: res.TaskType.DFSTORE,
-                common_pb2.TASK_TYPE_DFCACHE: res.TaskType.DFCACHE,
-            }.get(request.task_type, res.TaskType.STANDARD)
-            task = res.Task(
-                task_id, url=request.url, task_type=task_type,
-                digest=meta.digest, tag=meta.tag, application=meta.application,
-            )
-            # a fresh task adopts the announced grid outright —
-            # Task.piece_length defaults to a truthy 4 MiB, so a
-            # "not set" check can never fire here
-            if request.piece_length:
-                task.piece_length = request.piece_length
-            self.resource.task_manager.store(task)
+        fresh = self.resource.task_manager.load(task_id) is None
+        task = load_or_create_task(
+            self.resource, request.url, meta, task_id, request.task_type
+        )
+        # a fresh task adopts the announced grid outright —
+        # Task.piece_length defaults to a truthy 4 MiB, so a
+        # "not set" check can never fire here
+        if fresh and request.piece_length:
+            task.piece_length = request.piece_length
         if request.content_length >= 0 and task.content_length < 0:
             task.content_length = request.content_length
         if request.pieces and task.total_piece_count < 0:

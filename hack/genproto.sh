@@ -4,6 +4,6 @@
 set -euo pipefail
 cd "$(dirname "$0")/../dragonfly2_tpu/rpc"
 protoc -I protos --python_out=gen \
-  protos/common.proto protos/scheduler.proto protos/trainer.proto \
+  protos/common.proto protos/scheduler.proto protos/scheduler_v1.proto protos/trainer.proto \
   protos/manager.proto protos/dfdaemon.proto
 echo "generated: $(ls gen/*_pb2.py)"

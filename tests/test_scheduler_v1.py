@@ -1,0 +1,406 @@
+"""Scheduler v1 wire shape over real gRPC: unary RegisterPeerTask size-scope
+dispatch, ReportPieceResult bidi scheduling, ReportPeerResult record sink —
+and cross-generation visibility with the v2 AnnouncePeer service (reference
+scheduler/service/service_v1.go semantics; both bound into one server like
+reference scheduler/rpcserver/rpcserver.go:31-44)."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import common_pb2
+import scheduler_pb2
+import scheduler_v1_pb2 as v1
+
+from dragonfly2_tpu.rpc.glue import ServiceClient, dial, serve
+from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import SERVICE_NAME as V2_SERVICE
+from dragonfly2_tpu.scheduler.service import SchedulerService
+from dragonfly2_tpu.scheduler.service_v1 import (
+    BEGIN_OF_PIECE,
+    SCHEDULER_V1_SERVICE,
+    SchedulerServiceV1,
+)
+from dragonfly2_tpu.scheduler.storage import Storage
+
+
+class StreamDriver:
+    def __init__(self, call_fn):
+        self._q = queue.Queue()
+        self._responses = call_fn(iter(self._q.get, None))
+
+    def send(self, req):
+        self._q.put(req)
+
+    def close(self):
+        self._q.put(None)
+
+    def recv(self, timeout=5.0):
+        out = {}
+
+        def read():
+            try:
+                out["resp"] = next(self._responses)
+            except StopIteration:
+                out["resp"] = None
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        t.join(timeout)
+        if "resp" not in out:
+            raise TimeoutError("no response within timeout")
+        return out["resp"]
+
+
+def peer_host(i):
+    return v1.PeerHost(
+        id=f"host-{i}",
+        ip=f"10.0.0.{i}",
+        rpc_port=8002,
+        down_port=8001,
+        hostname=f"h{i}",
+        idc="idc-a",
+        location="as|cn|sh|dc1",
+    )
+
+
+URL = "https://example.com/blob.bin"
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    resource = res.Resource()
+    storage = Storage(tmp_path / "records", buffer_size=1)
+    scheduling = Scheduling(
+        BaseEvaluator(),
+        SchedulingConfig(retry_limit=2, retry_back_to_source_limit=1, retry_interval=0.01),
+    )
+    svc_v1 = SchedulerServiceV1(resource, scheduling, storage=storage)
+    svc_v2 = SchedulerService(resource, scheduling, storage=storage)
+    server, port = serve(
+        {SCHEDULER_V1_SERVICE: svc_v1, V2_SERVICE: svc_v2}, "127.0.0.1:0"
+    )
+    channel = dial(f"127.0.0.1:{port}")
+    yield {
+        "resource": resource,
+        "storage": storage,
+        "v1": ServiceClient(channel, SCHEDULER_V1_SERVICE),
+        "v2": ServiceClient(channel, V2_SERVICE),
+    }
+    channel.close()
+    server.stop(grace=None)
+
+
+def begin(task_id, pid):
+    return v1.PieceResult(
+        task_id=task_id,
+        src_pid=pid,
+        piece_info=common_pb2.PieceInfo(number=BEGIN_OF_PIECE),
+    )
+
+
+def register(client, i, pid, need_back_to_source=False):
+    return client.RegisterPeerTask(
+        v1.PeerTaskRequest(
+            url=URL,
+            peer_id=pid,
+            peer_host=peer_host(i),
+            need_back_to_source=need_back_to_source,
+        )
+    )
+
+
+def download_via_source(cluster, i, pid, n_pieces=3, piece_len=1 << 20):
+    """Drive one v1 peer through back-to-source download to success."""
+    reg = register(cluster["v1"], i, pid, need_back_to_source=True)
+    assert reg.size_scope == common_pb2.SIZE_SCOPE_NORMAL
+    stream = StreamDriver(cluster["v1"].ReportPieceResult)
+    stream.send(begin(reg.task_id, pid))
+    pkt = stream.recv()
+    assert pkt.code == v1.CODE_NEED_BACK_SOURCE
+    for n in range(n_pieces):
+        stream.send(
+            v1.PieceResult(
+                task_id=reg.task_id,
+                src_pid=pid,
+                success=True,
+                piece_info=common_pb2.PieceInfo(
+                    number=n,
+                    offset=n * piece_len,
+                    length=piece_len,
+                    traffic_type="back_to_source",
+                    cost_ns=5_000_000,
+                ),
+                finished_count=n + 1,
+            )
+        )
+    stream.close()
+    cluster["v1"].ReportPeerResult(
+        v1.PeerResult(
+            task_id=reg.task_id,
+            peer_id=pid,
+            success=True,
+            content_length=n_pieces * piece_len,
+            total_piece_count=n_pieces,
+            cost_ns=123_000_000,
+        )
+    )
+    return reg.task_id
+
+
+class TestV1Flow:
+    def test_back_to_source_then_child_gets_parent(self, cluster):
+        task_id = download_via_source(cluster, 1, "peer-1")
+        parent = cluster["resource"].peer_manager.load("peer-1")
+        assert parent.fsm.is_state(res.PEER_STATE_SUCCEEDED)
+        # a record landed in the sink
+        cluster["storage"].flush()
+        assert len(list(cluster["storage"].list_download())) == 1
+
+        # second v1 peer gets the first as main peer
+        reg = register(cluster["v1"], 2, "peer-2")
+        assert reg.task_id == task_id
+        stream = StreamDriver(cluster["v1"].ReportPieceResult)
+        stream.send(begin(task_id, "peer-2"))
+        pkt = stream.recv()
+        assert pkt.code == v1.CODE_SUCCESS
+        assert pkt.main_peer.peer_id == "peer-1"
+        assert pkt.main_peer.ip == "10.0.0.1"
+        assert pkt.main_peer.down_port == 8001
+        assert pkt.task_total_piece_count == 3
+        stream.close()
+
+    def test_piece_failure_blocks_parent_and_reschedules(self, cluster):
+        task_id = download_via_source(cluster, 1, "peer-1")
+        register(cluster["v1"], 2, "peer-2")
+        stream = StreamDriver(cluster["v1"].ReportPieceResult)
+        stream.send(begin(task_id, "peer-2"))
+        assert stream.recv().main_peer.peer_id == "peer-1"
+        # the only parent fails a piece → no candidates left → back to source
+        stream.send(
+            v1.PieceResult(
+                task_id=task_id,
+                src_pid="peer-2",
+                dst_pid="peer-1",
+                success=False,
+                code=v1.CODE_CLIENT_PIECE_FAIL,
+                piece_info=common_pb2.PieceInfo(number=1),
+            )
+        )
+        pkt = stream.recv()
+        assert pkt.code == v1.CODE_NEED_BACK_SOURCE
+        peer2 = cluster["resource"].peer_manager.load("peer-2")
+        assert "peer-1" in peer2.block_parents
+        stream.close()
+
+    def test_back_to_source_code_transitions_fsm(self, cluster):
+        """CODE_NEED_BACK_SOURCE IS the v1 back-to-source transition: the
+        peer must land in BackToSource (schedulable as an in-flight
+        parent) and consume the task's back-to-source budget."""
+        reg = register(cluster["v1"], 1, "peer-1", need_back_to_source=True)
+        stream = StreamDriver(cluster["v1"].ReportPieceResult)
+        stream.send(begin(reg.task_id, "peer-1"))
+        assert stream.recv().code == v1.CODE_NEED_BACK_SOURCE
+        peer = cluster["resource"].peer_manager.load("peer-1")
+        assert peer.fsm.is_state(res.PEER_STATE_BACK_TO_SOURCE)
+        task = cluster["resource"].task_manager.load(reg.task_id)
+        assert "peer-1" in task.back_to_source_peers
+        stream.close()
+
+    def test_wait_piece_does_not_block_parent(self, cluster):
+        """CODE_CLIENT_WAIT_PIECE means the parent is healthy but has no
+        new pieces — it must not be blocklisted or upload-penalised."""
+        task_id = download_via_source(cluster, 1, "peer-1")
+        register(cluster["v1"], 2, "peer-2")
+        stream = StreamDriver(cluster["v1"].ReportPieceResult)
+        stream.send(begin(task_id, "peer-2"))
+        assert stream.recv().main_peer.peer_id == "peer-1"
+        parent = cluster["resource"].peer_manager.load("peer-1")
+        failures_before = parent.host.upload_failed_count
+        stream.send(
+            v1.PieceResult(
+                task_id=task_id,
+                src_pid="peer-2",
+                dst_pid="peer-1",
+                success=False,
+                code=v1.CODE_CLIENT_WAIT_PIECE,
+                piece_info=common_pb2.PieceInfo(number=2),
+            )
+        )
+        time.sleep(0.1)
+        peer2 = cluster["resource"].peer_manager.load("peer-2")
+        assert "peer-1" not in peer2.block_parents
+        assert parent.host.upload_failed_count == failures_before
+        stream.close()
+
+    def test_reregister_refreshes_host_addressing(self, cluster):
+        register(cluster["v1"], 1, "peer-1")
+        moved = peer_host(1)
+        moved.down_port = 9999
+        cluster["v1"].RegisterPeerTask(
+            v1.PeerTaskRequest(url=URL, peer_id="peer-1b", peer_host=moved)
+        )
+        host = cluster["resource"].host_manager.load("host-1")
+        assert host.download_port == 9999
+
+    def test_peer_gone_on_unknown_peer(self, cluster):
+        stream = StreamDriver(cluster["v1"].ReportPieceResult)
+        stream.send(begin("task-x", "ghost-peer"))
+        pkt = stream.recv()
+        assert pkt.code == v1.CODE_PEER_GONE
+        stream.close()
+
+    def test_small_task_single_piece_dispatch(self, cluster):
+        # one-piece task downloaded by a parent → next register is SMALL
+        task_id = download_via_source(cluster, 1, "peer-1", n_pieces=1)
+        task = cluster["resource"].task_manager.load(task_id)
+        assert task.size_scope() is res.SizeScope.SMALL
+        reg = register(cluster["v1"], 2, "peer-2")
+        assert reg.size_scope == common_pb2.SIZE_SCOPE_SMALL
+        assert reg.single_piece.dst_pid == "peer-1"
+        assert reg.single_piece.dst_ip == "10.0.0.1"
+        assert reg.single_piece.piece_info.length == 1 << 20
+
+    def test_failed_peer_result_writes_error_record(self, cluster):
+        reg = register(cluster["v1"], 1, "peer-1", need_back_to_source=True)
+        stream = StreamDriver(cluster["v1"].ReportPieceResult)
+        stream.send(begin(reg.task_id, "peer-1"))
+        assert stream.recv().code == v1.CODE_NEED_BACK_SOURCE
+        stream.close()
+        cluster["v1"].ReportPeerResult(
+            v1.PeerResult(
+                task_id=reg.task_id,
+                peer_id="peer-1",
+                success=False,
+                code=v1.CODE_CLIENT_PIECE_FAIL,
+            )
+        )
+        cluster["storage"].flush()
+        (rec,) = cluster["storage"].list_download()
+        assert rec.error.code == "CODE_CLIENT_PIECE_FAIL"
+        peer = cluster["resource"].peer_manager.load("peer-1")
+        assert peer.fsm.is_state(res.PEER_STATE_FAILED)
+
+    def test_stat_and_leave(self, cluster):
+        task_id = download_via_source(cluster, 1, "peer-1")
+        stat = cluster["v1"].StatTask(v1.StatTaskRequest(task_id=task_id))
+        assert stat.total_piece_count == 3
+        assert stat.has_available_peer
+        cluster["v1"].LeaveTask(v1.PeerTarget(task_id=task_id, peer_id="peer-1"))
+        peer = cluster["resource"].peer_manager.load("peer-1")
+        assert peer.fsm.is_state(res.PEER_STATE_LEAVE)
+        cluster["v1"].LeaveHost(v1.LeaveHostRequest(host_id="host-1"))
+        assert cluster["resource"].host_manager.load("host-1") is None
+
+
+class TestCrossGeneration:
+    def test_v2_child_sees_v1_parent(self, cluster):
+        """A parent that downloaded via the v1 wire serves a v2 child —
+        one shared swarm across protocol generations."""
+        task_id = download_via_source(cluster, 1, "peer-1")
+        # v2 flow: announce host then register over the announce stream
+        cluster["v2"].AnnounceHost(
+            scheduler_pb2.AnnounceHostRequest(
+                host=common_pb2.HostInfo(
+                    id="host-2",
+                    hostname="h2",
+                    ip="10.0.0.2",
+                    port=8002,
+                    download_port=8001,
+                    concurrent_upload_limit=50,
+                    network=common_pb2.NetworkStat(idc="idc-a", location="as|cn|sh|dc1"),
+                )
+            )
+        )
+        stream = StreamDriver(cluster["v2"].AnnouncePeer)
+        stream.send(
+            scheduler_pb2.AnnouncePeerRequest(
+                host_id="host-2",
+                task_id=task_id,
+                peer_id="peer-v2",
+                register_peer=scheduler_pb2.RegisterPeerRequest(
+                    task_id=task_id, peer_id="peer-v2", url=URL
+                ),
+            )
+        )
+        resp = stream.recv()
+        assert resp.WhichOneof("response") == "normal_task"
+        assert resp.normal_task.candidate_parents[0].peer_id == "peer-1"
+        stream.close()
+
+    def test_v1_child_sees_v2_parent(self, cluster):
+        """And the reverse: a v2-announced parent serves a v1 child."""
+        cluster["v2"].AnnounceHost(
+            scheduler_pb2.AnnounceHostRequest(
+                host=common_pb2.HostInfo(
+                    id="host-1",
+                    hostname="h1",
+                    ip="10.0.0.1",
+                    port=8002,
+                    download_port=8001,
+                    concurrent_upload_limit=50,
+                )
+            )
+        )
+        stream = StreamDriver(cluster["v2"].AnnouncePeer)
+        stream.send(
+            scheduler_pb2.AnnouncePeerRequest(
+                host_id="host-1",
+                peer_id="peer-v2",
+                register_peer=scheduler_pb2.RegisterPeerRequest(
+                    peer_id="peer-v2",
+                    url=URL,
+                    need_back_to_source=True,
+                ),
+            )
+        )
+        resp = stream.recv()
+        assert resp.WhichOneof("response") == "need_back_to_source"
+        # drive pieces + finish over the v2 stream
+        for n in range(2):
+            stream.send(
+                scheduler_pb2.AnnouncePeerRequest(
+                    peer_id="peer-v2",
+                    download_piece_finished=scheduler_pb2.DownloadPieceFinishedRequest(
+                        piece=common_pb2.PieceInfo(
+                            number=n,
+                            offset=n * (1 << 20),
+                            length=1 << 20,
+                            traffic_type="back_to_source",
+                            cost_ns=4_000_000,
+                        )
+                    ),
+                )
+            )
+        stream.send(
+            scheduler_pb2.AnnouncePeerRequest(
+                peer_id="peer-v2",
+                download_peer_finished=scheduler_pb2.DownloadPeerFinishedRequest(
+                    content_length=2 << 20, piece_count=2, cost_ns=50_000_000
+                ),
+            )
+        )
+        stream.close()
+        peer_v2 = cluster["resource"].peer_manager.load("peer-v2")
+        assert peer_v2 is not None
+
+        def succeeded():
+            return peer_v2.fsm.is_state(res.PEER_STATE_SUCCEEDED)
+
+        deadline = time.time() + 5
+        while time.time() < deadline and not succeeded():
+            time.sleep(0.02)
+        assert succeeded()
+
+        reg = register(cluster["v1"], 3, "peer-v1-child")
+        stream1 = StreamDriver(cluster["v1"].ReportPieceResult)
+        stream1.send(begin(reg.task_id, "peer-v1-child"))
+        pkt = stream1.recv()
+        assert pkt.code == v1.CODE_SUCCESS
+        assert pkt.main_peer.peer_id == "peer-v2"
+        stream1.close()
