@@ -287,6 +287,20 @@ class RestServer:
                 from urllib.parse import parse_qsl, urlsplit
 
                 parts = urlsplit(self.path)
+                # embedded console: a static page (unauthenticated, like
+                # any static asset — its data calls carry the token); the
+                # reference embeds its React console the same way
+                # (manager/manager.go:61-85)
+                if self.command == "GET" and parts.path in ("/", "/console"):
+                    from dragonfly2_tpu.manager.console import index_html
+
+                    data = index_html()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 query = dict(parse_qsl(parts.query))
                 role = role_for(self.headers.get("Authorization"))
                 for method, rx, fname, write in _ROUTES:

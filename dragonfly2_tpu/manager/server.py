@@ -35,6 +35,9 @@ class ManagerServerConfig:
     tls_cert_file: str = ""
     tls_key_file: str = ""
     tls_client_ca_file: str = ""
+    # read-through DB cache TTL in seconds (reference manager/cache Redis
+    # TTLs); 0 disables caching
+    db_cache_ttl: float = 30.0
 
 
 class ManagerServer:
@@ -42,6 +45,10 @@ class ManagerServer:
         self.cfg = config
         Path(config.data_dir).mkdir(parents=True, exist_ok=True)
         self.db = Database(str(Path(config.data_dir) / "manager.db"))
+        if config.db_cache_ttl > 0:
+            from dragonfly2_tpu.manager.cache import CachedDatabase
+
+            self.db = CachedDatabase(self.db, ttl=config.db_cache_ttl)
         self.object_storage = FSObjectStorage(Path(config.data_dir) / "objects")
         self.models = ModelRegistry(self.db, self.object_storage)
         self.service = ManagerService(self.db, self.models)

@@ -145,13 +145,15 @@ def load_or_create_task(
     meta: URLMeta,
     task_id: str,
     wire_task_type: int,
-) -> res.Task:
+) -> tuple[res.Task, bool]:
     """Shared task resolution for both wire generations: load by id or
     create with meta-derived attributes (reference storeTask,
-    service_v1.go:919-1004 / service_v2.go handleRegisterPeerRequest)."""
+    service_v1.go:919-1004 / service_v2.go handleRegisterPeerRequest).
+    Returns (task, created) so callers learn freshness from the single
+    lookup instead of re-probing (TOCTOU-free)."""
     task = resource.task_manager.load(task_id)
     if task is not None:
-        return task
+        return task, False
     task_type = {
         common_pb2.TASK_TYPE_DFSTORE: res.TaskType.DFSTORE,
         common_pb2.TASK_TYPE_DFCACHE: res.TaskType.DFCACHE,
@@ -167,7 +169,21 @@ def load_or_create_task(
         url_range=meta.range,
     )
     resource.task_manager.store(task)
-    return task
+    return task, True
+
+
+def write_download_record(
+    storage: Storage | None, peer: res.Peer, error_code: str = "", error_message: str = ""
+) -> None:
+    """Shared Download-record sink for both wire generations (reference
+    createDownloadRecord, service_v1.go:1418-1632)."""
+    if storage is None:
+        return
+    try:
+        M.DOWNLOAD_RECORD_TOTAL.inc()
+        storage.create_download(build_download_record(peer, error_code, error_message))
+    except Exception:
+        logger.exception("write download record failed for %s", peer.id)
 
 
 class SchedulerService:
@@ -291,7 +307,7 @@ class SchedulerService:
             application=reg.url_meta.application,
         )
         task_id = reg.task_id or task_id_v1(reg.url, meta)
-        task = load_or_create_task(self.resource, reg.url, meta, task_id, reg.task_type)
+        task, _ = load_or_create_task(self.resource, reg.url, meta, task_id, reg.task_type)
 
         peer = res.Peer(
             reg.peer_id, task, host, tag=meta.tag, application=meta.application
@@ -363,15 +379,7 @@ class SchedulerService:
                 parent.host.record_upload(success=True)
 
     def _write_download_record(self, peer: res.Peer, error_code: str = "", error_message: str = "") -> None:
-        if self.storage is None:
-            return
-        try:
-            M.DOWNLOAD_RECORD_TOTAL.inc()
-            self.storage.create_download(
-                build_download_record(peer, error_code, error_message)
-            )
-        except Exception:
-            logger.exception("write download record failed for %s", peer.id)
+        write_download_record(self.storage, peer, error_code, error_message)
 
     # ------------------------------------------------------------------
     # unary RPCs
@@ -455,8 +463,7 @@ class SchedulerService:
             application=request.url_meta.application,
         )
         task_id = request.task_id or task_id_v1(request.url, meta)
-        fresh = self.resource.task_manager.load(task_id) is None
-        task = load_or_create_task(
+        task, fresh = load_or_create_task(
             self.resource, request.url, meta, task_id, request.task_type
         )
         # a fresh task adopts the announced grid outright —

@@ -32,7 +32,7 @@ from dragonfly2_tpu.scheduler.scheduling import (
     Scheduling,
     SchedulingError,
 )
-from dragonfly2_tpu.scheduler.service import load_or_create_task
+from dragonfly2_tpu.scheduler.service import load_or_create_task, write_download_record
 from dragonfly2_tpu.scheduler.storage import Storage, build_download_record
 from dragonfly2_tpu.utils import dflog
 from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
@@ -140,7 +140,7 @@ class SchedulerServiceV1:
             application=request.url_meta.application,
         )
         task_id = request.task_id or task_id_v1(request.url, meta)
-        task = load_or_create_task(
+        task, _ = load_or_create_task(
             self.resource, request.url, meta, task_id, request.task_type
         )
 
@@ -398,23 +398,22 @@ class SchedulerServiceV1:
                 peer.fsm.event(res.PEER_EVENT_DOWNLOAD_FAILED)
             if peer.task.fsm.can(res.TASK_EVENT_DOWNLOAD_FAILED):
                 peer.task.fsm.event(res.TASK_EVENT_DOWNLOAD_FAILED)
-            self._write_download_record(
-                peer, error_code=v1.Code.Name(request.code) if request.code else "download_failed"
-            )
+            # proto3 enums are open — a code outside the defined range
+            # must still land in the record, not crash the sink
+            code = request.code
+            if not code:
+                error_code = "download_failed"
+            elif code in v1.Code.values():
+                error_code = v1.Code.Name(code)
+            else:
+                error_code = str(code)
+            self._write_download_record(peer, error_code=error_code)
         return v1.Empty()
 
     def _write_download_record(
         self, peer: res.Peer, error_code: str = "", error_message: str = ""
     ) -> None:
-        if self.storage is None:
-            return
-        try:
-            M.DOWNLOAD_RECORD_TOTAL.inc()
-            self.storage.create_download(
-                build_download_record(peer, error_code, error_message)
-            )
-        except Exception:
-            logger.exception("v1 write download record failed for %s", peer.id)
+        write_download_record(self.storage, peer, error_code, error_message)
 
     # ------------------------------------------------------------------
     # unary task/host RPCs

@@ -1,0 +1,157 @@
+"""Manager DB read-through cache (manager/cache.py): hit/miss accounting,
+write invalidation by table tag, TTL expiry, and drop-in equivalence under
+the gRPC service (reference manager/cache — Redis in front of GORM)."""
+
+import time
+
+import pytest
+
+from dragonfly2_tpu.manager.cache import CachedDatabase, tables_of
+from dragonfly2_tpu.manager.database import Database
+
+
+@pytest.fixture
+def cdb(tmp_path):
+    db = Database(tmp_path / "m.db")
+    cached = CachedDatabase(db, ttl=30.0)
+    yield cached
+    cached.close()
+
+
+def test_tables_of():
+    assert tables_of("SELECT * FROM schedulers WHERE id = ?") == {"schedulers"}
+    assert tables_of("INSERT INTO jobs (a) VALUES (?)") == {"jobs"}
+    assert tables_of("UPDATE models SET state = ?") == {"models"}
+    assert tables_of("DELETE FROM seed_peers WHERE id = ?") == {"seed_peers"}
+    assert tables_of(
+        "SELECT * FROM schedulers JOIN scheduler_clusters ON 1"
+    ) == {"schedulers", "scheduler_clusters"}
+
+
+def test_repeat_read_hits_cache(cdb):
+    cdb.ensure_default_cluster()
+    first = cdb.query("SELECT * FROM scheduler_clusters")
+    misses = cdb.misses
+    second = cdb.query("SELECT * FROM scheduler_clusters")
+    assert second == first
+    assert cdb.misses == misses  # served from cache
+    assert cdb.hits >= 1
+
+
+def test_write_invalidates_only_touched_tables(cdb):
+    cdb.ensure_default_cluster()
+    cdb.query("SELECT * FROM scheduler_clusters")
+    cdb.query("SELECT * FROM jobs")
+    now = time.time()
+    cdb.execute(
+        "INSERT INTO jobs (type, created_at, updated_at) VALUES ('preheat', ?, ?)",
+        (now, now),
+    )
+    h0, m0 = cdb.hits, cdb.misses
+    # jobs was invalidated → miss + fresh row visible
+    rows = cdb.query("SELECT * FROM jobs")
+    assert cdb.misses == m0 + 1
+    assert len(rows) == 1
+    # scheduler_clusters untouched → still cached
+    cdb.query("SELECT * FROM scheduler_clusters")
+    assert cdb.hits == h0 + 1
+
+
+def test_mutating_returned_rows_does_not_poison_cache(cdb):
+    cdb.ensure_default_cluster()
+    rows = cdb.query("SELECT * FROM scheduler_clusters")
+    rows[0]["name"] = "mutated"
+    again = cdb.query("SELECT * FROM scheduler_clusters")
+    assert again[0]["name"] == "default"
+
+
+def test_ttl_expiry(tmp_path):
+    cdb = CachedDatabase(Database(tmp_path / "t.db"), ttl=0.05)
+    cdb.ensure_default_cluster()
+    cdb.query("SELECT * FROM scheduler_clusters")
+    m0 = cdb.misses
+    time.sleep(0.08)
+    cdb.query("SELECT * FROM scheduler_clusters")
+    assert cdb.misses == m0 + 1
+    cdb.close()
+
+
+def test_transaction_flushes_reads(cdb):
+    cdb.ensure_default_cluster()
+    cdb.query("SELECT * FROM jobs")
+    with cdb.transaction():
+        m0 = cdb.misses
+        cdb.query("SELECT * FROM jobs")
+        assert cdb.misses == m0 + 1  # leasing reads never see cache
+
+
+def test_service_drop_in(tmp_path):
+    """The gRPC manager service works unchanged over CachedDatabase:
+    keepalive write → list read sees the state flip despite caching."""
+    import manager_pb2
+
+    from dragonfly2_tpu.manager.models_registry import ModelRegistry
+    from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+    from dragonfly2_tpu.manager.service import ManagerService
+
+    cdb = CachedDatabase(Database(tmp_path / "m.db"), ttl=30.0)
+    service = ManagerService(cdb, ModelRegistry(cdb, FSObjectStorage(tmp_path / "o")))
+    cluster_id = cdb.ensure_default_cluster()
+    service.UpdateScheduler(
+        manager_pb2.UpdateSchedulerRequest(
+            hostname="s1", ip="10.0.0.1", port=8002, scheduler_cluster_id=cluster_id
+        ),
+        None,
+    )
+    resp = service.ListSchedulers(
+        manager_pb2.ListSchedulersRequest(hostname="c", ip="10.0.0.9"), None
+    )
+    assert [s.hostname for s in resp.schedulers] == ["s1"]
+    # a write through the service invalidates what list reads: deleting
+    # the row must be visible on the very next list, not after TTL
+    cdb.execute("DELETE FROM schedulers WHERE hostname = 's1'")
+    resp = service.ListSchedulers(
+        manager_pb2.ListSchedulersRequest(hostname="c", ip="10.0.0.9"), None
+    )
+    assert len(resp.schedulers) == 0
+    cdb.close()
+
+
+def test_zero_row_sweep_keeps_cache_warm(cdb):
+    """ListSchedulers' _expire_stale sweep UPDATEs usually match 0 rows —
+    that must not evict the very entries the cache exists to serve."""
+    cdb.ensure_default_cluster()
+    cdb.query("SELECT * FROM schedulers WHERE state = 'active'")
+    h0 = cdb.hits
+    # 0-row UPDATE (no schedulers exist)
+    cdb.execute("UPDATE schedulers SET state = 'inactive' WHERE last_keepalive < -1")
+    cdb.query("SELECT * FROM schedulers WHERE state = 'active'")
+    assert cdb.hits == h0 + 1  # still cached
+
+
+def test_list_schedulers_polls_hit_cache(tmp_path):
+    """The stated hot path: repeated ListSchedulers polls hit sqlite once
+    per TTL even though every call runs the expiry sweep."""
+    import manager_pb2
+
+    from dragonfly2_tpu.manager.models_registry import ModelRegistry
+    from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+    from dragonfly2_tpu.manager.service import ManagerService
+
+    cdb = CachedDatabase(Database(tmp_path / "m.db"), ttl=30.0)
+    service = ManagerService(cdb, ModelRegistry(cdb, FSObjectStorage(tmp_path / "o")))
+    cid = cdb.ensure_default_cluster()
+    service.UpdateScheduler(
+        manager_pb2.UpdateSchedulerRequest(
+            hostname="s1", ip="10.0.0.1", port=8002, scheduler_cluster_id=cid
+        ),
+        None,
+    )
+    req = manager_pb2.ListSchedulersRequest(hostname="c", ip="10.0.0.9")
+    service.ListSchedulers(req, None)  # prime
+    misses_before = cdb.misses
+    for _ in range(5):
+        resp = service.ListSchedulers(req, None)
+        assert [s.hostname for s in resp.schedulers] == ["s1"]
+    assert cdb.misses == misses_before  # five polls, zero DB reads
+    cdb.close()

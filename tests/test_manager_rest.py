@@ -186,3 +186,19 @@ def test_deactivate_stamps_updated_at(rest):
     )
     assert status == 200 and row["state"] == "inactive"
     assert models.get("m1", 1).updated_at > before
+
+
+def test_console_served_at_root(rest):
+    """The embedded console page is served at / and /console without auth
+    (static asset; its data calls carry the token — reference embeds its
+    React console the same way, manager/manager.go:61-85)."""
+    for path in ("/", "/console"):
+        req = urllib.request.Request(f"http://{rest['addr']}{path}")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/html")
+            page = resp.read().decode()
+    # the page drives the same REST surface this test drives
+    assert "/api/v1/scheduler-clusters" in page
+    assert "/api/v1/models" in page
+    assert "setModelState" in page

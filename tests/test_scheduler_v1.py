@@ -404,3 +404,23 @@ class TestCrossGeneration:
         assert pkt.code == v1.CODE_SUCCESS
         assert pkt.main_peer.peer_id == "peer-v2"
         stream1.close()
+
+
+def test_out_of_range_code_still_writes_record(cluster):
+    """proto3 enums are open: an unknown failure code must land in the
+    record as its number, not crash the sink after FSM transitions."""
+    reg = register(cluster["v1"], 1, "peer-1", need_back_to_source=True)
+    stream = StreamDriver(cluster["v1"].ReportPieceResult)
+    stream.send(begin(reg.task_id, "peer-1"))
+    assert stream.recv().code == v1.CODE_NEED_BACK_SOURCE
+    stream.close()
+    res_pb = v1.PeerResult(task_id=reg.task_id, peer_id="peer-1", success=False)
+    # bypass python-side enum validation the way a foreign client would:
+    # splice the raw varint for field 9 (code) = 99 onto the wire bytes
+    raw = res_pb.SerializeToString() + bytes([0x48, 99])
+    parsed = v1.PeerResult.FromString(raw)
+    assert parsed.code == 99
+    cluster["v1"].ReportPeerResult(parsed)
+    cluster["storage"].flush()
+    (rec,) = cluster["storage"].list_download()
+    assert rec.error.code == "99"
