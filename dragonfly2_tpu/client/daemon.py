@@ -13,6 +13,7 @@ import socket
 import threading
 import time
 from dataclasses import dataclass, field
+from pathlib import Path
 
 from dragonfly2_tpu.rpc import gen  # noqa: F401
 import common_pb2  # noqa: E402
@@ -42,6 +43,9 @@ class DaemonConfig:
     hostname: str = field(default_factory=socket.gethostname)
     ip: str = "127.0.0.1"
     listen: str = "127.0.0.1:0"  # daemon gRPC
+    # also serve the dfdaemon gRPC on this unix socket (local CLI path,
+    # reference pkg/rpc/mux.go); empty = TCP only
+    unix_socket: str = ""
     upload_host: str = "127.0.0.1"
     upload_port: int = 0
     host_type: str = "normal"  # "normal" | "super" (seed peer)
@@ -174,8 +178,31 @@ class Daemon:
             storage=self.storage,
             upload_addr=self.upload.address,
         )
+        extra = []
+        if self.cfg.unix_socket:
+            # local CLIs (dfget/dfcache/dfstore) reach the daemon through
+            # the socket without touching the TCP stack (reference
+            # pkg/rpc/mux.go unix listener; dfget root.go:279 dials it)
+            sock = Path(self.cfg.unix_socket)
+            sock.parent.mkdir(parents=True, exist_ok=True)
+            if sock.exists():
+                # connect-before-unlink: only a DEAD socket is stale. A
+                # spawn race must not unbind a healthy daemon and orphan
+                # it on a deleted inode
+                probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                try:
+                    probe.settimeout(1.0)
+                    probe.connect(str(sock))
+                    probe.close()
+                    raise RuntimeError(
+                        f"another daemon is serving {sock}; refusing to unbind it"
+                    )
+                except (ConnectionRefusedError, FileNotFoundError, socket.timeout, OSError):
+                    probe.close()
+                    sock.unlink()  # stale socket from an unclean shutdown
+            extra.append(f"unix:{sock}")
         self._server, self.port = glue.serve(
-            {DFDAEMON_SERVICE: service}, address=self.cfg.listen
+            {DFDAEMON_SERVICE: service}, address=self.cfg.listen, extra_addresses=extra
         )
         # announce before the proxy/gateway open for business: a gateway
         # PUT may AnnounceTask immediately, which requires a known host

@@ -21,6 +21,59 @@ from dragonfly2_tpu.rpc import glue
 from dragonfly2_tpu.rpc.glue import DFDAEMON_SERVICE
 
 
+def daemon_alive(daemon_address: str, timeout: float = 2.0) -> bool:
+    """Liveness probe: can a channel to the daemon become ready within
+    ``timeout``?"""
+    try:
+        channel = glue.dial(daemon_address, retries=1, ready_timeout=timeout)
+        channel.close()
+        return True
+    except Exception:
+        return False
+
+
+def ensure_daemon(
+    daemon_address: str,
+    scheduler_address: str,
+    data_dir: str,
+    wait: float = 15.0,
+) -> bool:
+    """Spawn-or-reuse the local daemon (reference cmd/dfget/cmd/root.go:279
+    checkAndSpawnDaemon): probe ``daemon_address`` (normally a
+    ``unix:/path`` socket); when dead, fork a detached
+    ``python -m dragonfly2_tpu.client.daemon`` serving that address and
+    wait for it to come up. Returns True when the daemon got spawned."""
+    import subprocess
+    import time
+
+    if daemon_alive(daemon_address):
+        return False
+    overrides = [
+        "--set", f"scheduler_address={scheduler_address}",
+        "--set", f"data_dir={data_dir}",
+    ]
+    if daemon_address.startswith("unix:"):
+        overrides += ["--set", f"unix_socket={daemon_address[5:]}"]
+    else:
+        overrides += ["--set", f"listen={daemon_address}"]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dragonfly2_tpu.client.daemon", *overrides],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,  # outlive this dfget invocation
+    )
+    deadline = time.monotonic() + wait
+    while time.monotonic() < deadline:
+        if daemon_alive(daemon_address, timeout=0.5):
+            return True
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"spawned daemon exited with rc={proc.returncode} before serving"
+            )
+        time.sleep(0.2)
+    raise TimeoutError(f"spawned daemon not ready on {daemon_address} within {wait}s")
+
+
 def download(
     daemon_address: str,
     url: str,
@@ -88,7 +141,23 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--digest", default="")
     p.add_argument("--disable-back-source", action="store_true")
     p.add_argument("--recursive", action="store_true")
+    # spawn-or-reuse: start a local daemon on --daemon when none answers
+    # (reference dfget root.go:279 checkAndSpawnDaemon)
+    p.add_argument("--spawn-daemon", action="store_true")
+    p.add_argument(
+        "--scheduler",
+        default=os.environ.get("DF_SCHEDULER_ADDR", "127.0.0.1:8002"),
+        help="scheduler address(es) a spawned daemon announces to",
+    )
+    p.add_argument(
+        "--daemon-data-dir",
+        default=os.path.expanduser("~/.dragonfly2/daemon"),
+        help="data dir a spawned daemon uses",
+    )
     args = p.parse_args(argv)
+
+    if args.spawn_daemon:
+        ensure_daemon(args.daemon, args.scheduler, args.daemon_data_dir)
 
     def progress(r):
         if r.content_length > 0:

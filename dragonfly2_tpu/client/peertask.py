@@ -9,8 +9,11 @@ requests for the same task share it) and reuse of completed local tasks
 from __future__ import annotations
 
 import dataclasses
+import queue
 import threading
+import time
 from dataclasses import dataclass
+from typing import Iterator
 
 from dragonfly2_tpu.rpc import gen  # noqa: F401
 import common_pb2  # noqa: E402
@@ -116,6 +119,113 @@ class TaskManager:
             self.conductors[task_id] = conductor
             conductor.start()
         return task_id, peer_id, conductor
+
+    # ------------------------------------------------------------------
+    # stream frontend (reference peertask_stream.go): bytes flow to the
+    # caller as pieces land, instead of waiting for the whole task —
+    # the proxy/transport/object-gateway path for large blobs
+    # ------------------------------------------------------------------
+    def start_stream_task(
+        self, req: FileTaskRequest, timeout: float | None = None
+    ) -> tuple[str, str, int, dict, "Iterator[bytes]"]:
+        """Returns (task_id, peer_id, content_length, origin_headers,
+        piece iterator). Blocks only until the task geometry and first
+        piece are known (time-to-first-byte), then hands back a generator
+        yielding pieces in order as they complete. The generator raises
+        ``IOError`` if the underlying task fails mid-stream."""
+        task_id, peer_id, conductor = self.start_file_task(
+            dataclasses.replace(req, output="")
+        )
+        if conductor is None:  # completed local task: stream from disk
+            ts = self.storage.load(task_id)
+            return (
+                task_id,
+                peer_id,
+                ts.meta.content_length,
+                dict(ts.meta.headers),
+                self._stored_pieces(ts),
+            )
+
+        # subscribe BEFORE inspecting state so no completion wakeup is lost
+        sub = conductor.subscribe()
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        def wait_tick(ctx: str) -> None:
+            p = conductor.progress()
+            if p.error:
+                raise IOError(f"stream task {task_id[:16]} failed {ctx}: {p.error}")
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"stream task {task_id[:16]} timed out {ctx}")
+            try:
+                sub.get(timeout=0.2)
+            except queue.Empty:
+                pass
+
+        # time-to-first-byte: geometry + piece 0 (reference
+        # peertask_stream.go waits for the first piece the same way)
+        while True:
+            ts = self.storage.load(task_id)
+            if ts is not None and ts.meta.content_length >= 0 and (
+                0 in ts.meta.pieces or conductor.progress().done
+            ):
+                break
+            wait_tick("before first byte")
+
+        def pieces() -> "Iterator[bytes]":
+            n = 0
+            sent = 0
+            while True:
+                if n in ts.meta.pieces:
+                    data = ts.read_piece(n)
+                    sent += len(data)
+                    yield data
+                    n += 1
+                    # the byte count, not the done flag, ends the stream —
+                    # the conductor's finish handshake with the scheduler
+                    # lags the last piece and must not hold the response
+                    if ts.meta.content_length >= 0 and sent >= ts.meta.content_length:
+                        return
+                    continue
+                p = conductor.progress()
+                if p.done:
+                    # pieces are written before done is published, so a
+                    # finished task has the full contiguous set
+                    if n >= len(ts.meta.pieces):
+                        return
+                    if n not in ts.meta.pieces:  # pragma: no cover - defensive
+                        raise IOError(f"stream task {task_id[:16]}: gap at piece {n}")
+                wait_tick(f"at piece {n}")
+
+        return task_id, peer_id, ts.meta.content_length, dict(ts.meta.headers), pieces()
+
+    @staticmethod
+    def _stored_pieces(ts) -> "Iterator[bytes]":
+        for number in sorted(ts.meta.pieces):
+            yield ts.read_piece(number)
+
+    # ------------------------------------------------------------------
+    # seed frontend (reference peertask_seed.go / seeder ObtainSeeds):
+    # origin-first download that makes THIS daemon the swarm's feed
+    # ------------------------------------------------------------------
+    def start_seed_task(
+        self,
+        url: str,
+        url_meta: common_pb2.UrlMeta | None = None,
+        headers: dict | None = None,
+        task_type: int = 0,
+    ) -> tuple[str, str, PeerTaskConductor | None]:
+        """Registers with need_back_to_source so the scheduler sends this
+        peer straight to the origin; children are then fed from here
+        (reference seed_peer.go:92-213 trigger → seeder.go ObtainSeeds)."""
+        return self.start_file_task(
+            FileTaskRequest(
+                url=url,
+                url_meta=url_meta,
+                need_back_to_source=True,
+                headers=headers,
+                task_type=task_type,
+            )
+        )
 
     def _forget(self, conductor: PeerTaskConductor) -> None:
         """Completion callback: drop the finished conductor so the dict

@@ -123,22 +123,19 @@ class P2PTransport:
             url_meta=common_pb2.UrlMeta(tag=self.default_tag, digest=digest),
             headers=dict(headers or {}),
         )
-        task_id, _, progress = self.tasks.wait_file_task(req, timeout=self.timeout)
-        if not progress.done:
-            raise RuntimeError(progress.error or "peer task timed out")
-        ts = self.tasks.storage.load(task_id)
-
-        def pieces() -> Iterator[bytes]:
-            for number in sorted(ts.meta.pieces):
-                yield ts.read_piece(number)
-
+        # stream frontend: the response starts at first byte, not last —
+        # a multi-GB layer pull begins flowing while later pieces are
+        # still in flight (reference peertask_stream.go)
+        task_id, _, content_length, origin_headers, body = self.tasks.start_stream_task(
+            req, timeout=self.timeout
+        )
         return TransportResult(
             status=200,
             # replay persisted origin headers (Content-Type) so registry
             # clients get proper metadata on P2P-served responses
-            headers=dict(ts.meta.headers),
-            body=pieces(),
-            content_length=ts.meta.content_length,
+            headers=origin_headers,
+            body=body,
+            content_length=content_length,
             via_p2p=True,
             task_id=task_id,
         )

@@ -1,0 +1,103 @@
+"""Users + personal access tokens for the manager REST plane.
+
+Role parity: reference manager/handlers user/PAT surface with casbin
+role checks (manager/service/ users.go, personal_access_tokens.go) —
+reduced to the two roles the API distinguishes (admin = full access,
+guest = read-only, reference roles `root`/`guest`). Passwords are
+PBKDF2-hashed with a per-user salt; tokens are random secrets returned
+exactly once and stored as SHA-256 hashes, so a database leak exposes
+neither.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+import time
+
+TOKEN_PREFIX = "dfp_"  # personal access token (reference PAT-style)
+ROLES = ("admin", "guest")
+_PBKDF2_ITERS = 100_000
+
+
+def _hash_password(password: str, salt: str) -> str:
+    return hashlib.pbkdf2_hmac(
+        "sha256", password.encode(), bytes.fromhex(salt), _PBKDF2_ITERS
+    ).hex()
+
+
+def _hash_token(token: str) -> str:
+    return hashlib.sha256(token.encode()).hexdigest()
+
+
+def create_user(
+    db, name: str, password: str, role: str = "guest", email: str = ""
+) -> dict:
+    if role not in ROLES:
+        raise ValueError(f"role must be one of {ROLES}")
+    if not name or not password:
+        raise ValueError("name and password are required")
+    salt = secrets.token_hex(16)
+    now = time.time()
+    cur = db.execute(
+        "INSERT INTO users (name, email, password_salt, password_hash, role,"
+        " created_at, updated_at) VALUES (?, ?, ?, ?, ?, ?, ?)",
+        (name, email, salt, _hash_password(password, salt), role, now, now),
+    )
+    return db.query_one("SELECT * FROM users WHERE id = ?", (cur.lastrowid,))
+
+
+def verify_password(db, name: str, password: str) -> dict | None:
+    """→ user row on a correct password for an enabled user, else None."""
+    row = db.query_one(
+        "SELECT * FROM users WHERE name = ? AND state = 'enabled'", (name,)
+    )
+    if row is None:
+        return None
+    expected = _hash_password(password, row["password_salt"])
+    if not hmac.compare_digest(expected, row["password_hash"]):
+        return None
+    return row
+
+
+def create_pat(db, user_id: int, name: str, ttl: float = 0.0) -> tuple[str, dict]:
+    """Mint a token for a user; returns (plaintext_token, row). The
+    plaintext is shown exactly once — only its hash is stored."""
+    token = TOKEN_PREFIX + secrets.token_urlsafe(32)
+    now = time.time()
+    cur = db.execute(
+        "INSERT INTO personal_access_tokens (user_id, name, token_hash,"
+        " expires_at, created_at, updated_at) VALUES (?, ?, ?, ?, ?, ?)",
+        (user_id, name, _hash_token(token), now + ttl if ttl > 0 else 0.0, now, now),
+    )
+    row = db.query_one(
+        "SELECT * FROM personal_access_tokens WHERE id = ?", (cur.lastrowid,)
+    )
+    return token, row
+
+
+def revoke_pat(db, pat_id: int) -> None:
+    db.execute(
+        "UPDATE personal_access_tokens SET state = 'revoked', updated_at = ?"
+        " WHERE id = ?",
+        (time.time(), pat_id),
+    )
+
+
+def resolve_token(db, token: str) -> str | None:
+    """Bearer token → role, or None. Valid = active token, not expired,
+    owned by an enabled user."""
+    if not token.startswith(TOKEN_PREFIX):
+        return None
+    row = db.query_one(
+        "SELECT t.expires_at, u.role, u.state AS user_state FROM"
+        " personal_access_tokens t JOIN users u ON u.id = t.user_id"
+        " WHERE t.token_hash = ? AND t.state = 'active'",
+        (_hash_token(token),),
+    )
+    if row is None or row["user_state"] != "enabled":
+        return None
+    if row["expires_at"] and row["expires_at"] < time.time():
+        return None
+    return row["role"]

@@ -43,6 +43,21 @@ class ApiError(Exception):
         self.status = status
 
 
+def _ttl_of(body: dict, default: float) -> float:
+    """Validated token TTL from a request body: numeric, non-negative
+    (0 = never expires for PATs; the session default applies a cap).
+    A string or negative ttl is a client error, not a 500 and not an
+    accidental forever-token."""
+    raw = body.get("ttl", default)
+    try:
+        ttl = float(raw)
+    except (TypeError, ValueError):
+        raise ApiError(400, f"ttl must be a number of seconds, got {raw!r}")
+    if ttl < 0:
+        raise ApiError(400, "ttl must be >= 0")
+    return ttl
+
+
 class RestApi:
     """Route handlers; one instance per server, stateless per request."""
 
@@ -223,6 +238,112 @@ class RestApi:
         self.models.delete(req["model_id"], int(req["version"]))
         return {"deleted": req["model_id"], "version": int(req["version"])}
 
+    # -- users + personal access tokens (reference manager/handlers
+    # users.go / personal_access_tokens.go; roles stand in for casbin) ---
+    @route("GET", "/api/v1/users")
+    def list_users(self, req):
+        return self.db.query(
+            "SELECT id, name, email, role, state, created_at, updated_at"
+            " FROM users ORDER BY id"
+        )
+
+    @route("POST", "/api/v1/users", write=True)
+    def create_user(self, req):
+        from dragonfly2_tpu.manager import auth
+
+        body = req["body"]
+        try:
+            row = auth.create_user(
+                self.db,
+                body.get("name", ""),
+                body.get("password", ""),
+                role=body.get("role", "guest"),
+                email=body.get("email", ""),
+            )
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        return {k: v for k, v in row.items() if not k.startswith("password")}
+
+    @route("PATCH", "/api/v1/users/:id", write=True)
+    def update_user(self, req):
+        body = req["body"]
+        sets, params = [], []
+        if "role" in body:
+            from dragonfly2_tpu.manager.auth import ROLES
+
+            if body["role"] not in ROLES:
+                raise ApiError(400, f"role must be one of {ROLES}")
+            sets.append("role = ?")
+            params.append(body["role"])
+        if "state" in body:
+            if body["state"] not in ("enabled", "disabled"):
+                raise ApiError(400, "state must be 'enabled' or 'disabled'")
+            sets.append("state = ?")
+            params.append(body["state"])
+        if not sets:
+            raise ApiError(400, "no updatable fields in body")
+        sets.append("updated_at = ?")
+        params += [time.time(), int(req["id"])]
+        self.db.execute(f"UPDATE users SET {', '.join(sets)} WHERE id = ?", tuple(params))
+        row = self.db.query_one(
+            "SELECT id, name, email, role, state FROM users WHERE id = ?",
+            (int(req["id"]),),
+        )
+        if row is None:
+            raise ApiError(404, "user not found")
+        return row
+
+    @route("POST", "/api/v1/users/signin")
+    def signin(self, req):
+        """Password → short-lived session token (the console's login;
+        reference issues a session JWT — here a TTL'd PAT)."""
+        from dragonfly2_tpu.manager import auth
+
+        body = req["body"]
+        user = auth.verify_password(
+            self.db, body.get("name", ""), body.get("password", "")
+        )
+        if user is None:
+            raise ApiError(401, "bad credentials")
+        token, _ = auth.create_pat(
+            self.db, user["id"], "session",
+            ttl=_ttl_of(body, default=24 * 3600.0),
+        )
+        return {"token": token, "role": user["role"]}
+
+    @route("GET", "/api/v1/users/:id/personal-access-tokens")
+    def list_pats(self, req):
+        return self.db.query(
+            "SELECT id, user_id, name, state, expires_at, created_at"
+            " FROM personal_access_tokens WHERE user_id = ? ORDER BY id",
+            (int(req["id"]),),
+        )
+
+    @route("POST", "/api/v1/users/:id/personal-access-tokens", write=True)
+    def create_pat(self, req):
+        from dragonfly2_tpu.manager import auth
+
+        user = self.db.query_one(
+            "SELECT id FROM users WHERE id = ?", (int(req["id"]),)
+        )
+        if user is None:
+            raise ApiError(404, "user not found")
+        token, row = auth.create_pat(
+            self.db,
+            user["id"],
+            req["body"].get("name", "token"),
+            ttl=_ttl_of(req["body"], default=0.0),
+        )
+        # plaintext returned exactly once; only the hash is stored
+        return {"token": token, "id": row["id"], "name": row["name"]}
+
+    @route("DELETE", "/api/v1/users/:id/personal-access-tokens/:pat_id", write=True)
+    def revoke_pat(self, req):
+        from dragonfly2_tpu.manager import auth
+
+        auth.revoke_pat(self.db, int(req["pat_id"]))
+        return {"revoked": int(req["pat_id"])}
+
     # -- applications ----------------------------------------------------
     @route("GET", "/api/v1/applications")
     def list_applications(self, req):
@@ -267,13 +388,30 @@ class RestServer:
 
     # ------------------------------------------------------------------
     def _role_for(self, auth_header: str | None) -> str | None:
-        """→ role, or None when unauthenticated. No tokens configured =
-        open admin access (dev mode, like the reference without auth)."""
-        if not self.tokens:
-            return "admin"
+        """→ role, or None when unauthenticated. Config tokens are
+        checked first, then DB-backed personal access tokens (auth.py).
+        No config tokens AND no users = open admin access (dev mode,
+        like the reference without auth)."""
+        from dragonfly2_tpu.manager import auth
+
+        token = ""
         if auth_header and auth_header.startswith("Bearer "):
-            return self.tokens.get(auth_header[7:])
+            token = auth_header[7:]
+        if token:
+            role = self.tokens.get(token)
+            if role is not None:
+                return role
+            role = auth.resolve_token(self.api.db, token)
+            if role is not None:
+                return role
+        if not self.tokens and not self._has_users():
+            return "admin"
         return None
+
+    def _has_users(self) -> bool:
+        return (
+            self.api.db.query_one("SELECT id FROM users LIMIT 1") is not None
+        )
 
     def start(self) -> str:
         api = self.api
@@ -309,9 +447,13 @@ class RestServer:
                     m = rx.match(parts.path)
                     if not m:
                         continue
-                    # health probes stay unauthenticated (LBs and
-                    # liveness checks don't carry tokens)
-                    if role is None and parts.path != "/healthy":
+                    # health probes and signin stay unauthenticated (LBs
+                    # don't carry tokens; signin EXCHANGES credentials
+                    # for one)
+                    if role is None and parts.path not in (
+                        "/healthy",
+                        "/api/v1/users/signin",
+                    ):
                         return self._send(401, {"error": "unauthorized"})
                     if write and role != "admin":
                         return self._send(403, {"error": "forbidden (read-only role)"})

@@ -175,12 +175,17 @@ def serve(
     max_workers: int = 16,
     tls: "tuple[bytes, bytes] | None" = None,  # (key_pem, cert_pem)
     client_ca: bytes | None = None,  # require client certs signed by this CA
+    extra_addresses: "list[str] | None" = None,
 ) -> tuple[grpc.Server, int]:
     """Start a server hosting {service_name: implementation}; returns
     (server, bound_port). With ``tls`` the port is TLS-terminated using
     the issued server cert (utils/issuer); ``client_ca`` additionally
     enforces mTLS (reference manager-issued certs, pkg/issuer +
-    scheduler.go:179-218)."""
+    scheduler.go:179-218). ``extra_addresses`` bind the same services on
+    additional listeners — e.g. ``unix:/run/dfdaemon.sock`` for the
+    local-CLI path (reference pkg/rpc/mux.go serves tcp+unix+vsock from
+    one grpc.Server); extras are plaintext, the filesystem is their
+    access control."""
     from concurrent import futures
 
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
@@ -195,6 +200,8 @@ def serve(
         port = server.add_secure_port(address, creds)
     else:
         port = server.add_insecure_port(address)
+    for extra in extra_addresses or []:
+        server.add_insecure_port(extra)
     server.start()
     return server, port
 
@@ -206,6 +213,7 @@ def dial(
     tls_ca: bytes | None = None,
     tls_client: "tuple[bytes, bytes] | None" = None,  # (key_pem, cert_pem)
     tls_server_name: str | None = None,
+    ready_timeout: float = 5.0,
 ) -> grpc.Channel:
     """Channel with connection wait + simple retry-on-dial (reference
     pkg/rpc client dialing uses retry/backoff interceptors). ``tls_ca``
@@ -230,12 +238,13 @@ def dial(
                 channel = grpc.secure_channel(address, creds, options=options)
             else:
                 channel = grpc.insecure_channel(address, options=options)
-            grpc.channel_ready_future(channel).result(timeout=5)
+            grpc.channel_ready_future(channel).result(timeout=ready_timeout)
             return channel
         except Exception as e:  # pragma: no cover - network timing
             last = e
             channel.close()  # else the failed channel keeps reconnect threads alive
-            time.sleep(backoff * (2**attempt))
+            if attempt + 1 < retries:  # no pointless sleep after the last try
+                time.sleep(backoff * (2**attempt))
     raise ConnectionError(f"failed to dial {address}: {last}")
 
 

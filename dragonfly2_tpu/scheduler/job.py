@@ -97,6 +97,8 @@ class JobWorker:
             return "failed", {"error": f"bad args: {e}"}
         try:
             if job.type == "preheat":
+                if args.get("type") == "image":
+                    return self._preheat_image(args)
                 return self._preheat(args)
             if job.type == "sync_peers":
                 return self._sync_peers(args)
@@ -141,6 +143,27 @@ class JobWorker:
                 triggered.append(task_id)
         return "succeeded", {"triggered": triggered, "count": len(triggered)}
 
+    def _preheat_image(self, args: dict) -> tuple[str, dict]:
+        """Image preheat: resolve a registry manifest URL into its layer
+        blob URLs, then seed each layer (reference manager/job/preheat.go
+        :126-165 image-manifest → layer URLs fan-out). Multi-arch indexes
+        pick ``args["platform"]`` (default linux/amd64)."""
+        url = args.get("url", "")
+        if "/manifests/" not in url:
+            return "failed", {"error": "image preheat needs a /v2/<name>/manifests/<ref> url"}
+        layers = resolve_image_layers(
+            url,
+            platform=args.get("platform", "linux/amd64"),
+            headers=args.get("headers") or {},
+        )
+        if not layers:
+            return "failed", {"error": "manifest resolved to zero layers"}
+        out_state, out = self._preheat(
+            {**args, "type": "", "url": "", "urls": layers, "digest": ""}
+        )
+        out["layers"] = len(layers)
+        return out_state, out
+
     # -- sync_peers (reference scheduler/job syncPeers) -----------------
     def _sync_peers(self, args: dict) -> tuple[str, dict]:
         hosts = []
@@ -160,3 +183,64 @@ class JobWorker:
             for p in self.resource.peer_manager.all()
         ]
         return "succeeded", {"hosts": hosts, "peers": peers}
+
+
+# ---------------------------------------------------------------------------
+# Image manifest resolution (reference manager/job/preheat.go:126-165)
+# ---------------------------------------------------------------------------
+
+MANIFEST_ACCEPT = ", ".join(
+    [
+        "application/vnd.docker.distribution.manifest.v2+json",
+        "application/vnd.oci.image.manifest.v1+json",
+        "application/vnd.docker.distribution.manifest.list.v2+json",
+        "application/vnd.oci.image.index.v1+json",
+    ]
+)
+
+_INDEX_TYPES = (
+    "application/vnd.docker.distribution.manifest.list.v2+json",
+    "application/vnd.oci.image.index.v1+json",
+)
+
+
+def _fetch_manifest(url: str, headers: dict, timeout: float) -> dict:
+    import urllib.request
+
+    from dragonfly2_tpu.client.source import open_url
+
+    req = urllib.request.Request(url, headers={**headers, "Accept": MANIFEST_ACCEPT})
+    with open_url(req, timeout) as resp:
+        return json.loads(resp.read())
+
+
+def resolve_image_layers(
+    manifest_url: str,
+    platform: str = "linux/amd64",
+    headers: dict | None = None,
+    timeout: float = 30.0,
+) -> list[str]:
+    """``…/v2/<name>/manifests/<ref>`` → layer blob URLs. Multi-arch
+    manifest lists/indexes are narrowed to ``platform`` ("os/arch")
+    before the per-arch manifest is fetched (reference preheat.go
+    platform handling)."""
+    headers = dict(headers or {})
+    base = manifest_url.rsplit("/manifests/", 1)[0]
+    body = _fetch_manifest(manifest_url, headers, timeout)
+    manifests = body.get("manifests")
+    if manifests and (body.get("mediaType") in _INDEX_TYPES or "layers" not in body):
+        want_os, _, want_arch = platform.partition("/")
+        chosen = None
+        for m in manifests:
+            plat = m.get("platform") or {}
+            if plat.get("os") == want_os and plat.get("architecture") == want_arch:
+                chosen = m
+                break
+        if chosen is None:
+            raise ValueError(f"no manifest for platform {platform!r} in index")
+        body = _fetch_manifest(f"{base}/manifests/{chosen['digest']}", headers, timeout)
+    return [
+        f"{base}/blobs/{layer['digest']}"
+        for layer in body.get("layers", [])
+        if layer.get("digest")
+    ]

@@ -74,8 +74,10 @@ class _V1StreamAdapter:
 
     def send(self, decision) -> None:
         if isinstance(decision, NormalTaskResponse):
+            # Scheduling only emits NormalTaskResponse with candidates
+            # (scheduling.py sends back-to-source otherwise)
             parents = decision.candidate_parents
-            task = parents[0].task if parents else None
+            task = parents[0].task
             pkt = v1.PeerPacket(
                 task_id=self.task_id,
                 src_pid=self.src_pid,
@@ -83,11 +85,10 @@ class _V1StreamAdapter:
                 main_peer=_dest_peer(parents[0]),
                 candidate_peers=[_dest_peer(p) for p in parents[1:]],
                 code=v1.CODE_SUCCESS,
+                task_content_length=task.content_length,
+                task_total_piece_count=task.total_piece_count,
+                task_piece_length=task.piece_length,
             )
-            if task is not None:
-                pkt.task_content_length = task.content_length
-                pkt.task_total_piece_count = task.total_piece_count
-                pkt.task_piece_length = task.piece_length
         elif isinstance(decision, NeedBackToSourceResponse):
             # unlike v2, the v1 client never sends an explicit
             # back-to-source-started event — the code on this packet IS

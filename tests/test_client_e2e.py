@@ -212,3 +212,53 @@ def test_host_stats_flow_into_download_records(cluster):
     assert host.memory.total > 0
     assert host.disk.total > 0
     assert host.cpu.logical_count > 0
+
+
+def test_stream_task_frontend(cluster):
+    """Stream frontend (reference peertask_stream.go): bytes yield in
+    piece order while the download is live, and a completed local task
+    streams from disk."""
+    from dragonfly2_tpu.client.peertask import FileTaskRequest
+
+    da, db = cluster["daemons"]
+    url = cluster["url"]
+    # daemon A seeds via the seed frontend (origin-first registration)
+    task_id, _, conductor = da.task_manager.start_seed_task(url)
+    assert conductor is not None
+    assert conductor.wait(10).done
+    ts_a = da.storage.find_completed_task(task_id)
+    assert all(
+        p.traffic_type == TRAFFIC_BACK_TO_SOURCE for p in ts_a.meta.pieces.values()
+    )
+
+    # daemon B streams the task: live P2P download, chunks arrive in order
+    sid, _, content_length, headers, body = db.task_manager.start_stream_task(
+        FileTaskRequest(url=url), timeout=10
+    )
+    assert sid == task_id
+    assert content_length == len(PAYLOAD)
+    data = b"".join(body)
+    assert data == PAYLOAD
+
+    # second stream on B = reuse path, served from completed local storage
+    sid2, _, cl2, _, body2 = db.task_manager.start_stream_task(
+        FileTaskRequest(url=url), timeout=10
+    )
+    assert sid2 == task_id and cl2 == len(PAYLOAD)
+    assert b"".join(body2) == PAYLOAD
+
+
+def test_stream_task_failure_raises(cluster, tmp_path):
+    """A stream on a task that can neither find parents nor back-source
+    must raise, not hang."""
+    from dragonfly2_tpu.client.peertask import FileTaskRequest
+
+    da, _ = cluster["daemons"]
+    with pytest.raises((IOError, TimeoutError, RuntimeError)):
+        _, _, _, _, body = da.task_manager.start_stream_task(
+            FileTaskRequest(
+                url=f"file://{tmp_path}/definitely-missing.bin",
+            ),
+            timeout=5,
+        )
+        b"".join(body)

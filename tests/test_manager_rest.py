@@ -202,3 +202,78 @@ def test_console_served_at_root(rest):
     assert "/api/v1/scheduler-clusters" in page
     assert "/api/v1/models" in page
     assert "setModelState" in page
+
+
+def test_users_and_pats(tmp_path):
+    """DB-backed users + personal access tokens: bootstrap in dev mode,
+    then auth flips on — PATs and signin tokens resolve to roles, config
+    tokens keep working (reference manager users/PAT surface)."""
+    db = Database(tmp_path / "u.db")
+    models = ModelRegistry(db, FSObjectStorage(tmp_path / "obj"))
+    server = RestServer(ManagerService(db, models))  # no config tokens
+    addr = server.start()
+    try:
+        # dev mode: open admin until the first user exists
+        status, _ = call(addr, "GET", "/api/v1/users", token=None)
+        assert status == 200
+        status, admin = call(
+            addr, "POST", "/api/v1/users",
+            {"name": "root", "password": "s3cret", "role": "admin"}, token=None,
+        )
+        assert status == 200 and "password_hash" not in admin
+        # auth is now enforced
+        status, _ = call(addr, "GET", "/api/v1/users", token=None)
+        assert status == 401
+        # signin exchanges the password for a session token
+        status, session = call(
+            addr, "POST", "/api/v1/users/signin",
+            {"name": "root", "password": "s3cret"}, token=None,
+        )
+        assert status == 200 and session["role"] == "admin"
+        tok = session["token"]
+        # bad password refused
+        status, _ = call(
+            addr, "POST", "/api/v1/users/signin",
+            {"name": "root", "password": "wrong"}, token=None,
+        )
+        assert status == 401
+        # the session token authenticates as admin
+        status, _ = call(addr, "GET", "/api/v1/users", token=tok)
+        assert status == 200
+        # mint a guest user + PAT: read-only enforcement
+        status, guest = call(
+            addr, "POST", "/api/v1/users",
+            {"name": "viewer", "password": "pw", "role": "guest"}, token=tok,
+        )
+        status, pat = call(
+            addr, "POST", f"/api/v1/users/{guest['id']}/personal-access-tokens",
+            {"name": "ci"}, token=tok,
+        )
+        assert status == 200 and pat["token"].startswith("dfp_")
+        status, _ = call(addr, "GET", "/api/v1/schedulers", token=pat["token"])
+        assert status == 200
+        status, _ = call(
+            addr, "POST", "/api/v1/scheduler-clusters", {"name": "x"},
+            token=pat["token"],
+        )
+        assert status == 403  # guest is read-only
+        # revocation kills the token
+        status, _ = call(
+            addr, "DELETE",
+            f"/api/v1/users/{guest['id']}/personal-access-tokens/{pat['id']}",
+            token=tok,
+        )
+        assert status == 200
+        status, _ = call(addr, "GET", "/api/v1/schedulers", token=pat["token"])
+        assert status == 401
+        # disabling a user kills their remaining tokens
+        status, pat2 = call(
+            addr, "POST", f"/api/v1/users/{guest['id']}/personal-access-tokens",
+            {"name": "ci2"}, token=tok,
+        )
+        call(addr, "PATCH", f"/api/v1/users/{guest['id']}", {"state": "disabled"}, token=tok)
+        status, _ = call(addr, "GET", "/api/v1/schedulers", token=pat2["token"])
+        assert status == 401
+    finally:
+        server.stop()
+        db.close()

@@ -1,0 +1,118 @@
+"""Image-manifest preheat: registry manifest → layer blob URLs → seed
+fan-out (reference manager/job/preheat.go:126-165), against a fake
+registry."""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from dragonfly2_tpu.scheduler.job import JobWorker, resolve_image_layers
+
+LAYERS = [
+    {"digest": "sha256:aaa", "size": 10},
+    {"digest": "sha256:bbb", "size": 20},
+]
+MANIFEST = {
+    "mediaType": "application/vnd.docker.distribution.manifest.v2+json",
+    "layers": LAYERS,
+}
+INDEX = {
+    "mediaType": "application/vnd.oci.image.index.v1+json",
+    "manifests": [
+        {"digest": "sha256:arm-manifest", "platform": {"os": "linux", "architecture": "arm64"}},
+        {"digest": "sha256:amd-manifest", "platform": {"os": "linux", "architecture": "amd64"}},
+    ],
+}
+
+
+@pytest.fixture
+def registry():
+    class Handler(http.server.BaseHTTPRequestHandler):
+        accepts: list[str] = []
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            Handler.accepts.append(self.headers.get("Accept", ""))
+            if self.path == "/v2/lib/nginx/manifests/latest":
+                body = MANIFEST
+            elif self.path == "/v2/lib/nginx/manifests/multi":
+                body = INDEX
+            elif self.path == "/v2/lib/nginx/manifests/sha256:amd-manifest":
+                body = MANIFEST
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            data = json.dumps(body).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_port}", Handler
+    httpd.shutdown()
+
+
+def test_resolve_plain_manifest(registry):
+    base, handler = registry
+    urls = resolve_image_layers(f"{base}/v2/lib/nginx/manifests/latest")
+    assert urls == [
+        f"{base}/v2/lib/nginx/blobs/sha256:aaa",
+        f"{base}/v2/lib/nginx/blobs/sha256:bbb",
+    ]
+    # the manifest request advertised the manifest media types
+    assert "manifest.v2+json" in handler.accepts[-1]
+
+
+def test_resolve_multiarch_index(registry):
+    base, _ = registry
+    urls = resolve_image_layers(
+        f"{base}/v2/lib/nginx/manifests/multi", platform="linux/amd64"
+    )
+    assert [u.rsplit("/", 1)[1] for u in urls] == ["sha256:aaa", "sha256:bbb"]
+    with pytest.raises(ValueError):
+        resolve_image_layers(
+            f"{base}/v2/lib/nginx/manifests/multi", platform="linux/s390x"
+        )
+
+
+class SeedSpy:
+    def __init__(self):
+        self.triggered = []
+
+    def seed_hosts(self):
+        return ["seed-1"]
+
+    def trigger(self, task_id, url, **kw):
+        self.triggered.append(url)
+        return True
+
+
+def test_image_preheat_job_fans_out_layers(registry):
+    base, _ = registry
+    worker = JobWorker(manager_client=None, resource=None, seed_client=SeedSpy())
+    state, result = worker._execute(
+        type(
+            "J",
+            (),
+            {
+                "id": 1,
+                "type": "preheat",
+                "args_json": json.dumps(
+                    {"type": "image", "url": f"{base}/v2/lib/nginx/manifests/latest"}
+                ),
+            },
+        )()
+    )
+    assert state == "succeeded"
+    assert result["layers"] == 2 and result["count"] == 2
+    assert worker.seed_client.triggered == [
+        f"{base}/v2/lib/nginx/blobs/sha256:aaa",
+        f"{base}/v2/lib/nginx/blobs/sha256:bbb",
+    ]

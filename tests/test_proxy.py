@@ -111,6 +111,21 @@ def _proxy_get(proxy_port: int, url: str):
         return resp.read(), dict(resp.headers)
 
 
+def _wait_completed(storage, task_id, timeout=5.0):
+    """Streaming responses end at the last byte; the conductor's finish
+    handshake (scheduler DownloadPeerFinished) completes moments later —
+    poll for the locally-completed task instead of assuming it."""
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        ts = storage.find_completed_task(task_id)
+        if ts is not None:
+            return ts
+        time.sleep(0.02)
+    raise AssertionError(f"task {task_id[:16]} never completed locally")
+
+
 def test_matching_request_rides_p2p(proxy_cluster):
     da, db = proxy_cluster["daemons"]
     url = proxy_cluster["origin"] + "/blob.bin"
@@ -124,7 +139,7 @@ def test_matching_request_rides_p2p(proxy_cluster):
     assert body_b == BLOB
     assert headers_b["X-Dragonfly-Via-P2P"] == "1"
     task_id = headers_b["X-Dragonfly-Task-Id"]
-    ts = db.storage.find_completed_task(task_id)
+    ts = _wait_completed(db.storage, task_id)
     assert {p.traffic_type for p in ts.meta.pieces.values()} == {TRAFFIC_REMOTE_PEER}
 
 
@@ -236,7 +251,7 @@ def test_p2p_response_preserves_content_type(proxy_cluster):
     assert headers_b["X-Dragonfly-Via-P2P"] == "1"
     assert headers_b.get("Content-Type") == "application/octet-stream"
     task_id = headers_b["X-Dragonfly-Task-Id"]
-    ts = db.storage.find_completed_task(task_id)
+    ts = _wait_completed(db.storage, task_id)
     assert {p.traffic_type for p in ts.meta.pieces.values()} == {TRAFFIC_REMOTE_PEER}
 
 
