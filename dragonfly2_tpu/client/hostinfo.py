@@ -102,6 +102,8 @@ def collect(data_dir: str = "/", upload_ports: tuple[int, ...] = ()) -> HostStat
             s.disk.used_percent = 100.0 * s.disk.used / s.disk.total
         s.disk.inodes_total = st.f_files
         s.disk.inodes_used = st.f_files - st.f_ffree
+        if s.disk.inodes_total > 0:
+            s.disk.inodes_used_percent = 100.0 * s.disk.inodes_used / s.disk.inodes_total
     except OSError:  # pragma: no cover - data_dir vanished
         pass
     return s

@@ -19,6 +19,10 @@ from typing import Protocol
 
 import numpy as np
 
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("scheduler.evaluator")
+
 from dragonfly2_tpu.scheduler.resource import (
     PEER_STATE_BACK_TO_SOURCE,
     PEER_STATE_FAILED,
@@ -173,6 +177,23 @@ class MLEvaluator(BaseEvaluator):
         super().__init__()
 
     def set_model(self, model) -> None:
+        # a model trained against an older feature schema must be refused
+        # LOUDLY at install time — a silent per-schedule fallback would
+        # disable ML scheduling with no operator signal (the feature dim
+        # changes when the schema grows, e.g. 12 → 18)
+        dim = getattr(model, "feature_dim", None)
+        if model is not None and dim is not None:
+            from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
+
+            if dim != MLP_FEATURE_DIM:
+                logger.warning(
+                    "rejecting model with feature_dim=%d (current schema is %d);"
+                    " keeping %s — retrain to re-enable ML scheduling",
+                    dim,
+                    MLP_FEATURE_DIM,
+                    "previous model" if self._model is not None else "base evaluator",
+                )
+                return
         self._model = model
 
     def evaluate_parents(
@@ -188,7 +209,11 @@ class MLEvaluator(BaseEvaluator):
             order = np.argsort(costs, kind="stable")
             return [parents[int(i)] for i in order]
         except Exception:
-            # degraded mode: never fail scheduling because of the model
+            # degraded mode: never fail scheduling because of the model —
+            # but say so, or operators can't tell ML scheduling is off
+            logger.warning(
+                "ml evaluator predict failed; using base ranking", exc_info=True
+            )
             return super().evaluate_parents(parents, child, total_piece_count)
 
 
@@ -227,6 +252,12 @@ def pair_features(parent: Peer, child: Peer, total_piece_count: int) -> np.ndarr
             math.log1p(h.network.upload_tcp_connection_count) / 10.0,
             h.disk.used_percent / 100.0,
             1.0 if parent.fsm.is_state(PEER_STATE_SUCCEEDED) else 0.0,
+            h.cpu.process_percent / 100.0,
+            h.memory.available / max(h.memory.total, 1),
+            h.disk.inodes_used_percent / 100.0,
+            child.host.cpu.percent / 100.0,
+            child.host.memory.used_percent / 100.0,
+            math.log1p(max(child.task.content_length, 0)) / 30.0,
         ],
         dtype=np.float32,
     )

@@ -42,6 +42,16 @@ MLP_FEATURE_NAMES = (
     "upload_tcp_connection_log",
     "disk_used_percent",
     "parent_succeeded",
+    # full host-stat surface (reference types.go:59-128 records it all;
+    # the default evaluator ignores it — extra signal is the point of
+    # the learned scorer). Excluded on purpose: upload_piece_count
+    # (pieces served to THIS child — label leakage).
+    "cpu_process_percent",
+    "mem_available_ratio",
+    "inodes_used_percent",
+    "child_cpu_percent",
+    "child_mem_used_percent",
+    "task_size_log",
 )
 MLP_FEATURE_DIM = len(MLP_FEATURE_NAMES)
 
@@ -139,6 +149,23 @@ def extract_pair_features(cols: dict[str, np.ndarray]) -> PairExamples:
     disk = pg("host.disk.used_percent") / 100.0
     succeeded = pg_str("state") == "Succeeded"
 
+    cpu_proc = pg("host.cpu.process_percent") / 100.0
+    mem_avail = pg("host.memory.available") / np.maximum(pg("host.memory.total"), 1.0)
+    inodes = pg("host.disk.inodes_used_percent") / 100.0
+    child_cpu = np.broadcast_to(
+        (cols["host.cpu.percent"].astype(np.float64) / 100.0)[:, None], (n, P)
+    )
+    child_mem = np.broadcast_to(
+        (cols["host.memory.used_percent"].astype(np.float64) / 100.0)[:, None], (n, P)
+    )
+    task_size = np.broadcast_to(
+        (
+            np.log1p(np.maximum(cols["task.content_length"].astype(np.float64), 0.0))
+            / 30.0
+        )[:, None],
+        (n, P),
+    )
+
     feats = np.stack(
         [
             finished_ratio,
@@ -153,6 +180,12 @@ def extract_pair_features(cols: dict[str, np.ndarray]) -> PairExamples:
             utcp,
             disk,
             succeeded.astype(np.float64),
+            cpu_proc,
+            mem_avail,
+            inodes,
+            child_cpu,
+            child_mem,
+            task_size,
         ],
         axis=-1,
     ).astype(np.float32)  # [N, P, F]

@@ -79,9 +79,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.df_pairs_rows.restype = c_long
     lib.df_pairs_errors.argtypes = [c_void_p]
     lib.df_pairs_errors.restype = c_long
+    u16_p = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")
     lib.df_pairs_export.argtypes = [c_void_p, f32_p, f32_p, i32_p]
     lib.df_pairs_take.argtypes = [c_void_p, f32_p, f32_p, i32_p]
     lib.df_pairs_take.restype = c_long
+    lib.df_pairs_take_half.argtypes = [c_void_p, u16_p, u16_p, i32_p]
+    lib.df_pairs_take_half.restype = c_long
     lib.df_topo_rows.argtypes = [c_void_p]
     lib.df_topo_rows.restype = c_long
 
@@ -274,6 +277,7 @@ def stream_pairs_file(
     chunk_bytes: int = _CHUNK,
     max_records: int | None = None,
     offset: int = 0,
+    half: bool = False,
 ):
     """Stream-decode download-record CSV file(s) into (features, labels)
     numpy shards — one shard per fed chunk — in bounded memory (the
@@ -327,27 +331,35 @@ def stream_pairs_file(
                             break
                         remaining -= len(chunk)
                         lib.df_pairs_feed(handle, chunk, len(chunk))
-                        yield _take(lib, handle)
+                        yield _take(lib, handle, half)
                         if max_records is not None:
                             if lib.df_pairs_rows(handle) >= max_records:
                                 lib.df_pairs_finish(handle)
-                                yield _take(lib, handle)
+                                yield _take(lib, handle, half)
                                 return
                 # per-span flush: emit the last record even when it lacks
                 # a trailing newline, and reset quote parity
                 lib.df_pairs_finish(handle)
-                yield _take(lib, handle)
+                yield _take(lib, handle, half)
     finally:
         lib.df_pairs_free(handle)
 
 
-def _take(lib, handle):
+def _take(lib, handle, half: bool = False):
     m = lib.df_pairs_count(handle)
-    feats = np.empty((m, MLP_FEATURE_DIM), dtype=np.float32)
-    labels = np.empty((m,), dtype=np.float32)
+    dt = np.float16 if half else np.float32
+    feats = np.empty((m, MLP_FEATURE_DIM), dtype=dt)
+    labels = np.empty((m,), dtype=dt)
     idx = np.empty((m,), dtype=np.int32)
     if m:
-        lib.df_pairs_take(handle, feats, labels, idx)
+        if half:
+            # cast rides the C-side copy (cache-hot, F16C) instead of a
+            # GIL-held numpy convert in the packing loop
+            lib.df_pairs_take_half(
+                handle, feats.view(np.uint16), labels.view(np.uint16), idx
+            )
+        else:
+            lib.df_pairs_take(handle, feats, labels, idx)
     return feats, labels, int(lib.df_pairs_rows(handle))
 
 

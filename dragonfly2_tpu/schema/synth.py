@@ -34,17 +34,34 @@ def _host(rng: np.random.Generator, hid: str, seed_peer: bool = False) -> R.Host
         os="linux",
         concurrent_upload_limit=int(rng.integers(50, 200)),
         concurrent_upload_count=int(rng.integers(0, 50)),
-        upload_count=int(rng.integers(0, 10_000)),
-        upload_failed_count=int(rng.integers(0, 100)),
-        cpu=R.CPU(logical_count=8, percent=float(rng.uniform(0, 100))),
-        memory=R.Memory(total=1 << 34, used_percent=float(rng.uniform(10, 95))),
+        upload_count=(uploads := int(rng.integers(0, 10_000))),
+        # bounded by uploads — a host can't fail more uploads than it served
+        upload_failed_count=int(rng.integers(0, max(uploads // 20, 1))),
+        cpu=R.CPU(
+            logical_count=8,
+            percent=float(rng.uniform(0, 100)),
+            process_percent=float(rng.uniform(0, 40)),
+        ),
+        memory=(
+            lambda used_pct, total: R.Memory(
+                total=total,
+                used_percent=used_pct,
+                used=int(total * used_pct / 100.0),
+                available=int(total * (100.0 - used_pct) / 100.0),
+            )
+        )(float(rng.uniform(10, 95)), 1 << 34),
         network=R.Network(
             tcp_connection_count=int(rng.integers(10, 2000)),
             upload_tcp_connection_count=int(rng.integers(0, 500)),
             location=str(rng.choice(_LOCS)),
             idc=str(rng.choice(_IDCS)),
         ),
-        disk=R.Disk(total=1 << 40, used_percent=float(rng.uniform(5, 90))),
+        disk=R.Disk(
+            total=1 << 40,
+            used_percent=float(rng.uniform(5, 90)),
+            inodes_total=1 << 24,
+            inodes_used_percent=float(rng.uniform(1, 60)),
+        ),
     )
 
 
@@ -157,6 +174,11 @@ def make_pair_tensors(
     """
     rng = np.random.default_rng(seed)
     x = rng.uniform(0, 1, size=(n, MLP_FEATURE_DIM)).astype(np.float32)
-    w = np.array([-1.2, -0.8, -0.9, -0.6, -1.5, -1.0, 0.9, 0.5, 0.4, 0.6, 0.3, -0.4], dtype=np.float32)
+    w = np.array(
+        [-1.2, -0.8, -0.9, -0.6, -1.5, -1.0, 0.9, 0.5, 0.4, 0.6, 0.3, -0.4,
+         0.7, -0.5, 0.2, 0.8, 0.6, -0.3],
+        dtype=np.float32,
+    )
+    assert w.shape[0] == MLP_FEATURE_DIM
     y = 3.0 + x @ w + 0.5 * np.sin(3.0 * x[:, 0]) * x[:, 4] + noise * rng.standard_normal(n).astype(np.float32)
     return x, y.astype(np.float32)

@@ -55,10 +55,11 @@ def stream_shards(
     paths,
     passes: int = 1,
     max_records: int | None = None,
-    queue_depth: int = 4,
+    queue_depth: int = 8,
     chunk_bytes: int = 8 * 1024 * 1024,
     offset: int = 0,
     workers: int = 1,
+    half: bool = False,
 ):
     """Generator of ``(feats, labels, total_rows)`` shards, decoded by
     background producer thread(s) through a bounded queue. ``total_rows``
@@ -103,6 +104,7 @@ def stream_shards(
                 passes=passes,
                 chunk_bytes=chunk_bytes,
                 max_records=max_records,
+                half=half,
             ):
                 item = (feats, labels, rows - prev_rows)
                 prev_rows = rows
@@ -292,6 +294,10 @@ def stream_train_mlp(
     pending_loss = None
     t0 = time.perf_counter()
 
+    # native-side f16 emit skips the GIL-held f32→f16 numpy convert in
+    # the packing loop below — the consumer thread is the bottleneck on
+    # small hosts
+    half = transfer_dtype == np.float16
     for feats, labels, rows in stream_shards(
         paths,
         passes=passes,
@@ -299,6 +305,7 @@ def stream_train_mlp(
         queue_depth=queue_depth,
         offset=offset,
         workers=workers,
+        half=half,
     ):
         stats.download_records = rows
         stats.pairs += feats.shape[0]
@@ -306,15 +313,22 @@ def stream_train_mlp(
             # warm-start the output bias at (an estimate of) the label
             # mean so the regression head doesn't spend its first steps
             # drifting there (train_mlp does the same with the full-data
-            # mean, train.py:137-138)
-            params["layers"][-1]["b"] = jnp.full((1,), float(labels.mean()))
+            # mean, train.py:137-138). dtype pinned to the init value's:
+            # a weak-typed scalar fill would give the first step a
+            # different jit signature than every later step — one extra
+            # XLA compile mid-stream
+            b = params["layers"][-1]["b"]
+            params["layers"][-1]["b"] = jnp.full((1,), float(labels.mean()), dtype=b.dtype)
             warm_bias = False
         if opt_state is None:
             opt_state = optimizer.init(params)
         if eval_every > 0 and feats.shape[0]:
             # content-hash holdout: same pair → same bucket on every pass
-            hv = feats.view(np.uint32).sum(axis=1, dtype=np.uint64)
-            hv = (hv * np.uint64(2654435761) + labels.view(np.uint32)) & np.uint64(
+            # (bucket assignment depends on the transfer dtype's bit
+            # pattern; deterministic within a run config either way)
+            u = np.uint16 if feats.dtype == np.float16 else np.uint32
+            hv = feats.view(u).sum(axis=1, dtype=np.uint64)
+            hv = (hv * np.uint64(2654435761) + labels.view(u)) & np.uint64(
                 0xFFFFFFFF
             )
             emask = (hv % np.uint64(eval_every)) == 0

@@ -83,6 +83,12 @@ class MLPScorer:
         self._params = params
         self._fn = jax.jit(score_parents)
 
+    @property
+    def feature_dim(self) -> int:
+        """Input width the model was trained for — MLEvaluator.set_model
+        refuses a scorer whose dim doesn't match the live schema."""
+        return int(self._params["layers"][0]["w"].shape[0])
+
     def predict(self, features: np.ndarray) -> np.ndarray:
         import jax.numpy as jnp
 

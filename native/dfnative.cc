@@ -29,7 +29,7 @@
 #include <unordered_map>
 #include <vector>
 
-#if defined(__AVX2__)
+#if defined(__AVX2__) || defined(__F16C__)
 #include <immintrin.h>
 #endif
 
@@ -38,7 +38,7 @@ namespace {
 constexpr int kMaxParents = 20;     // schema/records.py MAX_PARENTS
 constexpr int kMaxPieces = 10;      // MAX_PIECES_PER_PARENT
 constexpr int kMaxDestHosts = 5;    // MAX_DEST_HOSTS
-constexpr int kFeatureDim = 12;     // features.MLP_FEATURE_DIM
+constexpr int kFeatureDim = 18;     // features.MLP_FEATURE_DIM
 constexpr int kMaxLocationDepth = 5;
 constexpr double kNsPerMs = 1e6;
 
@@ -269,6 +269,11 @@ enum PairCol : uint8_t {
   C_TOTAL_PIECES,
   C_CHILD_IDC,
   C_CHILD_LOC,
+  C_CHILD_CPU,
+  C_CHILD_MEM,
+  C_TASK_LEN,
+  // every P_* kind must stay >= P_ID (the empty-slot fast-forward keys
+  // on that ordering)
   P_ID,
   P_STATE,
   P_FIN,
@@ -284,6 +289,10 @@ enum PairCol : uint8_t {
   P_TCP,
   P_UTCP,
   P_DISK,
+  P_CPU_PROC,
+  P_MEM_AVAIL,
+  P_MEM_TOTAL,
+  P_INODES,
   P_PIECE_COST,
 };
 
@@ -300,6 +309,7 @@ struct ParentScratch {
   std::string idc, loc;
   double fin = 0, upload_count = 0, upload_failed = 0, cul = 0, cuc = 0;
   double cpu = 0, mem = 0, tcp = 0, utcp = 0, disk = 0;
+  double cpu_proc = 0, mem_avail = 0, mem_total = 0, inodes = 0;
   double piece_cost[kMaxPieces];
   void reset() {
     has_id = succeeded = is_seed = false;
@@ -307,6 +317,7 @@ struct ParentScratch {
     loc.clear();
     fin = upload_count = upload_failed = cul = cuc = 0;
     cpu = mem = tcp = utcp = disk = 0;
+    cpu_proc = mem_avail = mem_total = inodes = 0;
     memset(piece_cost, 0, sizeof(piece_cost));
   }
 };
@@ -323,6 +334,7 @@ struct DfPairs {
   ParentScratch parents[kMaxParents];
   std::string child_idc, child_loc;
   double total_pieces = 0;
+  double child_cpu = 0, child_mem = 0, task_len = 0;
   int64_t row = 0;  // download-record counter (not counting headers)
   int64_t errors = 0;
 
@@ -338,10 +350,16 @@ struct DfPairs {
       ColAction a;
       if (name == "task.total_piece_count") {
         a.kind = C_TOTAL_PIECES;
+      } else if (name == "task.content_length") {
+        a.kind = C_TASK_LEN;
       } else if (name == "host.network.idc") {
         a.kind = C_CHILD_IDC;
       } else if (name == "host.network.location") {
         a.kind = C_CHILD_LOC;
+      } else if (name == "host.cpu.percent") {
+        a.kind = C_CHILD_CPU;
+      } else if (name == "host.memory.used_percent") {
+        a.kind = C_CHILD_MEM;
       } else if (name.rfind("parents.", 0) == 0) {
         const char* p = name.c_str() + 8;
         char* end;
@@ -367,6 +385,10 @@ struct DfPairs {
         else if (rest == "host.network.tcp_connection_count") a.kind = P_TCP;
         else if (rest == "host.network.upload_tcp_connection_count") a.kind = P_UTCP;
         else if (rest == "host.disk.used_percent") a.kind = P_DISK;
+        else if (rest == "host.cpu.process_percent") a.kind = P_CPU_PROC;
+        else if (rest == "host.memory.available") a.kind = P_MEM_AVAIL;
+        else if (rest == "host.memory.total") a.kind = P_MEM_TOTAL;
+        else if (rest == "host.disk.inodes_used_percent") a.kind = P_INODES;
         else if (rest.rfind("pieces.", 0) == 0) {
           const char* q = rest.c_str() + 7;
           long pj = strtol(q, &end, 10);
@@ -408,8 +430,11 @@ struct DfPairs {
     ParentScratch& ps = parents[a.parent];
     switch (a.kind) {
       case C_TOTAL_PIECES: total_pieces = to_num(f); break;
+      case C_TASK_LEN: task_len = to_num(f); break;
       case C_CHILD_IDC: child_idc.assign(p, n); break;
       case C_CHILD_LOC: child_loc.assign(p, n); break;
+      case C_CHILD_CPU: child_cpu = to_num(f); break;
+      case C_CHILD_MEM: child_mem = to_num(f); break;
       case P_ID: ps.has_id = true; break;
       case P_STATE: ps.succeeded = f.eq("Succeeded"); break;
       case P_FIN: ps.fin = to_num(f); break;
@@ -425,6 +450,10 @@ struct DfPairs {
       case P_TCP: ps.tcp = to_num(f); break;
       case P_UTCP: ps.utcp = to_num(f); break;
       case P_DISK: ps.disk = to_num(f); break;
+      case P_CPU_PROC: ps.cpu_proc = to_num(f); break;
+      case P_MEM_AVAIL: ps.mem_avail = to_num(f); break;
+      case P_MEM_TOTAL: ps.mem_total = to_num(f); break;
+      case P_INODES: ps.inodes = to_num(f); break;
       case P_PIECE_COST: ps.piece_cost[a.piece] = to_num(f); break;
       default: break;
     }
@@ -432,6 +461,7 @@ struct DfPairs {
 
   void reset_scratch() {
     total_pieces = 0;
+    child_cpu = child_mem = task_len = 0;
     child_idc.clear();
     child_loc.clear();
     for (auto& p : parents) p.reset();
@@ -668,6 +698,7 @@ struct DfPairs {
       if (free_upload > 1) free_upload = 1;
       bool idc_match = !p.idc.empty() && p.idc == child_idc;
 
+      double mem_total = p.mem_total > 1.0 ? p.mem_total : 1.0;
       const double f[kFeatureDim] = {
           finished_ratio,
           upload_success,
@@ -681,6 +712,12 @@ struct DfPairs {
           log1p(p.utcp) / 10.0,
           p.disk / 100.0,
           p.succeeded ? 1.0 : 0.0,
+          p.cpu_proc / 100.0,
+          p.mem_avail / mem_total,
+          p.inodes / 100.0,
+          child_cpu / 100.0,
+          child_mem / 100.0,
+          log1p(task_len > 0 ? task_len : 0.0) / 30.0,
       };
       for (double v : f) feat.push_back(float(v));
       double mean_cost_ms = cost_sum / cost_cnt / kNsPerMs;
@@ -916,6 +953,59 @@ long df_pairs_take(DfPairs* d, float* feat, float* label, int32_t* idx) {
   long m = long(d->label.size());
   memcpy(feat, d->feat.data(), d->feat.size() * sizeof(float));
   memcpy(label, d->label.data(), d->label.size() * sizeof(float));
+  memcpy(idx, d->index.data(), d->index.size() * sizeof(int32_t));
+  d->feat.clear();
+  d->label.clear();
+  d->index.clear();
+  return m;
+}
+
+// f32 → IEEE half (round-to-nearest-even) for the reduced-precision
+// device feed: converting at take time keeps the vectors cache-hot and
+// moves the cast off the GIL-held Python packing loop (the consumer is
+// the bottleneck on small hosts). F16C does 8 lanes per instruction when
+// the build arch has it; the scalar path is the bit-exact fallback.
+static inline uint16_t f32_to_f16(float v) {
+  uint32_t x;
+  memcpy(&x, &v, 4);
+  uint32_t sign = (x >> 16) & 0x8000u;
+  int32_t exp = int32_t((x >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = x & 0x7fffffu;
+  if (exp >= 31) return uint16_t(sign | 0x7c00u);  // inf/overflow (no NaN inputs here)
+  if (exp <= 0) {
+    if (exp < -10) return uint16_t(sign);
+    mant |= 0x800000u;
+    uint32_t shift = uint32_t(14 - exp);
+    uint32_t half = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t mid = 1u << (shift - 1);
+    if (rem > mid || (rem == mid && (half & 1))) ++half;
+    return uint16_t(sign | half);
+  }
+  uint32_t half = uint32_t(exp << 10) | (mant >> 13);
+  uint32_t rem = mant & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) ++half;
+  return uint16_t(sign | half);
+}
+
+static void f32_to_f16_buf(const float* in, uint16_t* out, size_t n) {
+#if defined(__F16C__)
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256 v = _mm256_loadu_ps(in + i);
+    __m128i h = _mm256_cvtps_ph(v, _MM_FROUND_TO_NEAREST_INT);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(out + i), h);
+  }
+  for (; i < n; ++i) out[i] = f32_to_f16(in[i]);
+#else
+  for (size_t i = 0; i < n; ++i) out[i] = f32_to_f16(in[i]);
+#endif
+}
+
+long df_pairs_take_half(DfPairs* d, uint16_t* feat, uint16_t* label, int32_t* idx) {
+  long m = long(d->label.size());
+  f32_to_f16_buf(d->feat.data(), feat, d->feat.size());
+  f32_to_f16_buf(d->label.data(), label, d->label.size());
   memcpy(idx, d->index.data(), d->index.size() * sizeof(int32_t));
   d->feat.clear();
   d->label.clear();

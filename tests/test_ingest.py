@@ -261,10 +261,13 @@ def test_eval_holdout_disjoint_from_training_across_passes(tmp_path):
     total = batch.features.shape[0]
     eval_every = 4
     # the exact per-pass holdout, recomputed with the same content hash
-    hv = batch.features.view(np.uint32).sum(axis=1, dtype=np.uint64)
-    hv = (hv * np.uint64(2654435761) + batch.labels.view(np.uint32)) & np.uint64(
-        0xFFFFFFFF
-    )
+    # the pipeline applies — over the TRANSFER dtype's bit pattern
+    # (float16 by default; native take_half and astype both round to
+    # nearest-even, so the bits match)
+    f16 = batch.features.astype(np.float16)
+    l16 = batch.labels.astype(np.float16)
+    hv = f16.view(np.uint16).sum(axis=1, dtype=np.uint64)
+    hv = (hv * np.uint64(2654435761) + l16.view(np.uint16)) & np.uint64(0xFFFFFFFF)
     holdout = int(((hv % np.uint64(eval_every)) == 0).sum())
     assert 0 < holdout < total
 
