@@ -383,6 +383,7 @@ class Daemon:
                 used_percent=s.disk.used_percent,
                 inodes_total=s.disk.inodes_total,
                 inodes_used=s.disk.inodes_used,
+                inodes_used_percent=s.disk.inodes_used_percent,
             ),
             scheduler_cluster_id=self.cfg.scheduler_cluster_id,
         )

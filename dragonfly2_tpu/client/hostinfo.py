@@ -59,6 +59,7 @@ class DiskStats:
     used_percent: float = 0.0
     inodes_total: int = 0
     inodes_used: int = 0
+    inodes_used_percent: float = 0.0
 
 
 @dataclass

@@ -132,6 +132,7 @@ def _host_from_info(info: common_pb2.HostInfo) -> res.Host:
     h.disk.used_percent = info.disk.used_percent
     h.disk.inodes_total = info.disk.inodes_total
     h.disk.inodes_used = info.disk.inodes_used
+    h.disk.inodes_used_percent = info.disk.inodes_used_percent
     h.network.tcp_connection_count = info.network.tcp_connection_count
     h.network.upload_tcp_connection_count = info.network.upload_tcp_connection_count
     h.network.location = info.network.location

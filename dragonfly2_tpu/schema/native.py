@@ -83,6 +83,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.df_pairs_export.argtypes = [c_void_p, f32_p, f32_p, i32_p]
     lib.df_pairs_take.argtypes = [c_void_p, f32_p, f32_p, i32_p]
     lib.df_pairs_take.restype = c_long
+    # ABI handshake: symbols added after the first release may be absent
+    # from an explicitly-overridden .so (DF_NATIVE_LIB skips the rebuild
+    # check by design) — missing symbol or a disagreeing feature width
+    # must degrade to the numpy path, not crash (load() catches this)
+    lib.df_feature_dim.restype = c_long
+    if lib.df_feature_dim() != MLP_FEATURE_DIM:
+        raise OSError(
+            f"native library feature dim {lib.df_feature_dim()} != schema"
+            f" {MLP_FEATURE_DIM} — stale build"
+        )
     lib.df_pairs_take_half.argtypes = [c_void_p, u16_p, u16_p, i32_p]
     lib.df_pairs_take_half.restype = c_long
     lib.df_topo_rows.argtypes = [c_void_p]
@@ -132,7 +142,9 @@ def load() -> ctypes.CDLL | None:
                 return None
         try:
             _lib = _bind(ctypes.CDLL(str(path)))
-        except OSError as e:
+        except (OSError, AttributeError) as e:
+            # AttributeError = missing symbol in an overridden/stale .so;
+            # either way the numpy fallback takes over
             logger.warning("native library load failed: %s", e)
             _load_failed = True
             return None

@@ -24,6 +24,9 @@ _LOCS = [
 
 
 def _host(rng: np.random.Generator, hid: str, seed_peer: bool = False) -> R.HostRecord:
+    uploads = int(rng.integers(0, 10_000))
+    mem_total = 1 << 34
+    mem_used_pct = float(rng.uniform(10, 95))
     return R.HostRecord(
         id=hid,
         type="super" if seed_peer else "normal",
@@ -34,7 +37,7 @@ def _host(rng: np.random.Generator, hid: str, seed_peer: bool = False) -> R.Host
         os="linux",
         concurrent_upload_limit=int(rng.integers(50, 200)),
         concurrent_upload_count=int(rng.integers(0, 50)),
-        upload_count=(uploads := int(rng.integers(0, 10_000))),
+        upload_count=uploads,
         # bounded by uploads — a host can't fail more uploads than it served
         upload_failed_count=int(rng.integers(0, max(uploads // 20, 1))),
         cpu=R.CPU(
@@ -42,14 +45,12 @@ def _host(rng: np.random.Generator, hid: str, seed_peer: bool = False) -> R.Host
             percent=float(rng.uniform(0, 100)),
             process_percent=float(rng.uniform(0, 40)),
         ),
-        memory=(
-            lambda used_pct, total: R.Memory(
-                total=total,
-                used_percent=used_pct,
-                used=int(total * used_pct / 100.0),
-                available=int(total * (100.0 - used_pct) / 100.0),
-            )
-        )(float(rng.uniform(10, 95)), 1 << 34),
+        memory=R.Memory(
+            total=mem_total,
+            used_percent=mem_used_pct,
+            used=int(mem_total * mem_used_pct / 100.0),
+            available=int(mem_total * (100.0 - mem_used_pct) / 100.0),
+        ),
         network=R.Network(
             tcp_connection_count=int(rng.integers(10, 2000)),
             upload_tcp_connection_count=int(rng.integers(0, 500)),

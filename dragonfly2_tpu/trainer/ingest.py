@@ -283,9 +283,17 @@ def stream_train_mlp(
             return jnp.asarray(buf)
 
     stats = StreamStats()
-    # packing buffer: fixed [batch_size, F+1] (features ‖ label), filled
-    # from variable shards; the f32→transfer_dtype convert rides the copy
-    buf = np.empty((batch_size, MLP_FEATURE_DIM + 1), transfer_dtype)
+    # Double-buffered packing: fixed [batch_size, F+1] (features ‖ label)
+    # buffers filled from variable shards. Two buffers because the CPU
+    # backend's asarray/device_put can be ZERO-COPY — the asynchronously
+    # dispatched step still reads the buffer while the loop packs the
+    # next batch, so each buffer is only reused after the step that read
+    # it has materialized its loss (a real TPU always copies on H2D, but
+    # correctness can't depend on the backend's copy behavior).
+    bufs = [np.empty((batch_size, MLP_FEATURE_DIM + 1), transfer_dtype) for _ in range(2)]
+    tokens: list = [None, None]  # per-buffer in-flight step output
+    cur = 0
+    buf = bufs[cur]
     fill = 0
     eval_cap_pairs = eval_max_batches * batch_size
     eval_x: list[np.ndarray] = []
@@ -356,7 +364,14 @@ def stream_train_mlp(
                 # async dispatch: the host returns to decoding while the
                 # chip trains this batch
                 params, opt_state, pending_loss = step(params, opt_state, put(buf))
+                tokens[cur] = pending_loss
                 stats.steps += 1
+                cur ^= 1
+                buf = bufs[cur]
+                if tokens[cur] is not None:
+                    # the step that read this buffer must be done before
+                    # the loop overwrites it (one-step overlap)
+                    jax.block_until_ready(tokens[cur])
                 fill = 0
     stats.eval_pairs = eval_collected
     if stats.steps == 0 and fill > 0:

@@ -1002,6 +1002,11 @@ static void f32_to_f16_buf(const float* in, uint16_t* out, size_t n) {
 #endif
 }
 
+// ABI handshake: the binding layer refuses a library whose feature
+// width disagrees with the python schema (a stale prebuilt .so via
+// DF_NATIVE_LIB would otherwise fill misaligned tensors silently).
+long df_feature_dim() { return kFeatureDim; }
+
 long df_pairs_take_half(DfPairs* d, uint16_t* feat, uint16_t* label, int32_t* idx) {
   long m = long(d->label.size());
   f32_to_f16_buf(d->feat.data(), feat, d->feat.size());

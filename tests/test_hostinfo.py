@@ -83,3 +83,31 @@ def test_host_stats_override_typo_fails_fast(tmp_path):
         )
     )
     assert d.host_stats().cpu.percent == 90.0
+
+
+def test_inodes_used_percent_round_trips_to_scheduler():
+    """Train/serve parity for the inode-pressure feature: the daemon's
+    announce carries disk.inodes_used_percent and the scheduler's host
+    copy keeps it — otherwise the model trains on a signal serving
+    always sees as 0."""
+    import common_pb2
+
+    from dragonfly2_tpu.client.hostinfo import HostStats
+    from dragonfly2_tpu.scheduler.service import _host_from_info
+
+    stats = HostStats()
+    assert stats.disk.inodes_used_percent == 0.0  # declared, not dynamic
+    info = common_pb2.HostInfo(
+        id="h1", disk=common_pb2.DiskStat(inodes_used_percent=37.5)
+    )
+    host = _host_from_info(info)
+    assert host.disk.inodes_used_percent == 37.5
+
+
+def test_host_stats_override_accepts_inodes_used_percent():
+    from dragonfly2_tpu.client.daemon import _apply_stat_overrides
+    from dragonfly2_tpu.client.hostinfo import HostStats
+
+    s = HostStats()
+    _apply_stat_overrides(s, {"disk.inodes_used_percent": 42.0})
+    assert s.disk.inodes_used_percent == 42.0
