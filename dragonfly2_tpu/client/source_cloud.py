@@ -73,50 +73,20 @@ class S3SourceClient(SourceClient):
         return f"https://{host}{path}", host, path
 
     def _sign(self, method, host, path, query, conf, extra_headers):
-        """SigV4 (AWS4-HMAC-SHA256) for an UNSIGNED-payload GET/HEAD."""
-        now = datetime.datetime.now(datetime.timezone.utc)
-        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
-        datestamp = now.strftime("%Y%m%d")
-        payload_hash = "UNSIGNED-PAYLOAD"
-        headers = {"host": host, "x-amz-content-sha256": payload_hash, "x-amz-date": amz_date}
-        headers.update({k.lower(): v for k, v in (extra_headers or {}).items()})
-        signed = ";".join(sorted(headers))
-        canonical = "\n".join(
-            [
-                method,
-                path,
-                query,
-                "".join(f"{k}:{headers[k]}\n" for k in sorted(headers)),
-                signed,
-                payload_hash,
-            ]
-        )
-        scope = f"{datestamp}/{conf['region']}/s3/aws4_request"
-        to_sign = "\n".join(
-            [
-                "AWS4-HMAC-SHA256",
-                amz_date,
-                scope,
-                hashlib.sha256(canonical.encode()).hexdigest(),
-            ]
-        )
+        """SigV4 (AWS4-HMAC-SHA256), unsigned payload — shared with the
+        s3 object-storage driver (utils/awssig.py)."""
+        from dragonfly2_tpu.utils.awssig import sigv4_headers
 
-        def hm(key, msg):
-            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
-
-        k = hm(("AWS4" + conf["secret_key"]).encode(), datestamp)
-        k = hm(k, conf["region"])
-        k = hm(k, "s3")
-        k = hm(k, "aws4_request")
-        sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
-        auth = (
-            f"AWS4-HMAC-SHA256 Credential={conf['access_key']}/{scope},"
-            f" SignedHeaders={signed}, Signature={sig}"
+        return sigv4_headers(
+            method,
+            host,
+            path,
+            query,
+            conf["region"],
+            conf["access_key"],
+            conf["secret_key"],
+            extra_headers,
         )
-        out = dict(headers)
-        out["authorization"] = auth
-        del out["host"]  # urllib sets it
-        return out
 
     def _request(self, method, url, headers=None, range_header=None, query=""):
         conf = self._conf(headers)

@@ -6,8 +6,14 @@ interface is the S3 verb set so a real driver drops in."""
 from __future__ import annotations
 
 import shutil
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
 from pathlib import Path
 from typing import Iterator, Protocol
+
+from dragonfly2_tpu.utils.awssig import sigv4_headers
 
 
 class ObjectStorage(Protocol):
@@ -76,3 +82,178 @@ class FSObjectStorage:
 
     def delete_bucket(self, bucket: str) -> None:
         shutil.rmtree(self._path(bucket), ignore_errors=True)
+
+
+class S3ObjectStorage:
+    """S3-compatible driver over SigV4-signed REST (role parity:
+    reference pkg/objectstorage s3 driver via aws-sdk) — endpoint-style
+    addressing (``endpoint/bucket/key``), so MinIO/Ceph/R2-style
+    S3-compatible stores work the same as AWS.
+
+    Missing objects surface as ``FileNotFoundError`` so the driver is a
+    true drop-in for ``FSObjectStorage`` behind the Protocol (the
+    gateway maps that to HTTP 404)."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        access_key: str,
+        secret_key: str,
+        region: str = "us-east-1",
+        timeout: float = 30.0,
+    ):
+        if not endpoint:
+            raise ValueError("s3 object storage needs an endpoint URL")
+        self._e = urllib.parse.urlsplit(endpoint)
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.timeout = timeout
+
+    # -- request plumbing ----------------------------------------------
+    def _request(self, method: str, bucket: str, key: str = "", query: str = "",
+                 data: bytes | None = None):
+        path = f"/{bucket}" + (f"/{urllib.parse.quote(key)}" if key else "")
+        headers = sigv4_headers(
+            method, self._e.netloc, path, query,
+            self.region, self.access_key, self.secret_key,
+        )
+        url = f"{self._e.scheme}://{self._e.netloc}{path}"
+        if query:
+            url = f"{url}?{query}"
+        req = urllib.request.Request(url, method=method, headers=headers, data=data)
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    @staticmethod
+    def _error_code(e: "urllib.error.HTTPError") -> str:
+        """<Code> from an S3 XML error body ('' when unparsable)."""
+        try:
+            root = ET.fromstring(e.read())
+            ns = root.tag.partition("}")[0] + "}" if root.tag.startswith("{") else ""
+            code = root.find(f"{ns}Code")
+            return code.text or "" if code is not None else ""
+        except Exception:
+            return ""
+
+    # -- verbs ----------------------------------------------------------
+    def create_bucket(self, bucket: str) -> None:
+        # non-default regions need an explicit LocationConstraint body —
+        # AWS rejects a bare PUT outside us-east-1
+        body = b""
+        if self.region != "us-east-1":
+            body = (
+                '<CreateBucketConfiguration xmlns='
+                '"http://s3.amazonaws.com/doc/2006-03-01/">'
+                f"<LocationConstraint>{self.region}</LocationConstraint>"
+                "</CreateBucketConfiguration>"
+            ).encode()
+        try:
+            with self._request("PUT", bucket, data=body or None):
+                pass
+        except urllib.error.HTTPError as e:
+            # only OUR existing bucket is success; a 409 for a bucket
+            # owned by someone else must fail loudly now, not as
+            # confusing 403s on the first put. Stores that return a
+            # codeless 409 (our fakes, some MinIO setups) count as ours.
+            code = self._error_code(e) if e.code == 409 else ""
+            if e.code == 409 and code in ("", "BucketAlreadyOwnedByYou"):
+                return
+            raise
+
+    def put_object(self, bucket: str, key: str, data: bytes) -> None:
+        with self._request("PUT", bucket, key, data=data):
+            pass
+
+    def get_object(self, bucket: str, key: str) -> bytes:
+        try:
+            with self._request("GET", bucket, key) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FileNotFoundError(f"s3://{bucket}/{key}") from e
+            raise
+
+    def head_object(self, bucket: str, key: str) -> bool:
+        try:
+            with self._request("HEAD", bucket, key):
+                return True
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise
+
+    def stat_object(self, bucket: str, key: str) -> int:
+        try:
+            with self._request("HEAD", bucket, key) as resp:
+                return int(resp.headers.get("Content-Length", 0) or 0)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FileNotFoundError(f"s3://{bucket}/{key}") from e
+            raise
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        try:
+            with self._request("DELETE", bucket, key):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code != 404:  # delete is idempotent, like the FS driver
+                raise
+
+    def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
+        """ListObjectsV2 with continuation (parses the XML keys)."""
+        out: list[str] = []
+        token = ""
+        while True:
+            q = {"list-type": "2"}
+            if prefix:
+                q["prefix"] = prefix
+            if token:
+                q["continuation-token"] = token
+            # canonical query must be sorted AND percent-encoded the way
+            # SigV4 canonicalizes (quote, not quote_plus — a '+' for
+            # space breaks the signature server-side)
+            query = urllib.parse.urlencode(
+                sorted(q.items()), quote_via=urllib.parse.quote
+            )
+            with self._request("GET", bucket, query=query) as resp:
+                root = ET.fromstring(resp.read())
+            ns = root.tag.partition("}")[0] + "}" if root.tag.startswith("{") else ""
+            for c in root.findall(f"{ns}Contents"):
+                k = c.find(f"{ns}Key")
+                if k is not None and k.text:
+                    out.append(k.text)
+            trunc = root.find(f"{ns}IsTruncated")
+            if trunc is None or trunc.text != "true":
+                break
+            nxt = root.find(f"{ns}NextContinuationToken")
+            if nxt is None or not nxt.text:
+                break
+            token = nxt.text
+        return sorted(out)
+
+    def delete_bucket(self, bucket: str) -> None:
+        try:
+            with self._request("DELETE", bucket):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
+def new_object_storage(
+    driver: str = "fs",
+    root: str = "",
+    endpoint: str = "",
+    access_key: str = "",
+    secret_key: str = "",
+    region: str = "us-east-1",
+) -> "ObjectStorage":
+    """Driver factory (reference pkg/objectstorage New): ``fs`` (default)
+    or ``s3`` (any S3-compatible endpoint)."""
+    if driver == "s3":
+        return S3ObjectStorage(
+            endpoint, access_key, secret_key, region=region
+        )
+    if driver in ("", "fs"):
+        return FSObjectStorage(root)
+    raise ValueError(f"unknown object-storage driver {driver!r} (fs | s3)")

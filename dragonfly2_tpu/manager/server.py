@@ -9,7 +9,7 @@ from pathlib import Path
 
 from dragonfly2_tpu.manager.database import Database
 from dragonfly2_tpu.manager.models_registry import ModelRegistry
-from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+from dragonfly2_tpu.manager.objectstorage import new_object_storage
 from dragonfly2_tpu.manager.service import ManagerService
 from dragonfly2_tpu.rpc import glue
 from dragonfly2_tpu.utils import dflog
@@ -38,6 +38,13 @@ class ManagerServerConfig:
     # read-through DB cache TTL in seconds (reference manager/cache Redis
     # TTLs); 0 disables caching
     db_cache_ttl: float = 30.0
+    # object storage for model weights: fs (default, under data_dir) or
+    # s3 (any S3-compatible endpoint; reference pkg/objectstorage)
+    object_storage_driver: str = "fs"
+    object_storage_endpoint: str = ""
+    object_storage_access_key: str = ""
+    object_storage_secret_key: str = ""
+    object_storage_region: str = "us-east-1"
 
 
 class ManagerServer:
@@ -49,7 +56,14 @@ class ManagerServer:
             from dragonfly2_tpu.manager.cache import CachedDatabase
 
             self.db = CachedDatabase(self.db, ttl=config.db_cache_ttl)
-        self.object_storage = FSObjectStorage(Path(config.data_dir) / "objects")
+        self.object_storage = new_object_storage(
+            driver=config.object_storage_driver,
+            root=str(Path(config.data_dir) / "objects"),
+            endpoint=config.object_storage_endpoint,
+            access_key=config.object_storage_access_key,
+            secret_key=config.object_storage_secret_key,
+            region=config.object_storage_region,
+        )
         self.models = ModelRegistry(self.db, self.object_storage)
         self.service = ManagerService(self.db, self.models)
         self._grpc = None
