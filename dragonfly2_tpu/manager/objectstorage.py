@@ -84,6 +84,17 @@ class FSObjectStorage:
         shutil.rmtree(self._path(bucket), ignore_errors=True)
 
 
+def _s3_error_code(e: "urllib.error.HTTPError") -> str:
+    """<Code> from an S3/OSS XML error body ('' when unparsable)."""
+    try:
+        root = ET.fromstring(e.read())
+        ns = root.tag.partition("}")[0] + "}" if root.tag.startswith("{") else ""
+        code = root.find(f"{ns}Code")
+        return code.text or "" if code is not None else ""
+    except Exception:
+        return ""
+
+
 class S3ObjectStorage:
     """S3-compatible driver over SigV4-signed REST (role parity:
     reference pkg/objectstorage s3 driver via aws-sdk) — endpoint-style
@@ -124,17 +135,6 @@ class S3ObjectStorage:
         req = urllib.request.Request(url, method=method, headers=headers, data=data)
         return urllib.request.urlopen(req, timeout=self.timeout)
 
-    @staticmethod
-    def _error_code(e: "urllib.error.HTTPError") -> str:
-        """<Code> from an S3 XML error body ('' when unparsable)."""
-        try:
-            root = ET.fromstring(e.read())
-            ns = root.tag.partition("}")[0] + "}" if root.tag.startswith("{") else ""
-            code = root.find(f"{ns}Code")
-            return code.text or "" if code is not None else ""
-        except Exception:
-            return ""
-
     # -- verbs ----------------------------------------------------------
     def create_bucket(self, bucket: str) -> None:
         # non-default regions need an explicit LocationConstraint body —
@@ -155,7 +155,7 @@ class S3ObjectStorage:
             # owned by someone else must fail loudly now, not as
             # confusing 403s on the first put. Stores that return a
             # codeless 409 (our fakes, some MinIO setups) count as ours.
-            code = self._error_code(e) if e.code == 409 else ""
+            code = _s3_error_code(e) if e.code == 409 else ""
             if e.code == 409 and code in ("", "BucketAlreadyOwnedByYou"):
                 return
             raise
@@ -240,6 +240,134 @@ class S3ObjectStorage:
                 raise
 
 
+class OSSObjectStorage:
+    """Alibaba OSS driver: classic header signature
+    (``OSS <key>:<base64 hmac-sha1>``; role parity: reference
+    pkg/objectstorage oss driver). Same endpoint-style addressing and
+    FileNotFoundError semantics as the S3 driver."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        access_key: str,
+        secret_key: str,
+        timeout: float = 30.0,
+    ):
+        if not endpoint:
+            raise ValueError("oss object storage needs an endpoint URL")
+        self._e = urllib.parse.urlsplit(endpoint)
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.timeout = timeout
+
+    def _request(self, method: str, bucket: str, key: str = "", query: str = "",
+                 data: bytes | None = None):
+        from dragonfly2_tpu.utils.awssig import oss_sign_headers
+
+        # urllib force-adds a Content-Type to data-carrying requests, and
+        # OSS signs Content-Type — so writers declare one explicitly and
+        # it participates in the signature
+        content_type = "application/octet-stream" if data is not None else ""
+        headers = oss_sign_headers(
+            method, bucket, key, self.access_key, self.secret_key,
+            content_type=content_type,
+        )
+        path = f"/{bucket}" + (f"/{urllib.parse.quote(key)}" if key else "")
+        url = f"{self._e.scheme}://{self._e.netloc}{path}"
+        if query:
+            url = f"{url}?{query}"
+        req = urllib.request.Request(url, method=method, headers=headers, data=data)
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    def create_bucket(self, bucket: str) -> None:
+        try:
+            with self._request("PUT", bucket):
+                pass
+        except urllib.error.HTTPError as e:
+            # same owned-vs-taken narrowing as the S3 driver: only OUR
+            # existing bucket (or a codeless 409 from simple stores) is
+            # success — someone else's bucket must fail loudly now
+            code = _s3_error_code(e) if e.code == 409 else ""
+            if e.code == 409 and code in ("", "BucketAlreadyOwnedByYou"):
+                return
+            raise
+
+    def put_object(self, bucket: str, key: str, data: bytes) -> None:
+        with self._request("PUT", bucket, key, data=data):
+            pass
+
+    def get_object(self, bucket: str, key: str) -> bytes:
+        try:
+            with self._request("GET", bucket, key) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FileNotFoundError(f"oss://{bucket}/{key}") from e
+            raise
+
+    def head_object(self, bucket: str, key: str) -> bool:
+        try:
+            with self._request("HEAD", bucket, key):
+                return True
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise
+
+    def stat_object(self, bucket: str, key: str) -> int:
+        try:
+            with self._request("HEAD", bucket, key) as resp:
+                return int(resp.headers.get("Content-Length", 0) or 0)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FileNotFoundError(f"oss://{bucket}/{key}") from e
+            raise
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        try:
+            with self._request("DELETE", bucket, key):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+    def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
+        """GetBucket (ListObjects) — parses <Contents><Key> with marker
+        continuation."""
+        out: list[str] = []
+        marker = ""
+        while True:
+            q = {}
+            if prefix:
+                q["prefix"] = prefix
+            if marker:
+                q["marker"] = marker
+            query = urllib.parse.urlencode(sorted(q.items()), quote_via=urllib.parse.quote)
+            with self._request("GET", bucket, query=query) as resp:
+                root = ET.fromstring(resp.read())
+            ns = root.tag.partition("}")[0] + "}" if root.tag.startswith("{") else ""
+            for c in root.findall(f"{ns}Contents"):
+                k = c.find(f"{ns}Key")
+                if k is not None and k.text:
+                    out.append(k.text)
+            trunc = root.find(f"{ns}IsTruncated")
+            if trunc is None or trunc.text != "true":
+                break
+            nxt = root.find(f"{ns}NextMarker")
+            if nxt is None or not nxt.text:
+                break
+            marker = nxt.text
+        return sorted(out)
+
+    def delete_bucket(self, bucket: str) -> None:
+        try:
+            with self._request("DELETE", bucket):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
 def new_object_storage(
     driver: str = "fs",
     root: str = "",
@@ -248,12 +376,14 @@ def new_object_storage(
     secret_key: str = "",
     region: str = "us-east-1",
 ) -> "ObjectStorage":
-    """Driver factory (reference pkg/objectstorage New): ``fs`` (default)
-    or ``s3`` (any S3-compatible endpoint)."""
+    """Driver factory (reference pkg/objectstorage New): ``fs`` (default),
+    ``s3`` (any S3-compatible endpoint), or ``oss``."""
     if driver == "s3":
         return S3ObjectStorage(
             endpoint, access_key, secret_key, region=region
         )
+    if driver == "oss":
+        return OSSObjectStorage(endpoint, access_key, secret_key)
     if driver in ("", "fs"):
         return FSObjectStorage(root)
-    raise ValueError(f"unknown object-storage driver {driver!r} (fs | s3)")
+    raise ValueError(f"unknown object-storage driver {driver!r} (fs | s3 | oss)")

@@ -38,3 +38,34 @@ LEAVE_HOST_TOTAL = _r.counter("scheduler_leave_host_total", "LeaveHost calls")
 TRAIN_UPLOAD_TOTAL = _r.counter(
     "scheduler_train_upload_total", "Dataset uploads to the trainer", ("outcome",)
 )
+TRAFFIC_BYTES_TOTAL = _r.counter(
+    "scheduler_traffic_bytes_total", "Piece bytes by traffic type", ("traffic_type",)
+)
+PEER_GAUGE = _r.gauge("scheduler_peers", "Live peers in the resource model", ("state",))
+TASK_GAUGE = _r.gauge("scheduler_tasks", "Live tasks in the resource model")
+HOST_GAUGE = _r.gauge("scheduler_hosts", "Announced hosts", ("type",))
+
+
+# label values seen on previous refreshes — a group that disappears must
+# be zeroed, not left at its last value (phantom peers in dashboards)
+_seen_peer_states: set = set()
+_seen_host_types: set = set()
+
+
+def refresh_resource_gauges(resource) -> None:
+    """Update cluster-state gauges from the live resource model (the
+    reference exports these via promauto collectors; here a periodic
+    refresh keeps the scrape path allocation-free)."""
+    by_state: dict = {}
+    for p in resource.peer_manager.all():
+        by_state[p.fsm.current] = by_state.get(p.fsm.current, 0) + 1
+    _seen_peer_states.update(by_state)
+    for state in _seen_peer_states:
+        PEER_GAUGE.labels(state).set(by_state.get(state, 0))
+    TASK_GAUGE.set(len(resource.task_manager.all()))
+    by_type: dict = {}
+    for h in resource.host_manager.all():
+        by_type[h.type.value] = by_type.get(h.type.value, 0) + 1
+    _seen_host_types.update(by_type)
+    for t in _seen_host_types:
+        HOST_GAUGE.labels(t).set(by_type.get(t, 0))

@@ -105,6 +105,16 @@ class SchedulerServer:
                 self.networktopology.snapshot,
             )
         )
+        from dragonfly2_tpu.scheduler import metrics as _M
+
+        self.gc.add(
+            GCTask(
+                "metrics-refresh",
+                15.0,
+                15.0,
+                lambda: _M.refresh_resource_gauges(self.resource),
+            )
+        )
 
         # upstream clients
         self._manager_channel = None

@@ -257,10 +257,10 @@ class SchedulerService:
                 peer.block_parents.add(pid)
             self._schedule(peer, adapter)
         elif which == "download_piece_finished":
-            M.DOWNLOAD_PIECE_FINISHED_TOTAL.labels(
-                req.download_piece_finished.piece.traffic_type or "unknown"
-            ).inc()
-            self._piece_finished(peer, req.download_piece_finished.piece)
+            piece = req.download_piece_finished.piece
+            M.DOWNLOAD_PIECE_FINISHED_TOTAL.labels(piece.traffic_type or "unknown").inc()
+            M.TRAFFIC_BYTES_TOTAL.labels(piece.traffic_type or "unknown").inc(piece.length)
+            self._piece_finished(peer, piece)
         elif which == "download_piece_failed":
             parent_id = req.download_piece_failed.parent_id
             if parent_id:

@@ -327,6 +327,9 @@ class SchedulerServiceV1:
             M.DOWNLOAD_PIECE_FINISHED_TOTAL.labels(
                 req.piece_info.traffic_type or "remote_peer"
             ).inc()
+            M.TRAFFIC_BYTES_TOTAL.labels(
+                req.piece_info.traffic_type or "remote_peer"
+            ).inc(req.piece_info.length)
             cost_ms = req.piece_info.cost_ns / 1e6
             piece = res.Piece(
                 number=number,

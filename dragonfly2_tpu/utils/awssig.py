@@ -69,3 +69,33 @@ def sigv4_headers(
     )
     del out["host"]  # urllib sets it
     return out
+
+
+def oss_sign_headers(
+    method: str,
+    bucket: str,
+    key: str,
+    access_key: str,
+    secret_key: str,
+    content_type: str = "",
+) -> dict:
+    """Alibaba OSS classic header signature
+    (``OSS <key>:<base64 hmac-sha1>``; string-to-sign =
+    VERB\\nContent-MD5\\nContent-Type\\nDate\\nResource). The caller must
+    send EXACTLY the Content-Type given here — urllib silently adds
+    ``application/x-www-form-urlencoded`` to data-carrying requests, so
+    writers must pass an explicit type or the signature won't match."""
+    import base64
+
+    date = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%a, %d %b %Y %H:%M:%S GMT"
+    )
+    resource = f"/{bucket}/{key}" if key else f"/{bucket}/"
+    to_sign = f"{method}\n\n{content_type}\n{date}\n{resource}"
+    sig = base64.b64encode(
+        hmac.new(secret_key.encode(), to_sign.encode(), hashlib.sha1).digest()
+    ).decode()
+    out = {"Date": date, "Authorization": f"OSS {access_key}:{sig}"}
+    if content_type:
+        out["Content-Type"] = content_type
+    return out

@@ -130,3 +130,49 @@ def test_service_series_move_on_real_download(tmp_path):
     text = default_registry.expose()
     assert "dragonfly_daemon_piece_downloaded_total" in text
     assert 'dragonfly_scheduler_register_peer_total' in text
+
+
+def test_resource_gauges_and_traffic_bytes():
+    """Cluster-state gauges refresh from the live resource model, and
+    piece results accumulate byte counters by traffic type."""
+    from dragonfly2_tpu.scheduler import metrics as M
+    from dragonfly2_tpu.scheduler import resource as res
+
+    resource = res.Resource()
+    h = res.Host(id="h1", type=res.HostType.SUPER)
+    resource.host_manager.store(h)
+    t = res.Task("t1", "https://e/x")
+    resource.task_manager.store(t)
+    p = res.Peer("p1", t, h)
+    resource.peer_manager.store(p)
+    p.fsm.event(res.PEER_EVENT_REGISTER_NORMAL)
+    M.refresh_resource_gauges(resource)
+    assert M.PEER_GAUGE.labels(res.PEER_STATE_RECEIVED_NORMAL)._value == 1
+    assert M.TASK_GAUGE._default_child()._value == 1
+    assert M.HOST_GAUGE.labels("super")._value == 1
+
+    before = M.TRAFFIC_BYTES_TOTAL.labels("remote_peer")._value
+    M.TRAFFIC_BYTES_TOTAL.labels("remote_peer").inc(4096)
+    assert M.TRAFFIC_BYTES_TOTAL.labels("remote_peer")._value == before + 4096
+
+
+def test_resource_gauges_zero_disappeared_groups():
+    """A state/type group that disappears must read 0 on the next
+    refresh, not keep its last value (phantom peers in dashboards)."""
+    from dragonfly2_tpu.scheduler import metrics as M
+    from dragonfly2_tpu.scheduler import resource as res
+
+    resource = res.Resource()
+    h = res.Host(id="hz", type=res.HostType.NORMAL)
+    resource.host_manager.store(h)
+    t = res.Task("tz", "https://e/z")
+    resource.task_manager.store(t)
+    p = res.Peer("pz", t, h)
+    resource.peer_manager.store(p)
+    M.refresh_resource_gauges(resource)
+    assert M.PEER_GAUGE.labels(res.PEER_STATE_PENDING)._value >= 1
+    resource.peer_manager.delete("pz")
+    resource.host_manager.delete("hz")
+    M.refresh_resource_gauges(resource)
+    assert M.PEER_GAUGE.labels(res.PEER_STATE_PENDING)._value == 0
+    assert M.HOST_GAUGE.labels("normal")._value == 0

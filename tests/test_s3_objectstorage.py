@@ -188,3 +188,99 @@ def test_missing_object_raises_filenotfound(s3):
         s3.get_object("b2", "nope")
     with pytest.raises(FileNotFoundError):
         s3.stat_object("b2", "nope")
+
+
+def test_oss_driver_crud():
+    """OSS driver CRUD + list against a scheme-agnostic fake store; the
+    classic "OSS <key>:<sig>" Authorization header is asserted on writes."""
+    from dragonfly2_tpu.manager.objectstorage import OSSObjectStorage
+
+    import http.server
+    import threading
+    import urllib.parse
+
+    store = {}
+    auth_seen = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _t(self):
+            p = urllib.parse.urlsplit(self.path)
+            path = urllib.parse.unquote(p.path).lstrip("/")
+            b, _, k = path.partition("/")
+            return b, k, dict(urllib.parse.parse_qsl(p.query))
+
+        def do_PUT(self):
+            auth_seen.append(self.headers.get("Authorization", ""))
+            b, k, _ = self._t()
+            if k:
+                store[(b, k)] = self.rfile.read(
+                    int(self.headers.get("Content-Length") or 0)
+                )
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def do_GET(self):
+            b, k, q = self._t()
+            if not k:
+                keys = sorted(
+                    kk for (bb, kk) in store
+                    if bb == b and kk.startswith(q.get("prefix", ""))
+                )
+                body = (
+                    "<ListBucketResult>"
+                    + "".join(f"<Contents><Key>{x}</Key></Contents>" for x in keys)
+                    + "<IsTruncated>false</IsTruncated></ListBucketResult>"
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            d = store.get((b, k))
+            if d is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(d)))
+            self.end_headers()
+            self.wfile.write(d)
+
+        def do_HEAD(self):
+            b, k, _ = self._t()
+            if (b, k) in store:
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(store[(b, k)])))
+            else:
+                self.send_response(404)
+            self.end_headers()
+
+        def do_DELETE(self):
+            b, k, _ = self._t()
+            store.pop((b, k), None)
+            self.send_response(204)
+            self.end_headers()
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        oss = OSSObjectStorage(
+            f"http://127.0.0.1:{httpd.server_port}", "AKID", "SECRET"
+        )
+        oss.create_bucket("b")
+        oss.put_object("b", "m/w.bin", b"oss-bytes")
+        assert oss.get_object("b", "m/w.bin") == b"oss-bytes"
+        assert oss.head_object("b", "m/w.bin")
+        assert oss.stat_object("b", "m/w.bin") == 9
+        assert oss.list_objects("b", prefix="m/") == ["m/w.bin"]
+        with pytest.raises(FileNotFoundError):
+            oss.get_object("b", "gone")
+        oss.delete_object("b", "m/w.bin")
+        assert not oss.head_object("b", "m/w.bin")
+        assert all(a.startswith("OSS AKID:") for a in auth_seen if a)
+    finally:
+        httpd.shutdown()
