@@ -95,60 +95,36 @@ def _s3_error_code(e: "urllib.error.HTTPError") -> str:
         return ""
 
 
-class S3ObjectStorage:
-    """S3-compatible driver over SigV4-signed REST (role parity:
-    reference pkg/objectstorage s3 driver via aws-sdk) — endpoint-style
-    addressing (``endpoint/bucket/key``), so MinIO/Ceph/R2-style
-    S3-compatible stores work the same as AWS.
+class _HTTPObjectStorage:
+    """Shared verb layer for REST object stores; subclasses provide the
+    signed ``_request`` and the listing dialect. Missing objects surface
+    as ``FileNotFoundError`` so both drivers are true drop-ins for
+    ``FSObjectStorage`` behind the Protocol (the gateway maps that to
+    HTTP 404)."""
 
-    Missing objects surface as ``FileNotFoundError`` so the driver is a
-    true drop-in for ``FSObjectStorage`` behind the Protocol (the
-    gateway maps that to HTTP 404)."""
+    _scheme = "object"
 
-    def __init__(
-        self,
-        endpoint: str,
-        access_key: str,
-        secret_key: str,
-        region: str = "us-east-1",
-        timeout: float = 30.0,
-    ):
+    def __init__(self, endpoint: str, timeout: float = 30.0):
         if not endpoint:
-            raise ValueError("s3 object storage needs an endpoint URL")
+            raise ValueError(f"{self._scheme} object storage needs an endpoint URL")
         self._e = urllib.parse.urlsplit(endpoint)
-        self.access_key = access_key
-        self.secret_key = secret_key
-        self.region = region
         self.timeout = timeout
 
-    # -- request plumbing ----------------------------------------------
-    def _request(self, method: str, bucket: str, key: str = "", query: str = "",
-                 data: bytes | None = None):
-        path = f"/{bucket}" + (f"/{urllib.parse.quote(key)}" if key else "")
-        headers = sigv4_headers(
-            method, self._e.netloc, path, query,
-            self.region, self.access_key, self.secret_key,
-        )
-        url = f"{self._e.scheme}://{self._e.netloc}{path}"
-        if query:
-            url = f"{url}?{query}"
-        req = urllib.request.Request(url, method=method, headers=headers, data=data)
-        return urllib.request.urlopen(req, timeout=self.timeout)
+    # subclasses implement: _request(method, bucket, key, query, data)
+    # and the listing dialect hooks below.
+    def _create_bucket_body(self) -> bytes:
+        return b""
+
+    def _list_query(self, prefix: str, token: str) -> dict:
+        raise NotImplementedError
+
+    def _list_next(self, root, ns: str) -> str:
+        raise NotImplementedError
 
     # -- verbs ----------------------------------------------------------
     def create_bucket(self, bucket: str) -> None:
-        # non-default regions need an explicit LocationConstraint body —
-        # AWS rejects a bare PUT outside us-east-1
-        body = b""
-        if self.region != "us-east-1":
-            body = (
-                '<CreateBucketConfiguration xmlns='
-                '"http://s3.amazonaws.com/doc/2006-03-01/">'
-                f"<LocationConstraint>{self.region}</LocationConstraint>"
-                "</CreateBucketConfiguration>"
-            ).encode()
         try:
-            with self._request("PUT", bucket, data=body or None):
+            with self._request("PUT", bucket, data=self._create_bucket_body() or None):
                 pass
         except urllib.error.HTTPError as e:
             # only OUR existing bucket is success; a 409 for a bucket
@@ -170,7 +146,7 @@ class S3ObjectStorage:
                 return resp.read()
         except urllib.error.HTTPError as e:
             if e.code == 404:
-                raise FileNotFoundError(f"s3://{bucket}/{key}") from e
+                raise FileNotFoundError(f"{self._scheme}://{bucket}/{key}") from e
             raise
 
     def head_object(self, bucket: str, key: str) -> bool:
@@ -188,7 +164,7 @@ class S3ObjectStorage:
                 return int(resp.headers.get("Content-Length", 0) or 0)
         except urllib.error.HTTPError as e:
             if e.code == 404:
-                raise FileNotFoundError(f"s3://{bucket}/{key}") from e
+                raise FileNotFoundError(f"{self._scheme}://{bucket}/{key}") from e
             raise
 
     def delete_object(self, bucket: str, key: str) -> None:
@@ -200,20 +176,16 @@ class S3ObjectStorage:
                 raise
 
     def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
-        """ListObjectsV2 with continuation (parses the XML keys)."""
+        """Paged listing; subclasses define the query/continuation dialect."""
         out: list[str] = []
         token = ""
         while True:
-            q = {"list-type": "2"}
-            if prefix:
-                q["prefix"] = prefix
-            if token:
-                q["continuation-token"] = token
             # canonical query must be sorted AND percent-encoded the way
-            # SigV4 canonicalizes (quote, not quote_plus — a '+' for
-            # space breaks the signature server-side)
+            # signatures canonicalize (quote, not quote_plus — a '+' for
+            # space breaks verification server-side)
             query = urllib.parse.urlencode(
-                sorted(q.items()), quote_via=urllib.parse.quote
+                sorted(self._list_query(prefix, token).items()),
+                quote_via=urllib.parse.quote,
             )
             with self._request("GET", bucket, query=query) as resp:
                 root = ET.fromstring(resp.read())
@@ -225,10 +197,9 @@ class S3ObjectStorage:
             trunc = root.find(f"{ns}IsTruncated")
             if trunc is None or trunc.text != "true":
                 break
-            nxt = root.find(f"{ns}NextContinuationToken")
-            if nxt is None or not nxt.text:
+            token = self._list_next(root, ns)
+            if not token:
                 break
-            token = nxt.text
         return sorted(out)
 
     def delete_bucket(self, bucket: str) -> None:
@@ -240,11 +211,71 @@ class S3ObjectStorage:
                 raise
 
 
-class OSSObjectStorage:
+class S3ObjectStorage(_HTTPObjectStorage):
+    """S3-compatible driver over SigV4-signed REST (role parity:
+    reference pkg/objectstorage s3 driver via aws-sdk) — endpoint-style
+    addressing (``endpoint/bucket/key``), so MinIO/Ceph/R2-style
+    S3-compatible stores work the same as AWS."""
+
+    _scheme = "s3"
+
+    def __init__(
+        self,
+        endpoint: str,
+        access_key: str,
+        secret_key: str,
+        region: str = "us-east-1",
+        timeout: float = 30.0,
+    ):
+        super().__init__(endpoint, timeout)
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+
+    def _request(self, method: str, bucket: str, key: str = "", query: str = "",
+                 data: bytes | None = None):
+        path = f"/{bucket}" + (f"/{urllib.parse.quote(key)}" if key else "")
+        headers = sigv4_headers(
+            method, self._e.netloc, path, query,
+            self.region, self.access_key, self.secret_key,
+        )
+        url = f"{self._e.scheme}://{self._e.netloc}{path}"
+        if query:
+            url = f"{url}?{query}"
+        req = urllib.request.Request(url, method=method, headers=headers, data=data)
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    def _create_bucket_body(self) -> bytes:
+        # non-default regions need an explicit LocationConstraint body —
+        # AWS rejects a bare PUT outside us-east-1
+        if self.region == "us-east-1":
+            return b""
+        return (
+            '<CreateBucketConfiguration xmlns='
+            '"http://s3.amazonaws.com/doc/2006-03-01/">'
+            f"<LocationConstraint>{self.region}</LocationConstraint>"
+            "</CreateBucketConfiguration>"
+        ).encode()
+
+    def _list_query(self, prefix: str, token: str) -> dict:
+        q = {"list-type": "2"}
+        if prefix:
+            q["prefix"] = prefix
+        if token:
+            q["continuation-token"] = token
+        return q
+
+    def _list_next(self, root, ns: str) -> str:
+        nxt = root.find(f"{ns}NextContinuationToken")
+        return nxt.text if nxt is not None and nxt.text else ""
+
+
+class OSSObjectStorage(_HTTPObjectStorage):
     """Alibaba OSS driver: classic header signature
     (``OSS <key>:<base64 hmac-sha1>``; role parity: reference
-    pkg/objectstorage oss driver). Same endpoint-style addressing and
-    FileNotFoundError semantics as the S3 driver."""
+    pkg/objectstorage oss driver)."""
+
+    _scheme = "oss"
 
     def __init__(
         self,
@@ -253,12 +284,9 @@ class OSSObjectStorage:
         secret_key: str,
         timeout: float = 30.0,
     ):
-        if not endpoint:
-            raise ValueError("oss object storage needs an endpoint URL")
-        self._e = urllib.parse.urlsplit(endpoint)
+        super().__init__(endpoint, timeout)
         self.access_key = access_key
         self.secret_key = secret_key
-        self.timeout = timeout
 
     def _request(self, method: str, bucket: str, key: str = "", query: str = "",
                  data: bytes | None = None):
@@ -279,93 +307,17 @@ class OSSObjectStorage:
         req = urllib.request.Request(url, method=method, headers=headers, data=data)
         return urllib.request.urlopen(req, timeout=self.timeout)
 
-    def create_bucket(self, bucket: str) -> None:
-        try:
-            with self._request("PUT", bucket):
-                pass
-        except urllib.error.HTTPError as e:
-            # same owned-vs-taken narrowing as the S3 driver: only OUR
-            # existing bucket (or a codeless 409 from simple stores) is
-            # success — someone else's bucket must fail loudly now
-            code = _s3_error_code(e) if e.code == 409 else ""
-            if e.code == 409 and code in ("", "BucketAlreadyOwnedByYou"):
-                return
-            raise
+    def _list_query(self, prefix: str, token: str) -> dict:
+        q = {}
+        if prefix:
+            q["prefix"] = prefix
+        if token:
+            q["marker"] = token
+        return q
 
-    def put_object(self, bucket: str, key: str, data: bytes) -> None:
-        with self._request("PUT", bucket, key, data=data):
-            pass
-
-    def get_object(self, bucket: str, key: str) -> bytes:
-        try:
-            with self._request("GET", bucket, key) as resp:
-                return resp.read()
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                raise FileNotFoundError(f"oss://{bucket}/{key}") from e
-            raise
-
-    def head_object(self, bucket: str, key: str) -> bool:
-        try:
-            with self._request("HEAD", bucket, key):
-                return True
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return False
-            raise
-
-    def stat_object(self, bucket: str, key: str) -> int:
-        try:
-            with self._request("HEAD", bucket, key) as resp:
-                return int(resp.headers.get("Content-Length", 0) or 0)
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                raise FileNotFoundError(f"oss://{bucket}/{key}") from e
-            raise
-
-    def delete_object(self, bucket: str, key: str) -> None:
-        try:
-            with self._request("DELETE", bucket, key):
-                pass
-        except urllib.error.HTTPError as e:
-            if e.code != 404:
-                raise
-
-    def list_objects(self, bucket: str, prefix: str = "") -> list[str]:
-        """GetBucket (ListObjects) — parses <Contents><Key> with marker
-        continuation."""
-        out: list[str] = []
-        marker = ""
-        while True:
-            q = {}
-            if prefix:
-                q["prefix"] = prefix
-            if marker:
-                q["marker"] = marker
-            query = urllib.parse.urlencode(sorted(q.items()), quote_via=urllib.parse.quote)
-            with self._request("GET", bucket, query=query) as resp:
-                root = ET.fromstring(resp.read())
-            ns = root.tag.partition("}")[0] + "}" if root.tag.startswith("{") else ""
-            for c in root.findall(f"{ns}Contents"):
-                k = c.find(f"{ns}Key")
-                if k is not None and k.text:
-                    out.append(k.text)
-            trunc = root.find(f"{ns}IsTruncated")
-            if trunc is None or trunc.text != "true":
-                break
-            nxt = root.find(f"{ns}NextMarker")
-            if nxt is None or not nxt.text:
-                break
-            marker = nxt.text
-        return sorted(out)
-
-    def delete_bucket(self, bucket: str) -> None:
-        try:
-            with self._request("DELETE", bucket):
-                pass
-        except urllib.error.HTTPError as e:
-            if e.code != 404:
-                raise
+    def _list_next(self, root, ns: str) -> str:
+        nxt = root.find(f"{ns}NextMarker")
+        return nxt.text if nxt is not None and nxt.text else ""
 
 
 def new_object_storage(

@@ -207,7 +207,7 @@ class ManagerService:
     # -- async jobs (manager is the queue of record; scheduler workers
     # poll ListPendingJobs — reference internal/job machinery on Redis) --
     def CreateJob(self, request, context):
-        if request.type not in ("preheat", "sync_peers"):
+        if request.type not in ("preheat", "sync_peers", "recommend_seeds"):
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"unknown job type {request.type}")
         now = time.time()
         cur = self.db.execute(

@@ -30,6 +30,7 @@ class JobWorker:
         manager_client,  # glue.ServiceClient of the manager service
         resource,
         seed_client=None,  # resource.seed_peer.SeedPeerClient
+        networktopology=None,  # for the recommend_seeds advisor
         hostname: str = "",
         ip: str = "",
         cluster_id: int = 0,
@@ -38,6 +39,7 @@ class JobWorker:
         self.manager = manager_client
         self.resource = resource
         self.seed_client = seed_client
+        self.networktopology = networktopology
         self.hostname = hostname
         self.ip = ip
         self.cluster_id = cluster_id
@@ -102,6 +104,8 @@ class JobWorker:
                 return self._preheat(args)
             if job.type == "sync_peers":
                 return self._sync_peers(args)
+            if job.type == "recommend_seeds":
+                return self._recommend_seeds(args)
             return "failed", {"error": f"unknown job type {job.type}"}
         except Exception as e:  # job errors must not kill the worker
             logger.exception("job %d (%s) failed", job.id, job.type)
@@ -163,6 +167,41 @@ class JobWorker:
         )
         out["layers"] = len(layers)
         return out_state, out
+
+    def _recommend_seeds(self, args: dict) -> tuple[str, dict]:
+        """Rank hosts as seed-peer candidates by GNN-predicted fleet RTT
+        (SURVEY §7 stage 6; seed_placement.py). Uses the active gnn
+        model's weights from the manager registry."""
+        if self.networktopology is None:
+            return "failed", {"error": "scheduler has no network topology"}
+        if self.manager is None:
+            return "failed", {"error": "no manager to load the gnn model from"}
+        models = self.manager.ListModels(
+            manager_pb2.ListModelsRequest(scheduler_cluster_id=self.cluster_id)
+        ).models
+        active = [m for m in models if m.state == "active" and m.type == "gnn"]
+        if not active:
+            return "failed", {"error": "no active gnn model"}
+        newest = max(active, key=lambda m: (m.updated_at_ns, m.version))
+        blob = self.manager.GetModelWeights(
+            manager_pb2.GetModelRequest(model_id=newest.model_id, version=newest.version)
+        ).weights
+        from dragonfly2_tpu.scheduler.seed_placement import recommend_seeds
+        from dragonfly2_tpu.trainer.serving import deserialize_params_auto
+
+        ranking = recommend_seeds(
+            self.networktopology,
+            deserialize_params_auto(blob),
+            k=int(args.get("k", 3)),
+            candidates=args.get("candidates"),
+        )
+        if not ranking:
+            return "failed", {"error": "probe graph too small to rank"}
+        return "succeeded", {
+            "model": newest.model_id,
+            "version": newest.version,
+            "ranking": ranking,
+        }
 
     # -- sync_peers (reference scheduler/job syncPeers) -----------------
     def _sync_peers(self, args: dict) -> tuple[str, dict]:

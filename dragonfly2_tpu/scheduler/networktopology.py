@@ -136,25 +136,29 @@ class NetworkTopology:
             self.kv.delete(*keys)
 
     # -- snapshot (training-data export) ----------------------------------
-    def snapshot(self) -> int:
-        """Walk the probe graph and append one NetworkTopologyRecord per
-        source host (up to 5 dest hosts each, reference
-        network_topology.go:325-436). Returns rows written."""
-        if self.storage is None:
-            return 0
+    def export_records(self, dest_limit: int = R.MAX_DEST_HOSTS) -> list:
+        """Walk the live probe graph into NetworkTopologyRecord rows (one
+        per source host, up to ``dest_limit`` dest hosts each) — the
+        snapshot sink and the seed-placement advisor both consume this.
+
+        ``dest_limit`` is clamped to the record schema's fixed group
+        width: the columnar flatten pads/truncates ``dest_hosts`` to
+        MAX_DEST_HOSTS, so a larger limit would be silently dropped
+        downstream rather than widening coverage."""
+        dest_limit = min(dest_limit, R.MAX_DEST_HOSTS)
         by_src: dict[str, list[str]] = {}
         for key in self.kv.scan_iter("networktopology:*:*"):
             _, src, dest = key.split(":", 2)
             by_src.setdefault(src, []).append(dest)
 
-        rows = 0
+        out: list[R.NetworkTopologyRecord] = []
         now_ns = int(time.time() * NS_PER_S)
         for src, dests in by_src.items():
             sh = self.host_manager.load(src)
             if sh is None:
                 continue
             dest_hosts: list[R.DestHost] = []
-            for dest in dests[: R.MAX_DEST_HOSTS]:
+            for dest in dests[:dest_limit]:
                 dh = self.host_manager.load(dest)
                 if dh is None:
                     continue
@@ -178,7 +182,7 @@ class NetworkTopology:
                 )
             if not dest_hosts:
                 continue
-            self.storage.create_network_topology(
+            out.append(
                 R.NetworkTopologyRecord(
                     id=str(uuid.uuid4()),
                     host=R.SrcHost(
@@ -193,5 +197,14 @@ class NetworkTopology:
                     created_at=now_ns,
                 )
             )
-            rows += 1
-        return rows
+        return out
+
+    def snapshot(self) -> int:
+        """Append the live probe graph to the CSV record sink (reference
+        network_topology.go:325-436). Returns rows written."""
+        if self.storage is None:
+            return 0
+        records = self.export_records()
+        for rec in records:
+            self.storage.create_network_topology(rec)
+        return len(records)

@@ -1,0 +1,64 @@
+"""GNN-driven seed-peer placement (SURVEY §7 stage 6: "link-prediction
+config for seed-peer placement").
+
+The GraphSAGE model embeds hosts from the probe graph and predicts
+pairwise RTT for pairs that were never probed; a good seed peer is the
+host the REST of the fleet can reach fastest — rank candidates by the
+mean predicted child→candidate RTT. Consumed by the ``recommend_seeds``
+job (scheduler/job.py), which fetches the active gnn model's weights
+from the manager registry.
+"""
+
+from __future__ import annotations
+
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("scheduler.seed_placement")
+
+
+def recommend_seeds(
+    networktopology,
+    gnn_params,
+    k: int = 3,
+    candidates: list[str] | None = None,
+) -> list[dict]:
+    """→ up to ``k`` ``{host_id, mean_predicted_rtt_log_ms}`` rows,
+    best (lowest predicted RTT from the rest of the fleet) first.
+
+    The graph is built from the LIVE probe state (the same export the
+    trainer's snapshot consumes), so the ranking reflects current
+    topology; candidates outside the probe graph can't be embedded and
+    are skipped."""
+    from dragonfly2_tpu.schema.columnar import records_to_columns
+    from dragonfly2_tpu.schema.features import build_probe_graph
+    from dragonfly2_tpu.trainer.serving import GNNScorer
+
+    records = networktopology.export_records()
+    if not records:
+        return []
+    graph = build_probe_graph(records_to_columns(records))
+    if graph.num_nodes < 2:
+        return []
+    scorer = GNNScorer(gnn_params, graph)
+
+    # an EXPLICIT empty candidate list means "none eligible" — ranking
+    # the whole fleet instead would silently widen the caller's scope
+    pool = candidates if candidates is not None else graph.node_ids
+    hosts = [h for h in pool if scorer.has_host(h)]
+    if candidates is not None and not hosts:
+        raise ValueError(
+            "no candidate host is in the probe graph yet"
+            f" (candidates={candidates!r})"
+        )
+    scores: list[tuple[float, str]] = []
+    for h in hosts:
+        others = [o for o in graph.node_ids if o != h]
+        if not others:
+            continue
+        pred = scorer.predict_rtt_log_ms(others, [h] * len(others))
+        scores.append((float(pred.mean()), h))
+    scores.sort()
+    return [
+        {"host_id": h, "mean_predicted_rtt_log_ms": round(s, 4)}
+        for s, h in scores[:k]
+    ]

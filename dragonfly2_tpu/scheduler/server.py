@@ -211,6 +211,7 @@ class SchedulerServer:
                 glue.ServiceClient(self._manager_channel, MANAGER_SERVICE),
                 self.resource,
                 seed_client=SeedPeerClient(self.resource.host_manager),
+                networktopology=self.networktopology,
                 hostname=config.hostname,
                 ip=config.advertise_ip,
                 cluster_id=config.cluster_id,

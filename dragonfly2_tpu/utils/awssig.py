@@ -86,10 +86,11 @@ def oss_sign_headers(
     ``application/x-www-form-urlencoded`` to data-carrying requests, so
     writers must pass an explicit type or the signature won't match."""
     import base64
+    import email.utils
 
-    date = datetime.datetime.now(datetime.timezone.utc).strftime(
-        "%a, %d %b %Y %H:%M:%S GMT"
-    )
+    # RFC1123 via email.utils — strftime('%a/%b') is locale-dependent and
+    # a non-English LC_TIME would render a Date OSS can't parse
+    date = email.utils.formatdate(usegmt=True)
     resource = f"/{bucket}/{key}" if key else f"/{bucket}/"
     to_sign = f"{method}\n\n{content_type}\n{date}\n{resource}"
     sig = base64.b64encode(

@@ -1,0 +1,133 @@
+"""GNN-driven seed-peer placement (scheduler/seed_placement.py + the
+recommend_seeds job): live probe graph → GraphSAGE embedding → fleet-RTT
+ranking (SURVEY §7 stage 6)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.job import JobWorker
+from dragonfly2_tpu.scheduler.networktopology import NetworkTopology, Probe
+from dragonfly2_tpu.scheduler.seed_placement import recommend_seeds
+from dragonfly2_tpu.utils.kvstore import KVStore
+
+NS_PER_MS = 1_000_000
+
+
+@pytest.fixture
+def topology():
+    """6 hosts: host-0 has fast probes from everyone (the natural seed),
+    host-5 is slow from everyone."""
+    resource = res.Resource()
+    nt = NetworkTopology(KVStore(), resource.host_manager, None)
+    for i in range(6):
+        resource.host_manager.store(
+            res.Host(id=f"host-{i}", hostname=f"h{i}", ip=f"10.0.0.{i}", port=1)
+        )
+    for src in range(6):
+        for dst in range(6):
+            if src == dst:
+                continue
+            rtt_ms = 2 if dst == 0 else (80 if dst == 5 else 20)
+            nt.store_edge(f"host-{src}", f"host-{dst}")
+            nt.enqueue_probe(
+                f"host-{src}", Probe(f"host-{dst}", rtt_ns=rtt_ms * NS_PER_MS)
+            )
+    return resource, nt
+
+
+def _trained_params(nt):
+    """Fit a tiny GraphSAGE on the live graph so predictions carry the
+    RTT structure (fast-to-reach host-0 ranks first)."""
+    from dragonfly2_tpu.schema.columnar import records_to_columns
+    from dragonfly2_tpu.schema.features import build_probe_graph
+    from dragonfly2_tpu.trainer.train import GNNFitConfig, train_gnn
+
+    graph = build_probe_graph(records_to_columns(nt.export_records(dest_limit=10)))
+    result = train_gnn(graph, config=GNNFitConfig(hidden_dims=(16,), epochs=60))
+    return result.params, graph
+
+
+def test_recommend_seeds_ranks_fast_host_first(topology):
+    resource, nt = topology
+    params, _ = _trained_params(nt)
+    ranking = recommend_seeds(nt, params, k=3)
+    assert len(ranking) == 3
+    assert ranking[0]["host_id"] == "host-0"  # fastest from the fleet
+    assert ranking[0]["mean_predicted_rtt_log_ms"] <= ranking[1]["mean_predicted_rtt_log_ms"]
+    # the slow host never makes the podium
+    assert all(r["host_id"] != "host-5" for r in ranking)
+
+
+def test_recommend_seeds_respects_candidates(topology):
+    resource, nt = topology
+    params, _ = _trained_params(nt)
+    ranking = recommend_seeds(nt, params, k=2, candidates=["host-3", "host-5"])
+    assert [r["host_id"] for r in ranking][0] == "host-3"
+    assert {r["host_id"] for r in ranking} <= {"host-3", "host-5"}
+
+
+def test_recommend_seeds_job_end_to_end(topology):
+    """The job worker loads the active gnn model from the manager
+    registry and returns the ranking."""
+    import manager_pb2
+
+    from dragonfly2_tpu.trainer.serving import serialize_params
+
+    resource, nt = topology
+    params, _ = _trained_params(nt)
+    blob = serialize_params(params)
+
+    class FakeManager:
+        def ListModels(self, req):
+            return manager_pb2.ListModelsResponse(
+                models=[
+                    manager_pb2.Model(
+                        model_id="gnn-x", type="gnn", version=2, state="active"
+                    ),
+                    manager_pb2.Model(
+                        model_id="mlp-x", type="mlp", version=1, state="active"
+                    ),
+                ]
+            )
+
+        def GetModelWeights(self, req):
+            assert req.model_id == "gnn-x" and req.version == 2
+            return manager_pb2.ModelWeights(weights=blob)
+
+    worker = JobWorker(FakeManager(), resource, networktopology=nt)
+    job = type(
+        "J", (), {"id": 1, "type": "recommend_seeds", "args_json": json.dumps({"k": 2})}
+    )()
+    state, result = worker._execute(job)
+    assert state == "succeeded", result
+    assert result["model"] == "gnn-x" and result["version"] == 2
+    assert result["ranking"][0]["host_id"] == "host-0"
+
+
+def test_recommend_seeds_job_without_model(topology):
+    import manager_pb2
+
+    resource, nt = topology
+
+    class EmptyManager:
+        def ListModels(self, req):
+            return manager_pb2.ListModelsResponse(models=[])
+
+    worker = JobWorker(EmptyManager(), resource, networktopology=nt)
+    job = type("J", (), {"id": 1, "type": "recommend_seeds", "args_json": "{}"})()
+    state, result = worker._execute(job)
+    assert state == "failed" and "gnn" in result["error"]
+
+
+def test_recommend_seeds_empty_candidates_and_unknown(topology):
+    """Explicit empty candidates = none eligible (not full-fleet); a
+    candidate absent from the probe graph raises a precise error."""
+    resource, nt = topology
+    params, _ = _trained_params(nt)
+    with pytest.raises(ValueError, match="probe graph"):
+        recommend_seeds(nt, params, candidates=[])
+    with pytest.raises(ValueError, match="never-probed"):
+        recommend_seeds(nt, params, candidates=["never-probed-host"])
