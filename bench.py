@@ -57,6 +57,21 @@ def synthesize_dataset(d: str, shards: int, shard_bytes: int) -> list:
     return paths
 
 
+def _emit(value: float = 0.0, vs_baseline: float = 0.0, error: str = "", **extra) -> None:
+    """The ONE JSON line the driver records — every exit path shares this
+    shape (metric renames must never diverge between error and success)."""
+    rec = {
+        "metric": "mlp_trainer_throughput_e2e",
+        "value": value,
+        "unit": "records/sec/chip",
+        "vs_baseline": vs_baseline,
+    }
+    if error:
+        rec["error"] = error
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
 def _backend_or_exit(timeout_s: float = 120.0):
     """Initialize the jax backend under a watchdog: a dead TPU tunnel
     makes device enumeration block forever (the axon plugin dials the
@@ -82,18 +97,7 @@ def _backend_or_exit(timeout_s: float = 120.0):
             "error",
             f"jax backend init exceeded {timeout_s:.0f}s — TPU tunnel unresponsive",
         )
-        print(
-            json.dumps(
-                {
-                    "metric": "mlp_trainer_throughput_e2e",
-                    "value": 0,
-                    "unit": "records/sec/chip",
-                    "vs_baseline": 0,
-                    "error": error,
-                }
-            ),
-            flush=True,
-        )
+        _emit(error=error)
         # the init thread may still be blocked inside native plugin code;
         # normal interpreter teardown with that thread alive can abort —
         # _exit after the flush keeps the honest error line AND exit 0
@@ -108,17 +112,7 @@ def main() -> None:
     from dragonfly2_tpu.trainer.ingest import stream_train_mlp
 
     if not native.available():
-        print(
-            json.dumps(
-                {
-                    "metric": "mlp_trainer_throughput_e2e",
-                    "value": 0,
-                    "unit": "records/sec/chip",
-                    "vs_baseline": 0,
-                    "error": "native ingestion library unavailable",
-                }
-            )
-        )
+        _emit(error="native ingestion library unavailable")
         sys.exit(0)
 
     n_devices = jax.device_count()
@@ -176,19 +170,13 @@ def main() -> None:
 
     rec_per_sec_per_chip = stats.download_records / dt / n_devices
     north_star_per_chip = 1e9 / 600 / 8  # 1B records / 10 min / v5e-8
-    print(
-        json.dumps(
-            {
-                "metric": "mlp_trainer_throughput_e2e",
-                "value": round(rec_per_sec_per_chip, 1),
-                "unit": "records/sec/chip",
-                "vs_baseline": round(rec_per_sec_per_chip / north_star_per_chip, 3),
-                "records": stats.download_records,
-                "pairs": stats.pairs,
-                "steps": stats.steps,
-                "wall_s": round(dt, 2),
-            }
-        )
+    _emit(
+        value=round(rec_per_sec_per_chip, 1),
+        vs_baseline=round(rec_per_sec_per_chip / north_star_per_chip, 3),
+        records=stats.download_records,
+        pairs=stats.pairs,
+        steps=stats.steps,
+        wall_s=round(dt, 2),
     )
 
 

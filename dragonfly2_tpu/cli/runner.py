@@ -37,6 +37,9 @@ def run(name: str, server) -> int:
     maddr = getattr(server, "metrics_addr", None)
     if maddr:
         print(f"METRICS {name} {maddr}", flush=True)
+    raddr = getattr(server, "rest_addr", None)
+    if raddr:
+        print(f"REST {name} {raddr}", flush=True)
     print(f"READY {name} {addr}", flush=True)
     try:
         stop_event.wait()

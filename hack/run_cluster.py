@@ -40,6 +40,7 @@ class Proc:
         )
         self.addr: str | None = None
         self.metrics_addr: str | None = None
+        self.rest_addr: str | None = None
         # a dedicated reader thread avoids mixing select() on the raw fd
         # with buffered readline() (lines stranded in the TextIOWrapper
         # buffer would make select starve)
@@ -64,6 +65,8 @@ class Proc:
                 continue
             if line.startswith("METRICS "):
                 self.metrics_addr = line.split()[2]
+            if line.startswith("REST "):
+                self.rest_addr = line.split()[2]
             if line.startswith("READY "):
                 self.addr = line.split()[2]
                 return self.addr
@@ -145,27 +148,29 @@ def main() -> int:
 
         daemons = []
         for name in ("a", "b"):
-            d = Proc(
-                f"daemon-{name}",
-                [
-                    "-m",
-                    "dragonfly2_tpu.client.daemon",
-                    "--set",
-                    f"data_dir={work}/daemon-{name}",
-                    "--set",
-                    f"scheduler_address={scheduler_addr}",
-                    "--set",
-                    f"hostname=host-{name}",
-                    "--set",
-                    "piece_length=65536",
-                    "--set",
-                    "schedule_timeout=10.0",
-                ],
-                env,
-            )
+            args = [
+                "-m",
+                "dragonfly2_tpu.client.daemon",
+                "--set",
+                f"data_dir={work}/daemon-{name}",
+                "--set",
+                f"scheduler_address={scheduler_addr}",
+                "--set",
+                f"hostname=host-{name}",
+                "--set",
+                "piece_length=65536",
+                "--set",
+                "schedule_timeout=10.0",
+            ]
+            if name == "a":
+                # daemon A also serves its gRPC on a unix socket — the
+                # local-CLI path dfget drives below
+                args += ["--set", f"unix_socket={work}/dfdaemon-a.sock"]
+            d = Proc(f"daemon-{name}", args, env)
             procs.append(d)
             daemons.append(d)
         daemon_addrs = [d.wait_ready() for d in daemons]
+        daemon_addrs[0] = f"unix:{work}/dfdaemon-a.sock"
 
         # origin file (file:// keeps the script hermetic; http origins are
         # covered by the in-process e2e tests)
@@ -195,7 +200,7 @@ def main() -> int:
         )
         assert rc.returncode == 0, f"dfget A failed: {rc.stderr[-2000:]}"
         assert open(out_a, "rb").read() == payload, "daemon A bytes mismatch"
-        print("PASS dfget back-to-source via daemon A")
+        print("PASS dfget back-to-source via daemon A (unix socket)")
 
         # dfget through daemon B: must pull pieces from A over P2P
         out_b = os.path.join(work, "out-b.bin")
@@ -243,7 +248,7 @@ def main() -> int:
         print("PASS scheduler metrics scrape")
 
         # manager sees the registered scheduler (gRPC registry; the REST
-        # surface is covered by tests/test_manager_rest.py)
+        # surface gets its own stanza below)
         sys.path.insert(0, REPO)
         from dragonfly2_tpu.rpc import glue, gen  # noqa: F401
         import manager_pb2
@@ -256,6 +261,51 @@ def main() -> int:
         assert "sched-e2e" in names, f"scheduler not registered: {names}"
         ch.close()
         print("PASS scheduler registered with manager")
+
+        # v1 wire generation bound in the production scheduler binary:
+        # StatTask over the v1 service sees the downloaded task
+        from dragonfly2_tpu.rpc.glue import SCHEDULER_V1_SERVICE
+        from dragonfly2_tpu.utils.idgen import task_id_v1
+        import scheduler_v1_pb2 as v1
+
+        ch = glue.dial(scheduler_addr)
+        v1c = glue.ServiceClient(ch, SCHEDULER_V1_SERVICE)
+        stat = v1c.StatTask(v1.StatTaskRequest(task_id=task_id_v1(url, None)))
+        assert stat.state == "Succeeded" and stat.has_available_peer, stat
+        ch.close()
+        print("PASS v1 wire generation serves the same swarm")
+
+        # REST surface: console page, user bootstrap → signin → PAT →
+        # authenticated API call
+        rest = manager.rest_addr
+        assert rest, "manager did not report a REST address"
+
+        def call(method, path, body=None, token=None):
+            req = urllib.request.Request(
+                f"http://{rest}{path}",
+                method=method,
+                data=json.dumps(body).encode() if body is not None else None,
+                headers={
+                    "Content-Type": "application/json",
+                    **({"Authorization": f"Bearer {token}"} if token else {}),
+                },
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return json.loads(r.read())
+
+        page = urllib.request.urlopen(f"http://{rest}/", timeout=5).read().decode()
+        assert "Dragonfly2-TPU" in page and "/api/v1/models" in page
+        user = call("POST", "/api/v1/users", {"name": "op", "password": "pw", "role": "admin"})
+        session = call("POST", "/api/v1/users/signin", {"name": "op", "password": "pw"})
+        pat = call(
+            "POST",
+            f"/api/v1/users/{user['id']}/personal-access-tokens",
+            {"name": "e2e"},
+            token=session["token"],
+        )
+        rows = call("GET", "/api/v1/schedulers", token=pat["token"])
+        assert any(r["hostname"] == "sched-e2e" for r in rows), rows
+        print("PASS console + users/PAT auth over REST")
 
         print("CLUSTER E2E: ALL PASS")
         return 0
