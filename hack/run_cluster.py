@@ -146,6 +146,7 @@ def main() -> int:
         procs.append(scheduler)
         scheduler_addr = scheduler.wait_ready()
 
+        sock_a = f"{work}/dfdaemon-a.sock"
         daemons = []
         for name in ("a", "b"):
             args = [
@@ -165,12 +166,12 @@ def main() -> int:
             if name == "a":
                 # daemon A also serves its gRPC on a unix socket — the
                 # local-CLI path dfget drives below
-                args += ["--set", f"unix_socket={work}/dfdaemon-a.sock"]
+                args += ["--set", f"unix_socket={sock_a}"]
             d = Proc(f"daemon-{name}", args, env)
             procs.append(d)
             daemons.append(d)
         daemon_addrs = [d.wait_ready() for d in daemons]
-        daemon_addrs[0] = f"unix:{work}/dfdaemon-a.sock"
+        daemon_addrs[0] = f"unix:{sock_a}"
 
         # origin file (file:// keeps the script hermetic; http origins are
         # covered by the in-process e2e tests)

@@ -110,3 +110,47 @@ class TestRingCollectives:
         np.testing.assert_allclose(
             np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2
         )
+
+
+class TestUlyssesAttention:
+    """All-to-all sequence parallelism vs the local oracle — the second
+    long-context pattern next to ring attention (ops/ulysses.py)."""
+
+    def _qkv(self, sp, heads=8, d=8, b=2, t_per=16, seed=3):
+        key = jax.random.PRNGKey(seed)
+        shape = (b, t_per * sp, heads, d)
+        return tuple(
+            jax.random.normal(k, shape, jnp.float32)
+            for k in jax.random.split(key, 3)
+        )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_oracle(self, ring_mesh, causal):
+        from dragonfly2_tpu.ops.ulysses import make_ulysses_attention
+
+        q, k, v = self._qkv(sp=8)
+        fn = make_ulysses_attention(ring_mesh, "sp", causal=causal)
+        spec = NamedSharding(ring_mesh, P(None, "sp", None, None))
+        out = fn(*(jax.device_put(x, spec) for x in (q, k, v)))
+        want = local_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-4)
+
+    def test_matches_ring(self, ring_mesh):
+        """Both sequence-parallel patterns compute the same attention."""
+        from dragonfly2_tpu.ops.ulysses import make_ulysses_attention
+
+        q, k, v = self._qkv(sp=8, seed=9)
+        spec = NamedSharding(ring_mesh, P(None, "sp", None, None))
+        args = tuple(jax.device_put(x, spec) for x in (q, k, v))
+        ring = make_ring_attention(ring_mesh, "sp", causal=True)(*args)
+        uly = make_ulysses_attention(ring_mesh, "sp", causal=True)(*args)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(uly), atol=2e-4)
+
+    def test_head_divisibility_error(self, ring_mesh):
+        from dragonfly2_tpu.ops.ulysses import make_ulysses_attention
+
+        q, k, v = self._qkv(sp=8, heads=6)  # 6 % 8 != 0
+        fn = make_ulysses_attention(ring_mesh, "sp")
+        spec = NamedSharding(ring_mesh, P(None, "sp", None, None))
+        with pytest.raises(ValueError, match="heads % axis_size"):
+            fn(*(jax.device_put(x, spec) for x in (q, k, v)))
