@@ -1,0 +1,160 @@
+"""Fused attention as a Pallas TPU kernel.
+
+The hot exact-attention block — used standalone (`flash_attention`) and
+as the compute inside the Ulysses head-sharded path — in the canonical
+flash form: grid over (batch·heads, query blocks, key blocks), online
+softmax carried across key-block grid steps in VMEM scratch, one
+(block_k, d) K/V tile resident at a time. The [T, T] score matrix never
+materializes and VMEM use is O(block²), independent of sequence length —
+the property the long-context Ulysses path needs (pallas_guide.md: grid
+iteration is sequential with the last axis fastest, so scratch carries
+are safe across the key-block axis; @pl.when gates init/finalize).
+
+Causal calls skip whole key blocks above the diagonal (no masked-out
+matmul work). `interpret=True` runs the same kernel through the Pallas
+interpreter — the CPU test suite's parity harness; on TPU it compiles.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30  # large-negative instead of -inf: exp() underflows to
+# exact zero without inf-inf=NaN hazards in the running-max updates
+
+
+def _attn_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    block_q: int,
+    block_k: int,
+    num_kb: int,
+    t_valid: int,
+    causal: bool,
+    scale: float,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # whole key block above the causal diagonal → no work at all
+    run = (
+        ki * block_k <= qi * block_q + (block_q - 1)
+        if causal
+        else ki == ki  # always-true traced predicate
+    )
+
+    @pl.when(run)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
+        k_blk = k_ref[0].astype(jnp.float32)  # [BK, D]
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = q @ k_blk.T  # [BQ, BK]
+        k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < t_valid  # padded keys must never win the softmax
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_old = m_scr[:]
+        m_new = jnp.maximum(m_old, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_old - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + p.sum(axis=-1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + p @ v_blk
+
+    @pl.when(ki == num_kb - 1)
+    def _finalize():
+        o_ref[0] = (
+            acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)[:, None]
+        ).astype(o_ref.dtype)
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    """[B, T, H, D] q/k/v → [B, T, H, D]; same contract as
+    ops.ring.local_attention, fused in one Pallas kernel. The sequence is
+    padded up to a common multiple of both block sizes (so no tail key is
+    ever dropped); padded keys are masked to -inf in-kernel and padded
+    query rows are sliced away on return."""
+    b, t, h, d = q.shape
+    scale = 1.0 / (d**0.5)
+
+    bq = min(block_q, _ceil_to(t, 8))
+    bk = min(block_k, _ceil_to(t, 8))
+    t_pad = _ceil_to(t, math.lcm(bq, bk))
+
+    def prep(x):
+        # [B, T, H, D] → [B·H, T_pad, D]
+        x = jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
+        if t_pad != t:
+            x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
+        return x
+
+    num_kb = t_pad // bk
+    kernel = functools.partial(
+        _attn_kernel,
+        block_q=bq,
+        block_k=bk,
+        num_kb=num_kb,
+        t_valid=t,
+        causal=causal,
+        scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t_pad // bq, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq,), jnp.float32),  # running max
+            pltpu.VMEM((bq,), jnp.float32),  # running normalizer
+            pltpu.VMEM((bq, d), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(prep(q), prep(k), prep(v))
+
+    out = out[:, :t].reshape(b, h, t, d)
+    return jnp.moveaxis(out, 1, 2)

@@ -24,10 +24,16 @@ def ulysses_attention(
     v: jax.Array,
     axis_name: str,
     causal: bool = False,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
 ) -> jax.Array:
     """Per-device shards [B, T/sp, H, D] (sequence-sharded along the mesh
     axis, shards concatenated in axis order form the global sequence) →
-    [B, T/sp, H, D]. Heads must divide evenly by the axis size."""
+    [B, T/sp, H, D]. Heads must divide evenly by the axis size.
+
+    ``use_pallas`` runs the head-sharded exact attention through the
+    fused Pallas kernel (ops/flash.py) — the hot per-device compute —
+    instead of the jnp oracle."""
     from dragonfly2_tpu.ops.ring import local_attention
 
     axis_size = lax.psum(1, axis_name)
@@ -47,17 +53,28 @@ def ulysses_attention(
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     # exact attention: the full sequence is local, only heads are sharded,
-    # so no online-softmax machinery is needed (that's the Ulysses trade)
-    oh = local_attention(qh, kh, vh, causal=causal)
+    # so no online-softmax machinery is needed at this layer (the Pallas
+    # kernel does its own blockwise softmax internally)
+    if use_pallas:
+        from dragonfly2_tpu.ops.flash import flash_attention
+
+        oh = flash_attention(qh, kh, vh, causal=causal, interpret=pallas_interpret)
+    else:
+        oh = local_attention(qh, kh, vh, causal=causal)
     return heads_to_seq(oh)
 
 
-def make_ulysses_attention(mesh, axis_name: str, causal: bool = False):
+def make_ulysses_attention(
+    mesh, axis_name: str, causal: bool = False, use_pallas: bool = False
+):
     """shard_map-wrapped all-to-all attention over ``mesh[axis_name]``
-    (same calling convention as ops.ring.make_ring_attention)."""
+    (same calling convention as ops.ring.make_ring_attention). With
+    ``use_pallas`` the per-device compute is the fused kernel — compiled
+    on TPU, interpreter elsewhere (CI runs on CPU)."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    interpret = jax.default_backend() != "tpu"
     spec = P(None, axis_name, None, None)
 
     @functools.partial(
@@ -68,6 +85,14 @@ def make_ulysses_attention(mesh, axis_name: str, causal: bool = False):
         check_vma=False,
     )
     def _ulysses(q, k, v):
-        return ulysses_attention(q, k, v, axis_name=axis_name, causal=causal)
+        return ulysses_attention(
+            q,
+            k,
+            v,
+            axis_name=axis_name,
+            causal=causal,
+            use_pallas=use_pallas,
+            pallas_interpret=interpret,
+        )
 
     return _ulysses
