@@ -83,6 +83,9 @@ class DaemonConfig:
     host_stats_override: dict = field(default_factory=dict)
     # synthetic per-piece upload latency (A/B harness models slow hosts)
     upload_delay_s: float = 0.0
+    # global upload bandwidth budget in bytes/s shared by all child peers
+    # (reference upload totalRateLimit); 0 = unlimited
+    upload_rate_limit: float = 0.0
     # Prometheus /metrics endpoint: -1 = disabled
     metrics_port: int = -1
     metrics_host: str = "127.0.0.1"
@@ -128,6 +131,7 @@ class Daemon:
             host=config.upload_host,
             port=config.upload_port,
             delay_s=config.upload_delay_s,
+            rate_limit_bps=config.upload_rate_limit,
         )
         self._selector = None
         self._scheduler = None

@@ -15,6 +15,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from dragonfly2_tpu.client.piece_manager import RateLimiter
 from dragonfly2_tpu.client.storage import StorageManager
 from dragonfly2_tpu.client import metrics as M
 from dragonfly2_tpu.utils import dflog
@@ -33,11 +34,15 @@ class UploadServer:
         host: str = "127.0.0.1",
         port: int = 0,
         delay_s: float = 0.0,
+        rate_limit_bps: float = 0.0,
     ):
         self.storage = storage
         # synthetic per-piece serving latency — benchmarking/AB-harness
         # knob to model slow hosts; 0 in production
         self.delay_s = delay_s
+        # global upload bandwidth budget shared by all child peers
+        # (reference upload_manager totalRateLimit); 0 = unlimited
+        self.limiter = RateLimiter(rate_limit_bps)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -103,7 +108,7 @@ class UploadServer:
             if ct:
                 req.send_header("X-Dragonfly-Origin-Content-Type", ct)
             req.end_headers()
-            req.wfile.write(data)
+            self._write_limited(req, data)
             return
 
         rng = req.headers.get("Range")
@@ -125,7 +130,7 @@ class UploadServer:
                 "Content-Range", f"bytes {start}-{start + len(data) - 1}/{total}"
             )
             req.end_headers()
-            req.wfile.write(data)
+            self._write_limited(req, data)
             return
 
         # whole object (requires completion)
@@ -137,4 +142,18 @@ class UploadServer:
         req.send_response(200)
         req.send_header("Content-Length", str(len(data)))
         req.end_headers()
-        req.wfile.write(data)
+        self._write_limited(req, data)
+
+    def _write_limited(self, req: BaseHTTPRequestHandler, data: bytes) -> None:
+        """Write the body through the shared upload-rate token bucket in
+        64 KiB chunks — concurrent child peers split the budget rather
+        than each getting the full rate."""
+        if self.limiter.rate <= 0:
+            req.wfile.write(data)
+            return
+        chunk = 64 * 1024
+        mv = memoryview(data)  # zero-copy slicing — no per-chunk bytes alloc
+        for off in range(0, len(data), chunk):
+            part = mv[off : off + chunk]
+            self.limiter.acquire(len(part))
+            req.wfile.write(part)

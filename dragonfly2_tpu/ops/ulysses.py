@@ -74,7 +74,12 @@ def make_ulysses_attention(
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    interpret = jax.default_backend() != "tpu"
+    # interpreter only on CPU (the CI parity harness). Derived from the
+    # MESH's devices, not jax.default_backend(): the real-TPU deployment
+    # registers platform "axon" (≠ "tpu"), and default_backend() would
+    # both misclassify it AND force backend init at factory time (which
+    # blocks forever when the TPU tunnel is down — see bench.py).
+    interpret = mesh.devices.flat[0].platform == "cpu"
     spec = P(None, axis_name, None, None)
 
     @functools.partial(

@@ -191,3 +191,51 @@ def test_upload_download_piece_roundtrip(tmp_path):
             download_piece(server.address, "0" * 64, 0)
     finally:
         server.stop()
+
+
+def test_upload_server_rate_limit(tmp_path):
+    """The upload server throttles body writes through a shared token
+    bucket (reference upload totalRateLimit): serving 256 KiB at
+    256 KiB/s must take ~1s, unlimited must be near-instant."""
+    import time
+    import urllib.request
+
+    from dragonfly2_tpu.client.storage import StorageManager
+    from dragonfly2_tpu.client.uploader import UploadServer
+
+    payload = os.urandom(256 * 1024)
+    storage = StorageManager(str(tmp_path / "store"))
+    ts = storage.register_task(
+        "task-rl", "peer-rl", url="file:///x", piece_length=64 * 1024,
+        content_length=len(payload),
+    )
+    for n in range(4):
+        ts.write_piece(n, n * 64 * 1024, payload[n * 65536 : (n + 1) * 65536])
+    ts.mark_done(len(payload))
+
+    fast = UploadServer(storage, port=0)
+    fast.start()
+    try:
+        t0 = time.monotonic()
+        with urllib.request.urlopen(
+            f"http://{fast.address}/download/task-rl", timeout=10
+        ) as r:
+            assert r.read() == payload
+        assert time.monotonic() - t0 < 1.0
+    finally:
+        fast.stop()
+
+    # budget of HALF the payload per second: the pre-filled bucket
+    # covers 128 KiB, the rest must wait ~1s of refill
+    slow = UploadServer(storage, port=0, rate_limit_bps=128 * 1024)
+    slow.start()
+    try:
+        t0 = time.monotonic()
+        with urllib.request.urlopen(
+            f"http://{slow.address}/download/task-rl", timeout=30
+        ) as r:
+            assert r.read() == payload
+        elapsed = time.monotonic() - t0
+        assert elapsed >= 0.5, f"rate limit had no effect ({elapsed:.2f}s)"
+    finally:
+        slow.stop()
