@@ -170,11 +170,59 @@ class BaseEvaluator:
 
 class MLEvaluator(BaseEvaluator):
     """Ranks parents by the trained MLP's predicted piece cost — lower
-    predicted cost sorts first. Shares IsBadNode with the base."""
+    predicted cost sorts first. With a GRU installed, bad-node detection
+    is model-based too: a parent whose latest piece cost blows far past
+    the prediction from its own history is flagged (base statistics
+    remain the fallback)."""
 
-    def __init__(self, model=None):
+    # flag when the observed cost exceeds ~20× the predicted cost — the
+    # same severity the base rule uses for short histories (mean*20)
+    GRU_BAD_LOG_MARGIN = math.log(20.0)
+
+    # verdict cache bound: cleared wholesale when exceeded (entries are
+    # invalidated naturally by the piece count changing)
+    GRU_CACHE_MAX = 4096
+
+    def __init__(self, model=None, gru=None):
         self._model = model  # ml.scorer.MLPScorer-compatible
+        self._gru = gru  # trainer.serving.GRUScorer-compatible
+        # peer.id -> (piece_count, verdict): is_bad_node runs once per
+        # candidate per scheduling attempt (per piece event), and a jit
+        # dispatch per call would multiply hot-path latency — the verdict
+        # only changes when a new piece cost lands
+        self._gru_verdicts: dict = {}
         super().__init__()
+
+    def set_gru(self, gru) -> None:
+        self._gru = gru
+        self._gru_verdicts.clear()
+
+    def is_bad_node(self, peer: Peer) -> bool:
+        if self._gru is None:
+            return super().is_bad_node(peer)
+        if peer.fsm.is_state(*_BAD_STATES):
+            return True
+        costs = peer.piece_costs()
+        n = len(costs)
+        if n < MIN_AVAILABLE_COST_LEN:
+            return False
+        cached = self._gru_verdicts.get(peer.id)
+        if cached is not None and cached[0] == n:
+            return cached[1]
+        try:
+            predicted = float(self._gru.predict_next_log_cost([costs[:-1]])[0])
+            verdict = (
+                math.log1p(max(costs[-1], 0.0)) > predicted + self.GRU_BAD_LOG_MARGIN
+            )
+        except Exception:
+            logger.warning(
+                "gru bad-node predict failed; using base statistics", exc_info=True
+            )
+            return super().is_bad_node(peer)
+        if len(self._gru_verdicts) >= self.GRU_CACHE_MAX:
+            self._gru_verdicts.clear()
+        self._gru_verdicts[peer.id] = (n, verdict)
+        return verdict
 
     def set_model(self, model) -> None:
         # a model trained against an older feature schema must be refused

@@ -43,6 +43,7 @@ class ModelRefresher:
         self.cluster_id = scheduler_cluster_id
         self.interval = interval
         self.loaded_version: tuple[str, int] | None = None  # (model_id, version)
+        self.loaded_gru_version: tuple[str, int] | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -57,6 +58,9 @@ class ModelRefresher:
             logger.warning("model list poll failed: %s", e)
             return False
 
+        # GRU refresh rides every poll, independent of MLP install state
+        gru_installed = self._refresh_gru(resp)
+
         active = [
             m for m in resp.models if m.state == "active" and m.type == "mlp"
         ]
@@ -68,7 +72,7 @@ class ModelRefresher:
                 logger.info("active model withdrawn; falling back to base evaluator")
                 self.evaluator.set_model(None)
                 self.loaded_version = None
-            return False
+            return gru_installed
 
         # newest ACTIVATION wins if several MLP models are active (e.g.
         # per-source-host model ids) — updated_at_ns is stamped by the
@@ -77,7 +81,7 @@ class ModelRefresher:
         m = max(active, key=lambda m: (m.updated_at_ns, m.created_at_ns))
         key = (m.model_id, m.version)
         if key == self.loaded_version:
-            return False
+            return gru_installed
 
         try:
             w = self.manager.GetModelWeights(
@@ -96,11 +100,48 @@ class ModelRefresher:
             logger.warning(
                 "loading model %s v%d failed (%s); keeping previous", m.model_id, m.version, e
             )
-            return False
+            return gru_installed
 
         self.evaluator.set_model(scorer)
         self.loaded_version = key
         logger.info("installed model %s v%d into ml evaluator", m.model_id, m.version)
+        return True
+
+    def _refresh_gru(self, resp) -> bool:
+        """Install the newest active GRU alongside the MLP (model-based
+        bad-node detection); best-effort — a broken GRU never blocks the
+        MLP install or scheduling. Returns True when a GRU was
+        (re)installed, so refresh_once's installed-something contract
+        covers both model types."""
+        if not hasattr(self.evaluator, "set_gru"):
+            return False
+        active = [m for m in resp.models if m.state == "active" and m.type == "gru"]
+        if not active:
+            if self.loaded_gru_version is not None:
+                logger.info("active gru withdrawn; bad-node falls back to statistics")
+                self.evaluator.set_gru(None)
+                self.loaded_gru_version = None
+            return False
+        m = max(active, key=lambda m: (m.updated_at_ns, m.created_at_ns))
+        key = (m.model_id, m.version)
+        if key == self.loaded_gru_version:
+            return False
+        try:
+            w = self.manager.GetModelWeights(
+                manager_pb2.GetModelRequest(model_id=m.model_id, version=m.version)
+            )
+            from dragonfly2_tpu.trainer.serving import GRUScorer
+
+            scorer = GRUScorer(deserialize_params_auto(w.weights))
+            scorer.predict_next_log_cost([[5.0, 6.0, 7.0]])  # compile + sanity
+        except Exception as e:
+            logger.warning(
+                "loading gru %s v%d failed (%s); keeping previous", m.model_id, m.version, e
+            )
+            return False
+        self.evaluator.set_gru(scorer)
+        self.loaded_gru_version = key
+        logger.info("installed gru %s v%d for bad-node detection", m.model_id, m.version)
         return True
 
     # ------------------------------------------------------------------

@@ -125,3 +125,51 @@ class GNNScorer:
         src = jnp.asarray([self._node_index[s] for s in src_ids], jnp.int32)
         dst = jnp.asarray([self._node_index[d] for d in dst_ids], jnp.int32)
         return np.asarray(self._predict(self._params, self._emb, src, dst))
+
+
+class GRUScorer:
+    """Next-piece-cost predictor around trained GRU params — the
+    scheduler's ml evaluator consults it for model-based bad-node
+    detection (a parent whose latest piece cost blows far past the
+    prediction from its own history is flagged)."""
+
+    def __init__(self, params: Any):
+        import jax
+
+        from dragonfly2_tpu.models.gru import predict_next_cost
+
+        self._params = params
+        self._fn = jax.jit(predict_next_cost)
+
+    def predict_next_log_cost(self, cost_prefixes_ms: list) -> np.ndarray:
+        """[B] predicted next log1p piece cost (ms) from per-parent piece
+        cost history prefixes — features built exactly like the offline
+        extractor (schema/features.extract_piece_sequences: log1p cost,
+        normalized piece position)."""
+        import jax.numpy as jnp
+
+        from dragonfly2_tpu.schema.features import (
+            GRU_FEATURE_DIM,
+            GRU_MAX_SEQ,
+        )
+        from dragonfly2_tpu.schema.records import MAX_PIECES_PER_PARENT
+
+        b = len(cost_prefixes_ms)
+        seqs = np.zeros((b, GRU_MAX_SEQ, GRU_FEATURE_DIM), np.float32)
+        lengths = np.zeros((b,), np.int32)
+        # positions trained on are (true piece index + 1)/MAX, capped at
+        # GRU_MAX_SEQ pieces per record — long live histories are tail-
+        # truncated to the most recent costs with their TRUE positions,
+        # clipped to the trained range (records never exceed MAX pieces,
+        # so larger positions would be out-of-distribution)
+        pos_cap = GRU_MAX_SEQ / MAX_PIECES_PER_PARENT
+        for i, prefix in enumerate(cost_prefixes_ms):
+            full = np.asarray(prefix, np.float64)
+            start = max(0, len(full) - GRU_MAX_SEQ)
+            p = full[start:]
+            L = len(p)
+            seqs[i, :L, 0] = np.log1p(p)
+            pos = (start + np.arange(L) + 1) / MAX_PIECES_PER_PARENT
+            seqs[i, :L, 1] = np.minimum(pos, pos_cap)
+            lengths[i] = L
+        return np.asarray(self._fn(self._params, jnp.asarray(seqs), jnp.asarray(lengths)))

@@ -39,7 +39,12 @@ def cluster(tmp_path):
         resource,
         Scheduling(
             BaseEvaluator(),
-            SchedulingConfig(retry_interval=0.0, retry_back_to_source_limit=1),
+            # a couple of retries with a real interval: under full-suite
+            # load daemon B can register before the scheduler has
+            # processed A's finished event, and with zero settling time a
+            # single empty candidate search would send B to the origin
+            # (observed as a rare pure-P2P assertion flake)
+            SchedulingConfig(retry_interval=0.05, retry_back_to_source_limit=3),
         ),
         storage=storage,
         networktopology=nt,

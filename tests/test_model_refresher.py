@@ -167,3 +167,86 @@ def test_reactivating_older_model_takes_effect(tmp_path):
     finally:
         server.stop(0)
         db.close()
+
+
+def test_gru_install_and_bad_node(tmp_path):
+    """Train→serve for the GRU: a trained next-piece-cost model installs
+    through the refresher and drives model-based bad-node detection —
+    a parent whose last piece blew ~20x past its own history is flagged,
+    a steady parent is not."""
+    import numpy as np
+
+    import manager_pb2
+
+    from dragonfly2_tpu.scheduler import resource as res
+    from dragonfly2_tpu.scheduler.evaluator import MLEvaluator
+    from dragonfly2_tpu.scheduler.model_refresher import ModelRefresher
+    from dragonfly2_tpu.schema.features import GRU_FEATURE_DIM, GRU_MAX_SEQ
+    from dragonfly2_tpu.trainer.serving import serialize_params
+    from dragonfly2_tpu.trainer.train import FitConfig, train_gru
+
+    # train on flat sequences: next cost ≈ recent costs
+    rng = np.random.default_rng(0)
+    n = 512
+    base = rng.uniform(2.0, 5.0, size=(n, 1))
+    # variable lengths: serving histories are often shorter than the max,
+    # so the model must see short sequences too
+    lengths = rng.integers(3, GRU_MAX_SEQ + 1, size=n).astype(np.int32)
+    seqs = np.zeros((n, GRU_MAX_SEQ, GRU_FEATURE_DIM), np.float32)
+    for i in range(n):
+        L = lengths[i]
+        seqs[i, :L, 0] = base[i, 0] + rng.normal(0, 0.05, size=L)
+        seqs[i, :L, 1] = (np.arange(L) + 1) / 10.0
+    labels = (base[:, 0] + rng.normal(0, 0.05, size=n)).astype(np.float32)
+    result = train_gru(
+        seqs, labels, lengths=lengths,
+        config=FitConfig(hidden_dims=(32,), batch_size=128, epochs=10),
+    )
+    blob = serialize_params(result.params)
+
+    class FakeManager:
+        def ListModels(self, req):
+            return manager_pb2.ListModelsResponse(
+                models=[
+                    manager_pb2.Model(
+                        model_id="gru-h", type="gru", version=1, state="active",
+                        updated_at_ns=1,
+                    )
+                ]
+            )
+
+        def GetModelWeights(self, req):
+            return manager_pb2.ModelWeights(weights=blob)
+
+    evaluator = MLEvaluator()
+    refresher = ModelRefresher(FakeManager(), evaluator, scheduler_cluster_id=1)
+    refresher.refresh_once()
+    assert refresher.loaded_gru_version == ("gru-h", 1)
+    assert evaluator._gru is not None
+
+    host = res.Host(id="h1")
+    task = res.Task("t1", "https://e/x")
+    steady = res.Peer("steady", task, host)
+    spiky = res.Peer("spiky", task, host)
+    for p in (steady, spiky):  # Pending is itself a bad state — run them
+        p.fsm.event(res.PEER_EVENT_REGISTER_NORMAL)
+        p.fsm.event(res.PEER_EVENT_DOWNLOAD)
+    # histories in ms-scale log space ≈ exp(3..5); steady stays flat,
+    # spiky's last piece is ~1000x its history
+    for _ in range(6):
+        steady.append_piece_cost(30.0)
+        spiky.append_piece_cost(30.0)
+    steady.append_piece_cost(33.0)
+    spiky.append_piece_cost(30_000.0)
+    assert evaluator.is_bad_node(spiky)
+    assert not evaluator.is_bad_node(steady)
+
+    # withdrawal falls back to base statistics
+    class EmptyManager(FakeManager):
+        def ListModels(self, req):
+            return manager_pb2.ListModelsResponse(models=[])
+
+    refresher.manager = EmptyManager()
+    refresher.refresh_once()
+    assert refresher.loaded_gru_version is None
+    assert evaluator._gru is None
