@@ -111,3 +111,19 @@ def _hint(cls, name: str):
     if origin is None:
         return h
     return object  # containers / optionals → YAML parse
+
+
+def apply_jax_platform_env() -> None:
+    """Pin the JAX platform from ``DF_JAX_PLATFORM`` before the first
+    backend init. The container's sitecustomize registers the real-TPU
+    backend for every process, so an env var alone is not enough (see
+    tests/conftest.py) — and a dead TPU tunnel hangs backend init, so
+    local CPU runs of any entry point need this hook. No-op when the
+    variable is unset or jax is already pinned by the caller."""
+    import os
+
+    platform = os.environ.get("DF_JAX_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)

@@ -68,15 +68,10 @@ def main_with_config(name: str, build, argv=None) -> int:
     args = p.parse_args(argv)
 
     # test/e2e hook: force the JAX platform before any compute-plane
-    # import (the container's sitecustomize pins the real-TPU backend,
-    # so an env var alone is not enough — see tests/conftest.py)
-    import os
+    # import (see cli/config.apply_jax_platform_env)
+    from dragonfly2_tpu.cli.config import apply_jax_platform_env
 
-    platform = os.environ.get("DF_JAX_PLATFORM")
-    if platform:
-        import jax
-
-        jax.config.update("jax_platforms", platform)
+    apply_jax_platform_env()
 
     # multi-host slice/DCN job: bring up jax.distributed before any
     # device query (no-op without DF_JAX_COORDINATOR)

@@ -311,6 +311,10 @@ def run_ab(cfg: ABConfig | None = None, workdir: str | None = None) -> dict:
 
 
 def main() -> None:
+    # same platform hook as the service binaries
+    from dragonfly2_tpu.cli.config import apply_jax_platform_env
+
+    apply_jax_platform_env()
     print(json.dumps(run_ab()))
 
 
