@@ -46,6 +46,16 @@ class DaemonConfig:
     # also serve the dfdaemon gRPC on this unix socket (local CLI path,
     # reference pkg/rpc/mux.go); empty = TCP only
     unix_socket: str = ""
+    # manager to fetch the scheduler list from (dynconfig-fed, searcher-
+    # scoped); empty = static scheduler_address only
+    manager_address: str = ""
+    dynconfig_interval: float = 300.0
+    # client-side roots (and optional mTLS pair) for the manager dial —
+    # same shape as the scheduler/trainer manager clients
+    manager_tls_ca_file: str = ""
+    manager_tls_server_name: str = ""
+    manager_tls_client_cert_file: str = ""
+    manager_tls_client_key_file: str = ""
     upload_host: str = "127.0.0.1"
     upload_port: int = 0
     host_type: str = "normal"  # "normal" | "super" (seed peer)
@@ -134,10 +144,11 @@ class Daemon:
             rate_limit_bps=config.upload_rate_limit,
         )
         self._selector = None
-        self._scheduler = None
         self._server = None
         self.port = 0
         self._stop = threading.Event()
+        self._dynconfig = None
+        self._manager_channel = None
         self._threads: list[threading.Thread] = []
         self.gc = GC()
         self.task_manager: TaskManager | None = None
@@ -145,9 +156,83 @@ class Daemon:
         self.object_gateway = None
 
     # ------------------------------------------------------------------
+    def _make_scheduler_dynconfig(self):
+        """Dynconfig engine polling the manager's searcher-scoped
+        scheduler list, with a disk cache fallback under data_dir
+        (reference internal/dynconfig manager source)."""
+        import manager_pb2  # noqa: E402 — flat proto import
+
+        from dragonfly2_tpu.manager.service import SERVICE_NAME as MANAGER_SERVICE
+        from dragonfly2_tpu.utils.dynconfig import Dynconfig
+
+        self._manager_channel = glue.dial(
+            self.cfg.manager_address,
+            **glue.dial_tls_args(
+                self.cfg.manager_tls_ca_file,
+                self.cfg.manager_tls_server_name,
+                self.cfg.manager_tls_client_cert_file,
+                self.cfg.manager_tls_client_key_file,
+            ),
+        )
+        client = glue.ServiceClient(self._manager_channel, MANAGER_SERVICE)
+
+        def fetch() -> dict:
+            resp = client.ListSchedulers(
+                manager_pb2.ListSchedulersRequest(
+                    hostname=self.cfg.hostname,
+                    ip=self.cfg.ip,
+                    idc=self.cfg.idc,
+                    location=self.cfg.location,
+                )
+            )
+            return {
+                "schedulers": [
+                    {"ip": s.ip, "port": s.port, "hostname": s.hostname}
+                    for s in resp.schedulers
+                ]
+            }
+
+        return Dynconfig(
+            fetch,
+            cache_path=Path(self.cfg.data_dir) / "dynconfig.json",
+            refresh_interval=self.cfg.dynconfig_interval,
+        )
+
+    @staticmethod
+    def _scheduler_addrs(data: dict) -> list[str]:
+        return [
+            f"{s['ip']}:{s['port']}"
+            for s in (data or {}).get("schedulers", [])
+            if s.get("ip") and s.get("port")
+        ]
+
     def start(self) -> None:
         self.upload.start()
         addresses = [a for a in self.cfg.scheduler_address.split(",") if a.strip()]
+        if self.cfg.manager_address:
+            # dynconfig-fed scheduler list: the manager's view of the
+            # cluster (searcher-scoped to this daemon's location) is the
+            # source of truth, refreshed on an interval; the static list
+            # is the bootstrap/fallback (reference client dynconfig)
+            self._dynconfig = self._make_scheduler_dynconfig()
+            fetched = self._scheduler_addrs(self._dynconfig.get())
+            if fetched:
+                addresses = fetched
+            elif not addresses:
+                # surface the real cause: get() swallows fetch failures
+                # into {}, which reads as "manager has no schedulers" —
+                # an unreachable/TLS-mismatched manager is a different bug
+                try:
+                    self._dynconfig.fetch_once()
+                except Exception as e:
+                    raise RuntimeError(
+                        f"manager dynconfig fetch failed ({e}) and no static"
+                        " scheduler_address fallback is configured"
+                    ) from e
+                raise RuntimeError(
+                    "manager returned no schedulers and no static"
+                    " scheduler_address fallback is configured"
+                )
         self._selector = glue.SchedulerSelector(
             addresses,
             dial_kwargs=glue.dial_tls_args(
@@ -157,7 +242,17 @@ class Daemon:
                 self.cfg.scheduler_tls_client_key_file,
             ),
         )
-        self._scheduler = self._selector.primary()
+        if self._dynconfig is not None:
+            self._dynconfig.register(
+                lambda data: self._selector.update_addresses(
+                    self._scheduler_addrs(data)
+                )
+            )
+            self._dynconfig.start()
+        # fail fast when no scheduler is reachable; NOT pinned — the
+        # probe loop re-resolves the primary per round because dynconfig
+        # membership changes can close any cached channel
+        self._selector.primary()
 
         from dragonfly2_tpu.client.piece_manager import TrafficShaper
 
@@ -282,6 +377,10 @@ class Daemon:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._dynconfig is not None:
+            self._dynconfig.stop()
+        if self._manager_channel is not None:
+            self._manager_channel.close()
         selector = getattr(self, "_selector", None)
         if selector is not None:
             for client in selector.all():
@@ -460,7 +559,7 @@ class Daemon:
                 host=me, probe_started=scheduler_pb2.ProbeStartedRequest()
             )
         )
-        responses = self._scheduler.SyncProbes(iter(q.get, None))
+        responses = self._selector.primary().SyncProbes(iter(q.get, None))
         probed = 0
         try:
             resp = next(responses, None)

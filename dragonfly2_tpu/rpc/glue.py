@@ -403,6 +403,33 @@ class SchedulerSelector:
             self._fail_until.pop(addr, None)
             return client
 
+    def update_addresses(self, addresses: list[str]) -> None:
+        """Reconcile the scheduler set against a fresh dynconfig list:
+        new addresses join the ring, removed ones leave it and their
+        channels close (reference dynconfig-fed scheduler list — the
+        daemon follows the manager's view of the cluster)."""
+        fresh = [a.strip() for a in addresses if a.strip()]
+        if not fresh:
+            return  # an empty push must not strand the daemon schedulerless
+        with self._lock:
+            current = set(self.addresses)
+            target = set(fresh)
+            if current == target:
+                return
+            for addr in target - current:
+                self.ring.add(addr)
+            dead_channels = []
+            for addr in current - target:
+                self.ring.remove(addr)
+                self._clients.pop(addr, None)
+                ch = self._channels.pop(addr, None)
+                if ch is not None:
+                    dead_channels.append(ch)
+                self._fail_until.pop(addr, None)
+            self.addresses = fresh
+        for ch in dead_channels:
+            ch.close()
+
     def for_task(self, task_id: str) -> ServiceClient:
         return self._client(self.ring.pick(task_id))
 

@@ -104,9 +104,24 @@ class Dynconfig:
             self._thread.join(timeout=2.0)
 
     def _loop(self) -> None:
-        self.refresh()
+        # skip the initial refresh when a recent get()/refresh() already
+        # fetched — start() right after a bootstrap fetch must not hit
+        # the source twice within milliseconds
+        with self._lock:
+            fresh = (
+                self._data is not None
+                and time.monotonic() - self._fetched_at < self.refresh_interval
+            )
+        if not fresh:
+            self.refresh()
         while not self._stop.wait(self.refresh_interval):
             self.refresh()
+
+    def fetch_once(self) -> dict:
+        """One direct fetch WITHOUT the failure fallbacks — callers that
+        must distinguish source-unreachable from source-empty use this
+        (get()/refresh() intentionally swallow into cache/{})."""
+        return self._fetch()
 
     # -- disk cache ------------------------------------------------------
     def _store_disk(self, data: dict) -> None:

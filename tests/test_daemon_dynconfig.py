@@ -1,0 +1,111 @@
+"""Manager-fed scheduler discovery on the daemon (reference client
+dynconfig manager source): the daemon bootstraps its scheduler set from
+ListSchedulers, follows membership changes on refresh, and falls back to
+the static list when the manager has nothing."""
+
+import pytest
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import manager_pb2
+
+from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+from dragonfly2_tpu.manager.database import Database
+from dragonfly2_tpu.manager.models_registry import ModelRegistry
+from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+from dragonfly2_tpu.manager.service import SERVICE_NAME as MANAGER_SERVICE
+from dragonfly2_tpu.manager.service import ManagerService
+from dragonfly2_tpu.rpc.glue import SchedulerSelector, serve
+from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import SERVICE_NAME as SCHED_SERVICE
+from dragonfly2_tpu.scheduler.service import SchedulerService
+
+
+def _scheduler_server():
+    service = SchedulerService(
+        res.Resource(), Scheduling(BaseEvaluator(), SchedulingConfig())
+    )
+    server, port = serve({SCHED_SERVICE: service})
+    return server, port
+
+
+def _register(db, hostname, ip, port, cluster=1):
+    import time
+
+    now = time.time()
+    db.execute(
+        "INSERT INTO schedulers (hostname, ip, port, state, scheduler_cluster_id,"
+        " last_keepalive, created_at, updated_at) VALUES (?, ?, ?, 'active', ?, ?, ?, ?)",
+        (hostname, ip, port, cluster, now, now, now),
+    )
+
+
+@pytest.fixture
+def manager(tmp_path):
+    db = Database(tmp_path / "m.db")
+    service = ManagerService(db, ModelRegistry(db, FSObjectStorage(tmp_path / "o")))
+    server, port = serve({MANAGER_SERVICE: service})
+    yield {"db": db, "addr": f"127.0.0.1:{port}"}
+    server.stop(grace=None)
+    db.close()
+
+
+def test_daemon_discovers_schedulers_from_manager(manager, tmp_path):
+    sched_server, sched_port = _scheduler_server()
+    _register(manager["db"], "s1", "127.0.0.1", sched_port)
+    d = Daemon(
+        DaemonConfig(
+            data_dir=str(tmp_path / "daemon"),
+            scheduler_address="",  # no static list — manager is the source
+            manager_address=manager["addr"],
+            hostname="dyn-host",
+            ip="127.0.0.1",
+            announce_interval=60.0,
+        )
+    )
+    d.start()
+    try:
+        assert d._selector.addresses == [f"127.0.0.1:{sched_port}"]
+        # membership change: a second scheduler registers; a refresh
+        # reconciles the ring
+        sched2, port2 = _scheduler_server()
+        _register(manager["db"], "s2", "127.0.0.2", port2)
+        d._dynconfig.refresh()
+        assert set(d._selector.addresses) == {
+            f"127.0.0.1:{sched_port}",
+            f"127.0.0.2:{port2}",
+        }
+        sched2.stop(grace=None)
+    finally:
+        d.stop()
+        sched_server.stop(grace=None)
+
+
+def test_daemon_requires_some_scheduler_source(manager, tmp_path):
+    """Manager with zero schedulers AND no static fallback must fail
+    loudly at startup, not run schedulerless."""
+    d = Daemon(
+        DaemonConfig(
+            data_dir=str(tmp_path / "daemon2"),
+            scheduler_address="",
+            manager_address=manager["addr"],
+            hostname="dyn-host2",
+            ip="127.0.0.1",
+        )
+    )
+    with pytest.raises(RuntimeError, match="no schedulers"):
+        d.start()
+    d.stop()
+
+
+def test_selector_update_addresses_reconciles():
+    sel = SchedulerSelector(["127.0.0.1:1", "127.0.0.1:2"])
+    sel.update_addresses(["127.0.0.1:2", "127.0.0.1:3"])
+    assert set(sel.addresses) == {"127.0.0.1:2", "127.0.0.1:3"}
+    # empty pushes are ignored — never strand the daemon schedulerless
+    sel.update_addresses([])
+    assert set(sel.addresses) == {"127.0.0.1:2", "127.0.0.1:3"}
+    # affinity only routes to live members
+    for key in ("t1", "t2", "t3", "t4"):
+        assert sel.addr_for_task(key) in sel.addresses
