@@ -157,13 +157,11 @@ class Daemon:
 
     # ------------------------------------------------------------------
     def _make_scheduler_dynconfig(self):
-        """Dynconfig engine polling the manager's searcher-scoped
-        scheduler list, with a disk cache fallback under data_dir
-        (reference internal/dynconfig manager source)."""
-        import manager_pb2  # noqa: E402 — flat proto import
-
+        """Searcher-scoped DaemonDynconfig over the manager, with a disk
+        cache fallback under data_dir (utils/dynconfig.DaemonDynconfig;
+        reference client/config/dynconfig_manager.go)."""
         from dragonfly2_tpu.manager.service import SERVICE_NAME as MANAGER_SERVICE
-        from dragonfly2_tpu.utils.dynconfig import Dynconfig
+        from dragonfly2_tpu.utils.dynconfig import DaemonDynconfig
 
         self._manager_channel = glue.dial(
             self.cfg.manager_address,
@@ -174,37 +172,15 @@ class Daemon:
                 self.cfg.manager_tls_client_key_file,
             ),
         )
-        client = glue.ServiceClient(self._manager_channel, MANAGER_SERVICE)
-
-        def fetch() -> dict:
-            resp = client.ListSchedulers(
-                manager_pb2.ListSchedulersRequest(
-                    hostname=self.cfg.hostname,
-                    ip=self.cfg.ip,
-                    idc=self.cfg.idc,
-                    location=self.cfg.location,
-                )
-            )
-            return {
-                "schedulers": [
-                    {"ip": s.ip, "port": s.port, "hostname": s.hostname}
-                    for s in resp.schedulers
-                ]
-            }
-
-        return Dynconfig(
-            fetch,
+        return DaemonDynconfig(
+            glue.ServiceClient(self._manager_channel, MANAGER_SERVICE),
             cache_path=Path(self.cfg.data_dir) / "dynconfig.json",
             refresh_interval=self.cfg.dynconfig_interval,
+            hostname=self.cfg.hostname,
+            ip=self.cfg.ip,
+            idc=self.cfg.idc,
+            location=self.cfg.location,
         )
-
-    @staticmethod
-    def _scheduler_addrs(data: dict) -> list[str]:
-        return [
-            f"{s['ip']}:{s['port']}"
-            for s in (data or {}).get("schedulers", [])
-            if s.get("ip") and s.get("port")
-        ]
 
     def start(self) -> None:
         self.upload.start()
@@ -215,7 +191,7 @@ class Daemon:
             # source of truth, refreshed on an interval; the static list
             # is the bootstrap/fallback (reference client dynconfig)
             self._dynconfig = self._make_scheduler_dynconfig()
-            fetched = self._scheduler_addrs(self._dynconfig.get())
+            fetched = self._dynconfig.scheduler_addresses()
             if fetched:
                 addresses = fetched
             elif not addresses:
@@ -245,7 +221,7 @@ class Daemon:
         if self._dynconfig is not None:
             self._dynconfig.register(
                 lambda data: self._selector.update_addresses(
-                    self._scheduler_addrs(data)
+                    self._dynconfig.addresses_of(data)
                 )
             )
             self._dynconfig.start()
@@ -361,6 +337,18 @@ class Daemon:
         self._spawn(self._announce_loop, "announcer")
         if self.cfg.probe_interval > 0:
             self._spawn(self._probe_loop, "prober")
+        if self.cfg.host_type == "super" and self._manager_channel is not None:
+            # seed peers are manager-visible infrastructure: register and
+            # keep alive so preheat targeting and the console's seed-peer
+            # view reflect them (reference seed-peer manager registration;
+            # normal daemons stay scheduler-only). Registration is
+            # best-effort here — the keepalive loop re-registers, so a
+            # transient manager outage never kills a booting daemon
+            try:
+                self._register_seed_peer()
+            except Exception as e:
+                logger.warning("initial seed-peer registration failed: %s", e)
+            self._spawn(self._seed_keepalive_loop, "seed-keepalive")
 
         self.gc.add(
             GCTask(
@@ -426,6 +414,37 @@ class Daemon:
             piece_length=self.cfg.piece_length,
             task_type=common_pb2.TASK_TYPE_DFSTORE,
         )
+
+    def _register_seed_peer(self) -> None:
+        import manager_pb2  # noqa: E402 — flat proto import
+
+        from dragonfly2_tpu.manager.service import SERVICE_NAME as MANAGER_SERVICE
+
+        client = glue.ServiceClient(self._manager_channel, MANAGER_SERVICE)
+        client.UpdateSeedPeer(
+            manager_pb2.UpdateSeedPeerRequest(
+                hostname=self.cfg.hostname,
+                ip=self.cfg.ip,
+                port=int(self.port),
+                download_port=int(self.upload.port),
+                type="super",
+                idc=self.cfg.idc,
+                location=self.cfg.location,
+                seed_peer_cluster_id=self.cfg.scheduler_cluster_id,
+            )
+        )
+        logger.info("registered as seed peer with manager")
+
+    def _seed_keepalive_loop(self) -> None:
+        # UpdateSeedPeer is an idempotent upsert stamping last_keepalive,
+        # so re-registering IS the keepalive — and it self-heals when the
+        # manager-side row vanished (DB recreated, operator delete),
+        # which a bare UPDATE-style keepalive would silently miss
+        while not self._stop.wait(self.cfg.announce_interval):
+            try:
+                self._register_seed_peer()
+            except Exception as e:
+                logger.warning("seed-peer keepalive failed: %s", e)
 
     def _spawn(self, fn, name: str) -> None:
         t = threading.Thread(target=fn, name=name, daemon=True)

@@ -398,6 +398,12 @@ class SchedulerSelector:
             if existing is not None:
                 channel.close()  # lost the race; reuse the cached one
                 return existing
+            if addr not in self.addresses:
+                # update_addresses removed this scheduler while we were
+                # dialing — caching now would leak a channel to a
+                # decommissioned member that nothing ever closes
+                channel.close()
+                raise ConnectionError(f"{addr} removed from the scheduler set")
             self._channels[addr] = channel
             client = self._clients[addr] = ServiceClient(channel, self.service)
             self._fail_until.pop(addr, None)

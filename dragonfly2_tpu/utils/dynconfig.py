@@ -206,19 +206,28 @@ class SchedulerDynconfig:
 class DaemonDynconfig:
     """Daemon-side view: polls the manager for the active scheduler list
     (reference client/config/dynconfig_manager.go) so daemons fail over
-    when schedulers come and go."""
+    when schedulers come and go. Location hints scope the list through
+    the manager's searcher (the joining daemon gets its best cluster)."""
 
     def __init__(
         self,
         manager_client,
         cache_path: str | Path | None = None,
         refresh_interval: float = DEFAULT_REFRESH_INTERVAL,
+        hostname: str = "",
+        ip: str = "",
+        idc: str = "",
+        location: str = "",
     ):
         from dragonfly2_tpu.rpc import gen  # noqa: F401
         import manager_pb2  # noqa: E402
 
         def fetch() -> dict:
-            resp = manager_client.ListSchedulers(manager_pb2.ListSchedulersRequest())
+            resp = manager_client.ListSchedulers(
+                manager_pb2.ListSchedulersRequest(
+                    hostname=hostname, ip=ip, idc=idc, location=location
+                )
+            )
             return {
                 "schedulers": [
                     {"hostname": s.hostname, "ip": s.ip, "port": s.port}
@@ -228,10 +237,22 @@ class DaemonDynconfig:
 
         self.engine = Dynconfig(fetch, cache_path, refresh_interval)
 
-    def scheduler_addresses(self) -> list[str]:
+    @staticmethod
+    def addresses_of(data: dict) -> list[str]:
+        """data dict → dialable addresses (rows missing ip/port dropped)."""
         return [
-            f"{s['ip']}:{s['port']}" for s in self.engine.get().get("schedulers", [])
+            f"{s['ip']}:{s['port']}"
+            for s in (data or {}).get("schedulers", [])
+            if s.get("ip") and s.get("port")
         ]
+
+    def scheduler_addresses(self) -> list[str]:
+        return self.addresses_of(self.engine.get())
+
+    def fetch_once(self) -> dict:
+        """Direct fetch without fallbacks (distinguishes unreachable from
+        empty — see Dynconfig.fetch_once)."""
+        return self.engine.fetch_once()
 
     def register(self, observer: Callable[[dict], None]) -> None:
         self.engine.register(observer)

@@ -71,7 +71,7 @@ def test_daemon_discovers_schedulers_from_manager(manager, tmp_path):
         # reconciles the ring
         sched2, port2 = _scheduler_server()
         _register(manager["db"], "s2", "127.0.0.2", port2)
-        d._dynconfig.refresh()
+        d._dynconfig.engine.refresh()
         assert set(d._selector.addresses) == {
             f"127.0.0.1:{sched_port}",
             f"127.0.0.2:{port2}",
@@ -109,3 +109,43 @@ def test_selector_update_addresses_reconciles():
     # affinity only routes to live members
     for key in ("t1", "t2", "t3", "t4"):
         assert sel.addr_for_task(key) in sel.addresses
+
+
+def test_seed_peer_registers_with_manager(manager, tmp_path):
+    """A super (seed) daemon with a manager configured registers itself
+    via UpdateSeedPeer — preheat targeting and the console's seed-peer
+    view see it; a normal daemon does not register."""
+    sched_server, sched_port = _scheduler_server()
+    _register(manager["db"], "s1", "127.0.0.1", sched_port)
+    seed = Daemon(
+        DaemonConfig(
+            data_dir=str(tmp_path / "seed"),
+            scheduler_address="",
+            manager_address=manager["addr"],
+            hostname="seed-host",
+            ip="127.0.0.1",
+            host_type="super",
+            announce_interval=60.0,
+        )
+    )
+    normal = Daemon(
+        DaemonConfig(
+            data_dir=str(tmp_path / "normal"),
+            scheduler_address="",
+            manager_address=manager["addr"],
+            hostname="normal-host",
+            ip="127.0.0.1",
+            announce_interval=60.0,
+        )
+    )
+    seed.start()
+    normal.start()
+    try:
+        rows = manager["db"].query("SELECT hostname, type, state FROM seed_peers")
+        assert [(r["hostname"], r["type"], r["state"]) for r in rows] == [
+            ("seed-host", "super", "active")
+        ]
+    finally:
+        seed.stop()
+        normal.stop()
+        sched_server.stop(grace=None)
