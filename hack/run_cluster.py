@@ -155,8 +155,6 @@ def main() -> int:
                 "--set",
                 f"data_dir={work}/daemon-{name}",
                 "--set",
-                f"scheduler_address={scheduler_addr}",
-                "--set",
                 f"hostname=host-{name}",
                 "--set",
                 "piece_length=65536",
@@ -164,9 +162,21 @@ def main() -> int:
                 "schedule_timeout=10.0",
             ]
             if name == "a":
-                # daemon A also serves its gRPC on a unix socket — the
-                # local-CLI path dfget drives below
-                args += ["--set", f"unix_socket={sock_a}"]
+                # daemon A: static scheduler list + unix socket (the
+                # local-CLI path dfget drives below)
+                args += [
+                    "--set", f"scheduler_address={scheduler_addr}",
+                    "--set", f"unix_socket={sock_a}",
+                ]
+            else:
+                # daemon B: no static list — scheduler set discovered
+                # from the manager (dynconfig), and it registers itself
+                # as a seed peer
+                args += [
+                    "--set", 'scheduler_address=""',
+                    "--set", f"manager_address={manager_addr}",
+                    "--set", "host_type=super",
+                ]
             d = Proc(f"daemon-{name}", args, env)
             procs.append(d)
             daemons.append(d)
@@ -307,6 +317,12 @@ def main() -> int:
         rows = call("GET", "/api/v1/schedulers", token=pat["token"])
         assert any(r["hostname"] == "sched-e2e" for r in rows), rows
         print("PASS console + users/PAT auth over REST")
+
+        # daemon B discovered its scheduler from the manager AND
+        # registered itself as a seed peer (visible over REST)
+        rows = call("GET", "/api/v1/seed-peers", token=pat["token"])
+        assert any(r["hostname"] == "host-b" for r in rows), rows
+        print("PASS manager-fed discovery + seed-peer registration")
 
         print("CLUSTER E2E: ALL PASS")
         return 0
