@@ -77,7 +77,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--tag", default="")
     p.add_argument("--application", default="")
     p.add_argument("--local-only", action="store_true")
+    # spawn-or-reuse, same as dfget (reference dfcache also spawns the
+    # daemon over the unix socket when none answers)
+    from dragonfly2_tpu.client.dfget import add_spawn_daemon_args
+
+    add_spawn_daemon_args(p)
     args = p.parse_args(argv)
+
+    if args.spawn_daemon:
+        from dragonfly2_tpu.client.dfget import ensure_daemon
+
+        ensure_daemon(args.daemon, args.scheduler, args.daemon_data_dir)
 
     if args.command == "stat":
         ok = stat(args.daemon, args.url, args.tag, args.application)

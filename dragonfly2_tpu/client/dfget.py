@@ -74,6 +74,22 @@ def ensure_daemon(
     raise TimeoutError(f"spawned daemon not ready on {daemon_address} within {wait}s")
 
 
+def add_spawn_daemon_args(parser) -> None:
+    """The spawn-or-reuse CLI trio shared by dfget/dfcache (reference:
+    both CLIs spawn the daemon over the unix socket when none answers)."""
+    parser.add_argument("--spawn-daemon", action="store_true")
+    parser.add_argument(
+        "--scheduler",
+        default=os.environ.get("DF_SCHEDULER_ADDR", "127.0.0.1:8002"),
+        help="scheduler address(es) a spawned daemon announces to",
+    )
+    parser.add_argument(
+        "--daemon-data-dir",
+        default=os.path.expanduser("~/.dragonfly2/daemon"),
+        help="data dir a spawned daemon uses",
+    )
+
+
 def download(
     daemon_address: str,
     url: str,
@@ -143,17 +159,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--recursive", action="store_true")
     # spawn-or-reuse: start a local daemon on --daemon when none answers
     # (reference dfget root.go:279 checkAndSpawnDaemon)
-    p.add_argument("--spawn-daemon", action="store_true")
-    p.add_argument(
-        "--scheduler",
-        default=os.environ.get("DF_SCHEDULER_ADDR", "127.0.0.1:8002"),
-        help="scheduler address(es) a spawned daemon announces to",
-    )
-    p.add_argument(
-        "--daemon-data-dir",
-        default=os.path.expanduser("~/.dragonfly2/daemon"),
-        help="data dir a spawned daemon uses",
-    )
+    add_spawn_daemon_args(p)
     args = p.parse_args(argv)
 
     if args.spawn_daemon:

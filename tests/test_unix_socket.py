@@ -95,3 +95,27 @@ def test_ensure_daemon_spawns_and_reuses(sched, tmp_path):
         subprocess.run(
             ["pkill", "-f", str(sock)], check=False
         )
+
+
+def test_dfcache_spawn_daemon(sched, tmp_path):
+    """dfcache shares dfget's spawn-or-reuse: import a blob through a
+    daemon it spawned itself on the unix socket, then stat it."""
+    from dragonfly2_tpu.client import dfcache
+
+    sock = tmp_path / "cache" / "dfd.sock"
+    addr = f"unix:{sock}"
+    blob = tmp_path / "blob.bin"
+    blob.write_bytes(PAYLOAD)
+    try:
+        rc = dfcache.main([
+            "import", "d7y://cache-blob", "--path", str(blob),
+            "--daemon", addr, "--spawn-daemon", "--scheduler", sched,
+            "--daemon-data-dir", str(tmp_path / "spawned"),
+        ])
+        assert rc == 0
+        rc = dfcache.main(["stat", "d7y://cache-blob", "--daemon", addr])
+        assert rc == 0  # cached
+    finally:
+        import subprocess
+
+        subprocess.run(["pkill", "-f", str(sock)], check=False)
