@@ -80,6 +80,14 @@ SERVICES: dict[str, dict[str, Method]] = {
         "LeaveHost": Method(
             UNARY, scheduler_v1_pb2.LeaveHostRequest, scheduler_v1_pb2.Empty
         ),
+        "AnnounceHost": Method(
+            UNARY, scheduler_v1_pb2.AnnounceHostRequest, scheduler_v1_pb2.Empty
+        ),
+        "SyncProbes": Method(
+            STREAM_STREAM,
+            scheduler_v1_pb2.SyncProbesRequest,
+            scheduler_v1_pb2.SyncProbesResponse,
+        ),
     },
     TRAINER_SERVICE: {
         "Train": Method(STREAM_UNARY, trainer_pb2.TrainRequest, trainer_pb2.TrainResponse),

@@ -187,7 +187,10 @@ class SchedulerServer:
         from dragonfly2_tpu.scheduler.service_v1 import SchedulerServiceV1
 
         self.service_v1 = SchedulerServiceV1(
-            self.resource, self.scheduling, storage=self.storage
+            self.resource,
+            self.scheduling,
+            storage=self.storage,
+            networktopology=self.networktopology,
         )
 
         self.announcer = Announcer(

@@ -123,10 +123,12 @@ class SchedulerServiceV1:
         resource: res.Resource,
         scheduling: Scheduling,
         storage: Storage | None = None,
+        networktopology=None,
     ):
         self.resource = resource
         self.scheduling = scheduling
         self.storage = storage
+        self.networktopology = networktopology
 
     # ------------------------------------------------------------------
     # RegisterPeerTask (unary, size-scope dispatch)
@@ -450,4 +452,28 @@ class SchedulerServiceV1:
         if host is not None:
             host.leave_peers()
             self.resource.host_manager.delete(request.host_id)
+        if self.networktopology is not None:
+            self.networktopology.delete_host(request.host_id)
         return v1.Empty()
+
+    # v1 AnnounceHost/SyncProbes delegate to the v2 service's handlers —
+    # identical message shapes, one domain layer (reference binds both
+    # generations over shared resource/networktopology state). Results
+    # are RE-WRAPPED into v1 types: glue registers this service with the
+    # v1 serializers, and returning v2 instances would only work while
+    # the shapes coincide byte-for-byte — a later v2-only field would
+    # silently leak undeclared bytes to v1 clients instead of failing
+    # loudly here
+    def AnnounceHost(self, request, context):
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+
+        SchedulerService.AnnounceHost(self, request, context)
+        return v1.Empty()
+
+    def SyncProbes(self, request_iterator, context):
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+
+        for resp in SchedulerService.SyncProbes(self, request_iterator, context):
+            yield v1.SyncProbesResponse(
+                hosts=[v1.ProbeHost(host=h.host) for h in resp.hosts]
+            )

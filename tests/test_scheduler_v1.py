@@ -424,3 +424,61 @@ def test_out_of_range_code_still_writes_record(cluster):
     cluster["storage"].flush()
     (rec,) = cluster["storage"].list_download()
     assert rec.error.code == "99"
+
+
+def test_v1_announce_host_and_sync_probes(tmp_path):
+    """The v1 surface also carries AnnounceHost and SyncProbes (reference
+    service_v1.go:478-778) — delegated onto the shared domain layer."""
+    from dragonfly2_tpu.scheduler.networktopology import NetworkTopology
+    from dragonfly2_tpu.scheduler.service_v1 import SchedulerServiceV1
+    from dragonfly2_tpu.utils.kvstore import KVStore
+
+    resource = res.Resource()
+    nt = NetworkTopology(KVStore(), resource.host_manager, None)
+    svc = SchedulerServiceV1(
+        resource,
+        Scheduling(BaseEvaluator(), SchedulingConfig()),
+        networktopology=nt,
+    )
+    server, port = serve({SCHEDULER_V1_SERVICE: svc}, "127.0.0.1:0")
+    channel = dial(f"127.0.0.1:{port}")
+    client = ServiceClient(channel, SCHEDULER_V1_SERVICE)
+    try:
+        for i in (1, 2, 3):
+            client.AnnounceHost(
+                v1.AnnounceHostRequest(
+                    host=common_pb2.HostInfo(
+                        id=f"probe-host-{i}", hostname=f"h{i}", ip=f"10.1.0.{i}", port=1
+                    )
+                )
+            )
+        assert resource.host_manager.load("probe-host-1") is not None
+
+        stream = StreamDriver(client.SyncProbes)
+        stream.send(
+            v1.SyncProbesRequest(
+                host=common_pb2.HostInfo(id="probe-host-1"),
+                probe_started=v1.ProbeStartedRequest(),
+            )
+        )
+        resp = stream.recv()
+        targets = {h.host.id for h in resp.hosts}
+        assert targets and targets <= {"probe-host-2", "probe-host-3"}
+        stream.send(
+            v1.SyncProbesRequest(
+                host=common_pb2.HostInfo(id="probe-host-1"),
+                probe_finished=v1.ProbeFinishedRequest(
+                    probes=[v1.ProbeResult(host_id="probe-host-2", rtt_ns=7_000_000)]
+                ),
+            )
+        )
+        stream.close()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if nt.average_rtt("probe-host-1", "probe-host-2") == 7_000_000:
+                break
+            time.sleep(0.02)
+        assert nt.average_rtt("probe-host-1", "probe-host-2") == 7_000_000
+    finally:
+        channel.close()
+        server.stop(grace=None)
