@@ -6,7 +6,7 @@ object-storage HTTP gateway, so reads ride the P2P swarm and writes can
 seed the writing daemon (reference objectstorage gateway replication).
 
 SDK functions take the gateway address ("host:port"); the CLI maps
-  dfstore cp <src> <dst>    (local → df://bucket/key or df://… → local)
+  dfstore cp <src> <dst>    (local ↔ df://bucket/key, or df://… → df://… object copy)
   dfstore stat df://bucket/key
   dfstore rm df://bucket/key
   dfstore ls df://bucket[/prefix]
@@ -78,6 +78,23 @@ def head_object(gateway: str, bucket: str, key: str) -> int | None:
         raise
 
 
+def copy_object(
+    gateway: str,
+    bucket: str,
+    key: str,
+    dst_bucket: str,
+    dst_key: str,
+    seed_local: bool = True,
+) -> None:
+    """Object→object copy through the gateway (reference dfstore
+    CopyObject) — composed client-side as get+put; the destination write
+    rides the normal seed-on-write path unless ``seed_local`` is off."""
+    put_object(
+        gateway, dst_bucket, dst_key, get_object(gateway, bucket, key),
+        seed_local=seed_local,
+    )
+
+
 def delete_object(gateway: str, bucket: str, key: str) -> None:
     _request("DELETE", _url(gateway, bucket, key)).close()
 
@@ -127,7 +144,13 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
     try:
         if args.cmd == "cp":
-            if args.src.startswith("df://"):
+            if args.src.startswith("df://") and args.dst.startswith("df://"):
+                sb, sk = _parse_df(args.src)
+                db_, dk = _parse_df(args.dst)
+                copy_object(
+                    args.endpoint, sb, sk, db_, dk, seed_local=not args.no_seed
+                )
+            elif args.src.startswith("df://"):
                 bucket, key = _parse_df(args.src)
                 data = get_object(args.endpoint, bucket, key)
                 with open(args.dst, "wb") as f:

@@ -127,3 +127,22 @@ def test_overwrite_serves_fresh_bytes(store_cluster):
     dfstore.put_object(_gw(da), "cfg", "app.conf", b"version-2-longer")
     assert dfstore.get_object(_gw(db), "cfg", "app.conf") == b"version-2-longer"
     assert dfstore.get_object(_gw(da), "cfg", "app.conf") == b"version-2-longer"
+
+
+def test_copy_object_between_keys(store_cluster):
+    """df://→df:// copy (reference dfstore CopyObject): composed through
+    the gateway, destination readable and seeded like any PUT."""
+    from dragonfly2_tpu.client import dfstore
+
+    da = store_cluster["daemons"][0]
+    addr = f"127.0.0.1:{da.object_gateway.port}"
+    dfstore.create_bucket(addr, "cpb")
+    dfstore.put_object(addr, "cpb", "src/a.bin", b"copy-me")
+    dfstore.copy_object(addr, "cpb", "src/a.bin", "cpb", "dst/b.bin")
+    assert dfstore.get_object(addr, "cpb", "dst/b.bin") == b"copy-me"
+    # CLI form
+    rc = dfstore.main([
+        "--endpoint", addr, "cp", "df://cpb/dst/b.bin", "df://cpb/dst/c.bin"
+    ])
+    assert rc == 0
+    assert dfstore.get_object(addr, "cpb", "dst/c.bin") == b"copy-me"
