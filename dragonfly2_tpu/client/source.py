@@ -5,9 +5,9 @@ content length, range support, download, metadata, recursive list) with
 clients under pkg/source/clients/{httpprotocol,...}. Scheme → client
 registry mirrors pkg/source's loader; plugins register at import time.
 
-http(s) and file are implemented here; s3 (SigV4), oss, and hdfs
-(WebHDFS) live in source_cloud.py — real REST clients, no SDKs; oras
-registers as an explicit unavailable stub.
+http(s) and file are implemented here; s3 (SigV4), oss, hdfs
+(WebHDFS), and oras (OCI registry artifacts) live in source_cloud.py —
+real REST clients, no SDKs.
 """
 
 from __future__ import annotations
@@ -211,28 +211,6 @@ class FileSourceClient(SourceClient):
         return out
 
 
-class UnavailableSourceClient(SourceClient):
-    """Registered for protocols whose SDKs aren't in this image — gives a
-    clear error at use (gating policy, not silent fallthrough)."""
-
-    def __init__(self, scheme: str):
-        self.scheme = scheme
-
-    def _fail(self):
-        raise SourceError(
-            f"{self.scheme} origin client is not available in this build"
-        )
-
-    def metadata(self, url: str, headers: dict | None = None) -> Metadata:
-        self._fail()
-
-    def download(self, url, headers=None, offset=0, length=-1):
-        self._fail()
-
-    def list(self, url, headers=None):
-        self._fail()
-
-
 _REGISTRY: dict[str, SourceClient] = {}
 
 
@@ -258,7 +236,12 @@ register_client("file", FileSourceClient())
 # cloud clients register lazily on first use — importing source_cloud
 # here would re-enter it while partially initialized when a caller
 # imports source_cloud first (it imports this module for the base types)
-_LAZY_CLOUD = {"s3": "S3SourceClient", "oss": "OSSSourceClient", "hdfs": "HDFSSourceClient"}
+_LAZY_CLOUD = {
+    "s3": "S3SourceClient",
+    "oss": "OSSSourceClient",
+    "hdfs": "HDFSSourceClient",
+    "oras": "ORASSourceClient",
+}
 
 
 def _load_cloud(scheme: str) -> SourceClient:
@@ -267,6 +250,3 @@ def _load_cloud(scheme: str) -> SourceClient:
     client = getattr(sc, _LAZY_CLOUD[scheme])()
     register_client(scheme, client)
     return client
-
-
-register_client("oras", UnavailableSourceClient("oras"))

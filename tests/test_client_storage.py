@@ -117,10 +117,10 @@ def test_file_source_list(tmp_path):
 
 
 def test_unavailable_scheme_raises():
-    # s3/oss/hdfs are real clients now (source_cloud.py); oras remains a
-    # declared-unavailable stub
-    with pytest.raises(source.SourceError, match="not available"):
-        source.client_for("oras://registry/repo").metadata("oras://registry/repo")
+    # every declared protocol has a real client now; unknown schemes
+    # still fail loudly rather than silently falling through
+    with pytest.raises(source.SourceError, match="no source client"):
+        source.client_for("ftp://host/x").metadata("ftp://host/x")
 
 
 def test_http_source_roundtrip(tmp_path):

@@ -222,6 +222,127 @@ def test_hdfs_webhdfs_roundtrip():
         httpd.server_close()
 
 
+@pytest.fixture
+def fake_registry(monkeypatch):
+    """OCI registry fake: bearer token service, manifest endpoint, blob
+    endpoint with Range — the surface the oras client speaks
+    (reference pkg/source/clients/orasprotocol)."""
+    blob = os.urandom(48 * 1024)
+    digest = "sha256:" + hashlib.sha256(blob).hexdigest()
+    manifest = json.dumps(
+        {
+            "schemaVersion": 2,
+            "mediaType": "application/vnd.oci.image.manifest.v1+json",
+            "layers": [
+                {
+                    "mediaType": "application/vnd.oci.image.layer.v1.tar",
+                    "digest": digest,
+                    "size": len(blob),
+                }
+            ],
+        }
+    ).encode()
+    seen = {"token_auth": None, "blob_auth": None}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_HEAD(self):
+            parts = urllib.parse.urlsplit(self.path)
+            if parts.path == f"/v2/org/artifact/blobs/{digest}":
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+            else:
+                self.send_error(404)
+
+        def do_GET(self):
+            parts = urllib.parse.urlsplit(self.path)
+            if parts.path == "/service/token":
+                seen["token_auth"] = self.headers.get("Authorization")
+                body = json.dumps({"token": "tok-123"}).encode()
+                self.send_response(200)
+            elif parts.path == "/v2/org/artifact/manifests/v1":
+                if self.headers.get("Authorization") != "Bearer tok-123":
+                    self.send_error(401)
+                    return
+                body = manifest
+                self.send_response(200)
+            elif parts.path == f"/v2/org/artifact/blobs/{digest}":
+                seen["blob_auth"] = self.headers.get("Authorization")
+                if self.headers.get("Authorization") != "Bearer tok-123":
+                    self.send_error(401)
+                    return
+                rng = self.headers.get("Range")
+                body = blob
+                if rng:
+                    lo, _, hi = rng.removeprefix("bytes=").partition("-")
+                    body = blob[int(lo) : (int(hi) + 1) if hi else len(blob)]
+                    self.send_response(206)
+                else:
+                    self.send_response(200)
+            else:
+                self.send_error(404)
+                return
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    monkeypatch.setenv("DF_ORAS_ENDPOINT", f"http://127.0.0.1:{httpd.server_port}")
+    yield {"blob": blob, "digest": digest, "seen": seen}
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_oras_metadata_and_download(fake_registry):
+    url = "oras://registry.example/org/artifact:v1"
+    client = source.client_for(url)
+    meta = client.metadata(url)
+    assert meta.content_length == len(fake_registry["blob"])
+    assert meta.etag == fake_registry["digest"]
+    got = b"".join(client.download(url))
+    assert got == fake_registry["blob"]
+    part = b"".join(client.download(url, offset=64, length=128))
+    assert part == fake_registry["blob"][64:192]
+
+
+def test_oras_metadata_digest_query_uses_head(fake_registry):
+    """With the digest supplied, size discovery is a blob HEAD — no
+    manifest fetch, no body transfer."""
+    url = f"oras://registry.example/org/artifact:v1?digest={fake_registry['digest']}"
+    meta = source.client_for(url).metadata(url)
+    assert meta.content_length == len(fake_registry["blob"])
+
+
+def test_oras_basic_auth_forwarded_to_token_service(fake_registry):
+    url = "oras://registry.example/org/artifact:v1"
+    creds = "Basic " + base64.b64encode(b"user:pass").decode()
+    b"".join(source.client_for(url).download(url, headers={"Authorization": creds}))
+    assert fake_registry["seen"]["token_auth"] == creds
+
+
+def test_oras_digest_token_fast_path(fake_registry):
+    """digest query + token header → no token-service or manifest hops
+    (the reference's goto-fetch shortcut)."""
+    url = f"oras://registry.example/org/artifact:v1?digest={fake_registry['digest']}"
+    got = b"".join(
+        source.client_for(url).download(
+            url, headers={"X-Dragonfly-Oras-Token": "tok-123"}
+        )
+    )
+    assert got == fake_registry["blob"]
+    assert fake_registry["seen"]["token_auth"] is None  # token service never hit
+
+
+def test_oras_malformed_urls():
+    client = source.client_for("oras://h/r:t")
+    with pytest.raises(SourceError, match="tag"):
+        client.metadata("oras://host/repo-no-tag")
+
+
 def test_dfget_back_to_source_via_fake_s3(fake_s3, tmp_path):
     """Full path: dfget → daemon → back-to-source s3 origin."""
     from dragonfly2_tpu.client import dfget
