@@ -155,6 +155,91 @@ class ServiceClient:
             setattr(self, name, callable_)
 
 
+# Per-RPC server observability (reference: every server wires
+# grpc-prometheus + otelgrpc interceptors, pkg/rpc/interceptor.go).
+# Counters/latency land in the shared default_registry so each service
+# process's /metrics endpoint exposes them alongside its own series.
+def _rpc_metrics():
+    global _RPC_HANDLED, _RPC_LATENCY
+    if _RPC_HANDLED is None:
+        from dragonfly2_tpu.utils.metrics import default_registry as r
+
+        _RPC_HANDLED = r.counter(
+            "rpc_server_handled_total",
+            "RPCs completed on the server, by outcome code",
+            ("service", "method", "code"),
+        )
+        _RPC_LATENCY = r.histogram(
+            "rpc_server_handling_seconds",
+            "Server-side RPC handling latency (streams: until exhausted)",
+            ("service", "method"),
+        )
+    return _RPC_HANDLED, _RPC_LATENCY
+
+
+_RPC_HANDLED = None
+_RPC_LATENCY = None
+
+
+def _instrument(service: str, name: str, kind: str, fn: Callable) -> Callable:
+    """Wrap a handler behavior with counters + latency + a trace span.
+    Response-streaming methods are timed to iterator exhaustion — the
+    handler returns a generator, so wrapping the call alone would record
+    only argument binding."""
+    from dragonfly2_tpu.utils import tracing
+
+    handled, latency = _rpc_metrics()
+    short = service.rsplit(".", 1)[-1]
+    streaming_out = kind in (UNARY_STREAM, STREAM_STREAM)
+
+    def wrapped(request_or_iterator, context):
+        tracer = tracing.get(short)
+        span = tracer.start_span(f"rpc.{name}")
+        t0 = time.perf_counter()
+
+        def finish(code: str) -> None:
+            latency.labels(service, name).observe(time.perf_counter() - t0)
+            handled.labels(service, name, code).inc()
+            span.end(status="ok" if code == "OK" else "error")
+
+        if not streaming_out:
+            try:
+                resp = fn(request_or_iterator, context)
+            except Exception:
+                finish(_code_of(context))
+                raise
+            finish("OK")
+            return resp
+
+        def stream():
+            # finally so abandonment is recorded too: a peer cancelling
+            # mid-stream closes this generator (GeneratorExit, which
+            # `except Exception` would miss) — exactly the broken-stream
+            # case the series exists to surface
+            code = "OK"
+            try:
+                yield from fn(request_or_iterator, context)
+            except GeneratorExit:
+                code = "CANCELLED"
+                raise
+            except Exception:
+                code = _code_of(context)
+                raise
+            finally:
+                finish(code)
+
+        return stream()
+
+    return wrapped
+
+
+def _code_of(context) -> str:
+    code = context.code()
+    if code is None:
+        return "UNKNOWN"
+    return code.name if hasattr(code, "name") else str(code)
+
+
 def make_handler(service: str, implementation: Any) -> grpc.GenericRpcHandler:
     """Bind an implementation object's methods as a generic service
     handler. Implementation methods receive (request_or_iterator, context)
@@ -162,7 +247,7 @@ def make_handler(service: str, implementation: Any) -> grpc.GenericRpcHandler:
     methods = SERVICES[service]
     handlers: dict[str, grpc.RpcMethodHandler] = {}
     for name, m in methods.items():
-        fn = getattr(implementation, name)
+        fn = _instrument(service, name, m.kind, getattr(implementation, name))
         factory = {
             UNARY: grpc.unary_unary_rpc_method_handler,
             UNARY_STREAM: grpc.unary_stream_rpc_method_handler,

@@ -43,6 +43,7 @@ class StreamStats:
     steps: int = 0
     eval_pairs: int = 0
     wall_s: float = 0.0
+    truncated: bool = False  # stopped early by a time budget
     losses: list = field(default_factory=list)
     metrics: dict = field(default_factory=dict)  # mse/mae on the holdout
 
@@ -174,6 +175,25 @@ def stream_shards(
 _step_cache: dict = {}
 
 
+def _optimizer_and_loss(learning_rate: float, weight_decay: float, warmup_steps: int):
+    """Shared by the single-step and k-step factories — the scan path's
+    'identical math' guarantee rests on there being exactly one
+    definition of the schedule, optimizer, and loss."""
+    import jax.numpy as jnp
+    import optax
+
+    from dragonfly2_tpu.models import mlp as mlp_mod
+
+    schedule = optax.linear_schedule(0.0, learning_rate, max(warmup_steps, 1))
+    optimizer = optax.adamw(schedule, weight_decay=weight_decay)
+
+    def loss_fn(p, xb, yb):
+        pred = mlp_mod.score_parents(p, xb)
+        return jnp.mean((pred - yb) ** 2)
+
+    return optimizer, loss_fn
+
+
 def _get_step(learning_rate: float, weight_decay: float, warmup_steps: int = 64):
     """(optimizer, jitted step) cached per optimizer config, so repeated
     fits (and bench warmup vs timed run) reuse one compiled executable
@@ -188,16 +208,9 @@ def _get_step(learning_rate: float, weight_decay: float, warmup_steps: int = 64)
         return _step_cache[key]
     import jax
     import jax.numpy as jnp
+
+    optimizer, loss_fn = _optimizer_and_loss(learning_rate, weight_decay, warmup_steps)
     import optax
-
-    from dragonfly2_tpu.models import mlp as mlp_mod
-
-    schedule = optax.linear_schedule(0.0, learning_rate, max(warmup_steps, 1))
-    optimizer = optax.adamw(schedule, weight_decay=weight_decay)
-
-    def loss_fn(p, xb, yb):
-        pred = mlp_mod.score_parents(p, xb)
-        return jnp.mean((pred - yb) ** 2)
 
     @jax.jit
     def step(params, opt_state, xy):
@@ -216,6 +229,43 @@ def _get_step(learning_rate: float, weight_decay: float, warmup_steps: int = 64)
     return optimizer, step
 
 
+def _get_scan_step(
+    learning_rate: float, weight_decay: float, k: int, warmup_steps: int = 64
+):
+    """(optimizer, jitted k-step call): one device dispatch runs ``k``
+    sequential optimizer steps via ``lax.scan`` over a [k, B, F+1]
+    superbatch. Amortizes per-dispatch overhead (host→device RPC,
+    transfer setup, executable launch) over k steps — the lever that
+    matters when the device link has per-call latency (remote chips,
+    small batches). Identical math to k calls of the single step."""
+    key = (learning_rate, weight_decay, warmup_steps, "scan", k)
+    if key in _step_cache:
+        return _step_cache[key]
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import lax
+
+    optimizer, loss_fn = _optimizer_and_loss(learning_rate, weight_decay, warmup_steps)
+
+    @jax.jit
+    def scan_step(params, opt_state, xy):
+        xy = xy.astype(jnp.float32)
+
+        def body(carry, slab):
+            params, opt_state = carry
+            xb, yb = slab[:, :MLP_FEATURE_DIM], slab[:, MLP_FEATURE_DIM]
+            loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        (params, opt_state), losses = lax.scan(body, (params, opt_state), xy)
+        return params, opt_state, losses[-1]
+
+    _step_cache[key] = (optimizer, scan_step)
+    return optimizer, scan_step
+
+
 def stream_train_mlp(
     paths,
     passes: int = 1,
@@ -232,6 +282,8 @@ def stream_train_mlp(
     params=None,
     mesh=None,
     transfer_dtype=np.float16,
+    time_budget_s: float | None = None,
+    steps_per_call: int = 1,
 ) -> tuple[object, StreamStats]:
     """Fit the MLP parent scorer directly off disk bytes. Returns
     (params, StreamStats with holdout mse/mae in .metrics).
@@ -252,6 +304,19 @@ def stream_train_mlp(
     float16): features are ratios/log-scales ≤ ~8, so halving H2D bytes
     costs ~5e-4 relative precision — upcast on device, where bf16 is the
     compute dtype anyway. Pass np.float32 for bit-exact feeds.
+
+    ``time_budget_s`` bounds the wall clock: the stream stops consuming
+    at the first shard boundary past the budget (``stats.truncated``
+    set). The fit over what WAS consumed stays real — rates computed
+    from ``stats.download_records`` remain honest. Benchmarks and
+    interval-scheduled training rounds use this so a slow device link
+    degrades to a shorter measurement, never an unbounded run.
+
+    ``steps_per_call`` > 1 packs k minibatches into one [k, B, F+1]
+    superbatch and runs k optimizer steps per device dispatch
+    (``lax.scan`` device-side) — same math, 1/k the per-call overhead.
+    Up to k·B trailing pairs are dropped at stream end (vs B with k=1),
+    so keep k modest relative to the dataset.
     """
     import jax
     import jax.numpy as jnp
@@ -259,6 +324,11 @@ def stream_train_mlp(
     from dragonfly2_tpu.models import mlp as mlp_mod
 
     optimizer, step = _get_step(learning_rate, weight_decay)
+    k = max(1, int(steps_per_call))
+    if k > 1:
+        # same optimizer config (pytree-compatible opt_state); the scan
+        # variant only changes how many steps one dispatch covers
+        optimizer, scan_step = _get_scan_step(learning_rate, weight_decay, k)
     warm_bias = params is None  # fresh model: warm-start the output bias
     if params is None:
         params = mlp_mod.init_mlp(
@@ -273,7 +343,11 @@ def stream_train_mlp(
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        xy_sharding = NamedSharding(mesh, P("dp", None))
+        # rows shard over dp; the superbatch's leading scan axis (k>1)
+        # stays unsharded — each scan step is one dp-parallel batch
+        xy_sharding = NamedSharding(
+            mesh, P("dp", None) if k == 1 else P(None, "dp", None)
+        )
 
         def put(buf):
             return jax.device_put(buf, xy_sharding)
@@ -290,7 +364,11 @@ def stream_train_mlp(
     # next batch, so each buffer is only reused after the step that read
     # it has materialized its loss (a real TPU always copies on H2D, but
     # correctness can't depend on the backend's copy behavior).
-    bufs = [np.empty((batch_size, MLP_FEATURE_DIM + 1), transfer_dtype) for _ in range(2)]
+    rows_per_call = batch_size * k
+    bufs = [
+        np.empty((rows_per_call, MLP_FEATURE_DIM + 1), transfer_dtype)
+        for _ in range(2)
+    ]
     tokens: list = [None, None]  # per-buffer in-flight step output
     cur = 0
     buf = bufs[cur]
@@ -306,6 +384,7 @@ def stream_train_mlp(
     # the packing loop below — the consumer thread is the bottleneck on
     # small hosts
     half = transfer_dtype == np.float16
+    budget_end = None if time_budget_s is None else t0 + time_budget_s
     for feats, labels, rows in stream_shards(
         paths,
         passes=passes,
@@ -315,6 +394,9 @@ def stream_train_mlp(
         workers=workers,
         half=half,
     ):
+        if budget_end is not None and time.perf_counter() > budget_end:
+            stats.truncated = True
+            break  # generator abandonment releases the producers
         stats.download_records = rows
         stats.pairs += feats.shape[0]
         if warm_bias and labels.size:
@@ -355,17 +437,24 @@ def stream_train_mlp(
                 labels = labels[~emask]
         off = 0
         while off < feats.shape[0]:
-            take = min(batch_size - fill, feats.shape[0] - off)
+            take = min(rows_per_call - fill, feats.shape[0] - off)
             buf[fill : fill + take, :MLP_FEATURE_DIM] = feats[off : off + take]
             buf[fill : fill + take, MLP_FEATURE_DIM] = labels[off : off + take]
             fill += take
             off += take
-            if fill == batch_size:
+            if fill == rows_per_call:
                 # async dispatch: the host returns to decoding while the
-                # chip trains this batch
-                params, opt_state, pending_loss = step(params, opt_state, put(buf))
+                # chip trains this batch (k>1: k sequential steps in one
+                # call over the scan-major superbatch view)
+                arg = buf if k == 1 else buf.reshape(k, batch_size, -1)
+                if k == 1:
+                    params, opt_state, pending_loss = step(params, opt_state, put(arg))
+                else:
+                    params, opt_state, pending_loss = scan_step(
+                        params, opt_state, put(arg)
+                    )
                 tokens[cur] = pending_loss
-                stats.steps += 1
+                stats.steps += k
                 cur ^= 1
                 buf = bufs[cur]
                 if tokens[cur] is not None:

@@ -69,6 +69,11 @@ class TrainingConfig:
     streaming_threshold_bytes: int = 64 * 1024 * 1024
     streaming_passes: int = 2
     streaming_workers: int = 1
+    # optimizer steps folded into one device dispatch (lax.scan
+    # superbatch) — raise on high-latency device links
+    streaming_steps_per_call: int = 1
+    # wall bound for one streamed fit; None = unbounded
+    streaming_time_budget_s: "float | None" = None
     # third model family: GRU next-piece-cost predictor over per-parent
     # piece-cost sequences (Download records carry up to 10 piece costs
     # per parent, reference scheduler/storage/types.go:143-176)
@@ -265,6 +270,8 @@ class Training:
             workers=self.config.streaming_workers,
             eval_every=eval_every,
             mesh=self.mesh,
+            steps_per_call=self.config.streaming_steps_per_call,
+            time_budget_s=self.config.streaming_time_budget_s,
         )
         # rows counted once per pass — gate on a single pass's worth
         rows = stats.download_records // max(self.config.streaming_passes, 1)

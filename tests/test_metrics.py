@@ -176,3 +176,47 @@ def test_resource_gauges_zero_disappeared_groups():
     M.refresh_resource_gauges(resource)
     assert M.PEER_GAUGE.labels(res.PEER_STATE_PENDING)._value == 0
     assert M.HOST_GAUGE.labels("normal")._value == 0
+
+
+def test_rpc_server_interceptor_series():
+    """Every RPC handled through glue.serve lands in the shared
+    rpc_server_handled_total / rpc_server_handling_seconds series
+    (reference: grpc-prometheus server interceptors on all services)."""
+    from dragonfly2_tpu.rpc import glue
+    import common_pb2
+    import scheduler_pb2
+    from dragonfly2_tpu.scheduler import resource as res
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+    from dragonfly2_tpu.scheduler.scheduling import Scheduling
+    from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
+
+    service = SchedulerService(res.Resource(), Scheduling(BaseEvaluator()))
+    server, port = glue.serve({SERVICE_NAME: service})
+    try:
+        chan = glue.dial(f"127.0.0.1:{port}")
+        client = glue.ServiceClient(chan, SERVICE_NAME)
+        handled, latency = glue._rpc_metrics()
+        ok_before = handled.labels(SERVICE_NAME, "AnnounceHost", "OK")._value
+        err_before = handled.labels(SERVICE_NAME, "StatPeer", "NOT_FOUND")._value
+
+        host = scheduler_pb2.AnnounceHostRequest(
+            host=common_pb2.HostInfo(id="h-metrics", ip="127.0.0.1", hostname="m")
+        )
+        client.AnnounceHost(host)
+        assert handled.labels(SERVICE_NAME, "AnnounceHost", "OK")._value == ok_before + 1
+
+        import grpc
+
+        with pytest.raises(grpc.RpcError):
+            client.StatPeer(scheduler_pb2.StatPeerRequest(task_id="t", peer_id="nope"))
+        assert (
+            handled.labels(SERVICE_NAME, "StatPeer", "NOT_FOUND")._value
+            == err_before + 1
+        )
+
+        # latency histogram observed both calls
+        child = latency.labels(SERVICE_NAME, "AnnounceHost")
+        assert child.count >= 1
+        chan.close()
+    finally:
+        server.stop(0)
