@@ -101,3 +101,177 @@ def resolve_token(db, token: str) -> str | None:
     if row["expires_at"] and row["expires_at"] < time.time():
         return None
     return row["role"]
+
+
+# ---------------------------------------------------------------------------
+# OAuth2 sign-in (reference manager/auth/oauth/{oauth,google,github}.go +
+# handlers/oauth.go). Providers are DB rows with generic endpoint URLs
+# (auth/token/userinfo) instead of baked per-vendor SDK configs — google
+# and github are both expressible as rows, and tests can point a row at
+# a fake provider.
+# ---------------------------------------------------------------------------
+
+
+def sign_state(secret: bytes, provider: str, ttl: float = 600.0) -> str:
+    """CSRF state: provider|expiry|nonce, HMAC-signed (the reference
+    signs a random state into the AuthCodeURL the same way)."""
+    import base64
+
+    payload = f"{provider}|{time.time() + ttl:.0f}|{secrets.token_hex(8)}"
+    sig = hmac.new(secret, payload.encode(), hashlib.sha256).hexdigest()[:32]
+    return base64.urlsafe_b64encode(f"{payload}|{sig}".encode()).decode()
+
+
+def verify_state(secret: bytes, state: str, provider: str) -> bool:
+    import base64
+
+    try:
+        payload, _, sig = (
+            base64.urlsafe_b64decode(state.encode()).decode().rpartition("|")
+        )
+        want = hmac.new(secret, payload.encode(), hashlib.sha256).hexdigest()[:32]
+        prov, expiry, _nonce = payload.split("|", 2)
+    except (ValueError, UnicodeDecodeError):
+        return False
+    return (
+        hmac.compare_digest(sig, want)
+        and prov == provider
+        and float(expiry) >= time.time()
+    )
+
+
+def oauth_authorize_url(provider: dict, state: str) -> str:
+    """The URL the browser is redirected to (reference AuthCodeURL)."""
+    import urllib.parse
+
+    params = {
+        "response_type": "code",
+        "client_id": provider["client_id"],
+        "state": state,
+    }
+    if provider.get("redirect_url"):
+        params["redirect_uri"] = provider["redirect_url"]
+    if provider.get("scopes"):
+        params["scope"] = provider["scopes"]
+    sep = "&" if "?" in provider["auth_url"] else "?"
+    return provider["auth_url"] + sep + urllib.parse.urlencode(params)
+
+
+def oauth_exchange(provider: dict, code: str, timeout: float = 10.0) -> str:
+    """Authorization code → access token (reference Exchange)."""
+    import json as _json
+    import urllib.parse
+    import urllib.request
+
+    body = urllib.parse.urlencode(
+        {
+            "grant_type": "authorization_code",
+            "code": code,
+            "client_id": provider["client_id"],
+            "client_secret": provider["client_secret"],
+            **(
+                {"redirect_uri": provider["redirect_url"]}
+                if provider.get("redirect_url")
+                else {}
+            ),
+        }
+    ).encode()
+    req = urllib.request.Request(
+        provider["token_url"],
+        data=body,
+        headers={
+            "Content-Type": "application/x-www-form-urlencoded",
+            "Accept": "application/json",
+        },
+    )
+    import urllib.error
+
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            data = _json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        # RFC 6749 token endpoints signal invalid_grant etc. as HTTP 400
+        # — a routine client retry, not a server fault
+        raise ValueError(f"token endpoint refused the code: {e.code}") from e
+    except urllib.error.URLError as e:
+        raise ValueError(f"token endpoint unreachable: {e.reason}") from e
+    token = data.get("access_token", "")
+    if not token:
+        raise ValueError(f"token endpoint returned no access_token: {data}")
+    return token
+
+
+def oauth_userinfo(provider: dict, access_token: str, timeout: float = 10.0) -> dict:
+    import json as _json
+    import urllib.request
+
+    req = urllib.request.Request(
+        provider["userinfo_url"],
+        headers={"Authorization": f"Bearer {access_token}", "Accept": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return _json.loads(resp.read())
+
+
+def oauth_signin(db, provider: dict, code: str) -> tuple[str, dict]:
+    """Full callback leg: exchange the code, fetch the identity,
+    find-or-provision the user (role guest, no password — OAuth is the
+    credential), and mint a 24h session token. → (token, user_row).
+
+    Users are matched by (provider, subject) — the IdP's STABLE id —
+    never by display name: an attacker-controlled login/name at the IdP
+    must not be able to take over an existing local account (e.g. one
+    named like an admin). A taken display name gets uniquified."""
+    access = oauth_exchange(provider, code)
+    info = oauth_userinfo(provider, access)
+    email = str(info.get("email") or "")
+    subject = str(info.get("id") or info.get("sub") or info.get("login") or "")
+    display = str(
+        info.get("login") or info.get("name") or email.partition("@")[0] or ""
+    )
+    if not subject:
+        raise ValueError("oauth userinfo carries no stable subject identifier")
+    user = db.query_one(
+        "SELECT * FROM users WHERE oauth_provider = ? AND oauth_subject = ?",
+        (provider["name"], subject),
+    )
+    if user is None:
+        name = display or f"{provider['name']}-{subject}"
+        for suffix in ("", *(f"-{i}" for i in range(2, 100))):
+            if db.query_one(
+                "SELECT id FROM users WHERE name = ?", (name + suffix,)
+            ) is None:
+                name = name + suffix
+                break
+        else:
+            raise ValueError(f"cannot allocate a unique name for {display!r}")
+        user = create_user(db, name, secrets.token_hex(16), role="guest", email=email)
+        db.execute(
+            "UPDATE users SET oauth_provider = ?, oauth_subject = ? WHERE id = ?",
+            (provider["name"], subject, user["id"]),
+        )
+        user = db.query_one("SELECT * FROM users WHERE id = ?", (user["id"],))
+    if user["state"] != "enabled":
+        raise ValueError(f"user {user['name']!r} is disabled")
+    token, _ = create_pat(
+        db, user["id"], f"oauth-session-{provider['name']}", ttl=24 * 3600.0
+    )
+    return token, user
+
+
+def state_secret(db) -> bytes:
+    """The OAuth CSRF-state HMAC key, stored in the DB so the
+    redirect→callback round-trip survives manager restarts and works
+    across replicas sharing the database."""
+    row = db.query_one("SELECT value FROM settings WHERE key = 'oauth_state_secret'")
+    if row is not None:
+        return bytes.fromhex(row["value"])
+    key = secrets.token_bytes(32)
+    # racing replicas: INSERT OR IGNORE, then re-read the winner
+    db.execute(
+        "INSERT OR IGNORE INTO settings (key, value) VALUES"
+        " ('oauth_state_secret', ?)",
+        (key.hex(),),
+    )
+    row = db.query_one("SELECT value FROM settings WHERE key = 'oauth_state_secret'")
+    return bytes.fromhex(row["value"])

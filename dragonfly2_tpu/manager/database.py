@@ -98,6 +98,8 @@ CREATE TABLE IF NOT EXISTS users (
   password_hash TEXT NOT NULL DEFAULT '',
   role TEXT NOT NULL DEFAULT 'guest',
   state TEXT NOT NULL DEFAULT 'enabled',
+  oauth_provider TEXT NOT NULL DEFAULT '',
+  oauth_subject TEXT NOT NULL DEFAULT '',
   created_at REAL NOT NULL,
   updated_at REAL NOT NULL
 );
@@ -119,6 +121,24 @@ CREATE TABLE IF NOT EXISTS applications (
   created_at REAL NOT NULL,
   updated_at REAL NOT NULL
 );
+CREATE TABLE IF NOT EXISTS settings (
+  key TEXT PRIMARY KEY,
+  value TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS oauth (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT UNIQUE NOT NULL,
+  bio TEXT NOT NULL DEFAULT '',
+  client_id TEXT NOT NULL,
+  client_secret TEXT NOT NULL,
+  redirect_url TEXT NOT NULL DEFAULT '',
+  auth_url TEXT NOT NULL,
+  token_url TEXT NOT NULL,
+  userinfo_url TEXT NOT NULL,
+  scopes TEXT NOT NULL DEFAULT '',
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL
+);
 """
 
 
@@ -136,6 +156,11 @@ class Database:
         tables)."""
         for table, column, decl in [
             ("models", "updated_at", "REAL NOT NULL DEFAULT 0"),
+            # OAuth identity linkage: which provider+subject this user
+            # belongs to ('' = local password account). Sign-in matches
+            # on these, never on the display name.
+            ("users", "oauth_provider", "TEXT NOT NULL DEFAULT ''"),
+            ("users", "oauth_subject", "TEXT NOT NULL DEFAULT ''"),
         ]:
             cols = {r[1] for r in self._conn.execute(f"PRAGMA table_info({table})")}
             if column not in cols:

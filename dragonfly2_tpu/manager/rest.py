@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import re
+import sqlite3
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -24,14 +25,18 @@ from dragonfly2_tpu.utils import dflog
 
 logger = dflog.get("manager.rest")
 
-_ROUTES: list[tuple[str, re.Pattern, str, bool]] = []  # (method, pattern, fn, write)
+#                 (method, pattern, fn, write, auth)
+_ROUTES: list[tuple[str, re.Pattern, str, bool, bool]] = []
 
 
-def route(method: str, pattern: str, write: bool = False):
+def route(method: str, pattern: str, write: bool = False, auth: bool = True):
+    """``auth=False`` marks the route itself unauthenticated (health
+    probes, credential-exchange legs) — a per-route flag, not a path
+    prefix, so unrelated routes can never inherit the exemption."""
     rx = re.compile("^" + re.sub(r":(\w+)", r"(?P<\1>[^/]+)", pattern) + "$")
 
     def wrap(fn):
-        _ROUTES.append((method, rx, fn.__name__, write))
+        _ROUTES.append((method, rx, fn.__name__, write, auth))
         return fn
 
     return wrap
@@ -41,6 +46,15 @@ class ApiError(Exception):
     def __init__(self, status: int, message: str):
         super().__init__(message)
         self.status = status
+
+
+class Redirect(Exception):
+    """Handler outcome: 302 with a Location header (OAuth authorize leg,
+    reference handlers/oauth.go OauthSignin → ctx.Redirect)."""
+
+    def __init__(self, location: str):
+        super().__init__(location)
+        self.location = location
 
 
 def _ttl_of(body: dict, default: float) -> float:
@@ -62,12 +76,18 @@ class RestApi:
     """Route handlers; one instance per server, stateless per request."""
 
     def __init__(self, service: ManagerService):
+        from dragonfly2_tpu.manager import auth as _auth
+
         self.service = service
         self.db = service.db
         self.models = service.models
+        # OAuth CSRF-state HMAC key, persisted in the DB: the
+        # redirect→callback round-trip survives restarts and works
+        # across replicas sharing the database
+        self.oauth_state_secret = _auth.state_secret(self.db)
 
     # -- health ----------------------------------------------------------
-    @route("GET", "/healthy")
+    @route("GET", "/healthy", auth=False)
     def healthy(self, req):
         return {"status": "ok"}
 
@@ -293,7 +313,7 @@ class RestApi:
             raise ApiError(404, "user not found")
         return row
 
-    @route("POST", "/api/v1/users/signin")
+    @route("POST", "/api/v1/users/signin", auth=False)
     def signin(self, req):
         """Password → short-lived session token (the console's login;
         reference issues a session JWT — here a TTL'd PAT)."""
@@ -345,6 +365,103 @@ class RestApi:
         return {"revoked": int(req["pat_id"])}
 
     # -- applications ----------------------------------------------------
+    # -- oauth providers + sign-in flow ---------------------------------
+    # (reference manager/handlers/oauth.go CRUD + OauthSignin/Callback)
+    _OAUTH_PUBLIC = ("id", "name", "bio", "client_id", "redirect_url",
+                     "auth_url", "scopes", "created_at", "updated_at")
+
+    def _oauth_row(self, ident: str) -> dict:
+        row = self.db.query_one(
+            "SELECT * FROM oauth WHERE id = ? OR name = ?", (ident, ident)
+        )
+        if row is None:
+            raise ApiError(404, f"no oauth provider {ident!r}")
+        return row
+
+    def _oauth_public(self, row: dict) -> dict:
+        # client_secret and token/userinfo endpoints stay server-side
+        return {k: row[k] for k in self._OAUTH_PUBLIC if k in row}
+
+    @route("GET", "/api/v1/oauth")
+    def list_oauth(self, req):
+        return [self._oauth_public(r) for r in self.db.query("SELECT * FROM oauth ORDER BY id")]
+
+    @route("POST", "/api/v1/oauth", write=True)
+    def create_oauth(self, req):
+        body = req["body"]
+        for field in ("name", "client_id", "client_secret", "auth_url",
+                      "token_url", "userinfo_url"):
+            if not body.get(field):
+                raise ApiError(400, f"{field} is required")
+        now = time.time()
+        cur = self.db.execute(
+            "INSERT INTO oauth (name, bio, client_id, client_secret,"
+            " redirect_url, auth_url, token_url, userinfo_url, scopes,"
+            " created_at, updated_at) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            (
+                body["name"], body.get("bio", ""), body["client_id"],
+                body["client_secret"], body.get("redirect_url", ""),
+                body["auth_url"], body["token_url"], body["userinfo_url"],
+                body.get("scopes", ""), now, now,
+            ),
+        )
+        return self._oauth_public(
+            self.db.query_one("SELECT * FROM oauth WHERE id = ?", (cur.lastrowid,))
+        )
+
+    @route("GET", "/api/v1/oauth/:id")
+    def get_oauth(self, req):
+        return self._oauth_public(self._oauth_row(req["id"]))
+
+    @route("PATCH", "/api/v1/oauth/:id", write=True)
+    def update_oauth(self, req):
+        row = self._oauth_row(req["id"])
+        body = req["body"]
+        fields = ("name", "bio", "client_id", "client_secret", "redirect_url",
+                  "auth_url", "token_url", "userinfo_url", "scopes")
+        updates = {k: body[k] for k in fields if k in body}
+        if updates:
+            sets = ", ".join(f"{k} = ?" for k in updates)
+            self.db.execute(
+                f"UPDATE oauth SET {sets}, updated_at = ? WHERE id = ?",
+                (*updates.values(), time.time(), row["id"]),
+            )
+        return self._oauth_public(self._oauth_row(str(row["id"])))
+
+    @route("DELETE", "/api/v1/oauth/:id", write=True)
+    def delete_oauth(self, req):
+        row = self._oauth_row(req["id"])
+        self.db.execute("DELETE FROM oauth WHERE id = ?", (row["id"],))
+        return {"deleted": row["id"]}
+
+    @route("GET", "/api/v1/users/signin/:name", auth=False)
+    def oauth_signin_redirect(self, req):
+        from dragonfly2_tpu.manager import auth
+
+        provider = self._oauth_row(req["name"])
+        state = auth.sign_state(self.oauth_state_secret, provider["name"])
+        raise Redirect(auth.oauth_authorize_url(provider, state))
+
+    @route("GET", "/api/v1/users/signin/:name/callback", auth=False)
+    def oauth_signin_callback(self, req):
+        from dragonfly2_tpu.manager import auth
+
+        provider = self._oauth_row(req["name"])
+        code = req["query"].get("code", "")
+        state = req["query"].get("state", "")
+        if not code:
+            raise ApiError(400, "missing code")
+        if not auth.verify_state(self.oauth_state_secret, state, provider["name"]):
+            raise ApiError(403, "state verification failed")
+        try:
+            token, user = auth.oauth_signin(self.db, provider, code)
+        except ValueError as e:
+            raise ApiError(401, str(e))
+        return {
+            "token": token,
+            "user": {k: user[k] for k in ("id", "name", "email", "role")},
+        }
+
     @route("GET", "/api/v1/applications")
     def list_applications(self, req):
         return self.db.query("SELECT * FROM applications ORDER BY id")
@@ -441,19 +558,16 @@ class RestServer:
                     return
                 query = dict(parse_qsl(parts.query))
                 role = role_for(self.headers.get("Authorization"))
-                for method, rx, fname, write in _ROUTES:
+                for method, rx, fname, write, needs_auth in _ROUTES:
                     if method != self.command:
                         continue
                     m = rx.match(parts.path)
                     if not m:
                         continue
-                    # health probes and signin stay unauthenticated (LBs
-                    # don't carry tokens; signin EXCHANGES credentials
-                    # for one)
-                    if role is None and parts.path not in (
-                        "/healthy",
-                        "/api/v1/users/signin",
-                    ):
+                    # auth=False routes (health probe, password signin,
+                    # OAuth redirect/callback legs) stay open — a
+                    # per-route flag, so nothing else inherits it
+                    if role is None and needs_auth:
                         return self._send(401, {"error": "unauthorized"})
                     if write and role != "admin":
                         return self._send(403, {"error": "forbidden (read-only role)"})
@@ -467,8 +581,16 @@ class RestServer:
                     req = dict(m.groupdict(), body=body, query=query)
                     try:
                         return self._send(200, getattr(api, fname)(req))
+                    except Redirect as r:
+                        return self._send(
+                            302, {"location": r.location}, location=r.location
+                        )
                     except ApiError as e:
                         return self._send(e.status, {"error": str(e)})
+                    except sqlite3.IntegrityError as e:
+                        # UNIQUE/foreign-key violations are client
+                        # mistakes (duplicate name), not server faults
+                        return self._send(409, {"error": str(e)})
                     except ValueError as e:
                         # non-numeric path/query params etc. are client
                         # errors, not server faults
@@ -478,12 +600,14 @@ class RestServer:
                         return self._send(500, {"error": str(e)})
                 self._send(404, {"error": f"no route for {self.command} {parts.path}"})
 
-            def _send(self, status: int, payload):
+            def _send(self, status: int, payload, location: str | None = None):
                 from dragonfly2_tpu.manager import metrics as M
 
                 M.REST_REQUEST_TOTAL.labels(self.command, str(status)).inc()
                 data = json.dumps(payload, default=str).encode()
                 self.send_response(status)
+                if location is not None:
+                    self.send_header("Location", location)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()

@@ -277,3 +277,275 @@ def test_users_and_pats(tmp_path):
     finally:
         server.stop()
         db.close()
+
+
+# ---------------------------------------------------------------------------
+# OAuth sign-in (reference manager/handlers/oauth.go + auth/oauth/)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fake_idp():
+    """OAuth2 provider fake: token endpoint validating client creds +
+    code, userinfo endpoint validating the bearer token."""
+    import threading
+    import urllib.parse
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    seen = {"token_body": None}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            if self.path != "/token":
+                self.send_error(404)
+                return
+            body = dict(
+                urllib.parse.parse_qsl(
+                    self.rfile.read(int(self.headers["Content-Length"])).decode()
+                )
+            )
+            seen["token_body"] = body
+            if (
+                body.get("client_id") == "cid"
+                and body.get("client_secret") == "csec"
+                and body.get("code") == "good-code"
+            ):
+                payload = json.dumps({"access_token": "at-1", "token_type": "bearer"})
+                self.send_response(200)
+            else:
+                payload = json.dumps({"error": "invalid_grant"})
+                self.send_response(200)  # oauth2 errors ride 200+JSON too
+            data = payload.encode()
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path != "/userinfo":
+                self.send_error(404)
+                return
+            if self.headers.get("Authorization") != "Bearer at-1":
+                self.send_error(401)
+                return
+            data = json.dumps({"login": "octo", "email": "octo@example.com"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield {"base": f"http://127.0.0.1:{httpd.server_port}", "seen": seen}
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _make_provider(addr, base):
+    status, body = call(
+        addr,
+        "POST",
+        "/api/v1/oauth",
+        {
+            "name": "fakehub",
+            "client_id": "cid",
+            "client_secret": "csec",
+            "redirect_url": "http://console.local/callback",
+            "auth_url": f"{base}/authorize",
+            "token_url": f"{base}/token",
+            "userinfo_url": f"{base}/userinfo",
+            "scopes": "read:user",
+        },
+    )
+    assert status == 200, body
+    return body
+
+
+def test_oauth_provider_crud_redacts_secret(rest, fake_idp):
+    addr = rest["addr"]
+    created = _make_provider(addr, fake_idp["base"])
+    assert "client_secret" not in created and "token_url" not in created
+    status, listed = call(addr, "GET", "/api/v1/oauth", token="guest-tok")
+    assert status == 200 and listed[0]["name"] == "fakehub"
+    status, got = call(addr, "PATCH", f"/api/v1/oauth/{created['id']}", {"bio": "x"})
+    assert status == 200 and got["bio"] == "x"
+    # guest cannot write providers
+    status, _ = call(addr, "POST", "/api/v1/oauth", {}, token="guest-tok")
+    assert status == 403
+    status, _ = call(addr, "DELETE", f"/api/v1/oauth/{created['id']}")
+    assert status == 200
+    status, listed = call(addr, "GET", "/api/v1/oauth")
+    assert listed == []
+
+
+def test_oauth_signin_full_flow(rest, fake_idp):
+    """Redirect leg → state round-trip → code exchange → user
+    provisioned → session token works against the API."""
+    import urllib.parse
+
+    addr = rest["addr"]
+    _make_provider(addr, fake_idp["base"])
+
+    # unauthenticated browser hits the signin leg; 302 carries state
+    req = urllib.request.Request(f"http://{addr}/api/v1/users/signin/fakehub")
+
+    class NoRedirect(urllib.request.HTTPRedirectHandler):
+        def redirect_request(self, *a, **k):
+            return None
+
+    opener = urllib.request.build_opener(NoRedirect)
+    try:
+        opener.open(req, timeout=5)
+        raise AssertionError("expected 302")
+    except urllib.error.HTTPError as e:
+        assert e.code == 302
+        loc = e.headers["Location"]
+    q = dict(urllib.parse.parse_qsl(urllib.parse.urlsplit(loc).query))
+    assert loc.startswith(fake_idp["base"] + "/authorize")
+    assert q["client_id"] == "cid" and q["redirect_uri"] == "http://console.local/callback"
+    state = q["state"]
+
+    # callback with the provider-issued code
+    status, body = call(
+        addr,
+        "GET",
+        f"/api/v1/users/signin/fakehub/callback?code=good-code&state={state}",
+        token=None,
+    )
+    assert status == 200, body
+    assert body["user"]["name"] == "octo" and body["user"]["role"] == "guest"
+    assert fake_idp["seen"]["token_body"]["redirect_uri"] == "http://console.local/callback"
+
+    # the minted session token authenticates read access
+    status, _ = call(addr, "GET", "/api/v1/schedulers", token=body["token"])
+    assert status == 200
+
+    # tampered/mismatched state is rejected
+    status, err = call(
+        addr,
+        "GET",
+        f"/api/v1/users/signin/fakehub/callback?code=good-code&state={state[:-4]}AAAA",
+        token=None,
+    )
+    assert status == 403
+
+    # bad code: provider refuses, no session
+    status, err = call(
+        addr,
+        "GET",
+        f"/api/v1/users/signin/fakehub/callback?code=bad&state={state}",
+        token=None,
+    )
+    assert status in (401, 500) and "token" not in err
+
+
+def test_oauth_name_collision_cannot_take_over_local_account(rest, fake_idp):
+    """An IdP login equal to an existing local admin's name must NOT
+    sign into that account: matching is by (provider, subject), and the
+    display name gets uniquified."""
+    from dragonfly2_tpu.manager import auth as A
+
+    addr = rest["addr"]
+    A.create_user(rest["db"], "octo", "hunter2", role="admin")  # local admin
+    _make_provider(addr, fake_idp["base"])
+    state = _state_secret_signed(rest, "fakehub")
+    status, body = call(
+        addr,
+        "GET",
+        f"/api/v1/users/signin/fakehub/callback?code=good-code&state={state}",
+        token=None,
+    )
+    assert status == 200, body
+    # NOT the admin account: provisioned under a uniquified name, guest role
+    assert body["user"]["name"] != "octo" or body["user"]["role"] == "guest"
+    assert body["user"]["role"] == "guest"
+    local = rest["db"].query_one("SELECT * FROM users WHERE name = 'octo'")
+    assert local["role"] == "admin" and local["oauth_subject"] == ""
+    # second sign-in reuses the SAME linked account (stable subject)
+    state2 = _state_secret_signed(rest, "fakehub")
+    status, body2 = call(
+        addr,
+        "GET",
+        f"/api/v1/users/signin/fakehub/callback?code=good-code&state={state2}",
+        token=None,
+    )
+    assert status == 200 and body2["user"]["id"] == body["user"]["id"]
+
+
+def _state_secret(rest):
+    from dragonfly2_tpu.manager import auth
+
+    return auth.state_secret(rest["db"])
+
+
+def _state_secret_signed(rest, provider):
+    from dragonfly2_tpu.manager import auth
+
+    return auth.sign_state(_state_secret(rest), provider)
+
+
+def test_oauth_state_survives_server_restart(rest, fake_idp, tmp_path):
+    """The CSRF state key is DB-persisted: a state minted before a
+    manager restart verifies after it."""
+    from dragonfly2_tpu.manager import auth
+
+    addr = rest["addr"]
+    _make_provider(addr, fake_idp["base"])
+    state = _state_secret_signed(rest, "fakehub")
+    # a fresh RestServer over the same DB (the "restarted" replica)
+    from dragonfly2_tpu.manager.rest import RestServer
+
+    server2 = RestServer(rest["service"], tokens={"admin-tok": "admin"})
+    addr2 = server2.start()
+    try:
+        status, body = call(
+            addr2,
+            "GET",
+            f"/api/v1/users/signin/fakehub/callback?code=good-code&state={state}",
+            token=None,
+        )
+        assert status == 200, body
+    finally:
+        server2.stop()
+
+
+def test_oauth_bad_code_is_401_and_duplicate_provider_409(rest, fake_idp):
+    addr = rest["addr"]
+    _make_provider(addr, fake_idp["base"])
+    # provider 400s / refuses the code → clean 401, not a 500
+    state = _state_secret_signed(rest, "fakehub")
+    status, err = call(
+        addr,
+        "GET",
+        f"/api/v1/users/signin/fakehub/callback?code=bad&state={state}",
+        token=None,
+    )
+    assert status == 401, err
+    # duplicate provider name → 409 conflict, not 500
+    status, err = call(
+        addr,
+        "POST",
+        "/api/v1/oauth",
+        {
+            "name": "fakehub", "client_id": "x", "client_secret": "y",
+            "auth_url": "http://a", "token_url": "http://t", "userinfo_url": "http://u",
+        },
+    )
+    assert status == 409, err
+
+
+def test_signin_prefix_does_not_unauthenticate_other_routes(rest):
+    """/api/v1/users/signin/... exemption is per-route: a path that
+    happens to share the prefix but matches another route still needs
+    auth."""
+    addr = rest["addr"]
+    status, _ = call(
+        addr, "GET", "/api/v1/users/signin/personal-access-tokens", token=None
+    )
+    # either the PAT route demands auth (401) or nothing matches (404);
+    # anything but an unauthenticated 200/400 is fine
+    assert status in (401, 404)
