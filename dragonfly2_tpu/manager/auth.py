@@ -203,14 +203,22 @@ def oauth_exchange(provider: dict, code: str, timeout: float = 10.0) -> str:
 
 def oauth_userinfo(provider: dict, access_token: str, timeout: float = 10.0) -> dict:
     import json as _json
+    import urllib.error
     import urllib.request
 
     req = urllib.request.Request(
         provider["userinfo_url"],
         headers={"Authorization": f"Bearer {access_token}", "Accept": "application/json"},
     )
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return _json.loads(resp.read())
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return _json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        # insufficient scope / revoked token — an IdP refusal, not a
+        # manager fault (mirrors oauth_exchange's mapping)
+        raise ValueError(f"userinfo endpoint refused the token: {e.code}") from e
+    except urllib.error.URLError as e:
+        raise ValueError(f"userinfo endpoint unreachable: {e.reason}") from e
 
 
 def oauth_signin(db, provider: dict, code: str) -> tuple[str, dict]:
@@ -225,12 +233,17 @@ def oauth_signin(db, provider: dict, code: str) -> tuple[str, dict]:
     access = oauth_exchange(provider, code)
     info = oauth_userinfo(provider, access)
     email = str(info.get("email") or "")
-    subject = str(info.get("id") or info.get("sub") or info.get("login") or "")
+    # id/sub only: login handles are reassignable at most IdPs, so a
+    # recycled handle must never resolve to the previous owner's account
+    subject = str(info.get("id") or info.get("sub") or "")
     display = str(
         info.get("login") or info.get("name") or email.partition("@")[0] or ""
     )
     if not subject:
-        raise ValueError("oauth userinfo carries no stable subject identifier")
+        raise ValueError(
+            "oauth userinfo lacks a stable subject (id/sub) — refusing to"
+            " link accounts by a reassignable handle"
+        )
     user = db.query_one(
         "SELECT * FROM users WHERE oauth_provider = ? AND oauth_subject = ?",
         (provider["name"], subject),

@@ -521,13 +521,20 @@ class RestServer:
             role = auth.resolve_token(self.api.db, token)
             if role is not None:
                 return role
-        if not self.tokens and not self._has_users():
+        if not self.tokens and not self._has_admin():
             return "admin"
         return None
 
-    def _has_users(self) -> bool:
+    def _has_admin(self) -> bool:
+        """Anonymous dev-mode admin ends when an ADMIN credential exists
+        — not when any user does: an OAuth passerby auto-provisioned as
+        guest must not close the bootstrap window and lock every write
+        route with no admin account in existence."""
         return (
-            self.api.db.query_one("SELECT id FROM users LIMIT 1") is not None
+            self.api.db.query_one(
+                "SELECT id FROM users WHERE role = 'admin' LIMIT 1"
+            )
+            is not None
         )
 
     def start(self) -> str:

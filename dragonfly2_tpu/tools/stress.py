@@ -1,0 +1,214 @@
+"""Stress load generator for a running P2P cluster (reference
+test/tools/stress/main.go: concurrent downloads through the daemon,
+latency percentiles at the end).
+
+Two drive modes:
+  --daemon ADDR   each request is a dfdaemon Download RPC (the dfget
+                  path: scheduler + P2P + back-to-source all exercised);
+                  ``{i}`` in --url varies the task per request, plain
+                  URLs stress single-task fan-out (dedup + reuse).
+  --proxy ADDR    each request is an HTTP GET through the daemon's
+                  proxy (the registry-mirror path).
+
+Stops at --requests or --duration, whichever comes first. Prints one
+JSON line of aggregate statistics (rps, MB/s, latency percentiles);
+--output saves per-request samples as CSV for offline analysis.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class Sample:
+    ok: bool
+    seconds: float
+    bytes: int
+    error: str = ""
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _daemon_worker(
+    daemon: str, url_tpl: str, stop, out: list, lock, tag: str, idx: int, stride: int
+):
+    from dragonfly2_tpu.client import dfget
+
+    i = idx  # disjoint per-worker stride: {i} values never collide
+    while not stop.is_set():
+        url = url_tpl.replace("{i}", str(i))
+        i += stride
+        tmp = tempfile.NamedTemporaryFile(prefix="dfstress-", delete=False)
+        tmp.close()
+        t0 = time.perf_counter()
+        try:
+            dfget.download(daemon, url, tmp.name, tag=tag)
+            size = os.path.getsize(tmp.name)
+            s = Sample(True, time.perf_counter() - t0, size)
+        except Exception as e:  # per-request failure is a data point
+            s = Sample(False, time.perf_counter() - t0, 0, str(e)[:200])
+        finally:
+            try:
+                os.unlink(tmp.name)
+            except OSError:
+                pass
+        with lock:
+            out.append(s)
+            if stop.budget_hit(len(out)):
+                stop.set()
+
+
+def _proxy_worker(
+    proxy: str, url_tpl: str, stop, out: list, lock, tag: str, idx: int, stride: int
+):
+    import urllib.request
+
+    opener = urllib.request.build_opener(
+        urllib.request.ProxyHandler({"http": f"http://{proxy}"})
+    )
+    i = idx
+    while not stop.is_set():
+        url = url_tpl.replace("{i}", str(i))
+        i += stride
+        t0 = time.perf_counter()
+        try:
+            with opener.open(url, timeout=60) as resp:
+                n = 0
+                while True:
+                    chunk = resp.read(1 << 20)
+                    if not chunk:
+                        break
+                    n += len(chunk)
+            s = Sample(True, time.perf_counter() - t0, n)
+        except Exception as e:
+            s = Sample(False, time.perf_counter() - t0, 0, str(e)[:200])
+        with lock:
+            out.append(s)
+            if stop.budget_hit(len(out)):
+                stop.set()
+
+
+class _Stop(threading.Event):
+    """Stop event that also knows the request budget."""
+
+    def __init__(self, max_requests: int):
+        super().__init__()
+        self.max_requests = max_requests
+
+    def budget_hit(self, done: int) -> bool:
+        return self.max_requests > 0 and done >= self.max_requests
+
+
+def run(
+    url: str,
+    daemon: str = "",
+    proxy: str = "",
+    connections: int = 8,
+    requests: int = 0,
+    duration: float = 0.0,
+    tag: str = "",
+    output: str = "",
+) -> dict:
+    """Drive the load; → the statistics dict that main() prints."""
+    if bool(daemon) == bool(proxy):
+        raise ValueError("exactly one of daemon/proxy is required")
+    samples: list[Sample] = []
+    lock = threading.Lock()
+    stop = _Stop(requests)
+    worker = _daemon_worker if daemon else _proxy_worker
+    target = daemon or proxy
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(
+            target=worker,
+            args=(target, url, stop, samples, lock, tag, idx, connections),
+            daemon=True,
+        )
+        for idx in range(connections)
+    ]
+    for t in threads:
+        t.start()
+    deadline = t0 + duration if duration > 0 else None
+    while any(t.is_alive() for t in threads):
+        # deadline checked every join slice, not once per full sweep —
+        # with many connections a sweep takes connections·0.2s
+        if deadline is not None and time.perf_counter() >= deadline:
+            stop.set()
+        for t in threads:
+            t.join(0.2)
+            if deadline is not None and time.perf_counter() >= deadline:
+                stop.set()
+    wall = time.perf_counter() - t0
+
+    lat = sorted(s.seconds for s in samples if s.ok)
+    ok = sum(1 for s in samples if s.ok)
+    total_bytes = sum(s.bytes for s in samples)
+    stats = {
+        "requests": len(samples),
+        "failures": len(samples) - ok,
+        "wall_s": round(wall, 3),
+        "rps": round(len(samples) / wall, 2) if wall else 0.0,
+        "throughput_mb_s": round(total_bytes / wall / 1e6, 2) if wall else 0.0,
+        "bytes": total_bytes,
+        "latency_s": {
+            "min": round(lat[0], 4) if lat else 0.0,
+            "p50": round(_percentile(lat, 0.50), 4),
+            "p90": round(_percentile(lat, 0.90), 4),
+            "p99": round(_percentile(lat, 0.99), 4),
+            "max": round(lat[-1], 4) if lat else 0.0,
+        },
+        "errors": sorted({s.error for s in samples if s.error})[:5],
+    }
+    if output:
+        import csv
+
+        with open(output, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["ok", "seconds", "bytes", "error"])
+            for s in samples:
+                w.writerow([int(s.ok), f"{s.seconds:.6f}", s.bytes, s.error])
+    return stats
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="df-stress", description=__doc__)
+    p.add_argument("--url", required=True, help="target url; {i} varies per request")
+    p.add_argument("--daemon", default="", help="dfdaemon gRPC address (Download path)")
+    p.add_argument("--proxy", default="", help="daemon proxy address (HTTP path)")
+    p.add_argument("-c", "--connections", type=int, default=8)
+    p.add_argument("-n", "--requests", type=int, default=0, help="stop after N requests")
+    p.add_argument("-d", "--duration", type=float, default=0.0, help="stop after S seconds")
+    p.add_argument("--tag", default="stress")
+    p.add_argument("--output", default="", help="per-request CSV path")
+    args = p.parse_args(argv)
+    if args.requests <= 0 and args.duration <= 0:
+        p.error("one of --requests/--duration is required")
+    stats = run(
+        args.url,
+        daemon=args.daemon,
+        proxy=args.proxy,
+        connections=args.connections,
+        requests=args.requests,
+        duration=args.duration,
+        tag=args.tag,
+        output=args.output,
+    )
+    print(json.dumps(stats))
+    return 1 if stats["requests"] and stats["failures"] == stats["requests"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -331,7 +331,9 @@ def fake_idp():
             if self.headers.get("Authorization") != "Bearer at-1":
                 self.send_error(401)
                 return
-            data = json.dumps({"login": "octo", "email": "octo@example.com"}).encode()
+            data = json.dumps(
+                {"id": 424242, "login": "octo", "email": "octo@example.com"}
+            ).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
@@ -549,3 +551,76 @@ def test_signin_prefix_does_not_unauthenticate_other_routes(rest):
     # either the PAT route demands auth (401) or nothing matches (404);
     # anything but an unauthenticated 200/400 is fine
     assert status in (401, 404)
+
+
+def test_oauth_refuses_userinfo_without_stable_subject(rest, fake_idp, monkeypatch):
+    """login-only userinfo (a reassignable handle) must be refused, not
+    used as the account link key."""
+    from dragonfly2_tpu.manager import auth
+
+    monkeypatch.setattr(
+        auth, "oauth_userinfo", lambda p, t, timeout=10.0: {"login": "octo"}
+    )
+    addr = rest["addr"]
+    _make_provider(addr, fake_idp["base"])
+    state = _state_secret_signed(rest, "fakehub")
+    status, err = call(
+        addr,
+        "GET",
+        f"/api/v1/users/signin/fakehub/callback?code=good-code&state={state}",
+        token=None,
+    )
+    assert status == 401 and "stable subject" in err["error"]
+
+
+def test_oauth_guest_does_not_close_admin_bootstrap(tmp_path, fake_idp):
+    """Token-less dev mode: an OAuth-provisioned guest must not end the
+    anonymous-admin bootstrap window (that would lock every write route
+    with no admin in existence); creating an admin user does."""
+    from dragonfly2_tpu.manager.rest import RestServer
+
+    db = Database(tmp_path / "boot.db")
+    service = ManagerService(db, ModelRegistry(db, FSObjectStorage(tmp_path / "o")))
+    server = RestServer(service)  # no config tokens
+    addr = server.start()
+    try:
+        status, _ = _make_provider_status(addr, fake_idp["base"], token=None)
+        assert status == 200  # anonymous admin can configure the provider
+        from dragonfly2_tpu.manager import auth
+
+        state = auth.sign_state(auth.state_secret(db), "fakehub")
+        status, body = call(
+            addr,
+            "GET",
+            f"/api/v1/users/signin/fakehub/callback?code=good-code&state={state}",
+            token=None,
+        )
+        assert status == 200 and body["user"]["role"] == "guest"
+        # bootstrap window still open: anonymous can create the admin
+        status, admin = call(
+            addr, "POST", "/api/v1/users",
+            {"name": "root", "password": "pw12345", "role": "admin"}, token=None,
+        )
+        assert status == 200, admin
+        # and NOW anonymous write access is gone
+        status, _ = call(
+            addr, "POST", "/api/v1/applications", {"name": "x"}, token=None
+        )
+        assert status == 401
+    finally:
+        server.stop()
+        db.close()
+
+
+def _make_provider_status(addr, base, token="admin-tok"):
+    return call(
+        addr,
+        "POST",
+        "/api/v1/oauth",
+        {
+            "name": "fakehub", "client_id": "cid", "client_secret": "csec",
+            "auth_url": f"{base}/authorize", "token_url": f"{base}/token",
+            "userinfo_url": f"{base}/userinfo",
+        },
+        token=token,
+    )

@@ -1,0 +1,117 @@
+"""Stress load generator (reference test/tools/stress) against an
+in-process cluster."""
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+from dragonfly2_tpu.rpc.glue import serve
+from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import SERVICE_NAME as SCHED_SERVICE
+from dragonfly2_tpu.scheduler.service import SchedulerService
+from dragonfly2_tpu.tools import stress
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    payload = os.urandom(64 * 1024)
+
+    class Origin(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def do_HEAD(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.send_header("Accept-Ranges", "bytes")
+            self.end_headers()
+
+    origin = ThreadingHTTPServer(("127.0.0.1", 0), Origin)
+    threading.Thread(target=origin.serve_forever, daemon=True).start()
+
+    service = SchedulerService(
+        res.Resource(),
+        Scheduling(BaseEvaluator(), SchedulingConfig(retry_interval=0.05)),
+    )
+    server, port = serve({SCHED_SERVICE: service})
+    d = Daemon(
+        DaemonConfig(
+            data_dir=str(tmp_path / "d"),
+            scheduler_address=f"127.0.0.1:{port}",
+            hostname="stress-host",
+            ip="127.0.0.1",
+            announce_interval=60.0,
+        )
+    )
+    d.start()
+    yield {
+        "daemon": f"127.0.0.1:{d.port}",
+        "origin": f"http://127.0.0.1:{origin.server_port}",
+        "payload": payload,
+    }
+    d.stop()
+    server.stop(0)
+    origin.shutdown()
+    origin.server_close()
+
+
+def test_stress_daemon_mode_counts_and_percentiles(cluster):
+    stats = stress.run(
+        cluster["origin"] + "/obj-{i}.bin",
+        daemon=cluster["daemon"],
+        connections=3,
+        requests=12,
+    )
+    assert stats["requests"] >= 12 and stats["failures"] == 0
+    assert stats["bytes"] >= 12 * 64 * 1024
+    lat = stats["latency_s"]
+    assert 0 < lat["min"] <= lat["p50"] <= lat["p90"] <= lat["p99"] <= lat["max"]
+    assert stats["rps"] > 0 and stats["throughput_mb_s"] > 0
+
+
+def test_stress_duration_stop_and_csv(cluster, tmp_path):
+    out = tmp_path / "samples.csv"
+    stats = stress.run(
+        cluster["origin"] + "/one.bin",  # single task: dedup/reuse path
+        daemon=cluster["daemon"],
+        connections=2,
+        duration=2.0,
+        output=str(out),
+    )
+    assert stats["requests"] > 0
+    lines = out.read_text().strip().splitlines()
+    assert lines[0] == "ok,seconds,bytes,error"
+    assert len(lines) == stats["requests"] + 1
+
+
+def test_stress_cli_json_line(cluster, capsys):
+    rc = stress.main(
+        [
+            "--url", cluster["origin"] + "/cli-{i}.bin",
+            "--daemon", cluster["daemon"],
+            "-c", "2", "-n", "4",
+        ]
+    )
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(line)
+    assert parsed["failures"] == 0 and parsed["requests"] >= 4
+
+
+def test_stress_requires_exactly_one_target():
+    with pytest.raises(ValueError):
+        stress.run("http://x", daemon="a", proxy="b", requests=1)
+    with pytest.raises(ValueError):
+        stress.run("http://x", requests=1)
