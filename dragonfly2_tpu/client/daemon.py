@@ -74,6 +74,7 @@ class DaemonConfig:
     # port; rules are transport.ProxyRule instances or kwargs dicts
     # ({"regex": ..., "direct": ..., "use_https": ..., "redirect": ...})
     proxy_port: int = -1
+    proxy_host: str = "127.0.0.1"  # bind address (0.0.0.0 in containers)
     proxy_rules: list = field(default_factory=list)
     registry_mirror: str = ""
     # HTTPS interception: spoof per-host certs signed by a local CA
@@ -297,6 +298,7 @@ class Daemon:
             self.proxy = ProxyServer(
                 P2PTransport(self.task_manager, rules=rules),
                 mirror=RegistryMirror(self.cfg.registry_mirror),
+                address=self.cfg.proxy_host,
                 port=self.cfg.proxy_port,
                 issuer=issuer,
                 intercept=self.cfg.proxy_mitm_hosts or None,

@@ -207,3 +207,58 @@ def test_upload_server_errors_do_not_poison_swarm(tmp_path):
             except Exception:
                 pass
         s["server"].stop(0)
+
+
+def test_no_content_length_origin_completes(tmp_path):
+    """An origin that never sends Content-Length (the reference's
+    test/tools/no-content-length fixture): metadata reads -1, the
+    back-to-source path falls to the sequential stream, and the full
+    body still lands with pieces recorded."""
+    import socketserver
+    import threading
+    from http.server import BaseHTTPRequestHandler
+
+    payload = os.urandom(PIECE * 3 + 777)
+
+    class NoLength(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.0"  # close-delimited body, no length
+
+        def log_message(self, *a):
+            pass
+
+        def do_HEAD(self):
+            self.send_response(200)
+            self.end_headers()
+
+        def do_GET(self):
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(payload)
+
+    origin = socketserver.ThreadingTCPServer(("127.0.0.1", 0), NoLength)
+    origin_port = origin.server_address[1]
+    threading.Thread(target=origin.serve_forever, daemon=True).start()
+    sched = _scheduler(tmp_path)
+    d = _daemon(tmp_path, "nl", sched["port"])
+    try:
+        out = tmp_path / "nolen.bin"
+        dfget.download(
+            f"127.0.0.1:{d.port}",
+            f"http://127.0.0.1:{origin_port}/blob",
+            str(out),
+        )
+        assert out.read_bytes() == payload
+        # pieces really recorded: the task can serve peers later, and a
+        # Download record with the back-to-source pieces reaches the
+        # scheduler's training sink
+        time.sleep(0.3)  # record sink flushes on peer-finished event
+        records = sched["storage"].list_download()
+        assert records, "no Download record written for unknown-length task"
+        assert any(
+            p.cost_ns >= 0 for r in records for par in r.parents for p in par.pieces
+        ) or records[0].task.content_length == len(payload)
+    finally:
+        d.stop()
+        sched["server"].stop(0)
+        origin.shutdown()
+        origin.server_close()
