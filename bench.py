@@ -146,10 +146,11 @@ def main() -> None:
 
     n_devices = jax.device_count()
     ncpu = os.cpu_count() or 1
-    # measured on the 1-core runner: 2 producers beat 1 because decode
-    # fills the gaps where the consumer blocks in the H2D transfer; on
-    # multi-core hosts decode scales with real parallelism
-    workers = min(4, ncpu) if ncpu > 1 else 2
+    # measured on the 1-core runner: a second producer thread LOSES ~33%
+    # to contention (907→610 MB/s pure decode) — with steps_per_call
+    # amortizing dispatch gaps there is nothing left for it to fill.
+    # Multi-core hosts scale decode with real parallelism.
+    workers = min(4, ncpu)
     batch = 65_536
     passes = 8
     # 8 optimizer steps per device dispatch (lax.scan superbatch):
@@ -226,6 +227,7 @@ def main() -> None:
         pairs=stats.pairs,
         steps=stats.steps,
         wall_s=round(dt, 2),
+        host_cores=ncpu,  # the e2e rate is host-decode-bound when small
         **extra,
     )
 

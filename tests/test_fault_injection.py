@@ -248,15 +248,14 @@ def test_no_content_length_origin_completes(tmp_path):
             str(out),
         )
         assert out.read_bytes() == payload
-        # pieces really recorded: the task can serve peers later, and a
-        # Download record with the back-to-source pieces reaches the
-        # scheduler's training sink
+        # the unknown-length task still produces a full Download record
+        # (training sink) with the discovered length — piece accounting
+        # survived the missing header
         time.sleep(0.3)  # record sink flushes on peer-finished event
         records = sched["storage"].list_download()
         assert records, "no Download record written for unknown-length task"
-        assert any(
-            p.cost_ns >= 0 for r in records for par in r.parents for p in par.pieces
-        ) or records[0].task.content_length == len(payload)
+        assert records[0].state == "Succeeded"
+        assert records[0].task.content_length == len(payload)
     finally:
         d.stop()
         sched["server"].stop(0)
