@@ -125,6 +125,28 @@ CREATE TABLE IF NOT EXISTS settings (
   key TEXT PRIMARY KEY,
   value TEXT NOT NULL
 );
+CREATE TABLE IF NOT EXISTS configs (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  name TEXT UNIQUE NOT NULL,
+  value TEXT NOT NULL DEFAULT '',
+  bio TEXT NOT NULL DEFAULT '',
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS peers (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  host_id TEXT NOT NULL,
+  hostname TEXT NOT NULL DEFAULT '',
+  ip TEXT NOT NULL DEFAULT '',
+  type TEXT NOT NULL DEFAULT 'normal',
+  state TEXT NOT NULL DEFAULT 'active',
+  peer_count INTEGER NOT NULL DEFAULT 0,
+  upload_count INTEGER NOT NULL DEFAULT 0,
+  scheduler_cluster_id INTEGER NOT NULL DEFAULT 0,
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL,
+  UNIQUE(host_id, scheduler_cluster_id)
+);
 CREATE TABLE IF NOT EXISTS oauth (
   id INTEGER PRIMARY KEY AUTOINCREMENT,
   name TEXT UNIQUE NOT NULL,

@@ -83,6 +83,9 @@ class FSObjectStorage:
     def delete_bucket(self, bucket: str) -> None:
         shutil.rmtree(self._path(bucket), ignore_errors=True)
 
+    def list_buckets(self) -> list[str]:
+        return sorted(p.name for p in self.root.iterdir() if p.is_dir())
+
 
 def _s3_error_code(e: "urllib.error.HTTPError") -> str:
     """<Code> from an S3/OSS XML error body ('' when unparsable)."""

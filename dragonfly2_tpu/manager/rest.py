@@ -462,6 +462,133 @@ class RestApi:
             "user": {k: user[k] for k in ("id", "name", "email", "role")},
         }
 
+    # -- peers (reference handlers/peer.go; rows materialized from
+    # sync_peers job results) -------------------------------------------
+    @route("GET", "/api/v1/peers")
+    def list_peers(self, req):
+        q = "SELECT * FROM peers"
+        params: tuple = ()
+        if req["query"].get("scheduler_cluster_id"):
+            q += " WHERE scheduler_cluster_id = ?"
+            params = (int(req["query"]["scheduler_cluster_id"]),)
+        return self.db.query(q + " ORDER BY id", params)
+
+    @route("GET", "/api/v1/peers/:id")
+    def get_peer(self, req):
+        row = self.db.query_one("SELECT * FROM peers WHERE id = ?", (int(req["id"]),))
+        if row is None:
+            raise ApiError(404, "peer not found")
+        return row
+
+    @route("DELETE", "/api/v1/peers/:id", write=True)
+    def delete_peer(self, req):
+        self.db.execute("DELETE FROM peers WHERE id = ?", (int(req["id"]),))
+        return {"deleted": int(req["id"])}
+
+    # -- configs (reference handlers/config.go: named config rows) ------
+    @route("GET", "/api/v1/configs")
+    def list_configs(self, req):
+        return self.db.query("SELECT * FROM configs ORDER BY id")
+
+    @staticmethod
+    def _config_text(v) -> str:
+        # structured values stored as JSON (like cluster config fields),
+        # scalars as plain text — never Python repr
+        return json.dumps(v) if isinstance(v, (dict, list)) else str(v)
+
+    @route("POST", "/api/v1/configs", write=True)
+    def create_config(self, req):
+        body = req["body"]
+        if not body.get("name") or not isinstance(body["name"], str):
+            raise ApiError(400, "name is required")
+        now = time.time()
+        cur = self.db.execute(
+            "INSERT INTO configs (name, value, bio, created_at, updated_at)"
+            " VALUES (?, ?, ?, ?, ?)",
+            (
+                body["name"],
+                self._config_text(body.get("value", "")),
+                self._config_text(body.get("bio", "")),
+                now,
+                now,
+            ),
+        )
+        return self.db.query_one("SELECT * FROM configs WHERE id = ?", (cur.lastrowid,))
+
+    @route("GET", "/api/v1/configs/:id")
+    def get_config(self, req):
+        # numeric path param addresses by id, anything else by name —
+        # never both at once (an id-lookup must not resolve some OTHER
+        # row whose name happens to be that number)
+        ident = req["id"]
+        if ident.isdigit():
+            row = self.db.query_one("SELECT * FROM configs WHERE id = ?", (int(ident),))
+        else:
+            row = self.db.query_one("SELECT * FROM configs WHERE name = ?", (ident,))
+        if row is None:
+            raise ApiError(404, "config not found")
+        return row
+
+    @route("PATCH", "/api/v1/configs/:id", write=True)
+    def update_config(self, req):
+        row = self.get_config(req)
+        body = req["body"]
+        if "name" in body and (not body["name"] or not isinstance(body["name"], str)):
+            raise ApiError(400, "name cannot be empty")
+        updates = {
+            k: self._config_text(body[k]) for k in ("name", "value", "bio") if k in body
+        }
+        if updates:
+            sets = ", ".join(f"{k} = ?" for k in updates)
+            self.db.execute(
+                f"UPDATE configs SET {sets}, updated_at = ? WHERE id = ?",
+                (*updates.values(), time.time(), row["id"]),
+            )
+        return self.db.query_one("SELECT * FROM configs WHERE id = ?", (row["id"],))
+
+    @route("DELETE", "/api/v1/configs/:id", write=True)
+    def delete_config(self, req):
+        row = self.get_config(req)
+        self.db.execute("DELETE FROM configs WHERE id = ?", (row["id"],))
+        return {"deleted": row["id"]}
+
+    # -- buckets (reference handlers/bucket.go over pkg/objectstorage) --
+    @route("GET", "/api/v1/buckets")
+    def list_buckets(self, req):
+        storage = self.models.storage
+        if not hasattr(storage, "list_buckets"):
+            raise ApiError(501, "bucket listing unsupported by this storage driver")
+        return [{"name": b} for b in storage.list_buckets()]
+
+    @route("POST", "/api/v1/buckets", write=True)
+    def create_bucket(self, req):
+        name = req["body"].get("name", "")
+        if not isinstance(name, str) or not name or "/" in name or name.startswith("."):
+            raise ApiError(400, "a bucket needs a plain name")
+        self.models.storage.create_bucket(name)
+        return {"name": name}
+
+    @route("GET", "/api/v1/buckets/:name")
+    def get_bucket(self, req):
+        storage = self.models.storage
+        if hasattr(storage, "list_buckets") and req["name"] not in storage.list_buckets():
+            raise ApiError(404, "bucket not found")
+        try:
+            objects = len(storage.list_objects(req["name"]))
+        except Exception:
+            # drivers without list_buckets (S3/OSS) surface a missing
+            # bucket here — that's a 404, not a server fault
+            raise ApiError(404, "bucket not found")
+        return {"name": req["name"], "objects": objects}
+
+    @route("DELETE", "/api/v1/buckets/:name", write=True)
+    def delete_bucket(self, req):
+        storage = self.models.storage
+        if not hasattr(storage, "delete_bucket"):
+            raise ApiError(501, "bucket deletion unsupported by this storage driver")
+        storage.delete_bucket(req["name"])
+        return {"deleted": req["name"]}
+
     @route("GET", "/api/v1/applications")
     def list_applications(self, req):
         return self.db.query("SELECT * FROM applications ORDER BY id")

@@ -4,6 +4,7 @@ model registry RPCs the trainer and scheduler consume."""
 
 from __future__ import annotations
 
+import json
 import time
 
 import grpc
@@ -277,7 +278,59 @@ class ManagerService:
                 grpc.StatusCode.FAILED_PRECONDITION,
                 f"job {request.id} lease not held by {worker} (state {r['state']})",
             )
+        if r["type"] == "sync_peers" and request.state == "succeeded":
+            self._materialize_peers(r)
         return self._job(r)
+
+    def _materialize_peers(self, job_row) -> None:
+        """sync_peers result → the peers table the REST surface reads
+        (reference manager/models.Peer refreshed by the sync-peers job,
+        handlers/peer.go). Full refresh per cluster: hosts gone from the
+        scheduler's view disappear here too.
+
+        The result is WORKER-SUPPLIED data: every row is validated and
+        coerced BEFORE the old rows are deleted (execute() auto-commits,
+        so a mid-loop crash would otherwise wipe the cluster's peers
+        with no rollback), and a malformed result is logged and skipped
+        — it must never fail the RPC after the job row committed."""
+        try:
+            result = json.loads(job_row["result"] or "{}")
+            if not isinstance(result, dict):
+                raise TypeError(f"result is {type(result).__name__}, not an object")
+            # an empty hosts LIST is a legitimate refresh-to-zero (the
+            # scheduler sees no hosts); a missing/wrong-shape field is not
+            hosts = result.get("hosts")
+            if not isinstance(hosts, list):
+                raise TypeError("result.hosts is not a list")
+            cluster = job_row["scheduler_cluster_id"]
+            now = time.time()
+            rows = [
+                (
+                    str(h.get("id", "")), str(h.get("hostname", "")),
+                    str(h.get("ip", "")), str(h.get("type", "normal")),
+                    int(h.get("peer_count") or 0), int(h.get("upload_count") or 0),
+                    cluster, now, now,
+                )
+                for h in hosts
+                if isinstance(h, dict)
+            ]
+        except (ValueError, TypeError) as e:
+            logger.warning(
+                "sync_peers job %s result unusable, peers table unchanged: %s",
+                job_row["id"], e,
+            )
+            return
+        with self.db.transaction():
+            self.db.execute(
+                "DELETE FROM peers WHERE scheduler_cluster_id = ?", (cluster,)
+            )
+            for row in rows:
+                self.db.execute(
+                    "INSERT OR REPLACE INTO peers (host_id, hostname, ip, type,"
+                    " state, peer_count, upload_count, scheduler_cluster_id,"
+                    " created_at, updated_at) VALUES (?, ?, ?, ?, 'active', ?, ?, ?, ?, ?)",
+                    row,
+                )
 
     @staticmethod
     def _job(r) -> manager_pb2.Job:

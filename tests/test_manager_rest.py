@@ -624,3 +624,159 @@ def _make_provider_status(addr, base, token="admin-tok"):
         },
         token=token,
     )
+
+
+def test_configs_crud(rest):
+    addr = rest["addr"]
+    status, row = call(addr, "POST", "/api/v1/configs", {"name": "gc-ttl", "value": "3600"})
+    assert status == 200 and row["value"] == "3600"
+    status, got = call(addr, "GET", "/api/v1/configs/gc-ttl", token="guest-tok")
+    assert status == 200 and got["id"] == row["id"]
+    status, upd = call(addr, "PATCH", f"/api/v1/configs/{row['id']}", {"value": "60"})
+    assert status == 200 and upd["value"] == "60"
+    status, _ = call(addr, "POST", "/api/v1/configs", {"name": "gc-ttl"})
+    assert status == 409  # UNIQUE name
+    status, _ = call(addr, "DELETE", f"/api/v1/configs/{row['id']}")
+    assert status == 200
+    status, listed = call(addr, "GET", "/api/v1/configs")
+    assert listed == []
+
+
+def test_buckets_crud(rest):
+    addr = rest["addr"]
+    status, listed = call(addr, "GET", "/api/v1/buckets")
+    assert status == 200  # models bucket pre-created by the registry
+    before = {b["name"] for b in listed}
+    status, made = call(addr, "POST", "/api/v1/buckets", {"name": "blobs"})
+    assert status == 200
+    status, got = call(addr, "GET", "/api/v1/buckets/blobs")
+    assert status == 200 and got["objects"] == 0
+    status, listed = call(addr, "GET", "/api/v1/buckets")
+    assert {b["name"] for b in listed} == before | {"blobs"}
+    status, _ = call(addr, "GET", "/api/v1/buckets/nope")
+    assert status == 404
+    status, _ = call(addr, "POST", "/api/v1/buckets", {"name": "../escape"})
+    assert status == 400
+    status, _ = call(addr, "DELETE", "/api/v1/buckets/blobs")
+    assert status == 200
+
+
+def test_peers_materialized_from_sync_peers_job(rest):
+    """sync_peers job result → peers rows the REST surface serves
+    (reference handlers/peer.go backed by the sync-peers job)."""
+    import grpc as _grpc
+
+    from dragonfly2_tpu.rpc import glue
+    import manager_pb2
+
+    service = rest["service"]
+    server, port = glue.serve({"dragonfly2_tpu.manager.Manager": service})
+    try:
+        chan = glue.dial(f"127.0.0.1:{port}")
+        client = glue.ServiceClient(chan, "dragonfly2_tpu.manager.Manager")
+        job = client.CreateJob(
+            manager_pb2.CreateJobRequest(
+                type="sync_peers", args_json="{}", scheduler_cluster_id=1
+            )
+        )
+        leased = client.ListPendingJobs(
+            manager_pb2.ListPendingJobsRequest(
+                ip="10.9.9.9", hostname="sched-w", scheduler_cluster_id=1
+            )
+        )
+        assert [j.id for j in leased.jobs] == [job.id]
+        result = json.dumps(
+            {
+                "hosts": [
+                    {"id": "h-1", "hostname": "a", "ip": "10.0.0.1",
+                     "type": "normal", "peer_count": 3, "upload_count": 7},
+                    {"id": "h-2", "hostname": "b", "ip": "10.0.0.2",
+                     "type": "super", "peer_count": 1, "upload_count": 0},
+                ]
+            }
+        )
+        client.UpdateJobResult(
+            manager_pb2.UpdateJobResultRequest(
+                id=job.id, state="succeeded", result_json=result,
+                ip="10.9.9.9", hostname="sched-w",
+            )
+        )
+        chan.close()
+    finally:
+        server.stop(0)
+
+    status, peers = call(rest["addr"], "GET", "/api/v1/peers?scheduler_cluster_id=1")
+    assert status == 200 and len(peers) == 2
+    by_host = {p["host_id"]: p for p in peers}
+    assert by_host["h-1"]["peer_count"] == 3 and by_host["h-2"]["type"] == "super"
+    status, one = call(rest["addr"], "GET", f"/api/v1/peers/{peers[0]['id']}")
+    assert status == 200 and one["host_id"] in by_host
+    status, _ = call(rest["addr"], "DELETE", f"/api/v1/peers/{peers[0]['id']}")
+    assert status == 200
+    status, remaining = call(rest["addr"], "GET", "/api/v1/peers")
+    assert len(remaining) == 1
+
+
+def test_config_numeric_name_never_shadows_id(rest):
+    addr = rest["addr"]
+    _, a = call(addr, "POST", "/api/v1/configs", {"name": "2", "value": "A"})
+    _, b = call(addr, "POST", "/api/v1/configs", {"name": "gc", "value": "B"})
+    assert a["id"] == 1 and b["id"] == 2
+    # id lookup resolves config B, not config A (whose NAME is "2")
+    status, got = call(addr, "GET", "/api/v1/configs/2")
+    assert got["name"] == "gc"
+    status, _ = call(addr, "DELETE", "/api/v1/configs/2")
+    status, remaining = call(addr, "GET", "/api/v1/configs")
+    assert [r["name"] for r in remaining] == ["2"]
+    # malformed bodies are client errors, not 500s
+    status, _ = call(addr, "POST", "/api/v1/configs", {"name": 7})
+    assert status == 400
+    status, _ = call(addr, "PATCH", "/api/v1/configs/1", {"name": ""})
+    assert status == 400
+    status, _ = call(addr, "POST", "/api/v1/buckets", {"name": 5})
+    assert status == 400
+    # structured config values stored as JSON
+    status, c = call(addr, "POST", "/api/v1/configs", {"name": "j", "value": {"a": 1}})
+    assert status == 200 and json.loads(c["value"]) == {"a": 1}
+
+
+def test_malformed_sync_peers_result_leaves_peers_intact(rest):
+    """A worker-supplied result with bad row shapes must neither wipe
+    the peers table nor fail the RPC (the job row already committed)."""
+    from dragonfly2_tpu.rpc import glue
+    import manager_pb2
+
+    service = rest["service"]
+    server, port = glue.serve({"dragonfly2_tpu.manager.Manager": service})
+    try:
+        chan = glue.dial(f"127.0.0.1:{port}")
+        client = glue.ServiceClient(chan, "dragonfly2_tpu.manager.Manager")
+
+        def run_job(result_json):
+            job = client.CreateJob(manager_pb2.CreateJobRequest(
+                type="sync_peers", args_json="{}", scheduler_cluster_id=1))
+            client.ListPendingJobs(manager_pb2.ListPendingJobsRequest(
+                ip="1.1.1.1", hostname="w", scheduler_cluster_id=1))
+            return client.UpdateJobResult(manager_pb2.UpdateJobResultRequest(
+                id=job.id, state="succeeded", result_json=result_json,
+                ip="1.1.1.1", hostname="w"))
+
+        run_job(json.dumps({"hosts": [{"id": "keep", "peer_count": 1}]}))
+        status, peers = call(rest["addr"], "GET", "/api/v1/peers")
+        assert [p["host_id"] for p in peers] == ["keep"]
+        # null count coerces to 0 — a usable row, refresh applies
+        r = run_job(json.dumps({"hosts": [{"id": "nul", "peer_count": None}]}))
+        assert r.state == "succeeded"
+        status, peers = call(rest["addr"], "GET", "/api/v1/peers")
+        assert [(p["host_id"], p["peer_count"]) for p in peers] == [("nul", 0)]
+        # truly unusable rows (non-numeric count) → logged no-op, RPC succeeds
+        r = run_job(json.dumps({"hosts": [{"id": "bad", "peer_count": "NaNsense"}]}))
+        assert r.state == "succeeded"
+        # list-shaped result (valid JSON, wrong shape) → also a no-op
+        r = run_job("[]")
+        assert r.state == "succeeded"
+        status, peers = call(rest["addr"], "GET", "/api/v1/peers")
+        assert [p["host_id"] for p in peers] == ["nul"]
+        chan.close()
+    finally:
+        server.stop(0)
