@@ -25,18 +25,22 @@ from dragonfly2_tpu.utils import dflog
 
 logger = dflog.get("manager.rest")
 
-#                 (method, pattern, fn, write, auth)
-_ROUTES: list[tuple[str, re.Pattern, str, bool, bool]] = []
+#                 (method, rx, fn, write, auth, raw pattern)
+_ROUTES: list[tuple[str, re.Pattern, str, bool, bool, str]] = []
 
 
 def route(method: str, pattern: str, write: bool = False, auth: bool = True):
     """``auth=False`` marks the route itself unauthenticated (health
     probes, credential-exchange legs) — a per-route flag, not a path
     prefix, so unrelated routes can never inherit the exemption."""
-    rx = re.compile("^" + re.sub(r":(\w+)", r"(?P<\1>[^/]+)", pattern) + "$")
+    # literal segments are escaped: a '.' in a pattern (openapi.json)
+    # must match only itself, never any byte
+    rx = re.compile(
+        "^" + re.sub(r":(\w+)", r"(?P<\1>[^/]+)", re.escape(pattern)) + "$"
+    )
 
     def wrap(fn):
-        _ROUTES.append((method, rx, fn.__name__, write, auth))
+        _ROUTES.append((method, rx, fn.__name__, write, auth, pattern))
         return fn
 
     return wrap
@@ -85,6 +89,62 @@ class RestApi:
         # redirect→callback round-trip survives restarts and works
         # across replicas sharing the database
         self.oauth_state_secret = _auth.state_secret(self.db)
+
+    # -- OpenAPI (reference api/manager/docs.go generated swagger; here
+    # the spec is derived live from the route table, so it can never
+    # drift from the actual surface) -------------------------------------
+    _openapi_cache: dict | None = None  # immutable after import; built once
+
+    @route("GET", "/api/v1/openapi.json", auth=False)
+    def openapi(self, req):
+        if RestApi._openapi_cache is not None:
+            return RestApi._openapi_cache
+        paths: dict = {}
+        for method, rx, fname, write, needs_auth, pattern in _ROUTES:
+            oa_path = re.sub(r":(\w+)", r"{\1}", pattern)
+            params = [
+                {
+                    "name": m.group(1),
+                    "in": "path",
+                    "required": True,
+                    "schema": {"type": "string"},
+                }
+                for m in re.finditer(r":(\w+)", pattern)
+            ]
+            doc = (getattr(type(self), fname).__doc__ or "").strip().split("\n")[0]
+            op = {
+                "operationId": fname,
+                "summary": doc or fname.replace("_", " "),
+                "responses": {"200": {"description": "OK"}},
+            }
+            if params:
+                op["parameters"] = params
+            if needs_auth:
+                op["security"] = [{"bearerAuth": []}]
+                op["responses"]["401"] = {"description": "unauthenticated"}
+            if write:
+                op["responses"]["403"] = {"description": "requires the admin role"}
+            if method in ("POST", "PATCH", "PUT"):
+                op["requestBody"] = {
+                    "content": {"application/json": {"schema": {"type": "object"}}}
+                }
+            paths.setdefault(oa_path, {})[method.lower()] = op
+        RestApi._openapi_cache = {
+            "openapi": "3.0.3",
+            "info": {
+                "title": "dragonfly2_tpu manager API",
+                "version": "1",
+                "description": "Derived from the live route table"
+                " (reference api/manager swagger docs).",
+            },
+            "components": {
+                "securitySchemes": {
+                    "bearerAuth": {"type": "http", "scheme": "bearer"}
+                }
+            },
+            "paths": paths,
+        }
+        return RestApi._openapi_cache
 
     # -- health ----------------------------------------------------------
     @route("GET", "/healthy", auth=False)
@@ -692,7 +752,7 @@ class RestServer:
                     return
                 query = dict(parse_qsl(parts.query))
                 role = role_for(self.headers.get("Authorization"))
-                for method, rx, fname, write, needs_auth in _ROUTES:
+                for method, rx, fname, write, needs_auth, _pattern in _ROUTES:
                     if method != self.command:
                         continue
                     m = rx.match(parts.path)
