@@ -33,7 +33,7 @@ from dragonfly2_tpu.client.piece_manager import (
     PieceResult,
     TRAFFIC_REMOTE_PEER,
 )
-from dragonfly2_tpu.client.pieces import PieceRange, piece_ranges
+from dragonfly2_tpu.client.pieces import PieceRange, parse_byte_range, piece_ranges
 from dragonfly2_tpu.client.storage import StorageManager
 from dragonfly2_tpu.client import metrics as M
 from dragonfly2_tpu.utils import dflog
@@ -285,8 +285,18 @@ class PeerTaskConductor:
             )
         )
         try:
+            # UrlMeta.range (dfget --range): the task IS that slice of
+            # the origin object (the range is baked into the task id, so
+            # P2P parents already hold sliced content; only the origin
+            # fetch needs the offset applied)
+            r_off, r_len = parse_byte_range(self.url_meta.range)
             n = self.pm.download_source(
-                self.ts, self.url, headers=self.headers, on_piece=self._piece_done
+                self.ts,
+                self.url,
+                headers=self.headers,
+                on_piece=self._piece_done,
+                offset=r_off,
+                length=r_len,
             )
         except Exception as e:
             self._fail(f"back-to-source failed: {e}")

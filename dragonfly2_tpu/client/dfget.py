@@ -97,6 +97,7 @@ def download(
     tag: str = "",
     application: str = "",
     digest: str = "",
+    byte_range: str = "",
     disable_back_source: bool = False,
     recursive: bool = False,
     on_progress=None,
@@ -112,7 +113,9 @@ def download(
     req = dfdaemon_pb2.DownloadRequest(
         url=url,
         output=os.path.abspath(output),
-        url_meta=common_pb2.UrlMeta(tag=tag, application=application, digest=digest),
+        url_meta=common_pb2.UrlMeta(
+            tag=tag, application=application, digest=digest, range=byte_range
+        ),
         disable_back_source=disable_back_source,
     )
     for result in client.Download(req):
@@ -155,6 +158,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--tag", default="")
     p.add_argument("--application", default="")
     p.add_argument("--digest", default="")
+    p.add_argument(
+        "--range",
+        default="",
+        dest="byte_range",
+        help='byte range of the origin object, e.g. "0-1023" or "bytes=4096-" '
+        "(inclusive HTTP semantics; the range is part of the task identity)",
+    )
     p.add_argument("--disable-back-source", action="store_true")
     p.add_argument("--recursive", action="store_true")
     # spawn-or-reuse: start a local daemon on --daemon when none answers
@@ -173,6 +183,7 @@ def main(argv: list[str] | None = None) -> int:
     paths = download(
         args.daemon, args.url, args.output,
         tag=args.tag, application=args.application, digest=args.digest,
+        byte_range=args.byte_range,
         disable_back_source=args.disable_back_source,
         recursive=args.recursive, on_progress=progress,
     )

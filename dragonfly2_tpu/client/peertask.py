@@ -68,12 +68,16 @@ class TaskManager:
 
     # ------------------------------------------------------------------
     def task_id_for(self, url: str, url_meta: common_pb2.UrlMeta | None) -> str:
+        from dragonfly2_tpu.client.pieces import normalize_byte_range
+
         meta = None
         if url_meta is not None:
             meta = URLMeta(
                 digest=url_meta.digest,
                 tag=url_meta.tag,
-                range=url_meta.range,
+                # canonicalized: equivalent range spellings share one
+                # task (and malformed specs fail at registration)
+                range=normalize_byte_range(url_meta.range),
                 filter=url_meta.filter,
                 application=url_meta.application,
             )

@@ -134,14 +134,35 @@ class PieceManager:
         url: str,
         headers: dict | None = None,
         on_piece=None,
+        offset: int = 0,
+        length: int = -1,
     ) -> int:
         """Whole-file origin download: ranged concurrent pieces when the
         origin supports Range and the file is big enough, else one
         sequential stream chunked into pieces (reference
-        piece_manager.go:303-373). Returns content length."""
+        piece_manager.go:303-373). Returns content length.
+
+        ``offset``/``length`` select a byte range of the origin object
+        (dfget --range / UrlMeta.range): the task's content IS that
+        slice — pieces number from its start, and the task completes at
+        ``length`` bytes."""
         client = source.client_for(url)
         meta = client.metadata(url, headers)
         content_length = meta.content_length
+        ranged = bool(offset or length >= 0)
+        if ranged:
+            if not meta.support_range:
+                raise ValueError(f"origin does not support ranges: {url}")
+            if content_length < 0:
+                raise ValueError("ranged download needs a known origin length")
+            if offset >= content_length:
+                # HTTP 416 semantics: a start past the end is an error,
+                # never an empty 'completed' task
+                raise ValueError(
+                    f"range start {offset} beyond object end {content_length}"
+                )
+            avail = content_length - offset
+            content_length = min(length, avail) if length >= 0 else avail
 
         if meta.content_type:
             ts.meta.headers["Content-Type"] = meta.content_type
@@ -160,7 +181,19 @@ class PieceManager:
 
             def fetch(pr: PieceRange):
                 t0 = time.monotonic()
-                data = b"".join(client.download(url, headers, pr.offset, pr.length))
+                # piece offsets are slice-relative; the origin fetch adds
+                # the slice's own start
+                data = b"".join(
+                    client.download(url, headers, offset + pr.offset, pr.length)
+                )
+                if len(data) != pr.length:
+                    # an origin that ignores Range (200 + full body) or
+                    # truncates must fail the task, not poison pieces —
+                    # the peer-download path enforces the same invariant
+                    raise ValueError(
+                        f"origin returned {len(data)} bytes for a"
+                        f" {pr.length}-byte ranged piece"
+                    )
                 dt = time.monotonic() - t0
                 if self.shaper is not None and self.shaper.enabled:
                     self.shaper.limiter_for(ts.meta.task_id).acquire(len(data))
@@ -176,35 +209,46 @@ class PieceManager:
             ts.mark_done(content_length)
             return content_length
 
-        # sequential stream → pieces
-        number, offset, buf = 0, 0, b""
+        # sequential stream → pieces (write offsets are slice-relative)
+        number, write_off, buf = 0, 0, b""
         pl = ts.meta.piece_length
         t0 = time.monotonic()
-        for chunk in client.download(url, headers):
+        stream = (
+            client.download(url, headers, offset, content_length)
+            if ranged
+            else client.download(url, headers)
+        )
+        for chunk in stream:
             buf += chunk
             while len(buf) >= pl:
                 piece, buf = buf[:pl], buf[pl:]
                 dt = time.monotonic() - t0
                 pm = ts.write_piece(
-                    number, offset, piece,
+                    number, write_off, piece,
                     traffic_type=TRAFFIC_BACK_TO_SOURCE, cost_ns=int(dt * 1e9),
                 )
                 if on_piece:
                     on_piece(PieceResult(pm.number, pm.offset, pm.length, pm.digest, pm.traffic_type, pm.cost_ns, ""))
                 number += 1
-                offset += len(piece)
+                write_off += len(piece)
                 t0 = time.monotonic()
         if buf or number == 0:
             dt = time.monotonic() - t0
             pm = ts.write_piece(
-                number, offset, buf,
+                number, write_off, buf,
                 traffic_type=TRAFFIC_BACK_TO_SOURCE, cost_ns=int(dt * 1e9),
             )
             if on_piece:
                 on_piece(PieceResult(pm.number, pm.offset, pm.length, pm.digest, pm.traffic_type, pm.cost_ns, ""))
-            offset += len(buf)
-        ts.mark_done(offset)
-        return offset
+            write_off += len(buf)
+        if ranged and write_off != content_length:
+            # over-delivery = origin ignored the Range header; short =
+            # truncated stream — both must fail, not complete wrong
+            raise ValueError(
+                f"ranged origin delivered {write_off} bytes, expected {content_length}"
+            )
+        ts.mark_done(write_off)
+        return write_off
 
 
 @dataclass

@@ -14,6 +14,37 @@ DEFAULT_PIECE_LENGTH = 4 * 1024 * 1024
 MAX_PIECE_COUNT = 2048
 
 
+def parse_byte_range(spec: str) -> tuple[int, int]:
+    """UrlMeta.range → (offset, length); '' → (0, -1) = whole object.
+    Accepts 'lo-hi' (inclusive, HTTP semantics), 'lo-' (to end), and a
+    'bytes=' prefix (reference dfget --range passes HTTP-style specs)."""
+    spec = (spec or "").strip()
+    if not spec:
+        return 0, -1
+    spec = spec.removeprefix("bytes=")
+    lo, sep, hi = spec.partition("-")
+    if not sep or not lo.strip().isdigit() or (hi.strip() and not hi.strip().isdigit()):
+        raise ValueError(f"malformed byte range {spec!r}")
+    start = int(lo)
+    if not hi.strip():
+        return start, -1
+    end = int(hi)
+    if end < start:
+        raise ValueError(f"range end before start: {spec!r}")
+    return start, end - start + 1
+
+
+def normalize_byte_range(spec: str) -> str:
+    """Canonical form for task identity: '0-1023', 'bytes=0-1023', and
+    ' 0-1023' are the SAME slice and must hash to the same task id (the
+    cache would otherwise split per spelling). '' stays ''; malformed
+    specs raise here — at task registration, not deep in back-to-source."""
+    off, ln = parse_byte_range(spec)
+    if not (spec or "").strip():
+        return ""
+    return f"{off}-{off + ln - 1}" if ln >= 0 else f"{off}-"
+
+
 def compute_piece_length(content_length: int) -> int:
     """Default piece size, doubled until piece count ≤ MAX_PIECE_COUNT."""
     if content_length <= 0:

@@ -12,6 +12,9 @@ import os
 
 import pytest
 
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import common_pb2  # noqa: E402
+
 from dragonfly2_tpu.client import dfcache, dfget
 from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
 from dragonfly2_tpu.client.piece_manager import TRAFFIC_BACK_TO_SOURCE, TRAFFIC_REMOTE_PEER
@@ -267,3 +270,83 @@ def test_stream_task_failure_raises(cluster, tmp_path):
             timeout=5,
         )
         b"".join(body)
+
+
+def test_parse_byte_range_forms():
+    from dragonfly2_tpu.client.pieces import parse_byte_range
+
+    assert parse_byte_range("") == (0, -1)
+    assert parse_byte_range("0-1023") == (0, 1024)
+    assert parse_byte_range("bytes=4096-") == (4096, -1)
+    assert parse_byte_range("100-100") == (100, 1)
+    for bad in ("abc", "5", "9-3", "-5-2", "1-x"):
+        with pytest.raises(ValueError):
+            parse_byte_range(bad)
+
+
+def test_ranged_download_end_to_end(cluster):
+    """dfget --range: the slice is the task (reference dfget-range
+    feature gate) — back-to-source fetches only the range, and a second
+    peer gets the same slice over P2P."""
+    url = cluster["url"]
+    tmp = cluster["tmp"]
+    d_a, d_b = cluster["daemons"]
+
+    out_a = tmp / "slice-a.bin"
+    dfget.download(
+        f"127.0.0.1:{d_a.port}", url, str(out_a), byte_range="1000-99999"
+    )
+    assert out_a.read_bytes() == PAYLOAD[1000:100000]
+
+    # same range from daemon B rides P2P (same task id, remote pieces)
+    out_b = tmp / "slice-b.bin"
+    dfget.download(
+        f"127.0.0.1:{d_b.port}", url, str(out_b), byte_range="1000-99999"
+    )
+    assert out_b.read_bytes() == PAYLOAD[1000:100000]
+    tid = d_b.task_manager.task_id_for(
+        url, common_pb2.UrlMeta(range="1000-99999")
+    )
+    ts_b = d_b.storage.find_completed_task(tid)
+    assert ts_b is not None
+    assert TRAFFIC_REMOTE_PEER in {
+        p.traffic_type for p in ts_b.meta.pieces.values()
+    }
+
+    # open-ended range
+    out_c = tmp / "tail.bin"
+    dfget.download(
+        f"127.0.0.1:{d_a.port}", url, str(out_c),
+        byte_range=f"bytes={len(PAYLOAD) - 777}-",
+    )
+    assert out_c.read_bytes() == PAYLOAD[-777:]
+
+    # a DIFFERENT range is a different task (distinct content)
+    out_d = tmp / "other.bin"
+    dfget.download(f"127.0.0.1:{d_a.port}", url, str(out_d), byte_range="0-999")
+    assert out_d.read_bytes() == PAYLOAD[:1000]
+
+
+def test_range_normalization_and_bounds(cluster):
+    """Equivalent range spellings share one task; out-of-bounds ranges
+    fail cleanly (HTTP 416 semantics), never complete empty."""
+    from dragonfly2_tpu.client.pieces import normalize_byte_range
+
+    d_a, _ = cluster["daemons"]
+    tm = d_a.task_manager
+    url = cluster["url"]
+    specs = ("0-1023", "bytes=0-1023", " 0-1023 ")
+    ids = {tm.task_id_for(url, common_pb2.UrlMeta(range=s)) for s in specs}
+    assert len(ids) == 1
+    assert normalize_byte_range("bytes=4096-") == "4096-"
+    assert normalize_byte_range("") == ""
+    with pytest.raises(ValueError):
+        tm.task_id_for(url, common_pb2.UrlMeta(range="9-3"))
+
+    # range starting past EOF fails the download (no empty success)
+    out = cluster["tmp"] / "past-eof.bin"
+    with pytest.raises(Exception):
+        dfget.download(
+            f"127.0.0.1:{d_a.port}", url, str(out),
+            byte_range=f"{len(PAYLOAD) + 10}-",
+        )
