@@ -199,17 +199,33 @@ def main() -> None:
         )
 
         _phase(f"warmup done at {time.perf_counter() - run_t0:.1f}s; timed run starts")
+        profile_dir = os.environ.get("DF_BENCH_PROFILE_DIR", "")
+        if profile_dir:
+            # XLA-side visibility for the timed region (trainer config
+            # exposes the same via profile_dir; Perfetto-compatible)
+            import jax.profiler
+
+            jax.profiler.start_trace(profile_dir)
         t0 = time.perf_counter()
-        _, stats = stream_train_mlp(
-            paths,
-            passes=passes,
-            batch_size=batch,
-            workers=workers,
-            eval_every=0,  # throughput run: every record trains
-            mesh=mesh,
-            time_budget_s=240,
-            steps_per_call=steps_per_call,
-        )
+        try:
+            _, stats = stream_train_mlp(
+                paths,
+                passes=passes,
+                batch_size=batch,
+                workers=workers,
+                eval_every=0,  # throughput run: every record trains
+                mesh=mesh,
+                time_budget_s=240,
+                steps_per_call=steps_per_call,
+            )
+        finally:
+            if profile_dir:
+                # flushed even on a failed run — that's when the trace
+                # is most wanted
+                import jax.profiler
+
+                jax.profiler.stop_trace()
+                _phase(f"profile written to {profile_dir}")
         dt = time.perf_counter() - t0
         _phase(
             f"timed run {dt:.1f}s steps={stats.steps} records={stats.download_records}"

@@ -105,6 +105,10 @@ def download(
     """Download ``url`` to ``output`` through the daemon; returns the
     list of written paths (1 for a file, N for recursive)."""
     if recursive:
+        if byte_range:
+            # a byte range of a directory is meaningless; dropping it
+            # silently would hand back full files the caller didn't ask for
+            raise ValueError("--range cannot be combined with --recursive")
         return _download_recursive(
             daemon_address, url, output, tag=tag, application=application,
             on_progress=on_progress,
@@ -171,6 +175,16 @@ def main(argv: list[str] | None = None) -> int:
     # (reference dfget root.go:279 checkAndSpawnDaemon)
     add_spawn_daemon_args(p)
     args = p.parse_args(argv)
+
+    if args.byte_range:
+        # fail fast with the real message — daemon-side validation would
+        # surface as an opaque gRPC error
+        from dragonfly2_tpu.client.pieces import normalize_byte_range
+
+        try:
+            args.byte_range = normalize_byte_range(args.byte_range)
+        except ValueError as e:
+            p.error(str(e))
 
     if args.spawn_daemon:
         ensure_daemon(args.daemon, args.scheduler, args.daemon_data_dir)

@@ -155,6 +155,10 @@ class PieceManager:
                 raise ValueError(f"origin does not support ranges: {url}")
             if content_length < 0:
                 raise ValueError("ranged download needs a known origin length")
+            if offset < 0:
+                # suffix form (-n = last n bytes): RFC 7233 clamps a
+                # suffix longer than the object to the whole object
+                offset = max(0, content_length + offset)
             if offset >= content_length:
                 # HTTP 416 semantics: a start past the end is an error,
                 # never an empty 'completed' task
@@ -220,6 +224,13 @@ class PieceManager:
         )
         for chunk in stream:
             buf += chunk
+            if ranged and write_off + len(buf) > content_length:
+                # fail the moment the origin over-delivers (Range
+                # ignored) — BEFORE more wrong-content pieces are
+                # written and announced to the scheduler
+                raise ValueError(
+                    f"ranged origin delivered more than {content_length} bytes"
+                )
             while len(buf) >= pl:
                 piece, buf = buf[:pl], buf[pl:]
                 dt = time.monotonic() - t0

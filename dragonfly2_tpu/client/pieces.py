@@ -16,17 +16,27 @@ MAX_PIECE_COUNT = 2048
 
 def parse_byte_range(spec: str) -> tuple[int, int]:
     """UrlMeta.range → (offset, length); '' → (0, -1) = whole object.
-    Accepts 'lo-hi' (inclusive, HTTP semantics), 'lo-' (to end), and a
-    'bytes=' prefix (reference dfget --range passes HTTP-style specs)."""
+    Accepts the RFC 7233 forms 'lo-hi' (inclusive), 'lo-' (to end), and
+    the suffix form '-n' (last n bytes — returned as offset=-n,
+    length=-1; resolved against the object length at fetch time), each
+    with an optional 'bytes=' prefix (reference dfget --range passes
+    HTTP-style specs)."""
     spec = (spec or "").strip()
     if not spec:
         return 0, -1
     spec = spec.removeprefix("bytes=")
     lo, sep, hi = spec.partition("-")
-    if not sep or not lo.strip().isdigit() or (hi.strip() and not hi.strip().isdigit()):
+    lo, hi = lo.strip(), hi.strip()
+    if not sep:
+        raise ValueError(f"malformed byte range {spec!r}")
+    if not lo:
+        if not hi.isdigit() or int(hi) == 0:
+            raise ValueError(f"malformed suffix range {spec!r}")
+        return -int(hi), -1
+    if not lo.isdigit() or (hi and not hi.isdigit()):
         raise ValueError(f"malformed byte range {spec!r}")
     start = int(lo)
-    if not hi.strip():
+    if not hi:
         return start, -1
     end = int(hi)
     if end < start:
@@ -37,11 +47,15 @@ def parse_byte_range(spec: str) -> tuple[int, int]:
 def normalize_byte_range(spec: str) -> str:
     """Canonical form for task identity: '0-1023', 'bytes=0-1023', and
     ' 0-1023' are the SAME slice and must hash to the same task id (the
-    cache would otherwise split per spelling). '' stays ''; malformed
-    specs raise here — at task registration, not deep in back-to-source."""
+    cache would otherwise split per spelling); '0-'/'bytes=0-' IS the
+    whole object and canonicalizes to '' (one task, not a duplicate
+    cache entry). Malformed specs raise here — at task registration,
+    not deep in back-to-source."""
     off, ln = parse_byte_range(spec)
-    if not (spec or "").strip():
-        return ""
+    if off == 0 and ln < 0:
+        return ""  # whole object — identical to the unranged task
+    if off < 0:
+        return f"-{-off}"  # suffix form
     return f"{off}-{off + ln - 1}" if ln >= 0 else f"{off}-"
 
 

@@ -350,3 +350,35 @@ def test_range_normalization_and_bounds(cluster):
             f"127.0.0.1:{d_a.port}", url, str(out),
             byte_range=f"{len(PAYLOAD) + 10}-",
         )
+
+
+def test_suffix_range_and_whole_object_canonicalization(cluster):
+    """RFC 7233 suffix ranges ('-n') work end-to-end, and '0-' IS the
+    unranged task (one cache entry, not two)."""
+    from dragonfly2_tpu.client.pieces import normalize_byte_range
+
+    d_a, _ = cluster["daemons"]
+    url = cluster["url"]
+    tmp = cluster["tmp"]
+
+    out = tmp / "suffix.bin"
+    dfget.download(f"127.0.0.1:{d_a.port}", url, str(out), byte_range="bytes=-512")
+    assert out.read_bytes() == PAYLOAD[-512:]
+
+    tm = d_a.task_manager
+    assert normalize_byte_range("0-") == "" == normalize_byte_range("bytes=0-")
+    assert tm.task_id_for(url, common_pb2.UrlMeta(range="0-")) == tm.task_id_for(url, None)
+    # suffix longer than the object clamps to the whole object (RFC 7233)
+    out2 = tmp / "clamped.bin"
+    dfget.download(
+        f"127.0.0.1:{d_a.port}", url, str(out2),
+        byte_range=f"-{len(PAYLOAD) * 2}",
+    )
+    assert out2.read_bytes() == PAYLOAD
+
+    # recursive + range is rejected up front
+    with pytest.raises(ValueError, match="recursive"):
+        dfget.download(
+            f"127.0.0.1:{d_a.port}", url, str(tmp / "x"),
+            byte_range="0-9", recursive=True,
+        )
