@@ -72,7 +72,7 @@ def _emit(value: float = 0.0, vs_baseline: float = 0.0, error: str = "", **extra
     print(json.dumps(rec), flush=True)
 
 
-def _backend_or_exit(timeout_s: float = 120.0):
+def _backend_or_exit(timeout_s: float = 300.0):
     """Initialize the jax backend under a watchdog: a dead TPU tunnel
     makes device enumeration block forever (the axon plugin dials the
     relay inside make_c_api_client), and a hung bench is worse than an
@@ -131,7 +131,7 @@ def _phase(msg: str) -> None:
 
 def main() -> None:
     _backend_or_exit()
-    # armed after backend init (which has its own 120s watchdog) so the
+    # armed after backend init (which has its own 300s watchdog) so the
     # budget covers only the phases whose internal budgets it must exceed
     # (warmup 150s + timed 240s + synthesis/eval margin)
     finished, run_t0 = _watchdog(float(os.environ.get("DF_BENCH_BUDGET_S", "540")))

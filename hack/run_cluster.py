@@ -235,6 +235,41 @@ def main() -> int:
         assert open(out_b, "rb").read() == payload, "daemon B bytes mismatch"
         print("PASS dfget P2P via daemon B")
 
+        # ranged dfget: the slice is its own task, correct bytes only
+        out_r = os.path.join(work, "out-range.bin")
+        rc = subprocess.run(
+            [
+                sys.executable, "-m", "dragonfly2_tpu.client.dfget",
+                url, "-O", out_r,
+                "--daemon", daemon_addrs[1],
+                "--range", "1000-65999",
+            ],
+            env=env, cwd=REPO, capture_output=True, text=True,
+        )
+        assert rc.returncode == 0, f"ranged dfget failed: {rc.stderr[-2000:]}"
+        assert open(out_r, "rb").read() == payload[1000:66000], "ranged bytes mismatch"
+        print("PASS ranged dfget (--range) via daemon B")
+
+        # stress tool: concurrent load through the daemon RPC, one JSON
+        # line of percentiles (reference test/tools/stress)
+        rc = subprocess.run(
+            [
+                sys.executable, "-m", "dragonfly2_tpu.tools.stress",
+                "--url", url, "--daemon", daemon_addrs[1], "-c", "3", "-n", "9",
+            ],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert rc.returncode == 0, f"stress failed: {rc.stderr[-2000:]}"
+        stress_stats = json.loads(rc.stdout.strip().splitlines()[-1])
+        assert stress_stats["failures"] == 0 and stress_stats["requests"] >= 9, (
+            f"stress run unhealthy: {stress_stats}"
+        )
+        print(
+            "PASS stress load generator"
+            f" (p50 {stress_stats['latency_s']['p50']}s,"
+            f" {stress_stats['throughput_mb_s']} MB/s)"
+        )
+
         # training records landed on the scheduler
         records_dir = os.path.join(work, "scheduler", "records")
         deadline = time.time() + 10
