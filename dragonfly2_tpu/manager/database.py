@@ -87,6 +87,7 @@ CREATE TABLE IF NOT EXISTS jobs (
   result TEXT NOT NULL DEFAULT '{}',
   scheduler_cluster_id INTEGER NOT NULL DEFAULT 0,
   leased_by TEXT NOT NULL DEFAULT '',
+  group_id TEXT NOT NULL DEFAULT '',
   created_at REAL NOT NULL,
   updated_at REAL NOT NULL
 );
@@ -178,6 +179,9 @@ class Database:
         tables)."""
         for table, column, decl in [
             ("models", "updated_at", "REAL NOT NULL DEFAULT 0"),
+            # group jobs: one logical job fanned to N scheduler clusters
+            # (reference manager/job createGroupJob / machinery groups)
+            ("jobs", "group_id", "TEXT NOT NULL DEFAULT ''"),
             # OAuth identity linkage: which provider+subject this user
             # belongs to ('' = local password account). Sign-in matches
             # on these, never on the display name.

@@ -259,23 +259,69 @@ class RestApi:
 
     @route("POST", "/api/v1/jobs", write=True)
     def create_job(self, req):
+        """One cluster → one job row. ``scheduler_cluster_ids`` (a list)
+        fans the job to every named cluster under a shared group id
+        (reference manager/job createGroupJob over machinery groups);
+        the group's aggregate state lives at /jobs/groups/:group_id."""
         body = req["body"]
         jtype = body.get("type")
         if not jtype:
             raise ApiError(400, "type is required")
+        raw_clusters = body.get("scheduler_cluster_ids")
+        grouped = raw_clusters is not None
+        if grouped:
+            if not isinstance(raw_clusters, list) or not raw_clusters:
+                raise ApiError(400, "scheduler_cluster_ids must be a non-empty list")
+        else:
+            raw_clusters = [body.get("scheduler_cluster_id", 0)]
+        # validate EVERY id before the first insert — execute() commits
+        # per statement, so a mid-loop error would leave orphaned queued
+        # jobs the caller can neither track nor cancel
+        try:
+            clusters = [int(c) for c in raw_clusters]
+        except (TypeError, ValueError):
+            raise ApiError(400, f"non-numeric scheduler cluster id in {raw_clusters!r}")
+        import uuid
+
+        # the list form ALWAYS gets a group (a 1-element list is still
+        # the group contract — callers poll /jobs/groups/:group_id)
+        group_id = uuid.uuid4().hex if grouped else ""
         now = time.time()
-        cur = self.db.execute(
-            "INSERT INTO jobs (type, state, args, scheduler_cluster_id,"
-            " created_at, updated_at) VALUES (?, 'queued', ?, ?, ?, ?)",
-            (
-                jtype,
-                json.dumps(body.get("args", {})),
-                int(body.get("scheduler_cluster_id", 0)),
-                now,
-                now,
-            ),
+        rows = []
+        args = json.dumps(body.get("args", {}))
+        for cid in clusters:
+            cur = self.db.execute(
+                "INSERT INTO jobs (type, state, args, scheduler_cluster_id,"
+                " group_id, created_at, updated_at) VALUES (?, 'queued', ?, ?, ?, ?, ?)",
+                (jtype, args, cid, group_id, now, now),
+            )
+            rows.append(
+                self.db.query_one("SELECT * FROM jobs WHERE id = ?", (cur.lastrowid,))
+            )
+        if grouped:
+            return {"group_id": group_id, "state": "queued", "jobs": rows}
+        return rows[0]
+
+    @route("GET", "/api/v1/jobs/groups/:group_id")
+    def get_job_group(self, req):
+        """Aggregate group state (reference machinery group semantics):
+        failed if ANY member failed, succeeded when ALL succeeded,
+        running if any is running, else queued."""
+        rows = self.db.query(
+            "SELECT * FROM jobs WHERE group_id = ? ORDER BY id", (req["group_id"],)
         )
-        return self.db.query_one("SELECT * FROM jobs WHERE id = ?", (cur.lastrowid,))
+        if not rows:
+            raise ApiError(404, "job group not found")
+        states = {r["state"] for r in rows}
+        if "failed" in states:
+            agg = "failed"
+        elif states == {"succeeded"}:
+            agg = "succeeded"
+        elif "running" in states:
+            agg = "running"
+        else:
+            agg = "queued"
+        return {"group_id": req["group_id"], "state": agg, "jobs": rows}
 
     @route("GET", "/api/v1/jobs/:id")
     def get_job(self, req):

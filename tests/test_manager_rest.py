@@ -815,3 +815,93 @@ def test_route_literals_are_escaped(rest):
     not resolve the openapi.json route."""
     status, _ = call(rest["addr"], "GET", "/api/v1/openapiXjson", token=None)
     assert status in (401, 404)
+
+
+def test_group_jobs_fan_out_and_aggregate(rest):
+    """scheduler_cluster_ids fans one job to N clusters under a group id
+    whose state aggregates machinery-style: any failed → failed, all
+    succeeded → succeeded (reference manager/job createGroupJob)."""
+    from dragonfly2_tpu.rpc import glue
+    import manager_pb2
+
+    addr = rest["addr"]
+    status, group = call(
+        addr, "POST", "/api/v1/jobs",
+        {"type": "preheat", "args": {"url": "https://x/y"},
+         "scheduler_cluster_ids": [1, 2]},
+    )
+    assert status == 200 and len(group["jobs"]) == 2 and group["group_id"]
+    gid = group["group_id"]
+    status, agg = call(addr, "GET", f"/api/v1/jobs/groups/{gid}")
+    assert agg["state"] == "queued"
+
+    service = rest["service"]
+    server, port = glue.serve({"dragonfly2_tpu.manager.Manager": service})
+    try:
+        chan = glue.dial(f"127.0.0.1:{port}")
+        client = glue.ServiceClient(chan, "dragonfly2_tpu.manager.Manager")
+
+        def work(cluster, state):
+            leased = client.ListPendingJobs(
+                manager_pb2.ListPendingJobsRequest(
+                    ip="1.1.1.1", hostname=f"w{cluster}",
+                    scheduler_cluster_id=cluster,
+                )
+            )
+            assert len(leased.jobs) == 1
+            client.UpdateJobResult(
+                manager_pb2.UpdateJobResultRequest(
+                    id=leased.jobs[0].id, state=state, result_json="{}",
+                    ip="1.1.1.1", hostname=f"w{cluster}",
+                )
+            )
+
+        work(1, "succeeded")
+        status, agg = call(addr, "GET", f"/api/v1/jobs/groups/{gid}")
+        assert agg["state"] == "queued"  # one member still pending
+        work(2, "succeeded")
+        status, agg = call(addr, "GET", f"/api/v1/jobs/groups/{gid}")
+        assert agg["state"] == "succeeded"
+        chan.close()
+    finally:
+        server.stop(0)
+
+    # single-cluster create keeps the old shape (no group wrapper)
+    status, single = call(
+        addr, "POST", "/api/v1/jobs",
+        {"type": "preheat", "args": {}, "scheduler_cluster_id": 1},
+    )
+    assert status == 200 and "id" in single and single.get("group_id") == ""
+
+    # a failed member fails the whole group
+    status, g2 = call(
+        addr, "POST", "/api/v1/jobs",
+        {"type": "preheat", "args": {}, "scheduler_cluster_ids": [3, 4]},
+    )
+    rest["db"].execute(
+        "UPDATE jobs SET state = 'failed' WHERE id = ?", (g2["jobs"][0]["id"],)
+    )
+    status, agg = call(addr, "GET", f"/api/v1/jobs/groups/{g2['group_id']}")
+    assert agg["state"] == "failed"
+    status, _ = call(addr, "GET", "/api/v1/jobs/groups/nope")
+    assert status == 404
+
+
+def test_group_job_validation_and_single_element_list(rest):
+    addr = rest["addr"]
+    # invalid id anywhere → 400, and NO orphaned rows inserted
+    status, err = call(
+        addr, "POST", "/api/v1/jobs",
+        {"type": "preheat", "scheduler_cluster_ids": [1, "abc"]},
+    )
+    assert status == 400
+    status, jobs = call(addr, "GET", "/api/v1/jobs")
+    assert jobs == []
+    # a 1-element LIST still follows the group contract
+    status, g = call(
+        addr, "POST", "/api/v1/jobs",
+        {"type": "preheat", "scheduler_cluster_ids": [7]},
+    )
+    assert status == 200 and g["group_id"] and len(g["jobs"]) == 1
+    status, agg = call(addr, "GET", f"/api/v1/jobs/groups/{g['group_id']}")
+    assert status == 200 and agg["state"] == "queued"
