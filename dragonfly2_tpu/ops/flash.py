@@ -37,6 +37,7 @@ def _attn_kernel(
     k_ref,
     v_ref,
     o_ref,
+    lse_ref,
     m_scr,
     l_scr,
     acc_scr,
@@ -91,29 +92,20 @@ def _attn_kernel(
         o_ref[0] = (
             acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)[:, None]
         ).astype(o_ref.dtype)
+        # log-sum-exp per query row — the residual the backward pass
+        # rebuilds P from without re-running the online softmax. Rows
+        # with no valid key (padding) keep a -inf-like sentinel.
+        lse_ref[0] = jnp.where(
+            l_scr[:] > 0.0, m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30)), _NEG_INF
+        )
 
 
 def _ceil_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-@functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
-)
-def flash_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    causal: bool = False,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
-    interpret: bool = False,
-) -> jax.Array:
-    """[B, T, H, D] q/k/v → [B, T, H, D]; same contract as
-    ops.ring.local_attention, fused in one Pallas kernel. The sequence is
-    padded up to a common multiple of both block sizes (so no tail key is
-    ever dropped); padded keys are masked to -inf in-kernel and padded
-    query rows are sliced away on return."""
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+    """Pallas forward → (out [B,T,H,D], lse [B,H,T] fp32)."""
     b, t, h, d = q.shape
     scale = 1.0 / (d**0.5)
 
@@ -138,7 +130,7 @@ def flash_attention(
         causal=causal,
         scale=scale,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t_pad // bq, num_kb),
         in_specs=[
@@ -146,8 +138,14 @@ def flash_attention(
             pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t_pad), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq,), jnp.float32),  # running max
             pltpu.VMEM((bq,), jnp.float32),  # running normalizer
@@ -156,5 +154,108 @@ def flash_attention(
         interpret=interpret,
     )(prep(q), prep(k), prep(v))
 
-    out = out[:, :t].reshape(b, h, t, d)
-    return jnp.moveaxis(out, 1, 2)
+    out = jnp.moveaxis(out[:, :t].reshape(b, h, t, d), 1, 2)
+    return out, lse[:, :t].reshape(b, h, t)
+
+
+def _blockwise_bwd(q, k, v, out, lse, do, causal, block_k):
+    """Memory-bounded attention backward: lax.scan over KV tiles, P
+    rebuilt per tile from the saved lse (the standard flash backward),
+    never materializing [T, T]. Plain XLA — the forward's Pallas kernel
+    bought the bandwidth win; the backward's win is O(T·block) memory,
+    which XLA delivers from this formulation directly."""
+    b, t, h, d = q.shape
+    scale = 1.0 / (d**0.5)
+    f32 = jnp.float32
+
+    # [B, H, T, D] layout for the scan
+    def mv(x):
+        return jnp.moveaxis(x, 2, 1).astype(f32)
+
+    qf, kf, vf, of, dof = mv(q), mv(k), mv(v), mv(out), mv(do)
+    bk = min(block_k, _ceil_to(t, 8))
+    t_pad = _ceil_to(t, bk)
+    if t_pad != t:
+        pad = ((0, 0), (0, 0), (0, t_pad - t), (0, 0))
+        kf = jnp.pad(kf, pad)
+        vf = jnp.pad(vf, pad)
+    nkb = t_pad // bk
+
+    delta = (dof * of).sum(-1)  # [B, H, T]
+    q_pos = jnp.arange(t)
+
+    # KV tiles as the scan axis: [nkb, B, H, bk, D]
+    k_tiles = jnp.moveaxis(kf.reshape(b, h, nkb, bk, d), 2, 0)
+    v_tiles = jnp.moveaxis(vf.reshape(b, h, nkb, bk, d), 2, 0)
+
+    def tile(carry, inp):
+        dq_acc, j = carry
+        k_j, v_j = inp
+        k_pos = j * bk + jnp.arange(bk)
+        s = scale * jnp.einsum("bhtd,bhkd->bhtk", qf, k_j)
+        valid = (k_pos < t)[None, None, None, :]
+        if causal:
+            valid = valid & (q_pos[None, None, :, None] >= k_pos[None, None, None, :])
+        p = jnp.where(valid, jnp.exp(s - lse[..., None]), 0.0)  # [B,H,T,bk]
+        dv_j = jnp.einsum("bhtk,bhtd->bhkd", p, dof)
+        dp = jnp.einsum("bhtd,bhkd->bhtk", dof, v_j)
+        ds = p * (dp - delta[..., None])
+        dq_acc = dq_acc + scale * jnp.einsum("bhtk,bhkd->bhtd", ds, k_j)
+        dk_j = scale * jnp.einsum("bhtk,bhtd->bhkd", ds, qf)
+        return (dq_acc, j + 1), (dk_j, dv_j)
+
+    (dq, _), (dk_tiles, dv_tiles) = lax.scan(
+        tile, (jnp.zeros_like(qf), 0), (k_tiles, v_tiles)
+    )
+    dk = jnp.moveaxis(dk_tiles, 0, 2).reshape(b, h, t_pad, d)[:, :, :t]
+    dv = jnp.moveaxis(dv_tiles, 0, 2).reshape(b, h, t_pad, d)[:, :, :t]
+
+    def back(x, like):
+        return jnp.moveaxis(x, 1, 2).astype(like.dtype)
+
+    return back(dq, q), back(dk, k), back(dv, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    return _blockwise_bwd(q, k, v, out, lse, do, causal, block_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    """[B, T, H, D] q/k/v → [B, T, H, D]; same contract as
+    ops.ring.local_attention, fused in one Pallas kernel. The sequence is
+    padded up to a common multiple of both block sizes (so no tail key is
+    ever dropped); padded keys are masked to -inf in-kernel and padded
+    query rows are sliced away on return.
+
+    Differentiable: the VJP rebuilds per-tile softmax weights from the
+    kernel's saved log-sum-exp and scans KV tiles (flash backward) — the
+    [T, T] score matrix materializes in NEITHER direction, so training
+    through this op keeps the O(T·block) memory property the
+    long-context path relies on."""
+    return _flash(q, k, v, causal, block_q, block_k, interpret)

@@ -80,3 +80,64 @@ def test_ulysses_with_pallas_kernel():
     out = fn(*(jax.device_put(x, spec) for x in (q, k, v)))
     want = local_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("t", [64, 70, 256])
+def test_flash_gradients_match_oracle(causal, t):
+    """Training through the fused kernel: VJP (lse-rebuilt flash
+    backward over KV tiles) must match the oracle's gradients, including
+    ragged lengths that exercise the padding path."""
+    b, h, d = 2, 2, 16
+    key = jax.random.PRNGKey(t + int(causal))
+    q, k, v = (
+        jax.random.normal(kk, (b, t, h, d), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+    g = jax.random.normal(jax.random.PRNGKey(9), (b, t, h, d), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, interpret=True) * g)
+
+    def loss_oracle(q, k, v):
+        return jnp.sum(local_attention(q, k, v, causal=causal) * g)
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b_ in zip("qkv", got, want):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=2e-3, rtol=2e-3,
+            err_msg=f"d{name} diverges",
+        )
+
+
+def test_ulysses_pallas_path_trains():
+    """The Ulysses sequence-parallel path with the Pallas kernel is
+    differentiable end-to-end, and its gradients MATCH the non-Pallas
+    Ulysses path's (grad flows through the all-to-alls AND the custom
+    VJP without dropping a scale or swapping dk/dv)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dragonfly2_tpu.ops.ulysses import make_ulysses_attention
+    from dragonfly2_tpu.parallel.mesh import make_mesh
+
+    n = min(4, jax.device_count())
+    mesh = make_mesh(jax.devices()[:n], sp=n)
+    b, t, h, d = 2, 16 * n, max(2, n), 8
+    key = jax.random.PRNGKey(3)
+    q, k, v = (
+        jax.random.normal(kk, (b, t, h, d), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    uly_pl = make_ulysses_attention(mesh, "sp", causal=True, use_pallas=True)
+    uly_xla = make_ulysses_attention(mesh, "sp", causal=True, use_pallas=False)
+
+    got = jax.grad(lambda *a: jnp.sum(uly_pl(*a) ** 2), argnums=(0, 1, 2))(qs, ks, vs)
+    want = jax.grad(lambda *a: jnp.sum(uly_xla(*a) ** 2), argnums=(0, 1, 2))(qs, ks, vs)
+    for name, a, b_ in zip("qkv", got, want):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=2e-3, rtol=2e-3,
+            err_msg=f"d{name} diverges between Pallas and XLA Ulysses paths",
+        )
