@@ -32,29 +32,11 @@ import time
 
 
 def synthesize_dataset(d: str, shards: int, shard_bytes: int) -> list:
-    """Write `shards` CSV files of ~shard_bytes each by replicating a
-    2,000-record synthetic body (per-record decode cost is content-size
-    driven, not uniqueness driven). Returns the shard paths; record
-    counts come from the decoder itself (stats.download_records)."""
-    from dragonfly2_tpu.schema.columnar import write_csv
-    from dragonfly2_tpu.schema.synth import make_download_records
+    """Dataset synthesis lives in the package (schema.synth) so tools
+    can share it; this alias keeps the bench's public surface."""
+    from dragonfly2_tpu.schema.synth import synthesize_dataset_csv
 
-    base = os.path.join(d, "base.csv")
-    write_csv(base, make_download_records(2000, seed=0))
-    with open(base, "rb") as f:
-        data = f.read()
-    nl = data.index(b"\n")
-    header, body = data[: nl + 1], data[nl + 1 :]
-    reps = max(1, shard_bytes // len(body))
-    paths = []
-    for s in range(shards):
-        p = os.path.join(d, f"shard{s}.csv")
-        with open(p, "wb") as f:
-            f.write(header)
-            for _ in range(reps):
-                f.write(body)
-        paths.append(p)
-    return paths
+    return synthesize_dataset_csv(d, shards, shard_bytes)
 
 
 def _emit(value: float = 0.0, vs_baseline: float = 0.0, error: str = "", **extra) -> None:

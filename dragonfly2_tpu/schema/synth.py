@@ -183,3 +183,31 @@ def make_pair_tensors(
     assert w.shape[0] == MLP_FEATURE_DIM
     y = 3.0 + x @ w + 0.5 * np.sin(3.0 * x[:, 0]) * x[:, 4] + noise * rng.standard_normal(n).astype(np.float32)
     return x, y.astype(np.float32)
+
+
+def synthesize_dataset_csv(d: str, shards: int, shard_bytes: int) -> list:
+    """Write ``shards`` download-record CSV files of ~shard_bytes each by
+    replicating a 2,000-record synthetic body (per-record decode cost is
+    content-size driven, not uniqueness driven). Returns the shard
+    paths. Shared by bench.py and tools/soak_ingest.py so both measure
+    the same byte format the scheduler's Train-stream upload produces."""
+    import os
+
+    from dragonfly2_tpu.schema.columnar import write_csv
+
+    base = os.path.join(d, "base.csv")
+    write_csv(base, make_download_records(2000, seed=0))
+    with open(base, "rb") as f:
+        data = f.read()
+    nl = data.index(b"\n")
+    header, body = data[: nl + 1], data[nl + 1 :]
+    reps = max(1, shard_bytes // len(body))
+    paths = []
+    for s in range(shards):
+        p = os.path.join(d, f"shard{s}.csv")
+        with open(p, "wb") as f:
+            f.write(header)
+            for _ in range(reps):
+                f.write(body)
+        paths.append(p)
+    return paths
