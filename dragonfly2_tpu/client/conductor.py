@@ -515,6 +515,26 @@ class PeerTaskConductor:
         self._publish()
 
     def _finish(self, piece_count: int, content_length: int | None = None) -> None:
+        if self.url_meta.digest:
+            # Whole-task integrity gate (UrlMeta.digest): the task never
+            # COMPLETES with content that doesn't hash to the pin —
+            # regardless of which parents/origin fed it. The stream
+            # frontend hands out pieces as they arrive by design, so its
+            # consumers see bytes before this gate; what the gate
+            # guarantees everywhere is that no completed task (reuse
+            # index, parents serving children, dfget success) ever
+            # carries mismatching content.
+            try:
+                self.ts.verify_content_digest(self.url_meta.digest)
+            except Exception as e:
+                # un-complete the stored task: a retry must re-download,
+                # never reuse these bytes
+                try:
+                    self.ts.invalidate()
+                except Exception:  # pragma: no cover - disk error path
+                    pass
+                self._fail(str(e))
+                return
         if getattr(self, "_span", None) is not None:
             self._span.set(piece_count=piece_count).end("ok")
         self._release_shaper()

@@ -161,7 +161,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--daemon", default=os.environ.get("DFDAEMON_ADDR", "127.0.0.1:65000"))
     p.add_argument("--tag", default="")
     p.add_argument("--application", default="")
-    p.add_argument("--digest", default="")
+    p.add_argument(
+        "--digest",
+        default="",
+        help='pin the downloaded content: "sha256:<hex>" or "md5:<hex>";'
+        " verified before success is reported (with --range, the pin"
+        " covers the slice — the task's content)",
+    )
     p.add_argument(
         "--range",
         default="",

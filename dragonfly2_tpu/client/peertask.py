@@ -72,6 +72,13 @@ class TaskManager:
 
         meta = None
         if url_meta is not None:
+            if url_meta.digest:
+                # reject malformed pins at registration — discovering a
+                # bad 'sha1:…' AFTER downloading gigabytes wastes the
+                # whole transfer
+                from dragonfly2_tpu.utils.digest import parse_digest
+
+                parse_digest(url_meta.digest)
             meta = URLMeta(
                 digest=url_meta.digest,
                 tag=url_meta.tag,

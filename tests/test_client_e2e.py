@@ -382,3 +382,45 @@ def test_suffix_range_and_whole_object_canonicalization(cluster):
             f"127.0.0.1:{d_a.port}", url, str(tmp / "x"),
             byte_range="0-9", recursive=True,
         )
+
+
+def test_whole_task_digest_gate(cluster):
+    """UrlMeta.digest: success is only reported when the assembled
+    content hashes to the pinned digest — a wrong pin fails the task
+    (the reference left this check TODO, peertask_conductor.go:607)."""
+    import hashlib
+
+    d_a, _ = cluster["daemons"]
+    url = cluster["url"]
+    tmp = cluster["tmp"]
+
+    good = "sha256:" + hashlib.sha256(PAYLOAD).hexdigest()
+    out = tmp / "pinned.bin"
+    dfget.download(f"127.0.0.1:{d_a.port}", url, str(out), digest=good)
+    assert out.read_bytes() == PAYLOAD
+
+    # uppercase pins match (hex case-insensitive)
+    out_u = tmp / "upper.bin"
+    dfget.download(
+        f"127.0.0.1:{d_a.port}", url, str(out_u),
+        digest="sha256:" + hashlib.sha256(PAYLOAD).hexdigest().upper(),
+    )
+    assert out_u.read_bytes() == PAYLOAD
+
+    bad = "sha256:" + hashlib.sha256(b"not the payload").hexdigest()
+    with pytest.raises(Exception, match="digest"):
+        dfget.download(
+            f"127.0.0.1:{d_a.port}", url, str(tmp / "bad.bin"), digest=bad
+        )
+    # retry with the SAME wrong pin must re-verify, not reuse the
+    # invalidated bytes (the task was un-completed on mismatch)
+    with pytest.raises(Exception, match="digest"):
+        dfget.download(
+            f"127.0.0.1:{d_a.port}", url, str(tmp / "bad2.bin"), digest=bad
+        )
+
+    # malformed pins fail at registration, before any transfer
+    with pytest.raises(Exception, match="[Ii]nvalid digest"):
+        dfget.download(
+            f"127.0.0.1:{d_a.port}", url, str(tmp / "m.bin"), digest="sha1:abcd"
+        )
