@@ -237,7 +237,11 @@ class PeerTaskConductor:
 
             if which == "empty_task":
                 self.ts.meta.piece_length = self.ts.meta.piece_length or 1
-                self.ts.mark_done(0)
+                try:
+                    self.ts.mark_done(0, expected_digest=self.url_meta.digest)
+                except Exception as e:
+                    self._fail(str(e))
+                    return
                 self._finish(piece_count=0)
                 return
             if which == "tiny_task":
@@ -249,7 +253,13 @@ class PeerTaskConductor:
                     cost_ns=int((time.monotonic() - t0) * 1e9),
                 )
                 self._piece_done(PieceResult(pm.number, pm.offset, pm.length, pm.digest, pm.traffic_type, pm.cost_ns, ""))
-                self.ts.mark_done(len(content))
+                try:
+                    self.ts.mark_done(
+                        len(content), expected_digest=self.url_meta.digest
+                    )
+                except Exception as e:
+                    self._fail(str(e))
+                    return
                 self._finish(piece_count=1)
                 return
             if which == "need_back_to_source":
@@ -297,6 +307,7 @@ class PeerTaskConductor:
                 on_piece=self._piece_done,
                 offset=r_off,
                 length=r_len,
+                expected_digest=self.url_meta.digest,
             )
         except Exception as e:
             self._fail(f"back-to-source failed: {e}")
@@ -442,7 +453,13 @@ class PeerTaskConductor:
             synchronizer.stop()
 
         if not failed:
-            self.ts.mark_done(content_length)
+            try:
+                self.ts.mark_done(
+                    content_length, expected_digest=self.url_meta.digest
+                )
+            except Exception as e:
+                self._fail(str(e))
+                return True  # terminal: pinned-content mismatch, not reschedulable
             self._finish(piece_count=len(self.ts.meta.pieces), content_length=content_length)
             return True
 
@@ -515,26 +532,13 @@ class PeerTaskConductor:
         self._publish()
 
     def _finish(self, piece_count: int, content_length: int | None = None) -> None:
-        if self.url_meta.digest:
-            # Whole-task integrity gate (UrlMeta.digest): the task never
-            # COMPLETES with content that doesn't hash to the pin —
-            # regardless of which parents/origin fed it. The stream
-            # frontend hands out pieces as they arrive by design, so its
-            # consumers see bytes before this gate; what the gate
-            # guarantees everywhere is that no completed task (reuse
-            # index, parents serving children, dfget success) ever
-            # carries mismatching content.
-            try:
-                self.ts.verify_content_digest(self.url_meta.digest)
-            except Exception as e:
-                # un-complete the stored task: a retry must re-download,
-                # never reuse these bytes
-                try:
-                    self.ts.invalidate()
-                except Exception:  # pragma: no cover - disk error path
-                    pass
-                self._fail(str(e))
-                return
+        # Whole-task integrity (UrlMeta.digest) is enforced INSIDE
+        # TaskStorage.mark_done before `done` ever flips, so every
+        # completion path races nothing: a reuse lookup can only see a
+        # verified task. The stream frontend hands out pieces as they
+        # arrive by design; its guarantee is that no COMPLETED task
+        # (reuse index, parents serving children, dfget success) ever
+        # carries mismatching content.
         if getattr(self, "_span", None) is not None:
             self._span.set(piece_count=piece_count).end("ok")
         self._release_shaper()

@@ -136,6 +136,7 @@ class PieceManager:
         on_piece=None,
         offset: int = 0,
         length: int = -1,
+        expected_digest: str = "",
     ) -> int:
         """Whole-file origin download: ranged concurrent pieces when the
         origin supports Range and the file is big enough, else one
@@ -210,7 +211,7 @@ class PieceManager:
 
             with ThreadPoolExecutor(max_workers=self.source_concurrency) as pool:
                 list(pool.map(fetch, ranges))
-            ts.mark_done(content_length)
+            ts.mark_done(content_length, expected_digest=expected_digest)
             return content_length
 
         # sequential stream → pieces (write offsets are slice-relative)
@@ -258,7 +259,7 @@ class PieceManager:
             raise ValueError(
                 f"ranged origin delivered {write_off} bytes, expected {content_length}"
             )
-        ts.mark_done(write_off)
+        ts.mark_done(write_off, expected_digest=expected_digest)
         return write_off
 
 

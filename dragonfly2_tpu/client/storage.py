@@ -198,15 +198,17 @@ class TaskStorage:
                 f" want {expected}, got {algorithm}:{h.hexdigest()}"
             )
 
-    def invalidate(self) -> None:
-        """Un-complete a task whose content failed verification: done is
-        cleared and persisted, so the completed-task reuse index can
-        never serve these bytes; the reclaimer collects the remains."""
-        with self.lock:
-            self.meta.done = False
-            self.persist()
 
-    def mark_done(self, content_length: int | None = None) -> None:
+    def mark_done(
+        self, content_length: int | None = None, expected_digest: str = ""
+    ) -> None:
+        """Complete the task. With ``expected_digest`` the content is
+        verified FIRST and ``done`` only ever flips on a match — a
+        concurrent reuse lookup (which requires done) can never observe
+        unverified pinned content, no matter how long the hash takes.
+        On mismatch the stored pieces are purged (a retry must
+        re-download, not re-fail on the same bytes) and StorageError
+        raises."""
         with self.lock:
             if content_length is not None:
                 self.meta.content_length = content_length
@@ -215,6 +217,17 @@ class TaskStorage:
                 # written into a sparse hole)
                 with open(self.data_path, "r+b") as f:
                     f.truncate(self.meta.content_length)
+        if expected_digest:
+            try:
+                self.verify_content_digest(expected_digest)
+            except StorageError:
+                with self.lock:
+                    self.meta.pieces.clear()
+                    self.meta.total_piece_count = 0
+                    open(self.data_path, "wb").close()  # drop the bytes
+                    self.persist()
+                raise
+        with self.lock:
             self.meta.done = True
             self.meta.total_piece_count = len(self.meta.pieces)
             self.persist()
@@ -339,8 +352,14 @@ class StorageManager:
         evicted = 0
         while self.total_bytes() > self.max_bytes:
             with self.lock:
+                now = time.time()
                 candidates = [
-                    t for t in self.tasks.values() if t.meta.done
+                    t
+                    for t in self.tasks.values()
+                    # completed tasks, plus abandoned incomplete ones
+                    # (failed/aborted downloads would otherwise leak
+                    # disk forever — nothing ever completes them)
+                    if t.meta.done or now - t.meta.access_time > 600
                 ]
                 if not candidates:
                     break

@@ -38,6 +38,11 @@ class ManagerServerConfig:
     # read-through DB cache TTL in seconds (reference manager/cache Redis
     # TTLs); 0 disables caching
     db_cache_ttl: float = 30.0
+    # dynamic certificate issuance (IssueCertificate RPC): CA persisted
+    # under data_dir/ca; False = static cert files only. The token gates
+    # who may obtain signed identities ('' = open — dev only)
+    issue_certs: bool = True
+    issue_certs_token: str = ""
     # object storage for model weights: fs (default, under data_dir) or
     # s3 (any S3-compatible endpoint; reference pkg/objectstorage)
     object_storage_driver: str = "fs"
@@ -65,10 +70,36 @@ class ManagerServer:
             region=config.object_storage_region,
         )
         self.models = ModelRegistry(self.db, self.object_storage)
-        self.service = ManagerService(self.db, self.models)
+        self.service = ManagerService(
+            self.db,
+            self.models,
+            ca=self._load_ca(config),
+            ca_token=config.issue_certs_token,
+        )
         self._grpc = None
         self._rest = None
         self.rest_addr: str | None = None
+
+    @staticmethod
+    def _load_ca(config):
+        """The cluster CA behind IssueCertificate, persisted under
+        data_dir/ca so restarts keep issuing from the same root
+        (reference pkg/issuer + securityv1). ``issue_certs=False``
+        disables dynamic issuance entirely."""
+        if not config.issue_certs:
+            return None
+        from dragonfly2_tpu.utils.issuer import CertificateAuthority
+
+        ca_dir = Path(config.data_dir) / "ca"
+        cert_p, key_p = ca_dir / "ca.crt", ca_dir / "ca.key"
+        if cert_p.exists() and key_p.exists():
+            return CertificateAuthority.load(cert_p.read_bytes(), key_p.read_bytes())
+        ca = CertificateAuthority(common_name="dragonfly2-tpu manager CA")
+        ca_dir.mkdir(parents=True, exist_ok=True)
+        cert_p.write_bytes(ca.cert_pem)
+        key_p.write_bytes(ca.key_pem)
+        key_p.chmod(0o600)
+        return ca
 
     def serve(self) -> str:
         from dragonfly2_tpu.manager.service import SERVICE_NAME

@@ -26,13 +26,19 @@ KEEPALIVE_TIMEOUT = 60.0
 
 
 class ManagerService:
-    def __init__(self, db: Database, models: ModelRegistry):
+    def __init__(self, db: Database, models: ModelRegistry, ca=None, ca_token: str = ""):
         from dragonfly2_tpu.manager.searcher import new_searcher
 
         self.db = db
         self.models = models
         self.searcher = new_searcher()  # plugin seam (utils/dfplugin)
         self.default_cluster_id = db.ensure_default_cluster()
+        # utils.issuer.CertificateAuthority for IssueCertificate; None =
+        # dynamic issuance disabled (static cert files only). ca_token:
+        # cluster registration secret required from requesters ('' = open
+        # — dev mode only; production sets one)
+        self.ca = ca
+        self.ca_token = ca_token
 
     # -- scheduler registry ------------------------------------------------
     def UpdateScheduler(self, request, context):
@@ -412,6 +418,38 @@ class ManagerService:
         if row is None:
             context.abort(grpc.StatusCode.NOT_FOUND, f"model {request.model_id} not found")
         return self._model(row)
+
+    # -- certificate issuance (reference securityv1 CertificateService,
+    # pkg/rpc/security/client/client_v1.go:99-117) ----------------------
+    def IssueCertificate(self, request, context):
+        if self.ca is None:
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "dynamic certificate issuance is not enabled on this manager",
+            )
+        if self.ca_token and request.token != self.ca_token:
+            # wrong/missing cluster token: whoever asks gets NOTHING
+            # signed — a CA that signs arbitrary identities for anyone
+            # with network reach hands out cluster-wide impersonation
+            context.abort(
+                grpc.StatusCode.PERMISSION_DENIED,
+                "certificate issuance requires the cluster registration token",
+            )
+        days = int(request.validity_days) or 180
+        if days > 366:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"validity {days}d exceeds the 366d cap",
+            )
+        try:
+            leaf = self.ca.issue_from_csr(request.csr_pem.encode(), validity_days=days)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Exception as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"unparsable CSR: {e}")
+        return manager_pb2.CertificateResponse(
+            certificate_chain=[leaf.decode(), self.ca.cert_pem.decode()]
+        )
 
     @staticmethod
     def _model(row) -> manager_pb2.Model:

@@ -122,6 +122,9 @@ SERVICES: dict[str, dict[str, Method]] = {
         ),
         "ListModels": Method(UNARY, manager_pb2.ListModelsRequest, manager_pb2.ListModelsResponse),
         "UpdateModel": Method(UNARY, manager_pb2.UpdateModelRequest, manager_pb2.Model),
+        "IssueCertificate": Method(
+            UNARY, manager_pb2.CertificateRequest, manager_pb2.CertificateResponse
+        ),
     },
     DFDAEMON_SERVICE: {
         "Download": Method(

@@ -12,6 +12,8 @@ ALGORITHM_SHA256 = "sha256"
 ALGORITHM_MD5 = "md5"
 
 _SUPPORTED = {ALGORITHM_SHA256, ALGORITHM_MD5}
+_HEX_LEN = {ALGORITHM_SHA256: 64, ALGORITHM_MD5: 32}
+_HEX_CHARS = set("0123456789abcdefABCDEF")
 
 
 def sha256_from_strings(*parts: str) -> str:
@@ -38,10 +40,17 @@ def digest_string(algorithm: str, value: str) -> str:
 
 
 def parse_digest(s: str) -> tuple[str, str]:
-    """Parse ``algo:hex`` back into (algorithm, value)."""
+    """Parse ``algo:hex`` back into (algorithm, value). The value must
+    be real hex of the algorithm's digest length — a pin that can never
+    match any content (wrong length, non-hex) is malformed input, and
+    catching it here means BEFORE a transfer is spent on it."""
     algorithm, sep, value = s.partition(":")
     if not sep or algorithm not in _SUPPORTED or not value:
         raise ValueError(f"invalid digest: {s!r}")
+    if len(value) != _HEX_LEN[algorithm] or not set(value) <= _HEX_CHARS:
+        raise ValueError(
+            f"invalid digest: {s!r} (need {_HEX_LEN[algorithm]} hex chars)"
+        )
     return algorithm, value
 
 

@@ -116,12 +116,54 @@ class CertificateAuthority:
         cert = builder.sign(self._key, hashes.SHA256())
         return CertPair(cert.public_bytes(serialization.Encoding.PEM), _key_pem(key))
 
+    def issue_from_csr(self, csr_pem: bytes, validity_days: int = 180) -> bytes:
+        """Sign a client-submitted CSR (reference securityv1
+        IssueCertificate: the private key never leaves the requester).
+        The CSR's own signature is verified first — a request whose
+        proof-of-possession fails must not become a certificate. SANs
+        and subject come from the CSR; CA capability is always denied."""
+        csr = x509.load_pem_x509_csr(csr_pem)
+        if not csr.is_signature_valid:
+            raise ValueError("CSR signature invalid (no proof of key possession)")
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = (
+            x509.CertificateBuilder()
+            .subject_name(csr.subject)
+            .issuer_name(self._cert.subject)
+            .public_key(csr.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - _ONE_DAY)
+            .not_valid_after(now + datetime.timedelta(days=validity_days))
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None), critical=True)
+        )
+        try:
+            san = csr.extensions.get_extension_for_class(x509.SubjectAlternativeName)
+            builder = builder.add_extension(san.value, critical=False)
+        except x509.ExtensionNotFound:
+            pass
+        cert = builder.sign(self._key, hashes.SHA256())
+        return cert.public_bytes(serialization.Encoding.PEM)
+
     @staticmethod
     def load(cert_pem: bytes, key_pem: bytes) -> "CertificateAuthority":
         ca = CertificateAuthority.__new__(CertificateAuthority)
         ca._key = serialization.load_pem_private_key(key_pem, password=None)
         ca._cert = x509.load_pem_x509_certificate(cert_pem)
         return ca
+
+
+def make_csr(common_name: str, hosts: list[str] | None = None) -> tuple[bytes, bytes]:
+    """Client side of dynamic issuance: generate a key + CSR with SANs;
+    → (key_pem, csr_pem). The key stays with the caller — only the CSR
+    travels to the manager."""
+    key = _key()
+    csr = (
+        x509.CertificateSigningRequestBuilder()
+        .subject_name(_name(common_name))
+        .add_extension(_san(hosts or [common_name]), critical=False)
+        .sign(key, hashes.SHA256())
+    )
+    return _key_pem(key), csr.public_bytes(serialization.Encoding.PEM)
 
 
 class SpoofingIssuer:
@@ -156,3 +198,39 @@ class SpoofingIssuer:
                 self._cache[host] = pair
                 self._issuing.pop(host, None)
                 return pair
+
+
+def obtain_certificate(
+    manager_address: str,
+    common_name: str,
+    hosts: list[str] | None = None,
+    validity_days: int = 180,
+    token: str = "",
+    **dial_kwargs,
+) -> tuple[bytes, bytes, bytes]:
+    """Dynamic issuance, client side (reference pkg/rpc/security
+    client): generate a key + CSR locally, submit to the manager's
+    IssueCertificate, → (key_pem, leaf_cert_pem, ca_cert_pem). The
+    private key never leaves this process; the returned triple plugs
+    straight into rpc.glue serve/dial TLS arguments."""
+    from dragonfly2_tpu.rpc import glue
+
+    key_pem, csr_pem = make_csr(common_name, hosts)
+    chan = glue.dial(manager_address, **dial_kwargs)
+    try:
+        import manager_pb2
+
+        client = glue.ServiceClient(chan, glue.MANAGER_SERVICE)
+        resp = client.IssueCertificate(
+            manager_pb2.CertificateRequest(
+                csr_pem=csr_pem.decode(), validity_days=validity_days, token=token
+            )
+        )
+    finally:
+        chan.close()
+    chain = list(resp.certificate_chain)
+    if not chain:
+        raise ValueError("manager returned an empty certificate chain")
+    leaf = chain[0].encode()
+    ca_pem = chain[-1].encode() if len(chain) > 1 else b""
+    return key_pem, leaf, ca_pem

@@ -1,0 +1,119 @@
+"""Dynamic certificate issuance (reference securityv1
+CertificateService / pkg/rpc/security): CSR → manager CA → TLS-usable
+leaf, end to end."""
+
+import grpc
+import pytest
+
+from dragonfly2_tpu.rpc import glue
+import manager_pb2
+
+from dragonfly2_tpu.manager.database import Database
+from dragonfly2_tpu.manager.models_registry import ModelRegistry
+from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+from dragonfly2_tpu.manager.service import SERVICE_NAME, ManagerService
+from dragonfly2_tpu.utils.issuer import (
+    CertificateAuthority,
+    make_csr,
+    obtain_certificate,
+)
+
+
+@pytest.fixture
+def manager(tmp_path):
+    db = Database(tmp_path / "m.db")
+    svc = ManagerService(
+        db,
+        ModelRegistry(db, FSObjectStorage(tmp_path / "o")),
+        ca=CertificateAuthority(common_name="test CA"),
+    )
+    server, port = glue.serve({SERVICE_NAME: svc})
+    yield {"addr": f"127.0.0.1:{port}", "svc": svc}
+    server.stop(0)
+    db.close()
+
+
+def test_csr_roundtrip_and_tls_serve(manager, tmp_path):
+    """obtain_certificate → the returned triple actually terminates a
+    TLS gRPC server that a client verifies against the returned CA."""
+    key_pem, leaf, ca_pem = obtain_certificate(
+        manager["addr"], "scheduler-x", hosts=["localhost", "127.0.0.1"]
+    )
+    assert b"PRIVATE KEY" in key_pem and b"BEGIN CERTIFICATE" in leaf
+
+    # serve a real TLS endpoint with the issued pair
+    db2 = Database(tmp_path / "m2.db")
+    svc2 = ManagerService(db2, ModelRegistry(db2, FSObjectStorage(tmp_path / "o2")))
+    server, port = glue.serve({SERVICE_NAME: svc2}, tls=(key_pem, leaf))
+    try:
+        chan = glue.dial(
+            f"127.0.0.1:{port}", tls_ca=ca_pem, tls_server_name="localhost"
+        )
+        client = glue.ServiceClient(chan, SERVICE_NAME)
+        client.ListSchedulers(manager_pb2.ListSchedulersRequest())
+        chan.close()
+    finally:
+        server.stop(0)
+        db2.close()
+
+
+def test_invalid_csr_and_validity_cap(manager):
+    chan = glue.dial(manager["addr"])
+    client = glue.ServiceClient(chan, SERVICE_NAME)
+    with pytest.raises(grpc.RpcError) as e:
+        client.IssueCertificate(
+            manager_pb2.CertificateRequest(csr_pem="not a csr", validity_days=10)
+        )
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    key, csr = make_csr("x")
+    with pytest.raises(grpc.RpcError) as e:
+        client.IssueCertificate(
+            manager_pb2.CertificateRequest(csr_pem=csr.decode(), validity_days=5000)
+        )
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    chan.close()
+
+
+def test_issuance_disabled_without_ca(tmp_path):
+    db = Database(tmp_path / "m.db")
+    svc = ManagerService(db, ModelRegistry(db, FSObjectStorage(tmp_path / "o")))
+    server, port = glue.serve({SERVICE_NAME: svc})
+    try:
+        chan = glue.dial(f"127.0.0.1:{port}")
+        client = glue.ServiceClient(chan, SERVICE_NAME)
+        _, csr = make_csr("y")
+        with pytest.raises(grpc.RpcError) as e:
+            client.IssueCertificate(
+                manager_pb2.CertificateRequest(csr_pem=csr.decode())
+            )
+        assert e.value.code() == grpc.StatusCode.UNIMPLEMENTED
+        chan.close()
+    finally:
+        server.stop(0)
+        db.close()
+
+
+def test_token_gates_issuance(tmp_path):
+    """A configured cluster token must be presented — a CA signing
+    arbitrary identities for anyone with network reach is cluster-wide
+    impersonation."""
+    db = Database(tmp_path / "m.db")
+    svc = ManagerService(
+        db,
+        ModelRegistry(db, FSObjectStorage(tmp_path / "o")),
+        ca=CertificateAuthority(common_name="gated CA"),
+        ca_token="join-secret",
+    )
+    server, port = glue.serve({SERVICE_NAME: svc})
+    try:
+        addr = f"127.0.0.1:{port}"
+        with pytest.raises(grpc.RpcError) as e:
+            obtain_certificate(addr, "rogue")
+        assert e.value.code() == grpc.StatusCode.PERMISSION_DENIED
+        with pytest.raises(grpc.RpcError):
+            obtain_certificate(addr, "rogue", token="wrong")
+        key, leaf, ca = obtain_certificate(addr, "legit", token="join-secret")
+        assert b"BEGIN CERTIFICATE" in leaf
+    finally:
+        server.stop(0)
+        db.close()
