@@ -108,6 +108,7 @@ class PeerTaskConductor:
             tag=url_meta.tag,
             application=url_meta.application,
         )
+        self.ts.busy = True  # owned by this conductor until finish/fail
         self._requests: "queue.Queue[scheduler_pb2.AnnouncePeerRequest | None]" = queue.Queue()
         self._decisions: "queue.Queue[object]" = queue.Queue()
         self._progress_subs: list["queue.Queue[Progress]"] = []
@@ -237,12 +238,8 @@ class PeerTaskConductor:
 
             if which == "empty_task":
                 self.ts.meta.piece_length = self.ts.meta.piece_length or 1
-                try:
-                    self.ts.mark_done(0, expected_digest=self.url_meta.digest)
-                except Exception as e:
-                    self._fail(str(e))
-                    return
-                self._finish(piece_count=0)
+                if self._complete(0):
+                    self._finish(piece_count=0)
                 return
             if which == "tiny_task":
                 content = body.content
@@ -253,14 +250,8 @@ class PeerTaskConductor:
                     cost_ns=int((time.monotonic() - t0) * 1e9),
                 )
                 self._piece_done(PieceResult(pm.number, pm.offset, pm.length, pm.digest, pm.traffic_type, pm.cost_ns, ""))
-                try:
-                    self.ts.mark_done(
-                        len(content), expected_digest=self.url_meta.digest
-                    )
-                except Exception as e:
-                    self._fail(str(e))
-                    return
-                self._finish(piece_count=1)
+                if self._complete(len(content)):
+                    self._finish(piece_count=1)
                 return
             if which == "need_back_to_source":
                 if self.opts.disable_back_source:
@@ -453,14 +444,10 @@ class PeerTaskConductor:
             synchronizer.stop()
 
         if not failed:
-            try:
-                self.ts.mark_done(
-                    content_length, expected_digest=self.url_meta.digest
-                )
-            except Exception as e:
-                self._fail(str(e))
-                return True  # terminal: pinned-content mismatch, not reschedulable
-            self._finish(piece_count=len(self.ts.meta.pieces), content_length=content_length)
+            # _complete failure is terminal (pinned-content mismatch),
+            # not reschedulable — fresh parents would feed the same task
+            if self._complete(content_length):
+                self._finish(piece_count=len(self.ts.meta.pieces), content_length=content_length)
             return True
 
         # some pieces failed everywhere → reschedule with blocklist;
@@ -531,7 +518,19 @@ class PeerTaskConductor:
         )
         self._publish()
 
+    def _complete(self, content_length: int) -> bool:
+        """mark_done with the digest pin applied; False = verification
+        failed and the task was failed (the one mismatch-handling site
+        for every completion path)."""
+        try:
+            self.ts.mark_done(content_length, expected_digest=self.url_meta.digest)
+        except Exception as e:
+            self._fail(str(e))
+            return False
+        return True
+
     def _finish(self, piece_count: int, content_length: int | None = None) -> None:
+        self.ts.busy = False
         # Whole-task integrity (UrlMeta.digest) is enforced INSIDE
         # TaskStorage.mark_done before `done` ever flips, so every
         # completion path races nothing: a reuse lookup can only see a
@@ -566,6 +565,7 @@ class PeerTaskConductor:
             shaper.release(self.task_id)
 
     def _fail(self, description: str) -> None:
+        self.ts.busy = False
         if getattr(self, "_span", None) is not None:
             self._span.set(error=description).end("error")
         self._release_shaper()

@@ -77,6 +77,9 @@ class TaskStorage:
         self.meta = meta
         self.lock = threading.RLock()
         self._dirty_pieces = 0
+        # a live conductor owns this task (not persisted: after a crash
+        # nothing is live, so orphans become reclaimable)
+        self.busy = False
         os.makedirs(task_dir, exist_ok=True)
         self.data_path = os.path.join(task_dir, "data")
         self.meta_path = os.path.join(task_dir, "metadata.json")
@@ -265,9 +268,12 @@ class StorageManager:
     disk usage crosses the high watermark).
     """
 
-    def __init__(self, data_dir: str, max_bytes: int = 0):
+    def __init__(self, data_dir: str, max_bytes: int = 0, abandoned_ttl: float = 3600.0):
         self.data_dir = data_dir
         self.max_bytes = max_bytes  # 0 = unbounded
+        # incomplete tasks idle this long AND not owned by a live
+        # conductor count as abandoned (crash leftovers)
+        self.abandoned_ttl = abandoned_ttl
         self.tasks: dict[str, TaskStorage] = {}
         self.lock = threading.RLock()
         os.makedirs(data_dir, exist_ok=True)
@@ -356,10 +362,13 @@ class StorageManager:
                 candidates = [
                     t
                     for t in self.tasks.values()
-                    # completed tasks, plus abandoned incomplete ones
-                    # (failed/aborted downloads would otherwise leak
-                    # disk forever — nothing ever completes them)
-                    if t.meta.done or now - t.meta.access_time > 600
+                    # completed tasks, plus ABANDONED incomplete ones
+                    # (crash leftovers would otherwise leak disk
+                    # forever). A live conductor's task is never a
+                    # candidate no matter how slowly its origin
+                    # trickles — busy says someone owns it.
+                    if t.meta.done
+                    or (not t.busy and now - t.meta.access_time > self.abandoned_ttl)
                 ]
                 if not candidates:
                     break

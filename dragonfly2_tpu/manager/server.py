@@ -97,8 +97,14 @@ class ManagerServer:
         ca = CertificateAuthority(common_name="dragonfly2-tpu manager CA")
         ca_dir.mkdir(parents=True, exist_ok=True)
         cert_p.write_bytes(ca.cert_pem)
-        key_p.write_bytes(ca.key_pem)
-        key_p.chmod(0o600)
+        # the key file is born 0600 — a chmod-after-write leaves a window
+        # where any local user can open (and keep) a readable fd to the
+        # cluster root key
+        import os as _os
+
+        fd = _os.open(str(key_p), _os.O_WRONLY | _os.O_CREAT | _os.O_EXCL, 0o600)
+        with _os.fdopen(fd, "wb") as f:
+            f.write(ca.key_pem)
         return ca
 
     def serve(self) -> str:

@@ -427,7 +427,9 @@ class ManagerService:
                 grpc.StatusCode.UNIMPLEMENTED,
                 "dynamic certificate issuance is not enabled on this manager",
             )
-        if self.ca_token and request.token != self.ca_token:
+        import hmac as _hmac
+
+        if self.ca_token and not _hmac.compare_digest(request.token, self.ca_token):
             # wrong/missing cluster token: whoever asks gets NOTHING
             # signed — a CA that signs arbitrary identities for anyone
             # with network reach hands out cluster-wide impersonation

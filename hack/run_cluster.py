@@ -359,6 +359,18 @@ def main() -> int:
         assert any(r["hostname"] == "host-b" for r in rows), rows
         print("PASS manager-fed discovery + seed-peer registration")
 
+        # dynamic certificate issuance: CSR → booted manager's CA →
+        # chain that verifies against the persisted root
+        from dragonfly2_tpu.utils.issuer import obtain_certificate
+
+        key_pem, leaf_pem, ca_pem = obtain_certificate(
+            manager_addr, "e2e-service", hosts=["localhost", "127.0.0.1"]
+        )
+        assert b"BEGIN CERTIFICATE" in leaf_pem and b"BEGIN CERTIFICATE" in ca_pem
+        on_disk_ca = open(os.path.join(work, "manager", "ca", "ca.crt"), "rb").read()
+        assert ca_pem == on_disk_ca, "returned chain root must be the persisted CA"
+        print("PASS dynamic certificate issuance (CSR → manager CA)")
+
         print("CLUSTER E2E: ALL PASS")
         return 0
     finally:

@@ -239,3 +239,26 @@ def test_upload_server_rate_limit(tmp_path):
         assert elapsed >= 0.5, f"rate limit had no effect ({elapsed:.2f}s)"
     finally:
         slow.stop()
+
+
+def test_reclaimer_never_evicts_busy_incomplete_tasks(tmp_path):
+    """A live conductor's incomplete task is never an eviction candidate
+    no matter how stale its access time; abandoned (crash-leftover)
+    incomplete tasks past the TTL are."""
+    import time as _time
+
+    from dragonfly2_tpu.client.storage import StorageManager
+
+    sm = StorageManager(str(tmp_path / "s"), max_bytes=1, abandoned_ttl=100.0)
+    live = sm.register_task("t-live", "p1", url="u", piece_length=4, content_length=8)
+    live.busy = True
+    live.write_piece(0, 0, b"aaaa")
+    dead = sm.register_task("t-dead", "p2", url="u", piece_length=4, content_length=8)
+    dead.write_piece(0, 0, b"bbbb")
+    old = _time.time() - 1000
+    live.meta.access_time = old
+    dead.meta.access_time = old
+
+    evicted = sm.reclaim()
+    assert evicted == 1
+    assert "t-live" in sm.tasks and "t-dead" not in sm.tasks
