@@ -164,3 +164,132 @@ def test_concurrent_host_announce_and_leave():
     for t in threads:
         t.join(timeout=10)
     assert not errors, errors[:3]
+
+
+def test_manager_rest_surfaces_under_concurrent_load(tmp_path):
+    """Hammer the newest manager surfaces from many threads at once:
+    config CRUD, group-job creation + leasing, and certificate issuance
+    must produce no 500s and a consistent end state (sqlite behind one
+    process-wide connection — exactly where races would hide)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from dragonfly2_tpu.manager.database import Database
+    from dragonfly2_tpu.manager.models_registry import ModelRegistry
+    from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+    from dragonfly2_tpu.manager.rest import RestServer
+    from dragonfly2_tpu.manager.service import SERVICE_NAME, ManagerService
+    from dragonfly2_tpu.rpc import glue
+    from dragonfly2_tpu.utils.issuer import CertificateAuthority, obtain_certificate
+    import manager_pb2
+
+    db = Database(tmp_path / "m.db")
+    svc = ManagerService(
+        db, ModelRegistry(db, FSObjectStorage(tmp_path / "o")),
+        ca=CertificateAuthority(common_name="load CA"),
+    )
+    rest = RestServer(svc, tokens={"tok": "admin"})
+    addr = rest.start()
+    gsrv, gport = glue.serve({SERVICE_NAME: svc})
+    errors: list[str] = []
+
+    def call(method, path, body=None):
+        req = urllib.request.Request(
+            f"http://{addr}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Authorization": "Bearer tok"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def guarded(label, fn):
+        # ANY worker exception must land in `errors`, not silently kill
+        # the thread and surface later as a bare count mismatch
+        def runner(i):
+            try:
+                fn(i)
+            except Exception as e:
+                errors.append(f"{label}: {type(e).__name__}: {e}")
+        return runner
+
+    N = 6
+
+    def config_worker(i):
+        for j in range(8):
+            st, _ = call("POST", "/api/v1/configs", {"name": f"c-{i}-{j}", "value": str(j)})
+            if st >= 500:
+                errors.append(f"config POST {st}")
+            st, _ = call("GET", "/api/v1/configs")
+            if st >= 500:
+                errors.append(f"config GET {st}")
+
+    def group_worker(i):
+        for j in range(4):
+            st, g = call(
+                "POST", "/api/v1/jobs",
+                {"type": "sync_peers", "scheduler_cluster_ids": [1, 2]},
+            )
+            if st != 200:
+                errors.append(f"group POST {st}")
+                continue
+            st, _ = call("GET", f"/api/v1/jobs/groups/{g['group_id']}")
+            if st >= 500:
+                errors.append(f"group GET {st}")
+
+    def lease_worker(i):
+        chan = glue.dial(f"127.0.0.1:{gport}")
+        client = glue.ServiceClient(chan, SERVICE_NAME)
+        for j in range(6):
+            try:
+                leased = client.ListPendingJobs(
+                    manager_pb2.ListPendingJobsRequest(
+                        ip=f"10.0.0.{i}", hostname=f"w{i}", scheduler_cluster_id=1 + (j % 2)
+                    )
+                )
+                for job in leased.jobs:
+                    client.UpdateJobResult(
+                        manager_pb2.UpdateJobResultRequest(
+                            id=job.id, state="succeeded",
+                            result_json=json.dumps({"hosts": []}),
+                            ip=f"10.0.0.{i}", hostname=f"w{i}",
+                        )
+                    )
+            except Exception as e:
+                errors.append(f"lease: {e}")
+        chan.close()
+
+    def cert_worker(i):
+        for j in range(3):
+            try:
+                _, leaf, _ = obtain_certificate(f"127.0.0.1:{gport}", f"svc-{i}-{j}")
+                assert b"BEGIN CERTIFICATE" in leaf
+            except Exception as e:
+                errors.append(f"cert: {e}")
+
+    threads = []
+    for i in range(N):
+        for fn in (config_worker, group_worker, lease_worker, cert_worker):
+            threads.append(
+                threading.Thread(target=guarded(fn.__name__, fn), args=(i,), daemon=True)
+            )
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(180)
+    try:
+        hung = [t.name for t in threads if t.is_alive()]
+        assert not hung, f"workers still running at the count asserts: {hung}"
+        assert not errors, errors[:10]
+        st, configs = call("GET", "/api/v1/configs")
+        assert st == 200 and len(configs) == N * 8
+        # every group eventually readable and internally consistent
+        rows = db.query("SELECT DISTINCT group_id FROM jobs WHERE group_id != ''")
+        assert len(rows) == N * 4
+    finally:
+        gsrv.stop(0)
+        rest.stop()
+        db.close()
