@@ -273,9 +273,21 @@ class Daemon:
                     raise RuntimeError(
                         f"another daemon is serving {sock}; refusing to unbind it"
                     )
-                except (ConnectionRefusedError, FileNotFoundError, socket.timeout, OSError):
+                except socket.timeout:
+                    # a connect TIMEOUT is a live-but-stalled daemon (GC
+                    # pause, loaded host) — unbinding it would orphan a
+                    # healthy server on a deleted inode
                     probe.close()
-                    sock.unlink()  # stale socket from an unclean shutdown
+                    raise RuntimeError(
+                        f"a daemon appears to be serving {sock} (slow to"
+                        " accept); refusing to unbind it"
+                    )
+                except (ConnectionRefusedError, FileNotFoundError, OSError):
+                    probe.close()
+                    try:
+                        sock.unlink()  # stale socket from an unclean shutdown
+                    except FileNotFoundError:
+                        pass  # raced: its owner already removed it
             extra.append(f"unix:{sock}")
         self._server, self.port = glue.serve(
             {DFDAEMON_SERVICE: service}, address=self.cfg.listen, extra_addresses=extra

@@ -67,10 +67,20 @@ def ensure_daemon(
         if daemon_alive(daemon_address, timeout=0.5):
             return True
         if proc.poll() is not None:
-            raise RuntimeError(
-                f"spawned daemon exited with rc={proc.returncode} before serving"
-            )
+            # OUR spawn exiting is not fatal by itself: in a concurrent
+            # spawn race the loser exits ("another daemon is serving")
+            # while the winner is still starting — keep probing until
+            # the deadline and only then conclude nothing is serving
+            time.sleep(0.2)
+            continue
         time.sleep(0.2)
+    if daemon_alive(daemon_address, timeout=1.0):
+        return True
+    if proc.poll() is not None:
+        raise RuntimeError(
+            f"spawned daemon exited with rc={proc.returncode} and nothing"
+            f" is serving {daemon_address}"
+        )
     raise TimeoutError(f"spawned daemon not ready on {daemon_address} within {wait}s")
 
 
@@ -109,6 +119,10 @@ def download(
             # a byte range of a directory is meaningless; dropping it
             # silently would hand back full files the caller didn't ask for
             raise ValueError("--range cannot be combined with --recursive")
+        if digest:
+            # one digest cannot pin N different files — silently skipping
+            # verification would betray exactly the caller who asked for it
+            raise ValueError("--digest cannot be combined with --recursive")
         return _download_recursive(
             daemon_address, url, output, tag=tag, application=application,
             on_progress=on_progress,

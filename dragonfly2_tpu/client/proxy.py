@@ -46,6 +46,30 @@ _HOP_HEADERS = {
 }
 
 
+def _read_chunked_body(rfile, max_bytes: int = 1 << 30) -> bytes:
+    """Decode an RFC 7230 chunked request body from ``rfile``; consuming
+    it fully also keeps the keep-alive connection in sync."""
+    out = []
+    total = 0
+    while True:
+        size_line = rfile.readline(1024).strip()
+        size = int(size_line.split(b";", 1)[0], 16)  # chunk-ext ignored
+        if size == 0:
+            # trailer section (if any) ends at the blank line
+            while rfile.readline(1024).strip():
+                pass
+            break
+        total += size
+        if total > max_bytes:
+            raise ValueError("chunked body exceeds the forwarding cap")
+        chunk = rfile.read(size)
+        if len(chunk) != size:
+            raise ValueError("truncated chunk in request body")
+        rfile.read(2)  # trailing CRLF
+        out.append(chunk)
+    return b"".join(out)
+
+
 @dataclass
 class RegistryMirror:
     """Resolves mirror-relative request paths onto a mirror remote
@@ -281,10 +305,20 @@ class ProxyServer:
 
         from dragonfly2_tpu.client.source import open_url
 
-        length = int(handler.headers.get("Content-Length") or 0)
-        body = handler.rfile.read(length) if length else None
+        te = (handler.headers.get("Transfer-Encoding") or "").lower()
+        if "chunked" in te:
+            # registry pushes (docker PATCH/POST blob uploads) send
+            # chunked bodies: decode them here — forwarding body=None
+            # would corrupt the upload AND leave the unread chunks in
+            # rfile to desync the next keep-alive request
+            body = _read_chunked_body(handler.rfile)
+        else:
+            length = int(handler.headers.get("Content-Length") or 0)
+            body = handler.rfile.read(length) if length else None
         headers = {
-            k: v for k, v in handler.headers.items() if k.lower() not in _HOP_HEADERS
+            k: v
+            for k, v in handler.headers.items()
+            if k.lower() not in _HOP_HEADERS and k.lower() != "transfer-encoding"
         }
         req = urllib.request.Request(
             f"https://{origin}{handler.path}",

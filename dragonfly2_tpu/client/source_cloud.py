@@ -303,12 +303,7 @@ class HDFSSourceClient(SourceClient):
         return out
 
 
-_OCI_MANIFEST_ACCEPT = ", ".join(
-    (
-        "application/vnd.oci.image.manifest.v1+json",
-        "application/vnd.docker.distribution.manifest.v2+json",
-    )
-)
+from dragonfly2_tpu.utils.oci import MANIFEST_ACCEPT as _OCI_MANIFEST_ACCEPT
 
 
 class ORASSourceClient(SourceClient):

@@ -278,7 +278,12 @@ class RestApi:
         # per statement, so a mid-loop error would leave orphaned queued
         # jobs the caller can neither track nor cancel
         try:
-            clusters = [int(c) for c in raw_clusters]
+            # id 0 = unspecified → the default cluster (matching gRPC
+            # CreateJob); a literal 0 would dead-letter the job — no
+            # worker ever leases cluster 0
+            clusters = [
+                int(c) or self.service.default_cluster_id for c in raw_clusters
+            ]
         except (TypeError, ValueError):
             raise ApiError(400, f"non-numeric scheduler cluster id in {raw_clusters!r}")
         import uuid
@@ -431,10 +436,12 @@ class RestApi:
         )
         if user is None:
             raise ApiError(401, "bad credentials")
-        token, _ = auth.create_pat(
-            self.db, user["id"], "session",
-            ttl=_ttl_of(body, default=24 * 3600.0),
-        )
+        # session TTLs are CAPPED: ttl=0 on the unauthenticated signin
+        # route must not mint an immortal credential (never-expiring
+        # tokens stay exclusive to the admin-gated PAT route)
+        ttl = _ttl_of(body, default=24 * 3600.0)
+        ttl = min(ttl or 24 * 3600.0, 30 * 24 * 3600.0)
+        token, _ = auth.create_pat(self.db, user["id"], "session", ttl=ttl)
         return {"token": token, "role": user["role"]}
 
     @route("GET", "/api/v1/users/:id/personal-access-tokens")
@@ -477,9 +484,13 @@ class RestApi:
                      "auth_url", "scopes", "created_at", "updated_at")
 
     def _oauth_row(self, ident: str) -> dict:
-        row = self.db.query_one(
-            "SELECT * FROM oauth WHERE id = ? OR name = ?", (ident, ident)
-        )
+        # numeric → by id only; else by name — a provider NAMED like
+        # another provider's id must never be resolved (or deleted) in
+        # its place (same rule as get_config)
+        if ident.isdigit():
+            row = self.db.query_one("SELECT * FROM oauth WHERE id = ?", (int(ident),))
+        else:
+            row = self.db.query_one("SELECT * FROM oauth WHERE name = ?", (ident,))
         if row is None:
             raise ApiError(404, f"no oauth provider {ident!r}")
         return row

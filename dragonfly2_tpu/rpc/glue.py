@@ -454,7 +454,14 @@ class SchedulerSelector:
     A scheduler that cannot be dialed is skipped until the next use.
     """
 
-    FAIL_COOLDOWN = 5.0  # seconds before re-dialing a failed address
+    # Longer than the default announce interval (30s): a known-dead
+    # scheduler is skipped for whole announce rounds instead of paying a
+    # fresh serial connect timeout per round, which would delay
+    # announcements to the healthy members.
+    FAIL_COOLDOWN = 60.0
+    # dead-address probes use a short ready wait; established channels
+    # are cached, so this only bounds how long a DOWN scheduler stalls us
+    DIAL_READY_TIMEOUT = 2.0
 
     def __init__(
         self,
@@ -484,7 +491,8 @@ class SchedulerSelector:
         # dial OUTSIDE the lock — a dead scheduler's connect timeout must
         # not stall task routing to healthy, already-cached schedulers
         try:
-            channel = dial(addr, retries=1, **self.dial_kwargs)
+            kw = {"ready_timeout": self.DIAL_READY_TIMEOUT, **self.dial_kwargs}
+            channel = dial(addr, retries=1, **kw)
         except Exception:
             with self._lock:
                 self._fail_until[addr] = time.monotonic() + self.FAIL_COOLDOWN

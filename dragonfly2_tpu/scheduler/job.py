@@ -228,18 +228,9 @@ class JobWorker:
 # Image manifest resolution (reference manager/job/preheat.go:126-165)
 # ---------------------------------------------------------------------------
 
-MANIFEST_ACCEPT = ", ".join(
-    [
-        "application/vnd.docker.distribution.manifest.v2+json",
-        "application/vnd.oci.image.manifest.v1+json",
-        "application/vnd.docker.distribution.manifest.list.v2+json",
-        "application/vnd.oci.image.index.v1+json",
-    ]
-)
-
-_INDEX_TYPES = (
-    "application/vnd.docker.distribution.manifest.list.v2+json",
-    "application/vnd.oci.image.index.v1+json",
+from dragonfly2_tpu.utils.oci import (  # noqa: E402 — one home for the
+    INDEX_TYPES as _INDEX_TYPES,  # registry dialect, shared with the oras client
+    MANIFEST_OR_INDEX_ACCEPT as MANIFEST_ACCEPT,
 )
 
 

@@ -273,9 +273,13 @@ class Training:
             steps_per_call=self.config.streaming_steps_per_call,
             time_budget_s=self.config.streaming_time_budget_s,
         )
-        # rows counted once per pass — gate on a single pass's worth
+        # rows counted once per pass — gate on a single pass's worth.
+        # A time-budget truncation may have stopped mid-pass; dividing
+        # by the CONFIGURED pass count would then undercount what was
+        # actually seen and fail a legitimately-trained fit, and the
+        # pre-gate above already enforced the minimum on real rows.
         rows = stats.download_records // max(self.config.streaming_passes, 1)
-        if rows < self.config.min_download_records:
+        if rows < self.config.min_download_records and not stats.truncated:
             raise ValueError(
                 f"{rows} download records for host {host_id}"
                 f" < min {self.config.min_download_records}"

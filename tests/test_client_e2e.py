@@ -424,3 +424,12 @@ def test_whole_task_digest_gate(cluster):
         dfget.download(
             f"127.0.0.1:{d_a.port}", url, str(tmp / "m.bin"), digest="sha1:abcd"
         )
+
+
+def test_recursive_rejects_digest_pin(cluster):
+    d_a, _ = cluster["daemons"]
+    with pytest.raises(ValueError, match="digest.*recursive"):
+        dfget.download(
+            f"127.0.0.1:{d_a.port}", cluster["url"], "/tmp/x",
+            digest="sha256:" + "0" * 64, recursive=True,
+        )

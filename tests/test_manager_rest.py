@@ -905,3 +905,41 @@ def test_group_job_validation_and_single_element_list(rest):
     assert status == 200 and g["group_id"] and len(g["jobs"]) == 1
     status, agg = call(addr, "GET", f"/api/v1/jobs/groups/{g['group_id']}")
     assert status == 200 and agg["state"] == "queued"
+
+
+def test_rest_job_defaults_to_default_cluster(rest):
+    """A REST-created job without scheduler_cluster_id must land in the
+    DEFAULT cluster, not dead-letter in cluster 0 no worker leases."""
+    status, job = call(rest["addr"], "POST", "/api/v1/jobs", {"type": "preheat"})
+    assert status == 200
+    assert job["scheduler_cluster_id"] == rest["service"].default_cluster_id != 0
+
+
+def test_signin_ttl_zero_is_capped(rest):
+    from dragonfly2_tpu.manager import auth
+
+    auth.create_user(rest["db"], "sess", "pw12345", role="guest")
+    status, out = call(
+        rest["addr"], "POST", "/api/v1/users/signin",
+        {"name": "sess", "password": "pw12345", "ttl": 0}, token=None,
+    )
+    assert status == 200
+    row = rest["db"].query_one(
+        "SELECT expires_at FROM personal_access_tokens ORDER BY id DESC LIMIT 1"
+    )
+    assert row["expires_at"] > 0  # never-expiring stays admin-route-only
+
+
+def test_oauth_numeric_name_never_shadows_provider_id(rest, fake_idp):
+    a = _make_provider(addr := rest["addr"], fake_idp["base"])
+    status, b = call(
+        addr, "POST", "/api/v1/oauth",
+        {"name": str(a["id"]), "client_id": "x", "client_secret": "y",
+         "auth_url": "http://a", "token_url": "http://t", "userinfo_url": "http://u"},
+    )
+    assert status == 200
+    status, got = call(addr, "GET", f"/api/v1/oauth/{a['id']}")
+    assert got["name"] == a["name"]  # id lookup resolves A, never B
+    status, _ = call(addr, "DELETE", f"/api/v1/oauth/{a['id']}")
+    status, listed = call(addr, "GET", "/api/v1/oauth")
+    assert [r["name"] for r in listed] == [str(a["id"])]

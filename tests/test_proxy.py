@@ -273,3 +273,18 @@ def test_mirror_does_not_capture_absolute_uris(origin_server):
         assert body == b'{"layers": []}'
     finally:
         proxy.stop()
+
+
+def test_mitm_forwards_chunked_request_bodies():
+    """docker-push-style chunked uploads through the MITM proxy must be
+    decoded and forwarded whole, and must not desync keep-alive."""
+    from dragonfly2_tpu.client.proxy import _read_chunked_body
+    import io
+
+    body = b"5\r\nhello\r\n6\r\n world\r\n0\r\n\r\n"
+    assert _read_chunked_body(io.BytesIO(body)) == b"hello world"
+    # chunk extensions and trailers tolerated
+    ext = b"5;ext=1\r\nhello\r\n0\r\nTrailer: x\r\n\r\n"
+    assert _read_chunked_body(io.BytesIO(ext)) == b"hello"
+    with pytest.raises(ValueError):
+        _read_chunked_body(io.BytesIO(b"5\r\nhel"))  # truncated
