@@ -263,3 +263,32 @@ def test_cli_config_null_override_rules():
         load_config(SchedulerServerConfig, overrides={"retry_limit": None})
     with pytest.raises(ConfigError, match="cannot be null"):
         load_config(SchedulerServerConfig, overrides={"manager_address": None})
+
+
+def test_example_configs_load_against_current_dataclasses():
+    """hack/configs/*.yaml (shipped into the Docker image) must keep
+    loading as the config dataclasses evolve — load_config rejects
+    unknown keys loudly, so drift fails here instead of at deploy."""
+    import glob
+    import os
+
+    from dragonfly2_tpu.cli.config import load_config
+    from dragonfly2_tpu.client.daemon import DaemonConfig
+    from dragonfly2_tpu.manager.server import ManagerServerConfig
+    from dragonfly2_tpu.scheduler.server import SchedulerServerConfig
+    from dragonfly2_tpu.trainer.server import TrainerServerConfig
+
+    root = os.path.join(os.path.dirname(__file__), "..", "hack", "configs")
+    classes = {
+        "manager": ManagerServerConfig,
+        "scheduler": SchedulerServerConfig,
+        "trainer": TrainerServerConfig,
+        "daemon": DaemonConfig,
+    }
+    seen = set()
+    for path in sorted(glob.glob(os.path.join(root, "*.yaml"))):
+        name = os.path.basename(path).split(".")[0]
+        cls = classes[name]
+        load_config(cls, path)  # raises on unknown/invalid keys
+        seen.add(name)
+    assert seen == set(classes), f"missing example configs: {set(classes) - seen}"
