@@ -292,3 +292,51 @@ def test_example_configs_load_against_current_dataclasses():
         load_config(cls, path)  # raises on unknown/invalid keys
         seen.add(name)
     assert seen == set(classes), f"missing example configs: {set(classes) - seen}"
+
+
+def test_deploy_manifests_set_keys_exist_on_dataclasses():
+    """Every --set key in docker-compose and the k8s manifests must be a
+    real field of the service's config dataclass (load_config rejects
+    unknown keys at boot — catch the drift here, not in a cluster)."""
+    import dataclasses
+    import os
+    import re
+
+    import yaml
+
+    from dragonfly2_tpu.client.daemon import DaemonConfig
+    from dragonfly2_tpu.manager.server import ManagerServerConfig
+    from dragonfly2_tpu.scheduler.server import SchedulerServerConfig
+    from dragonfly2_tpu.trainer.server import TrainerServerConfig
+
+    classes = {
+        "manager": ManagerServerConfig,
+        "scheduler": SchedulerServerConfig,
+        "trainer": TrainerServerConfig,
+        "daemon": DaemonConfig,
+    }
+    fields = {
+        svc: {f.name for f in dataclasses.fields(cls)} for svc, cls in classes.items()
+    }
+
+    def check_args(svc: str, args: list):
+        assert svc in fields, f"unknown service {svc!r}"
+        for i, a in enumerate(args):
+            if a == "--set":
+                key = str(args[i + 1]).split("=", 1)[0]
+                assert key in fields[svc], f"{svc}: unknown --set key {key!r}"
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    compose = yaml.safe_load(open(os.path.join(root, "deploy/docker-compose/docker-compose.yml")))
+    for name, svc in compose["services"].items():
+        cmd = svc.get("command") or []
+        if cmd:
+            check_args(cmd[0], cmd)
+
+    for doc in yaml.safe_load_all(open(os.path.join(root, "deploy/kubernetes/manifests.yaml"))):
+        if not doc or doc.get("kind") not in ("Deployment", "DaemonSet"):
+            continue
+        for c in doc["spec"]["template"]["spec"]["containers"]:
+            args = c.get("args") or []
+            if args:
+                check_args(args[0], args)
