@@ -50,6 +50,12 @@ def _emit(value: float = 0.0, vs_baseline: float = 0.0, error: str = "", **extra
     }
     if error:
         rec["error"] = error
+    # EVERY exit path carries the fallback provenance — an error emitted
+    # inside the CPU child must still say the TPU tunnel was the root cause
+    fallback = os.environ.get("DF_BENCH_CPU_FALLBACK", "")
+    if fallback:
+        rec["platform"] = "cpu-fallback"
+        rec["fallback_reason"] = fallback
     rec.update(extra)
     print(json.dumps(rec), flush=True)
 
@@ -79,6 +85,16 @@ def _backend_or_exit(timeout_s: float = 300.0):
             "error",
             f"jax backend init exceeded {timeout_s:.0f}s — TPU tunnel unresponsive",
         )
+        if "error" not in out and not os.environ.get("DF_BENCH_CPU_FALLBACK"):
+            # Honest fallback for a HUNG tunnel only (an outright init
+            # ERROR — e.g. broken jax — would recur in the child too):
+            # re-exec pinned to CPU and measure the SAME end-to-end
+            # pipeline there, labeled as such — a labeled CPU number
+            # beats a 0.0 error line when the accelerator link is down.
+            # exec also discards the thread wedged in plugin init.
+            _phase(f"{error}; re-exec on CPU fallback")
+            env = dict(os.environ, DF_BENCH_CPU_FALLBACK=error, JAX_PLATFORMS="cpu")
+            os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
         _emit(error=error)
         # the init thread may still be blocked inside native plugin code;
         # normal interpreter teardown with that thread alive can abort —
@@ -112,6 +128,17 @@ def _phase(msg: str) -> None:
 
 
 def main() -> None:
+    if os.environ.get("DF_BENCH_CPU_FALLBACK"):
+        # the sitecustomize pins the axon platform at interpreter start;
+        # env alone doesn't switch it (tests/conftest.py does the same
+        # dance) — must run before the first device query
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception as e:  # still ONE json line, exit 0
+            _emit(error=f"cpu fallback failed to import jax: {e}")
+            os._exit(0)
     _backend_or_exit()
     # armed after backend init (which has its own 300s watchdog) so the
     # budget covers only the phases whose internal budgets it must exceed
@@ -217,6 +244,11 @@ def main() -> None:
     rec_per_sec_per_chip = stats.download_records / dt / n_devices
     north_star_per_chip = 1e9 / 600 / 8  # 1B records / 10 min / v5e-8
     extra = {"truncated": True} if stats.truncated else {}
+    if not os.environ.get("DF_BENCH_CPU_FALLBACK"):
+        # (_emit stamps the cpu-fallback provenance itself)
+        import jax as _jax
+
+        extra["platform"] = _jax.devices()[0].platform
     finished.set()  # before the emit: the watchdog must never add a second line
     _emit(
         value=round(rec_per_sec_per_chip, 1),
