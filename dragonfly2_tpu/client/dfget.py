@@ -108,6 +108,7 @@ def download(
     application: str = "",
     digest: str = "",
     byte_range: str = "",
+    headers: dict | None = None,
     disable_back_source: bool = False,
     recursive: bool = False,
     on_progress=None,
@@ -125,14 +126,18 @@ def download(
             raise ValueError("--digest cannot be combined with --recursive")
         return _download_recursive(
             daemon_address, url, output, tag=tag, application=application,
-            on_progress=on_progress,
+            headers=headers, on_progress=on_progress,
         )
     client = glue.ServiceClient(glue.dial(daemon_address), DFDAEMON_SERVICE)
     req = dfdaemon_pb2.DownloadRequest(
         url=url,
         output=os.path.abspath(output),
         url_meta=common_pb2.UrlMeta(
-            tag=tag, application=application, digest=digest, range=byte_range
+            tag=tag,
+            application=application,
+            digest=digest,
+            range=byte_range,
+            header=headers or {},
         ),
         disable_back_source=disable_back_source,
     )
@@ -146,24 +151,25 @@ def download(
 
 def _download_recursive(
     daemon_address: str, url: str, output: str, tag: str = "",
-    application: str = "", on_progress=None,
+    application: str = "", headers: dict | None = None, on_progress=None,
 ) -> list[str]:
     """Directory mode: list the origin, download each file through the
-    daemon (reference dfget.go:317-386)."""
-    entries = source.client_for(url).list(url)
+    daemon (reference dfget.go:317-386). ``headers`` authenticate both
+    the listing and every per-file back-to-source fetch."""
+    entries = source.client_for(url).list(url, headers)
     written: list[str] = []
     for e in entries:
         dest = os.path.join(output, e.name)
         if e.is_dir:
             written += _download_recursive(
                 daemon_address, e.url, dest, tag=tag,
-                application=application, on_progress=on_progress,
+                application=application, headers=headers, on_progress=on_progress,
             )
         else:
             os.makedirs(output, exist_ok=True)
             written += download(
                 daemon_address, e.url, dest, tag=tag,
-                application=application, on_progress=on_progress,
+                application=application, headers=headers, on_progress=on_progress,
             )
     return written
 
@@ -181,6 +187,16 @@ def main(argv: list[str] | None = None) -> int:
         help='pin the downloaded content: "sha256:<hex>" or "md5:<hex>";'
         " verified before success is reported (with --range, the pin"
         " covers the slice — the task's content)",
+    )
+    p.add_argument(
+        "-H",
+        "--header",
+        action="append",
+        default=[],
+        dest="origin_headers",
+        metavar="'K: V'",
+        help="origin request header (repeatable) — auth for private"
+        " registries / signed URLs on the back-to-source fetch",
     )
     p.add_argument(
         "--range",
@@ -214,10 +230,17 @@ def main(argv: list[str] | None = None) -> int:
             pct = 100.0 * r.completed_length / r.content_length
             print(f"\r{pct:6.2f}% {r.completed_length}/{r.content_length}", end="", file=sys.stderr)
 
+    origin_headers = {}
+    for spec in args.origin_headers:
+        k, sep, v = spec.partition(":")
+        if not sep or not k.strip():
+            p.error(f"malformed --header {spec!r} (need 'Name: value')")
+        origin_headers[k.strip()] = v.strip()
+
     paths = download(
         args.daemon, args.url, args.output,
         tag=args.tag, application=args.application, digest=args.digest,
-        byte_range=args.byte_range,
+        byte_range=args.byte_range, headers=origin_headers,
         disable_back_source=args.disable_back_source,
         recursive=args.recursive, on_progress=progress,
     )

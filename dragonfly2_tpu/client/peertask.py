@@ -123,7 +123,11 @@ class TaskManager:
                 piece_manager=self.pm,
                 options=opts,
                 task_type=req.task_type,
-                headers=req.headers,
+                # origin headers: explicit request field, else
+                # UrlMeta.header — EVERY frontend (Download, ExportTask,
+                # proxy, gateway) gets auth to the back-to-source fetch
+                # without per-entry-point special-casing
+                headers=req.headers or dict(url_meta.header),
                 need_back_to_source=req.need_back_to_source,
                 on_done=self._forget,
             )

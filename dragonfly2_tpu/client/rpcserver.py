@@ -43,6 +43,8 @@ class DfdaemonService:
         req = FileTaskRequest(
             url=request.url,
             output=request.output,
+            # UrlMeta.header (dfget --header origin auth) is applied
+            # centrally in TaskManager.start_file_task
             url_meta=request.url_meta,
             disable_back_source=request.disable_back_source,
             need_back_to_source=request.need_back_to_source,

@@ -433,3 +433,93 @@ def test_recursive_rejects_digest_pin(cluster):
             f"127.0.0.1:{d_a.port}", cluster["url"], "/tmp/x",
             digest="sha256:" + "0" * 64, recursive=True,
         )
+
+
+def test_origin_headers_ride_back_to_source(cluster, tmp_path):
+    """dfget --header: origin request headers (private-registry auth)
+    reach the back-to-source fetch; without them the origin refuses."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    payload = os.urandom(40_000)
+
+    class AuthOrigin(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _authed(self):
+            return self.headers.get("Authorization") == "Bearer s3cr3t"
+
+        def do_HEAD(self):
+            if not self._authed():
+                self.send_error(401)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.send_header("Accept-Ranges", "bytes")
+            self.end_headers()
+
+        def do_GET(self):
+            if not self._authed():
+                self.send_error(401)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    origin = ThreadingHTTPServer(("127.0.0.1", 0), AuthOrigin)
+    threading.Thread(target=origin.serve_forever, daemon=True).start()
+    try:
+        d_a, _ = cluster["daemons"]
+        url = f"http://127.0.0.1:{origin.server_address[1]}/private.bin"
+        out = tmp_path / "authed.bin"
+        dfget.download(
+            f"127.0.0.1:{d_a.port}", url, str(out),
+            headers={"Authorization": "Bearer s3cr3t"},
+        )
+        assert out.read_bytes() == payload
+
+        # without the header the origin 401s and the download fails
+        with pytest.raises(Exception):
+            dfget.download(
+                f"127.0.0.1:{d_a.port}", url + "?v=2", str(tmp_path / "no.bin")
+            )
+    finally:
+        origin.shutdown()
+        origin.server_close()
+
+
+def test_recursive_download_carries_headers(cluster, tmp_path, monkeypatch):
+    """--header + --recursive: the listing AND every per-file fetch get
+    the origin headers (not silently dropped)."""
+    from dragonfly2_tpu.client import source as source_mod
+
+    seen = {"list": None, "downloads": 0}
+    real_client_for = source_mod.client_for
+
+    class Spy:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def list(self, url, headers=None):
+            seen["list"] = dict(headers or {})
+            return self.inner.list(url, headers)
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+    monkeypatch.setattr(
+        dfget, "source", type("S", (), {"client_for": lambda u: Spy(real_client_for(u))})
+    )
+    src = tmp_path / "tree2"
+    src.mkdir()
+    (src / "one.bin").write_bytes(b"one")
+    d_a, _ = cluster["daemons"]
+    dest = tmp_path / "tree2-out"
+    written = dfget.download(
+        f"127.0.0.1:{d_a.port}", f"file://{src}", str(dest),
+        recursive=True, headers={"Authorization": "Bearer r"},
+    )
+    assert len(written) == 1 and (dest / "one.bin").read_bytes() == b"one"
+    assert seen["list"] == {"Authorization": "Bearer r"}
