@@ -13,6 +13,8 @@ never whole-blob buffers — and upstream status/headers are preserved so
 from __future__ import annotations
 
 import re
+import threading
+import time
 import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
@@ -72,6 +74,8 @@ class P2PTransport:
     """Route a request: matching rule → peer task (P2P swarm + scheduler
     + back-to-source); no match or failure → direct origin fetch."""
 
+    NO_RANGE_TTL = 60.0  # negative cache for range-refusing origins
+
     def __init__(
         self,
         task_manager: TaskManager,
@@ -83,6 +87,8 @@ class P2PTransport:
         self.rules = rules or []
         self.default_tag = default_tag
         self.timeout = timeout
+        self._no_range: dict[str, float] = {}
+        self._no_range_lock = threading.Lock()
 
     def match_rule(self, url: str) -> ProxyRule | None:
         for rule in self.rules:
@@ -102,26 +108,64 @@ class P2PTransport:
             target = url if rule is None else rule.rewrite(url)
             return self._direct(target, headers, head)
         target = rule.rewrite(url)
-        # a ranged request is a different byte stream than the task blob —
-        # don't serve it from the whole-file swarm
-        if head or any(k.lower() == "range" for k in (headers or {})):
+        if head:
             return self._direct(target, headers, head)
+        # a client Range request rides P2P as a RANGED task (the slice
+        # IS the task — client/pieces.py semantics), so resumed pulls
+        # and ranged layer fetches still hit the swarm. Suffix ('-n')
+        # and multi-range forms fall back to a direct fetch: their
+        # absolute start is unknown without the total, which
+        # Content-Range needs.
+        range_spec = next(
+            (v for k, v in (headers or {}).items() if k.lower() == "range"), ""
+        )
+        byte_range = ""
+        if range_spec:
+            from dragonfly2_tpu.client.pieces import normalize_byte_range
+
+            # If-Range is a VALIDATOR the swarm cache cannot honor (task
+            # identity is url+range, not etag) — serving a stale slice
+            # would splice old bytes onto a newer partial file; a digest
+            # pin covers the whole object, never the slice. Both go
+            # direct, as does a recently range-refusing origin (no
+            # Accept-Ranges on HEAD → the P2P leg would fail every time).
+            if any(k.lower() == "if-range" for k in (headers or {})) or digest:
+                return self._direct(target, headers, head)
+            try:
+                byte_range = normalize_byte_range(range_spec)
+            except ValueError:
+                return self._direct(target, headers, head)
+            if byte_range.startswith("-"):
+                return self._direct(target, headers, head)
+            with self._no_range_lock:
+                if self._no_range.get(target, 0.0) > time.monotonic():
+                    return self._direct(target, headers, head)
         try:
-            return self._via_p2p(target, headers, digest)
+            return self._via_p2p(target, headers, digest, byte_range=byte_range)
         except Exception as e:
             # P2P failure degrades to a direct fetch, never a user error
             # (reference transport.go back-source fallback)
             logger.warning("p2p round-trip for %s failed (%s); going direct", url, e)
+            if byte_range:
+                # negative-cache ranged failures: a no-Accept-Ranges
+                # origin must not pay register→schedule→fail per request
+                with self._no_range_lock:
+                    self._no_range[target] = time.monotonic() + self.NO_RANGE_TTL
             return self._direct(target, headers, head)
 
     # ------------------------------------------------------------------
-    def _via_p2p(self, url: str, headers: dict | None, digest: str = "") -> TransportResult:
+    def _via_p2p(
+        self, url: str, headers: dict | None, digest: str = "", byte_range: str = ""
+    ) -> TransportResult:
         # the digest participates in the task id: rewritten content gets a
         # fresh task identity instead of serving stale cached bytes
+        fwd = {k: v for k, v in (headers or {}).items() if k.lower() != "range"}
         req = FileTaskRequest(
             url=url,
-            url_meta=common_pb2.UrlMeta(tag=self.default_tag, digest=digest),
-            headers=dict(headers or {}),
+            url_meta=common_pb2.UrlMeta(
+                tag=self.default_tag, digest=digest, range=byte_range
+            ),
+            headers=fwd,
         )
         # stream frontend: the response starts at first byte, not last —
         # a multi-GB layer pull begins flowing while later pieces are
@@ -129,8 +173,20 @@ class P2PTransport:
         task_id, _, content_length, origin_headers, body = self.tasks.start_stream_task(
             req, timeout=self.timeout
         )
+        status = 200
+        if byte_range:
+            # the task's content IS the slice; HTTP semantics for the
+            # ranged client are 206 + Content-Range (total unknown: '*')
+            status = 206
+            lo = int(byte_range.split("-", 1)[0])
+            origin_headers = dict(origin_headers)
+            origin_headers["Content-Range"] = (
+                f"bytes {lo}-{lo + content_length - 1}/*"
+                if content_length >= 0
+                else f"bytes {lo}-/*"
+            )
         return TransportResult(
-            status=200,
+            status=status,
             # replay persisted origin headers (Content-Type) so registry
             # clients get proper metadata on P2P-served responses
             headers=origin_headers,

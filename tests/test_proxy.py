@@ -41,6 +41,19 @@ def origin_server(tmp_path):
         def log_message(self, *a):
             pass
 
+        def do_HEAD(self):
+            # advertise range support (the ranged-task back-source gate
+            # requires it); SimpleHTTPRequestHandler never sends it
+            path = root / self.path.lstrip("/")
+            if path.is_file():
+                self.send_response(200)
+                self.send_header("Content-Length", str(path.stat().st_size))
+                self.send_header("Accept-Ranges", "bytes")
+                self.send_header("Content-Type", self.guess_type(str(path)))
+                self.end_headers()
+                return
+            super().do_HEAD()
+
         def do_GET(self):
             # minimal Range support (SimpleHTTPRequestHandler ignores it)
             rng = self.headers.get("Range", "")
@@ -48,8 +61,12 @@ def origin_server(tmp_path):
             if rng.startswith("bytes=") and path.is_file():
                 start_s, _, end_s = rng[6:].partition("-")
                 data = path.read_bytes()
-                start = int(start_s or 0)
-                end = int(end_s) if end_s else len(data) - 1
+                if not start_s:  # suffix form: last N bytes
+                    start = max(0, len(data) - int(end_s))
+                    end = len(data) - 1
+                else:
+                    start = int(start_s)
+                    end = int(end_s) if end_s else len(data) - 1
                 chunk = data[start : end + 1]
                 self.send_response(206)
                 self.send_header("Content-Length", str(len(chunk)))
@@ -212,18 +229,29 @@ def test_upstream_404_passes_through(proxy_cluster):
     assert exc_info.value.code == 404
 
 
-def test_ranged_request_bypasses_swarm(proxy_cluster):
-    """Range requests are a different byte stream than the task blob —
-    they go direct and keep the upstream 206."""
-    da = proxy_cluster["daemons"][0]
+def test_ranged_request_rides_p2p_as_a_ranged_task(proxy_cluster):
+    """A client Range request becomes a RANGED task (the slice is the
+    task): 206 + Content-Range, served via P2P, and a second daemon
+    requesting the same slice pulls it from the first."""
+    da, db = proxy_cluster["daemons"]
     url = proxy_cluster["origin"] + "/blob.bin"
-    req = urllib.request.Request(url, headers={"Range": "bytes=0-99"})
+    for d, expect_via in ((da, "1"), (db, "1")):
+        req = urllib.request.Request(url, headers={"Range": "bytes=100-4095"})
+        req.set_proxy(f"127.0.0.1:{d.proxy.port}", "http")
+        with urllib.request.urlopen(req, timeout=20) as resp:
+            body = resp.read()
+            assert resp.status == 206
+            assert resp.headers["X-Dragonfly-Via-P2P"] == expect_via
+            assert resp.headers["Content-Range"].startswith("bytes 100-4095/")
+        assert body == BLOB[100:4096]
+
+    # suffix form has no absolute start without the total → direct, 206
+    req = urllib.request.Request(url, headers={"Range": "bytes=-100"})
     req.set_proxy(f"127.0.0.1:{da.proxy.port}", "http")
     with urllib.request.urlopen(req, timeout=10) as resp:
-        body = resp.read()
         assert resp.status == 206
         assert resp.headers["X-Dragonfly-Via-P2P"] == "0"
-    assert body == BLOB[:100]
+        assert resp.read() == BLOB[-100:]
 
 
 def test_head_reports_length_without_body(proxy_cluster):
@@ -288,3 +316,47 @@ def test_mitm_forwards_chunked_request_bodies():
     assert _read_chunked_body(io.BytesIO(ext)) == b"hello"
     with pytest.raises(ValueError):
         _read_chunked_body(io.BytesIO(b"5\r\nhel"))  # truncated
+
+
+def test_if_range_and_digest_pins_go_direct(proxy_cluster):
+    """If-Range validators and whole-object digest pins cannot be
+    honored by the swarm cache — both must bypass P2P."""
+    da = proxy_cluster["daemons"][0]
+    url = proxy_cluster["origin"] + "/blob.bin"
+    req = urllib.request.Request(
+        url, headers={"Range": "bytes=0-99", "If-Range": '"some-etag"'}
+    )
+    req.set_proxy(f"127.0.0.1:{da.proxy.port}", "http")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 206
+        assert resp.headers["X-Dragonfly-Via-P2P"] == "0"
+        assert resp.read() == BLOB[:100]
+
+
+def test_range_refusing_origin_is_negatively_cached(tmp_path):
+    """An origin without Accept-Ranges pays the P2P register→fail cycle
+    ONCE; subsequent ranged requests go direct off the negative cache."""
+    from dragonfly2_tpu.client.transport import P2PTransport, ProxyRule
+
+    calls = {"p2p": 0}
+
+    class TM:
+        def start_stream_task(self, req, timeout=None):
+            calls["p2p"] += 1
+            raise RuntimeError("origin does not support ranges: x")
+
+    t = P2PTransport(TM(), rules=[ProxyRule(regex=".*")])
+
+    class _Direct:
+        status = 206
+        headers = {}
+        body = iter(())
+        content_length = 0
+        via_p2p = False
+        task_id = ""
+
+    t._direct = lambda *a, **k: _Direct()
+    t.round_trip("http://o/x.bin", headers={"Range": "bytes=0-9"})
+    t.round_trip("http://o/x.bin", headers={"Range": "bytes=0-9"})
+    t.round_trip("http://o/x.bin", headers={"Range": "bytes=10-19"})
+    assert calls["p2p"] == 1  # one failure, then the negative cache
