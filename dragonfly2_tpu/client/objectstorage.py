@@ -43,6 +43,26 @@ MODE_IMPORT_LOCAL = 1
 DIGEST_SUFFIX = ".df-digest"
 
 
+def _slice_stream(chunks, offset: int, length: int):
+    """Skip ``offset`` bytes of a chunk iterator, then yield exactly
+    ``length`` — range semantics over a whole-object stream without
+    buffering it."""
+    remaining_skip, remaining = offset, length
+    for chunk in chunks:
+        if remaining_skip:
+            if len(chunk) <= remaining_skip:
+                remaining_skip -= len(chunk)
+                continue
+            chunk = chunk[remaining_skip:]
+            remaining_skip = 0
+        if remaining <= 0:
+            break
+        if len(chunk) > remaining:
+            chunk = chunk[:remaining]
+        remaining -= len(chunk)
+        yield chunk
+
+
 def _sha256(data: bytes) -> str:
     return "sha256:" + hashlib.sha256(data).hexdigest()
 
@@ -165,29 +185,85 @@ class ObjectStorageGateway:
             return ""
 
     def _get_object(self, h, bucket: str, key: str) -> None:
+        from dragonfly2_tpu.client.pieces import resolve_byte_range
+
         if not self.backend.head_object(bucket, key):
             raise FileNotFoundError(key)
+        # resolve the client Range ONCE against the known total (shared
+        # by every route below); RFC 7233: an unparsable Range header is
+        # IGNORED (whole object, 200), an unsatisfiable one is 416
+        rng = h.headers.get("Range", "")
+        total = self.backend.stat_object(bucket, key)
+        rr = None
+        if rng:
+            try:
+                rr = resolve_byte_range(rng, total)
+            except ValueError:
+                rng = ""
+            else:
+                if rr is None:
+                    h.send_error(416, "range not satisfiable")
+                    return
         if self.transport is not None and self.url_for is not None:
+            # client Range rides through the transport, which serves it
+            # as a P2P ranged task or goes direct. A whole-object digest
+            # pin can't gate a slice, so ranged GETs drop it (the
+            # transport would refuse the combination).
             result = self.transport.round_trip(
-                self.url_for(bucket, key), digest=self._digest_of(bucket, key)
+                self.url_for(bucket, key),
+                headers={"Range": rng} if rng else None,
+                digest="" if rng else self._digest_of(bucket, key),
             )
             if result.status == 404:
                 raise FileNotFoundError(key)
+            if result.status not in (200, 206):
+                # upstream error stays an error — never relabeled 200,
+                # never sliced into a fake successful partial read
+                h.send_error(502, f"upstream returned {result.status}")
+                return
             length = result.content_length
+            body = result.body
+            status = result.status
+            content_range = result.headers.get("Content-Range", "")
+            if rr and status == 200:
+                # the transport couldn't serve the range itself (suffix
+                # form, direct file fetch) and returned the whole object
+                # — slice it HERE so S3 semantics hold on every route
+                off, end = rr
+                body = _slice_stream(result.body, off, end - off + 1)
+                length = end - off + 1
+                status = 206
+                content_range = f"bytes {off}-{end}/{total}"
+            elif status == 206 and content_range.endswith("/*"):
+                # the transport doesn't know the total; the gateway does
+                # (size probes like 'bytes=0-0' read it from here)
+                content_range = content_range[:-1] + str(total)
             if length < 0:
-                length = self.backend.stat_object(bucket, key)
-            h.send_response(200)
+                # unknown-length stream on keep-alive HTTP/1.1 would
+                # hang the client waiting for EOF
+                length = (rr[1] - rr[0] + 1) if rr else total
+            h.send_response(status)
             h.send_header("Content-Length", str(length))
+            if content_range:
+                h.send_header("Content-Range", content_range)
+            if result.headers.get("Content-Type"):
+                h.send_header("Content-Type", result.headers["Content-Type"])
             h.send_header("X-Dragonfly-Via-P2P", "1" if result.via_p2p else "0")
             if result.task_id:
                 h.send_header("X-Dragonfly-Task-Id", result.task_id)
             h.end_headers()
             # stream — multi-GB objects must not be buffered per request
-            for chunk in result.body:
+            for chunk in body:
                 h.wfile.write(chunk)
             return
         body = self.backend.get_object(bucket, key)
-        h.send_response(200)
+        if rr:
+            off, end = rr
+            h.send_response(206)
+            h.send_header("Content-Range", f"bytes {off}-{end}/{total}")
+            body = body[off : end + 1]
+        else:
+            h.send_response(200)
         h.send_header("Content-Length", str(len(body)))
         h.send_header("X-Dragonfly-Via-P2P", "0")
         h.end_headers()

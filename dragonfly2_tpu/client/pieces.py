@@ -44,6 +44,21 @@ def parse_byte_range(spec: str) -> tuple[int, int]:
     return start, end - start + 1
 
 
+def resolve_byte_range(spec: str, total: int) -> "tuple[int, int] | None":
+    """Resolve a range spec against a known object size → inclusive
+    (offset, end), or None when unsatisfiable (HTTP 416: start past the
+    end, or an empty object). Raises ValueError on malformed specs —
+    RFC 7233 callers IGNORE those (serve the whole object), they don't
+    error."""
+    off, ln = parse_byte_range(spec)
+    if off < 0:  # suffix: last n bytes, clamped to the object
+        off = max(0, total + off)
+    if off >= total:
+        return None
+    end = total - 1 if ln < 0 else min(off + ln - 1, total - 1)
+    return off, end
+
+
 def normalize_byte_range(spec: str) -> str:
     """Canonical form for task identity: '0-1023', 'bytes=0-1023', and
     ' 0-1023' are the SAME slice and must hash to the same task id (the
