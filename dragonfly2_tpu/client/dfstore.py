@@ -34,8 +34,14 @@ def _url(gateway: str, bucket: str, key: str = "", query: str = "") -> str:
     return f"http://{gateway}{path}" + (f"?{query}" if query else "")
 
 
-def _request(method: str, url: str, data: bytes | None = None, timeout: float = 300.0):
-    req = urllib.request.Request(url, data=data, method=method)
+def _request(
+    method: str,
+    url: str,
+    data: bytes | None = None,
+    timeout: float = 300.0,
+    headers: dict | None = None,
+):
+    req = urllib.request.Request(url, data=data, method=method, headers=headers or {})
     try:
         return urllib.request.urlopen(req, timeout=timeout)
     except urllib.error.HTTPError as e:
@@ -62,8 +68,13 @@ def put_object(
     ).close()
 
 
-def get_object(gateway: str, bucket: str, key: str) -> bytes:
-    with _request("GET", _url(gateway, bucket, key)) as resp:
+def get_object(
+    gateway: str, bucket: str, key: str, byte_range: str = ""
+) -> bytes:
+    """Fetch an object (or, with ``byte_range``, a slice of it — RFC
+    7233 forms; the gateway answers 206 + Content-Range)."""
+    headers = {"Range": byte_range} if byte_range else None
+    with _request("GET", _url(gateway, bucket, key), headers=headers) as resp:
         return resp.read()
 
 
@@ -130,6 +141,10 @@ def main(argv: list[str] | None = None) -> int:
     cp.add_argument("src")
     cp.add_argument("dst")
     cp.add_argument("--no-seed", action="store_true", help="don't seed the local daemon on upload")
+    cp.add_argument(
+        "--range", default="", dest="byte_range",
+        help='byte range for a df://→local copy, e.g. "0-1023" or "bytes=-500"',
+    )
 
     for name in ("stat", "rm"):
         s = sub.add_parser(name)
@@ -142,6 +157,18 @@ def main(argv: list[str] | None = None) -> int:
     mb.add_argument("uri")
 
     args = p.parse_args(argv)
+    if getattr(args, "byte_range", ""):
+        # validate client-side (like dfget): the gateway IGNORES a
+        # malformed Range per RFC 7233, which would silently copy the
+        # whole object; and a range only means something for df://→local
+        from dragonfly2_tpu.client.pieces import normalize_byte_range
+
+        try:
+            args.byte_range = normalize_byte_range(args.byte_range)
+        except ValueError as e:
+            p.error(str(e))
+        if not (args.src.startswith("df://") and not args.dst.startswith("df://")):
+            p.error("--range applies only to df://→local copies")
     try:
         if args.cmd == "cp":
             if args.src.startswith("df://") and args.dst.startswith("df://"):
@@ -152,7 +179,7 @@ def main(argv: list[str] | None = None) -> int:
                 )
             elif args.src.startswith("df://"):
                 bucket, key = _parse_df(args.src)
-                data = get_object(args.endpoint, bucket, key)
+                data = get_object(args.endpoint, bucket, key, byte_range=args.byte_range)
                 with open(args.dst, "wb") as f:
                     f.write(data)
             else:

@@ -206,3 +206,33 @@ def test_ranged_get_semantics_rfc7233(store_cluster):
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(req, timeout=30)
     assert e.value.code == 416
+
+
+def test_dfstore_cli_ranged_cp(store_cluster, tmp_path):
+    from dragonfly2_tpu.client import dfstore
+
+    da, _ = store_cluster["daemons"]
+    dfstore.put_object(_gw(da), "bkt", "cli.bin", OBJ)
+    out = tmp_path / "slice.bin"
+    rc = dfstore.main(
+        ["--endpoint", _gw(da), "cp", "df://bkt/cli.bin", str(out),
+         "--range", "bytes=10-1033"]
+    )
+    assert rc == 0
+    assert out.read_bytes() == OBJ[10:1034]
+
+
+def test_dfstore_cli_range_validation(store_cluster, tmp_path):
+    import pytest as _pytest
+
+    from dragonfly2_tpu.client import dfstore
+
+    da, _ = store_cluster["daemons"]
+    # malformed spec fails fast client-side (never a silent full copy)
+    with _pytest.raises(SystemExit):
+        dfstore.main(["--endpoint", _gw(da), "cp", "df://b/k", str(tmp_path / "o"),
+                      "--range", "bytes=zz"])
+    # range on a df->df copy is meaningless → rejected
+    with _pytest.raises(SystemExit):
+        dfstore.main(["--endpoint", _gw(da), "cp", "df://a/k", "df://b/k",
+                      "--range", "0-9"])
