@@ -281,6 +281,28 @@ def test_mitm_forwards_non_get_methods(tmp_path, monkeypatch):
             assert resp.read() == b"ok"
         assert got["body"] == b"layerdata"
         assert got["path"] == "/v2/blobs/uploads/"
+
+        # chunked upload (docker PATCH blob): decoded and forwarded
+        # whole, keep-alive stays in sync for the follow-up request
+        import http.client
+
+        conn = http.client.HTTPSConnection(
+            "127.0.0.1", httpd.server_address[1], context=ctx, timeout=15
+        )
+        conn.host, conn.port = "127.0.0.1", proxy.port  # CONNECT via proxy
+        conn.set_tunnel("127.0.0.1", httpd.server_address[1])
+        conn.request(
+            "POST", "/v2/blobs/uploads/", body=iter([b"chun", b"ked-", b"body"])
+        )  # http.client sends iterables chunked
+        r = conn.getresponse()
+        assert r.status == 202 and r.read() == b"ok"
+        assert got["body"] == b"chunked-body"
+        # same tunnel, next request — desync would garble this one
+        conn.request("POST", "/v2/blobs/uploads/", body=b"after")
+        r = conn.getresponse()
+        assert r.status == 202 and r.read() == b"ok"
+        assert got["body"] == b"after"
+        conn.close()
     finally:
         proxy.stop()
         httpd.shutdown()
