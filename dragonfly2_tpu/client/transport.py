@@ -1,5 +1,6 @@
 """P2P transport: route eligible HTTP requests through the peer-task
-pipeline with back-source fallback.
+pipeline with back-source fallback; client Range requests become ranged
+tasks (206 + Content-Range) when their absolute start is known.
 
 Role parity: reference client/daemon/transport/transport.go — an
 http.RoundTripper that sends matching GET requests through P2P (stream
