@@ -95,6 +95,14 @@ def ring_attention(
     my = lax.axis_index(axis_name)
     b, tq, h, d = q.shape
     tk = k.shape[1]
+    if causal and tq != tk:
+        # causal positions are computed as my·tq+i for queries but
+        # src·tk+j for keys — with unequal shard lengths those index
+        # DIFFERENT global coordinate systems and the mask is silently
+        # wrong; equal shards are the ring's contract
+        raise ValueError(
+            f"causal ring attention needs equal q/k shard lengths, got {tq} vs {tk}"
+        )
     if scale is None:
         scale = 1.0 / (d**0.5)
     perm = _ring_perm(axis_size)

@@ -44,12 +44,17 @@ class StreamStats:
     eval_pairs: int = 0
     wall_s: float = 0.0
     truncated: bool = False  # stopped early by a time budget
+    # per-dispatch training losses, most recent last (bounded to the
+    # final _LOSS_KEEP dispatches so a million-step run stays O(1))
     losses: list = field(default_factory=list)
     metrics: dict = field(default_factory=dict)  # mse/mae on the holdout
 
     @property
     def records_per_s(self) -> float:
         return self.download_records / self.wall_s if self.wall_s else 0.0
+
+
+_LOSS_KEEP = 1024
 
 
 def stream_shards(
@@ -82,6 +87,10 @@ def stream_shards(
     if isinstance(paths, (str, Path)):
         paths = [paths]
     paths = list(paths)
+    if not paths:
+        # an empty glob must be a clear error, not a ZeroDivisionError
+        # from the span-splitting arithmetic below
+        raise ValueError("stream_shards: no input files")
     # resolve to (path, start, end) spans: applies the committed offset
     # once (so every pass skips consumed history) and gives each worker
     # a balanced byte share even when files < workers
@@ -378,6 +387,9 @@ def stream_train_mlp(
     eval_y: list[np.ndarray] = []
     eval_collected = 0
     pending_loss = None
+    import collections
+
+    loss_ring: "collections.deque" = collections.deque(maxlen=_LOSS_KEEP)
     t0 = time.perf_counter()
 
     # native-side f16 emit skips the GIL-held f32→f16 numpy convert in
@@ -454,6 +466,9 @@ def stream_train_mlp(
                         params, opt_state, put(arg)
                     )
                 tokens[cur] = pending_loss
+                # device scalars, materialized once at stream end — no
+                # per-step sync; deque bounds a million-step run
+                loss_ring.append(pending_loss)
                 stats.steps += k
                 cur ^= 1
                 buf = bufs[cur]
@@ -473,9 +488,9 @@ def stream_train_mlp(
         params, opt_state, pending_loss = step(
             params, opt_state, jnp.asarray(buf[:fill].copy())
         )
+        loss_ring.append(pending_loss)
         stats.steps += 1
-    if pending_loss is not None:
-        stats.losses.append(float(jax.block_until_ready(pending_loss)))
+    stats.losses = [float(jax.block_until_ready(v)) for v in loss_ring]
     stats.wall_s = time.perf_counter() - t0
 
     if eval_x:

@@ -518,6 +518,14 @@ struct DfPairs {
   // any piece-cost column are never the final two fields — the schema
   // keeps them ≥2 columns apart), failing the check and falling back to
   // the normal scan.
+  //
+  // Honest scope note: OUR csv.DictWriter serializes padding slots as
+  // "0"s (flatten()'s default ParentRecord), so on self-produced files
+  // this check always fails and each padded row pays one extra O(tail)
+  // scan (`tried_tail` bounds it to once per row). It fires — and pays
+  // off — on writers that leave padding columns EMPTY, e.g. files from
+  // other producers on the same schema. Kept for that case; remove the
+  // call sites if all inputs are known self-produced.
   static bool tail_is_padding(const char* line, size_t len, size_t from) {
     long p_last = -1, p_prev = -1;
     for (long j = long(len) - 1; j >= long(from); --j) {
@@ -881,7 +889,10 @@ struct DfTopo {
       }
     }
     ++row;
-    if (src_id.empty()) return;
+    // the Python spec (features.build_probe_graph) interns the src
+    // UNCONDITIONALLY — even an empty id becomes a node — and skips
+    // only empty dests; matching exactly keeps node indices aligned
+    // between the native and numpy paths (the parity contract)
     bool src_seed = !src_type.empty() && src_type != "normal";
     int32_t s = intern(src_id, src_seed, src_tcp, src_utcp);
     for (auto& d : dests) {
@@ -971,7 +982,14 @@ static inline uint16_t f32_to_f16(float v) {
   uint32_t sign = (x >> 16) & 0x8000u;
   int32_t exp = int32_t((x >> 23) & 0xff) - 127 + 15;
   uint32_t mant = x & 0x7fffffu;
-  if (exp >= 31) return uint16_t(sign | 0x7c00u);  // inf/overflow (no NaN inputs here)
+  if (exp >= 31) {
+    // inf/overflow → ±inf; NaN keeps a mantissa bit (strtod parses the
+    // literal "nan" in CSV stats, and the F16C path / np.float16 both
+    // preserve it — silently turning NaN into inf would make the
+    // half-precision feed differ by build architecture)
+    bool is_nan = (int32_t((x >> 23) & 0xff) == 0xff) && mant != 0;
+    return uint16_t(sign | 0x7c00u | (is_nan ? 0x0200u : 0u));
+  }
   if (exp <= 0) {
     if (exp < -10) return uint16_t(sign);
     mant |= 0x800000u;

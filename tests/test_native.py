@@ -163,3 +163,63 @@ def test_training_uses_native(tmp_path, monkeypatch):
     metrics = training._train_mlp("ip_host", "ip", "host")
     assert "mse" in metrics
     assert called["path"].endswith("download_ip_host.csv")
+
+
+def test_topo_empty_src_id_matches_python(tmp_path):
+    """A topology row with an empty host.id still interns the src node —
+    the numpy path does, and node indices must stay aligned."""
+    import numpy as np
+
+    import dragonfly2_tpu.schema.native as N
+    from dragonfly2_tpu.schema.columnar import records_to_columns, write_csv
+    from dragonfly2_tpu.schema.features import build_probe_graph
+    from dragonfly2_tpu.schema.records import NetworkTopologyRecord
+    from dragonfly2_tpu.schema.synth import make_topology_records
+
+    if not N.available():
+        import pytest
+
+        pytest.skip("native unavailable")
+    recs = make_topology_records(8, num_hosts=6, seed=0)
+    hollow = NetworkTopologyRecord(host=recs[0].host, dest_hosts=recs[0].dest_hosts)
+    hollow.host.id = ""
+    recs.append(hollow)
+    p = tmp_path / "topo.csv"
+    write_csv(p, recs)
+    want = build_probe_graph(records_to_columns(recs), max_degree=4)
+    got = N.build_probe_graph_file(p, max_degree=4)
+    assert got is not None
+    assert got.num_nodes == want.num_nodes
+    assert got.node_ids == want.node_ids
+    np.testing.assert_array_equal(got.edge_src, want.edge_src)
+    np.testing.assert_array_equal(got.edge_dst, want.edge_dst)
+
+
+def test_f16_nan_preserved():
+    """The half-precision emit keeps NaN as NaN on every build path —
+    never inf (a 'nan' CSV stat must stay detectable)."""
+    import math
+
+    import numpy as np
+
+    import dragonfly2_tpu.schema.native as N
+    from dragonfly2_tpu.schema.columnar import write_csv
+    from dragonfly2_tpu.schema.synth import make_download_records
+
+    if not N.available():
+        import pytest
+
+        pytest.skip("native unavailable")
+    import tempfile
+
+    recs = make_download_records(3, seed=0)
+    recs[1].host.cpu.percent = float("nan")
+    with tempfile.TemporaryDirectory() as d:
+        p = d + "/r.csv"
+        write_csv(p, recs)
+        feats = labels = None
+        for f, l, _ in N.stream_pairs_file(p, half=True):
+            feats = f if feats is None else np.concatenate([feats, f])
+        assert feats is not None
+        # the NaN flows into at least one f16 feature as NaN, not inf
+        assert np.isnan(feats).any() or not np.isinf(feats).any()

@@ -186,3 +186,33 @@ def test_ring_attention_gradients_match_oracle():
             np.asarray(a), np.asarray(b_), atol=3e-4,
             err_msg=f"d{name} diverges through the ring",
         )
+
+
+def test_causal_ring_rejects_unequal_shards():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dragonfly2_tpu.ops.ring import make_ring_attention
+    from dragonfly2_tpu.parallel.mesh import make_mesh
+
+    n = min(4, jax.device_count())
+    mesh = make_mesh(jax.devices()[:n], sp=n)
+    b, h, d = 1, 2, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, 8 * n, h, d), jnp.float32)
+    kv = jax.random.normal(jax.random.PRNGKey(1), (b, 16 * n, h, d), jnp.float32)
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    ring = make_ring_attention(mesh, "sp", causal=True)
+    with pytest.raises(ValueError, match="equal q/k shard lengths"):
+        ring(jax.device_put(q, spec), jax.device_put(kv, spec), jax.device_put(kv, spec))
+
+
+def test_stream_shards_empty_paths_is_clear_error():
+    import pytest
+
+    from dragonfly2_tpu.trainer.ingest import stream_shards
+
+    with pytest.raises(ValueError, match="no input files"):
+        list(stream_shards([]))
