@@ -359,6 +359,56 @@ def main() -> int:
         assert any(r["hostname"] == "host-b" for r in rows), rows
         print("PASS manager-fed discovery + seed-peer registration")
 
+        # train→serve round-trip at subprocess level: the scheduler's
+        # Download records stream over the trainer's Train RPC, EOF
+        # fires the fit, the model lands in the manager registry, and
+        # activation flips it live (SURVEY §3.3)
+        import glob as _glob
+
+        import trainer_pb2
+
+        csvs = [
+            p
+            for p in _glob.glob(
+                os.path.join(records_dir, "**", "download*.csv"), recursive=True
+            )
+            if os.path.isfile(p)
+        ]
+        assert csvs, "no download CSVs to upload"
+        tchan = glue.dial(trainer_addr)
+        tclient = glue.ServiceClient(tchan, glue.TRAINER_SERVICE)
+
+        def _train_reqs():
+            for p in csvs:
+                with open(p, "rb") as f:
+                    data = f.read()
+                yield trainer_pb2.TrainRequest(
+                    ip="10.99.0.1",
+                    hostname="sched-e2e",
+                    train_mlp=trainer_pb2.TrainMlpRequest(dataset=data),
+                )
+
+        tclient.Train(_train_reqs(), timeout=600)
+        tchan.close()
+        model = None
+        deadline = time.time() + 180
+        while time.time() < deadline and model is None:
+            rows = call("GET", "/api/v1/models", token=pat["token"])
+            model = rows[0] if rows else None
+            time.sleep(1)
+        assert model, "trainer never uploaded a model to the manager"
+        act = call(
+            "PUT",
+            f"/api/v1/models/{model['model_id']}/versions/{model['version']}/state",
+            {"state": "active"},
+            token=pat["token"],
+        )
+        assert act["state"] == "active"
+        print(
+            "PASS train-serve roundtrip (records -> Train RPC -> fit ->"
+            f" CreateModel → activation; eval={model.get('evaluation')})"
+        )
+
         # dynamic certificate issuance: CSR → booted manager's CA →
         # chain that verifies against the persisted root
         from dragonfly2_tpu.utils.issuer import obtain_certificate

@@ -57,28 +57,31 @@ print("LOSS", sys.argv[1], f"{float(jax.block_until_ready(loss)):.8f}", flush=Tr
 """
 
 
-def test_two_process_dp_train_step(tmp_path):
+
+def _run_workers(template: str, n: int = 2, timeout: float = 300.0) -> list[str]:
+    """Spawn ``n`` coordinated worker processes from a code template
+    (@REPO@/@COORD@ substituted), assert all exit 0, return stdouts."""
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
     repo = str(__import__("pathlib").Path(__file__).resolve().parents[1])
-    code = _WORKER.replace("@REPO@", repo).replace("@COORD@", f"127.0.0.1:{port}")
+    code = template.replace("@REPO@", repo).replace("@COORD@", f"127.0.0.1:{port}")
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", code, str(i)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
-        for i in range(2)
+        for i in range(n)
     ]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=300)
-        outs.append(out)
+    outs = [p.communicate(timeout=timeout)[0] for p in procs]
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
+    return outs
+
+
+def test_two_process_dp_train_step():
+    outs = _run_workers(_WORKER)
     losses = {}
     for out in outs:
         for line in out.splitlines():
@@ -140,26 +143,11 @@ print("FED", pid, f"{local[0]:.6f}", f"{local[1]:.6f}", flush=True)
 """
 
 
-def test_two_process_fedavg_over_dcn_analog(tmp_path):
+def test_two_process_fedavg_over_dcn_analog():
     """Federated merge ACROSS processes: each process contributes its
     locally-fit member params; the example-weighted FedAvg psum rides
     the cross-process collective (DCN on real multi-slice TPU)."""
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    repo = str(__import__("pathlib").Path(__file__).resolve().parents[1])
-    code = _FED_WORKER.replace("@REPO@", repo).replace("@COORD@", f"127.0.0.1:{port}")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", code, str(i)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
-        for i in range(2)
-    ]
-    outs = [p.communicate(timeout=300)[0] for p in procs]
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
+    outs = _run_workers(_FED_WORKER)
     vals = {}
     for out in outs:
         for line in out.splitlines():
