@@ -235,7 +235,13 @@ def main(argv: list[str] | None = None) -> int:
         k, sep, v = spec.partition(":")
         if not sep or not k.strip():
             p.error(f"malformed --header {spec!r} (need 'Name: value')")
-        origin_headers[k.strip()] = v.strip()
+        k = k.strip()
+        if k in origin_headers:
+            # repeated names combine per RFC 9110 — silent last-wins
+            # would drop a Cookie/Forwarded entry the origin requires
+            origin_headers[k] = f"{origin_headers[k]}, {v.strip()}"
+        else:
+            origin_headers[k] = v.strip()
 
     paths = download(
         args.daemon, args.url, args.output,

@@ -202,17 +202,25 @@ class ObjectStorageGateway:
                 rng = ""
             else:
                 if rr is None:
-                    h.send_error(416, "range not satisfiable")
+                    # RFC 7233: the 416 carries the total so resume
+                    # logic can recover the object size
+                    h.send_response(416)
+                    h.send_header("Content-Range", f"bytes */{total}")
+                    h.send_header("Content-Length", "0")
+                    h.end_headers()
                     return
         if self.transport is not None and self.url_for is not None:
             # client Range rides through the transport, which serves it
             # as a P2P ranged task or goes direct. A whole-object digest
             # pin can't gate a slice, so ranged GETs drop it (the
             # transport would refuse the combination).
+            # the digest ALWAYS rides along: for unranged GETs it pins
+            # content; for ranged ones the transport converts it into
+            # task-identity salt so overwrites never serve stale slices
             result = self.transport.round_trip(
                 self.url_for(bucket, key),
                 headers={"Range": rng} if rng else None,
-                digest="" if rng else self._digest_of(bucket, key),
+                digest=self._digest_of(bucket, key),
             )
             if result.status == 404:
                 raise FileNotFoundError(key)
@@ -248,6 +256,7 @@ class ObjectStorageGateway:
                 h.send_header("Content-Range", content_range)
             if result.headers.get("Content-Type"):
                 h.send_header("Content-Type", result.headers["Content-Type"])
+            h.send_header("Accept-Ranges", "bytes")
             h.send_header("X-Dragonfly-Via-P2P", "1" if result.via_p2p else "0")
             if result.task_id:
                 h.send_header("X-Dragonfly-Task-Id", result.task_id)
@@ -265,6 +274,7 @@ class ObjectStorageGateway:
         else:
             h.send_response(200)
         h.send_header("Content-Length", str(len(body)))
+        h.send_header("Accept-Ranges", "bytes")
         h.send_header("X-Dragonfly-Via-P2P", "0")
         h.end_headers()
         h.wfile.write(body)
@@ -275,6 +285,7 @@ class ObjectStorageGateway:
             return
         h.send_response(200)
         h.send_header("Content-Length", str(self.backend.stat_object(bucket, key)))
+        h.send_header("Accept-Ranges", "bytes")  # SDK transfer managers probe this
         h.end_headers()
 
     def _delete_object(self, h, bucket: str, key: str) -> None:

@@ -121,16 +121,16 @@ class P2PTransport:
             (v for k, v in (headers or {}).items() if k.lower() == "range"), ""
         )
         byte_range = ""
+        tag_salt = ""
         if range_spec:
             from dragonfly2_tpu.client.pieces import normalize_byte_range
 
             # If-Range is a VALIDATOR the swarm cache cannot honor (task
             # identity is url+range, not etag) — serving a stale slice
-            # would splice old bytes onto a newer partial file; a digest
-            # pin covers the whole object, never the slice. Both go
-            # direct, as does a recently range-refusing origin (no
-            # Accept-Ranges on HEAD → the P2P leg would fail every time).
-            if any(k.lower() == "if-range" for k in (headers or {})) or digest:
+            # would splice old bytes onto a newer partial file: direct,
+            # as are suffix forms (absolute start unknown) and recently
+            # range-refusing origins.
+            if any(k.lower() == "if-range" for k in (headers or {})):
                 return self._direct(target, headers, head)
             try:
                 byte_range = normalize_byte_range(range_spec)
@@ -138,33 +138,59 @@ class P2PTransport:
                 return self._direct(target, headers, head)
             if byte_range.startswith("-"):
                 return self._direct(target, headers, head)
-            with self._no_range_lock:
-                if self._no_range.get(target, 0.0) > time.monotonic():
-                    return self._direct(target, headers, head)
+            if byte_range == "":
+                # 'bytes=0-' IS the whole object — plain unranged
+                # semantics (incl. the digest pin); anything else would
+                # mint a duplicate full-object cache entry
+                range_spec = ""
+            else:
+                # a whole-object digest can't VERIFY a slice, but it must
+                # still VERSION the cache — as task-identity salt — or an
+                # object overwrite would serve stale slice bytes forever
+                tag_salt, digest = digest, ""
+                with self._no_range_lock:
+                    if self._no_range.get(target, 0.0) > time.monotonic():
+                        return self._direct(target, headers, head)
         try:
-            return self._via_p2p(target, headers, digest, byte_range=byte_range)
+            return self._via_p2p(
+                target, headers, digest, byte_range=byte_range, tag_salt=tag_salt
+            )
         except Exception as e:
             # P2P failure degrades to a direct fetch, never a user error
             # (reference transport.go back-source fallback)
             logger.warning("p2p round-trip for %s failed (%s); going direct", url, e)
-            if byte_range:
-                # negative-cache ranged failures: a no-Accept-Ranges
-                # origin must not pay register→schedule→fail per request
+            if byte_range and "support" in str(e) and "range" in str(e).lower():
+                # negative-cache RANGE-REFUSING origins only (a transient
+                # scheduler hiccup must not unroute a capable origin):
+                # they'd pay register→schedule→fail on every request
                 with self._no_range_lock:
-                    self._no_range[target] = time.monotonic() + self.NO_RANGE_TTL
+                    now = time.monotonic()
+                    if len(self._no_range) > 256:  # drop expired entries
+                        self._no_range = {
+                            u: t for u, t in self._no_range.items() if t > now
+                        }
+                    self._no_range[target] = now + self.NO_RANGE_TTL
             return self._direct(target, headers, head)
 
     # ------------------------------------------------------------------
     def _via_p2p(
-        self, url: str, headers: dict | None, digest: str = "", byte_range: str = ""
+        self,
+        url: str,
+        headers: dict | None,
+        digest: str = "",
+        byte_range: str = "",
+        tag_salt: str = "",
     ) -> TransportResult:
         # the digest participates in the task id: rewritten content gets a
-        # fresh task identity instead of serving stale cached bytes
+        # fresh task identity instead of serving stale cached bytes. For
+        # ranged tasks the whole-object digest rides the TAG instead —
+        # identity versioning without slice-verification semantics.
         fwd = {k: v for k, v in (headers or {}).items() if k.lower() != "range"}
+        tag = f"{self.default_tag}|{tag_salt}" if tag_salt else self.default_tag
         req = FileTaskRequest(
             url=url,
             url_meta=common_pb2.UrlMeta(
-                tag=self.default_tag, digest=digest, range=byte_range
+                tag=tag, digest=digest, range=byte_range
             ),
             headers=fwd,
         )

@@ -236,3 +236,52 @@ def test_dfstore_cli_range_validation(store_cluster, tmp_path):
     with _pytest.raises(SystemExit):
         dfstore.main(["--endpoint", _gw(da), "cp", "df://a/k", "df://b/k",
                       "--range", "0-9"])
+
+
+def test_ranged_get_never_serves_stale_slices_after_overwrite(store_cluster):
+    """An object overwrite must refresh RANGED reads too: the content
+    digest versions the ranged task's identity (as tag salt), so the
+    swarm can't keep serving v1 slice bytes forever."""
+    import urllib.request
+
+    da, _ = store_cluster["daemons"]
+    from dragonfly2_tpu.client import dfstore
+
+    v1 = bytes([65]) * 70000  # 'A' * 70000
+    v2 = bytes([66]) * 70000  # 'B' * 70000
+    dfstore.put_object(_gw(da), "bkt", "ver.bin", v1)
+
+    def ranged():
+        req = urllib.request.Request(
+            f"http://{_gw(da)}/buckets/bkt/objects/ver.bin",
+            headers={"Range": "bytes=10-109"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 206
+            return r.read()
+
+    assert ranged() == v1[10:110]
+    dfstore.put_object(_gw(da), "bkt", "ver.bin", v2)
+    assert dfstore.get_object(_gw(da), "bkt", "ver.bin") == v2  # unranged fresh
+    assert ranged() == v2[10:110], "ranged read served stale pre-overwrite bytes"
+
+    # 'bytes=0-' IS the whole object: same task as unranged (no
+    # duplicate full-object cache copy) and the digest pin still applies
+    assert dfstore.get_object(_gw(da), "bkt", "ver.bin", byte_range="bytes=0-") == v2
+
+    # RFC surface: Accept-Ranges advertised; 416 carries the total
+    req = urllib.request.Request(f"http://{_gw(da)}/buckets/bkt/objects/ver.bin")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.headers["Accept-Ranges"] == "bytes"
+    import urllib.error
+
+    req = urllib.request.Request(
+        f"http://{_gw(da)}/buckets/bkt/objects/ver.bin",
+        headers={"Range": "bytes=999999-"},
+    )
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        raise AssertionError("want 416")
+    except urllib.error.HTTPError as e:
+        assert e.code == 416
+        assert e.headers["Content-Range"] == f"bytes */{len(v2)}"
