@@ -183,7 +183,10 @@ def test_transport_p2p_failure_falls_back_direct(origin_server, monkeypatch):
     rule = ProxyRule(regex=r"blob\.bin")
     t = P2PTransport(task_manager=None, rules=[rule])
 
-    def boom(url, headers):
+    def boom(*args, **kwargs):
+        # accept the full real signature — a TypeError from a stale
+        # signature would ALSO be swallowed by the fallback and pass
+        # this test for the wrong reason
         raise RuntimeError("swarm unavailable")
 
     monkeypatch.setattr(t, "_via_p2p", boom)
