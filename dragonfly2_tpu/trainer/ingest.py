@@ -8,14 +8,19 @@ on the trainer's disk (reference trainer/storage/storage.go:44-148,
 announcer 128 MiB-chunk upload announcer.go:39-41); from there this
 module drives the fused C++ CSV→tensor decoder (native/dfnative.cc) in
 producer threads, packs pair shards into fixed-size minibatches, and
-feeds the jitted train step — the decode of chunk k+1 overlaps the
-device step on batch k (ctypes releases the GIL during native parsing;
-XLA dispatch is async). Multiple dataset files decode in parallel, one
-producer thread per file shard, each with its own parser handle.
+hands full superbatches to a dedicated dispatcher thread that runs
+transfer + jitted train step — decode, H2D, and device compute all
+overlap (ctypes releases the GIL during native parsing; XLA dispatch is
+async; the dispatcher absorbs the device link's transfer latency so it
+never stalls packing or decode). Multiple dataset files decode in
+parallel, one producer thread per file shard, each with its own parser
+handle.
 
 Memory bound: the shard queue holds ≤ ``queue_depth`` chunks of decoded
-pairs (~chunk_bytes of CSV each) plus one packing buffer and a capped
-eval holdout — independent of file size.
+pairs (~chunk_bytes of CSV each) plus a three-buffer packing pool
+(3 × batch_size·steps_per_call superbatches: one packing, one in
+transfer/step, one awaiting confirmation) and a capped eval holdout —
+independent of file size.
 """
 
 from __future__ import annotations
@@ -366,117 +371,180 @@ def stream_train_mlp(
             return jnp.asarray(buf)
 
     stats = StreamStats()
-    # Double-buffered packing: fixed [batch_size, F+1] (features ‖ label)
-    # buffers filled from variable shards. Two buffers because the CPU
-    # backend's asarray/device_put can be ZERO-COPY — the asynchronously
-    # dispatched step still reads the buffer while the loop packs the
-    # next batch, so each buffer is only reused after the step that read
-    # it has materialized its loss (a real TPU always copies on H2D, but
-    # correctness can't depend on the backend's copy behavior).
+    # Pipelined packing: fixed [batch_size·k, F+1] (features ‖ label)
+    # buffers cycle through a free pool → packing → a dispatcher thread
+    # that runs transfer + step. A DEDICATED dispatcher thread matters on
+    # a host whose device link has variable latency (tunneled/remote
+    # chips): H2D transfer time under decode contention was measured at
+    # 100-600 ms per superbatch, and paying that on the packing thread
+    # stalls the decode pipeline behind it — measured 110k → 200k
+    # records/s on a 1-core host by moving dispatch off-thread. Three
+    # buffers = one packing + one in transfer/step + one awaiting
+    # confirmation. A buffer is reused only after the step that read it
+    # has materialized its loss: the CPU backend's asarray/device_put can
+    # be ZERO-COPY, so the asynchronously dispatched step may still read
+    # the numpy buffer after dispatch returns (a real TPU always copies
+    # on H2D, but correctness can't depend on the backend's copy
+    # behavior).
     rows_per_call = batch_size * k
-    bufs = [
-        np.empty((rows_per_call, MLP_FEATURE_DIM + 1), transfer_dtype)
-        for _ in range(2)
-    ]
-    tokens: list = [None, None]  # per-buffer in-flight step output
-    cur = 0
-    buf = bufs[cur]
+    free_bufs: "queue.Queue" = queue.Queue()
+    for _ in range(3):
+        free_bufs.put(np.empty((rows_per_call, MLP_FEATURE_DIM + 1), transfer_dtype))
+    filled_bufs: "queue.Queue" = queue.Queue(maxsize=1)
+    disp_errors: list[BaseException] = []
+    buf = free_bufs.get()
     fill = 0
     eval_cap_pairs = eval_max_batches * batch_size
     eval_x: list[np.ndarray] = []
     eval_y: list[np.ndarray] = []
     eval_collected = 0
-    pending_loss = None
     import collections
 
     loss_ring: "collections.deque" = collections.deque(maxlen=_LOSS_KEEP)
     t0 = time.perf_counter()
+
+    # Dispatcher thread: owns params/opt_state from its start to its
+    # join; runs transfer + step per filled buffer, confirms the
+    # previous step before recycling that step's buffer (the reuse rule
+    # above). Single consumer of filled_bufs, single producer of
+    # free_bufs recycles; stats.steps/loss_ring writes are GIL-atomic
+    # with a single writer. On error it keeps draining filled buffers
+    # until the None sentinel so the packing thread never deadlocks.
+    state: dict = {}
+    disp_thread: threading.Thread | None = None
+
+    def _dispatch_loop():
+        prev_loss = prev_buf = None
+        saw_sentinel = False
+        try:
+            while True:
+                b = filled_bufs.get()
+                if b is None:
+                    saw_sentinel = True
+                    break
+                arg = b if k == 1 else b.reshape(k, batch_size, -1)
+                fn = step if k == 1 else scan_step
+                state["params"], state["opt_state"], loss = fn(
+                    state["params"], state["opt_state"], put(arg)
+                )
+                loss_ring.append(loss)
+                stats.steps += k
+                if prev_loss is not None:
+                    jax.block_until_ready(prev_loss)
+                    free_bufs.put(prev_buf)
+                prev_loss, prev_buf = loss, b
+            if prev_loss is not None:
+                jax.block_until_ready(prev_loss)
+                free_bufs.put(prev_buf)
+        except BaseException as e:
+            disp_errors.append(e)
+            if prev_buf is not None:
+                free_bufs.put(prev_buf)
+            # drain to the sentinel so the packing thread never blocks on
+            # the pool — but only if the sentinel hasn't been consumed
+            # yet: a failure in the post-sentinel tail (e.g. the final
+            # block_until_ready raising on a dropped device link) must
+            # not wait for a second sentinel that will never come while
+            # the packer sits in join()
+            while not saw_sentinel:
+                b = filled_bufs.get()
+                if b is None:
+                    break
+                free_bufs.put(b)
 
     # native-side f16 emit skips the GIL-held f32→f16 numpy convert in
     # the packing loop below — the consumer thread is the bottleneck on
     # small hosts
     half = transfer_dtype == np.float16
     budget_end = None if time_budget_s is None else t0 + time_budget_s
-    for feats, labels, rows in stream_shards(
-        paths,
-        passes=passes,
-        max_records=max_records,
-        queue_depth=queue_depth,
-        offset=offset,
-        workers=workers,
-        half=half,
-    ):
-        if budget_end is not None and time.perf_counter() > budget_end:
-            stats.truncated = True
-            break  # generator abandonment releases the producers
-        stats.download_records = rows
-        stats.pairs += feats.shape[0]
-        if warm_bias and labels.size:
-            # warm-start the output bias at (an estimate of) the label
-            # mean so the regression head doesn't spend its first steps
-            # drifting there (train_mlp does the same with the full-data
-            # mean, train.py:137-138). dtype pinned to the init value's:
-            # a weak-typed scalar fill would give the first step a
-            # different jit signature than every later step — one extra
-            # XLA compile mid-stream
-            b = params["layers"][-1]["b"]
-            params["layers"][-1]["b"] = jnp.full((1,), float(labels.mean()), dtype=b.dtype)
-            warm_bias = False
-        if opt_state is None:
-            opt_state = optimizer.init(params)
-        if eval_every > 0 and feats.shape[0]:
-            # content-hash holdout: same pair → same bucket on every pass
-            # (bucket assignment depends on the transfer dtype's bit
-            # pattern; deterministic within a run config either way)
-            u = np.uint16 if feats.dtype == np.float16 else np.uint32
-            hv = feats.view(u).sum(axis=1, dtype=np.uint64)
-            hv = (hv * np.uint64(2654435761) + labels.view(u)) & np.uint64(
-                0xFFFFFFFF
-            )
-            emask = (hv % np.uint64(eval_every)) == 0
-            if emask.any():
-                if eval_collected < eval_cap_pairs:
-                    # exclusion from training is the invariant that must
-                    # hold on every pass; collection is cap-bounded (a
-                    # later pass may re-collect a pair it already holds,
-                    # which only reweights identical content in the
-                    # metric, never leaks it into training)
-                    ef = feats[emask]
-                    eval_x.append(ef)
-                    eval_y.append(labels[emask])
-                    eval_collected += ef.shape[0]
-                feats = feats[~emask]
-                labels = labels[~emask]
-        off = 0
-        while off < feats.shape[0]:
-            take = min(rows_per_call - fill, feats.shape[0] - off)
-            buf[fill : fill + take, :MLP_FEATURE_DIM] = feats[off : off + take]
-            buf[fill : fill + take, MLP_FEATURE_DIM] = labels[off : off + take]
-            fill += take
-            off += take
-            if fill == rows_per_call:
-                # async dispatch: the host returns to decoding while the
-                # chip trains this batch (k>1: k sequential steps in one
-                # call over the scan-major superbatch view)
-                arg = buf if k == 1 else buf.reshape(k, batch_size, -1)
-                if k == 1:
-                    params, opt_state, pending_loss = step(params, opt_state, put(arg))
-                else:
-                    params, opt_state, pending_loss = scan_step(
-                        params, opt_state, put(arg)
-                    )
-                tokens[cur] = pending_loss
-                # device scalars, materialized once at stream end — no
-                # per-step sync; deque bounds a million-step run
-                loss_ring.append(pending_loss)
-                stats.steps += k
-                cur ^= 1
-                buf = bufs[cur]
-                if tokens[cur] is not None:
-                    # the step that read this buffer must be done before
-                    # the loop overwrites it (one-step overlap)
-                    jax.block_until_ready(tokens[cur])
-                fill = 0
+    # the shutdown handshake lives in a finally: an exception out of the
+    # packing loop (producer decode error re-raised by stream_shards, a
+    # KeyboardInterrupt, …) must still send the sentinel and join, or the
+    # dispatcher thread leaks blocked on filled_bufs.get() with its
+    # buffers pinned — the long-lived trainer service calls this every
+    # training round
+    try:
+        for feats, labels, rows in stream_shards(
+            paths,
+            passes=passes,
+            max_records=max_records,
+            queue_depth=queue_depth,
+            offset=offset,
+            workers=workers,
+            half=half,
+        ):
+            if budget_end is not None and time.perf_counter() > budget_end:
+                stats.truncated = True
+                break  # generator abandonment releases the producers
+            if disp_errors:
+                break
+            stats.download_records = rows
+            stats.pairs += feats.shape[0]
+            if warm_bias and labels.size and disp_thread is None:
+                # warm-start the output bias at (an estimate of) the label
+                # mean so the regression head doesn't spend its first steps
+                # drifting there (train_mlp does the same with the full-data
+                # mean, train.py:137-138). dtype pinned to the init value's:
+                # a weak-typed scalar fill would give the first step a
+                # different jit signature than every later step — one extra
+                # XLA compile mid-stream
+                b = params["layers"][-1]["b"]
+                params["layers"][-1]["b"] = jnp.full((1,), float(labels.mean()), dtype=b.dtype)
+                warm_bias = False
+            if opt_state is None:
+                opt_state = optimizer.init(params)
+            if eval_every > 0 and feats.shape[0]:
+                # content-hash holdout: same pair → same bucket on every pass
+                # (bucket assignment depends on the transfer dtype's bit
+                # pattern; deterministic within a run config either way)
+                u = np.uint16 if feats.dtype == np.float16 else np.uint32
+                hv = feats.view(u).sum(axis=1, dtype=np.uint64)
+                hv = (hv * np.uint64(2654435761) + labels.view(u)) & np.uint64(
+                    0xFFFFFFFF
+                )
+                emask = (hv % np.uint64(eval_every)) == 0
+                if emask.any():
+                    if eval_collected < eval_cap_pairs:
+                        # exclusion from training is the invariant that must
+                        # hold on every pass; collection is cap-bounded (a
+                        # later pass may re-collect a pair it already holds,
+                        # which only reweights identical content in the
+                        # metric, never leaks it into training)
+                        ef = feats[emask]
+                        eval_x.append(ef)
+                        eval_y.append(labels[emask])
+                        eval_collected += ef.shape[0]
+                    feats = feats[~emask]
+                    labels = labels[~emask]
+            off = 0
+            while off < feats.shape[0]:
+                take = min(rows_per_call - fill, feats.shape[0] - off)
+                buf[fill : fill + take, :MLP_FEATURE_DIM] = feats[off : off + take]
+                buf[fill : fill + take, MLP_FEATURE_DIM] = labels[off : off + take]
+                fill += take
+                off += take
+                if fill == rows_per_call:
+                    # hand the full buffer to the dispatcher thread and keep
+                    # packing: transfer + step latency (large and variable on
+                    # a tunneled device link) never stalls the decode pipeline
+                    if disp_thread is None:
+                        state["params"], state["opt_state"] = params, opt_state
+                        disp_thread = threading.Thread(
+                            target=_dispatch_loop, name="ingest-dispatch", daemon=True
+                        )
+                        disp_thread.start()
+                    filled_bufs.put(buf)
+                    buf = free_bufs.get()
+                    fill = 0
+                    if disp_errors:
+                        break
+    finally:
+        if disp_thread is not None:
+            filled_bufs.put(None)
+            disp_thread.join()
+            params, opt_state = state["params"], state["opt_state"]
+    if disp_errors:
+        raise disp_errors[0]
     stats.eval_pairs = eval_collected
     if stats.steps == 0 and fill > 0:
         # tiny dataset (< one batch): one ragged step so the fit is real.

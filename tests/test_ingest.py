@@ -411,3 +411,39 @@ def test_steps_per_call_matches_single_step_math(tmp_path):
             np.asarray(a, np.float32), np.asarray(b, np.float32),
             rtol=2e-4, atol=2e-5,
         )
+
+
+def test_dispatcher_thread_joined_on_producer_error(tmp_path, monkeypatch):
+    """An exception raised out of the packing loop (producer decode
+    failure) must still shut the dispatcher thread down via the sentinel
+    + join handshake — the trainer service calls stream_train_mlp every
+    round, so a leaked 'ingest-dispatch' thread accumulates."""
+    import dragonfly2_tpu.schema.native as N
+    from dragonfly2_tpu.trainer.ingest import stream_train_mlp
+
+    p = _write_dataset(tmp_path / "d.csv", 200)
+    real = N.stream_pairs_file
+
+    def poisoned(paths, **kw):
+        # enough yields to dispatch at least one full superbatch (the
+        # dispatcher thread must have started), then fail mid-stream
+        n = 0
+        for item in real(paths, **kw):
+            yield item
+            n += 1
+            if n >= 2:
+                raise RuntimeError("decode failed mid-stream")
+
+    monkeypatch.setattr(N, "stream_pairs_file", poisoned)
+    with pytest.raises(RuntimeError, match="decode failed"):
+        stream_train_mlp(p, passes=50, batch_size=16, eval_every=0)
+    deadline = time.time() + 5.0
+    while time.time() < deadline and any(
+        t.name == "ingest-dispatch" and t.is_alive() for t in threading.enumerate()
+    ):
+        time.sleep(0.05)
+    leaked = [
+        t.name for t in threading.enumerate()
+        if t.name == "ingest-dispatch" and t.is_alive()
+    ]
+    assert not leaked, f"dispatcher thread leaked: {leaked}"
