@@ -480,7 +480,7 @@ def stream_train_mlp(
                 break
             stats.download_records = rows
             stats.pairs += feats.shape[0]
-            if warm_bias and labels.size and disp_thread is None:
+            if warm_bias and labels.size:
                 # warm-start the output bias at (an estimate of) the label
                 # mean so the regression head doesn't spend its first steps
                 # drifting there (train_mlp does the same with the full-data

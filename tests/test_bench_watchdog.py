@@ -1,0 +1,51 @@
+"""bench.py watchdog: a budget overrun must report the best COMPLETED
+timed run when one exists (labeled with the overrun), and only fall back
+to an error line when nothing finished — finished measurements are never
+discarded (the driver records whatever single JSON line bench prints).
+"""
+
+import json
+import threading
+
+import bench
+
+
+def _run_watchdog(monkeypatch, capfd, holder):
+    exited = threading.Event()
+
+    def fake_exit(code):
+        # record instead of killing the test process; the watchdog thread
+        # simply returns after this
+        assert code == 0
+        exited.set()
+
+    monkeypatch.setattr(bench.os, "_exit", fake_exit)
+    bench._watchdog(0.2, holder)
+    assert exited.wait(5.0), "watchdog never fired"
+    out = capfd.readouterr().out.strip()
+    return json.loads(out)
+
+
+def test_watchdog_reports_best_completed_run(monkeypatch, capfd):
+    holder = {"value": 12345.6, "vs_baseline": 0.059, "run_rates": [11000.0, 12345.6]}
+    rec = _run_watchdog(monkeypatch, capfd, holder)
+    assert rec["value"] == 12345.6
+    assert rec["vs_baseline"] == 0.059
+    assert rec["run_rates"] == [11000.0, 12345.6]
+    assert "wall budget" in rec["watchdog_note"]
+    assert "error" not in rec
+
+
+def test_watchdog_errors_when_nothing_finished(monkeypatch, capfd):
+    rec = _run_watchdog(monkeypatch, capfd, {})
+    assert rec["value"] == 0.0
+    assert "wall budget" in rec["error"]
+
+
+def test_watchdog_silent_when_finished_in_time(monkeypatch, capfd):
+    fired = threading.Event()
+    monkeypatch.setattr(bench.os, "_exit", lambda code: fired.set())
+    done, _t0 = bench._watchdog(0.3, {})
+    done.set()
+    assert not fired.wait(0.6)
+    assert capfd.readouterr().out.strip() == ""

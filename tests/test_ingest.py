@@ -424,14 +424,29 @@ def test_dispatcher_thread_joined_on_producer_error(tmp_path, monkeypatch):
     p = _write_dataset(tmp_path / "d.csv", 200)
     real = N.stream_pairs_file
 
+    def _dispatcher_alive():
+        return any(
+            t.name == "ingest-dispatch" and t.is_alive()
+            for t in threading.enumerate()
+        )
+
     def poisoned(paths, **kw):
-        # enough yields to dispatch at least one full superbatch (the
-        # dispatcher thread must have started), then fail mid-stream
+        # yield until the consumer has packed a superbatch and started
+        # the dispatcher thread — the handshake under test cannot be
+        # exercised (and the test would pass vacuously) without it —
+        # then fail mid-stream
         n = 0
         for item in real(paths, **kw):
             yield item
             n += 1
             if n >= 2:
+                deadline = time.time() + 10.0
+                while not _dispatcher_alive():
+                    if time.time() > deadline:
+                        raise AssertionError(
+                            "dispatcher thread never started — poison too early"
+                        )
+                    time.sleep(0.01)
                 raise RuntimeError("decode failed mid-stream")
 
     monkeypatch.setattr(N, "stream_pairs_file", poisoned)
