@@ -128,10 +128,15 @@ def _watchdog(budget_s: float, best_holder: dict):
             if done.is_set():  # main finished in the wake-up window
                 return
             note = f"bench exceeded {budget_s:.0f}s wall budget — device link too slow"
-            if best_holder:
-                # the holder carries value/vs_baseline/run_rates/platform —
-                # the same schema as the main-path success line
-                _emit(watchdog_note=note, **best_holder)
+            # "snap" holds one complete snapshot dict, written with a
+            # single (GIL-atomic) assignment — this read can never see a
+            # half-updated measurement
+            snap = best_holder.get("snap")
+            if snap:
+                # the snapshot carries value/vs_baseline/run_rates/
+                # platform/truncated/run_error — the same schema as the
+                # main-path success line
+                _emit(watchdog_note=note, **snap)
             else:
                 _emit(error=note)
             os._exit(0)
@@ -229,19 +234,26 @@ def main() -> None:
                 while f.read(1 << 24):
                     pass
         _phase(f"page cache warm after {time.perf_counter() - run_t0:.1f}s; compiling warmup fit")
-        stream_train_mlp(
-            paths[0],
-            # enough pairs for at least one full k·B superbatch (≈4 pairs
-            # per record) so the scan executable compiles here, capped so
-            # warmup never trains the whole shard repeatedly
-            passes=steps_per_call,
-            max_records=max(2 * steps_per_call * batch // 4, 50_000),
-            batch_size=batch,
-            workers=1,
-            mesh=mesh,  # same sharding signature as the timed run
-            time_budget_s=150,
-            steps_per_call=steps_per_call,
-        )
+        try:
+            stream_train_mlp(
+                paths[0],
+                # enough pairs for at least one full k·B superbatch (≈4 pairs
+                # per record) so the scan executable compiles here, capped so
+                # warmup never trains the whole shard repeatedly
+                passes=steps_per_call,
+                max_records=max(2 * steps_per_call * batch // 4, 50_000),
+                batch_size=batch,
+                workers=1,
+                mesh=mesh,  # same sharding signature as the timed run
+                time_budget_s=150,
+                steps_per_call=steps_per_call,
+            )
+        except Exception as e:
+            # the one-JSON-line contract holds even when the link dies
+            # during compile/warmup — an error line, never a traceback
+            finished.set()
+            _emit(error=f"warmup fit failed: {e}")
+            return
 
         _phase(f"warmup done at {time.perf_counter() - run_t0:.1f}s; timed runs start")
         profile_dir = os.environ.get("DF_BENCH_PROFILE_DIR", "")
@@ -299,10 +311,12 @@ def main() -> None:
                     # finish — record the failure, keep what we measured
                     run_error = f"run {r + 1}/{repeats} failed: {e}"
                     _phase(run_error)
-                    if best_holder:
+                    prev = best_holder.get("snap")
+                    if prev:
                         # the watchdog line must carry the cause too if
-                        # teardown wedges after this point
-                        best_holder["run_error"] = run_error
+                        # teardown wedges after this point; whole-dict
+                        # replacement keeps the snapshot read atomic
+                        best_holder["snap"] = {**prev, "run_error": run_error}
                     break
                 dt = time.perf_counter() - t0
                 rate = stats.download_records / dt / n_devices
@@ -314,18 +328,18 @@ def main() -> None:
                 )
                 if best is None or rate > best[0]:
                     best = (rate, dt, stats)
-                # keep the watchdog able to report the best finished run
-                # (one shared dict, single-writer; GIL-atomic updates)
-                # a stale flag from a previously-best truncated run must
-                # not stick once an untruncated run takes the lead
-                best_holder.pop("truncated", None)
-                best_holder.update(
-                    value=round(best[0], 1),
-                    vs_baseline=round(best[0] / NORTH_STAR_PER_CHIP, 3),
-                    run_rates=list(run_rates),
+                # keep the watchdog able to report the best finished run:
+                # a COMPLETE fresh snapshot dict per run, installed with
+                # one GIL-atomic assignment, so the watchdog never reads
+                # a half-updated state (e.g. a truncated flag stripped
+                # from a measurement it still belongs to)
+                best_holder["snap"] = {
+                    "value": round(best[0], 1),
+                    "vs_baseline": round(best[0] / NORTH_STAR_PER_CHIP, 3),
+                    "run_rates": list(run_rates),
                     **({"truncated": True} if best[2].truncated else {}),
                     **platform_extra,
-                )
+                }
         finally:
             if profile_dir:
                 # flushed even on a failed run — that's when the trace

@@ -140,6 +140,10 @@ def main() -> int:
                 "hostname=sched-e2e",
                 "--set",
                 "metrics_port=0",
+                # export the probe graph as NetworkTopology records fast
+                # enough for the GNN train leg (reference default: 2h)
+                "--set",
+                "topology_snapshot_interval=2.0",
             ],
             env,
         )
@@ -160,6 +164,11 @@ def main() -> int:
                 "piece_length=65536",
                 "--set",
                 "schedule_timeout=10.0",
+                # fast prober so SyncProbes populates the scheduler's
+                # probe graph within the script's lifetime (the GNN
+                # train leg below consumes its snapshot)
+                "--set",
+                "probe_interval=0.5",
             ]
             if name == "a":
                 # daemon A: static scheduler list + unix socket (the
@@ -375,6 +384,28 @@ def main() -> int:
             if os.path.isfile(p)
         ]
         assert csvs, "no download CSVs to upload"
+
+        # the probe loop (probe_interval=0.5 above) + snapshot timer
+        # (topology_snapshot_interval=2.0) must have exported probe-graph
+        # records by now — the GNN leg trains on them
+        def _topo_csvs():
+            return [
+                p
+                for p in _glob.glob(
+                    os.path.join(records_dir, "**", "networktopology*.csv"),
+                    recursive=True,
+                )
+                if os.path.isfile(p) and os.path.getsize(p) > 0
+            ]
+
+        deadline = time.time() + 60
+        topo = _topo_csvs()
+        while time.time() < deadline and not topo:
+            time.sleep(0.5)
+            topo = _topo_csvs()
+        assert topo, f"no networktopology CSVs under {records_dir}"
+        print("PASS probe loop exported NetworkTopology records")
+
         tchan = glue.dial(trainer_addr)
         tclient = glue.ServiceClient(tchan, glue.TRAINER_SERVICE)
 
@@ -387,16 +418,26 @@ def main() -> int:
                     hostname="sched-e2e",
                     train_mlp=trainer_pb2.TrainMlpRequest(dataset=data),
                 )
+            for p in topo:
+                with open(p, "rb") as f:
+                    data = f.read()
+                yield trainer_pb2.TrainRequest(
+                    ip="10.99.0.1",
+                    hostname="sched-e2e",
+                    train_gnn=trainer_pb2.TrainGnnRequest(dataset=data),
+                )
 
         tclient.Train(_train_reqs(), timeout=600)
         tchan.close()
-        model = None
+        models = {}
         deadline = time.time() + 180
-        while time.time() < deadline and model is None:
+        while time.time() < deadline and len(models) < 2:
             rows = call("GET", "/api/v1/models", token=pat["token"])
-            model = rows[0] if rows else None
+            models = {r["type"]: r for r in rows}
             time.sleep(1)
-        assert model, "trainer never uploaded a model to the manager"
+        assert "mlp" in models, f"no MLP model uploaded: {sorted(models)}"
+        assert "gnn" in models, f"no GNN model uploaded: {sorted(models)}"
+        model = models["mlp"]
         act = call(
             "PUT",
             f"/api/v1/models/{model['model_id']}/versions/{model['version']}/state",
@@ -405,8 +446,8 @@ def main() -> int:
         )
         assert act["state"] == "active"
         print(
-            "PASS train-serve roundtrip (records -> Train RPC -> fit ->"
-            f" CreateModel → activation; eval={model.get('evaluation')})"
+            "PASS train-serve roundtrip (records -> Train RPC -> MLP+GNN fits ->"
+            f" CreateModel → activation; mlp eval={model.get('evaluation')})"
         )
 
         # dynamic certificate issuance: CSR → booted manager's CA →

@@ -75,6 +75,15 @@ def test_failure_before_any_run_emits_error_line(monkeypatch, capfd):
     assert "run 1/3 failed: link down" in rec["error"]
 
 
+def test_warmup_failure_emits_error_line(monkeypatch, capfd):
+    def stub(paths, **kw):
+        raise RuntimeError("link died in compile")
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert rec["value"] == 0.0
+    assert "warmup fit failed: link died in compile" in rec["error"]
+
+
 def test_all_runs_complete_emits_best(monkeypatch, capfd):
     def stub(paths, **kw):
         return None, _stats(1000)

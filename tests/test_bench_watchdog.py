@@ -27,7 +27,13 @@ def _run_watchdog(monkeypatch, capfd, holder):
 
 
 def test_watchdog_reports_best_completed_run(monkeypatch, capfd):
-    holder = {"value": 12345.6, "vs_baseline": 0.059, "run_rates": [11000.0, 12345.6]}
+    holder = {
+        "snap": {
+            "value": 12345.6,
+            "vs_baseline": 0.059,
+            "run_rates": [11000.0, 12345.6],
+        }
+    }
     rec = _run_watchdog(monkeypatch, capfd, holder)
     assert rec["value"] == 12345.6
     assert rec["vs_baseline"] == 0.059
