@@ -27,6 +27,11 @@ class TrainerServerConfig:
     gnn_epochs: int = 60
     min_download_records: int = 1
     min_topology_records: int = 1
+    # third model family: GRU over per-(task,parent) piece-cost
+    # sequences extracted from the same download records (our addition
+    # over the reference's MLP+GNN pair — see trainer/training.py)
+    gru: bool = False
+    gru_min_sequences: int = 8
     incremental: bool = False
     streaming: bool = True
     streaming_workers: int = 1
@@ -78,6 +83,8 @@ class TrainerServer:
                 gnn=GNNFitConfig(epochs=config.gnn_epochs),
                 min_download_records=config.min_download_records,
                 min_topology_records=config.min_topology_records,
+                gru=config.gru,
+                gru_min_sequences=config.gru_min_sequences,
                 incremental=config.incremental,
                 clear_after_train=not config.incremental,
                 streaming=config.streaming,

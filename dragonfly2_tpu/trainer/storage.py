@@ -47,22 +47,37 @@ class TrainerStorage:
 
     # -- reads ------------------------------------------------------------
     def list_download(self, host_id: str) -> list[R.DownloadRecord]:
-        return self._read_concatenated(self.download_path(host_id), R.DownloadRecord)
+        return list(self._iter_concatenated(self.download_path(host_id), R.DownloadRecord))
 
     def list_network_topology(self, host_id: str) -> list[R.NetworkTopologyRecord]:
-        return self._read_concatenated(
-            self.network_topology_path(host_id), R.NetworkTopologyRecord
+        return list(
+            self._iter_concatenated(
+                self.network_topology_path(host_id), R.NetworkTopologyRecord
+            )
         )
 
+    def iter_download_chunks(self, host_id: str, chunk_records: int = 50_000):
+        """Yield lists of ≤ ``chunk_records`` DownloadRecords — the
+        bounded-memory read of an arbitrarily large dataset file (the
+        GRU leg consumes this chunk-wise; the MLP leg streams through
+        the native decoder instead)."""
+        chunk: list = []
+        for rec in self._iter_concatenated(self.download_path(host_id), R.DownloadRecord):
+            chunk.append(rec)
+            if len(chunk) >= chunk_records:
+                yield chunk
+                chunk = []
+        if chunk:
+            yield chunk
+
     @staticmethod
-    def _read_concatenated(path: Path, cls: type) -> list:
+    def _iter_concatenated(path: Path, cls: type):
         """Parse a file made of appended CSV uploads: every upload round
         (and every rotated backup within a round) starts with its own
         header line, so embedded headers must be skipped, not parsed as
-        data rows."""
+        data rows. A generator so callers can bound memory."""
         if not path.exists():
-            return []
-        out = []
+            return
         with open(path, newline="") as f:
             reader = csv.reader(f)
             header: list[str] | None = None
@@ -78,8 +93,7 @@ class TrainerStorage:
                 if row and header and row[0] == header[0]:
                     header = row
                     continue
-                out.append(R.unflatten(cls, dict(zip(header, row))))
-        return out
+                yield R.unflatten(cls, dict(zip(header, row)))
 
     def host_ids(self) -> list[str]:
         """Every host with at least one dataset file (the FedAvg shards)."""

@@ -79,6 +79,9 @@ class TrainingConfig:
     # per parent, reference scheduler/storage/types.go:143-176)
     gru: bool = False
     gru_min_sequences: int = 8
+    # RAM bound for the GRU leg: sequences kept per fit (~70 B each);
+    # past this, more history stops improving the next-cost model
+    gru_max_sequences: int = 1_000_000
     gru_config: FitConfig = field(
         default_factory=lambda: FitConfig(hidden_dims=(32,), batch_size=128, epochs=10)
     )
@@ -341,13 +344,38 @@ class Training:
 
     # -- trainGRU (piece time-series; our addition over the reference) -----
     def _train_gru(self, host_id: str, ip: str, hostname: str) -> dict[str, float]:
-        from dragonfly2_tpu.schema.features import extract_piece_sequences
+        from dragonfly2_tpu.schema.features import PieceSequences, extract_piece_sequences
         from dragonfly2_tpu.trainer.train import train_gru
         from dragonfly2_tpu.utils.idgen import gru_model_id_v1
 
-        seqs = extract_piece_sequences(
-            records_to_columns(self.storage.list_download(host_id))
-        )
+        # sequence extraction is row-local (each Download record yields
+        # its own per-parent sequences), so read the dataset in bounded
+        # chunks instead of materializing the whole file — this leg must
+        # hold the same memory bound as the streaming MLP path. The
+        # sequence count is capped at the NEWEST gru_max_sequences:
+        # records append in time order, so trimming from the front keeps
+        # the fit tracking recent link behavior — in incremental mode
+        # the file is never cleared, and an oldest-first cap would pin
+        # the model to stale history forever.
+        parts: list[PieceSequences] = []
+        total = 0
+        cap = self.config.gru_max_sequences
+        for chunk in self.storage.iter_download_chunks(host_id):
+            s = extract_piece_sequences(records_to_columns(chunk))
+            if s.sequences.shape[0]:
+                parts.append(s)
+                total += s.sequences.shape[0]
+            while parts and total - parts[0].sequences.shape[0] >= cap:
+                total -= parts[0].sequences.shape[0]
+                parts.pop(0)
+        if parts:
+            seqs = PieceSequences(
+                sequences=np.concatenate([p.sequences for p in parts])[-cap:],
+                labels=np.concatenate([p.labels for p in parts])[-cap:],
+                lengths=np.concatenate([p.lengths for p in parts])[-cap:],
+            )
+        else:
+            seqs = extract_piece_sequences({})
         n = seqs.sequences.shape[0]
         if n < self.config.gru_min_sequences:
             raise ValueError(

@@ -115,6 +115,13 @@ def main() -> int:
                 f"data_dir={work}/trainer",
                 "--set",
                 f"manager_address={manager_addr}",
+                # third model family on the same Train stream: GRU over
+                # piece-cost sequences from the download records (the
+                # smoke swarm yields only a handful of sequences)
+                "--set",
+                "gru=true",
+                "--set",
+                "gru_min_sequences=1",
             ],
             env,
         )
@@ -430,13 +437,19 @@ def main() -> int:
         tclient.Train(_train_reqs(), timeout=600)
         tchan.close()
         models = {}
-        deadline = time.time() + 180
-        while time.time() < deadline and len(models) < 2:
+        deadline = time.time() + 240
+        while time.time() < deadline and len(models) < 3:
             rows = call("GET", "/api/v1/models", token=pat["token"])
             models = {r["type"]: r for r in rows}
             time.sleep(1)
-        assert "mlp" in models, f"no MLP model uploaded: {sorted(models)}"
-        assert "gnn" in models, f"no GNN model uploaded: {sorted(models)}"
+        # NOTE: no early exit once some models land — on a 1-core CI box
+        # the three fits' first XLA compiles run concurrently and the
+        # slowest can trail the others by minutes; "two landed, third
+        # missing" does NOT imply the third failed
+        missing_hint = "(check the trainer proc's log for the fit error)"
+        assert "mlp" in models, f"no MLP model uploaded: {sorted(models)} {missing_hint}"
+        assert "gnn" in models, f"no GNN model uploaded: {sorted(models)} {missing_hint}"
+        assert "gru" in models, f"no GRU model uploaded: {sorted(models)} {missing_hint}"
         model = models["mlp"]
         act = call(
             "PUT",
@@ -446,8 +459,8 @@ def main() -> int:
         )
         assert act["state"] == "active"
         print(
-            "PASS train-serve roundtrip (records -> Train RPC -> MLP+GNN fits ->"
-            f" CreateModel → activation; mlp eval={model.get('evaluation')})"
+            "PASS train-serve roundtrip (records -> Train RPC -> MLP+GNN+GRU"
+            f" fits -> CreateModel → activation; models={sorted(models)})"
         )
 
         # dynamic certificate issuance: CSR → booted manager's CA →

@@ -21,7 +21,10 @@ def test_run_cluster_script():
         cwd=REPO,
         capture_output=True,
         text=True,
-        timeout=280,
+        # headroom over the script's own internal deadlines (the model
+        # wait alone may take 240s when three first-compiles share one
+        # CPU core) — the script fails itself long before this fires
+        timeout=480,
     )
     assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-3000:]}"
     assert "CLUSTER E2E: ALL PASS" in proc.stdout

@@ -62,6 +62,71 @@ def test_gru_fit_through_training(tmp_path):
     assert "gru" in types and "mlp" in types
 
 
+def test_iter_download_chunks_matches_list(tmp_path):
+    """The GRU leg's bounded-memory chunked read must see exactly the
+    records list_download sees — including across the embedded headers
+    that separate appended upload rounds."""
+    storage = TrainerStorage(tmp_path / "store")
+    hid = host_id_v2("10.0.0.1", "s1")
+    for seed in (1, 2):  # two upload rounds → an embedded header
+        p = tmp_path / f"round{seed}.csv"
+        write_csv(p, make_download_records(30, seed=seed))
+        storage.append_download(hid, p.read_bytes())
+    full = storage.list_download(hid)
+    chunks = list(storage.iter_download_chunks(hid, chunk_records=7))
+    assert [len(c) for c in chunks] == [7] * 8 + [4]  # 60 records
+    flat = [r for c in chunks for r in c]
+    assert len(flat) == len(full) == 60
+    assert [r.id for r in flat] == [r.id for r in full]
+
+
+def test_gru_max_sequences_caps_the_fit(tmp_path, monkeypatch):
+    """gru_max_sequences bounds what the GRU leg materializes — the fit
+    sees at most the cap, and the NEWEST sequences win (in incremental
+    mode the file is never cleared; an oldest-first cap would pin the
+    model to stale history forever)."""
+    import dragonfly2_tpu.trainer.train as T
+
+    storage = _seed_storage(tmp_path, [("10.0.0.1", "s1", 120, 1)])
+    all_seqs = extract_piece_sequences(
+        records_to_columns(storage.list_download(host_id_v2("10.0.0.1", "s1")))
+    )
+    total = all_seqs.sequences.shape[0]
+    assert total > 4  # the cap below actually bites
+
+    fitted = {}
+    real_train_gru = T.train_gru
+
+    def spy(sequences, labels, **kw):
+        fitted["n"] = sequences.shape[0]
+        fitted["labels"] = np.array(labels)
+        return real_train_gru(sequences, labels, **kw)
+
+    monkeypatch.setattr(T, "train_gru", spy)
+    uploads = []
+
+    class Mgr:
+        def create_model(self, **kw):
+            uploads.append(kw)
+
+    cfg = TrainingConfig(
+        mlp=FitConfig(batch_size=64, epochs=2),
+        gru=True,
+        gru_min_sequences=1,
+        gru_max_sequences=4,
+        min_topology_records=10**9,
+        streaming=False,
+    )
+    t = Training(storage, manager_client=Mgr(), config=cfg)
+    outcome = t.train("10.0.0.1", "s1")
+    assert outcome.gru_error is None, outcome.gru_error
+    assert "gru" in {u["model_type"] for u in uploads}
+    assert fitted["n"] == 4  # the cap, not the full dataset
+    # newest-kept: the fitted labels are the TAIL of the full label
+    # stream, not its head
+    np.testing.assert_array_equal(fitted["labels"], all_seqs.labels[-4:])
+
+
 def test_federated_round_merges_and_uploads(tmp_path):
     storage = _seed_storage(
         tmp_path,
