@@ -274,9 +274,12 @@ class SchedulerService:
             peer.cost_ns = fin.cost_ns
             if peer.fsm.can(res.PEER_EVENT_DOWNLOAD_SUCCEEDED):
                 peer.fsm.event(res.PEER_EVENT_DOWNLOAD_SUCCEEDED)
-            if fin.content_length and peer.task.content_length < 0:
+            # a finished download always knows its true size — 0 is a
+            # legitimate value (empty file), not "unset": truthiness
+            # checks here would leave empty tasks at length -1 forever
+            if peer.task.content_length < 0:
                 peer.task.content_length = fin.content_length
-            if fin.piece_count and peer.task.total_piece_count < 0:
+            if peer.task.total_piece_count < 0:
                 peer.task.total_piece_count = fin.piece_count
             if peer.task.fsm.can(res.TASK_EVENT_DOWNLOAD_SUCCEEDED):
                 peer.task.fsm.event(res.TASK_EVENT_DOWNLOAD_SUCCEEDED)

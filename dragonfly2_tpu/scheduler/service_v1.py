@@ -391,9 +391,11 @@ class SchedulerServiceV1:
             M.DOWNLOAD_PEER_FINISHED_TOTAL.inc()
             if peer.fsm.can(res.PEER_EVENT_DOWNLOAD_SUCCEEDED):
                 peer.fsm.event(res.PEER_EVENT_DOWNLOAD_SUCCEEDED)
-            if request.content_length and peer.task.content_length < 0:
+            # 0 is a legitimate value here (empty file), not "unset" —
+            # a successful ReportPeerResult always carries the true size
+            if peer.task.content_length < 0:
                 peer.task.content_length = request.content_length
-            if request.total_piece_count and peer.task.total_piece_count < 0:
+            if peer.task.total_piece_count < 0:
                 peer.task.total_piece_count = request.total_piece_count
             if peer.task.fsm.can(res.TASK_EVENT_DOWNLOAD_SUCCEEDED):
                 peer.task.fsm.event(res.TASK_EVENT_DOWNLOAD_SUCCEEDED)

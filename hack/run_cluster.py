@@ -266,6 +266,24 @@ def main() -> int:
         assert open(out_r, "rb").read() == payload[1000:66000], "ranged bytes mismatch"
         print("PASS ranged dfget (--range) via daemon B")
 
+        # zero-byte origin: completes as an empty file through both
+        # daemons (reference feature gate dfget-empty-file); the
+        # scheduler must record the true length 0, not stay "unknown"
+        empty_origin = os.path.join(work, "empty.bin")
+        open(empty_origin, "wb").close()
+        for i, addr in enumerate(daemon_addrs):
+            out_e = os.path.join(work, f"out-empty-{i}.bin")
+            rc = subprocess.run(
+                [
+                    sys.executable, "-m", "dragonfly2_tpu.client.dfget",
+                    f"file://{empty_origin}", "-O", out_e, "--daemon", addr,
+                ],
+                env=env, cwd=REPO, capture_output=True, text=True,
+            )
+            assert rc.returncode == 0, f"empty dfget {i} failed: {rc.stderr[-2000:]}"
+            assert os.path.getsize(out_e) == 0, "empty download must be empty"
+        print("PASS empty-file dfget via both daemons")
+
         # stress tool: concurrent load through the daemon RPC, one JSON
         # line of percentiles (reference test/tools/stress)
         rc = subprocess.run(

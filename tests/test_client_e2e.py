@@ -125,6 +125,35 @@ def test_p2p_download_slice(cluster):
     assert task.content_length == len(PAYLOAD)
 
 
+def test_empty_file_download(cluster):
+    """A zero-byte origin completes as an empty output file on both the
+    back-to-source path and the second-daemon path (the reference gates
+    an e2e suite on exactly this: feature_gate.go dfget-empty-file;
+    scheduler-side SIZE_SCOPE_EMPTY short-circuits parent scheduling)."""
+    da, db = cluster["daemons"]
+    tmp = cluster["tmp"]
+    origin = tmp / "empty.bin"
+    origin.write_bytes(b"")
+    url = f"file://{origin}"
+
+    out_a = tmp / "empty-a.bin"
+    paths = dfget.download(f"127.0.0.1:{da.port}", url, str(out_a))
+    assert paths == [str(out_a)]
+    assert out_a.exists() and out_a.read_bytes() == b""
+
+    # a second daemon must also complete (no parents have pieces to
+    # serve for an empty task — it must not hang waiting for any)
+    out_b = tmp / "empty-b.bin"
+    dfget.download(f"127.0.0.1:{db.port}", url, str(out_b))
+    assert out_b.exists() and out_b.read_bytes() == b""
+
+    # the scheduler saw the task and recorded its true (zero) length
+    task_id = da.task_manager.task_id_for(url, None)
+    task = cluster["resource"].task_manager.load(task_id)
+    assert task is not None
+    assert task.content_length == 0
+
+
 def test_reuse_completed_task(cluster):
     da, _ = cluster["daemons"]
     url = cluster["url"]
