@@ -94,8 +94,13 @@ def _attn_kernel(
         ).astype(o_ref.dtype)
         # log-sum-exp per query row — the residual the backward pass
         # rebuilds P from without re-running the online softmax. Rows
-        # with no valid key (padding) keep a -inf-like sentinel.
-        lse_ref[0] = jnp.where(
+        # with no valid key (padding) keep a -inf-like sentinel. The
+        # ref block is [1, 1, BQ]: Mosaic requires a block's trailing
+        # two dims each divisible by (8, 128) or equal to the array's —
+        # the singleton middle axis satisfies the first by equality and
+        # BQ (128, or == T_pad when shorter) the second, where a
+        # [1, BQ] block of a rank-2 [B·H, T] array satisfies neither.
+        lse_ref[0, 0] = jnp.where(
             l_scr[:] > 0.0, m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30)), _NEG_INF
         )
 
@@ -140,11 +145,11 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh, qi)),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi, ki: (bh, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, t_pad), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq,), jnp.float32),  # running max
@@ -155,7 +160,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     )(prep(q), prep(k), prep(v))
 
     out = jnp.moveaxis(out[:, :t].reshape(b, h, t, d), 1, 2)
-    return out, lse[:, :t].reshape(b, h, t)
+    return out, lse[:, 0, :t].reshape(b, h, t)
 
 
 def _blockwise_bwd(q, k, v, out, lse, do, causal, block_k):
