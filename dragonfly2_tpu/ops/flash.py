@@ -114,8 +114,16 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     b, t, h, d = q.shape
     scale = 1.0 / (d**0.5)
 
-    bq = min(block_q, _ceil_to(t, 8))
-    bk = min(block_k, _ceil_to(t, 8))
+    t8 = _ceil_to(t, 8)
+    bq = min(block_q, t8)
+    bk = min(block_k, t8)
+    # Mosaic legality for the [1, 1, BQ] LSE block: BQ must be a
+    # multiple of 128 OR equal the padded sequence (equality holds
+    # exactly when bq covers the whole sequence and bk divides it, so
+    # t_pad == bq). Any other caller block_q hint is rounded up —
+    # block size is a scheduling hint, never semantics.
+    if bq % 128 and not (bq >= t8 and bq % bk == 0):
+        bq = min(_ceil_to(bq, 128), _ceil_to(t8, 128))
     t_pad = _ceil_to(t, math.lcm(bq, bk))
 
     def prep(x):
