@@ -114,16 +114,23 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     b, t, h, d = q.shape
     scale = 1.0 / (d**0.5)
 
+    # Canonicalize caller block hints to Mosaic-legal, low-padding
+    # tiles — block size is a scheduling hint, never semantics. Rules:
+    # every block's sublane dim must be a multiple of 8 (bq for q/out,
+    # bk for k/v), and the [1, 1, BQ] LSE block's lane dim must be a
+    # multiple of 128 OR equal the padded sequence (the "one query
+    # block covers everything" escape). bk is then snapped down to a
+    # divisor of bq so t_pad == ceil_to(t, bq) — never more than one
+    # block of padding (an unaligned pair like (128, 127) would
+    # otherwise drive t_pad to lcm = 16k+ for a 512-token call).
     t8 = _ceil_to(t, 8)
-    bq = min(block_q, t8)
-    bk = min(block_k, t8)
-    # Mosaic legality for the [1, 1, BQ] LSE block: BQ must be a
-    # multiple of 128 OR equal the padded sequence (equality holds
-    # exactly when bq covers the whole sequence and bk divides it, so
-    # t_pad == bq). Any other caller block_q hint is rounded up —
-    # block size is a scheduling hint, never semantics.
-    if bq % 128 and not (bq >= t8 and bq % bk == 0):
+    bq = _ceil_to(min(block_q, t8), 8)
+    bk = _ceil_to(min(block_k, t8), 8)
+    if not (bq >= t8 and bq % bk == 0) and bq % 128:
         bq = min(_ceil_to(bq, 128), _ceil_to(t8, 128))
+    bk = min(bk, bq)
+    while bq % bk:  # 8 divides bq, so this terminates by bk == 8
+        bk -= 8
     t_pad = _ceil_to(t, math.lcm(bq, bk))
 
     def prep(x):

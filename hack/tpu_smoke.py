@@ -69,7 +69,13 @@ def main() -> None:
 
     # non-default block hints must stay Mosaic-legal (the LSE lane rule
     # bites when block_q isn't a multiple of 128)
-    for bq_hint, bk_hint, t in [(64, 64, 512), (24, 16, 100), (32, 96, 96)]:
+    for bq_hint, bk_hint, t in [
+        (64, 64, 512),
+        (24, 16, 100),
+        (32, 96, 96),
+        (127, 127, 512),  # unaligned pair: must not lcm-explode t_pad
+        (128, 12, 512),  # bk not a multiple of 8: sublane rule
+    ]:
         key = jax.random.PRNGKey(bq_hint * t)
         q, k, v = (
             jax.random.normal(kk, (1, t, 2, 32), jnp.float32)
@@ -134,6 +140,25 @@ def main() -> None:
         gp, jnp.asarray(seqs), jnp.full((16,), 6, np.int32)
     )
     check("gru pred finite", 0.0 if np.isfinite(np.asarray(pred)).all() else 1.0, 0.5)
+
+    # ---- orbax checkpoint round-trip of on-device arrays ----
+    import tempfile
+
+    from dragonfly2_tpu.models import mlp as mlp_mod
+    from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
+    from dragonfly2_tpu.trainer.checkpoint import FitCheckpointer, params_equal
+
+    params = jax.device_put(
+        mlp_mod.init_mlp(jax.random.PRNGKey(0), [MLP_FEATURE_DIM, 32, 1])
+    )
+    with tempfile.TemporaryDirectory(prefix="smoke-ckpt-") as d:
+        ck = FitCheckpointer(d)
+        state = {"params": params, "epoch": 3}
+        ck.save(3, state)
+        got = ck.restore_latest(like=state)
+        ck.close()
+        ok = got is not None and got[0] == 3 and params_equal(params, got[1]["params"])
+        check("orbax device-array round-trip", 0.0 if ok else 1.0, 0.5)
 
     if failures:
         raise SystemExit(f"SMOKE FAILURES: {failures}")
