@@ -109,20 +109,16 @@ def _ceil_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
-    """Pallas forward → (out [B,T,H,D], lse [B,H,T] fp32)."""
-    b, t, h, d = q.shape
-    scale = 1.0 / (d**0.5)
-
-    # Canonicalize caller block hints to Mosaic-legal, low-padding
-    # tiles — block size is a scheduling hint, never semantics. Rules:
-    # every block's sublane dim must be a multiple of 8 (bq for q/out,
-    # bk for k/v), and the [1, 1, BQ] LSE block's lane dim must be a
-    # multiple of 128 OR equal the padded sequence (the "one query
-    # block covers everything" escape). bk is then snapped down to a
-    # divisor of bq so t_pad == ceil_to(t, bq) — never more than one
-    # block of padding (an unaligned pair like (128, 127) would
-    # otherwise drive t_pad to lcm = 16k+ for a 512-token call).
+def _legal_blocks(block_q: int, block_k: int, t: int) -> tuple[int, int, int]:
+    """Canonicalize caller block hints to Mosaic-legal, low-padding
+    tiles → (bq, bk, t_pad) — block size is a scheduling hint, never
+    semantics. Rules: every block's sublane dim must be a multiple of 8
+    (bq for q/out, bk for k/v), and the [1, 1, BQ] LSE block's lane dim
+    must be a multiple of 128 OR equal the padded sequence (the "one
+    query block covers everything" escape). bk is then snapped down to
+    a divisor of bq so t_pad == ceil_to(t, bq) — never more than one
+    block of padding (an unaligned pair like (128, 127) would otherwise
+    drive t_pad to lcm = 16k+ for a 512-token call)."""
     t8 = _ceil_to(t, 8)
     bq = _ceil_to(min(block_q, t8), 8)
     bk = _ceil_to(min(block_k, t8), 8)
@@ -131,7 +127,15 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     bk = min(bk, bq)
     while bq % bk:  # 8 divides bq, so this terminates by bk == 8
         bk -= 8
-    t_pad = _ceil_to(t, math.lcm(bq, bk))
+    return bq, bk, _ceil_to(t, math.lcm(bq, bk))
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+    """Pallas forward → (out [B,T,H,D], lse [B,H,T] fp32)."""
+    b, t, h, d = q.shape
+    scale = 1.0 / (d**0.5)
+
+    bq, bk, t_pad = _legal_blocks(block_q, block_k, t)
 
     def prep(x):
         # [B, T, H, D] → [B·H, T_pad, D]

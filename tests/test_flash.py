@@ -141,3 +141,32 @@ def test_ulysses_pallas_path_trains():
             np.asarray(a), np.asarray(b_), atol=2e-3, rtol=2e-3,
             err_msg=f"d{name} diverges between Pallas and XLA Ulysses paths",
         )
+
+
+def test_block_hint_legalization_properties():
+    """Every caller hint must canonicalize to Mosaic-legal tiles with
+    bounded padding: sublane dims multiples of 8, the LSE lane dim a
+    multiple of 128 or equal to t_pad, bk dividing bq, and t_pad within
+    one block of t. (The TPU lowering rules the CPU interpreter cannot
+    enforce — hack/tpu_smoke.py compiles a sample of these on the real
+    chip; this pins the arithmetic for the whole space.)"""
+    hypothesis = pytest.importorskip("hypothesis")
+    given, settings, st = hypothesis.given, hypothesis.settings, hypothesis.strategies
+
+    from dragonfly2_tpu.ops.flash import _legal_blocks
+
+    @settings(max_examples=300, deadline=None)
+    @given(
+        st.integers(min_value=1, max_value=512),
+        st.integers(min_value=1, max_value=512),
+        st.integers(min_value=1, max_value=4096),
+    )
+    def prop(block_q, block_k, t):
+        bq, bk, t_pad = _legal_blocks(block_q, block_k, t)
+        assert bq % 8 == 0 and bk % 8 == 0  # sublane rule
+        assert bq % 128 == 0 or bq == t_pad  # LSE lane rule
+        assert bq % bk == 0  # no lcm blowup
+        assert t_pad % bq == 0 and t_pad % bk == 0  # grid divides
+        assert t <= t_pad <= t + max(bq, bk)  # bounded padding
+
+    prop()
