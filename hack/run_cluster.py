@@ -284,6 +284,39 @@ def main() -> int:
             assert os.path.getsize(out_e) == 0, "empty download must be empty"
         print("PASS empty-file dfget via both daemons")
 
+        # dfcache: import a local file into the cache through the real
+        # daemon binary, stat it, export it back (reference dfcache e2e)
+        cache_src = os.path.join(work, "cache-src.bin")
+        with open(cache_src, "wb") as f:
+            f.write(os.urandom(70 * 1024))
+        cache_url = "d7y:///cache-e2e"
+        for cmd_args in (
+            ["import", cache_url, "--path", cache_src],
+            ["stat", cache_url],
+            # --local-only on export: the step must assert a LOCAL cache
+            # hit — without it a miss falls back to "downloading" the
+            # unresolvable d7y:// url instead of failing crisply
+            [
+                "export", cache_url, "--local-only",
+                "--output", os.path.join(work, "cache-out.bin"),
+            ],
+        ):
+            rc = subprocess.run(
+                [
+                    sys.executable, "-m", "dragonfly2_tpu.client.dfcache",
+                    *cmd_args, "--daemon", daemon_addrs[0],
+                ],
+                env=env, cwd=REPO, capture_output=True, text=True, timeout=60,
+            )
+            assert rc.returncode == 0, (
+                f"dfcache {cmd_args[0]} failed: {rc.stderr[-2000:]}"
+            )
+        assert (
+            open(os.path.join(work, "cache-out.bin"), "rb").read()
+            == open(cache_src, "rb").read()
+        ), "dfcache export bytes mismatch"
+        print("PASS dfcache import/stat/export via daemon A")
+
         # stress tool: concurrent load through the daemon RPC, one JSON
         # line of percentiles (reference test/tools/stress)
         rc = subprocess.run(
