@@ -40,6 +40,9 @@ def run(name: str, server) -> int:
     raddr = getattr(server, "rest_addr", None)
     if raddr:
         print(f"REST {name} {raddr}", flush=True)
+    gaddr = getattr(server, "gateway_addr", None)
+    if gaddr:
+        print(f"GATEWAY {name} {gaddr}", flush=True)
     print(f"READY {name} {addr}", flush=True)
     try:
         stop_event.wait()

@@ -82,6 +82,7 @@ class DaemonConfig:
     # matching proxy_mitm_hosts regexes are intercepted (empty = all)
     proxy_mitm: bool = False
     proxy_mitm_hosts: list = field(default_factory=list)
+    object_storage_host: str = "127.0.0.1"  # bind address (0.0.0.0 in containers)
     # object-storage gateway: -1 = disabled, 0 = ephemeral port; the
     # backend dir is the bucket store (shared across daemons — NFS/S3
     # mount in production, a shared tmp dir in tests)
@@ -336,6 +337,7 @@ class Daemon:
                 transport=transport,
                 importer=self._import_object,
                 url_for=lambda bucket, key: f"file://{backend_root}/{bucket}/{key}",
+                address=self.cfg.object_storage_host,
                 port=self.cfg.object_storage_port,
             )
             self.object_gateway.start()
@@ -667,6 +669,15 @@ class _DaemonRunAdapter:
     def serve(self) -> str:
         self.daemon.start()
         host = self.daemon.cfg.listen.rsplit(":", 1)[0]
+        if self.daemon.object_gateway is not None:
+            # surfaced as a "GATEWAY <name> <addr>" line by the runner so
+            # subprocess drivers (hack/run_cluster.py) can reach it —
+            # advertise the gateway's OWN bind host, which may differ
+            # from the gRPC listen host
+            self.gateway_addr = (
+                f"{self.daemon.cfg.object_storage_host}:"
+                f"{self.daemon.object_gateway.port}"
+            )
         return f"{host}:{self.daemon.port}"
 
     def stop(self) -> None:

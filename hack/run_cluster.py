@@ -41,6 +41,7 @@ class Proc:
         self.addr: str | None = None
         self.metrics_addr: str | None = None
         self.rest_addr: str | None = None
+        self.gateway_addr: str | None = None
         # a dedicated reader thread avoids mixing select() on the raw fd
         # with buffered readline() (lines stranded in the TextIOWrapper
         # buffer would make select starve)
@@ -67,6 +68,8 @@ class Proc:
                 self.metrics_addr = line.split()[2]
             if line.startswith("REST "):
                 self.rest_addr = line.split()[2]
+            if line.startswith("GATEWAY "):
+                self.gateway_addr = line.split()[2]
             if line.startswith("READY "):
                 self.addr = line.split()[2]
                 return self.addr
@@ -187,11 +190,13 @@ def main() -> int:
             else:
                 # daemon B: no static list — scheduler set discovered
                 # from the manager (dynconfig), and it registers itself
-                # as a seed peer
+                # as a seed peer; also fronts the object-storage gateway
                 args += [
                     "--set", 'scheduler_address=""',
                     "--set", f"manager_address={manager_addr}",
                     "--set", "host_type=super",
+                    "--set", "object_storage_port=0",
+                    "--set", f"object_storage_dir={work}/objects",
                 ]
             d = Proc(f"daemon-{name}", args, env)
             procs.append(d)
@@ -316,6 +321,35 @@ def main() -> int:
             == open(cache_src, "rb").read()
         ), "dfcache export bytes mismatch"
         print("PASS dfcache import/stat/export via daemon A")
+
+        # dfstore: object put/stat/get through daemon B's real gateway
+        # process (S3-verb surface; upload seeds the swarm)
+        gateway = daemons[1].gateway_addr
+        assert gateway, "daemon B did not report a GATEWAY address"
+        store_src = os.path.join(work, "store-src.bin")
+        with open(store_src, "wb") as f:
+            f.write(os.urandom(90 * 1024))
+        store_out = os.path.join(work, "store-out.bin")
+        for cmd_args in (
+            ["mb", "df://e2e"],
+            ["cp", store_src, "df://e2e/dir/obj.bin"],
+            ["stat", "df://e2e/dir/obj.bin"],
+            ["cp", "df://e2e/dir/obj.bin", store_out],
+        ):
+            rc = subprocess.run(
+                [
+                    sys.executable, "-m", "dragonfly2_tpu.client.dfstore",
+                    "--endpoint", gateway, *cmd_args,
+                ],
+                env=env, cwd=REPO, capture_output=True, text=True, timeout=60,
+            )
+            assert rc.returncode == 0, (
+                f"dfstore {cmd_args[0]} failed: {rc.stderr[-2000:]}"
+            )
+        assert (
+            open(store_out, "rb").read() == open(store_src, "rb").read()
+        ), "dfstore round-trip bytes mismatch"
+        print("PASS dfstore mb/cp/stat round-trip via daemon B gateway")
 
         # stress tool: concurrent load through the daemon RPC, one JSON
         # line of percentiles (reference test/tools/stress)
