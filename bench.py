@@ -336,6 +336,13 @@ def main() -> None:
                 best_holder["snap"] = {
                     "value": round(best[0], 1),
                     "vs_baseline": round(best[0] / NORTH_STAR_PER_CHIP, 3),
+                    # the full success-line schema, so a watchdog-path
+                    # line parses identically to a normal one
+                    "records": best[2].download_records,
+                    "pairs": best[2].pairs,
+                    "steps": best[2].steps,
+                    "wall_s": round(best[1], 2),
+                    "host_cores": ncpu,
                     "run_rates": list(run_rates),
                     **({"truncated": True} if best[2].truncated else {}),
                     **platform_extra,

@@ -392,7 +392,11 @@ class SchedulerServiceV1:
             if peer.fsm.can(res.PEER_EVENT_DOWNLOAD_SUCCEEDED):
                 peer.fsm.event(res.PEER_EVENT_DOWNLOAD_SUCCEEDED)
             # 0 is a legitimate value here (empty file), not "unset" —
-            # a successful ReportPeerResult always carries the true size
+            # a successful ReportPeerResult always carries the true size.
+            # Trusting the report verbatim matches the reference, which
+            # Stores unconditionally on first task success
+            # (service_v1.go:1350-1352 handleTaskSuccess); proto3 cannot
+            # distinguish an omitted int from a true 0 either way.
             if peer.task.content_length < 0:
                 peer.task.content_length = request.content_length
             if peer.task.total_piece_count < 0:

@@ -56,13 +56,24 @@ class TrainerStorage:
             )
         )
 
-    def iter_download_chunks(self, host_id: str, chunk_records: int = 50_000):
+    def iter_download_chunks(
+        self,
+        host_id: str,
+        chunk_records: int = 50_000,
+        max_bytes: int | None = None,
+    ):
         """Yield lists of ≤ ``chunk_records`` DownloadRecords — the
         bounded-memory read of an arbitrarily large dataset file (the
         GRU leg consumes this chunk-wise; the MLP leg streams through
-        the native decoder instead)."""
+        the native decoder instead). ``max_bytes`` stops the read at a
+        record-aligned byte boundary (pass a committed round boundary):
+        this generator stays open across long extraction pauses, so
+        without a bound a concurrent Train-stream append could be read
+        mid-write as a torn trailing row."""
         chunk: list = []
-        for rec in self._iter_concatenated(self.download_path(host_id), R.DownloadRecord):
+        for rec in self._iter_concatenated(
+            self.download_path(host_id), R.DownloadRecord, max_bytes=max_bytes
+        ):
             chunk.append(rec)
             if len(chunk) >= chunk_records:
                 yield chunk
@@ -71,15 +82,26 @@ class TrainerStorage:
             yield chunk
 
     @staticmethod
-    def _iter_concatenated(path: Path, cls: type):
+    def _iter_concatenated(path: Path, cls: type, max_bytes: int | None = None):
         """Parse a file made of appended CSV uploads: every upload round
         (and every rotated backup within a round) starts with its own
         header line, so embedded headers must be skipped, not parsed as
-        data rows. A generator so callers can bound memory."""
+        data rows. A generator so callers can bound memory. With
+        ``max_bytes``, only lines that END at or before that offset are
+        parsed — callers pass a record-aligned boundary, so no torn or
+        in-flight trailing data is ever decoded."""
         if not path.exists():
             return
-        with open(path, newline="") as f:
-            reader = csv.reader(f)
+        with open(path, "rb") as bf:
+            def lines():
+                consumed = 0
+                for raw in bf:
+                    consumed += len(raw)
+                    if max_bytes is not None and consumed > max_bytes:
+                        return
+                    yield raw.decode("utf-8", errors="replace")
+
+            reader = csv.reader(lines())
             header: list[str] | None = None
             for row in reader:
                 if header is None:

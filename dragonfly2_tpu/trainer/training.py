@@ -360,7 +360,12 @@ class Training:
         parts: list[PieceSequences] = []
         total = 0
         cap = self.config.gru_max_sequences
-        for chunk in self.storage.iter_download_chunks(host_id):
+        # read only up to the committed round boundary: this generator
+        # stays open across extraction pauses, and a concurrent Train
+        # stream may be appending past it (same protocol as the MLP
+        # leg's offset/boundary machinery)
+        boundary = self.storage.download_round_boundary(host_id)
+        for chunk in self.storage.iter_download_chunks(host_id, max_bytes=boundary):
             s = extract_piece_sequences(records_to_columns(chunk))
             if s.sequences.shape[0]:
                 parts.append(s)
