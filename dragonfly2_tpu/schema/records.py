@@ -259,9 +259,20 @@ def _flat_plan(cls: type) -> tuple:
     return tuple(plan)
 
 
-def flatten(rec: Any, prefix: str = "") -> dict[str, Any]:
+def flatten(rec: Any, prefix: str = "", skip_padding: bool = False) -> dict[str, Any]:
     """Flatten a record into dotted keys; fixed-width lists are padded with
-    default-constructed elements so every row has identical columns."""
+    default-constructed elements so every row has identical columns.
+
+    ``skip_padding`` OMITS the padding columns instead (the CSV writer pairs
+    it with ``DictWriter(restval="")`` so padding serializes as EMPTY cells,
+    not ``"0"``s). Lossless: ``unflatten``'s ``_coerce`` reads ``""`` as the
+    field default and ``_trim_padding`` already drops trailing default-equal
+    elements, and the decoders key parent validity on a non-empty id
+    (features.py:120, native empty-slot fast-forward). Empty cells shrink
+    rows ~17% and let the native scanner's tail short-circuit skip the
+    padding bytes entirely — the delta vs the reference's gocsv (which
+    serializes zero-values as ``"0"``, reference scheduler/storage
+    types.go) is documented in PARITY.md."""
     out: dict[str, Any] = {}
     for name, kind, extra in _flat_plan(type(rec)):
         key = f"{prefix}{name}"
@@ -269,13 +280,14 @@ def flatten(rec: Any, prefix: str = "") -> dict[str, Any]:
         if kind == "list":
             width, empty_flat = extra
             for i, item in enumerate(value[:width]):
-                out.update(flatten(item, prefix=f"{key}.{i}."))
-            for i in range(len(value), width):
-                p = f"{key}.{i}."
-                for k, v in empty_flat:
-                    out[p + k] = v
+                out.update(flatten(item, prefix=f"{key}.{i}.", skip_padding=skip_padding))
+            if not skip_padding:
+                for i in range(len(value), width):
+                    p = f"{key}.{i}."
+                    for k, v in empty_flat:
+                        out[p + k] = v
         elif kind == "record":
-            out.update(flatten(value, prefix=f"{key}."))
+            out.update(flatten(value, prefix=f"{key}.", skip_padding=skip_padding))
         else:
             out[key] = value
     return out
@@ -300,9 +312,23 @@ def unflatten(cls: type, row: dict[str, Any], prefix: str = "") -> Any:
     return cls(**kwargs)
 
 
+@functools.lru_cache(maxsize=None)
+def _empty_element(elem_cls: type) -> Any:
+    """The element an all-empty-cells row slice unflattens to. Differs from
+    ``elem_cls()`` where a string field has a non-empty default (e.g.
+    HostRecord.type == "normal"): the CSV writer omits padding cells
+    entirely (flatten ``skip_padding``), so they read back as ``""``, not
+    the field default."""
+    return unflatten(elem_cls, {})
+
+
 def _trim_padding(items: list, elem_cls: type) -> list:
-    empty = elem_cls()
-    while items and items[-1] == empty:
+    # Two padding spellings: default-constructed elements (pre-empty-cell
+    # files, where gocsv-style "0"s round-trip to defaults) and all-empty
+    # cells (current writer). Both are semantically invalid as real
+    # elements — parent/dest validity keys on a non-empty id everywhere.
+    defaults = (elem_cls(), _empty_element(elem_cls))
+    while items and (items[-1] == defaults[0] or items[-1] == defaults[1]):
         items.pop()
     return items
 

@@ -1,0 +1,80 @@
+// Standalone decode-rate driver: feeds a download-record CSV file through
+// the DfPairs parser exactly the way schema/native.py does (8 MiB chunks,
+// f16 take after every chunk) and prints MB/s + records/s. Used for
+// profiling (build with -pg) and for the bench artifact's decode_only_rate.
+//
+// Usage: decode_bench FILE [passes]
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <chrono>
+#include <vector>
+
+extern "C" {
+void* df_pairs_new();
+void df_pairs_free(void*);
+long df_pairs_feed(void*, const char*, long);
+void df_pairs_finish(void*);
+long df_pairs_count(void*);
+long df_pairs_rows(void*);
+long df_pairs_errors(void*);
+long df_pairs_take_half(void*, uint16_t*, uint16_t*, int32_t*);
+long df_feature_dim();
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s FILE [passes]\n", argv[0]);
+    return 2;
+  }
+  int passes = argc > 2 ? atoi(argv[2]) : 1;
+  FILE* f = fopen(argv[1], "rb");
+  if (!f) {
+    perror("open");
+    return 1;
+  }
+  // Read the whole file up front so the timed loop measures decode, not IO.
+  std::vector<char> data;
+  {
+    char buf[1 << 20];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof(buf), f)) > 0)
+      data.insert(data.end(), buf, buf + n);
+  }
+  fclose(f);
+
+  const long F = df_feature_dim();
+  const size_t chunk = 8u << 20;
+  std::vector<uint16_t> feat, label;
+  std::vector<int32_t> idx;
+  long rows = 0, pairs = 0;
+  auto t0 = std::chrono::steady_clock::now();
+  for (int p = 0; p < passes; ++p) {
+    void* h = df_pairs_new();
+    for (size_t off = 0; off < data.size(); off += chunk) {
+      size_t n = data.size() - off < chunk ? data.size() - off : chunk;
+      df_pairs_feed(h, data.data() + off, long(n));
+      long m = df_pairs_count(h);
+      feat.resize(size_t(m) * F);
+      label.resize(size_t(m));
+      idx.resize(size_t(m));
+      pairs += df_pairs_take_half(h, feat.data(), label.data(), idx.data());
+    }
+    df_pairs_finish(h);
+    long m = df_pairs_count(h);
+    feat.resize(size_t(m) * F);
+    label.resize(size_t(m));
+    idx.resize(size_t(m));
+    pairs += df_pairs_take_half(h, feat.data(), label.data(), idx.data());
+    rows += df_pairs_rows(h);
+    df_pairs_free(h);
+  }
+  auto dt = std::chrono::duration<double>(std::chrono::steady_clock::now() - t0).count();
+  double mb = double(data.size()) * passes / 1e6;
+  printf("{\"bytes\": %zu, \"passes\": %d, \"records\": %ld, \"pairs\": %ld, "
+         "\"seconds\": %.4f, \"mb_per_s\": %.1f, \"records_per_s\": %.1f}\n",
+         data.size(), passes, rows, pairs, dt, mb / dt, rows / dt);
+  return 0;
+}

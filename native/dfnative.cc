@@ -7,9 +7,11 @@
 // csv/numpy decode the bottleneck. This library streams the trainer's
 // concatenated-CSV dataset files and emits training tensors directly:
 //
-//  - DfPairs: download records → (download,parent) pair features [M,12]
-//    + log-cost labels, byte-identical semantics to
-//    schema/features.extract_pair_features (the Python fallback).
+//  - DfPairs: download records → (download,parent) pair features [M,18]
+//    (kFeatureDim below — kept in lockstep with features.MLP_FEATURE_DIM
+//    by the df_feature_dim ABI handshake) + log-cost labels, byte-identical
+//    semantics to schema/features.extract_pair_features (the Python
+//    fallback).
 //  - DfTopo: networktopology records → interned host nodes + probe edge
 //    list, matching schema/features.build_probe_graph's interning and
 //    last-write-wins edge semantics.
@@ -22,10 +24,12 @@
 // C ABI only — bound from Python via ctypes (schema/native.py).
 
 #include <cmath>
+#include <cstddef>  // offsetof — do not rely on <immintrin.h> pulling it in
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <type_traits>
 #include <unordered_map>
 #include <vector>
 
@@ -114,66 +118,105 @@ double to_num_slow(const char* p, size_t n) {
   return strtod(buf, nullptr);
 }
 
+// SWAR digit-run helpers (the classic 8-digits-per-multiply technique —
+// same per-digit arithmetic as the scalar loop, so results stay
+// bit-identical to the numpy fallback's float()):
+//   parse8: 8 ASCII digits → their base-10 value
+static inline uint32_t parse8(uint64_t v) {
+  v = (v & 0x0F0F0F0F0F0F0F0Full) * 2561 >> 8;
+  v = (v & 0x00FF00FF00FF00FFull) * 6553601 >> 16;
+  return uint32_t((v & 0x0000FFFF0000FFFFull) * 42949672960001ull >> 32);
+}
+// Leading digit-byte count of an 8-byte window (little-endian: byte 0 is
+// the first character), 0..8.
+static inline size_t digit_run_len8(uint64_t v) {
+  const uint64_t t =
+      ((v & 0xF0F0F0F0F0F0F0F0ull) |
+       (((v + 0x0606060606060606ull) & 0xF0F0F0F0F0F0F0F0ull) >> 4)) ^
+      0x3333333333333333ull;
+  return t ? size_t(__builtin_ctzll(t)) >> 3 : 8;
+}
+
+// Extend acc by the digit run starting at p, stopping at the first
+// non-digit; returns the run length. 8-byte loads stay within [p, p+len)
+// — len is the field remainder, so no read ever crosses the feed
+// buffer's end. Per-digit arithmetic is identical to the scalar
+// original, so results are bit-equal.
+static inline size_t parse_run(const char* p, size_t len, uint64_t& acc) {
+  size_t i = 0;
+  while (i + 8 <= len) {
+    uint64_t v;
+    memcpy(&v, p + i, 8);
+    const size_t k = digit_run_len8(v);
+    if (k == 8) {
+      acc = acc * 100000000ull + parse8(v);
+      i += 8;
+      continue;
+    }
+    for (size_t j = 0; j < k; ++j)
+      acc = acc * 10 + (unsigned(p[i + j]) - '0');
+    return i + k;
+  }
+  for (; i < len; ++i) {
+    const unsigned d = unsigned(p[i]) - '0';
+    if (d > 9) break;
+    acc = acc * 10 + d;
+  }
+  return i;
+}
+
 // Fast decimal parse for the hot path: [-]digits[.digits]; anything else
-// (exponents, >18 digits, inf/nan) falls back to strtod. CSV numbers here
-// are short host stats and ns costs, so the fast path covers ~all fields.
+// (exponents, >18 digits on either side of the dot, inf/nan) falls back
+// to strtod. CSV numbers here are host stats (long float reprs) and ns
+// costs (10-13 digit ints), so the fast path covers ~all fields — with
+// no libc calls. The accumulation order (integer build-up, then one
+// double add+divide) matches the scalar original exactly — parity with
+// the Python fallback. (Divergence note: >18 fractional digits now go
+// to strtod — correctly rounded, like Python's float() — where the old
+// loop truncated; double reprs carry ≤17 digits, so self-produced files
+// never hit this.)
 double parse_num(const char* p, size_t n) {
   if (n == 0) return 0.0;
   static const double kPow10[] = {1.0,    1e1,  1e2,  1e3,  1e4,  1e5,  1e6,
                                   1e7,    1e8,  1e9,  1e10, 1e11, 1e12, 1e13,
                                   1e14,   1e15, 1e16, 1e17, 1e18};
-  size_t i = 0;
-  bool neg = false;
-  if (p[0] == '-') {
-    neg = true;
-    i = 1;
-  }
+  const size_t s = (p[0] == '-') ? 1 : 0;
+  const bool neg = s != 0;
   uint64_t ip = 0;
-  size_t digits = 0;
-  for (; i < n; ++i) {
-    unsigned d = unsigned(p[i]) - '0';
-    if (d > 9) break;
-    ip = ip * 10 + d;
-    if (++digits > 18) return to_num_slow(p, n);
-  }
-  if (digits == 0) return to_num_slow(p, n);
-  if (i == n) return neg ? -double(ip) : double(ip);
-  if (p[i] != '.') return to_num_slow(p, n);
-  ++i;
+  const size_t li = parse_run(p + s, n - s, ip);  // integer-part digits
+  // li > 18: ip may have wrapped, but it is never used — strtod takes over
+  if (li == 0 || li > 18) return to_num_slow(p, n);
+  const size_t dot = s + li;
+  if (dot == n) return neg ? -double(ip) : double(ip);
+  if (p[dot] != '.') return to_num_slow(p, n);
   uint64_t fp = 0;
-  size_t fd = 0;
-  for (; i < n; ++i) {
-    unsigned d = unsigned(p[i]) - '0';
-    if (d > 9) break;
-    if (fd < 18) {
-      fp = fp * 10 + d;
-      ++fd;
-    }
-  }
-  if (i != n) return to_num_slow(p, n);
-  double v = double(ip) + double(fp) / kPow10[fd];
+  const size_t lf = parse_run(p + dot + 1, n - dot - 1, fp);
+  if (dot + 1 + lf != n || lf > 18) return to_num_slow(p, n);
+  const double v = double(ip) + double(fp) / kPow10[lf];
   return neg ? -v : v;
 }
 
 double to_num(const FieldRef& f) { return parse_num(f.data, f.len); }
 
 // Shared leading "|"-separated path depth / kMaxLocationDepth
-// (features.location_affinity).
-double location_affinity(const std::string& a, const std::string& b) {
-  if (a.empty() || b.empty()) return 0.0;
+// (features.location_affinity). Operates on line views — no allocation.
+double location_affinity(const char* pa, size_t na, const char* pb, size_t nb) {
+  if (na == 0 || nb == 0) return 0.0;
   int depth = 0;
   size_t ia = 0, ib = 0;
   for (int d = 0; d < kMaxLocationDepth; ++d) {
-    if (ia > a.size() || ib > b.size()) break;
-    size_t ea = a.find('|', ia);
-    size_t eb = b.find('|', ib);
-    size_t la = (ea == std::string::npos ? a.size() : ea) - ia;
-    size_t lb = (eb == std::string::npos ? b.size() : eb) - ib;
-    if (la != lb || memcmp(a.data() + ia, b.data() + ib, la) != 0) break;
+    if (ia > na || ib > nb) break;
+    const char* ca =
+        static_cast<const char*>(memchr(pa + ia, '|', na - ia));
+    const char* cb =
+        static_cast<const char*>(memchr(pb + ib, '|', nb - ib));
+    size_t la = (ca ? size_t(ca - pa) : na) - ia;
+    size_t lb = (cb ? size_t(cb - pb) : nb) - ib;
+    if (la != lb || memcmp(pa + ia, pb + ib, la) != 0) break;
     ++depth;
-    if (ea == std::string::npos || eb == std::string::npos) break;
-    ia = ea + 1;
-    ib = eb + 1;
+    if (!ca || !cb) break;
+    ia = size_t(ca - pa) + 1;
+    ib = size_t(cb - pb) + 1;
   }
   return double(depth) / kMaxLocationDepth;
 }
@@ -264,77 +307,74 @@ void feed_lines(std::string& carry, bool& in_quotes, const char* buf, long len,
 // Download-record pair decoder
 // ---------------------------------------------------------------------------
 
-enum PairCol : uint8_t {
-  C_IGNORE = 0,
-  C_TOTAL_PIECES,
-  C_CHILD_IDC,
-  C_CHILD_LOC,
-  C_CHILD_CPU,
-  C_CHILD_MEM,
-  C_TASK_LEN,
-  // every P_* kind must stay >= P_ID (the empty-slot fast-forward keys
-  // on that ordering)
-  P_ID,
-  P_STATE,
-  P_FIN,
-  P_UPLOAD_COUNT,
-  P_UPLOAD_FAILED,
-  P_CUL,
-  P_CUC,
-  P_TYPE,
-  P_IDC,
-  P_LOC,
-  P_CPU,
-  P_MEM,
-  P_TCP,
-  P_UTCP,
-  P_DISK,
-  P_CPU_PROC,
-  P_MEM_AVAIL,
-  P_MEM_TOTAL,
-  P_INODES,
-  P_PIECE_COST,
+// Dispatch ops: one tiny op per hot column, with the destination encoded
+// as a byte offset into the per-parent (or child) scratch struct resolved
+// at header time. OP_NUM covers ~90% of hot fields, so the dispatch
+// branch is effectively free; the old 27-way kind switch cost ~45
+// cycles/field in calls + branch misses.
+enum Op : uint8_t {
+  OP_IGNORE = 0,
+  OP_NUM,           // parse_num → double at offset
+  OP_FLAG_TRUE,     // non-empty field → bool true at offset (parent id)
+  OP_EQ_SUCCEEDED,  // bool at offset = (field == "Succeeded")
+  OP_NE_NORMAL,     // bool at offset = (field != "normal")
+  OP_STR,           // StrRef at offset → view into the current line
 };
+
+// 0xff in `parent` selects the child/task scratch as the offset base.
+constexpr uint8_t kChildBase = 0xff;
 
 struct ColAction {
-  uint8_t kind = C_IGNORE;
-  uint8_t parent = 0;
-  uint8_t piece = 0;
+  uint8_t op = OP_IGNORE;
+  uint8_t parent = kChildBase;
+  uint16_t offset = 0;
 };
 
-struct ParentScratch {
-  bool has_id = false;
-  bool succeeded = false;
-  bool is_seed = false;
-  std::string idc, loc;
-  double fin = 0, upload_count = 0, upload_failed = 0, cul = 0, cuc = 0;
-  double cpu = 0, mem = 0, tcp = 0, utcp = 0, disk = 0;
-  double cpu_proc = 0, mem_avail = 0, mem_total = 0, inodes = 0;
-  double piece_cost[kMaxPieces];
-  void reset() {
-    has_id = succeeded = is_seed = false;
-    idc.clear();
-    loc.clear();
-    fin = upload_count = upload_failed = cul = cuc = 0;
-    cpu = mem = tcp = utcp = disk = 0;
-    cpu_proc = mem_avail = mem_total = inodes = 0;
-    memset(piece_cost, 0, sizeof(piece_cost));
-  }
+// View into the line being scanned (or the unquote scratch). Valid only
+// until the next line — emit_row consumes it within the same on_line
+// call, so no copy is ever needed (the old std::string assigns were two
+// allocations per populated parent per row). No default initializers:
+// keeps the scratch structs trivial so reset() is one memset (every
+// member is zeroed there or fully written before any read).
+struct StrRef {
+  const char* data;
+  uint32_t len;
+  bool empty() const { return len == 0; }
 };
+
+// POD scratch: reset is one memset. Field order groups the doubles first
+// so offsetof stays simple; StrRef/null resets to empty via zeroing.
+struct ParentScratch {
+  double fin, upload_count, upload_failed, cul, cuc;
+  double cpu, mem, tcp, utcp, disk;
+  double cpu_proc, mem_avail, mem_total, inodes;
+  double piece_cost[kMaxPieces];
+  StrRef idc, loc;
+  bool has_id, succeeded, is_seed;
+  void reset() { memset(this, 0, sizeof(*this)); }
+};
+static_assert(std::is_trivially_copyable<ParentScratch>::value,
+              "memset reset requires a trivially-copyable scratch");
+
+struct ChildScratch {
+  double total_pieces, cpu, mem, task_len;
+  StrRef idc, loc;
+  void reset() { memset(this, 0, sizeof(*this)); }
+};
+static_assert(std::is_trivially_copyable<ChildScratch>::value,
+              "memset reset requires a trivially-copyable scratch");
 
 struct DfPairs {
   std::vector<ColAction> colmap;
   std::vector<uint32_t> hot_cols;  // ascending indices of non-ignored columns
-  std::vector<uint32_t> skip_on_empty;  // hot-index jump when a P_ID is empty
+  std::vector<uint32_t> skip_on_empty;  // hot-index jump when a parent id is empty
   std::string header_col0;
   std::string carry;        // partial record across feed() chunks
   bool in_quotes = false;   // RFC4180 quote parity across chunks
   std::string scratch;      // unquote buffer
   std::vector<FieldRef> fields;
   ParentScratch parents[kMaxParents];
-  std::string child_idc, child_loc;
-  double total_pieces = 0;
-  double child_cpu = 0, child_mem = 0, task_len = 0;
+  ChildScratch child;
   int64_t row = 0;  // download-record counter (not counting headers)
   int64_t errors = 0;
 
@@ -348,18 +388,23 @@ struct DfPairs {
     for (size_t c = 0; c < hs.size(); ++c) {
       std::string name = hs[c].view();
       ColAction a;
+      auto child_num = [&](size_t off) {
+        a.op = OP_NUM;
+        a.parent = kChildBase;
+        a.offset = uint16_t(off);
+      };
       if (name == "task.total_piece_count") {
-        a.kind = C_TOTAL_PIECES;
+        child_num(offsetof(ChildScratch, total_pieces));
       } else if (name == "task.content_length") {
-        a.kind = C_TASK_LEN;
-      } else if (name == "host.network.idc") {
-        a.kind = C_CHILD_IDC;
-      } else if (name == "host.network.location") {
-        a.kind = C_CHILD_LOC;
+        child_num(offsetof(ChildScratch, task_len));
       } else if (name == "host.cpu.percent") {
-        a.kind = C_CHILD_CPU;
+        child_num(offsetof(ChildScratch, cpu));
       } else if (name == "host.memory.used_percent") {
-        a.kind = C_CHILD_MEM;
+        child_num(offsetof(ChildScratch, mem));
+      } else if (name == "host.network.idc") {
+        a = {OP_STR, kChildBase, uint16_t(offsetof(ChildScratch, idc))};
+      } else if (name == "host.network.location") {
+        a = {OP_STR, kChildBase, uint16_t(offsetof(ChildScratch, loc))};
       } else if (name.rfind("parents.", 0) == 0) {
         const char* p = name.c_str() + 8;
         char* end;
@@ -369,32 +414,34 @@ struct DfPairs {
           continue;
         }
         std::string rest(end + 1);
-        a.parent = uint8_t(slot);
-        if (rest == "id") a.kind = P_ID;
-        else if (rest == "state") a.kind = P_STATE;
-        else if (rest == "finished_piece_count") a.kind = P_FIN;
-        else if (rest == "host.upload_count") a.kind = P_UPLOAD_COUNT;
-        else if (rest == "host.upload_failed_count") a.kind = P_UPLOAD_FAILED;
-        else if (rest == "host.concurrent_upload_limit") a.kind = P_CUL;
-        else if (rest == "host.concurrent_upload_count") a.kind = P_CUC;
-        else if (rest == "host.type") a.kind = P_TYPE;
-        else if (rest == "host.network.idc") a.kind = P_IDC;
-        else if (rest == "host.network.location") a.kind = P_LOC;
-        else if (rest == "host.cpu.percent") a.kind = P_CPU;
-        else if (rest == "host.memory.used_percent") a.kind = P_MEM;
-        else if (rest == "host.network.tcp_connection_count") a.kind = P_TCP;
-        else if (rest == "host.network.upload_tcp_connection_count") a.kind = P_UTCP;
-        else if (rest == "host.disk.used_percent") a.kind = P_DISK;
-        else if (rest == "host.cpu.process_percent") a.kind = P_CPU_PROC;
-        else if (rest == "host.memory.available") a.kind = P_MEM_AVAIL;
-        else if (rest == "host.memory.total") a.kind = P_MEM_TOTAL;
-        else if (rest == "host.disk.inodes_used_percent") a.kind = P_INODES;
+        const uint8_t pa = uint8_t(slot);
+        auto num = [&](size_t off) {
+          a = {OP_NUM, pa, uint16_t(off)};
+        };
+        if (rest == "id") a = {OP_FLAG_TRUE, pa, uint16_t(offsetof(ParentScratch, has_id))};
+        else if (rest == "state") a = {OP_EQ_SUCCEEDED, pa, uint16_t(offsetof(ParentScratch, succeeded))};
+        else if (rest == "finished_piece_count") num(offsetof(ParentScratch, fin));
+        else if (rest == "host.upload_count") num(offsetof(ParentScratch, upload_count));
+        else if (rest == "host.upload_failed_count") num(offsetof(ParentScratch, upload_failed));
+        else if (rest == "host.concurrent_upload_limit") num(offsetof(ParentScratch, cul));
+        else if (rest == "host.concurrent_upload_count") num(offsetof(ParentScratch, cuc));
+        else if (rest == "host.type") a = {OP_NE_NORMAL, pa, uint16_t(offsetof(ParentScratch, is_seed))};
+        else if (rest == "host.network.idc") a = {OP_STR, pa, uint16_t(offsetof(ParentScratch, idc))};
+        else if (rest == "host.network.location") a = {OP_STR, pa, uint16_t(offsetof(ParentScratch, loc))};
+        else if (rest == "host.cpu.percent") num(offsetof(ParentScratch, cpu));
+        else if (rest == "host.memory.used_percent") num(offsetof(ParentScratch, mem));
+        else if (rest == "host.network.tcp_connection_count") num(offsetof(ParentScratch, tcp));
+        else if (rest == "host.network.upload_tcp_connection_count") num(offsetof(ParentScratch, utcp));
+        else if (rest == "host.disk.used_percent") num(offsetof(ParentScratch, disk));
+        else if (rest == "host.cpu.process_percent") num(offsetof(ParentScratch, cpu_proc));
+        else if (rest == "host.memory.available") num(offsetof(ParentScratch, mem_avail));
+        else if (rest == "host.memory.total") num(offsetof(ParentScratch, mem_total));
+        else if (rest == "host.disk.inodes_used_percent") num(offsetof(ParentScratch, inodes));
         else if (rest.rfind("pieces.", 0) == 0) {
           const char* q = rest.c_str() + 7;
           long pj = strtol(q, &end, 10);
           if (end != q && strcmp(end, ".cost") == 0 && pj >= 0 && pj < kMaxPieces) {
-            a.kind = P_PIECE_COST;
-            a.piece = uint8_t(pj);
+            num(offsetof(ParentScratch, piece_cost) + sizeof(double) * size_t(pj));
           }
         }
       }
@@ -402,20 +449,20 @@ struct DfPairs {
     }
     hot_cols.clear();
     for (size_t c = 0; c < colmap.size(); ++c)
-      if (colmap[c].kind != C_IGNORE) hot_cols.push_back(uint32_t(c));
+      if (colmap[c].op != OP_IGNORE) hot_cols.push_back(uint32_t(c));
     // Empty-slot fast-forward: when a parent's id column is empty the
     // whole slot is padding, so the scan can jump to the first hot column
     // NOT belonging to that parent. This is what keeps 20-slot padded
-    // rows near the cost of their populated prefix.
+    // rows near the cost of their populated prefix. The id column is the
+    // only OP_FLAG_TRUE op, so it identifies slot starts.
     skip_on_empty.assign(hot_cols.size(), 0);
     for (size_t hi = 0; hi < hot_cols.size(); ++hi) {
       const ColAction a = colmap[hot_cols[hi]];
-      if (a.kind != P_ID) continue;
+      if (a.op != OP_FLAG_TRUE) continue;
       size_t hj = hi + 1;
       while (hj < hot_cols.size()) {
         const ColAction b = colmap[hot_cols[hj]];
-        const bool same_parent = b.kind >= P_ID && b.parent == a.parent;
-        if (!same_parent) break;
+        if (b.parent != a.parent) break;  // kChildBase never matches a slot
         ++hj;
       }
       skip_on_empty[hi] = uint32_t(hj);
@@ -426,44 +473,34 @@ struct DfPairs {
     // empty fields (padding parent slots) keep their reset() defaults —
     // skipping them is what makes padded 20-slot rows cheap
     if (n == 0) return;
-    const FieldRef f{p, n};
-    ParentScratch& ps = parents[a.parent];
-    switch (a.kind) {
-      case C_TOTAL_PIECES: total_pieces = to_num(f); break;
-      case C_TASK_LEN: task_len = to_num(f); break;
-      case C_CHILD_IDC: child_idc.assign(p, n); break;
-      case C_CHILD_LOC: child_loc.assign(p, n); break;
-      case C_CHILD_CPU: child_cpu = to_num(f); break;
-      case C_CHILD_MEM: child_mem = to_num(f); break;
-      case P_ID: ps.has_id = true; break;
-      case P_STATE: ps.succeeded = f.eq("Succeeded"); break;
-      case P_FIN: ps.fin = to_num(f); break;
-      case P_UPLOAD_COUNT: ps.upload_count = to_num(f); break;
-      case P_UPLOAD_FAILED: ps.upload_failed = to_num(f); break;
-      case P_CUL: ps.cul = to_num(f); break;
-      case P_CUC: ps.cuc = to_num(f); break;
-      case P_TYPE: ps.is_seed = !f.eq("normal"); break;
-      case P_IDC: ps.idc.assign(p, n); break;
-      case P_LOC: ps.loc.assign(p, n); break;
-      case P_CPU: ps.cpu = to_num(f); break;
-      case P_MEM: ps.mem = to_num(f); break;
-      case P_TCP: ps.tcp = to_num(f); break;
-      case P_UTCP: ps.utcp = to_num(f); break;
-      case P_DISK: ps.disk = to_num(f); break;
-      case P_CPU_PROC: ps.cpu_proc = to_num(f); break;
-      case P_MEM_AVAIL: ps.mem_avail = to_num(f); break;
-      case P_MEM_TOTAL: ps.mem_total = to_num(f); break;
-      case P_INODES: ps.inodes = to_num(f); break;
-      case P_PIECE_COST: ps.piece_cost[a.piece] = to_num(f); break;
-      default: break;
+    char* base = a.parent == kChildBase
+                     ? reinterpret_cast<char*>(&child)
+                     : reinterpret_cast<char*>(&parents[a.parent]);
+    switch (a.op) {
+      case OP_NUM:
+        *reinterpret_cast<double*>(base + a.offset) = parse_num(p, n);
+        return;
+      case OP_FLAG_TRUE:
+        *reinterpret_cast<bool*>(base + a.offset) = true;
+        return;
+      case OP_EQ_SUCCEEDED:
+        *reinterpret_cast<bool*>(base + a.offset) =
+            (n == 9 && memcmp(p, "Succeeded", 9) == 0);
+        return;
+      case OP_NE_NORMAL:
+        *reinterpret_cast<bool*>(base + a.offset) =
+            !(n == 6 && memcmp(p, "normal", 6) == 0);
+        return;
+      case OP_STR:
+        *reinterpret_cast<StrRef*>(base + a.offset) = {p, uint32_t(n)};
+        return;
+      default:
+        return;
     }
   }
 
   void reset_scratch() {
-    total_pieces = 0;
-    child_cpu = child_mem = task_len = 0;
-    child_idc.clear();
-    child_loc.clear();
+    child.reset();
     for (auto& p : parents) p.reset();
   }
 
@@ -502,7 +539,7 @@ struct DfPairs {
     size_t n = fields.size() < colmap.size() ? fields.size() : colmap.size();
     for (size_t c = 0; c < n; ++c) {
       const ColAction a = colmap[c];
-      if (a.kind == C_IGNORE) continue;
+      if (a.op == OP_IGNORE) continue;
       dispatch(a, fields[c].data, fields[c].len);
     }
     emit_row();
@@ -519,13 +556,13 @@ struct DfPairs {
   // keeps them ≥2 columns apart), failing the check and falling back to
   // the normal scan.
   //
-  // Honest scope note: OUR csv.DictWriter serializes padding slots as
-  // "0"s (flatten()'s default ParentRecord), so on self-produced files
-  // this check always fails and each padded row pays one extra O(tail)
-  // scan (`tried_tail` bounds it to once per row). It fires — and pays
-  // off — on writers that leave padding columns EMPTY, e.g. files from
-  // other producers on the same schema. Kept for that case; remove the
-  // call sites if all inputs are known self-produced.
+  // Scope note: since columnar.write_csv's skip_padding change (round 5)
+  // OUR writer serializes padding slots as EMPTY cells, so this fires on
+  // every self-produced row with spare parent capacity — skipping the
+  // padding tail wholesale is part of the measured decode win. On
+  // "0"-padded files (older rounds, gocsv-style writers) the check fails
+  // at the first "0" and costs one bounded extra scan per row
+  // (`tried_tail`).
   static bool tail_is_padding(const char* line, size_t len, size_t from) {
     long p_last = -1, p_prev = -1;
     for (long j = long(len) - 1; j >= long(from); --j) {
@@ -682,7 +719,14 @@ struct DfPairs {
   }
 
   void emit_row() {
-    double total = total_pieces > 1.0 ? total_pieces : 1.0;
+    double total = child.total_pieces > 1.0 ? child.total_pieces : 1.0;
+    // per-row invariants: identical values to computing them per pair
+    // (pure hoisting — parity with the numpy path is preserved), but one
+    // log1p per row instead of one per parent
+    const double child_cpu_t = child.cpu / 100.0;
+    const double child_mem_t = child.mem / 100.0;
+    const double task_len_t =
+        log1p(child.task_len > 0 ? child.task_len : 0.0) / 30.0;
     for (int s = 0; s < kMaxParents; ++s) {
       ParentScratch& p = parents[s];
       if (!p.has_id) continue;
@@ -704,7 +748,8 @@ struct DfPairs {
       double free_upload = 1.0 - p.cuc / cul;
       if (free_upload < 0) free_upload = 0;
       if (free_upload > 1) free_upload = 1;
-      bool idc_match = !p.idc.empty() && p.idc == child_idc;
+      bool idc_match = !p.idc.empty() && p.idc.len == child.idc.len &&
+                       memcmp(p.idc.data, child.idc.data, p.idc.len) == 0;
 
       double mem_total = p.mem_total > 1.0 ? p.mem_total : 1.0;
       const double f[kFeatureDim] = {
@@ -713,7 +758,8 @@ struct DfPairs {
           free_upload,
           p.is_seed ? 1.0 : 0.0,
           idc_match ? 1.0 : 0.0,
-          location_affinity(child_loc, p.loc),
+          location_affinity(child.loc.data, child.loc.len, p.loc.data,
+                            p.loc.len),
           p.cpu / 100.0,
           p.mem / 100.0,
           log1p(p.tcp) / 10.0,
@@ -723,11 +769,16 @@ struct DfPairs {
           p.cpu_proc / 100.0,
           p.mem_avail / mem_total,
           p.inodes / 100.0,
-          child_cpu / 100.0,
-          child_mem / 100.0,
-          log1p(task_len > 0 ? task_len : 0.0) / 30.0,
+          child_cpu_t,
+          child_mem_t,
+          task_len_t,
       };
-      for (double v : f) feat.push_back(float(v));
+      // one grow per pair, then straight-line stores (push_back's
+      // per-element capacity branch defeats vectorization here)
+      const size_t base = feat.size();
+      feat.resize(base + kFeatureDim);
+      float* dst = feat.data() + base;
+      for (int k = 0; k < kFeatureDim; ++k) dst[k] = float(f[k]);
       double mean_cost_ms = cost_sum / cost_cnt / kNsPerMs;
       label.push_back(float(log1p(mean_cost_ms)));
       index.push_back(int32_t(row));
