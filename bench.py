@@ -233,6 +233,33 @@ def main() -> None:
             with open(p, "rb") as f:
                 while f.read(1 << 24):
                     pass
+        # Host-side bottleneck split, recorded IN the artifact (round-4
+        # verdict: the "decode scales with cores" argument was a memory-
+        # bank claim — make the decode/stream rates measured facts):
+        #   decode_only_rate — native decoder alone, one thread, f16 emit
+        #   stream_only_rate — decode + producer thread(s) + bounded queue
+        #     (the exact feed the train loop consumes), no device work
+        # Both ride the same page-cache-warm shard the timed runs use.
+        from dragonfly2_tpu.trainer.ingest import stream_shards
+
+        t0 = time.perf_counter()
+        nrec = 0
+        for _, _, nrec in native.stream_pairs_file(paths[0], passes=2, half=True):
+            pass
+        decode_only_rate = nrec / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        nrec = 0
+        for _, _, nrec in stream_shards(paths[0], passes=2, workers=workers, half=True):
+            pass
+        stream_only_rate = nrec / (time.perf_counter() - t0)
+        host_rates = {
+            "decode_only_rate": round(decode_only_rate, 1),
+            "stream_only_rate": round(stream_only_rate, 1),
+        }
+        _phase(
+            f"host split: decode {decode_only_rate / 1e3:.1f}k/s,"
+            f" stream {stream_only_rate / 1e3:.1f}k/s"
+        )
         _phase(f"page cache warm after {time.perf_counter() - run_t0:.1f}s; compiling warmup fit")
         try:
             stream_train_mlp(
@@ -251,8 +278,10 @@ def main() -> None:
         except Exception as e:
             # the one-JSON-line contract holds even when the link dies
             # during compile/warmup — an error line, never a traceback
+            # (still carrying the host-side rates already measured: the
+            # bottleneck split is real even when the device leg died)
             finished.set()
-            _emit(error=f"warmup fit failed: {e}")
+            _emit(error=f"warmup fit failed: {e}", **host_rates)
             return
 
         _phase(f"warmup done at {time.perf_counter() - run_t0:.1f}s; timed runs start")
@@ -344,6 +373,7 @@ def main() -> None:
                     "wall_s": round(best[1], 2),
                     "host_cores": ncpu,
                     "run_rates": list(run_rates),
+                    **host_rates,
                     **({"truncated": True} if best[2].truncated else {}),
                     **platform_extra,
                 }
@@ -356,12 +386,14 @@ def main() -> None:
                 jax.profiler.stop_trace()
                 _phase(f"profile written to {profile_dir}")
         if best is None:
-            # nothing finished: the error line, with the cause
+            # nothing finished: the error line, with the cause (plus the
+            # measured host rates — they don't depend on the device link)
             finished.set()
-            _emit(error=run_error or "no timed run completed")
+            _emit(error=run_error or "no timed run completed", **host_rates)
             return
         rec_per_sec_per_chip, dt, stats = best
     extra = {"truncated": True} if stats.truncated else {}
+    extra.update(host_rates)
     if run_error:
         extra["run_error"] = run_error  # partial repeats: cause on record
     if repeats > 1:

@@ -83,6 +83,9 @@ SERVICES: dict[str, dict[str, Method]] = {
         "AnnounceHost": Method(
             UNARY, scheduler_v1_pb2.AnnounceHostRequest, scheduler_v1_pb2.Empty
         ),
+        "AnnounceTask": Method(
+            UNARY, scheduler_v1_pb2.AnnounceTaskRequest, scheduler_v1_pb2.Empty
+        ),
         "SyncProbes": Method(
             STREAM_STREAM,
             scheduler_v1_pb2.SyncProbesRequest,

@@ -140,6 +140,18 @@ def _host_from_info(info: common_pb2.HostInfo) -> res.Host:
     return h
 
 
+def url_meta_of(msg) -> URLMeta:
+    """UrlMeta wire message → domain URLMeta (one definition for every
+    RPC that carries one — v1 and v2 both)."""
+    return URLMeta(
+        digest=msg.digest,
+        tag=msg.tag,
+        range=msg.range,
+        filter=msg.filter,
+        application=msg.application,
+    )
+
+
 def load_or_create_task(
     resource: res.Resource,
     url: str,
@@ -303,13 +315,7 @@ class SchedulerService:
             host = res.Host(id=req.host_id)
             self.resource.host_manager.store(host)
 
-        meta = URLMeta(
-            digest=reg.url_meta.digest,
-            tag=reg.url_meta.tag,
-            range=reg.url_meta.range,
-            filter=reg.url_meta.filter,
-            application=reg.url_meta.application,
-        )
+        meta = url_meta_of(reg.url_meta)
         task_id = reg.task_id or task_id_v1(reg.url, meta)
         task, _ = load_or_create_task(self.resource, reg.url, meta, task_id, reg.task_type)
 
@@ -459,13 +465,7 @@ class SchedulerService:
                 f"host {request.host_id} has not announced and carried no addressing",
             )
 
-        meta = URLMeta(
-            digest=request.url_meta.digest,
-            tag=request.url_meta.tag,
-            range=request.url_meta.range,
-            filter=request.url_meta.filter,
-            application=request.url_meta.application,
-        )
+        meta = url_meta_of(request.url_meta)
         task_id = request.task_id or task_id_v1(request.url, meta)
         task, fresh = load_or_create_task(
             self.resource, request.url, meta, task_id, request.task_type

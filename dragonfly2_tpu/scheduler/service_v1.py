@@ -32,7 +32,11 @@ from dragonfly2_tpu.scheduler.scheduling import (
     Scheduling,
     SchedulingError,
 )
-from dragonfly2_tpu.scheduler.service import load_or_create_task, write_download_record
+from dragonfly2_tpu.scheduler.service import (
+    load_or_create_task,
+    url_meta_of,
+    write_download_record,
+)
 from dragonfly2_tpu.scheduler.storage import Storage, build_download_record
 from dragonfly2_tpu.utils import dflog
 from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
@@ -135,13 +139,7 @@ class SchedulerServiceV1:
     # ------------------------------------------------------------------
     def RegisterPeerTask(self, request: v1.PeerTaskRequest, context):
         host = self._store_host(request.peer_host)
-        meta = URLMeta(
-            digest=request.url_meta.digest,
-            tag=request.url_meta.tag,
-            range=request.url_meta.range,
-            filter=request.url_meta.filter,
-            application=request.url_meta.application,
-        )
+        meta = url_meta_of(request.url_meta)
         task_id = request.task_id or task_id_v1(request.url, meta)
         task, _ = load_or_create_task(
             self.resource, request.url, meta, task_id, request.task_type
@@ -460,6 +458,77 @@ class SchedulerServiceV1:
             self.resource.host_manager.delete(request.host_id)
         if self.networktopology is not None:
             self.networktopology.delete_host(request.host_id)
+        return v1.Empty()
+
+    def AnnounceTask(self, request: v1.AnnounceTaskRequest, context):
+        """Register an already-completed local task on the v1 wire
+        (reference scheduler/service/service_v1.go:349-433): the
+        announcing peer lands in Succeeded with every announced piece
+        finished, so dfcache imports / object-gateway writes become
+        schedulable parents for v1 clients. Same domain transitions as
+        the v2 AnnounceTask (service.py), keyed off the PiecePacket."""
+        peer_id = request.piece_packet.dst_pid
+        if not peer_id:
+            # reject BEFORE any state mutation: a bad announce must not
+            # leave a ghost Pending task / refreshed host behind (the v2
+            # handler aborts first the same way)
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "announce task carried no piece_packet.dst_pid",
+            )
+        host = self._store_host(request.peer_host)
+        meta = url_meta_of(request.url_meta)
+        task_id = request.task_id or task_id_v1(request.url, meta)
+        task, _ = load_or_create_task(
+            self.resource, request.url, meta, task_id, request.task_type
+        )
+        peer = res.Peer(
+            peer_id, task, host, tag=meta.tag, application=meta.application
+        )
+        peer, _ = self.resource.peer_manager.load_or_store(peer)
+
+        # task not yet succeeded: adopt the announced piece inventory and
+        # advance it (reference :368-405 — pieces stored on both the peer
+        # and the task, then handleTaskSuccess with the packet's totals)
+        if not task.fsm.is_state(res.TASK_STATE_SUCCEEDED):
+            if task.fsm.can(res.TASK_EVENT_DOWNLOAD):
+                task.fsm.event(res.TASK_EVENT_DOWNLOAD)
+            for pi in request.piece_packet.piece_infos:
+                piece = res.Piece(
+                    number=pi.number,
+                    parent_id=peer_id,
+                    offset=pi.offset,
+                    length=pi.length,
+                    digest=pi.digest,
+                    traffic_type="local_peer",
+                    # announced pieces were produced locally, no transfer
+                    # happened — reference :361 sets Cost 0
+                    cost_ms=0.0,
+                    created_at=time.time(),
+                )
+                peer.finish_piece(pi.number, cost_ms=0.0, piece=piece)
+                task.store_piece(piece)
+            # adopt the packet's totals verbatim — 0 is a legitimate value
+            # (empty file announced), not "unset"; proto3 can't distinguish
+            # the two and the reference trusts the packet the same way
+            # (:400-403 handleTaskSuccess with the packet's totals). Only
+            # unknown (-1) task values are overwritten.
+            if task.content_length < 0:
+                task.content_length = request.piece_packet.content_length
+            if task.total_piece_count < 0:
+                task.total_piece_count = request.piece_packet.total_piece
+            if task.fsm.can(res.TASK_EVENT_DOWNLOAD_SUCCEEDED):
+                task.fsm.event(res.TASK_EVENT_DOWNLOAD_SUCCEEDED)
+
+        # peer not yet succeeded: walk it Pending → Running → Succeeded
+        # (reference :407-431)
+        if not peer.fsm.is_state(res.PEER_STATE_SUCCEEDED):
+            if peer.fsm.is_state(res.PEER_STATE_PENDING):
+                peer.fsm.event(res.PEER_EVENT_REGISTER_NORMAL)
+            if peer.fsm.can(res.PEER_EVENT_DOWNLOAD):
+                peer.fsm.event(res.PEER_EVENT_DOWNLOAD)
+            if peer.fsm.can(res.PEER_EVENT_DOWNLOAD_SUCCEEDED):
+                peer.fsm.event(res.PEER_EVENT_DOWNLOAD_SUCCEEDED)
         return v1.Empty()
 
     # v1 AnnounceHost/SyncProbes delegate to the v2 service's handlers —

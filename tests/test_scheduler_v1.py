@@ -482,3 +482,127 @@ def test_v1_announce_host_and_sync_probes(tmp_path):
     finally:
         channel.close()
         server.stop(grace=None)
+
+
+class TestAnnounceTask:
+    def test_announce_then_schedulable_as_parent(self, cluster):
+        """v1 AnnounceTask (reference service_v1.go:349-433): a dfcache
+        import announces a completed local task; the announcing peer must
+        land Succeeded with its pieces on the task, and a later v1 child
+        registering the same URL must be offered it as main peer."""
+        n_pieces = 3
+        piece_len = 1 << 20
+        cluster["v1"].AnnounceTask(
+            v1.AnnounceTaskRequest(
+                url=URL,
+                peer_host=peer_host(8),
+                piece_packet=v1.PiecePacket(
+                    dst_pid="announcer-peer",
+                    piece_infos=[
+                        common_pb2.PieceInfo(
+                            number=n, offset=n * piece_len, length=piece_len
+                        )
+                        for n in range(n_pieces)
+                    ],
+                    total_piece=n_pieces,
+                    content_length=n_pieces * piece_len,
+                ),
+            )
+        )
+        announcer = cluster["resource"].peer_manager.load("announcer-peer")
+        assert announcer is not None
+        assert announcer.fsm.is_state(res.PEER_STATE_SUCCEEDED)
+        assert announcer.task.fsm.is_state(res.TASK_STATE_SUCCEEDED)
+        assert announcer.task.content_length == n_pieces * piece_len
+        assert announcer.task.total_piece_count == n_pieces
+
+        # a fresh v1 child on the same URL schedules against the announcer
+        reg = register(cluster["v1"], 9, "child-after-announce")
+        stream = StreamDriver(cluster["v1"].ReportPieceResult)
+        stream.send(begin(reg.task_id, "child-after-announce"))
+        pkt = stream.recv()
+        assert pkt.code == v1.CODE_SUCCESS
+        assert pkt.main_peer.peer_id == "announcer-peer"
+        stream.close()
+
+    def test_announce_is_idempotent(self, cluster):
+        """Re-announcing an already-succeeded task must not throw or
+        regress FSM state (reference guards both transitions)."""
+        req = v1.AnnounceTaskRequest(
+            url=URL,
+            peer_host=peer_host(8),
+            piece_packet=v1.PiecePacket(
+                dst_pid="announcer-peer",
+                piece_infos=[common_pb2.PieceInfo(number=0, length=64)],
+                total_piece=1,
+                content_length=64,
+            ),
+        )
+        cluster["v1"].AnnounceTask(req)
+        cluster["v1"].AnnounceTask(req)
+        announcer = cluster["resource"].peer_manager.load("announcer-peer")
+        assert announcer.fsm.is_state(res.PEER_STATE_SUCCEEDED)
+
+    def test_missing_peer_id_rejected_without_ghost_state(self, cluster):
+        import grpc as _grpc
+
+        with pytest.raises(_grpc.RpcError) as ei:
+            cluster["v1"].AnnounceTask(
+                v1.AnnounceTaskRequest(url=URL, peer_host=peer_host(8))
+            )
+        assert ei.value.code() == _grpc.StatusCode.INVALID_ARGUMENT
+        # the rejected announce must not have materialized a Pending task
+        # or registered the host (validation precedes mutation)
+        from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
+
+        tid = task_id_v1(URL, URLMeta())
+        assert cluster["resource"].task_manager.load(tid) is None
+        assert cluster["resource"].host_manager.load("host-8") is None
+
+    def test_announce_empty_file_resolves_empty_scope(self, cluster):
+        """A 0-byte dfcache import: content_length=0 is a value, not
+        'unset' — the task must land in the EMPTY size scope so later v1
+        registrations get the direct empty response, not a parent
+        schedule against a piece-less peer."""
+        cluster["v1"].AnnounceTask(
+            v1.AnnounceTaskRequest(
+                url=URL,
+                peer_host=peer_host(8),
+                piece_packet=v1.PiecePacket(
+                    dst_pid="empty-announcer", total_piece=0, content_length=0
+                ),
+            )
+        )
+        announcer = cluster["resource"].peer_manager.load("empty-announcer")
+        assert announcer.task.content_length == 0
+        assert announcer.task.size_scope() is res.SizeScope.EMPTY
+        reg = register(cluster["v1"], 9, "empty-child")
+        assert reg.size_scope == common_pb2.SIZE_SCOPE_EMPTY
+
+
+def test_v1_surface_covers_reference_rpcs():
+    """Drift guard: every RPC on the reference's v1 scheduler service
+    (reference scheduler/service/service_v1.go — RegisterPeerTask,
+    ReportPieceResult, ReportPeerResult, AnnounceTask, StatTask,
+    LeaveTask, AnnounceHost, LeaveHost, SyncProbes) must exist in both
+    the glue method table and the servicer."""
+    from dragonfly2_tpu.rpc import glue
+
+    reference_v1_rpcs = {
+        "RegisterPeerTask",
+        "ReportPieceResult",
+        "ReportPeerResult",
+        "AnnounceTask",
+        "StatTask",
+        "LeaveTask",
+        "AnnounceHost",
+        "LeaveHost",
+        "SyncProbes",
+    }
+    table = set(glue.SERVICES[SCHEDULER_V1_SERVICE])
+    missing_in_table = reference_v1_rpcs - table
+    assert not missing_in_table, f"glue v1 table missing: {missing_in_table}"
+    missing_in_servicer = {
+        m for m in reference_v1_rpcs if not callable(getattr(SchedulerServiceV1, m, None))
+    }
+    assert not missing_in_servicer, f"servicer missing: {missing_in_servicer}"
