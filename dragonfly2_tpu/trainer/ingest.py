@@ -49,6 +49,14 @@ class StreamStats:
     eval_pairs: int = 0
     wall_s: float = 0.0
     truncated: bool = False  # stopped early by a time budget
+    # wall-clock split of the packing thread (the pipeline's spine):
+    # decode_wait_s — blocked on the decode queue (decoders too slow);
+    # buffer_wait_s — blocked on the superbatch pool (device leg too
+    # slow). The remainder is packing work itself. Together these say
+    # WHICH stage bounded a run — recorded per run so a bench artifact
+    # carries the bottleneck, not a guess.
+    decode_wait_s: float = 0.0
+    buffer_wait_s: float = 0.0
     # per-dispatch training losses, most recent last (bounded to the
     # final _LOSS_KEEP dispatches so a million-step run stays O(1))
     losses: list = field(default_factory=list)
@@ -388,9 +396,17 @@ def stream_train_mlp(
     # behavior).
     rows_per_call = batch_size * k
     free_bufs: "queue.Queue" = queue.Queue()
-    for _ in range(3):
+    # Five buffers / filled depth 3 (was 3 / 1): one packing + up to
+    # three queued-or-in-transfer + one awaiting step confirmation. The
+    # device link's throughput is bursty (tunneled chips measured
+    # 75 MB/s–1.5 GB/s within one run); extra in-flight superbatches let
+    # decode run ahead through a slow patch instead of stalling behind
+    # one delayed transfer. Memory cost: 5 × k·B·(F+1) half-words
+    # (~100 MB at the bench shape) — bounded and config-independent of
+    # file size, same as before.
+    for _ in range(5):
         free_bufs.put(np.empty((rows_per_call, MLP_FEATURE_DIM + 1), transfer_dtype))
-    filled_bufs: "queue.Queue" = queue.Queue(maxsize=1)
+    filled_bufs: "queue.Queue" = queue.Queue(maxsize=3)
     disp_errors: list[BaseException] = []
     buf = free_bufs.get()
     fill = 0
@@ -464,15 +480,24 @@ def stream_train_mlp(
     # buffers pinned — the long-lived trainer service calls this every
     # training round
     try:
-        for feats, labels, rows in stream_shards(
-            paths,
-            passes=passes,
-            max_records=max_records,
-            queue_depth=queue_depth,
-            offset=offset,
-            workers=workers,
-            half=half,
-        ):
+        shard_iter = iter(
+            stream_shards(
+                paths,
+                passes=passes,
+                max_records=max_records,
+                queue_depth=queue_depth,
+                offset=offset,
+                workers=workers,
+                half=half,
+            )
+        )
+        while True:
+            w0 = time.perf_counter()
+            try:
+                feats, labels, rows = next(shard_iter)
+            except StopIteration:
+                break
+            stats.decode_wait_s += time.perf_counter() - w0
             if budget_end is not None and time.perf_counter() > budget_end:
                 stats.truncated = True
                 break  # generator abandonment releases the producers
@@ -533,8 +558,10 @@ def stream_train_mlp(
                             target=_dispatch_loop, name="ingest-dispatch", daemon=True
                         )
                         disp_thread.start()
-                    filled_bufs.put(buf)
+                    w0 = time.perf_counter()
+                    filled_bufs.put(buf)  # may block at queue depth
                     buf = free_bufs.get()
+                    stats.buffer_wait_s += time.perf_counter() - w0
                     fill = 0
                     if disp_errors:
                         break
