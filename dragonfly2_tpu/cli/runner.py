@@ -43,6 +43,9 @@ def run(name: str, server) -> int:
     gaddr = getattr(server, "gateway_addr", None)
     if gaddr:
         print(f"GATEWAY {name} {gaddr}", flush=True)
+    kaddr = getattr(server, "kv_addr", None)
+    if kaddr:
+        print(f"KV {name} {kaddr}", flush=True)
     print(f"READY {name} {addr}", flush=True)
     try:
         stop_event.wait()

@@ -43,6 +43,17 @@ class ManagerServerConfig:
     # who may obtain signed identities ('' = open — dev only)
     issue_certs: bool = True
     issue_certs_token: str = ""
+    # embedded RESP KV server (the Redis role): schedulers point their
+    # kv_address here to share one probe-graph/counter store across
+    # processes (reference deploys Redis alongside the manager for the
+    # same purpose). -1 = disabled, 0 = ephemeral port. The bind host
+    # and the ADVERTISED host are distinct (same pattern as the gRPC
+    # listen/advertise split): 0.0.0.0 binds everywhere but is not a
+    # dialable address, so kv_advertise_ip is what lands in kv_addr /
+    # the runner's KV line.
+    kv_port: int = -1
+    kv_host: str = "0.0.0.0"
+    kv_advertise_ip: str = "127.0.0.1"
     # object storage for model weights: fs (default, under data_dir) or
     # s3 (any S3-compatible endpoint; reference pkg/objectstorage)
     object_storage_driver: str = "fs"
@@ -137,10 +148,27 @@ class ManagerServer:
             self._metrics = MetricsServer(default_registry, host=self.cfg.metrics_host, port=self.cfg.metrics_port)
             self.metrics_addr = self._metrics.start()
             logger.info("manager metrics on %s", self.metrics_addr)
+        if self.cfg.kv_port >= 0:
+            from dragonfly2_tpu.utils.kvserver import KVServer
+
+            self._kv = KVServer(host=self.cfg.kv_host, port=self.cfg.kv_port)
+            kv_port = self._kv.serve()
+            advertise = (
+                self.cfg.kv_advertise_ip
+                if self.cfg.kv_host in ("0.0.0.0", "::")
+                else self.cfg.kv_host
+            )
+            self.kv_addr = f"{advertise}:{kv_port}"
+            logger.info(
+                "manager kv (RESP) bound %s:%d, advertising %s",
+                self.cfg.kv_host, kv_port, self.kv_addr,
+            )
         logger.info("manager gRPC on %s", addr)
         return addr
 
     def stop(self) -> None:
+        if getattr(self, "_kv", None) is not None:
+            self._kv.stop()
         if getattr(self, "_metrics", None) is not None:
             self._metrics.stop()
         if self._rest is not None:

@@ -16,6 +16,7 @@ every collect interval (default 2h).
 
 from __future__ import annotations
 
+import json
 import random
 import time
 import uuid
@@ -79,16 +80,31 @@ class NetworkTopology:
 
     def enqueue_probe(self, src: str, probe: Probe) -> None:
         """Append a raw probe, maintain the bounded queue and the EWMA
-        (reference probes.go:145-222)."""
+        (reference probes.go:145-222). Probe entries are JSON strings —
+        the same marshaling the reference pushes into Redis lists — so
+        the in-process and RESP/Redis backends hold identical bytes."""
         dest = probe.host_id
         self.store_edge(src, dest)
         qkey = make_probes_key(src, dest)
-        if self.kv.llen(qkey) >= self.queue_length:
-            self.kv.lpop(qkey)
-        self.kv.rpush(qkey, {"rtt": probe.rtt_ns, "createdAt": probe.created_at})
+        # `while`, not `if`: with N schedulers sharing the store, two
+        # writers can both see len==4 and push to 6 — the reference has
+        # the same unguarded Llen/Lpop/Rpush sequence (probes.go:158-170)
+        # so its bound is equally best-effort, but a while-loop makes the
+        # queue CONVERGE back to the bound on the next write instead of
+        # staying permanently over it. The EWMA read-modify-write below
+        # shares the same documented raciness (one concurrent update may
+        # be lost; the 0.9-new weighting makes the next probe dominate
+        # anyway).
+        while self.kv.llen(qkey) >= self.queue_length:
+            if self.kv.lpop(qkey) is None:
+                break  # another writer drained it first
+        self.kv.rpush(
+            qkey, json.dumps({"rtt": probe.rtt_ns, "createdAt": probe.created_at})
+        )
 
         ekey = make_network_topology_key(src, dest)
-        old = self.kv.hget(ekey, "averageRTT") or 0
+        # int(...): the RESP backend returns strings (and "0" is truthy)
+        old = int(self.kv.hget(ekey, "averageRTT") or 0)
         if old == 0:
             avg = probe.rtt_ns
         else:
@@ -104,7 +120,10 @@ class NetworkTopology:
         return int(v) if v is not None else None
 
     def probes(self, src: str, dest: str) -> list[dict]:
-        return self.kv.lrange(make_probes_key(src, dest), 0, -1)
+        return [
+            json.loads(e) if isinstance(e, str) else e
+            for e in self.kv.lrange(make_probes_key(src, dest), 0, -1)
+        ]
 
     def probed_count(self, host_id: str) -> int:
         return int(self.kv.get(make_probed_count_key(host_id)) or 0)

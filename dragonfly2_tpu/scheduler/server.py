@@ -19,6 +19,7 @@ from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
 from dragonfly2_tpu.scheduler.storage import Storage
 from dragonfly2_tpu.utils import dflog
 from dragonfly2_tpu.utils.gc import GC, GCTask
+from dragonfly2_tpu.utils import kvstore
 from dragonfly2_tpu.utils.kvstore import KVStore
 
 logger = dflog.get("scheduler.server")
@@ -55,6 +56,11 @@ class SchedulerServerConfig:
     candidate_parent_limit: int = 4
     # probe-graph CSV snapshot cadence (reference CollectInterval, 2h)
     topology_snapshot_interval: float = 2 * 3600.0
+    # shared KV backend for the Redis role (probe graph, probed counts):
+    # "host:port" of utils.kvserver.KVServer (the manager embeds one) or
+    # an actual Redis; empty = process-local store (single-scheduler).
+    # Matches reference network_topology.go:88-89 taking a redis client.
+    kv_address: str = ""
     # Prometheus /metrics endpoint (reference :8000): -1 = disabled
     metrics_port: int = -1
     # df_plugin_*.py modules loaded at startup (reference internal/dfplugin)
@@ -93,7 +99,17 @@ class SchedulerServer:
             max_size=config.storage_max_size,
             buffer_size=config.storage_buffer_size,
         )
-        self.kvstore = KVStore()
+        # kv_address set → RESP client to the shared store (manager-embedded
+        # KVServer or real Redis): N schedulers then see one probe graph,
+        # like the reference's redis.UniversalClient wiring. Unset → an
+        # isolated in-process store (NOT the process-wide singleton: two
+        # SchedulerServers in one test process must not silently share
+        # topology state through a global).
+        self.kvstore = (
+            kvstore.RemoteKVStore(config.kv_address)
+            if config.kv_address
+            else KVStore()
+        )
         self.networktopology = NetworkTopology(
             self.kvstore, self.resource.host_manager, self.storage
         )
@@ -288,6 +304,7 @@ class SchedulerServer:
         if self._grpc is not None:
             self._grpc.stop(grace=2).wait(5)
         self.storage.flush()
+        self.kvstore.close()  # releases the RESP socket when remote
         for ch in (self._manager_channel, self._trainer_channel):
             if ch is not None:
                 ch.close()

@@ -1,18 +1,27 @@
-"""Embedded KV store — the role Redis plays in the reference.
+"""Embedded KV store + RESP client — the role Redis plays in the reference.
 
 The reference keeps the probe graph, probed-count counters and the job queue
 in Redis (reference scheduler/networktopology/network_topology.go:52-436,
-internal/job). This environment has no Redis server, so the same key schema
-runs against an in-process store with the subset of commands the system
-uses: hashes, bounded lists, counters, key scan with glob patterns, TTL.
+internal/job). Two backends share one redis-py-shaped interface here:
 
-The store is process-local; multi-scheduler deployments would point this at
-a real Redis via the same interface (the methods are 1:1 with redis-py).
+- ``KVStore`` — in-process store for single-process deployments and tests.
+- ``RemoteKVStore`` — RESP2 client for multi-scheduler deployments: point
+  it at ``utils.kvserver.KVServer`` (embedded in the manager) or at an
+  actual Redis — the wire protocol is the real one, so both work.
+
+``connect(address)`` picks the backend: empty address → the process-local
+singleton; ``host:port`` → RESP. Like Redis, the remote backend stores
+STRINGS — callers serialize structure (the topology's probe entries are
+JSON strings, matching what the reference marshals into Redis lists,
+probes.go) and parse numbers on read. The in-process store accepts rich
+values but the shared consumers stick to strings so both backends behave
+identically.
 """
 
 from __future__ import annotations
 
 import fnmatch
+import socket
 import threading
 import time
 from typing import Any
@@ -61,6 +70,10 @@ class KVStore:
         with self._lock:
             self._data.clear()
             self._expires.clear()
+
+    def close(self) -> None:
+        """No-op: interface parity with RemoteKVStore so owners can close
+        their backend unconditionally."""
 
     def _prepare_write(self, key: str) -> None:
         """Drop expired state before writing (redis semantics: a write to an
@@ -141,6 +154,175 @@ class KVStore:
             return list(lst[start : stop + 1])
 
 
+_CRLF = b"\r\n"
+
+
+class RemoteKVStore:
+    """RESP2 client with the same method surface as ``KVStore``.
+
+    One socket, one in-flight command (guarded by a lock) — the callers
+    are a scheduler's SyncProbes handlers and periodic snapshots, not a
+    throughput path. Reconnects once per call on a dropped connection so
+    a restarted server (or Redis failover) doesn't wedge the scheduler.
+    All returned values are ``str`` (or ``None``) exactly like redis-py
+    with ``decode_responses=True``.
+    """
+
+    def __init__(self, address: str, timeout: float = 5.0):
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._buf = b""
+
+    # -- wire ------------------------------------------------------------
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self._addr, timeout=self._timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+            self._buf = b""
+        return self._sock
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    def _send(self, *parts) -> None:
+        out = b"*" + str(len(parts)).encode() + _CRLF
+        for p in parts:
+            data = p if isinstance(p, bytes) else str(p).encode()
+            out += b"$" + str(len(data)).encode() + _CRLF + data + _CRLF
+        self._connect().sendall(out)
+
+    def _read_line(self) -> bytes:
+        while True:
+            nl = self._buf.find(_CRLF)
+            if nl >= 0:
+                line, self._buf = self._buf[:nl], self._buf[nl + 2 :]
+                return line
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("kv server closed connection")
+            self._buf += chunk
+
+    def _read_exactly(self, n: int) -> bytes:
+        while len(self._buf) < n + 2:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("kv server closed connection")
+            self._buf += chunk
+        data, self._buf = self._buf[:n], self._buf[n + 2 :]
+        return data
+
+    def _read_reply(self):
+        line = self._read_line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise ValueError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            return None if n < 0 else self._read_exactly(n).decode()
+        if kind == b"*":
+            n = int(rest)
+            return None if n < 0 else [self._read_reply() for _ in range(n)]
+        raise ValueError(f"bad RESP reply: {line!r}")
+
+    def _drop_connection(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _call(self, *parts):
+        with self._lock:
+            try:
+                self._send(*parts)
+            except (ConnectionError, OSError):
+                # SEND-phase failure: a stale cached connection (server
+                # restarted while we were idle). Safe to retry — a
+                # partially-written RESP frame is never executed (the
+                # server discards incomplete commands when the
+                # connection dies), so the command cannot run twice.
+                self._drop_connection()
+                self._send(*parts)
+            try:
+                return self._read_reply()
+            except (ConnectionError, OSError) as e:
+                # READ-phase failure (including socket.timeout): the
+                # request WAS delivered and may have executed — a resend
+                # would double-apply non-idempotent commands (INCRBY,
+                # RPUSH), so propagate instead. redis-py draws the same
+                # line (retry_on_timeout is opt-in for this reason). The
+                # dropped connection makes the NEXT call reconnect.
+                self._drop_connection()
+                raise ConnectionError(f"kv reply lost ({e}); not retried") from e
+
+    # -- KVStore surface -------------------------------------------------
+    def exists(self, key: str) -> bool:
+        return bool(self._call("EXISTS", key))
+
+    def delete(self, *keys: str) -> int:
+        return int(self._call("DEL", *keys)) if keys else 0
+
+    def expire(self, key: str, ttl_seconds: float) -> bool:
+        # PEXPIRE with integer milliseconds: real Redis rejects a float
+        # EXPIRE argument, and sub-second TTLs must not round to zero
+        return bool(self._call("PEXPIRE", key, max(1, int(ttl_seconds * 1000))))
+
+    def scan_iter(self, pattern: str = "*") -> list[str]:
+        return list(self._call("KEYS", pattern) or [])
+
+    def flushall(self) -> None:
+        self._call("FLUSHALL")
+
+    def set(self, key: str, value: Any) -> None:
+        self._call("SET", key, value)
+
+    def get(self, key: str):
+        return self._call("GET", key)
+
+    def incr(self, key: str, amount: int = 1) -> int:
+        return int(self._call("INCRBY", key, amount))
+
+    def hset(self, key: str, mapping: dict[str, Any]) -> int:
+        flat: list = []
+        for k, v in mapping.items():
+            flat.append(k)
+            flat.append(v)
+        return int(self._call("HSET", key, *flat))
+
+    def hget(self, key: str, field: str):
+        return self._call("HGET", key, field)
+
+    def hgetall(self, key: str) -> dict[str, str]:
+        flat = self._call("HGETALL", key) or []
+        return dict(zip(flat[::2], flat[1::2]))
+
+    def rpush(self, key: str, *values: Any) -> int:
+        return int(self._call("RPUSH", key, *values))
+
+    def lpop(self, key: str):
+        return self._call("LPOP", key)
+
+    def llen(self, key: str) -> int:
+        return int(self._call("LLEN", key))
+
+    def lrange(self, key: str, start: int, stop: int) -> list[str]:
+        return list(self._call("LRANGE", key, start, stop) or [])
+
+
 _default_store: KVStore | None = None
 _default_lock = threading.Lock()
 
@@ -152,6 +334,12 @@ def default_store() -> KVStore:
         if _default_store is None:
             _default_store = KVStore()
         return _default_store
+
+
+def connect(address: str = "") -> "KVStore | RemoteKVStore":
+    """Backend selection: empty address → the in-process singleton;
+    ``host:port`` → the RESP client (our KVServer or a real Redis)."""
+    return RemoteKVStore(address) if address else default_store()
 
 
 # -- key schema (reference parity: pkg/redis/redis.go) -------------------

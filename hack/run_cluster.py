@@ -42,6 +42,7 @@ class Proc:
         self.metrics_addr: str | None = None
         self.rest_addr: str | None = None
         self.gateway_addr: str | None = None
+        self.kv_addr: str | None = None
         # a dedicated reader thread avoids mixing select() on the raw fd
         # with buffered readline() (lines stranded in the TextIOWrapper
         # buffer would make select starve)
@@ -70,6 +71,8 @@ class Proc:
                 self.rest_addr = line.split()[2]
             if line.startswith("GATEWAY "):
                 self.gateway_addr = line.split()[2]
+            if line.startswith("KV "):
+                self.kv_addr = line.split()[2]
             if line.startswith("READY "):
                 self.addr = line.split()[2]
                 return self.addr

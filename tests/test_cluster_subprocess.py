@@ -28,3 +28,21 @@ def test_run_cluster_script():
     )
     assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-3000:]}"
     assert "CLUSTER E2E: ALL PASS" in proc.stdout
+
+
+def test_run_cluster_two_schedulers_shared_kv():
+    """Round-4 verdict item 2: TWO scheduler processes sharing the Redis
+    role through the manager's embedded RESP KV server — consistent-hash
+    affinity splits tasks, SyncProbes from both daemons land in one
+    store, and each scheduler snapshots the whole shared probe graph."""
+    env = dict(os.environ, DF_QUIET="1", DF_JAX_PLATFORM="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "hack", "run_cluster_multisched.py")],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-3000:]}"
+    assert "CLUSTER2 E2E: ALL PASS" in proc.stdout

@@ -1,0 +1,194 @@
+"""RESP KV server/client: the cross-process backend for the Redis role
+(reference scheduler/networktopology/network_topology.go:88-89 takes a
+redis.UniversalClient; key schema pkg/redis/redis.go). These tests pin
+the wire behavior two schedulers rely on to share one probe graph."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.scheduler.networktopology import NetworkTopology, Probe
+from dragonfly2_tpu.scheduler.resource import Host, HostManager
+from dragonfly2_tpu.utils.kvserver import KVServer
+from dragonfly2_tpu.utils.kvstore import KVStore, RemoteKVStore, connect
+
+
+@pytest.fixture
+def served():
+    srv = KVServer(host="127.0.0.1")
+    port = srv.serve()
+    client = RemoteKVStore(f"127.0.0.1:{port}")
+    yield srv, client
+    client.close()
+    srv.stop()
+
+
+class TestRESPCommands:
+    def test_string_roundtrip(self, served):
+        _, kv = served
+        kv.set("k", "value")
+        assert kv.get("k") == "value"
+        assert kv.get("absent") is None
+        assert kv.exists("k") and not kv.exists("absent")
+        assert kv.delete("k") == 1
+        assert kv.get("k") is None
+
+    def test_counters(self, served):
+        _, kv = served
+        assert kv.incr("c") == 1
+        assert kv.incr("c", 5) == 6
+        assert kv.get("c") == "6"
+
+    def test_hash(self, served):
+        _, kv = served
+        kv.hset("h", {"a": 1, "b": "two"})
+        assert kv.hget("h", "a") == "1"
+        assert kv.hget("h", "missing") is None
+        assert kv.hgetall("h") == {"a": "1", "b": "two"}
+
+    def test_list_bounded_queue(self, served):
+        _, kv = served
+        kv.rpush("q", "x", "y", "z")
+        assert kv.llen("q") == 3
+        assert kv.lrange("q", 0, -1) == ["x", "y", "z"]
+        assert kv.lpop("q") == "x"
+        assert kv.llen("q") == 2
+
+    def test_keys_scan(self, served):
+        _, kv = served
+        kv.set("networktopology:a:b", "1")
+        kv.set("networktopology:a:c", "1")
+        kv.set("probes:a:b", "1")
+        assert sorted(kv.scan_iter("networktopology:a:*")) == [
+            "networktopology:a:b",
+            "networktopology:a:c",
+        ]
+
+    def test_expire(self, served):
+        _, kv = served
+        kv.set("t", "v")
+        assert kv.expire("t", 0.05)
+        time.sleep(0.1)
+        assert kv.get("t") is None
+
+    def test_binary_safe_values(self, served):
+        _, kv = served
+        payload = "with\r\nnewlines and \x00 bytes and unicode ✓"
+        kv.set("bin", payload)
+        assert kv.get("bin") == payload
+
+    def test_unknown_command_is_error_not_disconnect(self, served):
+        _, kv = served
+        with pytest.raises(ValueError):
+            kv._call("NOSUCH")
+        kv.set("still", "alive")  # same connection keeps working
+        assert kv.get("still") == "alive"
+
+    def test_flushall(self, served):
+        _, kv = served
+        kv.set("a", "1")
+        kv.flushall()
+        assert kv.scan_iter("*") == []
+
+
+class TestCrossProcessSemantics:
+    def test_two_clients_share_state(self, served):
+        srv, kv1 = served
+        kv2 = RemoteKVStore(f"127.0.0.1:{srv.port}")
+        try:
+            kv1.incr("probedcount:h1")
+            kv2.incr("probedcount:h1")
+            assert kv1.get("probedcount:h1") == "2"
+            kv2.hset("networktopology:a:b", {"averageRTT": 42})
+            assert kv1.hget("networktopology:a:b", "averageRTT") == "42"
+        finally:
+            kv2.close()
+
+    def test_reconnect_after_server_restart_socket_drop(self, served):
+        srv, kv = served
+        kv.set("k", "1")
+        # sever the client's socket underneath it; next call reconnects
+        kv._sock.shutdown(socket.SHUT_RDWR)
+        kv._sock.close()
+        assert kv.get("k") == "1"
+
+    def test_concurrent_clients(self, served):
+        srv, _ = served
+        errors = []
+
+        def worker(n):
+            c = RemoteKVStore(f"127.0.0.1:{srv.port}")
+            try:
+                for i in range(50):
+                    c.incr("shared")
+                    c.hset(f"h{n}", {"i": i})
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=worker, args=(n,)) for n in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        client = RemoteKVStore(f"127.0.0.1:{srv.port}")
+        try:
+            assert client.get("shared") == "200"
+        finally:
+            client.close()
+
+
+class TestTopologyOverRESP:
+    """NetworkTopology must behave identically on both backends — the
+    in-process store is the spec, the served store is the deployment."""
+
+    def _topology(self, kv):
+        hm = HostManager()
+        for hid in ("h0", "h1", "h2"):
+            hm.store(Host(id=hid, ip="10.0.0.1"))
+        return NetworkTopology(kv, hm)
+
+    @pytest.mark.parametrize("backend", ["local", "resp"])
+    def test_probe_flow(self, served, backend):
+        srv, remote = served
+        kv = KVStore() if backend == "local" else remote
+        nt = self._topology(kv)
+        base = 100_000_000
+        for i in range(7):  # overflow the 5-deep queue
+            nt.enqueue_probe("h0", Probe("h1", base + i, created_at=time.time()))
+        q = nt.probes("h0", "h1")
+        assert len(q) == 5  # bounded
+        assert all(isinstance(e, dict) and "rtt" in e for e in q)
+        assert nt.probed_count("h1") == 7
+        # EWMA: nearly last-sample (0.1 old + 0.9 new)
+        rtt = nt.average_rtt("h0", "h1")
+        assert rtt is not None and abs(rtt - (base + 6)) < base * 0.2
+        recs = nt.export_records()
+        assert len(recs) == 1 and recs[0].dest_hosts[0].id == "h1"
+        nt.delete_host("h1")
+        assert nt.average_rtt("h0", "h1") is None
+        assert nt.probes("h0", "h1") == []
+
+    def test_two_schedulers_one_graph(self, served):
+        """The round-4 gap: probes from TWO topology instances (standing
+        in for two scheduler processes) land in ONE store."""
+        srv, _ = served
+        nt_a = self._topology(RemoteKVStore(f"127.0.0.1:{srv.port}"))
+        nt_b = self._topology(RemoteKVStore(f"127.0.0.1:{srv.port}"))
+        nt_a.enqueue_probe("h0", Probe("h1", 10_000_000))
+        nt_b.enqueue_probe("h2", Probe("h1", 20_000_000))
+        # both edges visible from either instance; probed counts merged
+        assert nt_b.average_rtt("h0", "h1") == 10_000_000
+        assert nt_a.average_rtt("h2", "h1") == 20_000_000
+        assert nt_a.probed_count("h1") == 2
+        srcs = {r.host.id for r in nt_b.export_records()}
+        assert srcs == {"h0", "h2"}
+
+
+def test_connect_backend_selection():
+    assert isinstance(connect(""), KVStore)
+    assert isinstance(connect("127.0.0.1:6379"), RemoteKVStore)
