@@ -95,6 +95,12 @@ class DaemonConfig:
     host_stats_override: dict = field(default_factory=dict)
     # synthetic per-piece upload latency (A/B harness models slow hosts)
     upload_delay_s: float = 0.0
+    # extra serving latency on piece 0 only (benign cold-piece pattern:
+    # TCP slow start / cold cache — the GRU bad-node A/B scenario)
+    upload_cold_piece_delay_s: float = 0.0
+    # synthetic receive-side per-piece latency, inside the measured cost
+    # window (fault injection: a loaded host's own downloads slow down)
+    download_delay_s: float = 0.0
     # global upload bandwidth budget in bytes/s shared by all child peers
     # (reference upload totalRateLimit); 0 = unlimited
     upload_rate_limit: float = 0.0
@@ -143,6 +149,7 @@ class Daemon:
             host=config.upload_host,
             port=config.upload_port,
             delay_s=config.upload_delay_s,
+            cold_piece_delay_s=config.upload_cold_piece_delay_s,
             rate_limit_bps=config.upload_rate_limit,
         )
         self._selector = None
@@ -156,6 +163,18 @@ class Daemon:
         self.task_manager: TaskManager | None = None
         self.proxy = None
         self.object_gateway = None
+        # constructed here, not in start(): probe_once() is a public
+        # single-round entry point and must work without a running
+        # probe loop (per-host echo budget tied to the probe cadence —
+        # concurrent probes of one host within a round reuse the cached
+        # RTT instead of multiplying echoes)
+        from dragonfly2_tpu.utils.ping import Pinger
+
+        self._pinger = Pinger(
+            min_interval=min(1.0, config.probe_interval / 2)
+            if config.probe_interval > 0
+            else 1.0
+        )
 
     # ------------------------------------------------------------------
     def _make_scheduler_dynconfig(self):
@@ -241,7 +260,9 @@ class Daemon:
             storage=self.storage,
             scheduler_client=self._selector,
             piece_manager=PieceManager(
-                concurrent_pieces=self.cfg.piece_workers, shaper=self.shaper
+                concurrent_pieces=self.cfg.piece_workers,
+                shaper=self.shaper,
+                download_delay_s=self.cfg.download_delay_s,
             ),
             options=ConductorOptions(
                 piece_workers=self.cfg.piece_workers,
@@ -576,9 +597,12 @@ class Daemon:
     # ------------------------------------------------------------------
     # prober (reference client/daemon/networktopology/network_topology.go:71-203)
     #
-    # ICMP needs raw sockets; as an unprivileged stand-in the probe RTT
-    # is a TCP connect round-trip to the target's upload port — same
-    # signal shape (latency to the host), no privileges needed.
+    # RTT measurement is ICMP echo first (reference pkg/net/ping/ping.go:
+    # privileged pinger, 1 echo, 1s timeout) with a per-host rate limit,
+    # falling back to a TCP connect round-trip to the target's upload
+    # port when ICMP is unavailable (no CAP_NET_RAW and no unprivileged
+    # ping range) — same latency signal, needs an open port instead of
+    # privileges. utils/ping.py implements both ICMP modes.
     # ------------------------------------------------------------------
     def probe_once(self) -> int:
         """One SyncProbes round; returns number of hosts probed. The
@@ -601,7 +625,11 @@ class Daemon:
             if resp is not None and resp.hosts:
                 probes, failed = [], []
                 for ph in resp.hosts:
-                    rtt = self._tcp_ping(ph.host.ip, ph.host.download_port or ph.host.port)
+                    port = ph.host.download_port or ph.host.port
+                    rtt = self._pinger.rtt(
+                        ph.host.ip,
+                        fallback=lambda ip, p=port: self._tcp_ping(ip, p),
+                    )
                     if rtt is None:
                         failed.append(
                             scheduler_pb2.FailedProbeResult(

@@ -83,11 +83,17 @@ class PieceManager:
         source_concurrency: int = 4,
         source_concurrency_threshold: int = 32 * 1024 * 1024,
         shaper: "TrafficShaper | None" = None,
+        download_delay_s: float = 0.0,
     ):
         self.concurrent_pieces = concurrent_pieces
         self.source_concurrency = source_concurrency
         self.source_concurrency_threshold = source_concurrency_threshold
         self.shaper = shaper
+        # synthetic receive-side latency per piece, landing INSIDE the
+        # measured cost window — fault-injection knob modelling a loaded
+        # host whose pressure slows its own downloads (the signal the
+        # bad-node detectors read); 0 in production
+        self.download_delay_s = download_delay_s
 
     # ------------------------------------------------------------------
     def download_piece_from_parent(
@@ -101,6 +107,8 @@ class PieceManager:
         data, digest, content_type = downloader.download_piece(
             parent.upload_addr, ts.meta.task_id, pr.number, peer_id=peer_id
         )
+        if self.download_delay_s > 0:
+            time.sleep(self.download_delay_s)  # inside the cost window
         dt_transfer = time.monotonic() - t0
         if self.shaper is not None and self.shaper.enabled:
             # debit on SUCCESS, outside the measured window: optimistic

@@ -34,12 +34,17 @@ class UploadServer:
         host: str = "127.0.0.1",
         port: int = 0,
         delay_s: float = 0.0,
+        cold_piece_delay_s: float = 0.0,
         rate_limit_bps: float = 0.0,
     ):
         self.storage = storage
         # synthetic per-piece serving latency — benchmarking/AB-harness
         # knob to model slow hosts; 0 in production
         self.delay_s = delay_s
+        # extra latency on piece 0 only — models the benign cold-piece
+        # effect (TCP slow start / cold cache on a task's first chunk)
+        # the GRU bad-node A/B scenario relies on; 0 in production
+        self.cold_piece_delay_s = cold_piece_delay_s
         # global upload bandwidth budget shared by all child peers
         # (reference upload_manager totalRateLimit); 0 = unlimited
         self.limiter = RateLimiter(rate_limit_bps)
@@ -90,13 +95,22 @@ class UploadServer:
             time.sleep(self.delay_s)
         number = qs.get("number", [None])[0]
         if number is not None:
-            # piece fetch by number
+            # piece fetch by number — parsed ONCE, with the malformed
+            # case answered 404 like every other bad-request path (not a
+            # handler crash)
             try:
-                data = ts.read_piece(int(number))
+                piece_number = int(number)
+            except ValueError:
+                req.send_error(404, f"bad piece number {number!r}")
+                return
+            if self.cold_piece_delay_s > 0 and piece_number == 0:
+                time.sleep(self.cold_piece_delay_s)
+            try:
+                data = ts.read_piece(piece_number)
             except Exception as e:
                 req.send_error(404, str(e))
                 return
-            pm = ts.meta.pieces[int(number)]
+            pm = ts.meta.pieces[piece_number]
             M.PIECE_UPLOADED_TOTAL.inc()
             M.PIECE_UPLOAD_BYTES.inc(len(data))
             req.send_response(200)

@@ -175,9 +175,18 @@ class MLEvaluator(BaseEvaluator):
     the prediction from its own history is flagged (base statistics
     remain the fallback)."""
 
-    # flag when the observed cost exceeds ~20× the predicted cost — the
-    # same severity the base rule uses for short histories (mean*20)
-    GRU_BAD_LOG_MARGIN = math.log(20.0)
+    # flag when the observed cost exceeds ~6× the PREDICTED cost. Tighter
+    # than the base rule's blunt 20×-mean threshold on purpose: the
+    # prediction is conditioned on the peer's own cost sequence, so
+    # benign structure the statistics cannot separate (cold first
+    # pieces, periodic slow chunks — which inflate the mean/σ and mask
+    # real degradation) is explained away by the model, leaving a margin
+    # that only genuine anomalies cross. 6× sits well above the GRU's
+    # eval residual (~1.3× typical mae on log costs) and is validated by
+    # the A/B harness's degrading-parent scenario: no false positives on
+    # the benign pattern, detection where the statistical rule stays
+    # blind (tools/ab_harness.py run_gru_ab).
+    GRU_BAD_LOG_MARGIN = math.log(6.0)
 
     # verdict cache bound: cleared wholesale when exceeded (entries are
     # invalidated naturally by the piece count changing)

@@ -67,10 +67,32 @@ def _percentile(xs: list[float], q: float) -> float:
     return float(np.percentile(np.asarray(xs), q)) if xs else 0.0
 
 
-class _Cluster:
-    """One phase's scheduler + daemons (fresh state, same topology)."""
+def _make_origins(
+    workdir: str, tag: str, n: int, piece_length: int, pieces_per_task: int, rng
+) -> list[str]:
+    """n origin payload files of exactly pieces_per_task pieces; one
+    definition so every scenario's "identical workload" premise rests on
+    the same generator."""
+    d = os.path.join(workdir, f"origin-{tag}")
+    os.makedirs(d, exist_ok=True)
+    out = []
+    for t in range(n):
+        path = os.path.join(d, f"task-{t}.bin")
+        with open(path, "wb") as f:
+            f.write(rng.randbytes(piece_length * pieces_per_task))
+        out.append(f"file://{path}")
+    return out
 
-    def __init__(self, cfg: ABConfig, evaluator, workdir: str):
+
+class _Cluster:
+    """One phase's scheduler + daemons (fresh state, same topology).
+
+    ``daemon_kwargs_fn(i) -> dict`` overrides per-daemon DaemonConfig
+    fields; the default models the MLP scenario's slow/fast split. A
+    daemon whose kwargs carry ``_slow=True`` lands in ``slow_ids`` (the
+    workload's parent-attribution set)."""
+
+    def __init__(self, cfg: ABConfig, evaluator, workdir: str, daemon_kwargs_fn=None):
         from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
         from dragonfly2_tpu.rpc.glue import serve
         from dragonfly2_tpu.scheduler import resource as res
@@ -96,10 +118,23 @@ class _Cluster:
         )
         self.server, self.port = serve({SERVICE_NAME: self.service})
 
+        if daemon_kwargs_fn is None:
+
+            def daemon_kwargs_fn(i):
+                slow = i < cfg.n_slow
+                return {
+                    "_slow": slow,
+                    "upload_delay_s": cfg.slow_delay_s if slow else cfg.fast_delay_s,
+                    "host_stats_override": dict(
+                        cfg.slow_stats if slow else cfg.fast_stats
+                    ),
+                }
+
         self.daemons = []
         self.slow_ids: set[str] = set()
         for i in range(cfg.n_daemons):
-            slow = i < cfg.n_slow
+            overrides = dict(daemon_kwargs_fn(i))
+            slow = overrides.pop("_slow", False)
             d = Daemon(
                 DaemonConfig(
                     data_dir=os.path.join(workdir, f"daemon-{i}"),
@@ -109,11 +144,8 @@ class _Cluster:
                     piece_length=cfg.piece_length,
                     schedule_timeout=10.0,
                     announce_interval=60.0,
-                    upload_delay_s=cfg.slow_delay_s if slow else cfg.fast_delay_s,
                     collect_host_stats=False,
-                    host_stats_override=dict(
-                        cfg.slow_stats if slow else cfg.fast_stats
-                    ),
+                    **overrides,
                 )
             )
             d.start()
@@ -248,6 +280,25 @@ def _train_and_activate(cluster: _Cluster, workdir: str):
     client.UpdateModel(
         manager_pb2.UpdateModelRequest(model_id=model_id, version=1, state="active")
     )
+    # the GRU leg trains by default (TrainingConfig.gru); activate it too
+    # when it produced a model (the bad-node scenario consumes it — a
+    # too-small record set skips the leg without failing the MLP path).
+    # ONLY NOT_FOUND is the benign skip; any other failure is a real
+    # serving-loop regression and must fail the harness loudly.
+    import grpc
+
+    from dragonfly2_tpu.utils.idgen import gru_model_id_v1
+
+    try:
+        client.UpdateModel(
+            manager_pb2.UpdateModelRequest(
+                model_id=gru_model_id_v1(ip, hostname), version=1, state="active"
+            )
+        )
+    except grpc.RpcError as e:
+        if e.code() != grpc.StatusCode.NOT_FOUND:
+            raise
+        logger.info("no GRU model to activate (too few sequences)")
     return client, server, channel, metrics
 
 
@@ -260,14 +311,9 @@ def run_ab(cfg: ABConfig | None = None, workdir: str | None = None) -> dict:
     rng = random.Random(cfg.seed)
 
     # shared origin payloads — identical workload in both phases
-    origins = []
-    origin_dir = os.path.join(workdir, "origin")
-    os.makedirs(origin_dir, exist_ok=True)
-    for t in range(cfg.n_tasks):
-        path = os.path.join(origin_dir, f"task-{t}.bin")
-        with open(path, "wb") as f:
-            f.write(rng.randbytes(cfg.piece_length * cfg.pieces_per_task))
-        origins.append(f"file://{path}")
+    origins = _make_origins(
+        workdir, "shared", cfg.n_tasks, cfg.piece_length, cfg.pieces_per_task, rng
+    )
 
     # ---- phase 1: default evaluator (also produces training data) ----
     logger.info("phase 1: default evaluator, %d daemons", cfg.n_daemons)
@@ -310,12 +356,189 @@ def run_ab(cfg: ABConfig | None = None, workdir: str | None = None) -> dict:
     return out
 
 
+@dataclass
+class GruABConfig:
+    """Degrading-parent scenario (round-4 verdict #6): isolates the GRU
+    bad-node leg. Every host announces IDENTICAL stats (the MLP ranking
+    cannot separate them) and serves a benign cold-piece pattern (piece
+    0 slow — TCP slow start / cold cache). One host then degrades on
+    both sides mid-scenario. The statistical bad-node rule is blind
+    here: the benign cold spike inflates its per-peer mean, so
+    sustained ~15x degradation stays under the 20x-mean threshold
+    (evaluator.py:156-168); the GRU learned the cold-piece schedule
+    from phase-1 records, so off-schedule highs blow past its
+    prediction margin and the parent gets filtered."""
+
+    n_daemons: int = 6
+    n_train_tasks: int = 8    # phase 1: records the GRU trains on
+    n_measure_tasks: int = 5  # phase 2: identical workload per arm
+    piece_length: int = 16 * 1024
+    pieces_per_task: int = 6
+    fast_delay_s: float = 0.002
+    cold_piece_delay_s: float = 0.030  # benign: piece 0 only, every host
+    degraded_delay_s: float = 0.030    # degradation: EVERY piece + own downloads
+    candidate_parent_limit: int = 2
+    seed: int = 11
+    stats: dict = field(
+        default_factory=lambda: {"cpu.percent": 30.0, "memory.used_percent": 40.0}
+    )
+
+
+def _gru_run_workload(cluster: _Cluster, cfg: GruABConfig, origins: list[str]):
+    """Per task: a healthy seeder back-sources, the DEGRADED host (index
+    0) downloads next — giving its peer the degraded cost history the
+    detectors read — then the remaining hosts download. Measures the
+    children's remote-peer piece costs and the fraction pulled from the
+    degraded host."""
+    from dragonfly2_tpu.client import dfget
+    from dragonfly2_tpu.client.piece_manager import TRAFFIC_REMOTE_PEER
+
+    peer_host: dict[str, str] = {}
+    costs_ms: list[float] = []
+    degraded_pulls = total_pulls = 0
+    degraded = cluster.daemons[0]
+
+    for t, url in enumerate(origins):
+        seeder = cluster.daemons[1]
+        dfget.download(
+            f"127.0.0.1:{seeder.port}", url, f"{seeder.cfg.data_dir}/seed-{t}.bin"
+        )
+        task_id = seeder.task_manager.task_id_for(url, None)
+        ts = seeder.storage.find_completed_task(task_id)
+        peer_host[ts.meta.peer_id] = seeder.host_id
+
+        # degraded host downloads second: its peer history carries the
+        # sustained-high pattern before any child asks for parents
+        dfget.download(
+            f"127.0.0.1:{degraded.port}", url, f"{degraded.cfg.data_dir}/own-{t}.bin"
+        )
+        ts_d = degraded.storage.find_completed_task(task_id)
+        peer_host[ts_d.meta.peer_id] = degraded.host_id
+
+        for c in range(2, cfg.n_daemons):
+            cd = cluster.daemons[c]
+            out = f"{cd.cfg.data_dir}/out-{t}.bin"
+            dfget.download(f"127.0.0.1:{cd.port}", url, out)
+            ts_c = cd.storage.find_completed_task(task_id)
+            peer_host[ts_c.meta.peer_id] = cd.host_id
+            for p in ts_c.meta.pieces.values():
+                if p.traffic_type != TRAFFIC_REMOTE_PEER:
+                    continue
+                costs_ms.append(p.cost_ns / 1e6)
+                total_pulls += 1
+                if peer_host.get(p.parent_id) == degraded.host_id:
+                    degraded_pulls += 1
+
+    return PhaseResult(
+        p50_ms=_percentile(costs_ms, 50),
+        p90_ms=_percentile(costs_ms, 90),
+        mean_ms=float(np.mean(costs_ms)) if costs_ms else 0.0,
+        piece_count=len(costs_ms),
+        slow_parent_fraction=degraded_pulls / total_pulls if total_pulls else 0.0,
+    )
+
+
+def run_gru_ab(cfg: GruABConfig | None = None, workdir: str | None = None) -> dict:
+    """GRU-attributable A/B: identical degraded-parent workload under
+    the ml evaluator WITHOUT the GRU (bad-node = base statistics) vs
+    WITH it — the MLP ranking is shared by both arms, so any delta is
+    the GRU's. Returns a dict for AB_RESULTS.json's "gru" section."""
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator, MLEvaluator
+    from dragonfly2_tpu.scheduler.model_refresher import ModelRefresher
+
+    cfg = cfg or GruABConfig()
+    workdir = workdir or tempfile.mkdtemp(prefix="dragonfly-ab-gru-")
+    rng = random.Random(cfg.seed)
+
+    ab = ABConfig(
+        n_daemons=cfg.n_daemons,
+        piece_length=cfg.piece_length,
+        pieces_per_task=cfg.pieces_per_task,
+        candidate_parent_limit=cfg.candidate_parent_limit,
+        seed=cfg.seed,
+    )
+
+
+
+    def healthy_kwargs(i):
+        return {
+            "upload_delay_s": cfg.fast_delay_s,
+            "upload_cold_piece_delay_s": cfg.cold_piece_delay_s,
+            "host_stats_override": dict(cfg.stats),
+        }
+
+    def measure_kwargs(i):
+        kw = healthy_kwargs(i)
+        if i == 0:  # the degrading parent: slow serving AND slow own IO
+            kw["_slow"] = True
+            kw["upload_delay_s"] = cfg.degraded_delay_s
+            kw["download_delay_s"] = cfg.degraded_delay_s
+        return kw
+
+    # ---- phase 1: healthy cluster produces the training records ----
+    logger.info("gru phase 1: healthy cold-piece cluster, %d tasks", cfg.n_train_tasks)
+    c1 = _Cluster(ab, BaseEvaluator(), os.path.join(workdir, "phase-train"),
+                  daemon_kwargs_fn=healthy_kwargs)
+    try:
+        train_origins = _make_origins(
+            workdir, "train", cfg.n_train_tasks, cfg.piece_length, cfg.pieces_per_task, rng
+        )
+        _run_workload(c1, ab, train_origins)
+        client, mgr_server, mgr_channel, _ = _train_and_activate(
+            c1, os.path.join(workdir, "manager")
+        )
+    finally:
+        c1.stop()
+
+    measure_origins = _make_origins(
+        workdir, "measure", cfg.n_measure_tasks, cfg.piece_length, cfg.pieces_per_task, rng
+    )
+    results = {}
+    try:
+        for arm in ("ml", "ml_gru"):
+            evaluator = MLEvaluator()
+            refresher = ModelRefresher(client, evaluator, scheduler_cluster_id=1)
+            if not refresher.refresh_once():
+                raise RuntimeError("model refresh failed")
+            if arm == "ml":
+                # ablation: same MLP ranking, bad-node back to statistics
+                evaluator.set_gru(None)
+            elif evaluator._gru is None:
+                raise RuntimeError("no GRU installed — phase 1 produced too few sequences")
+            c = _Cluster(ab, evaluator, os.path.join(workdir, f"phase-{arm}"),
+                         daemon_kwargs_fn=measure_kwargs)
+            try:
+                results[arm] = _gru_run_workload(c, cfg, measure_origins)
+            finally:
+                c.stop()
+    finally:
+        mgr_channel.close()
+        mgr_server.stop(0)
+
+    ml, gru = results["ml"], results["ml_gru"]
+    return {
+        "scenario": "degrading-parent (benign cold-piece pattern)",
+        "p50_ml_ms": round(ml.p50_ms, 3),
+        "p50_ml_gru_ms": round(gru.p50_ms, 3),
+        "p90_ml_ms": round(ml.p90_ms, 3),
+        "p90_ml_gru_ms": round(gru.p90_ms, 3),
+        "degraded_parent_fraction_ml": round(ml.slow_parent_fraction, 3),
+        "degraded_parent_fraction_ml_gru": round(gru.slow_parent_fraction, 3),
+        "pieces_ml": ml.piece_count,
+        "pieces_ml_gru": gru.piece_count,
+        "gru_wins": gru.p50_ms < ml.p50_ms
+        and gru.slow_parent_fraction < ml.slow_parent_fraction,
+    }
+
+
 def main() -> None:
     # same platform hook as the service binaries
     from dragonfly2_tpu.cli.config import apply_jax_platform_env
 
     apply_jax_platform_env()
-    print(json.dumps(run_ab()))
+    out = run_ab()
+    out["gru"] = run_gru_ab()
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -76,8 +76,13 @@ class TrainingConfig:
     streaming_time_budget_s: "float | None" = None
     # third model family: GRU next-piece-cost predictor over per-parent
     # piece-cost sequences (Download records carry up to 10 piece costs
-    # per parent, reference scheduler/storage/types.go:143-176)
-    gru: bool = False
+    # per parent, reference scheduler/storage/types.go:143-176). ON by
+    # default since round 5: the third model family — and the ml
+    # evaluator's model-based bad-node detection that consumes it — must
+    # train under production defaults, not behind a knob (round-4
+    # verdict). gru_error still never gates .ok, so a host with too few
+    # sequences just skips the leg.
+    gru: bool = True
     gru_min_sequences: int = 8
     # RAM bound for the GRU leg: sequences kept per fit (~70 B each);
     # past this, more history stops improving the next-cost model

@@ -40,3 +40,30 @@ def test_ml_evaluator_beats_default_p50(tmp_path):
     assert last["slow_parent_fraction_ml"] < last["slow_parent_fraction_default"], last
     # ...and win the headline metric
     assert last["p50_ml_ms"] < last["p50_default_ms"], last
+
+
+@pytest.mark.slow
+def test_gru_bad_node_beats_statistics_on_degrading_parent(tmp_path):
+    """Round-4 verdict #6: the GRU-attributable scenario. Both arms share
+    the MLP ranking; only bad-node detection differs (statistics vs GRU
+    prediction). The benign cold-piece pattern inflates the statistical
+    rule's per-peer mean so the degraded parent stays under its 20x-mean
+    threshold; the GRU learned the pattern and filters the parent."""
+    from dragonfly2_tpu.tools.ab_harness import GruABConfig, run_gru_ab
+
+    cfg = GruABConfig(n_daemons=5, n_train_tasks=6, n_measure_tasks=3)
+    last = None
+    for attempt in range(2):  # same wall-clock-jitter allowance as above
+        out = run_gru_ab(cfg, workdir=str(tmp_path / f"attempt-{attempt}"))
+        assert out["pieces_ml"] == out["pieces_ml_gru"] > 0
+        if out["gru_wins"]:
+            return
+        last = out
+    # the GRU must steer children away from the degraded parent where
+    # the statistical detector cannot see it...
+    assert (
+        last["degraded_parent_fraction_ml_gru"]
+        < last["degraded_parent_fraction_ml"]
+    ), last
+    # ...and win the piece-latency metric
+    assert last["p50_ml_gru_ms"] < last["p50_ml_ms"], last

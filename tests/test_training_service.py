@@ -86,7 +86,9 @@ class TestTrainingOrchestration:
         storage, manager, cfg, ip, hostname, hid = setup
         outcome = Training(storage, manager, cfg).train(ip, hostname)
         assert outcome.ok, (outcome.mlp_error, outcome.gnn_error)
-        assert set(manager.models) == {"mlp", "gnn"}
+        # gru included: the third model family trains under production
+        # DEFAULTS since round 5 (TrainingConfig.gru=True)
+        assert set(manager.models) == {"mlp", "gnn", "gru"}
         assert "mse" in manager.models["mlp"]["evaluation"]
         assert "f1" in manager.models["gnn"]["evaluation"]
         # consumed datasets cleared (reference retrains from scratch each round)
