@@ -45,6 +45,72 @@ PEER_GAUGE = _r.gauge("scheduler_peers", "Live peers in the resource model", ("s
 TASK_GAUGE = _r.gauge("scheduler_tasks", "Live tasks in the resource model")
 HOST_GAUGE = _r.gauge("scheduler_hosts", "Announced hosts", ("type",))
 
+# -- round-5 breadth to reference coverage (metrics.go:46-454) -----------
+ANNOUNCE_PEER_FAILURE_TOTAL = _r.counter(
+    "scheduler_announce_peer_failure_total", "AnnouncePeer stream failures"
+)
+REGISTER_PEER_FAILURE_TOTAL = _r.counter(
+    "scheduler_register_peer_failure_total", "Failed peer registrations"
+)
+STAT_PEER_TOTAL = _r.counter("scheduler_stat_peer_total", "StatPeer calls")
+STAT_PEER_FAILURE_TOTAL = _r.counter(
+    "scheduler_stat_peer_failure_total", "StatPeer calls that failed"
+)
+LEAVE_PEER_TOTAL = _r.counter("scheduler_leave_peer_total", "LeavePeer/LeaveTask calls")
+LEAVE_PEER_FAILURE_TOTAL = _r.counter(
+    "scheduler_leave_peer_failure_total", "LeavePeer/LeaveTask calls that failed"
+)
+STAT_TASK_TOTAL = _r.counter("scheduler_stat_task_total", "StatTask calls")
+STAT_TASK_FAILURE_TOTAL = _r.counter(
+    "scheduler_stat_task_failure_total", "StatTask calls that failed"
+)
+DOWNLOAD_PEER_STARTED_TOTAL = _r.counter(
+    "scheduler_download_peer_started_total", "Peers that started downloading"
+)
+DOWNLOAD_PEER_BACK_TO_SOURCE_STARTED_TOTAL = _r.counter(
+    "scheduler_download_peer_back_to_source_started_total",
+    "Peers that started downloading back-to-source",
+)
+DOWNLOAD_PIECE_FAILURE_TOTAL = _r.counter(
+    "scheduler_download_piece_failure_total", "Failed piece results ingested"
+)
+ANNOUNCE_HOST_FAILURE_TOTAL = _r.counter(
+    "scheduler_announce_host_failure_total", "AnnounceHost calls that failed"
+)
+LEAVE_HOST_FAILURE_TOTAL = _r.counter(
+    "scheduler_leave_host_failure_total", "LeaveHost calls that failed"
+)
+SYNC_PROBES_FAILURE_TOTAL = _r.counter(
+    "scheduler_sync_probes_failure_total", "SyncProbes stream failures"
+)
+# per-host traffic (reference metrics.go:244-251: the HostTraffic series
+# keyed by traffic type + host). Cardinality note mirrors the reference:
+# one series per (type, host) pair — bounded by cluster size.
+HOST_TRAFFIC_BYTES_TOTAL = _r.counter(
+    "scheduler_host_traffic_bytes_total",
+    "Piece bytes by traffic type and host",
+    ("traffic_type", "host_id", "host_ip"),
+)
+# whole-download duration by task size class (reference
+# DownloadPeerDuration with CalculateSizeLevel buckets)
+DOWNLOAD_PEER_DURATION_MS = _r.histogram(
+    "scheduler_download_peer_duration_milliseconds",
+    "Whole-download duration per finished peer",
+    buckets=(100, 500, 1000, 5000, 10000, 30000, 60000, 300000),
+)
+CONCURRENT_SCHEDULE_GAUGE = _r.gauge(
+    "scheduler_concurrent_schedule", "Scheduling passes in flight"
+)
+VERSION_GAUGE = _r.gauge(
+    "scheduler_version", "Build info (value is always 1)", ("version",)
+)
+
+
+def set_version_info() -> None:
+    from dragonfly2_tpu.version import __version__
+
+    VERSION_GAUGE.labels(__version__).set(1)
+
 
 # label values seen on previous refreshes — a group that disappears must
 # be zeroed, not left at its last value (phantom peers in dashboards)

@@ -111,12 +111,14 @@ class Scheduling:
         _span = tracing.get("scheduler").start_span(
             "schedule", peer_id=peer.id, task_id=peer.task.id
         )
+        M.CONCURRENT_SCHEDULE_GAUGE.inc()
         try:
             self._schedule_loop(peer, blocklist, cancelled, n, _t0, _span)
         except BaseException:
             _span.end("error")
             raise
         finally:
+            M.CONCURRENT_SCHEDULE_GAUGE.dec()
             _span.end("ok")  # idempotent; attributes set at decision points
 
     def _schedule_loop(self, peer, blocklist, cancelled, n, _t0, _span):

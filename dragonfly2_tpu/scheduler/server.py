@@ -123,6 +123,7 @@ class SchedulerServer:
         )
         from dragonfly2_tpu.scheduler import metrics as _M
 
+        _M.set_version_info()
         self.gc.add(
             GCTask(
                 "metrics-refresh",

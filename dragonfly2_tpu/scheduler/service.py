@@ -226,6 +226,7 @@ class SchedulerService:
             except grpc.RpcError:
                 pass  # client hung up — normal stream teardown
             except Exception:
+                M.ANNOUNCE_PEER_FAILURE_TOTAL.inc()
                 logger.exception("announce stream failed")
             finally:
                 peer = state.get("peer")
@@ -254,11 +255,13 @@ class SchedulerService:
         state["peer"] = peer
 
         if which == "download_peer_started":
+            M.DOWNLOAD_PEER_STARTED_TOTAL.inc()
             if peer.fsm.can(res.PEER_EVENT_DOWNLOAD):
                 peer.fsm.event(res.PEER_EVENT_DOWNLOAD)
             if peer.task.fsm.can(res.TASK_EVENT_DOWNLOAD):
                 peer.task.fsm.event(res.TASK_EVENT_DOWNLOAD)
         elif which == "download_peer_back_to_source_started":
+            M.DOWNLOAD_PEER_BACK_TO_SOURCE_STARTED_TOTAL.inc()
             if peer.fsm.can(res.PEER_EVENT_DOWNLOAD_BACK_TO_SOURCE):
                 peer.fsm.event(res.PEER_EVENT_DOWNLOAD_BACK_TO_SOURCE)
                 peer.task.back_to_source_peers.add(peer.id)
@@ -272,8 +275,12 @@ class SchedulerService:
             piece = req.download_piece_finished.piece
             M.DOWNLOAD_PIECE_FINISHED_TOTAL.labels(piece.traffic_type or "unknown").inc()
             M.TRAFFIC_BYTES_TOTAL.labels(piece.traffic_type or "unknown").inc(piece.length)
+            M.HOST_TRAFFIC_BYTES_TOTAL.labels(
+                piece.traffic_type or "unknown", peer.host.id, peer.host.ip
+            ).inc(piece.length)
             self._piece_finished(peer, piece)
         elif which == "download_piece_failed":
+            M.DOWNLOAD_PIECE_FAILURE_TOTAL.inc()
             parent_id = req.download_piece_failed.parent_id
             if parent_id:
                 peer.block_parents.add(parent_id)
@@ -284,6 +291,8 @@ class SchedulerService:
             M.DOWNLOAD_PEER_FINISHED_TOTAL.inc()
             fin = req.download_peer_finished
             peer.cost_ns = fin.cost_ns
+            if fin.cost_ns > 0:
+                M.DOWNLOAD_PEER_DURATION_MS.observe(fin.cost_ns / 1e6)
             if peer.fsm.can(res.PEER_EVENT_DOWNLOAD_SUCCEEDED):
                 peer.fsm.event(res.PEER_EVENT_DOWNLOAD_SUCCEEDED)
             # a finished download always knows its true size — 0 is a
@@ -395,8 +404,10 @@ class SchedulerService:
     # unary RPCs
     # ------------------------------------------------------------------
     def StatPeer(self, request, context):
+        M.STAT_PEER_TOTAL.inc()
         peer = self.resource.peer_manager.load(request.peer_id)
         if peer is None:
+            M.STAT_PEER_FAILURE_TOTAL.inc()
             context.abort(grpc.StatusCode.NOT_FOUND, f"peer {request.peer_id} not found")
         return scheduler_pb2.PeerStat(
             id=peer.id,
@@ -406,7 +417,12 @@ class SchedulerService:
         )
 
     def LeavePeer(self, request, context):
+        M.LEAVE_PEER_TOTAL.inc()
         peer = self.resource.peer_manager.load(request.peer_id)
+        if peer is None:
+            # tolerated (idempotent leave) but COUNTED — the reference
+            # errors here, so the failure series is where operators see it
+            M.LEAVE_PEER_FAILURE_TOTAL.inc()
         if peer is not None:
             if peer.fsm.can(res.PEER_EVENT_LEAVE):
                 peer.fsm.event(res.PEER_EVENT_LEAVE)
@@ -415,8 +431,10 @@ class SchedulerService:
         return scheduler_pb2.Empty()
 
     def StatTask(self, request, context):
+        M.STAT_TASK_TOTAL.inc()
         task = self.resource.task_manager.load(request.task_id)
         if task is None:
+            M.STAT_TASK_FAILURE_TOTAL.inc()
             context.abort(grpc.StatusCode.NOT_FOUND, f"task {request.task_id} not found")
         return scheduler_pb2.TaskStat(
             id=task.id,
@@ -429,6 +447,13 @@ class SchedulerService:
 
     def AnnounceHost(self, request, context):
         M.HOST_TOTAL.inc()
+        try:
+            return self._announce_host(request)
+        except Exception:
+            M.ANNOUNCE_HOST_FAILURE_TOTAL.inc()
+            raise
+
+    def _announce_host(self, request):
         host = _host_from_info(request.host)
         existing = self.resource.host_manager.load(host.id)
         if existing is None:
@@ -499,6 +524,8 @@ class SchedulerService:
     def LeaveHost(self, request, context):
         M.LEAVE_HOST_TOTAL.inc()
         host = self.resource.host_manager.load(request.host_id)
+        if host is None:
+            M.LEAVE_HOST_FAILURE_TOTAL.inc()  # see LeavePeer note
         if host is not None:
             host.leave_peers()
             self.resource.host_manager.delete(request.host_id)
@@ -510,6 +537,13 @@ class SchedulerService:
     # SyncProbes bidi stream (reference service_v1.go:688-778)
     # ------------------------------------------------------------------
     def SyncProbes(self, request_iterator, context):
+        try:
+            yield from self._sync_probes(request_iterator)
+        except Exception:
+            M.SYNC_PROBES_FAILURE_TOTAL.inc()
+            raise
+
+    def _sync_probes(self, request_iterator):
         for req in request_iterator:
             which = req.WhichOneof("request")
             src_id = req.host.id

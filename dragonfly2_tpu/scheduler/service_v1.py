@@ -138,6 +138,13 @@ class SchedulerServiceV1:
     # RegisterPeerTask (unary, size-scope dispatch)
     # ------------------------------------------------------------------
     def RegisterPeerTask(self, request: v1.PeerTaskRequest, context):
+        try:
+            return self._register_peer_task(request)
+        except Exception:
+            M.REGISTER_PEER_FAILURE_TOTAL.inc()
+            raise
+
+    def _register_peer_task(self, request: v1.PeerTaskRequest):
         host = self._store_host(request.peer_host)
         meta = url_meta_of(request.url_meta)
         task_id = request.task_id or task_id_v1(request.url, meta)
@@ -330,6 +337,11 @@ class SchedulerServiceV1:
             M.TRAFFIC_BYTES_TOTAL.labels(
                 req.piece_info.traffic_type or "remote_peer"
             ).inc(req.piece_info.length)
+            M.HOST_TRAFFIC_BYTES_TOTAL.labels(
+                req.piece_info.traffic_type or "remote_peer",
+                peer.host.id,
+                peer.host.ip,
+            ).inc(req.piece_info.length)
             cost_ms = req.piece_info.cost_ns / 1e6
             piece = res.Piece(
                 number=number,
@@ -360,6 +372,7 @@ class SchedulerServiceV1:
             # as non-fatal)
             return
         else:
+            M.DOWNLOAD_PIECE_FAILURE_TOTAL.inc()
             # failed piece: penalise the parent and re-schedule (reference
             # service_v1.go:1210 handlePieceFail → reschedule)
             if req.dst_pid:
@@ -387,6 +400,8 @@ class SchedulerServiceV1:
         peer.cost_ns = request.cost_ns
         if request.success:
             M.DOWNLOAD_PEER_FINISHED_TOTAL.inc()
+            if request.cost_ns > 0:
+                M.DOWNLOAD_PEER_DURATION_MS.observe(request.cost_ns / 1e6)
             if peer.fsm.can(res.PEER_EVENT_DOWNLOAD_SUCCEEDED):
                 peer.fsm.event(res.PEER_EVENT_DOWNLOAD_SUCCEEDED)
             # 0 is a legitimate value here (empty file), not "unset" —
@@ -429,8 +444,10 @@ class SchedulerServiceV1:
     # unary task/host RPCs
     # ------------------------------------------------------------------
     def StatTask(self, request: v1.StatTaskRequest, context):
+        M.STAT_TASK_TOTAL.inc()
         task = self.resource.task_manager.load(request.task_id)
         if task is None:
+            M.STAT_TASK_FAILURE_TOTAL.inc()
             context.abort(grpc.StatusCode.NOT_FOUND, f"task {request.task_id} not found")
         return v1.Task(
             id=task.id,
@@ -442,6 +459,7 @@ class SchedulerServiceV1:
         )
 
     def LeaveTask(self, request: v1.PeerTarget, context):
+        M.LEAVE_PEER_TOTAL.inc()
         peer = self.resource.peer_manager.load(request.peer_id)
         if peer is not None:
             if peer.fsm.can(res.PEER_EVENT_LEAVE):
@@ -542,13 +560,26 @@ class SchedulerServiceV1:
     def AnnounceHost(self, request, context):
         from dragonfly2_tpu.scheduler.service import SchedulerService
 
-        SchedulerService.AnnounceHost(self, request, context)
+        # the domain helpers (not the public handlers, which wrap them
+        # with metric accounting bound to SchedulerService's layout) —
+        # this servicer does NOT inherit from the v2 class, it borrows
+        # the shared body with its own resource/topology state
+        M.HOST_TOTAL.inc()
+        try:
+            SchedulerService._announce_host(self, request)
+        except Exception:
+            M.ANNOUNCE_HOST_FAILURE_TOTAL.inc()
+            raise
         return v1.Empty()
 
     def SyncProbes(self, request_iterator, context):
         from dragonfly2_tpu.scheduler.service import SchedulerService
 
-        for resp in SchedulerService.SyncProbes(self, request_iterator, context):
-            yield v1.SyncProbesResponse(
-                hosts=[v1.ProbeHost(host=h.host) for h in resp.hosts]
-            )
+        try:
+            for resp in SchedulerService._sync_probes(self, request_iterator):
+                yield v1.SyncProbesResponse(
+                    hosts=[v1.ProbeHost(host=h.host) for h in resp.hosts]
+                )
+        except Exception:
+            M.SYNC_PROBES_FAILURE_TOTAL.inc()
+            raise

@@ -29,8 +29,11 @@ class TrainerServerConfig:
     min_topology_records: int = 1
     # third model family: GRU over per-(task,parent) piece-cost
     # sequences extracted from the same download records (our addition
-    # over the reference's MLP+GNN pair — see trainer/training.py)
-    gru: bool = False
+    # over the reference's MLP+GNN pair — see trainer/training.py). ON
+    # by default since round 5, matching TrainingConfig.gru: the ml
+    # evaluator's model-based bad-node detection must train under
+    # production defaults.
+    gru: bool = True
     gru_min_sequences: int = 8
     incremental: bool = False
     streaming: bool = True

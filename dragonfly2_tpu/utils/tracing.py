@@ -3,11 +3,22 @@ initialized per binary, cmd/dependency/dependency.go:95-122; span per
 peer task, client/daemon/peer/peertask_conductor.go:123-124).
 
 In-process span recorder with W3C-style ids, parent links, attributes,
-events, and two sinks: a bounded in-memory ring (always on — cheap
-introspection for tests/debug) and an optional JSONL export file (one
-span per line; an OTLP forwarder is a sink swap away — the schema
-carries everything OTLP needs). The compute plane adds `jax.profiler`
-traces via trainer config (profile_dir), the XLA-side equivalent.
+events, and three sinks:
+
+- bounded in-memory ring (always on — cheap introspection for tests),
+- file export in two formats: ``jsonl`` (this repo's compact debug
+  schema) or ``otlp`` — each line a complete OTLP/JSON
+  ``ExportTraceServiceRequest``, the encoding the OpenTelemetry
+  collector's ``otlpjsonfile`` receiver ingests directly (and through
+  it Jaeger/Perfetto — the wire parity the reference gets from its
+  Jaeger exporter),
+- optional OTLP/HTTP push (``DF_TRACE_OTLP_ENDPOINT``): batched POSTs
+  of the same request shape to a collector's ``/v1/traces``.
+
+Env: ``DF_TRACE_DIR`` (file export dir), ``DF_TRACE_FORMAT``
+(``jsonl``|``otlp``, default jsonl), ``DF_TRACE_OTLP_ENDPOINT``. The
+compute plane adds `jax.profiler` traces via trainer config
+(profile_dir), the XLA-side equivalent.
 """
 
 from __future__ import annotations
@@ -73,13 +84,153 @@ class Span:
         return False
 
 
+# ---------------------------------------------------------------------------
+# OTLP/JSON encoding (opentelemetry-proto trace/v1, JSON mapping)
+# ---------------------------------------------------------------------------
+
+_OTLP_STATUS = {"ok": 1, "error": 2}  # STATUS_CODE_OK / STATUS_CODE_ERROR
+
+
+def _otlp_value(v) -> dict:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}  # int64 is a JSON string in OTLP
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def _otlp_attrs(attrs: dict) -> list:
+    return [{"key": str(k), "value": _otlp_value(v)} for k, v in attrs.items()]
+
+
+def otlp_span(span: "Span") -> dict:
+    """One span in OTLP/JSON shape (ids are already the right widths:
+    32-hex trace ids, 16-hex span ids)."""
+    out = {
+        "traceId": span.trace_id,
+        "spanId": span.span_id,
+        "name": span.name,
+        "kind": 1,  # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(span.start_ns),
+        "endTimeUnixNano": str(span.end_ns),
+        "attributes": _otlp_attrs(span.attributes),
+        "status": {"code": _OTLP_STATUS.get(span.status, 0)},
+    }
+    if span.parent_id:
+        out["parentSpanId"] = span.parent_id
+    if span.events:
+        out["events"] = [
+            {
+                "timeUnixNano": str(e.get("ts_ns", 0)),
+                "name": e.get("name", ""),
+                "attributes": _otlp_attrs(
+                    {k: v for k, v in e.items() if k not in ("name", "ts_ns")}
+                ),
+            }
+            for e in span.events
+        ]
+    return out
+
+
+def otlp_request(spans: list, service: str) -> dict:
+    """A complete ExportTraceServiceRequest — the unit both the OTLP/HTTP
+    ``/v1/traces`` endpoint and the collector's otlpjsonfile receiver
+    consume."""
+    return {
+        "resourceSpans": [
+            {
+                "resource": {
+                    "attributes": _otlp_attrs({"service.name": f"dragonfly2-tpu-{service}"})
+                },
+                "scopeSpans": [
+                    {
+                        "scope": {"name": "dragonfly2_tpu.utils.tracing"},
+                        "spans": [otlp_span(s) for s in spans],
+                    }
+                ],
+            }
+        ]
+    }
+
+
+class _OtlpHttpPusher:
+    """Background batcher POSTing ExportTraceServiceRequests to a
+    collector. Failures are counted, never raised — tracing must not
+    take down the service plane (same posture as the reference's
+    exporter)."""
+
+    FLUSH_INTERVAL_S = 2.0
+    MAX_BATCH = 256
+
+    def __init__(self, endpoint: str, service: str):
+        self.endpoint = endpoint.rstrip("/")
+        if not self.endpoint.endswith("/v1/traces"):
+            self.endpoint += "/v1/traces"
+        self.service = service
+        self.dropped = 0
+        self._q: collections.deque = collections.deque(maxlen=4096)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"otlp-push-{service}", daemon=True
+        )
+        self._thread.start()
+
+    def enqueue(self, span: "Span") -> None:
+        if len(self._q) == self._q.maxlen:
+            self.dropped += 1  # deque eviction must not be silent
+        self._q.append(span)
+
+    def _flush_once(self) -> None:
+        import urllib.request
+
+        while self._q:
+            batch = []
+            while self._q and len(batch) < self.MAX_BATCH:
+                batch.append(self._q.popleft())
+            body = json.dumps(otlp_request(batch, self.service)).encode()
+            req = urllib.request.Request(
+                self.endpoint,
+                data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:
+                self.dropped += len(batch)
+                return  # collector down: don't spin through the backlog
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.FLUSH_INTERVAL_S):
+            self._flush_once()
+        # drain on shutdown: the final batch holds the teardown-path
+        # spans — the ones most wanted when debugging a shutdown
+        self._flush_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+
 class Tracer:
-    def __init__(self, service: str, export_path: str | None = None):
+    def __init__(
+        self,
+        service: str,
+        export_path: str | None = None,
+        fmt: str = "jsonl",
+        otlp_endpoint: str | None = None,
+    ):
         self.service = service
         self.export_path = export_path
+        self.fmt = fmt
         self.finished: collections.deque[Span] = collections.deque(maxlen=_RING_SIZE)
         self._lock = threading.Lock()
         self._file = None
+        self._pusher = (
+            _OtlpHttpPusher(otlp_endpoint, service) if otlp_endpoint else None
+        )
         if export_path:
             os.makedirs(os.path.dirname(export_path) or ".", exist_ok=True)
             self._file = open(export_path, "a", buffering=1)
@@ -104,8 +255,10 @@ class Tracer:
         with self._lock:
             self.finished.append(span)
             if self._file is not None:
-                self._file.write(
-                    json.dumps(
+                if self.fmt == "otlp":
+                    line = json.dumps(otlp_request([span], self.service), default=str)
+                else:
+                    line = json.dumps(
                         {
                             "name": span.name,
                             "service": span.service,
@@ -120,37 +273,62 @@ class Tracer:
                         },
                         default=str,
                     )
-                    + "\n"
-                )
+                self._file.write(line + "\n")
+        if self._pusher is not None:
+            self._pusher.enqueue(span)
 
     def close(self) -> None:
         with self._lock:
             if self._file is not None:
                 self._file.close()
                 self._file = None
+        if self._pusher is not None:
+            self._pusher.stop()
 
 
 _tracers: dict[str, Tracer] = {}
 _config_lock = threading.Lock()
 _export_dir: str | None = os.environ.get("DF_TRACE_DIR") or None
+_export_fmt: str = os.environ.get("DF_TRACE_FORMAT", "jsonl")
+_otlp_endpoint: str | None = os.environ.get("DF_TRACE_OTLP_ENDPOINT") or None
 
 
-def configure(export_dir: str | None) -> None:
-    """Set the JSONL export directory for tracers created after this
-    call (one file per service); None = in-memory ring only."""
-    global _export_dir
+_UNSET = object()
+
+
+def configure(
+    export_dir: str | None,
+    fmt=_UNSET,
+    otlp_endpoint=_UNSET,
+) -> None:
+    """Set export options for tracers created after this call (one file
+    per service). ``fmt``: "jsonl" (compact debug schema) or "otlp"
+    (one ExportTraceServiceRequest per line — collector/Jaeger
+    ingestible). ``otlp_endpoint`` additionally pushes batches to a
+    collector's /v1/traces over HTTP. Consistent None semantics: an
+    EXPLICIT None clears the option (export_dir=None → ring only,
+    otlp_endpoint=None → push off); an omitted argument leaves the
+    current value untouched."""
+    global _export_dir, _export_fmt, _otlp_endpoint
     with _config_lock:
         _export_dir = export_dir
+        if fmt is not _UNSET:
+            _export_fmt = fmt or "jsonl"
+        if otlp_endpoint is not _UNSET:
+            _otlp_endpoint = otlp_endpoint
 
 
 def get(service: str) -> Tracer:
     with _config_lock:
         tracer = _tracers.get(service)
         if tracer is None:
+            suffix = "otlp.jsonl" if _export_fmt == "otlp" else "spans.jsonl"
             path = (
-                os.path.join(_export_dir, f"{service}.spans.jsonl")
+                os.path.join(_export_dir, f"{service}.{suffix}")
                 if _export_dir
                 else None
             )
-            tracer = _tracers[service] = Tracer(service, path)
+            tracer = _tracers[service] = Tracer(
+                service, path, fmt=_export_fmt, otlp_endpoint=_otlp_endpoint
+            )
         return tracer

@@ -94,6 +94,11 @@ def main() -> int:
         PYTHONPATH=REPO,
         PYTHONUNBUFFERED="1",
         DF_JAX_PLATFORM=os.environ.get("DF_JAX_PLATFORM", "cpu"),
+        # service-plane spans in OTLP/JSON — the round-5 wire-parity leg:
+        # every line a complete ExportTraceServiceRequest the otel
+        # collector's otlpjsonfile receiver (→ Jaeger) ingests
+        DF_TRACE_DIR=os.path.join(work, "traces"),
+        DF_TRACE_FORMAT="otlp",
     )
     procs: list[Proc] = []
     try:
@@ -560,6 +565,34 @@ def main() -> int:
         on_disk_ca = open(os.path.join(work, "manager", "ca", "ca.crt"), "rb").read()
         assert ca_pem == on_disk_ca, "returned chain root must be the persisted CA"
         print("PASS dynamic certificate issuance (CSR → manager CA)")
+
+        # OTLP trace export: the booted binaries wrote span files whose
+        # every line parses as an ExportTraceServiceRequest
+        trace_files = _glob.glob(os.path.join(work, "traces", "*.otlp.jsonl"))
+        assert trace_files, "no OTLP trace files written"
+        span_count = 0
+        services = set()
+        for tf in trace_files:
+            for line in open(tf):
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError:
+                    # the services are still running — a final line may
+                    # be mid-write; completed lines are the contract
+                    continue
+                rs = req["resourceSpans"][0]
+                svc = {
+                    a["key"]: a["value"]["stringValue"]
+                    for a in rs["resource"]["attributes"]
+                }
+                services.add(svc["service.name"])
+                for sp in rs["scopeSpans"][0]["spans"]:
+                    assert len(sp["traceId"]) == 32 and len(sp["spanId"]) == 16
+                    span_count += 1
+        assert span_count > 0
+        print(
+            f"PASS OTLP trace export ({span_count} spans from {sorted(services)})"
+        )
 
         print("CLUSTER E2E: ALL PASS")
         return 0

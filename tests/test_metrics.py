@@ -220,3 +220,34 @@ def test_rpc_server_interceptor_series():
         chan.close()
     finally:
         server.stop(0)
+
+
+def test_documented_series_exist():
+    """Drift guard (round-4 verdict #7): every series named in
+    docs/metrics.md must be registered — the census vs the reference's
+    metrics.go lives in the doc, and this test keeps the doc honest."""
+    import os
+    import re
+
+    # importing the modules registers their series
+    import dragonfly2_tpu.client.metrics  # noqa: F401
+    import dragonfly2_tpu.manager.metrics  # noqa: F401
+    import dragonfly2_tpu.scheduler.metrics  # noqa: F401
+    import dragonfly2_tpu.trainer.metrics  # noqa: F401
+    from dragonfly2_tpu.rpc import glue
+    from dragonfly2_tpu.utils.metrics import default_registry
+
+    glue._rpc_metrics()  # rpc series register lazily on first server build
+
+    doc = open(
+        os.path.join(os.path.dirname(__file__), "..", "docs", "metrics.md")
+    ).read()
+    documented = set(re.findall(r"^\| `([a-z0-9_]+)` \|", doc, re.MULTILINE))
+    assert len(documented) > 40, f"doc parse failed: {len(documented)} series"
+    registered = {
+        name[len("dragonfly_"):]
+        for name in default_registry._metrics
+        if name.startswith("dragonfly_")
+    }
+    missing = documented - registered
+    assert not missing, f"documented but not registered: {sorted(missing)}"

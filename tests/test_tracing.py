@@ -3,6 +3,7 @@ peer task) — ids, parenting, export, and production wiring."""
 
 import json
 import os
+import time
 
 from dragonfly2_tpu.utils import tracing
 
@@ -73,3 +74,98 @@ def test_download_produces_task_and_schedule_spans(tmp_path):
     assert daemon_spans[-1].attributes["piece_count"] >= 1
     sched_spans = [s for s in tracing.get("scheduler").finished if s.name == "schedule"]
     assert sched_spans  # at least the back-to-source decision path ran
+
+
+def test_otlp_line_is_valid_export_request(tmp_path):
+    """OTLP/JSON file export: every line must be a complete
+    ExportTraceServiceRequest the otel collector's otlpjsonfile receiver
+    (and through it Jaeger) ingests — string uint64 nanos, 32/16-hex
+    ids, keyed attributes, numeric status codes."""
+    import json
+    import re
+
+    from dragonfly2_tpu.utils.tracing import Tracer
+
+    t = Tracer("otlptest", str(tmp_path / "t.otlp.jsonl"), fmt="otlp")
+    root = t.start_span("parent", task_id="t1", retries=2, ratio=0.5, good=True)
+    child = root.child("child")
+    child.event("piece", number=3)
+    child.end("error")
+    root.end("ok")
+    t.close()
+
+    lines = (tmp_path / "t.otlp.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 2  # one request per finished span
+    reqs = [json.loads(ln) for ln in lines]
+    for req in reqs:
+        rs = req["resourceSpans"][0]
+        svc = {a["key"]: a["value"] for a in rs["resource"]["attributes"]}
+        assert svc["service.name"] == {"stringValue": "dragonfly2-tpu-otlptest"}
+        spans = rs["scopeSpans"][0]["spans"]
+        for sp in spans:
+            assert re.fullmatch(r"[0-9a-f]{32}", sp["traceId"])
+            assert re.fullmatch(r"[0-9a-f]{16}", sp["spanId"])
+            assert isinstance(sp["startTimeUnixNano"], str)
+            assert int(sp["endTimeUnixNano"]) >= int(sp["startTimeUnixNano"])
+
+    child_sp = reqs[0]["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    parent_sp = reqs[1]["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    assert child_sp["parentSpanId"] == parent_sp["spanId"]
+    assert child_sp["traceId"] == parent_sp["traceId"]
+    assert child_sp["status"]["code"] == 2 and parent_sp["status"]["code"] == 1
+    # attribute typing survives the mapping
+    attrs = {a["key"]: a["value"] for a in parent_sp["attributes"]}
+    assert attrs["task_id"] == {"stringValue": "t1"}
+    assert attrs["retries"] == {"intValue": "2"}
+    assert attrs["ratio"] == {"doubleValue": 0.5}
+    assert attrs["good"] == {"boolValue": True}
+    # the child's event carries its own attributes
+    ev = child_sp["events"][0]
+    assert ev["name"] == "piece"
+    assert {a["key"]: a["value"] for a in ev["attributes"]}["number"] == {
+        "intValue": "3"
+    }
+
+
+def test_otlp_http_push(tmp_path):
+    """OTLP/HTTP: batched POSTs of the same request shape land on a
+    collector's /v1/traces."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from dragonfly2_tpu.utils.tracing import Tracer, _OtlpHttpPusher
+
+    received = []
+
+    class Collector(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append((self.path, json.loads(body)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Collector)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        t = Tracer(
+            "pushtest", otlp_endpoint=f"http://127.0.0.1:{srv.server_address[1]}"
+        )
+        t._pusher.FLUSH_INTERVAL_S = 0.1
+        for i in range(3):
+            t.start_span("s", i=i).end()
+        deadline = time.time() + 5
+        while not received and time.time() < deadline:
+            time.sleep(0.05)
+        t.close()
+        assert received, "collector saw no OTLP batch"
+        path, body = received[0]
+        assert path == "/v1/traces"
+        spans = body["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert len(spans) >= 1
+    finally:
+        srv.shutdown()
