@@ -48,6 +48,29 @@ def create_user(
     return db.query_one("SELECT * FROM users WHERE id = ?", (cur.lastrowid,))
 
 
+def set_password(db, user_id: int, new_password: str) -> None:
+    """Re-salt and store a new password (reset_password handler)."""
+    if not new_password:
+        raise ValueError("new password must not be empty")
+    salt = secrets.token_hex(16)
+    db.execute(
+        "UPDATE users SET password_salt = ?, password_hash = ?, updated_at = ?"
+        " WHERE id = ?",
+        (salt, _hash_password(new_password, salt), time.time(), user_id),
+    )
+
+
+def revoke_pats_for_token(db, token: str) -> int:
+    """Revoke the PAT row matching this plaintext token (signout).
+    Returns rows revoked (0 when the token is config-file based or
+    already gone — callers surface that as a client error)."""
+    cur = db.execute(
+        "UPDATE personal_access_tokens SET state = 'revoked' WHERE token_hash = ?",
+        (_hash_token(token),),
+    )
+    return cur.rowcount
+
+
 def verify_password(db, name: str, password: str) -> dict | None:
     """→ user row on a correct password for an enabled user, else None."""
     row = db.query_one(

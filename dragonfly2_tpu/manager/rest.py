@@ -477,6 +477,213 @@ class RestApi:
         auth.revoke_pat(self.db, int(req["pat_id"]))
         return {"revoked": int(req["pat_id"])}
 
+    # -- user lifecycle: signup / signout / refresh / reset ---------------
+    # (reference router.go:97-111; self-service legs are auth=False like
+    # signin — they exchange credentials, they don't consume a session)
+    @route("POST", "/api/v1/users/signup", auth=False)
+    def signup(self, req):
+        """Self-service registration — always the guest role (an open
+        route must never mint admins; promotion is an admin PATCH,
+        reference SignUp creates a regular user the same way)."""
+        from dragonfly2_tpu.manager import auth
+
+        body = req["body"]
+        try:
+            row = auth.create_user(
+                self.db,
+                body.get("name", ""),
+                body.get("password", ""),
+                role="guest",
+                email=body.get("email", ""),
+            )
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        return {k: v for k, v in row.items() if not k.startswith("password")}
+
+    @route("POST", "/api/v1/users/signout")
+    def signout(self, req):
+        """Revoke the presenting session token (reference LogoutHandler)."""
+        from dragonfly2_tpu.manager import auth
+
+        if not req["token"]:
+            raise ApiError(400, "no bearer token to sign out")
+        if not auth.revoke_pats_for_token(self.db, req["token"]):
+            # config-file tokens aren't DB rows — nothing to revoke
+            raise ApiError(400, "token is not a revocable session token")
+        return {"signed_out": True}
+
+    @route("POST", "/api/v1/users/refresh_token")
+    def refresh_token(self, req):
+        """Rotate the presenting session token: mint a fresh one with the
+        same ownership, revoke the old (reference RefreshHandler extends
+        the JWT; rotation is the PAT-shaped equivalent)."""
+        from dragonfly2_tpu.manager import auth
+
+        if not req["token"]:
+            raise ApiError(400, "no bearer token to refresh")
+        row = self.db.query_one(
+            "SELECT * FROM personal_access_tokens WHERE token_hash = ?"
+            " AND state = 'active'",
+            (auth._hash_token(req["token"]),),
+        )
+        if row is None:
+            raise ApiError(400, "token is not a refreshable session token")
+        ttl = min(_ttl_of(req["body"], default=24 * 3600.0) or 24 * 3600.0,
+                  30 * 24 * 3600.0)
+        token, _ = auth.create_pat(self.db, row["user_id"], row["name"], ttl=ttl)
+        auth.revoke_pat(self.db, row["id"])
+        return {"token": token}
+
+    @route("POST", "/api/v1/users/:id/reset_password", auth=False)
+    def reset_password(self, req):
+        """Credential exchange: proves the OLD password, stores a new one
+        (reference ResetPassword — unauthenticated route, router.go:107,
+        gated by the credential itself)."""
+        from dragonfly2_tpu.manager import auth
+
+        body = req["body"]
+        user = self.db.query_one(
+            "SELECT * FROM users WHERE id = ?", (int(req["id"]),)
+        )
+        if user is None:
+            raise ApiError(404, "user not found")
+        verified = auth.verify_password(
+            self.db, user["name"], body.get("old_password", "")
+        )
+        if verified is None:
+            raise ApiError(401, "old password incorrect")
+        try:
+            auth.set_password(self.db, user["id"], body.get("new_password", ""))
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        return {"reset": user["id"]}
+
+    # -- roles / permissions (read surface of the two-role model — the
+    # casbin delta is documented in PARITY.md; reference router.go:108-124)
+    @route("GET", "/api/v1/roles")
+    def list_roles(self, req):
+        from dragonfly2_tpu.manager.auth import ROLES
+
+        return list(ROLES)
+
+    @route("GET", "/api/v1/roles/:role")
+    def get_role(self, req):
+        from dragonfly2_tpu.manager.auth import ROLES
+
+        if req["role"] not in ROLES:
+            raise ApiError(404, f"no role {req['role']!r}")
+        writable = req["role"] == "admin"
+        return {
+            "name": req["role"],
+            "permissions": [
+                {"object": pattern, "action": method}
+                for method, _rx, _f, write, _a, pattern in _ROUTES
+                if writable or not write
+            ],
+        }
+
+    @route("GET", "/api/v1/permissions")
+    def list_permissions(self, req):
+        """Route-derived permission objects (reference GetPermissions
+        walks the gin route table the same way)."""
+        pairs = sorted(
+            {(pattern, method) for method, _rx, _f, _w, _a, pattern in _ROUTES}
+        )
+        return [{"object": p, "action": m} for p, m in pairs]
+
+    @route("GET", "/api/v1/users/:id/roles")
+    def get_user_roles(self, req):
+        row = self.db.query_one(
+            "SELECT role FROM users WHERE id = ?", (int(req["id"]),)
+        )
+        if row is None:
+            raise ApiError(404, "user not found")
+        return [row["role"]]
+
+    @route("PUT", "/api/v1/users/:id/roles/:role", write=True)
+    def add_user_role(self, req):
+        """Two-role model: PUT admin promotes, PUT guest demotes —
+        role assignment IS the role field."""
+        from dragonfly2_tpu.manager.auth import ROLES
+
+        if req["role"] not in ROLES:
+            raise ApiError(400, f"role must be one of {ROLES}")
+        cur = self.db.execute(
+            "UPDATE users SET role = ?, updated_at = ? WHERE id = ?",
+            (req["role"], time.time(), int(req["id"])),
+        )
+        if cur.rowcount == 0:
+            raise ApiError(404, "user not found")
+        return {"id": int(req["id"]), "role": req["role"]}
+
+    @route("DELETE", "/api/v1/users/:id/roles/:role", write=True)
+    def delete_user_role(self, req):
+        """Removing a role falls back to guest (the floor role)."""
+        cur = self.db.execute(
+            "UPDATE users SET role = 'guest', updated_at = ? WHERE id = ? AND role = ?",
+            (time.time(), int(req["id"]), req["role"]),
+        )
+        if cur.rowcount == 0:
+            raise ApiError(404, "user not found or does not hold that role")
+        return {"id": int(req["id"]), "role": "guest"}
+
+    # -- top-level personal-access-tokens group (reference router.go:254-260;
+    # the per-user nested group above is the console's path)
+    @route("GET", "/api/v1/personal-access-tokens")
+    def list_all_pats(self, req):
+        return self.db.query(
+            "SELECT id, user_id, name, state, expires_at, created_at"
+            " FROM personal_access_tokens ORDER BY id"
+        )
+
+    @route("GET", "/api/v1/personal-access-tokens/:id")
+    def get_pat(self, req):
+        row = self.db.query_one(
+            "SELECT id, user_id, name, state, expires_at, created_at"
+            " FROM personal_access_tokens WHERE id = ?",
+            (int(req["id"]),),
+        )
+        if row is None:
+            raise ApiError(404, "personal access token not found")
+        return row
+
+    @route("POST", "/api/v1/personal-access-tokens", write=True)
+    def create_pat_toplevel(self, req):
+        from dragonfly2_tpu.manager import auth
+
+        body = req["body"]
+        user_id = body.get("user_id")
+        if not user_id:
+            raise ApiError(400, "user_id is required")
+        if self.db.query_one("SELECT id FROM users WHERE id = ?", (int(user_id),)) is None:
+            raise ApiError(404, "user not found")
+        token, row = auth.create_pat(
+            self.db, int(user_id), body.get("name", "token"),
+            ttl=_ttl_of(body, default=0.0),
+        )
+        return {"token": token, "id": row["id"], "name": row["name"]}
+
+    @route("PATCH", "/api/v1/personal-access-tokens/:id", write=True)
+    def update_pat(self, req):
+        state = req["body"].get("state")
+        if state not in ("active", "inactive"):
+            raise ApiError(400, "state must be 'active' or 'inactive'")
+        cur = self.db.execute(
+            "UPDATE personal_access_tokens SET state = ? WHERE id = ?"
+            " AND state != 'revoked'",
+            (state, int(req["id"])),
+        )
+        if cur.rowcount == 0:
+            raise ApiError(404, "token not found or revoked")
+        return {"id": int(req["id"]), "state": state}
+
+    @route("DELETE", "/api/v1/personal-access-tokens/:id", write=True)
+    def delete_pat_toplevel(self, req):
+        from dragonfly2_tpu.manager import auth
+
+        auth.revoke_pat(self.db, int(req["id"]))
+        return {"revoked": int(req["id"])}
+
     # -- applications ----------------------------------------------------
     # -- oauth providers + sign-in flow ---------------------------------
     # (reference manager/handlers/oauth.go CRUD + OauthSignin/Callback)
@@ -581,6 +788,26 @@ class RestApi:
 
     # -- peers (reference handlers/peer.go; rows materialized from
     # sync_peers job results) -------------------------------------------
+    @route("POST", "/api/v1/peers", write=True)
+    def create_peer(self, req):
+        """Manual peer row (reference CreatePeer — rows normally arrive
+        via the sync_peers job; the write exists for operator tooling)."""
+        body = req["body"]
+        if not body.get("host_id"):
+            raise ApiError(400, "host_id is required")
+        now = time.time()
+        cur = self.db.execute(
+            "INSERT INTO peers (host_id, hostname, ip, type, state,"
+            " scheduler_cluster_id, created_at, updated_at)"
+            " VALUES (?, ?, ?, ?, 'active', ?, ?, ?)",
+            (
+                body["host_id"], body.get("hostname", ""), body.get("ip", ""),
+                body.get("type", "normal"),
+                int(body.get("scheduler_cluster_id", 1)), now, now,
+            ),
+        )
+        return self.db.query_one("SELECT * FROM peers WHERE id = ?", (cur.lastrowid,))
+
     @route("GET", "/api/v1/peers")
     def list_peers(self, req):
         q = "SELECT * FROM peers"
@@ -731,6 +958,444 @@ class RestApi:
             "SELECT * FROM applications WHERE id = ?", (cur.lastrowid,)
         )
 
+    @route("GET", "/api/v1/applications/:id")
+    def get_application(self, req):
+        row = self.db.query_one(
+            "SELECT * FROM applications WHERE id = ?", (int(req["id"]),)
+        )
+        if row is None:
+            raise ApiError(404, "application not found")
+        return row
+
+    @route("PATCH", "/api/v1/applications/:id", write=True)
+    def update_application(self, req):
+        body = req["body"]
+        sets, params = [], []
+        for col in ("name", "url"):
+            if col in body:
+                sets.append(f"{col} = ?")
+                params.append(body[col])
+        if "priority" in body:
+            sets.append("priority = ?")
+            v = body["priority"]
+            params.append(v if isinstance(v, str) else json.dumps(v))
+        if not sets:
+            raise ApiError(400, "no updatable fields in body")
+        sets.append("updated_at = ?")
+        params += [time.time(), int(req["id"])]
+        cur = self.db.execute(
+            f"UPDATE applications SET {', '.join(sets)} WHERE id = ?", tuple(params)
+        )
+        if cur.rowcount == 0:
+            raise ApiError(404, "application not found")
+        return self.get_application(req)
+
+    @route("DELETE", "/api/v1/applications/:id", write=True)
+    def delete_application(self, req):
+        self.db.execute("DELETE FROM applications WHERE id = ?", (int(req["id"]),))
+        return {"deleted": int(req["id"])}
+
+    # -- seed-peer clusters (reference router.go:159-168) -----------------
+    @route("GET", "/api/v1/seed-peer-clusters")
+    def list_seed_peer_clusters(self, req):
+        return self.db.query("SELECT * FROM seed_peer_clusters ORDER BY id")
+
+    @route("POST", "/api/v1/seed-peer-clusters", write=True)
+    def create_seed_peer_cluster(self, req):
+        body = req["body"]
+        if not body.get("name"):
+            raise ApiError(400, "name is required")
+        now = time.time()
+        cur = self.db.execute(
+            "INSERT INTO seed_peer_clusters (name, config, created_at, updated_at)"
+            " VALUES (?, ?, ?, ?)",
+            (
+                body["name"],
+                json.dumps(body.get("config", {})),
+                now,
+                now,
+            ),
+        )
+        return self.db.query_one(
+            "SELECT * FROM seed_peer_clusters WHERE id = ?", (cur.lastrowid,)
+        )
+
+    @route("GET", "/api/v1/seed-peer-clusters/:id")
+    def get_seed_peer_cluster(self, req):
+        row = self.db.query_one(
+            "SELECT * FROM seed_peer_clusters WHERE id = ?", (int(req["id"]),)
+        )
+        if row is None:
+            raise ApiError(404, "seed peer cluster not found")
+        return row
+
+    @route("PATCH", "/api/v1/seed-peer-clusters/:id", write=True)
+    def update_seed_peer_cluster(self, req):
+        body = req["body"]
+        sets, params = [], []
+        if "name" in body:
+            sets.append("name = ?")
+            params.append(body["name"])
+        if "config" in body:
+            v = body["config"]
+            sets.append("config = ?")
+            params.append(v if isinstance(v, str) else json.dumps(v))
+        if not sets:
+            raise ApiError(400, "no updatable fields in body")
+        sets.append("updated_at = ?")
+        params += [time.time(), int(req["id"])]
+        cur = self.db.execute(
+            f"UPDATE seed_peer_clusters SET {', '.join(sets)} WHERE id = ?",
+            tuple(params),
+        )
+        if cur.rowcount == 0:
+            raise ApiError(404, "seed peer cluster not found")
+        return self.get_seed_peer_cluster(req)
+
+    @route("DELETE", "/api/v1/seed-peer-clusters/:id", write=True)
+    def delete_seed_peer_cluster(self, req):
+        self.db.execute(
+            "DELETE FROM seed_peer_clusters WHERE id = ?", (int(req["id"]),)
+        )
+        return {"deleted": int(req["id"])}
+
+    @route("PUT", "/api/v1/seed-peer-clusters/:id/seed-peers/:seed_peer_id", write=True)
+    def add_seed_peer_to_cluster(self, req):
+        """Re-home a seed peer into a cluster (reference
+        AddSeedPeerToSeedPeerCluster)."""
+        if self.db.query_one(
+            "SELECT id FROM seed_peer_clusters WHERE id = ?", (int(req["id"]),)
+        ) is None:
+            raise ApiError(404, "seed peer cluster not found")
+        cur = self.db.execute(
+            "UPDATE seed_peers SET seed_peer_cluster_id = ?, updated_at = ?"
+            " WHERE id = ?",
+            (int(req["id"]), time.time(), int(req["seed_peer_id"])),
+        )
+        if cur.rowcount == 0:
+            raise ApiError(404, "seed peer not found")
+        return {"seed_peer_cluster_id": int(req["id"]),
+                "seed_peer_id": int(req["seed_peer_id"])}
+
+    # -- users read (reference GetUser, router.go:99)
+    @route("GET", "/api/v1/users/:id")
+    def get_user(self, req):
+        row = self.db.query_one(
+            "SELECT id, name, email, role, state, created_at, updated_at"
+            " FROM users WHERE id = ?",
+            (int(req["id"]),),
+        )
+        if row is None:
+            raise ApiError(404, "user not found")
+        return row
+
+    # -- jobs PATCH/DELETE (reference router.go:202-203)
+    @route("PATCH", "/api/v1/jobs/:id", write=True)
+    def update_job(self, req):
+        body = req["body"]
+        sets, params = [], []
+        if "state" in body:
+            if body["state"] not in ("queued", "running", "succeeded", "failed"):
+                raise ApiError(400, "invalid state")
+            sets.append("state = ?")
+            params.append(body["state"])
+        if "result" in body:
+            v = body["result"]
+            sets.append("result = ?")
+            params.append(v if isinstance(v, str) else json.dumps(v))
+        if not sets:
+            raise ApiError(400, "no updatable fields in body")
+        sets.append("updated_at = ?")
+        params += [time.time(), int(req["id"])]
+        cur = self.db.execute(
+            f"UPDATE jobs SET {', '.join(sets)} WHERE id = ?", tuple(params)
+        )
+        if cur.rowcount == 0:
+            raise ApiError(404, "job not found")
+        return self.get_job(req)
+
+    @route("DELETE", "/api/v1/jobs/:id", write=True)
+    def delete_job(self, req):
+        self.db.execute("DELETE FROM jobs WHERE id = ?", (int(req["id"]),))
+        return {"deleted": int(req["id"])}
+
+    # -- scheduler / seed-peer write surface (reference router.go:151-174:
+    # instances normally register over gRPC keepalive; the REST writes
+    # exist for operators pre-provisioning or correcting rows)
+    @route("POST", "/api/v1/schedulers", write=True)
+    def create_scheduler(self, req):
+        body = req["body"]
+        if not body.get("hostname") or not body.get("ip"):
+            raise ApiError(400, "hostname and ip are required")
+        now = time.time()
+        cur = self.db.execute(
+            "INSERT INTO schedulers (hostname, ip, port, idc, location, state,"
+            " scheduler_cluster_id, created_at, updated_at)"
+            " VALUES (?, ?, ?, ?, ?, 'inactive', ?, ?, ?)",
+            (
+                body["hostname"], body["ip"], int(body.get("port", 8002)),
+                body.get("idc", ""), body.get("location", ""),
+                int(body.get("scheduler_cluster_id", 1)), now, now,
+            ),
+        )
+        return self.db.query_one(
+            "SELECT * FROM schedulers WHERE id = ?", (cur.lastrowid,)
+        )
+
+    @route("PATCH", "/api/v1/schedulers/:id", write=True)
+    def update_scheduler(self, req):
+        body = req["body"]
+        sets, params = [], []
+        for col in ("idc", "location", "state"):
+            if col in body:
+                sets.append(f"{col} = ?")
+                params.append(body[col])
+        if "scheduler_cluster_id" in body:
+            sets.append("scheduler_cluster_id = ?")
+            params.append(int(body["scheduler_cluster_id"]))
+        if not sets:
+            raise ApiError(400, "no updatable fields in body")
+        sets.append("updated_at = ?")
+        params += [time.time(), int(req["id"])]
+        cur = self.db.execute(
+            f"UPDATE schedulers SET {', '.join(sets)} WHERE id = ?", tuple(params)
+        )
+        if cur.rowcount == 0:
+            raise ApiError(404, "scheduler not found")
+        return self.get_scheduler(req)
+
+    @route("POST", "/api/v1/seed-peers", write=True)
+    def create_seed_peer(self, req):
+        body = req["body"]
+        if not body.get("hostname") or not body.get("ip"):
+            raise ApiError(400, "hostname and ip are required")
+        now = time.time()
+        cur = self.db.execute(
+            "INSERT INTO seed_peers (hostname, ip, port, download_port, type,"
+            " idc, location, state, seed_peer_cluster_id, created_at, updated_at)"
+            " VALUES (?, ?, ?, ?, ?, ?, ?, 'inactive', ?, ?, ?)",
+            (
+                body["hostname"], body["ip"], int(body.get("port", 8002)),
+                int(body.get("download_port", 0)), body.get("type", "super"),
+                body.get("idc", ""), body.get("location", ""),
+                int(body.get("seed_peer_cluster_id", 1)), now, now,
+            ),
+        )
+        return self.db.query_one(
+            "SELECT * FROM seed_peers WHERE id = ?", (cur.lastrowid,)
+        )
+
+    @route("PATCH", "/api/v1/seed-peers/:id", write=True)
+    def update_seed_peer(self, req):
+        body = req["body"]
+        sets, params = [], []
+        for col in ("idc", "location", "state", "type"):
+            if col in body:
+                sets.append(f"{col} = ?")
+                params.append(body[col])
+        if "seed_peer_cluster_id" in body:
+            sets.append("seed_peer_cluster_id = ?")
+            params.append(int(body["seed_peer_cluster_id"]))
+        if not sets:
+            raise ApiError(400, "no updatable fields in body")
+        sets.append("updated_at = ?")
+        params += [time.time(), int(req["id"])]
+        cur = self.db.execute(
+            f"UPDATE seed_peers SET {', '.join(sets)} WHERE id = ?", tuple(params)
+        )
+        if cur.rowcount == 0:
+            raise ApiError(404, "seed peer not found")
+        return self.get_seed_peer(req)
+
+    @route("DELETE", "/api/v1/seed-peers/:id", write=True)
+    def delete_seed_peer(self, req):
+        self.db.execute("DELETE FROM seed_peers WHERE id = ?", (int(req["id"]),))
+        return {"deleted": int(req["id"])}
+
+    @route("PUT", "/api/v1/scheduler-clusters/:id/schedulers/:scheduler_id", write=True)
+    def add_scheduler_to_cluster(self, req):
+        """Re-home a scheduler into a cluster (reference
+        AddSchedulerToSchedulerCluster)."""
+        if self.db.query_one(
+            "SELECT id FROM scheduler_clusters WHERE id = ?", (int(req["id"]),)
+        ) is None:
+            raise ApiError(404, "scheduler cluster not found")
+        cur = self.db.execute(
+            "UPDATE schedulers SET scheduler_cluster_id = ?, updated_at = ?"
+            " WHERE id = ?",
+            (int(req["id"]), time.time(), int(req["scheduler_id"])),
+        )
+        if cur.rowcount == 0:
+            raise ApiError(404, "scheduler not found")
+        return {"scheduler_cluster_id": int(req["id"]),
+                "scheduler_id": int(req["scheduler_id"])}
+
+    # -- v1-compat preheat + ping (reference router.go:283-289, kept for
+    # old clients: a thin alias over the jobs queue)
+    @route("GET", "/_ping", auth=False)
+    def ping(self, req):
+        return {"status": "ok"}
+
+    @route("POST", "/preheats", write=True)
+    def create_v1_preheat(self, req):
+        body = req["body"]
+        url = (body.get("url") or "").strip()
+        if not url:
+            raise ApiError(400, "url is required")
+        job = self.create_job(
+            {**req, "body": {"type": "preheat", "args": {"url": url}}}
+        )
+        return {"id": str(job["id"]), "status": job["state"]}
+
+    @route("GET", "/preheats/:id")
+    def get_v1_preheat(self, req):
+        job = self.get_job(req)
+        return {"id": str(job["id"]), "status": job["state"]}
+
+    # -- open API (reference router.go:262-281: /oapi/v1 groups gated by
+    # personal access tokens — here PATs already authenticate every
+    # bearer route, so these are first-class aliases of the same
+    # handlers for automation clients)
+    @route("GET", "/oapi/v1/jobs")
+    def oapi_list_jobs(self, req):
+        return self.list_jobs(req)
+
+    @route("POST", "/oapi/v1/jobs", write=True)
+    def oapi_create_job(self, req):
+        return self.create_job(req)
+
+    @route("GET", "/oapi/v1/jobs/:id")
+    def oapi_get_job(self, req):
+        return self.get_job(req)
+
+    @route("PATCH", "/oapi/v1/jobs/:id", write=True)
+    def oapi_update_job(self, req):
+        return self.update_job(req)
+
+    @route("DELETE", "/oapi/v1/jobs/:id", write=True)
+    def oapi_delete_job(self, req):
+        return self.delete_job(req)
+
+    # -- composite clusters group (reference router.go:133-139: the main
+    # UI resource — one "cluster" = a scheduler cluster and its paired
+    # seed-peer cluster, created/listed together)
+    @route("GET", "/api/v1/clusters")
+    def list_clusters(self, req):
+        out = []
+        spc_by_name = {
+            r["name"]: r
+            for r in self.db.query("SELECT * FROM seed_peer_clusters")
+        }
+        for sc in self.db.query("SELECT * FROM scheduler_clusters ORDER BY id"):
+            spc = spc_by_name.get(sc["name"])
+            out.append(
+                {
+                    "id": sc["id"],
+                    "name": sc["name"],
+                    "scheduler_cluster": sc,
+                    "seed_peer_cluster": spc,
+                }
+            )
+        return out
+
+    @route("POST", "/api/v1/clusters", write=True)
+    def create_cluster(self, req):
+        """One call provisions the scheduler cluster AND its paired
+        seed-peer cluster under a shared name (reference CreateCluster)."""
+        body = req["body"]
+        if not body.get("name"):
+            raise ApiError(400, "name is required")
+        # pre-check BOTH names: the composite must not half-create (a
+        # scheduler cluster with no pair) when either side collides —
+        # sqlite has no cross-statement transaction here, so collision
+        # is answered before any write
+        for table in ("scheduler_clusters", "seed_peer_clusters"):
+            if self.db.query_one(
+                f"SELECT id FROM {table} WHERE name = ?", (body["name"],)
+            ) is not None:
+                raise ApiError(409, f"{table[:-1]} named {body['name']!r} exists")
+        sc = self.create_scheduler_cluster(
+            {**req, "body": {
+                "name": body["name"],
+                "config": body.get("scheduler_cluster_config", {}),
+                "client_config": body.get("client_config", {}),
+                "scopes": body.get("scopes", {}),
+                "is_default": body.get("is_default", False),
+            }}
+        )
+        spc = self.create_seed_peer_cluster(
+            {**req, "body": {
+                "name": body["name"],
+                "config": body.get("seed_peer_cluster_config", {}),
+            }}
+        )
+        return {"id": sc["id"], "name": sc["name"],
+                "scheduler_cluster": sc, "seed_peer_cluster": spc}
+
+    @route("GET", "/api/v1/clusters/:id")
+    def get_cluster(self, req):
+        sc = self.get_scheduler_cluster(req)
+        spc = self.db.query_one(
+            "SELECT * FROM seed_peer_clusters WHERE name = ?", (sc["name"],)
+        )
+        return {"id": sc["id"], "name": sc["name"],
+                "scheduler_cluster": sc, "seed_peer_cluster": spc}
+
+    @route("PATCH", "/api/v1/clusters/:id", write=True)
+    def update_cluster(self, req):
+        """Composite update: scheduler-cluster fields apply directly;
+        seed_peer_cluster_config applies to the paired cluster; a rename
+        renames BOTH sides (the pairing is by name, so renaming only one
+        would orphan the other)."""
+        body = dict(req["body"])
+        spc_cfg = body.pop("seed_peer_cluster_config", None)
+        # resolve the pair by the CURRENT name before any rename
+        sc_before = self.get_scheduler_cluster(req)
+        spc = self.db.query_one(
+            "SELECT id FROM seed_peer_clusters WHERE name = ?", (sc_before["name"],)
+        )
+        if body:
+            self.update_scheduler_cluster({**req, "body": body})
+        if spc is not None:
+            spc_body = {}
+            if "name" in body:
+                spc_body["name"] = body["name"]
+            if spc_cfg is not None:
+                spc_body["config"] = spc_cfg
+            if spc_body:
+                self.update_seed_peer_cluster(
+                    {**req, "id": str(spc["id"]), "body": spc_body}
+                )
+        return self.get_cluster(req)
+
+    @route("DELETE", "/api/v1/clusters/:id", write=True)
+    def delete_cluster(self, req):
+        sc = self.get_scheduler_cluster(req)
+        self.db.execute(
+            "DELETE FROM seed_peer_clusters WHERE name = ?", (sc["name"],)
+        )
+        return self.delete_scheduler_cluster(req)
+
+    @route("GET", "/oapi/v1/clusters")
+    def oapi_list_clusters(self, req):
+        return self.list_scheduler_clusters(req)
+
+    @route("POST", "/oapi/v1/clusters", write=True)
+    def oapi_create_cluster(self, req):
+        return self.create_scheduler_cluster(req)
+
+    @route("GET", "/oapi/v1/clusters/:id")
+    def oapi_get_cluster(self, req):
+        return self.get_scheduler_cluster(req)
+
+    @route("PATCH", "/oapi/v1/clusters/:id", write=True)
+    def oapi_update_cluster(self, req):
+        return self.update_scheduler_cluster(req)
+
+    @route("DELETE", "/oapi/v1/clusters/:id", write=True)
+    def oapi_delete_cluster(self, req):
+        return self.delete_scheduler_cluster(req)
+
 
 class RestServer:
     def __init__(
@@ -808,6 +1473,10 @@ class RestServer:
                     self.wfile.write(data)
                     return
                 query = dict(parse_qsl(parts.query))
+                auth_header = self.headers.get("Authorization") or ""
+                bearer = (
+                    auth_header[7:] if auth_header.startswith("Bearer ") else ""
+                )
                 role = role_for(self.headers.get("Authorization"))
                 for method, rx, fname, write, needs_auth, _pattern in _ROUTES:
                     if method != self.command:
@@ -829,7 +1498,17 @@ class RestServer:
                             body = json.loads(self.rfile.read(length))
                         except ValueError:
                             return self._send(400, {"error": "invalid JSON body"})
-                    req = dict(m.groupdict(), body=body, query=query)
+                    # the bearer token rides along for the session
+                    # routes (signout revokes it, refresh_token rotates
+                    # it); the caller's role under a NON-COLLIDING key —
+                    # path params (e.g. :role) must always win
+                    req = {
+                        "body": body,
+                        "query": query,
+                        "token": bearer,
+                        "auth_role": role,
+                        **m.groupdict(),
+                    }
                     try:
                         return self._send(200, getattr(api, fname)(req))
                     except Redirect as r:

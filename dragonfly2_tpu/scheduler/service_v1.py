@@ -461,6 +461,10 @@ class SchedulerServiceV1:
     def LeaveTask(self, request: v1.PeerTarget, context):
         M.LEAVE_PEER_TOTAL.inc()
         peer = self.resource.peer_manager.load(request.peer_id)
+        if peer is None:
+            # tolerated (idempotent leave) but counted, matching v2
+            # LeavePeer — docs/metrics.md documents one series for both
+            M.LEAVE_PEER_FAILURE_TOTAL.inc()
         if peer is not None:
             if peer.fsm.can(res.PEER_EVENT_LEAVE):
                 peer.fsm.event(res.PEER_EVENT_LEAVE)
