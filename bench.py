@@ -314,6 +314,7 @@ def main() -> None:
         # alongside so the variance is visible, not hidden.
         best = None  # (rate, dt, stats)
         run_rates = []
+        run_details = []
         run_error = ""
         # stamped into every success line (holder included) so the
         # watchdog path carries the same schema; _emit adds the
@@ -363,9 +364,20 @@ def main() -> None:
                 dt = time.perf_counter() - t0
                 rate = stats.download_records / dt / n_devices
                 run_rates.append(round(rate, 1))
+                run_details.append(
+                    {
+                        "rate": round(rate, 1),
+                        "wall_s": round(dt, 2),
+                        # the packing thread's wall split: which stage
+                        # bounded THIS run (decoders vs the device leg)
+                        "decode_wait_s": round(stats.decode_wait_s, 2),
+                        "buffer_wait_s": round(stats.buffer_wait_s, 2),
+                    }
+                )
                 _phase(
                     f"timed run {r + 1}/{repeats}: {dt:.1f}s steps={stats.steps}"
                     f" records={stats.download_records} rate={rate / 1e3:.1f}k/s"
+                    f" dwait={stats.decode_wait_s:.1f}s bwait={stats.buffer_wait_s:.1f}s"
                     + (" TRUNCATED" if stats.truncated else "")
                 )
                 if best is None or rate > best[0]:
@@ -413,6 +425,7 @@ def main() -> None:
         # every completed run's rate, even if a later repeat failed —
         # the docstring's "every run's rate in run_rates" promise
         extra["run_rates"] = run_rates
+        extra["run_details"] = run_details
     extra.update(platform_extra)
     finished.set()  # before the emit: the watchdog must never add a second line
     _emit(
