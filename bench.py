@@ -48,6 +48,15 @@ def synthesize_dataset(d: str, shards: int, shard_bytes: int) -> list:
     return synthesize_dataset_csv(d, shards, shard_bytes)
 
 
+def synthesize_dataset_binary(d: str, shards: int, shard_bytes: int) -> list:
+    """Binary columnar shards (schema/wire.py) of the SAME synthetic
+    records — the production train-stream payload since the columnar-v1
+    negotiation; the timed e2e runs ride this format."""
+    from dragonfly2_tpu.schema.synth import synthesize_dataset_binary as _synth
+
+    return _synth(d, shards, shard_bytes)
+
+
 def _emit(value: float = 0.0, vs_baseline: float = 0.0, error: str = "", **extra) -> None:
     """The ONE JSON line the driver records — every exit path shares this
     shape (metric renames must never diverge between error and success)."""
@@ -195,26 +204,20 @@ def main() -> None:
     from dragonfly2_tpu.schema import native
     from dragonfly2_tpu.trainer.ingest import stream_train_mlp
 
-    if not native.available():
-        _emit(error="native ingestion library unavailable")
-        sys.exit(0)
-
     n_devices = jax.device_count()
     ncpu = os.cpu_count() or 1
-    # measured on the 1-core runner: a second producer thread LOSES ~33%
-    # to contention (907→610 MB/s pure decode) — with steps_per_call
-    # amortizing dispatch gaps there is nothing left for it to fill.
-    # Multi-core hosts scale decode with real parallelism.
-    workers = min(4, ncpu)
+    # producer pool sized off host cores (ingest.default_workers): binary
+    # block decode is numpy/zlib work that releases the GIL on the big
+    # ops, so real cores scale it; a 1-core host keeps a single producer
+    # (the packing thread needs the core — measured in round 4).
+    from dragonfly2_tpu.trainer.ingest import default_workers, stream_shards
+
+    workers = default_workers(ncpu)
     batch = 65_536
-    # 24 passes ≈ 12-14s per timed run at current pipeline rates: the
-    # north star is a SUSTAINED rate, and the pipeline's fixed ramp
-    # (fill the decode queue + first superbatch before the first
-    # transfer) and tail (last transfer+step after decode ends) are
-    # ~1s/run — at the old 8 passes (~6s runs) they shaved ~15% off the
-    # steady-state rate; 24 amortizes them 3x. Longer runs also drop a
-    # smaller trailing-pair fraction (2-3% vs 7%), so the trained
-    # fraction comparison vs earlier rounds is conservative.
+    # 24 passes over the shard set ≈ 15-25s per timed run at target
+    # rates: the north star is a SUSTAINED rate, and the pipeline's
+    # fixed ramp and tail are ~1s/run — longer runs amortize them and
+    # drop a smaller trailing-pair fraction.
     passes = 24
     # 8 optimizer steps per device dispatch (lax.scan superbatch):
     # amortizes per-call link latency — on a tunneled/remote chip the
@@ -230,9 +233,20 @@ def main() -> None:
         mesh = make_mesh(dp=n_devices)
 
     with tempfile.TemporaryDirectory(prefix="dfbench-") as d:
-        _phase(f"devices={n_devices} workers={workers}; synthesizing dataset")
-        paths = synthesize_dataset(
-            d, shards=max(workers * 2, 4), shard_bytes=128 * 1024 * 1024
+        _phase(f"devices={n_devices} workers={workers}; synthesizing datasets")
+        # BOTH payload formats, same records (synth seed 0): the binary
+        # columnar shards are the production path the timed e2e runs
+        # ride; one CSV shard sticks around so the fallback decoder's
+        # rate stays a measured fact next to the binary one. Binary
+        # shards are sized so a pass covers a similar record count to
+        # the old 128 MiB CSV shards (~600 B/rec vs ~4 KB/rec).
+        bpaths = synthesize_dataset_binary(
+            d, shards=max(workers * 2, 4), shard_bytes=24 * 1024 * 1024
+        )
+        csv_paths = (
+            synthesize_dataset(d, shards=1, shard_bytes=128 * 1024 * 1024)
+            if native.available()
+            else []
         )
 
         # steady-state setup: the north star is a sustained rate, so flush
@@ -242,41 +256,58 @@ def main() -> None:
         # (cached in ingest._step_cache — the timed run reuses the
         # executable)
         os.sync()
-        for p in paths:
+        for p in bpaths + csv_paths:
             with open(p, "rb") as f:
                 while f.read(1 << 24):
                     pass
-        # Host-side bottleneck split, recorded IN the artifact (round-4
-        # verdict: the "decode scales with cores" argument was a memory-
-        # bank claim — make the decode/stream rates measured facts):
-        #   decode_only_rate — native decoder alone, one thread, f16 emit
-        #   stream_only_rate — decode + producer thread(s) + bounded queue
-        #     (the exact feed the train loop consumes), no device work
-        # Both ride the same page-cache-warm shard the timed runs use.
-        from dragonfly2_tpu.trainer.ingest import stream_shards
+        # Host-side bottleneck split, recorded IN the artifact, now PER
+        # PAYLOAD FORMAT:
+        #   decode_only_rate_binary — columnar block decode alone, one
+        #     thread, CRC verified, f16 emit (the production path)
+        #   decode_only_rate_csv — fused native CSV decoder alone, one
+        #     thread, f16 emit (the fallback; absent when the native
+        #     library is unavailable)
+        #   stream_only_rate — binary decode + producer pool + bounded
+        #     queue (the exact feed the train loop consumes), no device
+        #     work
+        # All ride the same page-cache-warm shards the timed runs use.
+        from dragonfly2_tpu.schema import wire
 
         t0 = time.perf_counter()
         nrec = 0
-        for _, _, nrec in native.stream_pairs_file(paths[0], passes=2, half=True):
+        for _, _, nrec in wire.stream_train_pairs(bpaths[0], passes=8, half=True):
             pass
-        decode_only_rate = nrec / (time.perf_counter() - t0)
+        decode_only_rate_binary = nrec / (time.perf_counter() - t0)
+        host_rates = {
+            "payload_format": wire.FORMAT_NAME,
+            "decode_only_rate_binary": round(decode_only_rate_binary, 1),
+        }
+        if csv_paths:
+            t0 = time.perf_counter()
+            nrec = 0
+            for _, _, nrec in native.stream_pairs_file(
+                csv_paths[0], passes=2, half=True
+            ):
+                pass
+            host_rates["decode_only_rate_csv"] = round(
+                nrec / (time.perf_counter() - t0), 1
+            )
+        else:
+            _phase("native library unavailable; csv decode rate not measured")
         t0 = time.perf_counter()
         nrec = 0
-        for _, _, nrec in stream_shards(paths[0], passes=2, workers=workers, half=True):
+        for _, _, nrec in stream_shards(bpaths[0], passes=8, workers=workers, half=True):
             pass
-        stream_only_rate = nrec / (time.perf_counter() - t0)
-        host_rates = {
-            "decode_only_rate": round(decode_only_rate, 1),
-            "stream_only_rate": round(stream_only_rate, 1),
-        }
+        host_rates["stream_only_rate"] = round(nrec / (time.perf_counter() - t0), 1)
         _phase(
-            f"host split: decode {decode_only_rate / 1e3:.1f}k/s,"
-            f" stream {stream_only_rate / 1e3:.1f}k/s"
+            f"host split: decode(binary) {decode_only_rate_binary / 1e3:.1f}k/s,"
+            f" decode(csv) {host_rates.get('decode_only_rate_csv', 0) / 1e3:.1f}k/s,"
+            f" stream {host_rates['stream_only_rate'] / 1e3:.1f}k/s"
         )
         _phase(f"page cache warm after {time.perf_counter() - run_t0:.1f}s; compiling warmup fit")
         try:
             stream_train_mlp(
-                paths[0],
+                bpaths[0],
                 # enough pairs for at least one full k·B superbatch (≈4 pairs
                 # per record) so the scan executable compiles here, capped so
                 # warmup never trains the whole shard repeatedly
@@ -329,7 +360,7 @@ def main() -> None:
                 t0 = time.perf_counter()
                 try:
                     _, stats = stream_train_mlp(
-                        paths,
+                        bpaths,
                         passes=passes,
                         batch_size=batch,
                         workers=workers,
@@ -372,6 +403,12 @@ def main() -> None:
                         # bounded THIS run (decoders vs the device leg)
                         "decode_wait_s": round(stats.decode_wait_s, 2),
                         "buffer_wait_s": round(stats.buffer_wait_s, 2),
+                        # producer-side split (summed over the pool):
+                        # read / cast / enqueue — names the next
+                        # bottleneck when decode_wait_s is nonzero
+                        "read_s": round(stats.read_s, 2),
+                        "cast_s": round(stats.cast_s, 2),
+                        "enqueue_s": round(stats.enqueue_s, 2),
                     }
                 )
                 _phase(

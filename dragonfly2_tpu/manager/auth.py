@@ -108,13 +108,15 @@ def revoke_pat(db, pat_id: int) -> None:
     )
 
 
-def resolve_token(db, token: str) -> str | None:
-    """Bearer token → role, or None. Valid = active token, not expired,
-    owned by an enabled user."""
+def _resolve_token_row(db, token: str) -> dict | None:
+    """ONE definition of token validity (active token, not expired,
+    enabled owner) shared by authentication (role) and authorization
+    (owner id) — two copies of this rule set in a security path would
+    inevitably drift."""
     if not token.startswith(TOKEN_PREFIX):
         return None
     row = db.query_one(
-        "SELECT t.expires_at, u.role, u.state AS user_state FROM"
+        "SELECT t.user_id, t.expires_at, u.role, u.state AS user_state FROM"
         " personal_access_tokens t JOIN users u ON u.id = t.user_id"
         " WHERE t.token_hash = ? AND t.state = 'active'",
         (_hash_token(token),),
@@ -123,7 +125,14 @@ def resolve_token(db, token: str) -> str | None:
         return None
     if row["expires_at"] and row["expires_at"] < time.time():
         return None
-    return row["role"]
+    return row
+
+
+def resolve_token(db, token: str) -> str | None:
+    """Bearer token → role, or None. Valid = active token, not expired,
+    owned by an enabled user."""
+    row = _resolve_token_row(db, token)
+    return None if row is None else row["role"]
 
 
 # ---------------------------------------------------------------------------

@@ -444,8 +444,20 @@ class RestApi:
         token, _ = auth.create_pat(self.db, user["id"], "session", ttl=ttl)
         return {"token": token, "role": user["role"]}
 
+    def _require_admin_or_self(self, req, user_id: int) -> None:
+        """Token metadata is a credential inventory: only an admin or
+        the user who owns it may read it (reference casbin policy scopes
+        the nested PAT group to the token's subject). The caller's id
+        was resolved once with the role (dispatcher _auth_info)."""
+        if req["auth_role"] == "admin":
+            return
+        if req.get("auth_user_id") is not None and req["auth_user_id"] == user_id:
+            return
+        raise ApiError(403, "forbidden (admin or resource owner only)")
+
     @route("GET", "/api/v1/users/:id/personal-access-tokens")
     def list_pats(self, req):
+        self._require_admin_or_self(req, int(req["id"]))
         return self.db.query(
             "SELECT id, user_id, name, state, expires_at, created_at"
             " FROM personal_access_tokens WHERE user_id = ? ORDER BY id",
@@ -631,6 +643,10 @@ class RestApi:
     # the per-user nested group above is the console's path)
     @route("GET", "/api/v1/personal-access-tokens")
     def list_all_pats(self, req):
+        """Admin-only: the cross-user token inventory would otherwise
+        let any guest enumerate every user's credential metadata."""
+        if req["auth_role"] != "admin":
+            raise ApiError(403, "forbidden (requires the admin role)")
         return self.db.query(
             "SELECT id, user_id, name, state, expires_at, created_at"
             " FROM personal_access_tokens ORDER BY id"
@@ -643,6 +659,12 @@ class RestApi:
             " FROM personal_access_tokens WHERE id = ?",
             (int(req["id"]),),
         )
+        # existence is leaked only to admins too: 403 before 404 for
+        # guests, so token ids can't be probed
+        if req["auth_role"] != "admin":
+            uid = req.get("auth_user_id")
+            if row is None or uid is None or int(row["user_id"]) != uid:
+                raise ApiError(403, "forbidden (admin or resource owner only)")
         if row is None:
             raise ApiError(404, "personal access token not found")
         return row
@@ -1413,11 +1435,14 @@ class RestServer:
         self._thread: threading.Thread | None = None
 
     # ------------------------------------------------------------------
-    def _role_for(self, auth_header: str | None) -> str | None:
-        """→ role, or None when unauthenticated. Config tokens are
-        checked first, then DB-backed personal access tokens (auth.py).
-        No config tokens AND no users = open admin access (dev mode,
-        like the reference without auth)."""
+    def _auth_info(self, auth_header: str | None) -> tuple[str | None, int | None]:
+        """→ (role, owning user id), or (None, None) when
+        unauthenticated. Config tokens are checked first (they have no
+        DB user, so no owner id), then DB-backed personal access tokens
+        — resolved ONCE here; handlers needing the owner (per-user PAT
+        routes) read it from the request instead of re-querying. No
+        config tokens AND no users = open admin access (dev mode, like
+        the reference without auth)."""
         from dragonfly2_tpu.manager import auth
 
         token = ""
@@ -1426,13 +1451,13 @@ class RestServer:
         if token:
             role = self.tokens.get(token)
             if role is not None:
-                return role
-            role = auth.resolve_token(self.api.db, token)
-            if role is not None:
-                return role
+                return role, None
+            row = auth._resolve_token_row(self.api.db, token)
+            if row is not None:
+                return row["role"], int(row["user_id"])
         if not self.tokens and not self._has_admin():
-            return "admin"
-        return None
+            return "admin", None
+        return None, None
 
     def _has_admin(self) -> bool:
         """Anonymous dev-mode admin ends when an ADMIN credential exists
@@ -1448,7 +1473,7 @@ class RestServer:
 
     def start(self) -> str:
         api = self.api
-        role_for = self._role_for
+        auth_info = self._auth_info
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # route to dflog, not stderr
@@ -1477,7 +1502,7 @@ class RestServer:
                 bearer = (
                     auth_header[7:] if auth_header.startswith("Bearer ") else ""
                 )
-                role = role_for(self.headers.get("Authorization"))
+                role, auth_user_id = auth_info(self.headers.get("Authorization"))
                 for method, rx, fname, write, needs_auth, _pattern in _ROUTES:
                     if method != self.command:
                         continue
@@ -1507,6 +1532,7 @@ class RestServer:
                         "query": query,
                         "token": bearer,
                         "auth_role": role,
+                        "auth_user_id": auth_user_id,
                         **m.groupdict(),
                     }
                     try:

@@ -50,10 +50,14 @@ class ManagerServerConfig:
     # and the ADVERTISED host are distinct (same pattern as the gRPC
     # listen/advertise split): 0.0.0.0 binds everywhere but is not a
     # dialable address, so kv_advertise_ip is what lands in kv_addr /
-    # the runner's KV line.
+    # the runner's KV line. Loopback bind by default — exposing the KV
+    # on the network is an explicit opt-in, and should come with
+    # kv_secret so every connection must AUTH (requirepass semantics;
+    # schedulers pass the same value as their kv_secret).
     kv_port: int = -1
-    kv_host: str = "0.0.0.0"
+    kv_host: str = "127.0.0.1"
     kv_advertise_ip: str = "127.0.0.1"
+    kv_secret: str = ""
     # object storage for model weights: fs (default, under data_dir) or
     # s3 (any S3-compatible endpoint; reference pkg/objectstorage)
     object_storage_driver: str = "fs"
@@ -151,7 +155,9 @@ class ManagerServer:
         if self.cfg.kv_port >= 0:
             from dragonfly2_tpu.utils.kvserver import KVServer
 
-            self._kv = KVServer(host=self.cfg.kv_host, port=self.cfg.kv_port)
+            self._kv = KVServer(
+                host=self.cfg.kv_host, port=self.cfg.kv_port, secret=self.cfg.kv_secret
+            )
             kv_port = self._kv.serve()
             advertise = (
                 self.cfg.kv_advertise_ip

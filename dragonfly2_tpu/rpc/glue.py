@@ -94,6 +94,11 @@ SERVICES: dict[str, dict[str, Method]] = {
     },
     TRAINER_SERVICE: {
         "Train": Method(STREAM_UNARY, trainer_pb2.TrainRequest, trainer_pb2.TrainResponse),
+        "Capabilities": Method(
+            UNARY,
+            trainer_pb2.CapabilitiesRequest,
+            trainer_pb2.CapabilitiesResponse,
+        ),
     },
     MANAGER_SERVICE: {
         "GetScheduler": Method(UNARY, manager_pb2.GetSchedulerRequest, manager_pb2.Scheduler),

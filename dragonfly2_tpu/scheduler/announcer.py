@@ -3,9 +3,16 @@ to the trainer (reference scheduler/announcer/announcer.go:44-235).
 
 Every train interval (default 7 days, reference
 scheduler/config/constants.go:196-197) the announcer opens a `Train`
-client-stream and ships both CSV datasets in chunks (default 128 MiB,
-reference announcer.go:39-41): downloads as TrainMlpRequest, topology as
-TrainGnnRequest.
+client-stream and ships both datasets in chunks (default 128 MiB,
+reference announcer.go:39-41).
+
+Payload format is negotiated once per trainer connection via the
+Capabilities RPC: a trainer advertising ``columnar-v1`` gets the binary
+columnar block files (schema/wire.py — the zero-parse ingest path);
+anything else — including an old trainer that answers Capabilities with
+UNIMPLEMENTED — gets the CSV files, byte-compatible with the reference.
+Both forms carry the same records (the scheduler's dual sink), so ONE
+format ships per round and the whole snapshot is discarded on success.
 """
 
 from __future__ import annotations
@@ -20,6 +27,7 @@ from dragonfly2_tpu.rpc import gen  # noqa: F401
 import trainer_pb2  # noqa: E402
 
 from dragonfly2_tpu.rpc.glue import TRAINER_SERVICE, ServiceClient
+from dragonfly2_tpu.schema import wire
 from dragonfly2_tpu.scheduler.storage import Storage
 from dragonfly2_tpu.scheduler import metrics as M
 from dragonfly2_tpu.utils import dflog
@@ -56,8 +64,39 @@ class Announcer:
             if trainer_channel is not None
             else None
         )
+        # negotiated train payload format; None until the first probe.
+        # Re-probed at the start of every upload round (one cheap unary
+        # per train interval): a trainer upgraded to binary mid-flight
+        # starts receiving binary at the NEXT round, and a rolled-back
+        # one degrades to CSV instead of receiving blocks it can't read.
+        self._train_format: str | None = None
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+
+    # -- format negotiation ------------------------------------------------
+    def negotiated_format(self) -> str:
+        """The train payload format for this trainer connection
+        (cached). ``columnar-v1`` when the trainer advertises it via
+        Capabilities; ``csv`` otherwise — old trainers answer
+        UNIMPLEMENTED, which is the designed fallback signal, and ANY
+        RPC failure degrades to the format every trainer accepts."""
+        if self._train_format is not None:
+            return self._train_format
+        fmt = wire.CSV_FORMAT_NAME
+        try:
+            resp = self._trainer.Capabilities(
+                trainer_pb2.CapabilitiesRequest(), timeout=30
+            )
+            if wire.FORMAT_NAME in list(resp.train_formats):
+                fmt = wire.FORMAT_NAME
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            logger.info(
+                "capabilities probe failed (%s); falling back to csv payload", code
+            )
+        self._train_format = fmt
+        logger.info("train payload format negotiated: %s", fmt)
+        return fmt
 
     # -- trainer upload ----------------------------------------------------
     def train_once(self) -> bool:
@@ -68,38 +107,79 @@ class Announcer:
         # snapshot moves the files aside: records that arrive during the
         # (potentially long) Train stream keep accumulating in fresh
         # files and are uploaded next round instead of being destroyed
-        download_files, topology_files = self.storage.snapshot_for_upload()
-        if not download_files and not topology_files:
+        snap = self.storage.snapshot_for_upload()
+        if not snap:
             logger.info("no datasets to upload")
             return False
 
+        # fresh probe each round — the peer's capabilities are allowed
+        # to change between (week-long) train intervals
+        self._train_format = None
+        binary = self.negotiated_format() == wire.FORMAT_NAME
+
+        def arm(field: str, msg_cls):
+            """One TrainRequest constructor per oneof arm — a single
+            envelope definition, not four copies."""
+            return lambda chunk: trainer_pb2.TrainRequest(
+                ip=self.ip,
+                hostname=self.hostname,
+                cluster_id=self.cluster_id,
+                **{field: msg_cls(dataset=chunk)},
+            )
+
+        # per-dataset format decision: binary only when negotiated AND
+        # block files exist (a scheduler running with write_blocks=False
+        # still uploads CSV on a binary-capable trainer) AND the CSV
+        # files aren't a superset of the blocks (a blocks-off era from a
+        # previous process — the blocks would ship an incomplete history
+        # while the discard below destroyed the rest)
+        def plan(
+            csv_files: list[Path],
+            block_files: list[Path],
+            csv_superset: bool,
+            csv_arm,
+            bin_arm,
+        ):
+            if binary and block_files and not csv_superset:
+                return block_files, bin_arm
+            return csv_files, csv_arm
+
+        mlp_files, mlp_arm = plan(
+            snap.download_csv,
+            snap.download_blocks,
+            snap.csv_superset_download,
+            arm("train_mlp", trainer_pb2.TrainMlpRequest),
+            arm("train_mlp_binary", trainer_pb2.TrainMlpBinaryRequest),
+        )
+        gnn_files, gnn_arm = plan(
+            snap.topology_csv,
+            snap.topology_blocks,
+            snap.csv_superset_topology,
+            arm("train_gnn", trainer_pb2.TrainGnnRequest),
+            arm("train_gnn_binary", trainer_pb2.TrainGnnBinaryRequest),
+        )
+
         def requests():
-            for path in download_files:
+            for path in mlp_files:
                 for chunk in self._chunks(path):
-                    yield trainer_pb2.TrainRequest(
-                        ip=self.ip,
-                        hostname=self.hostname,
-                        cluster_id=self.cluster_id,
-                        train_mlp=trainer_pb2.TrainMlpRequest(dataset=chunk),
-                    )
-            for path in topology_files:
+                    yield mlp_arm(chunk)
+            for path in gnn_files:
                 for chunk in self._chunks(path):
-                    yield trainer_pb2.TrainRequest(
-                        ip=self.ip,
-                        hostname=self.hostname,
-                        cluster_id=self.cluster_id,
-                        train_gnn=trainer_pb2.TrainGnnRequest(dataset=chunk),
-                    )
+                    yield gnn_arm(chunk)
 
         try:
             self._trainer.Train(requests(), timeout=3600)
         except Exception:
+            # no negotiation reset needed: every round re-probes anyway,
+            # so a retry after a rolled-back trainer degrades to CSV
             M.TRAIN_UPLOAD_TOTAL.labels("failure").inc()
             raise
         M.TRAIN_UPLOAD_TOTAL.labels("success").inc()
-        # uploaded datasets are consumed; on failure the snapshot files
-        # stay in the pending dir and ride along with the next round
-        self.storage.discard_uploaded(download_files + topology_files)
+        # uploaded datasets are consumed — including the snapshot files of
+        # the format that did NOT ship (same records, other encoding); on
+        # failure everything stays in the pending dir and rides along
+        # with the next round
+        self.storage.discard_uploaded(snap.all_files())
         return True
 
     def _chunks(self, path: Path):

@@ -61,6 +61,9 @@ class SchedulerServerConfig:
     # an actual Redis; empty = process-local store (single-scheduler).
     # Matches reference network_topology.go:88-89 taking a redis client.
     kv_address: str = ""
+    # AUTH secret for the shared KV (KVServer requirepass / Redis AUTH);
+    # empty = unauthenticated (loopback/dev deployments)
+    kv_secret: str = ""
     # Prometheus /metrics endpoint (reference :8000): -1 = disabled
     metrics_port: int = -1
     # df_plugin_*.py modules loaded at startup (reference internal/dfplugin)
@@ -106,7 +109,7 @@ class SchedulerServer:
         # SchedulerServers in one test process must not silently share
         # topology state through a global).
         self.kvstore = (
-            kvstore.RemoteKVStore(config.kv_address)
+            kvstore.RemoteKVStore(config.kv_address, secret=config.kv_secret)
             if config.kv_address
             else KVStore()
         )

@@ -18,17 +18,47 @@ import threading
 import time
 from pathlib import Path
 
-from dragonfly2_tpu.schema import records as R
-from dragonfly2_tpu.schema.columnar import (
-    BlockWriter,
-    RotatingCSVWriter,
-    records_to_columns,
-)
+from dataclasses import dataclass, field
+
+from dragonfly2_tpu.schema import records as R, wire
+from dragonfly2_tpu.schema.columnar import RotatingBlockWriter, RotatingCSVWriter
 from dragonfly2_tpu.scheduler.resource import Peer
 from dragonfly2_tpu.scheduler.resource.host import Host
 from dragonfly2_tpu.scheduler.resource.task import Task
 
 NS_PER_S = 1_000_000_000
+
+BLOCK_RECORDS = wire.BLOCK_RECORDS  # block batch floor for the binary sink
+
+
+@dataclass
+class UploadSnapshot:
+    """Files moved aside for one Train-stream upload round, per dataset
+    and payload format. The announcer ships ONE format per dataset
+    (binary when negotiated and present, CSV otherwise) and discards the
+    whole snapshot on success — the two forms carry the same records."""
+
+    download_csv: list[Path] = field(default_factory=list)
+    topology_csv: list[Path] = field(default_factory=list)
+    download_blocks: list[Path] = field(default_factory=list)
+    topology_blocks: list[Path] = field(default_factory=list)
+    # the CSV files hold records the block files DON'T (a blocks-off era
+    # predating this process, see Storage.__init__): the announcer must
+    # ship CSV this round even on a binary-capable trainer, or that era
+    # would be discarded unshipped after a binary upload
+    csv_superset_download: bool = False
+    csv_superset_topology: bool = False
+
+    def all_files(self) -> list[Path]:
+        return (
+            self.download_csv
+            + self.topology_csv
+            + self.download_blocks
+            + self.topology_blocks
+        )
+
+    def __bool__(self) -> bool:
+        return bool(self.all_files())
 
 
 class Storage:
@@ -53,26 +83,70 @@ class Storage:
             max_backups,
             buffer_size,
         )
+        # binary columnar sink: one `train` block (pair features + GRU
+        # sequences, extracted in batch HERE) per flushed record buffer —
+        # the wire payload the trainer ingests with zero parsing. The
+        # block batch is floored at BLOCK_RECORDS (above the CSV buffer):
+        # it amortizes both the extraction here and the per-block decode
+        # overhead trainer-side, and is the block size the bench
+        # synthesizes so its decode rate reflects production blocks.
         self._blocks_download = (
-            BlockWriter(self.dir / "blocks", "download") if write_blocks else None
+            RotatingBlockWriter(
+                self.dir / "blocks",
+                "download",
+                wire.encode_train_block,
+                max_size,
+                max_backups,
+                max(buffer_size, BLOCK_RECORDS),
+            )
+            if write_blocks
+            else None
         )
         self._blocks_topology = (
-            BlockWriter(self.dir / "blocks", "networktopology") if write_blocks else None
+            RotatingBlockWriter(
+                self.dir / "blocks",
+                "networktopology",
+                wire.encode_topology_block,
+                max_size,
+                max_backups,
+                max(buffer_size, BLOCK_RECORDS),
+            )
+            if write_blocks
+            else None
         )
         self._lock = threading.Lock()
+        # blocks-off-era detection: the CSV sink ALWAYS runs while the
+        # block sink is optional, so CSV ⊇ blocks — records written by a
+        # previous process with write_blocks=False exist ONLY as CSV. If
+        # startup finds CSV data with no blocks beside it, the next
+        # upload round must ship CSV even when the trainer negotiates
+        # binary, or the era would be discarded unshipped. (A partial
+        # blockless era INSIDE a mixed history is undetectable and
+        # bounded by CSV rotation; config toggles are restarts, so the
+        # common case is exactly this startup shape.)
+        self._csv_superset_download = bool(
+            self._blocks_download is not None
+            and self._download.all_files()
+            and not self._blocks_download.all_files()
+        )
+        self._csv_superset_topology = bool(
+            self._blocks_topology is not None
+            and self._topology.all_files()
+            and not self._blocks_topology.all_files()
+        )
 
     # -- writes ----------------------------------------------------------
     def create_download(self, rec: R.DownloadRecord) -> None:
         with self._lock:
             self._download.create(rec)
             if self._blocks_download is not None:
-                self._blocks_download.append_columns(records_to_columns([rec]))
+                self._blocks_download.create(rec)
 
     def create_network_topology(self, rec: R.NetworkTopologyRecord) -> None:
         with self._lock:
             self._topology.create(rec)
             if self._blocks_topology is not None:
-                self._blocks_topology.append_columns(records_to_columns([rec]))
+                self._blocks_topology.create(rec)
 
     def flush(self) -> None:
         with self._lock:
@@ -102,29 +176,53 @@ class Storage:
             self._topology.flush()
             return self._topology.all_files()
 
-    def snapshot_for_upload(self) -> tuple[list[Path], list[Path]]:
-        """Atomically move the current download/topology files into a
-        pending-upload dir and return them (any leftovers from a prior
-        failed upload are included for retry). Records written during the
-        subsequent slow Train stream go to fresh files and survive —
-        unlike a clear()-after-upload, which would destroy them."""
+    def snapshot_for_upload(self) -> UploadSnapshot:
+        """Atomically move the current download/topology files — BOTH
+        payload forms — into a pending-upload dir and return them (any
+        leftovers from a prior failed upload are included for retry).
+        Records written during the subsequent slow Train stream go to
+        fresh files and survive — unlike a clear()-after-upload, which
+        would destroy them."""
         with self._lock:
             pending = self.dir / "upload-pending"
-            d = self._download.snapshot(pending / "download")
-            t = self._topology.snapshot(pending / "networktopology")
-            return d, t
+            snap = UploadSnapshot(
+                download_csv=self._download.snapshot(pending / "download"),
+                topology_csv=self._topology.snapshot(pending / "networktopology"),
+                csv_superset_download=self._csv_superset_download,
+                csv_superset_topology=self._csv_superset_topology,
+            )
+            if self._blocks_download is not None:
+                snap.download_blocks = self._blocks_download.snapshot(
+                    pending / "download-blocks"
+                )
+            if self._blocks_topology is not None:
+                snap.topology_blocks = self._blocks_topology.snapshot(
+                    pending / "networktopology-blocks"
+                )
+            return snap
 
     def discard_uploaded(self, files: list[Path]) -> None:
+        """Drop a successfully uploaded snapshot. Only now does the
+        blocks-off-era flag clear: a FAILED upload leaves the mixed-era
+        CSV files in the pending dir for the next round's snapshot,
+        which must keep preferring CSV until they actually ship."""
         for p in files:
             p.unlink(missing_ok=True)
+        with self._lock:
+            self._csv_superset_download = False
+            self._csv_superset_topology = False
 
     def clear_download(self) -> None:
         with self._lock:
             self._download.clear()
+            if self._blocks_download is not None:
+                self._blocks_download.clear()
 
     def clear_network_topology(self) -> None:
         with self._lock:
             self._topology.clear()
+            if self._blocks_topology is not None:
+                self._blocks_topology.clear()
 
 
 # ---------------------------------------------------------------------------

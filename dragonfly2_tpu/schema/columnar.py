@@ -1,15 +1,24 @@
 """Columnar codec + rotating writers for training records.
 
-Two on-disk forms:
+Two on-disk forms, behind one rotation/snapshot mechanic:
 
-- **CSV** — interoperability/debugging form, same information content as the
-  reference's gocsv files (reference scheduler/storage/storage.go:412-545),
-  with size-based rotation and bounded backups
-  (reference storage.go:92-139 rotation semantics).
-- **npz blocks** — the trainer's high-throughput form: every column is one
-  contiguous numpy array per block file, so ingestion is load + reshape with
-  no per-record Python work. Nested repeated groups land as extra
-  dimensions (parents → [N, 20], pieces → [N, 20, 10]).
+- **CSV** (`RotatingCSVWriter`) — interoperability/debugging form, same
+  information content as the reference's gocsv files (reference
+  scheduler/storage/storage.go:412-545), with size-based rotation and
+  bounded backups (reference storage.go:92-139 rotation semantics).
+  Also the negotiated train-stream fallback for old trainers.
+- **binary columnar blocks** (`RotatingBlockWriter`, format in
+  schema/wire.py) — the train-stream payload: each flush encodes the
+  buffered record batch into one self-delimiting block with the
+  training tensors precomputed, so trainer ingestion is frombuffer +
+  cast with no per-record work.
+
+The ``records_to_columns`` transpose (one numpy array per dotted
+column; fixed-width repeated groups land as extra dimensions, parents →
+[N, 20], pieces → [N, 20, 10]) is the shared columnar layout both the
+feature extractors and the wire format consume; ``save_block``/
+``load_block`` keep an npz round-trip of that layout for
+debugging/interop.
 """
 
 from __future__ import annotations
@@ -58,20 +67,22 @@ def read_csv(path: str | os.PathLike, cls: type) -> list[Any]:
     return out
 
 
-class RotatingCSVWriter:
-    """Size-rotated CSV sink with bounded backups.
+class _RotatingSink:
+    """Shared rotation/snapshot mechanics for the record sinks.
 
-    Reference semantics (scheduler/storage/storage.go): the active file is
-    ``<base>.csv``; on exceeding ``max_size`` bytes it rotates to
-    ``<base>-<n>.csv`` and at most ``max_backups`` rotated files are kept
-    (oldest dropped). ``buffer_size`` rows are batched per flush.
+    Reference semantics (scheduler/storage/storage.go): the active file
+    is ``<base>.<suffix>``; on exceeding ``max_size`` bytes it rotates to
+    ``<base>-<n>.<suffix>`` and at most ``max_backups`` rotated files are
+    kept (oldest dropped). ``buffer_size`` records are batched per flush;
+    subclasses define how a batch lands on disk (``_write_batch``).
     """
+
+    suffix = "dat"
 
     def __init__(
         self,
         directory: str | os.PathLike,
         base: str,
-        record_cls: type,
         max_size: int = 100 * 1024 * 1024,
         max_backups: int = 10,
         buffer_size: int = 64,
@@ -79,7 +90,6 @@ class RotatingCSVWriter:
         self.dir = Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.base = base
-        self.record_cls = record_cls
         self.max_size = max_size
         self.max_backups = max_backups
         self.buffer_size = max(1, buffer_size)
@@ -87,7 +97,7 @@ class RotatingCSVWriter:
 
     @property
     def active_path(self) -> Path:
-        return self.dir / f"{self.base}.csv"
+        return self.dir / f"{self.base}.{self.suffix}"
 
     def create(self, *recs: Any) -> None:
         """Queue records; flush when the buffer fills."""
@@ -100,20 +110,23 @@ class RotatingCSVWriter:
             return
         if self.active_path.exists() and self.active_path.stat().st_size >= self.max_size:
             self._rotate()
-        write_csv(self.active_path, self._buf, append=True)
+        self._write_batch(self._buf)
         self._buf.clear()
+
+    def _write_batch(self, recs: list[Any]) -> None:
+        raise NotImplementedError
 
     def _rotate(self) -> None:
         nums = sorted(self._backup_numbers())
         nxt = (nums[-1] + 1) if nums else 1
-        self.active_path.rename(self.dir / f"{self.base}-{nxt}.csv")
+        self.active_path.rename(self.dir / f"{self.base}-{nxt}.{self.suffix}")
         nums.append(nxt)
         while len(nums) > self.max_backups:
             oldest = nums.pop(0)
-            (self.dir / f"{self.base}-{oldest}.csv").unlink(missing_ok=True)
+            (self.dir / f"{self.base}-{oldest}.{self.suffix}").unlink(missing_ok=True)
 
     def _backup_numbers(self) -> list[int]:
-        pat = re.compile(rf"^{re.escape(self.base)}-(\d+)\.csv$")
+        pat = re.compile(rf"^{re.escape(self.base)}-(\d+)\.{re.escape(self.suffix)}$")
         out = []
         for p in self.dir.iterdir():
             m = pat.match(p.name)
@@ -122,20 +135,16 @@ class RotatingCSVWriter:
         return out
 
     def backups(self) -> list[Path]:
-        return [self.dir / f"{self.base}-{n}.csv" for n in sorted(self._backup_numbers())]
+        return [
+            self.dir / f"{self.base}-{n}.{self.suffix}"
+            for n in sorted(self._backup_numbers())
+        ]
 
     def all_files(self) -> list[Path]:
         files = self.backups()
         if self.active_path.exists():
             files.append(self.active_path)
         return files
-
-    def read_all(self) -> list[Any]:
-        self.flush()
-        out: list[Any] = []
-        for p in self.all_files():
-            out.extend(read_csv(p, self.record_cls))
-        return out
 
     def snapshot(self, dest_dir: str | os.PathLike) -> list[Path]:
         """Move every current file into ``dest_dir`` and start fresh.
@@ -161,6 +170,81 @@ class RotatingCSVWriter:
         self._buf.clear()
         for p in self.all_files():
             p.unlink(missing_ok=True)
+
+
+class RotatingCSVWriter(_RotatingSink):
+    """Size-rotated CSV sink with bounded backups — the
+    reference-compatible / debugging form of the record stream."""
+
+    suffix = "csv"
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        base: str,
+        record_cls: type,
+        max_size: int = 100 * 1024 * 1024,
+        max_backups: int = 10,
+        buffer_size: int = 64,
+    ):
+        super().__init__(directory, base, max_size, max_backups, buffer_size)
+        self.record_cls = record_cls
+
+    def _write_batch(self, recs: list[Any]) -> None:
+        write_csv(self.active_path, recs, append=True)
+
+    def read_all(self) -> list[Any]:
+        self.flush()
+        out: list[Any] = []
+        for p in self.all_files():
+            out.extend(read_csv(p, self.record_cls))
+        return out
+
+
+class RotatingBlockWriter(_RotatingSink):
+    """Size-rotated binary columnar sink (schema/wire.py blocks) — the
+    train-stream payload. Each flush encodes the buffered record batch
+    into ONE self-delimiting block appended to the active file, so the
+    per-record cost of tensor extraction is amortized over the batch and
+    the announcer can ship the files verbatim (blocks concatenate)."""
+
+    suffix = "dfb"
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        base: str,
+        encoder,
+        max_size: int = 100 * 1024 * 1024,
+        max_backups: int = 10,
+        buffer_size: int = 64,
+    ):
+        super().__init__(directory, base, max_size, max_backups, buffer_size)
+        self.encoder = encoder  # list[record] -> block bytes
+        self.encode_failures = 0
+
+    def _write_batch(self, recs: list[Any]) -> None:
+        # an encode failure (a poisoned record breaking tensor
+        # extraction) must not take down the scheduler's record-creation
+        # hot path: drop the batch LOUDLY and count it. The loss is
+        # real — when the announcer ships the binary payload it discards
+        # the parallel CSV snapshot unshipped, so these records never
+        # reach the trainer in either form. That trade (lose one batch
+        # of training data vs crash the serving path on a code bug in
+        # extraction) is deliberate; encode_failures > 0 is the alarm.
+        try:
+            block = self.encoder(recs)
+        except Exception:
+            self.encode_failures += 1
+            from dragonfly2_tpu.utils import dflog
+
+            dflog.get("columnar").exception(
+                "block encode failed; dropping %d records from the binary sink",
+                len(recs),
+            )
+            return
+        with open(self.active_path, "ab") as f:
+            f.write(block)
 
 
 # ---------------------------------------------------------------------------
@@ -215,50 +299,3 @@ def concat_columns(blocks: Iterable[dict[str, np.ndarray]]) -> dict[str, np.ndar
         return {}
     keys = blocks[0].keys()
     return {k: np.concatenate([b[k] for b in blocks], axis=0) for k in keys}
-
-
-class BlockWriter:
-    """Append-only block sink: ``<base>-<seq>.npz`` files of up to
-    ``rows_per_block`` rows — the shard unit the data-parallel trainer maps
-    over (one shard file ↔ one input shard, reference
-    trainer/storage/storage.go:141-148 keys files by source scheduler)."""
-
-    def __init__(self, directory: str | os.PathLike, base: str, rows_per_block: int = 1 << 16):
-        self.dir = Path(directory)
-        self.dir.mkdir(parents=True, exist_ok=True)
-        self.base = base
-        self.rows_per_block = rows_per_block
-        self._pending: list[dict[str, np.ndarray]] = []
-        self._pending_rows = 0
-        self._seq = len(self.block_paths())
-
-    def append_columns(self, cols: dict[str, np.ndarray]) -> None:
-        if not cols:
-            return
-        self._pending.append(cols)
-        self._pending_rows += num_rows(cols)
-        while self._pending_rows >= self.rows_per_block:
-            merged = concat_columns(self._pending)
-            head = {k: v[: self.rows_per_block] for k, v in merged.items()}
-            tail = {k: v[self.rows_per_block :] for k, v in merged.items()}
-            self._write(head)
-            self._pending = [tail] if num_rows(tail) else []
-            self._pending_rows = num_rows(tail)
-
-    def flush(self) -> None:
-        if self._pending_rows:
-            self._write(concat_columns(self._pending))
-            self._pending = []
-            self._pending_rows = 0
-
-    def _write(self, cols: dict[str, np.ndarray]) -> None:
-        save_block(self.dir / f"{self.base}-{self._seq:06d}.npz", cols)
-        self._seq += 1
-
-    def block_paths(self) -> list[Path]:
-        pat = re.compile(rf"^{re.escape(self.base)}-(\d+)\.npz$")
-        return sorted(p for p in self.dir.iterdir() if pat.match(p.name))
-
-    def read_all(self) -> dict[str, np.ndarray]:
-        self.flush()
-        return concat_columns(load_block(p) for p in self.block_paths())

@@ -158,24 +158,36 @@ def available() -> bool:
 _CHUNK = 8 * 1024 * 1024
 
 
-def _feed_file(lib, handle, feed, finish, path: str | Path, offset: int = 0) -> None:
+def _feed_file(
+    lib, handle, feed, finish, path: str | Path, offset: int = 0, end: int | None = None
+) -> None:
     with open(path, "rb") as f:
         if offset:
             f.seek(offset)
+        remaining = None if end is None else max(0, end - offset)
         while True:
-            chunk = f.read(_CHUNK)
+            take = _CHUNK if remaining is None else min(_CHUNK, remaining)
+            if take == 0:
+                break
+            chunk = f.read(take)
             if not chunk:
                 break
+            if remaining is not None:
+                remaining -= len(chunk)
             feed(handle, chunk, len(chunk))
     finish(handle)
 
 
-def decode_pairs_file(path: str | Path, offset: int = 0) -> PairExamples | None:
+def decode_pairs_file(
+    path: str | Path, offset: int = 0, end: int | None = None
+) -> PairExamples | None:
     """Download-record CSV file → MLP training pairs via the native
     decoder; None when the library is unavailable (caller falls back to
     read_csv + extract_pair_features). ``offset`` starts mid-file at an
     upload-round boundary (each round begins with its own header line —
-    the decoder re-keys on it)."""
+    the decoder re-keys on it); ``end`` stops at one, so an in-flight
+    concurrent upload's tail (which a failed stream may truncate) is
+    never decoded."""
     lib = load()
     if lib is None or not Path(path).exists():
         return None
@@ -185,7 +197,9 @@ def decode_pairs_file(path: str | Path, offset: int = 0) -> PairExamples | None:
         offset = 0
     handle = lib.df_pairs_new()
     try:
-        _feed_file(lib, handle, lib.df_pairs_feed, lib.df_pairs_finish, path, offset)
+        _feed_file(
+            lib, handle, lib.df_pairs_feed, lib.df_pairs_finish, path, offset, end
+        )
         m = lib.df_pairs_count(handle)
         feats = np.empty((m, MLP_FEATURE_DIM), dtype=np.float32)
         labels = np.empty((m,), dtype=np.float32)
@@ -205,9 +219,14 @@ def decode_pairs_file(path: str | Path, offset: int = 0) -> PairExamples | None:
         lib.df_pairs_free(handle)
 
 
-def split_file_spans(path: str | Path, n: int, offset: int = 0) -> list[tuple]:
-    """Split ``[offset, size)`` of a CSV file into ≤ n record-aligned
-    ``(path, start, end)`` spans for parallel decode.
+def split_file_spans(
+    path: str | Path, n: int, offset: int = 0, end: int | None = None
+) -> list[tuple]:
+    """Split ``[offset, end or size)`` of a CSV file into ≤ n
+    record-aligned ``(path, start, end)`` spans for parallel decode.
+    ``end`` bounds the read at a committed round boundary so bytes a
+    concurrent upload appends (or a failed stream's truncation removes)
+    are never touched.
 
     Record boundaries are newlines at even RFC4180 quote parity — a
     newline inside a quoted field is data, so boundaries are found with
@@ -218,6 +237,8 @@ def split_file_spans(path: str | Path, n: int, offset: int = 0) -> list[tuple]:
     schema per file — true for trainer dataset files unless the uploading
     scheduler changed versions mid-file."""
     size = Path(path).stat().st_size
+    if end is not None and end < size:
+        size = end
     if offset > size:
         offset = 0  # stale committed offset beyond a recreated file
     span = size - offset

@@ -185,6 +185,38 @@ def make_pair_tensors(
     return x, y.astype(np.float32)
 
 
+def synthesize_dataset_binary(
+    d: str, shards: int, shard_bytes: int, records_per_block: int | None = None
+) -> list:
+    """Write ``shards`` binary columnar shard files of ~shard_bytes each
+    by replicating a group of encoded `train` blocks (schema/wire.py) —
+    the exact byte format a columnar-v1 announcer upload lands in
+    trainer storage, at the SAME block size the production sink flushes
+    (scheduler Storage BLOCK_RECORDS), so benchmarked decode rates carry
+    production per-block overhead. Same synthetic body as
+    ``synthesize_dataset_csv`` (seed 0), so the two payload formats are
+    measured on identical records."""
+    import os
+
+    from dragonfly2_tpu.schema import wire
+
+    rpb = records_per_block or wire.BLOCK_RECORDS
+    recs = make_download_records(2000, seed=0)
+    group = b"".join(
+        wire.encode_train_block(recs[i : i + rpb])
+        for i in range(0, len(recs), rpb)
+    )
+    reps = max(1, shard_bytes // len(group))
+    paths = []
+    for s in range(shards):
+        p = os.path.join(d, f"shard{s}.dfb")
+        with open(p, "wb") as f:
+            for _ in range(reps):
+                f.write(group)
+        paths.append(p)
+    return paths
+
+
 def synthesize_dataset_csv(d: str, shards: int, shard_bytes: int) -> list:
     """Write ``shards`` download-record CSV files of ~shard_bytes each by
     replicating a 2,000-record synthetic body (per-record decode cost is

@@ -107,6 +107,13 @@ class OffsetLedger:
         with self._lock:
             return int(self._offsets.get(key, 0))
 
+    def has(self, key: str) -> bool:
+        """Whether an entry exists — callers that must distinguish "never
+        committed" from "committed at 0" (round-boundary recovery) need
+        more than get()'s 0 default."""
+        with self._lock:
+            return key in self._offsets
+
     def commit(self, key: str, offset: int) -> None:
         with self._lock:
             self._offsets[key] = int(offset)

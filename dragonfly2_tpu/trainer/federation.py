@@ -19,7 +19,7 @@ import numpy as np
 from dragonfly2_tpu.parallel.fedavg import fedavg_trees
 from dragonfly2_tpu.schema import native
 from dragonfly2_tpu.schema.columnar import records_to_columns
-from dragonfly2_tpu.schema.features import extract_pair_features
+from dragonfly2_tpu.schema.features import PairExamples, extract_pair_features
 from dragonfly2_tpu.trainer.train import FitConfig, evaluate_mlp, train_mlp
 from dragonfly2_tpu.utils import dflog
 
@@ -35,7 +35,51 @@ class FederatedResult:
 
 
 def _host_pairs(storage, host_id: str):
-    pairs = native.decode_pairs_file(storage.download_path(host_id))
+    # a host that uploaded the binary columnar stream carries its pairs
+    # pre-extracted (schema/wire.py); CSV shards decode via the native
+    # parser with the numpy path as fallback — identical tensors either
+    # way. A host holding BOTH forms (scheduler switched payload formats
+    # mid-history) contributes the union, not just the newer era.
+    cpath = storage.download_path(host_id)
+    pairs = None
+    if cpath.exists() and cpath.stat().st_size:
+        # bounded at the committed round boundary, same as the binary
+        # read below: an in-flight upload's tail may be truncated by a
+        # failed stream mid-read
+        csv_boundary = storage.download_round_boundary(host_id)
+        pairs = native.decode_pairs_file(cpath, end=csv_boundary)
+        if pairs is None:
+            recs = [
+                r
+                for chunk in storage.iter_download_chunks(
+                    host_id, max_bytes=csv_boundary
+                )
+                for r in chunk
+            ]
+            pairs = extract_pair_features(records_to_columns(recs))
+    bpath = storage.download_blocks_path(host_id)
+    if bpath.exists() and bpath.stat().st_size:
+        from dragonfly2_tpu.schema import wire
+
+        # bounded at the committed round boundary like every other
+        # block reader: bytes past it belong to an in-flight upload
+        # whose failure may truncate them under this reader's mmap
+        bin_pairs = wire.read_train_pairs(
+            bpath, end=storage.download_round_boundary(host_id, binary=True)
+        )
+        if pairs is None or pairs.features.shape[0] == 0:
+            return bin_pairs
+        return PairExamples(
+            features=np.concatenate([pairs.features, bin_pairs.features]),
+            labels=np.concatenate([pairs.labels, bin_pairs.labels]),
+            download_index=np.concatenate(
+                [
+                    pairs.download_index,
+                    bin_pairs.download_index + pairs.num_downloads,
+                ]
+            ),
+            num_downloads=pairs.num_downloads + bin_pairs.num_downloads,
+        )
     if pairs is None:
         pairs = extract_pair_features(
             records_to_columns(storage.list_download(host_id))

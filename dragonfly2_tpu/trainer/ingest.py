@@ -1,20 +1,26 @@
-"""Streaming ingestion: bytes-on-disk → native decode → device feed →
-trained params, in bounded host memory with decode/transfer/compute
-overlapped.
+"""Streaming ingestion: bytes-on-disk → decode → device feed → trained
+params, in bounded host memory with decode/transfer/compute overlapped.
 
 This is the hard part of the 1B-records-in-10-min north star (SURVEY §7:
-~1.7M records/s sustained): the reference's Train stream lands CSV files
-on the trainer's disk (reference trainer/storage/storage.go:44-148,
-announcer 128 MiB-chunk upload announcer.go:39-41); from there this
-module drives the fused C++ CSV→tensor decoder (native/dfnative.cc) in
-producer threads, packs pair shards into fixed-size minibatches, and
-hands full superbatches to a dedicated dispatcher thread that runs
+~1.7M records/s sustained): the Train stream lands dataset files on the
+trainer's disk (reference trainer/storage/storage.go:44-148, announcer
+128 MiB-chunk upload announcer.go:39-41) in one of two payload formats,
+sniffed from the file's magic bytes:
+
+- **binary columnar blocks** (schema/wire.py, the negotiated production
+  format): pair tensors precomputed scheduler-side — producer threads
+  mmap block-aligned spans, verify checksums, and cast to the staging
+  dtype; decode_wait collapses to I/O.
+- **CSV** (the old-peer fallback): producer threads drive the fused C++
+  CSV→tensor decoder (native/dfnative.cc) over newline-aligned spans
+  (ctypes releases the GIL during native parsing).
+
+Either way the consumer packs pair shards into fixed-size minibatches
+and hands full superbatches to a dedicated dispatcher thread that runs
 transfer + jitted train step — decode, H2D, and device compute all
-overlap (ctypes releases the GIL during native parsing; XLA dispatch is
-async; the dispatcher absorbs the device link's transfer latency so it
-never stalls packing or decode). Multiple dataset files decode in
-parallel, one producer thread per file shard, each with its own parser
-handle.
+overlap (XLA dispatch is async; the dispatcher absorbs the device
+link's transfer latency so it never stalls packing or decode). Multiple
+dataset files decode in parallel, one producer thread per span.
 
 Memory bound: the shard queue holds ≤ ``queue_depth`` chunks of decoded
 pairs (~chunk_bytes of CSV each) plus a three-buffer packing pool
@@ -25,6 +31,7 @@ independent of file size.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -34,7 +41,7 @@ from pathlib import Path
 import numpy as np
 
 from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
-from dragonfly2_tpu.schema import native
+from dragonfly2_tpu.schema import native, wire
 from dragonfly2_tpu.trainer import metrics as M
 from dragonfly2_tpu.utils import dflog
 
@@ -57,6 +64,17 @@ class StreamStats:
     # carries the bottleneck, not a guess.
     decode_wait_s: float = 0.0
     buffer_wait_s: float = 0.0
+    # producer-side per-stage split, summed across the worker pool (so
+    # with W workers the totals can exceed wall time): read_s — I/O +
+    # block decode + checksum (binary) / fused read+parse (CSV, where
+    # the native decoder doesn't separate them); cast_s — staging-dtype
+    # conversion (binary; fused into read_s on CSV); enqueue_s — blocked
+    # on the bounded shard queue (consumer too slow). When the e2e rate
+    # disappoints, this names the NEXT bottleneck instead of leaving it
+    # to archaeology.
+    read_s: float = 0.0
+    cast_s: float = 0.0
+    enqueue_s: float = 0.0
     # per-dispatch training losses, most recent last (bounded to the
     # final _LOSS_KEEP dispatches so a million-step run stays O(1))
     losses: list = field(default_factory=list)
@@ -70,6 +88,15 @@ class StreamStats:
 _LOSS_KEEP = 1024
 
 
+def default_workers(ncpu: int | None = None) -> int:
+    """Producer pool size off host_cores: decode parallelism helps up to
+    a point (the packing thread needs a core too), so leave one core
+    free and cap the pool — beyond ~6 decoders the bounded queue, not
+    decode, is the limit."""
+    ncpu = ncpu or os.cpu_count() or 1
+    return max(1, min(6, ncpu - 1))
+
+
 def stream_shards(
     paths,
     passes: int = 1,
@@ -77,8 +104,10 @@ def stream_shards(
     queue_depth: int = 8,
     chunk_bytes: int = 8 * 1024 * 1024,
     offset: int = 0,
+    end: int | None = None,
     workers: int = 1,
     half: bool = False,
+    stats: "StreamStats | None" = None,
 ):
     """Generator of ``(feats, labels, total_rows)`` shards, decoded by
     background producer thread(s) through a bounded queue. ``total_rows``
@@ -86,14 +115,26 @@ def stream_shards(
     far (per-worker deltas are summed internally), so the last yielded
     value is the whole stream's row count.
 
-    With ``workers > 1`` the dataset is split across that many producer
-    threads, each driving its own native parser — decode scales across
-    cores because ctypes releases the GIL. Fewer files than workers is
-    fine: files are split into newline-aligned byte spans
-    (native.split_file_spans), so one big per-host dataset file decodes
-    in parallel too. Shard order is then interleaved (fine for SGD).
-    ``offset`` (a committed round boundary in the first file) is
-    excluded on every pass. Abandoning the generator (consumer breaks
+    Payload format is sniffed from the first file's magic bytes:
+
+    - binary columnar blocks (schema/wire.py) — the zero-parse path:
+      producers mmap block-aligned spans, verify checksums, and cast the
+      precomputed pair tensors to the staging dtype. All residual decode
+      work (CRC, f16 cast) runs IN the producer pool.
+    - CSV — the fallback: producers drive the fused native parser
+      (native/dfnative.cc) over newline-aligned byte spans.
+
+    With ``workers > 1`` the dataset splits across that many producer
+    threads (``workers=0`` → sized off host cores, ``default_workers``).
+    Fewer files than workers is fine: files are split into aligned spans,
+    so one big per-host dataset file decodes in parallel too. Shard
+    order is then interleaved (fine for SGD). ``offset`` (a committed
+    round boundary in the first file) is excluded on every pass, and
+    ``end`` bounds the first file's read at the CURRENT round boundary —
+    bytes a concurrent upload appends past it (which a failed stream's
+    truncation may later remove) are never touched.
+    ``stats``, when given, accumulates the producer-side read/cast/
+    enqueue stage split. Abandoning the generator (consumer breaks
     early / errors) releases the producers: they observe the stop event
     instead of blocking forever on a full queue.
     """
@@ -104,39 +145,93 @@ def stream_shards(
         # an empty glob must be a clear error, not a ZeroDivisionError
         # from the span-splitting arithmetic below
         raise ValueError("stream_shards: no input files")
+    if workers <= 0:
+        workers = default_workers()
+    binary = wire.is_block_file(paths[0])
     # resolve to (path, start, end) spans: applies the committed offset
     # once (so every pass skips consumed history) and gives each worker
     # a balanced byte share even when files < workers
-    per_file = max(1, -(-workers // len(paths)))  # ceil
-    spans = []
-    for j, p in enumerate(paths):
-        spans.extend(
-            native.split_file_spans(p, per_file, offset=offset if j == 0 else 0)
-        )
+    spans: list = []
+    if binary:
+        bounded = [
+            (str(p), offset if j == 0 else 0, end if j == 0 else None)
+            for j, p in enumerate(paths)
+        ]
+        spans = wire.split_block_spans(bounded)
+    else:
+        per_file = max(1, -(-workers // len(paths)))  # ceil
+        for j, p in enumerate(paths):
+            spans.extend(
+                native.split_file_spans(
+                    p,
+                    per_file,
+                    offset=offset if j == 0 else 0,
+                    end=end if j == 0 else None,
+                )
+            )
+    if not spans:
+        return  # binary file with no complete blocks past the offset
     workers = max(1, min(workers, len(spans)))
     # queue items: per-worker rows are deltas, so interleaving is additive
     q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
     stop = threading.Event()
     errors: list[BaseException] = []
+    stats_lock = threading.Lock()
+
+    def add_stage(stage: str, dt: float) -> None:
+        if stats is None:
+            return
+        with stats_lock:
+            if stage == "read":
+                stats.read_s += dt
+            elif stage == "cast":
+                stats.cast_s += dt
+            else:
+                stats.enqueue_s += dt
 
     def produce(worker_spans):
         try:
             prev_rows = 0
-            for feats, labels, rows in native.stream_pairs_file(
-                worker_spans,
-                passes=passes,
-                chunk_bytes=chunk_bytes,
-                max_records=max_records,
-                half=half,
-            ):
+            if binary:
+                shard_iter = wire.stream_train_pairs(
+                    worker_spans,
+                    passes=passes,
+                    max_records=max_records,
+                    half=half,
+                    stage_timer=add_stage,
+                )
+            else:
+                # the native parser fuses file read + parse + (optional)
+                # f16 emit, so its whole cost lands in read_s
+                def csv_iter():
+                    it = native.stream_pairs_file(
+                        worker_spans,
+                        passes=passes,
+                        chunk_bytes=chunk_bytes,
+                        max_records=max_records,
+                        half=half,
+                    )
+                    while True:
+                        t0 = time.perf_counter()
+                        try:
+                            item = next(it)
+                        except StopIteration:
+                            return
+                        add_stage("read", time.perf_counter() - t0)
+                        yield item
+
+                shard_iter = csv_iter()
+            for feats, labels, rows in shard_iter:
                 item = (feats, labels, rows - prev_rows)
                 prev_rows = rows
+                t0 = time.perf_counter()
                 while not stop.is_set():
                     try:
                         q.put(item, timeout=0.2)
                         break
                     except queue.Full:
                         continue
+                add_stage("enqueue", time.perf_counter() - t0)
                 if stop.is_set():
                     return
         except BaseException as e:  # surfaced to the consumer
@@ -298,6 +393,7 @@ def stream_train_mlp(
     weight_decay: float = 1e-4,
     queue_depth: int = 4,
     offset: int = 0,
+    end: int | None = None,
     workers: int = 1,
     eval_every: int = 10,
     eval_max_batches: int = 16,
@@ -487,8 +583,10 @@ def stream_train_mlp(
                 max_records=max_records,
                 queue_depth=queue_depth,
                 offset=offset,
+                end=end,
                 workers=workers,
                 half=half,
+                stats=stats,
             )
         )
         while True:

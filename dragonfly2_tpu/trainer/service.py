@@ -1,11 +1,15 @@
 """Trainer RPC service: the `Train` client-stream endpoint (reference
-trainer/service/service_v1.go:59-162).
+trainer/service/service_v1.go:59-162) plus payload-format negotiation.
 
 First message keys the uploading scheduler (hostID = sha256(ip,hostname),
-reference :87); each TrainMlpRequest chunk appends to that host's download
-CSV, TrainGnnRequest to its topology CSV (:126-145); on EOF the fit runs
-asynchronously (:155-159) so the stream ack isn't held for minutes of
-training.
+reference :87); each chunk appends to that host's dataset file — CSV
+chunks to ``*.csv``, binary columnar chunks (schema/wire.py) to
+``*.dfb`` — and on EOF the fit runs asynchronously (:155-159) so the
+stream ack isn't held for minutes of training.
+
+`Capabilities` advertises the payload formats this trainer accepts; the
+announcer probes it before uploading and falls back to CSV when the RPC
+is missing (old trainer) or the binary token is absent.
 """
 
 from __future__ import annotations
@@ -15,6 +19,7 @@ import threading
 from dragonfly2_tpu.rpc import gen  # noqa: F401
 import trainer_pb2  # noqa: E402
 
+from dragonfly2_tpu.schema import wire
 from dragonfly2_tpu.trainer.storage import TrainerStorage
 from dragonfly2_tpu.trainer.training import Training
 from dragonfly2_tpu.trainer import metrics as M
@@ -27,6 +32,9 @@ from dragonfly2_tpu.rpc.glue import TRAINER_SERVICE as SERVICE_NAME
 
 
 class TrainerService:
+    # newest-preferred order; Capabilities returns it verbatim
+    TRAIN_FORMATS = (wire.FORMAT_NAME, wire.CSV_FORMAT_NAME)
+
     def __init__(self, storage: TrainerStorage, training: Training, synchronous: bool = False):
         self.storage = storage
         self.training = training
@@ -34,6 +42,9 @@ class TrainerService:
         self.synchronous = synchronous
         self.train_total = 0
         self.train_failure_total = 0  # mirrored into Prometheus (metrics.py)
+
+    def Capabilities(self, request, context):
+        return trainer_pb2.CapabilitiesResponse(train_formats=list(self.TRAIN_FORMATS))
 
     def Train(self, request_iterator, context):
         ip = hostname = None
@@ -52,9 +63,30 @@ class TrainerService:
                 elif which == "train_gnn":
                     M.DATASET_BYTES_TOTAL.labels("topology").inc(len(req.train_gnn.dataset))
                     self.storage.append_network_topology(host_id, req.train_gnn.dataset)
+                elif which == "train_mlp_binary":
+                    M.DATASET_BYTES_TOTAL.labels("download_binary").inc(
+                        len(req.train_mlp_binary.dataset)
+                    )
+                    self.storage.append_download_blocks(
+                        host_id, req.train_mlp_binary.dataset
+                    )
+                elif which == "train_gnn_binary":
+                    M.DATASET_BYTES_TOTAL.labels("topology_binary").inc(
+                        len(req.train_gnn_binary.dataset)
+                    )
+                    self.storage.append_network_topology_blocks(
+                        host_id, req.train_gnn_binary.dataset
+                    )
         except Exception:
             self.train_failure_total += 1
             M.TRAIN_FAILURE_TOTAL.inc()
+            if host_id is not None:
+                # a broken stream may have landed half an upload round —
+                # for the binary files a torn block would poison every
+                # later append (its length prefix points into the new
+                # data), so cut every file back to its last complete
+                # round before the announcer retries
+                self.storage.truncate_to_round(host_id)
             raise
 
         if host_id is not None:

@@ -21,7 +21,7 @@ from typing import Any, Protocol
 
 import numpy as np
 
-from dragonfly2_tpu.schema import native
+from dragonfly2_tpu.schema import native, wire
 from dragonfly2_tpu.schema.columnar import records_to_columns
 from dragonfly2_tpu.schema.features import build_probe_graph, extract_pair_features
 from dragonfly2_tpu.trainer.storage import TrainerStorage
@@ -31,6 +31,14 @@ from dragonfly2_tpu.utils import dflog
 from dragonfly2_tpu.utils.idgen import gnn_model_id_v1, host_id_v2, mlp_model_id_v1
 
 logger = dflog.get("trainer")
+
+
+class BelowMinRecords(ValueError):
+    """The dataset (or era) holds too few records / no trainable pairs
+    to fit — the condition the mixed-era fall-through is allowed to
+    treat as 'drop the sub-minimum tail'. Any OTHER error (corrupt
+    data, decode failure) must propagate and never silently discard an
+    untrained dataset."""
 
 
 class ManagerClient(Protocol):
@@ -68,7 +76,8 @@ class TrainingConfig:
     streaming: bool = True
     streaming_threshold_bytes: int = 64 * 1024 * 1024
     streaming_passes: int = 2
-    streaming_workers: int = 1
+    # decode producer pool; 0 = sized off host cores (ingest.default_workers)
+    streaming_workers: int = 0
     # optimizer steps folded into one device dispatch (lax.scan
     # superbatch) — raise on high-latency device links
     streaming_steps_per_call: int = 1
@@ -126,8 +135,14 @@ class Training:
         (reference training.go:60-78 errgroup)."""
         host_id = host_id_v2(ip, hostname)
         outcome = TrainingOutcome()
+        # which payload form the MLP leg consumed (None until decided):
+        # the post-fit clear drops exactly that form, so other-era data
+        # from a format switch survives to train next round
+        mlp_info: dict = {}
         with concurrent.futures.ThreadPoolExecutor(max_workers=3) as pool:
-            f_mlp = pool.submit(self._timed_fit, "mlp", self._train_mlp, host_id, ip, hostname)
+            f_mlp = pool.submit(
+                self._timed_fit, "mlp", self._train_mlp, host_id, ip, hostname, mlp_info
+            )
             f_gnn = pool.submit(self._timed_fit, "gnn", self._train_gnn, host_id, ip, hostname)
             f_gru = (
                 pool.submit(self._timed_fit, "gru", self._train_gru, host_id, ip, hostname)
@@ -153,9 +168,12 @@ class Training:
 
         if self.config.clear_after_train and not self.config.incremental:
             # the reference retrains from scratch each round and drops
-            # consumed uploads (trainer/trainer.go:156-161)
+            # consumed uploads (trainer/trainer.go:156-161). Only the
+            # payload form the MLP leg actually trained on is dropped —
+            # after a scheduler format switch the other era's records
+            # remain and train next round.
             if outcome.mlp_error is None:
-                self.storage.clear_download(host_id)
+                self.storage.clear_download(host_id, binary=mlp_info.get("binary"))
             if outcome.gnn_error is None:
                 self.storage.clear_network_topology(host_id)
         return outcome
@@ -191,27 +209,101 @@ class Training:
         )
 
     # -- trainMLP (reference training.go:92-98) ---------------------------
-    def _train_mlp(self, host_id: str, ip: str, hostname: str) -> dict[str, float]:
-        # native fused decode+featurize (1000x the numpy path); fall back
-        # to the Python pipeline when the library is unavailable
-        path = self.storage.download_path(host_id)
-        offset = self.storage.download_offset(host_id) if self.config.incremental else 0
+    def _train_mlp(
+        self, host_id: str, ip: str, hostname: str, info: dict | None = None
+    ) -> dict[str, float]:
+        # payload selection: binary columnar stream (zero-parse ingest)
+        # or CSV via the native fused decoder (numpy fallback) — all
+        # paths produce identical tensors (the equivalence tests pin
+        # this). When BOTH eras hold pending data (the scheduler
+        # switched formats), the OLDER era — CSV — drains first: it gets
+        # trained and cleared this round, and the binary data trains at
+        # the next round; preferring binary unconditionally would leave
+        # a CSV leftover untrained (and re-merged into the GRU/FedAvg
+        # legs) forever under continuous binary uploads. The consumed
+        # form is reported back via ``info`` so train() clears only it.
+        has_csv = self._pending_bytes(host_id, binary=False) > 0
+        has_bin = self._pending_bytes(host_id, binary=True) > 0
+        if has_csv and has_bin:
+            try:
+                return self._train_mlp_from(
+                    host_id, ip, hostname, binary=False, info=info
+                )
+            except BelowMinRecords as e:
+                # the CSV-era leftover alone can't train (below the
+                # min-record gate / no pairs): fall through to the
+                # binary era INSTEAD of failing this host every round
+                # while its binary data grows unboundedly. The
+                # sub-minimum tail rides out with this round's clear
+                # (info["binary"]=None → both forms dropped): the
+                # operator's own gate declared it too small to train on.
+                logger.warning(
+                    "csv-era leftover for %s untrainable (%s);"
+                    " training the binary era and dropping the tail",
+                    host_id,
+                    e,
+                )
+                if info is not None:
+                    info["binary"] = None
+                return self._train_mlp_from(
+                    host_id, ip, hostname, binary=True, info=None
+                )
+        return self._train_mlp_from(
+            host_id, ip, hostname, binary=has_bin, info=info
+        )
+
+    def _train_mlp_from(
+        self,
+        host_id: str,
+        ip: str,
+        hostname: str,
+        binary: bool,
+        info: dict | None = None,
+    ) -> dict[str, float]:
+        if info is not None:
+            info["binary"] = binary
+        path = (
+            self.storage.download_blocks_path(host_id)
+            if binary
+            else self.storage.download_path(host_id)
+        )
+        offset = (
+            self.storage.download_offset(host_id, binary=binary)
+            if self.config.incremental
+            else 0
+        )
         # the boundary is marked by the Train service at stream EOF (locked
         # against appends), so the committed offset never lands mid-record
-        boundary = self.storage.download_round_boundary(host_id)
-        if self._use_streaming(path, offset):
-            return self._train_mlp_streaming(host_id, ip, hostname, path, offset, boundary)
-        pairs = native.decode_pairs_file(path, offset=offset)
-        if pairs is None:
-            recs = self.storage.list_download(host_id)
-            pairs = extract_pair_features(records_to_columns(recs))
+        # (mid-block for the binary file)
+        boundary = self.storage.download_round_boundary(host_id, binary=binary)
+        if self._use_streaming(path, offset, binary):
+            return self._train_mlp_streaming(
+                host_id, ip, hostname, path, offset, boundary, binary
+            )
+        if binary:
+            pairs = wire.read_train_pairs(path, offset=offset, end=boundary)
+        else:
+            # bounded at the round boundary exactly like the binary and
+            # streaming paths: the in-flight tail past it may be
+            # truncated by a failed stream, and the offset commit below
+            # wouldn't cover it anyway
+            pairs = native.decode_pairs_file(path, offset=offset, end=boundary)
+            if pairs is None:
+                recs = [
+                    r
+                    for chunk in self.storage.iter_download_chunks(
+                        host_id, max_bytes=boundary
+                    )
+                    for r in chunk
+                ]
+                pairs = extract_pair_features(records_to_columns(recs))
         if pairs.num_downloads < self.config.min_download_records:
-            raise ValueError(
+            raise BelowMinRecords(
                 f"{pairs.num_downloads} download records for host {host_id}"
                 f" < min {self.config.min_download_records}"
             )
         if pairs.features.shape[0] == 0:
-            raise ValueError("no trainable (download, parent) pairs")
+            raise BelowMinRecords("no trainable (download, parent) pairs")
         result = train_mlp(pairs.features, pairs.labels, mesh=self.mesh, config=self.config.mlp)
         if self.manager_client is not None:
             self.manager_client.create_model(
@@ -225,13 +317,35 @@ class Training:
         if self.config.incremental:
             # commit only after a fully successful round (incl. upload) —
             # a crashed round re-decodes from the previous offset
-            self.storage.commit_download_offset(host_id, boundary)
+            self.storage.commit_download_offset(host_id, boundary, binary=binary)
         return result.metrics
 
-    def _use_streaming(self, path, offset: int) -> bool:
+    def _pending_bytes(self, host_id: str, binary: bool) -> int:
         import os
 
-        if not (self.config.streaming and native.available()):
+        path = (
+            self.storage.download_blocks_path(host_id)
+            if binary
+            else self.storage.download_path(host_id)
+        )
+        offset = (
+            self.storage.download_offset(host_id, binary=binary)
+            if self.config.incremental
+            else 0
+        )
+        try:
+            return os.path.getsize(path) - offset
+        except OSError:
+            return 0
+
+    def _use_streaming(self, path, offset: int, binary: bool) -> bool:
+        import os
+
+        # the binary stream needs no native library — frombuffer IS the
+        # decoder; CSV streaming still rides the fused C++ parser
+        if not self.config.streaming:
+            return False
+        if not binary and not native.available():
             return False
         try:
             pending = os.path.getsize(path) - offset
@@ -240,7 +354,14 @@ class Training:
         return pending >= self.config.streaming_threshold_bytes
 
     def _train_mlp_streaming(
-        self, host_id: str, ip: str, hostname: str, path, offset: int, boundary: int
+        self,
+        host_id: str,
+        ip: str,
+        hostname: str,
+        path,
+        offset: int,
+        boundary: int,
+        binary: bool = False,
     ) -> dict[str, float]:
         """Large-dataset path: bounded-memory overlapped decode+train
         (trainer.ingest.stream_train_mlp) instead of materializing every
@@ -253,14 +374,20 @@ class Training:
             # cheap pre-gate (batch path checks before fitting too): a
             # bounded decode stops as soon as min records are seen, so a
             # sparse host fails here instead of after the full multi-pass
-            # fit on the chip
-            rows = 0
-            for _, _, rows in native.stream_pairs_file(
-                path, offset=offset, max_records=self.config.min_download_records
-            ):
-                pass
+            # fit on the chip. Binary counts from block headers alone —
+            # no payload bytes are touched.
+            if binary:
+                rows = wire.count_records(
+                    path, offset=offset, max_records=self.config.min_download_records
+                )
+            else:
+                rows = 0
+                for _, _, rows in native.stream_pairs_file(
+                    path, offset=offset, max_records=self.config.min_download_records
+                ):
+                    pass
             if rows < self.config.min_download_records:
-                raise ValueError(
+                raise BelowMinRecords(
                     f"{rows} download records for host {host_id}"
                     f" < min {self.config.min_download_records}"
                 )
@@ -275,6 +402,12 @@ class Training:
             learning_rate=cfg.learning_rate,
             weight_decay=cfg.weight_decay,
             offset=offset,
+            # bound at the committed round boundary, exactly like the
+            # batch path: bytes past it belong to an in-flight upload
+            # whose failure may TRUNCATE them mid-read, and training
+            # them would double-count records the offset commit below
+            # doesn't cover
+            end=boundary,
             workers=self.config.streaming_workers,
             eval_every=eval_every,
             mesh=self.mesh,
@@ -288,12 +421,12 @@ class Training:
         # pre-gate above already enforced the minimum on real rows.
         rows = stats.download_records // max(self.config.streaming_passes, 1)
         if rows < self.config.min_download_records and not stats.truncated:
-            raise ValueError(
+            raise BelowMinRecords(
                 f"{rows} download records for host {host_id}"
                 f" < min {self.config.min_download_records}"
             )
         if stats.pairs == 0:
-            raise ValueError("no trainable (download, parent) pairs")
+            raise BelowMinRecords("no trainable (download, parent) pairs")
         logger.info(
             "streamed fit for %s: %d records, %d pairs, %d steps, %.0f rec/s",
             host_id,
@@ -312,18 +445,52 @@ class Training:
                 evaluation=stats.metrics,
             )
         if self.config.incremental:
-            self.storage.commit_download_offset(host_id, boundary)
+            self.storage.commit_download_offset(host_id, boundary, binary=binary)
         return stats.metrics
 
     # -- trainGNN (reference training.go:82-88) ---------------------------
     def _train_gnn(self, host_id: str, ip: str, hostname: str) -> dict[str, float]:
         # the probe graph is cumulative state (EWMA RTT edges), so the GNN
-        # always rebuilds from the whole file — no offset decode here; the
-        # incremental win is on the (much larger) download stream
-        graph = native.build_probe_graph_file(
-            self.storage.network_topology_path(host_id),
-            max_degree=self.config.gnn_max_degree,
-        )
+        # always rebuilds from the whole history — no offset decode here;
+        # the incremental win is on the (much larger) download stream
+        bpath = self.storage.network_topology_blocks_path(host_id)
+        cpath = self.storage.network_topology_path(host_id)
+        has_bin = bpath.exists() and bpath.stat().st_size > 0
+        has_csv = cpath.exists() and cpath.stat().st_size > 0
+        graph = None
+        if has_bin and has_csv:
+            # format-switch history: merge BOTH eras (CSV rows first —
+            # they predate the binary era, and edge RTT is
+            # last-write-wins in the graph build)
+            from dragonfly2_tpu.schema.columnar import concat_columns
+
+            cols = concat_columns(
+                [
+                    records_to_columns(self.storage.list_network_topology(host_id)),
+                    wire.read_columns(
+                        bpath,
+                        kind=wire.KIND_TOPOLOGY,
+                        end=self.storage.network_topology_round_boundary(
+                            host_id, binary=True
+                        ),
+                    ),
+                ]
+            )
+            graph = build_probe_graph(cols, max_degree=self.config.gnn_max_degree)
+        elif has_bin:
+            # binary topology upload: raw record columns, decoded straight
+            # into the vectorized graph build (read bounded by the round
+            # boundary so a concurrent upload's tail is never decoded)
+            cols = wire.read_columns(
+                bpath,
+                kind=wire.KIND_TOPOLOGY,
+                end=self.storage.network_topology_round_boundary(host_id, binary=True),
+            )
+            graph = build_probe_graph(cols, max_degree=self.config.gnn_max_degree)
+        else:
+            graph = native.build_probe_graph_file(
+                cpath, max_degree=self.config.gnn_max_degree
+            )
         if graph is None:
             recs = self.storage.list_network_topology(host_id)
             graph = build_probe_graph(
@@ -368,10 +535,34 @@ class Training:
         # read only up to the committed round boundary: this generator
         # stays open across extraction pauses, and a concurrent Train
         # stream may be appending past it (same protocol as the MLP
-        # leg's offset/boundary machinery)
-        boundary = self.storage.download_round_boundary(host_id)
-        for chunk in self.storage.iter_download_chunks(host_id, max_bytes=boundary):
-            s = extract_piece_sequences(records_to_columns(chunk))
+        # leg's offset/boundary machinery). Binary uploads carry the
+        # sequences pre-extracted in each train block; CSV re-extracts
+        # chunk-wise — both sides of the same bounded-memory contract.
+        # BOTH sources are consumed (CSV era first, it's older): a host
+        # that switched payload formats keeps its whole recent history
+        # feeding the next-cost model, and the newest-kept cap below
+        # still bounds memory.
+        import itertools
+
+        seq_iters = []
+        cpath = self.storage.download_path(host_id)
+        if cpath.exists() and cpath.stat().st_size:
+            boundary = self.storage.download_round_boundary(host_id)
+            seq_iters.append(
+                extract_piece_sequences(records_to_columns(chunk))
+                for chunk in self.storage.iter_download_chunks(
+                    host_id, max_bytes=boundary
+                )
+            )
+        bpath = self.storage.download_blocks_path(host_id)
+        if bpath.exists() and bpath.stat().st_size:
+            seq_iters.append(
+                wire.stream_gru_sequences(
+                    bpath,
+                    end=self.storage.download_round_boundary(host_id, binary=True),
+                )
+            )
+        for s in itertools.chain(*seq_iters):
             if s.sequences.shape[0]:
                 parts.append(s)
                 total += s.sequences.shape[0]

@@ -19,9 +19,15 @@ structure (the topology's probe entries are JSON strings, which is also
 what the reference stores — probes.go marshals JSON into Redis lists).
 
 Commands implemented (the subset the system uses, plus introspection):
-PING ECHO SET GET DEL EXISTS EXPIRE INCR INCRBY HSET HGET HGETALL RPUSH
-LPOP LLEN LRANGE KEYS SCAN FLUSHALL. Unknown commands get -ERR, never a
-dropped connection.
+AUTH PING ECHO SET GET DEL EXISTS EXPIRE INCR INCRBY HSET HGET HGETALL
+RPUSH LPOP LLEN LRANGE KEYS SCAN FLUSHALL. Unknown commands get -ERR,
+never a dropped connection.
+
+Hardening: the server binds loopback by default (network exposure is an
+explicit config decision), and a configured ``secret`` gates every data
+command behind RESP ``AUTH`` exactly like Redis's ``requirepass`` —
+unauthenticated commands get ``-NOAUTH``, wrong secrets get ``-ERR
+invalid password`` (redis-py and redis-cli both speak this natively).
 """
 
 from __future__ import annotations
@@ -62,6 +68,13 @@ def _err(msg: str) -> bytes:
 
 _OK = b"+OK" + CRLF
 _PONG = b"+PONG" + CRLF
+_NOAUTH = b"-NOAUTH Authentication required." + CRLF
+
+
+def _compare(given: str, secret: str) -> bool:
+    import hmac
+
+    return hmac.compare_digest(given.encode(), secret.encode())
 
 
 class _Reader:
@@ -130,6 +143,8 @@ class _Reader:
 class KVRequestHandler(socketserver.BaseRequestHandler):
     def handle(self) -> None:  # one thread per connection
         store: KVStore = self.server.store  # type: ignore[attr-defined]
+        secret: str = getattr(self.server, "secret", "")
+        authed = not secret  # no secret configured = open (dev mode)
         reader = _Reader(self.request)
         try:
             while True:
@@ -137,6 +152,26 @@ class KVRequestHandler(socketserver.BaseRequestHandler):
                 if cmd is None:
                     return
                 if not cmd:
+                    continue
+                op = cmd[0].upper()
+                if op == "AUTH":
+                    # 1-arg (requirepass) and 2-arg (ACL: user password)
+                    # forms, like Redis 6; only the default user exists
+                    if not secret:
+                        resp = _err("Client sent AUTH, but no password is set")
+                    elif len(cmd) not in (2, 3) or (
+                        len(cmd) == 3 and cmd[1] != "default"
+                    ):
+                        resp = _err("invalid username-password pair")
+                    elif _compare(cmd[-1], secret):
+                        authed = True
+                        resp = _OK
+                    else:
+                        resp = _err("invalid password")
+                    self.request.sendall(resp)
+                    continue
+                if not authed:
+                    self.request.sendall(_NOAUTH)
                     continue
                 try:
                     resp = self._dispatch(store, cmd)
@@ -212,10 +247,21 @@ class KVRequestHandler(socketserver.BaseRequestHandler):
 
 
 class KVServer:
-    """Threaded RESP server; ``serve()`` binds and returns the port."""
+    """Threaded RESP server; ``serve()`` binds and returns the port.
 
-    def __init__(self, store: KVStore | None = None, host: str = "0.0.0.0", port: int = 0):
+    Binds loopback by default — exposing the store on the network is an
+    explicit opt-in (pass ``host="0.0.0.0"``), and should come with a
+    ``secret`` so every connection must AUTH first."""
+
+    def __init__(
+        self,
+        store: KVStore | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        secret: str = "",
+    ):
         self.store = store if store is not None else KVStore()
+        self.secret = secret
         self._host = host
         self._port = port
         self._server: socketserver.ThreadingTCPServer | None = None
@@ -232,6 +278,7 @@ class KVServer:
 
         self._server = _Srv((self._host, self._port), KVRequestHandler)
         self._server.store = self.store  # type: ignore[attr-defined]
+        self._server.secret = self.secret  # type: ignore[attr-defined]
         self._port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="kv-server", daemon=True

@@ -168,10 +168,11 @@ class RemoteKVStore:
     with ``decode_responses=True``.
     """
 
-    def __init__(self, address: str, timeout: float = 5.0):
+    def __init__(self, address: str, timeout: float = 5.0, secret: str = ""):
         host, _, port = address.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self._timeout = timeout
+        self._secret = secret
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
         self._buf = b""
@@ -183,6 +184,24 @@ class RemoteKVStore:
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
             self._buf = b""
+            if self._secret:
+                # AUTH inline on the fresh connection (requirepass
+                # semantics, matching KVServer and real Redis) — every
+                # reconnect re-authenticates before any queued command
+                try:
+                    data = self._secret.encode()
+                    s.sendall(
+                        b"*2" + _CRLF + b"$4" + _CRLF + b"AUTH" + _CRLF
+                        + b"$" + str(len(data)).encode() + _CRLF + data + _CRLF
+                    )
+                    reply = self._read_reply()  # raises ValueError on -ERR
+                    if reply != "OK":
+                        raise ValueError(f"kv AUTH rejected: {reply!r}")
+                except BaseException:
+                    # never cache a connection that failed to
+                    # authenticate — the next call reconnects cleanly
+                    self._drop_connection()
+                    raise
         return self._sock
 
     def close(self) -> None:
@@ -336,10 +355,11 @@ def default_store() -> KVStore:
         return _default_store
 
 
-def connect(address: str = "") -> "KVStore | RemoteKVStore":
+def connect(address: str = "", secret: str = "") -> "KVStore | RemoteKVStore":
     """Backend selection: empty address → the in-process singleton;
-    ``host:port`` → the RESP client (our KVServer or a real Redis)."""
-    return RemoteKVStore(address) if address else default_store()
+    ``host:port`` → the RESP client (our KVServer or a real Redis),
+    authenticating with ``secret`` when the server requires AUTH."""
+    return RemoteKVStore(address, secret=secret) if address else default_store()
 
 
 # -- key schema (reference parity: pkg/redis/redis.go) -------------------

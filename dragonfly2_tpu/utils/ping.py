@@ -159,9 +159,15 @@ class Pinger:
         if self._icmp_available is not False:
             rtt = icmp_ping(addr, timeout=self.timeout)
             if rtt is None and self._icmp_available is None:
-                # distinguish "no permission ever" from "this host down"
-                self._icmp_available = _open_icmp_socket() is not None
-                if not self._icmp_available:
+                # distinguish "no permission ever" from "this host down";
+                # the probe socket must be CLOSED, not dropped — this
+                # branch can run once per Pinger, but a leaked fd lives
+                # for the daemon's whole lifetime
+                opened = _open_icmp_socket()
+                self._icmp_available = opened is not None
+                if opened is not None:
+                    opened[0].close()
+                else:
                     logger.info("icmp unavailable (no raw/dgram socket); using fallback probes")
             elif rtt is not None:
                 self._icmp_available = True

@@ -2,15 +2,18 @@
 device-link failure mid-repeat must not discard runs that DID finish
 (emit best + ``run_error``), and must produce the error line — never a
 traceback with no JSON — when no run completed. The heavy phases
-(dataset synthesis, the real streaming fit) are stubbed; everything
-else in main() runs for real.
+(dataset synthesis, the real streaming fit) are stubbed with REAL (tiny)
+files of both payload formats — the host-split section decodes them for
+real; everything else in main() runs too.
 """
 
 import json
+import time
 
 import pytest
 
 import bench
+from dragonfly2_tpu.schema import synth, wire
 from dragonfly2_tpu.trainer import ingest
 from dragonfly2_tpu.trainer.ingest import StreamStats
 
@@ -25,6 +28,17 @@ def _fake_synthesize(d, shards, shard_bytes):
     return paths
 
 
+def _fake_synthesize_binary(d, shards, shard_bytes):
+    block = wire.encode_train_block(synth.make_download_records(5, seed=0))
+    paths = []
+    for i in range(2):
+        p = f"{d}/shard-{i}.dfb"
+        with open(p, "wb") as f:
+            f.write(block)
+        paths.append(p)
+    return paths
+
+
 def _stats(records=1000):
     s = StreamStats()
     s.download_records = records
@@ -35,6 +49,7 @@ def _stats(records=1000):
 
 def _run_main(monkeypatch, capfd, fit_stub):
     monkeypatch.setattr(bench, "synthesize_dataset", _fake_synthesize)
+    monkeypatch.setattr(bench, "synthesize_dataset_binary", _fake_synthesize_binary)
     monkeypatch.setattr(ingest, "stream_train_mlp", fit_stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     monkeypatch.delenv("DF_BENCH_CPU_FALLBACK", raising=False)
@@ -95,3 +110,64 @@ def test_all_runs_complete_emits_best(monkeypatch, capfd):
     assert len(rec["run_rates"]) == 3
     assert rec["value"] == max(rec["run_rates"])
     assert "run_error" not in rec and "error" not in rec
+
+
+def test_emits_decode_rate_per_payload_format(monkeypatch, capfd):
+    """The artifact must carry the host-side decode rate for BOTH
+    payload formats plus the production format name (ISSUE r6: the
+    bottleneck split is a measured fact, per format)."""
+
+    def stub(paths, **kw):
+        return None, _stats(1000)
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert rec["payload_format"] == wire.FORMAT_NAME
+    assert rec["decode_only_rate_binary"] > 0
+    assert "stream_only_rate" in rec
+    from dragonfly2_tpu.schema import native
+
+    if native.available():
+        assert "decode_only_rate_csv" in rec
+    # the e2e runs rode the binary shards
+    assert rec["value"] == max(rec["run_rates"])
+    # per-run producer stage split rides along
+    for detail in rec["run_details"]:
+        assert {"read_s", "cast_s", "enqueue_s"} <= set(detail)
+
+
+def test_binary_decode_outruns_csv_decode(tmp_path):
+    """Pure-decode microbench on the SAME records: the columnar block
+    decoder must be strictly faster than the CSV decoder — the whole
+    premise of shipping binary (acceptance: decode rate above the CSV
+    decoder's on the same data)."""
+    from dragonfly2_tpu.schema import native
+
+    if not native.available():
+        pytest.skip("native CSV decoder unavailable")
+    from dragonfly2_tpu.schema.columnar import write_csv
+
+    recs = synth.make_download_records(1500, seed=0)
+    csv_path = tmp_path / "d.csv"
+    write_csv(csv_path, recs)
+    bin_path = tmp_path / "d.dfb"
+    bin_path.write_bytes(wire.encode_train_block(recs))
+
+    def rate(fn, passes):
+        t0 = time.perf_counter()
+        n = 0
+        for _, _, n in fn(passes):
+            pass
+        return n / (time.perf_counter() - t0)
+
+    # warm both once (page cache + lazy init), then measure
+    for fn in (
+        lambda p: wire.stream_train_pairs(bin_path, passes=p, half=True),
+        lambda p: native.stream_pairs_file(csv_path, passes=p, half=True),
+    ):
+        for _ in fn(1):
+            pass
+    binary_rate = rate(lambda p: wire.stream_train_pairs(bin_path, passes=p, half=True), 8)
+    csv_rate = rate(lambda p: native.stream_pairs_file(csv_path, passes=p, half=True), 8)
+    assert binary_rate > csv_rate, (
+        f"binary decode {binary_rate:.0f} rec/s must beat csv {csv_rate:.0f} rec/s"
+    )

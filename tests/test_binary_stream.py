@@ -1,0 +1,539 @@
+"""Binary columnar train-stream, end to end: wire format round-trip,
+announcer → trainer service → ingest over real gRPC, bit-identical
+tensors vs the CSV path, and the CSV-fallback negotiation for old
+trainers (ISSUE round 6 tentpole)."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.rpc import gen  # noqa: F401
+import trainer_pb2  # noqa: E402
+
+import grpc
+
+from dragonfly2_tpu.rpc.glue import TRAINER_SERVICE, ServiceClient, dial, serve
+from dragonfly2_tpu.schema import synth, wire
+from dragonfly2_tpu.schema.columnar import records_to_columns, write_csv
+from dragonfly2_tpu.schema.features import extract_pair_features, extract_piece_sequences
+from dragonfly2_tpu.scheduler.announcer import Announcer
+from dragonfly2_tpu.scheduler.storage import Storage
+from dragonfly2_tpu.trainer.ingest import StreamStats, stream_shards
+from dragonfly2_tpu.trainer.service import TrainerService
+from dragonfly2_tpu.trainer.storage import TrainerStorage
+from dragonfly2_tpu.trainer.train import FitConfig, GNNFitConfig
+from dragonfly2_tpu.trainer.training import Training, TrainingConfig
+from dragonfly2_tpu.utils.idgen import host_id_v2
+
+
+class TestWireFormat:
+    def test_train_block_roundtrip_bit_identical(self):
+        recs = synth.make_download_records(40, seed=3)
+        cols = records_to_columns(recs)
+        pairs = extract_pair_features(cols)
+        seqs = extract_piece_sequences(cols)
+        header, dec, end = wire.decode_block(wire.encode_train_block(recs))
+        assert header["kind"] == wire.KIND_TRAIN
+        assert header["records"] == 40
+        np.testing.assert_array_equal(dec["pairs.features"], pairs.features)
+        np.testing.assert_array_equal(dec["pairs.labels"], pairs.labels)
+        np.testing.assert_array_equal(dec["pairs.download_index"], pairs.download_index)
+        np.testing.assert_array_equal(dec["gru.sequences"], seqs.sequences)
+        np.testing.assert_array_equal(dec["gru.labels"], seqs.labels)
+
+    def test_topology_block_roundtrip_all_columns(self):
+        recs = synth.make_topology_records(30, num_hosts=12, seed=4)
+        cols = records_to_columns(recs)
+        _, dec, _ = wire.decode_block(wire.encode_topology_block(recs))
+        assert set(dec) == set(cols)
+        for k in cols:  # dict/zero/raw encodings must all be lossless
+            np.testing.assert_array_equal(dec[k], cols[k], err_msg=k)
+
+    def test_concatenated_blocks_and_torn_tail(self, tmp_path):
+        blk = wire.encode_train_block(synth.make_download_records(10, seed=5))
+        p = tmp_path / "d.dfb"
+        p.write_bytes(blk + blk + blk[: len(blk) // 2])  # torn tail
+        spans = wire.scan_blocks(p)
+        assert len(spans) == 2  # the torn trailing block is ignored
+        assert wire.count_records(p) == 20
+        pairs = wire.read_train_pairs(p)
+        assert pairs.num_downloads == 20
+
+    def test_crc_mismatch_raises(self, tmp_path):
+        blk = bytearray(wire.encode_train_block(synth.make_download_records(5, seed=6)))
+        blk[-3] ^= 0xFF  # flip a payload byte
+        with pytest.raises(wire.WireError, match="crc"):
+            wire.decode_block(bytes(blk))
+
+    def test_bad_magic_raises(self, tmp_path):
+        p = tmp_path / "junk.dfb"
+        p.write_bytes(b"NOPE" + b"\x00" * 64)
+        with pytest.raises(wire.WireError, match="magic"):
+            wire.scan_blocks(p)
+
+    def test_split_block_spans_cover_exactly(self, tmp_path):
+        blk = wire.encode_train_block(synth.make_download_records(8, seed=7))
+        p = tmp_path / "d.dfb"
+        p.write_bytes(blk * 5)
+        spans = wire.split_block_spans([str(p)], target_span_bytes=len(blk))
+        assert [s[1] for s in spans] == [i * len(blk) for i in range(5)]
+        assert spans[-1][2] == 5 * len(blk)
+
+
+def _identical_records_both_formats(tmp_path, n=120, seed=11):
+    """The same records as a CSV file and a binary block file."""
+    recs = synth.make_download_records(n, seed=seed)
+    csv_path = tmp_path / "d.csv"
+    write_csv(csv_path, recs)
+    bin_path = tmp_path / "d.dfb"
+    bin_path.write_bytes(wire.encode_train_block(recs))
+    return recs, csv_path, bin_path
+
+
+class TestIngestEquivalence:
+    @pytest.mark.parametrize("half", [False, True])
+    def test_stream_shards_binary_matches_csv(self, tmp_path, half):
+        """The consumer-visible stream — (features, labels) — must be
+        bit-identical between payload formats, in both staging dtypes."""
+        pytest.importorskip("ctypes")
+        from dragonfly2_tpu.schema import native
+
+        if not native.available():
+            pytest.skip("native CSV decoder unavailable")
+        _, csv_path, bin_path = _identical_records_both_formats(tmp_path)
+
+        def collect(path, stats):
+            feats, labels, total = [], [], 0
+            for f, l, total in stream_shards(path, workers=2, half=half, stats=stats):
+                if f.shape[0]:
+                    feats.append(np.array(f))
+                    labels.append(np.array(l))
+            return np.concatenate(feats), np.concatenate(labels), total
+
+        s_bin, s_csv = StreamStats(), StreamStats()
+        bf, bl, brows = collect(bin_path, s_bin)
+        cf, cl, crows = collect(csv_path, s_csv)
+        assert brows == crows == 120
+        # worker interleaving may reorder shards; compare as sorted rows
+        order_b = np.lexsort(bf.T)
+        order_c = np.lexsort(cf.T)
+        np.testing.assert_array_equal(bf[order_b], cf[order_c])
+        np.testing.assert_array_equal(bl[order_b], cl[order_c])
+        assert bf.dtype == (np.float16 if half else np.float32)
+        # the stage split is being recorded on the binary path
+        assert s_bin.read_s > 0
+
+    def test_read_train_pairs_rebases_indices_across_blocks(self, tmp_path):
+        """Per-block download_index values are 0-based within their
+        block; the concatenated read must rebase them onto the running
+        record count (the 'row in the source batch' invariant)."""
+        recs = synth.make_download_records(20, seed=13)
+        p = tmp_path / "d.dfb"
+        p.write_bytes(
+            wire.encode_train_block(recs[:10]) + wire.encode_train_block(recs[10:])
+        )
+        merged = wire.read_train_pairs(p)
+        direct = extract_pair_features(records_to_columns(recs))
+        np.testing.assert_array_equal(merged.download_index, direct.download_index)
+        assert merged.num_downloads == 20
+
+    def test_batch_pairs_match(self, tmp_path):
+        recs, _, bin_path = _identical_records_both_formats(tmp_path, seed=12)
+        direct = extract_pair_features(records_to_columns(recs))
+        via_wire = wire.read_train_pairs(bin_path)
+        np.testing.assert_array_equal(via_wire.features, direct.features)
+        np.testing.assert_array_equal(via_wire.labels, direct.labels)
+        assert via_wire.num_downloads == direct.num_downloads == 120
+
+
+class RecordingManager:
+    def __init__(self):
+        self.models = {}
+
+    def create_model(self, model_id, model_type, ip, hostname, params, evaluation):
+        self.models[model_type] = {"params": params, "evaluation": evaluation}
+
+
+def _trainer_stack(tmp_path, name="trainer"):
+    manager = RecordingManager()
+    t_storage = TrainerStorage(tmp_path / name)
+    training = Training(
+        t_storage,
+        manager,
+        TrainingConfig(
+            mlp=FitConfig(hidden_dims=(16,), batch_size=128, epochs=3, seed=0),
+            gnn=GNNFitConfig(hidden_dims=(8,), batch_size=128, epochs=10, seed=0),
+            # keep the uploaded files around so the tests can assert
+            # WHICH payload format actually landed
+            clear_after_train=False,
+        ),
+    )
+    return manager, t_storage, TrainerService(t_storage, training, synchronous=True)
+
+
+def _scheduler_storage(tmp_path, name, n_dl=80, n_topo=200):
+    storage = Storage(tmp_path / name, buffer_size=16)
+    for r in synth.make_download_records(n_dl, seed=21):
+        storage.create_download(r)
+    for r in synth.make_topology_records(n_topo, num_hosts=16, seed=22):
+        storage.create_network_topology(r)
+    storage.flush()
+    return storage
+
+
+class TestAnnouncerRoundTrip:
+    def test_binary_negotiated_and_trains(self, tmp_path):
+        """New trainer: Capabilities advertises columnar-v1 → the
+        announcer ships block files → the trainer's binary ingest path
+        fits all three model families."""
+        manager, t_storage, service = _trainer_stack(tmp_path)
+        server, port = serve({TRAINER_SERVICE: service})
+        channel = dial(f"127.0.0.1:{port}")
+        try:
+            storage = _scheduler_storage(tmp_path, "sched")
+            ann = Announcer(
+                storage,
+                ip="10.9.9.9",
+                hostname="sched-bin",
+                trainer_channel=channel,
+                upload_chunk=1 << 14,  # small chunks: blocks split mid-payload
+            )
+            assert ann.negotiated_format() == wire.FORMAT_NAME
+            assert ann.train_once()
+            hid = host_id_v2("10.9.9.9", "sched-bin")
+            # the payload landed as block files, no CSV
+            assert t_storage.download_blocks_path(hid).exists()
+            assert not t_storage.download_path(hid).exists()
+            assert t_storage.network_topology_blocks_path(hid).exists()
+            assert set(manager.models) == {"mlp", "gnn", "gru"}
+            assert manager.models["mlp"]["evaluation"]["mse"] > 0
+        finally:
+            channel.close()
+            server.stop(0)
+
+    def test_old_trainer_falls_back_to_csv(self, tmp_path):
+        """Old trainer: Capabilities answers UNIMPLEMENTED (the RPC
+        didn't exist) → the announcer ships CSV and training still
+        completes — no peer is ever stranded by the format change."""
+        manager, t_storage, service = _trainer_stack(tmp_path)
+
+        class OldTrainer:
+            Train = service.Train
+
+            def Capabilities(self, request, context):
+                context.abort(grpc.StatusCode.UNIMPLEMENTED, "no such method")
+
+        server, port = serve({TRAINER_SERVICE: OldTrainer()})
+        channel = dial(f"127.0.0.1:{port}")
+        try:
+            storage = _scheduler_storage(tmp_path, "sched2")
+            ann = Announcer(
+                storage, ip="10.8.8.8", hostname="sched-old", trainer_channel=channel
+            )
+            assert ann.negotiated_format() == wire.CSV_FORMAT_NAME
+            assert ann.train_once()
+            hid = host_id_v2("10.8.8.8", "sched-old")
+            assert t_storage.download_path(hid).exists()
+            assert not t_storage.download_blocks_path(hid).exists()
+            assert set(manager.models) == {"mlp", "gnn", "gru"}
+        finally:
+            channel.close()
+            server.stop(0)
+
+    def test_blocks_off_era_ships_csv_superset(self, tmp_path):
+        """Records written by a previous process with write_blocks=False
+        exist only as CSV; after the toggle, the CSV files are a
+        SUPERSET of the blocks — shipping blocks would silently discard
+        the old era, so the round ships CSV even on a binary trainer."""
+        sched_dir = tmp_path / "sched"
+        old = Storage(sched_dir, buffer_size=16, write_blocks=False)
+        for r in synth.make_download_records(30, seed=80):
+            old.create_download(r)
+        old.flush()
+
+        # restart with the block sink ON, more records arrive
+        storage = Storage(sched_dir, buffer_size=16, write_blocks=True)
+        for r in synth.make_download_records(20, seed=81):
+            storage.create_download(r)
+        storage.flush()
+
+        manager, t_storage, service = _trainer_stack(tmp_path)
+        server, port = serve({TRAINER_SERVICE: service})
+        channel = dial(f"127.0.0.1:{port}")
+        try:
+            ann = Announcer(
+                storage, ip="10.6.6.6", hostname="sched-mix", trainer_channel=channel
+            )
+            assert ann.negotiated_format() == wire.FORMAT_NAME  # binary-capable
+            assert ann.train_once()
+            hid = host_id_v2("10.6.6.6", "sched-mix")
+            # CSV shipped (the superset): every record reached the trainer
+            assert len(t_storage.list_download(hid)) == 50
+            assert not t_storage.download_blocks_path(hid).exists()
+            # the next round (clean dual-sink history) ships binary again
+            for r in synth.make_download_records(10, seed=82):
+                storage.create_download(r)
+            storage.flush()
+            assert ann.train_once()
+            assert t_storage.download_blocks_path(hid).exists()
+        finally:
+            channel.close()
+            server.stop(0)
+
+    def test_binary_and_csv_train_to_identical_models(self, tmp_path):
+        """The equivalence that matters: the SAME records uploaded via
+        the binary payload and via the CSV fallback produce bit-identical
+        MLP parameters (same tensors + same deterministic fit)."""
+        results = {}
+        for mode in ("binary", "csv"):
+            manager, t_storage, service = _trainer_stack(tmp_path, f"trainer-{mode}")
+            if mode == "csv":
+                svc_impl = service
+
+                class CsvOnly:
+                    Train = svc_impl.Train
+
+                    def Capabilities(self, request, context):
+                        return trainer_pb2.CapabilitiesResponse(
+                            train_formats=[wire.CSV_FORMAT_NAME]
+                        )
+
+                impl = CsvOnly()
+            else:
+                impl = service
+            server, port = serve({TRAINER_SERVICE: impl})
+            channel = dial(f"127.0.0.1:{port}")
+            try:
+                storage = _scheduler_storage(tmp_path, f"sched-{mode}")
+                ann = Announcer(
+                    storage, ip="10.7.7.7", hostname="sched-eq", trainer_channel=channel
+                )
+                assert ann.train_once()
+            finally:
+                channel.close()
+                server.stop(0)
+            results[mode] = manager.models["mlp"]["params"]
+        flat_b = results["binary"]["layers"]
+        flat_c = results["csv"]["layers"]
+        for lb, lc in zip(flat_b, flat_c):
+            np.testing.assert_array_equal(np.asarray(lb["w"]), np.asarray(lc["w"]))
+            np.testing.assert_array_equal(np.asarray(lb["b"]), np.asarray(lc["b"]))
+
+
+class TestFormatSwitch:
+    def test_other_era_survives_clear_and_trains_next_round(self, tmp_path):
+        """A host whose scheduler switched payload formats holds BOTH a
+        CSV and a binary file: the round drains the OLDER (CSV) era and
+        clears ONLY it — the binary era survives and trains on the
+        following round instead of either era being destroyed or left
+        lingering forever."""
+        import csv as _csv
+        import io
+
+        from dragonfly2_tpu.schema import records as R
+
+        manager = RecordingManager()
+        t_storage = TrainerStorage(tmp_path / "t")
+        training = Training(
+            t_storage,
+            manager,
+            TrainingConfig(
+                mlp=FitConfig(hidden_dims=(8,), batch_size=64, epochs=2, seed=0),
+                min_topology_records=10**9,  # no topology uploaded here
+            ),
+        )
+        hid = host_id_v2("3.3.3.3", "s3")
+        # CSV era (40 records)
+        recs = synth.make_download_records(40, seed=40)
+        buf = io.StringIO()
+        w = _csv.DictWriter(buf, fieldnames=R.headers(R.DownloadRecord))
+        w.writeheader()
+        for r in recs:
+            w.writerow(R.flatten(r))
+        t_storage.append_download(hid, buf.getvalue().encode())
+        # binary era (25 records)
+        t_storage.append_download_blocks(
+            hid, wire.encode_train_block(synth.make_download_records(25, seed=41))
+        )
+        t_storage.mark_download_round(hid)
+
+        outcome = training.train("3.3.3.3", "s3")
+        assert outcome.mlp_error is None
+        # older (CSV) era consumed and cleared; binary era intact
+        assert not t_storage.download_path(hid).exists()
+        assert t_storage.download_blocks_path(hid).exists()
+        assert wire.count_records(t_storage.download_blocks_path(hid)) == 25
+        # next round trains the surviving binary era, then clears it
+        outcome2 = training.train("3.3.3.3", "s3")
+        assert outcome2.mlp_error is None
+        assert not t_storage.download_blocks_path(hid).exists()
+
+    def test_gnn_merges_both_topology_eras(self, tmp_path, monkeypatch):
+        """The probe graph is cumulative: after a format switch the GNN
+        leg must build from the CSV era AND the binary era."""
+        import csv as _csv
+        import io
+
+        import dragonfly2_tpu.trainer.training as training_mod
+        from dragonfly2_tpu.schema import records as R
+
+        t_storage = TrainerStorage(tmp_path / "t")
+        hid = host_id_v2("4.4.4.4", "s4")
+        era_a = synth.make_topology_records(30, num_hosts=8, seed=50)
+        era_b = synth.make_topology_records(30, num_hosts=8, seed=51)
+        s = io.StringIO()
+        w = _csv.DictWriter(s, fieldnames=R.headers(R.NetworkTopologyRecord))
+        w.writeheader()
+        for r in era_a:
+            w.writerow(R.flatten(r))
+        t_storage.append_network_topology(hid, s.getvalue().encode())
+        t_storage.append_network_topology_blocks(hid, wire.encode_topology_block(era_b))
+        t_storage.mark_download_round(hid)
+
+        captured = {}
+
+        def fake_train_gnn(graph, mesh=None, config=None):
+            captured["records"] = graph.num_records
+            captured["nodes"] = set(graph.node_ids)
+
+            class Result:
+                params = {}
+                metrics = {"f1": 1.0}
+
+            return Result()
+
+        monkeypatch.setattr(training_mod, "train_gnn", fake_train_gnn)
+        training = Training(t_storage, None, TrainingConfig())
+        metrics = training._train_gnn(hid, "4.4.4.4", "s4")
+        assert metrics == {"f1": 1.0}
+        assert captured["records"] == 60
+        from dragonfly2_tpu.schema.features import build_probe_graph
+
+        expected = build_probe_graph(records_to_columns(era_a + era_b))
+        assert captured["nodes"] == set(expected.node_ids)
+
+
+class TestTornStreamRecovery:
+    def test_failed_stream_truncates_partial_round(self, tmp_path):
+        manager, t_storage, service = _trainer_stack(tmp_path)
+        hid = host_id_v2("1.1.1.1", "s")
+        blk = wire.encode_train_block(synth.make_download_records(6, seed=30))
+
+        def broken_stream():
+            yield trainer_pb2.TrainRequest(
+                ip="1.1.1.1",
+                hostname="s",
+                train_mlp_binary=trainer_pb2.TrainMlpBinaryRequest(
+                    dataset=blk[: len(blk) // 2]
+                ),
+            )
+            raise RuntimeError("upload died mid-chunk")
+
+        with pytest.raises(RuntimeError):
+            service.Train(broken_stream(), None)
+        # the torn half-block was dropped — the file is gone (no prior round)
+        assert not t_storage.download_blocks_path(hid).exists()
+
+        # a complete round after a failed one decodes cleanly
+        def good_stream():
+            yield trainer_pb2.TrainRequest(
+                ip="1.1.1.1",
+                hostname="s",
+                train_mlp_binary=trainer_pb2.TrainMlpBinaryRequest(dataset=blk),
+            )
+
+        service.Train(good_stream(), None)
+        assert wire.count_records(t_storage.download_blocks_path(hid)) == 6
+
+    def test_restart_then_failed_stream_keeps_prior_rounds(self, tmp_path):
+        """Round boundaries are PERSISTED: a trainer restart followed by
+        one failed upload must not destroy previously-accumulated
+        complete rounds (the in-memory-only boundary map would have
+        truncated everything to zero)."""
+        manager, t_storage, service = _trainer_stack(tmp_path)
+        hid = host_id_v2("2.2.2.2", "s2")
+        blk = wire.encode_train_block(synth.make_download_records(6, seed=31))
+
+        def good_stream():
+            yield trainer_pb2.TrainRequest(
+                ip="2.2.2.2",
+                hostname="s2",
+                train_mlp_binary=trainer_pb2.TrainMlpBinaryRequest(dataset=blk),
+            )
+
+        service.Train(good_stream(), None)
+
+        # "restart": a fresh storage over the same directory, empty RAM state
+        restarted = TrainerStorage(t_storage.dir)
+        assert restarted.download_round_boundary(hid, binary=True) == len(blk)
+
+        def broken_stream():
+            yield trainer_pb2.TrainRequest(
+                ip="2.2.2.2",
+                hostname="s2",
+                train_mlp_binary=trainer_pb2.TrainMlpBinaryRequest(
+                    dataset=blk[: len(blk) // 3]
+                ),
+            )
+            raise RuntimeError("died")
+
+        service2 = TrainerService(restarted, service.training, synchronous=True)
+        with pytest.raises(RuntimeError):
+            service2.Train(broken_stream(), None)
+        # the prior complete round survived; only the torn tail is gone
+        assert wire.count_records(restarted.download_blocks_path(hid)) == 6
+
+    def test_crashed_process_torn_tail_healed_on_next_append(self, tmp_path):
+        """A trainer KILLED mid-stream never runs the in-process
+        truncation — the next process's first append must heal the torn
+        tail, or the retry's complete blocks land after it and the file
+        is poisoned forever."""
+        storage = TrainerStorage(tmp_path)
+        hid = host_id_v2("5.5.5.5", "s5")
+        blk = wire.encode_train_block(synth.make_download_records(7, seed=60))
+        # simulate the dead process's half-written file directly on disk
+        storage.download_blocks_path(hid).write_bytes(blk + blk[: len(blk) // 2])
+
+        # "restarted" trainer appends the announcer's retry
+        fresh = TrainerStorage(tmp_path)
+        fresh.append_download_blocks(hid, blk)
+        assert wire.count_records(fresh.download_blocks_path(hid)) == 14
+        pairs = wire.read_train_pairs(fresh.download_blocks_path(hid))
+        assert pairs.num_downloads == 14
+
+    def test_subminimum_csv_tail_falls_through_to_binary(self, tmp_path):
+        """A CSV-era leftover below min_download_records must not
+        deadlock the MLP leg forever: the round falls through to the
+        binary era and the sub-minimum tail is dropped with the clear."""
+        import csv as _csv
+        import io
+
+        from dragonfly2_tpu.schema import records as R
+
+        manager = RecordingManager()
+        t_storage = TrainerStorage(tmp_path / "t")
+        training = Training(
+            t_storage,
+            manager,
+            TrainingConfig(
+                mlp=FitConfig(hidden_dims=(8,), batch_size=64, epochs=2, seed=0),
+                min_download_records=10,
+                min_topology_records=10**9,
+            ),
+        )
+        hid = host_id_v2("6.6.6.6", "s6")
+        s = io.StringIO()
+        w = _csv.DictWriter(s, fieldnames=R.headers(R.DownloadRecord))
+        w.writeheader()
+        for r in synth.make_download_records(3, seed=61):  # below min=10
+            w.writerow(R.flatten(r))
+        t_storage.append_download(hid, s.getvalue().encode())
+        t_storage.append_download_blocks(
+            hid, wire.encode_train_block(synth.make_download_records(25, seed=62))
+        )
+        t_storage.mark_download_round(hid)
+
+        outcome = training.train("6.6.6.6", "s6")
+        assert outcome.mlp_error is None  # binary era trained
+        # both forms cleared: the binary was consumed, the tail dropped
+        assert not t_storage.download_path(hid).exists()
+        assert not t_storage.download_blocks_path(hid).exists()

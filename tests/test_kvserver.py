@@ -192,3 +192,90 @@ class TestTopologyOverRESP:
 def test_connect_backend_selection():
     assert isinstance(connect(""), KVStore)
     assert isinstance(connect("127.0.0.1:6379"), RemoteKVStore)
+
+
+class TestAuth:
+    """RESP AUTH gating (requirepass semantics): a configured secret
+    must lock every data command behind authentication, for raw clients
+    and RemoteKVStore alike (ADVICE r5 hardening)."""
+
+    @pytest.fixture
+    def secured(self):
+        srv = KVServer(host="127.0.0.1", secret="hunter2")
+        srv.serve()
+        yield srv
+        srv.stop()
+
+    def _raw(self, port: int, *commands: bytes) -> list:
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+            out = []
+            for c in commands:
+                s.sendall(c)
+                out.append(s.recv(4096))
+            return out
+
+    def test_unauthenticated_commands_rejected(self, secured):
+        replies = self._raw(
+            secured.port, b"*1\r\n$4\r\nPING\r\n", b"*2\r\n$3\r\nGET\r\n$1\r\nk\r\n"
+        )
+        assert all(r.startswith(b"-NOAUTH") for r in replies)
+
+    def test_wrong_password_rejected_then_correct_accepted(self, secured):
+        replies = self._raw(
+            secured.port,
+            b"*2\r\n$4\r\nAUTH\r\n$5\r\nwrong\r\n",
+            b"*2\r\n$4\r\nAUTH\r\n$7\r\nhunter2\r\n",
+            b"*1\r\n$4\r\nPING\r\n",
+        )
+        assert replies[0].startswith(b"-ERR invalid password")
+        assert replies[1] == b"+OK\r\n"
+        assert replies[2] == b"+PONG\r\n"
+
+    def test_two_arg_auth_requires_default_user(self, secured):
+        replies = self._raw(
+            secured.port,
+            b"*3\r\n$4\r\nAUTH\r\n$5\r\nadmin\r\n$7\r\nhunter2\r\n",
+            b"*3\r\n$4\r\nAUTH\r\n$7\r\ndefault\r\n$7\r\nhunter2\r\n",
+            b"*1\r\n$4\r\nPING\r\n",
+        )
+        assert replies[0].startswith(b"-ERR")
+        assert replies[1] == b"+OK\r\n"
+        assert replies[2] == b"+PONG\r\n"
+
+    def test_auth_without_secret_is_error_but_connection_stays_open(self, served):
+        srv, kv = served
+        replies = self._raw(
+            srv.port, b"*2\r\n$4\r\nAUTH\r\n$2\r\npw\r\n", b"*1\r\n$4\r\nPING\r\n"
+        )
+        assert replies[0].startswith(b"-ERR")
+        assert replies[1] == b"+PONG\r\n"  # open server stays usable
+
+    def test_remote_kvstore_authenticates(self, secured):
+        kv = RemoteKVStore(f"127.0.0.1:{secured.port}", secret="hunter2")
+        kv.set("k", "v")
+        assert kv.get("k") == "v"
+        kv.close()
+        # reconnect after close re-authenticates transparently
+        assert kv.get("k") == "v"
+        kv.close()
+
+    def test_remote_kvstore_wrong_secret_raises(self, secured):
+        kv = RemoteKVStore(f"127.0.0.1:{secured.port}", secret="nope")
+        with pytest.raises(ValueError, match="invalid password"):
+            kv.get("k")
+        kv.close()
+
+    def test_remote_kvstore_no_secret_gets_noauth(self, secured):
+        kv = RemoteKVStore(f"127.0.0.1:{secured.port}")
+        with pytest.raises(ValueError, match="NOAUTH"):
+            kv.set("k", "v")
+        kv.close()
+
+    def test_connect_passes_secret(self, secured):
+        kv = connect(f"127.0.0.1:{secured.port}", secret="hunter2")
+        assert kv.incr("c") == 1
+        kv.close()
+
+    def test_default_bind_is_loopback(self):
+        srv = KVServer()
+        assert srv._host == "127.0.0.1"

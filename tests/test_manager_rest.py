@@ -1205,3 +1205,80 @@ def test_composite_clusters_and_v1_preheat(rest):
     status, got = call(addr, "GET", f"/preheats/{ph['id']}")
     assert status == 200 and got["status"] in ("queued", "running")
     assert call(addr, "GET", "/_ping", token=None)[0] == 200
+
+
+def test_pat_metadata_restricted_to_admin_or_owner(tmp_path):
+    """Token metadata is a credential inventory (ISSUE r6): the
+    top-level PAT routes are admin-only, and the per-user list is
+    readable only by an admin or the user it belongs to."""
+    db = Database(tmp_path / "pat.db")
+    models = ModelRegistry(db, FSObjectStorage(tmp_path / "obj"))
+    server = RestServer(ManagerService(db, models))
+    addr = server.start()
+    try:
+        # bootstrap an admin + two guests, each with a PAT
+        status, _ = call(
+            addr, "POST", "/api/v1/users",
+            {"name": "root", "password": "pw", "role": "admin"}, token=None,
+        )
+        assert status == 200
+        status, session = call(
+            addr, "POST", "/api/v1/users/signin",
+            {"name": "root", "password": "pw"}, token=None,
+        )
+        admin_tok = session["token"]
+        users, toks = {}, {}
+        for name in ("alice", "bob"):
+            status, u = call(
+                addr, "POST", "/api/v1/users",
+                {"name": name, "password": "pw", "role": "guest"}, token=admin_tok,
+            )
+            assert status == 200
+            users[name] = u["id"]
+            status, pat = call(
+                addr, "POST", f"/api/v1/users/{u['id']}/personal-access-tokens",
+                {"name": f"{name}-tok"}, token=admin_tok,
+            )
+            assert status == 200
+            toks[name] = pat["token"]
+
+        # top-level inventory: admin yes, guest no
+        status, body = call(addr, "GET", "/api/v1/personal-access-tokens", token=admin_tok)
+        assert status == 200 and len(body) >= 3
+        status, _ = call(addr, "GET", "/api/v1/personal-access-tokens", token=toks["alice"])
+        assert status == 403
+        # single token: admin yes; guests can't read others' (or even
+        # probe ids — 403, not 404)
+        some_id = body[0]["id"]
+        status, row = call(
+            addr, "GET", f"/api/v1/personal-access-tokens/{some_id}", token=admin_tok
+        )
+        assert status == 200 and "token_hash" not in row
+        status, _ = call(
+            addr, "GET", f"/api/v1/personal-access-tokens/{some_id}", token=toks["alice"]
+        )
+        assert status == 403
+        status, _ = call(
+            addr, "GET", "/api/v1/personal-access-tokens/999999", token=toks["alice"]
+        )
+        assert status == 403  # non-existent id leaks nothing to guests
+
+        # per-user list: owner yes, other guest no, admin yes
+        status, mine = call(
+            addr, "GET", f"/api/v1/users/{users['alice']}/personal-access-tokens",
+            token=toks["alice"],
+        )
+        assert status == 200 and all(r["user_id"] == users["alice"] for r in mine)
+        status, _ = call(
+            addr, "GET", f"/api/v1/users/{users['alice']}/personal-access-tokens",
+            token=toks["bob"],
+        )
+        assert status == 403
+        status, _ = call(
+            addr, "GET", f"/api/v1/users/{users['alice']}/personal-access-tokens",
+            token=admin_tok,
+        )
+        assert status == 200
+    finally:
+        server.stop()
+        db.close()

@@ -151,9 +151,9 @@ def test_training_uses_native(tmp_path, monkeypatch):
     called = {}
     orig = native.decode_pairs_file
 
-    def spy(path, offset=0):
+    def spy(path, offset=0, end=None):
         called["path"] = str(path)
-        return orig(path, offset=offset)
+        return orig(path, offset=offset, end=end)
 
     monkeypatch.setattr(native, "decode_pairs_file", spy)
     training = Training(

@@ -99,3 +99,23 @@ class TestPinger:
             assert rtt is not None and rtt < 1.0
         finally:
             srv.close()
+
+
+class TestProbeSocketLifecycle:
+    def test_availability_recheck_closes_probe_socket(self, monkeypatch):
+        """The ICMP availability re-check opens a socket purely to learn
+        whether one CAN be opened — it must close it, not leak the fd
+        for the daemon's lifetime (ISSUE r6: utils/ping.py fd leak)."""
+        closed = []
+
+        class FakeSock:
+            def close(self):
+                closed.append(True)
+
+        monkeypatch.setattr(P, "icmp_ping", lambda addr, timeout=1.0: None)
+        monkeypatch.setattr(P, "_open_icmp_socket", lambda: (FakeSock(), True))
+        pinger = P.Pinger(min_interval=0.0)
+        pinger.rtt("10.3.3.1", fallback=lambda a: 0.01)
+        assert closed == [True]
+        # and availability was learned as True (a socket WAS grantable)
+        assert pinger._icmp_available is True

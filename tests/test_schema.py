@@ -12,7 +12,7 @@ from dragonfly2_tpu.schema import (
 from dragonfly2_tpu.schema import records as R
 from dragonfly2_tpu.schema import synth
 from dragonfly2_tpu.schema.columnar import (
-    BlockWriter,
+    RotatingBlockWriter,
     RotatingCSVWriter,
     concat_columns,
     load_block,
@@ -96,15 +96,23 @@ class TestColumnar:
         assert set(loaded) == set(cols)
         np.testing.assert_array_equal(loaded["task.total_piece_count"], cols["task.total_piece_count"])
 
-    def test_block_writer_splits(self, tmp_path):
+    def test_rotating_block_writer_roundtrip(self, tmp_path):
+        from dragonfly2_tpu.schema import wire
+
         recs = synth.make_topology_records(25, num_hosts=16, seed=6)
-        w = BlockWriter(tmp_path, "nt", rows_per_block=10)
-        w.append_columns(records_to_columns(recs))
-        w.flush()
-        paths = w.block_paths()
-        assert len(paths) == 3  # 10 + 10 + 5
-        allcols = w.read_all()
-        assert num_rows(allcols) == 25
+        w = RotatingBlockWriter(
+            tmp_path, "nt", wire.encode_topology_block, buffer_size=10
+        )
+        for r in recs:  # one at a time: auto-flush at 10 and 20
+            w.create(r)
+        w.flush()  # the trailing 5
+        spans = wire.scan_blocks(w.active_path)
+        assert [s.records for s in spans] == [10, 10, 5]
+        cols = wire.read_columns(w.active_path, kind=wire.KIND_TOPOLOGY)
+        assert num_rows(cols) == 25
+        np.testing.assert_array_equal(
+            cols["id"], records_to_columns(recs)["id"]
+        )
 
     def test_concat(self):
         a = records_to_columns(synth.make_download_records(2, seed=7))
