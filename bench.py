@@ -158,6 +158,44 @@ def _phase(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def topology_bench(hosts: int = 64, probes: int = 2048, queries: int = 1024) -> dict:
+    """Topology-engine soak: probe deltas through flush + est_rtt
+    queries against the resident adjacency (scheduler-side path, no
+    device-train dependency — runs on whatever backend the engine
+    picks).
+
+    - ``topology_flush_rate``: probe deltas applied to the device
+      adjacency per second (drain + EWMA fold + CSR build + kernels).
+    - ``topology_query_p50``: median est_rtt latency in ms over a mixed
+      direct/inferred/cached query load.
+    """
+    import random
+
+    from dragonfly2_tpu.topology import TopologyConfig, TopologyEngine
+
+    rng = random.Random(0)
+    eng = TopologyEngine(TopologyConfig(flush_threshold=10**9))
+    ids = [f"bench-host-{i}" for i in range(hosts)]
+    # sparse probe plane: each host probes a handful of peers, like the
+    # production DEFAULT_PROBE_COUNT=5 sync rounds
+    pairs = [(s, d) for s in ids for d in rng.sample(ids, 6) if s != d]
+    t0 = time.perf_counter()
+    applied = 0
+    for i in range(probes):
+        s, d = pairs[i % len(pairs)]
+        eng.enqueue(s, d, rtt_ns=rng.randrange(1_000_000, 80_000_000))
+        if i % 256 == 255:
+            applied += eng.flush()
+    applied += eng.flush()
+    flush_rate = applied / (time.perf_counter() - t0)
+    for _ in range(queries):
+        eng.est_rtt_ns(rng.choice(ids), rng.choice(ids))
+    return {
+        "topology_flush_rate": round(flush_rate, 1),
+        "topology_query_p50": eng.query_p50_ms(),
+    }
+
+
 def main() -> None:
     if os.environ.get("DF_BENCH_CPU_FALLBACK"):
         # the sitecustomize pins the axon platform at interpreter start;
@@ -299,6 +337,18 @@ def main() -> None:
         for _, _, nrec in stream_shards(bpaths[0], passes=8, workers=workers, half=True):
             pass
         host_rates["stream_only_rate"] = round(nrec / (time.perf_counter() - t0), 1)
+        # topology-engine soak rides in host_rates so every exit path
+        # (success, warmup failure, watchdog snapshot) carries it
+        try:
+            host_rates.update(topology_bench())
+            _phase(
+                f"topology: flush {host_rates['topology_flush_rate'] / 1e3:.1f}k deltas/s,"
+                f" query p50 {host_rates['topology_query_p50']:.3f}ms"
+            )
+        except Exception as e:
+            # the headline metric must survive a topology-bench failure
+            host_rates["topology_error"] = str(e)
+            _phase(f"topology bench failed: {e}")
         _phase(
             f"host split: decode(binary) {decode_only_rate_binary / 1e3:.1f}k/s,"
             f" decode(csv) {host_rates.get('decode_only_rate_csv', 0) / 1e3:.1f}k/s,"

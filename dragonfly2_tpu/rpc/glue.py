@@ -23,12 +23,14 @@ import dfdaemon_pb2  # noqa: E402
 import manager_pb2  # noqa: E402
 import scheduler_pb2  # noqa: E402
 import scheduler_v1_pb2  # noqa: E402
+import topology_pb2  # noqa: E402
 import trainer_pb2  # noqa: E402
 
 # Canonical service names — every client/server refers to these, so a
 # rename can never leave a client dialing a service no server registers.
 SCHEDULER_SERVICE = "dragonfly2_tpu.scheduler.Scheduler"
 SCHEDULER_V1_SERVICE = "dragonfly2_tpu.scheduler.v1.SchedulerV1"
+TOPOLOGY_SERVICE = "dragonfly2_tpu.topology.Topology"
 TRAINER_SERVICE = "dragonfly2_tpu.trainer.Trainer"
 MANAGER_SERVICE = "dragonfly2_tpu.manager.Manager"
 DFDAEMON_SERVICE = "dragonfly2_tpu.dfdaemon.Dfdaemon"
@@ -91,6 +93,15 @@ SERVICES: dict[str, dict[str, Method]] = {
             scheduler_v1_pb2.SyncProbesRequest,
             scheduler_v1_pb2.SyncProbesResponse,
         ),
+    },
+    TOPOLOGY_SERVICE: {
+        "EstRtt": Method(
+            UNARY, topology_pb2.EstRttRequest, topology_pb2.EstRttResponse
+        ),
+        "Neighbors": Method(
+            UNARY, topology_pb2.NeighborsRequest, topology_pb2.NeighborsResponse
+        ),
+        "Stats": Method(UNARY, topology_pb2.StatsRequest, topology_pb2.StatsResponse),
     },
     TRAINER_SERVICE: {
         "Train": Method(STREAM_UNARY, trainer_pb2.TrainRequest, trainer_pb2.TrainResponse),

@@ -19,6 +19,9 @@ from typing import Protocol
 
 import numpy as np
 
+from dragonfly2_tpu.schema.features import (
+    location_affinity as offline_location_affinity,
+)
 from dragonfly2_tpu.utils import dflog
 
 logger = dflog.get("scheduler.evaluator")
@@ -192,9 +195,10 @@ class MLEvaluator(BaseEvaluator):
     # invalidated naturally by the piece count changing)
     GRU_CACHE_MAX = 4096
 
-    def __init__(self, model=None, gru=None):
+    def __init__(self, model=None, gru=None, topology=None):
         self._model = model  # ml.scorer.MLPScorer-compatible
         self._gru = gru  # trainer.serving.GRUScorer-compatible
+        self._topology = topology  # topology.TopologyEngine-compatible
         # peer.id -> (piece_count, verdict): is_bad_node runs once per
         # candidate per scheduling attempt (per piece event), and a jit
         # dispatch per call would multiply hot-path latency — the verdict
@@ -205,6 +209,21 @@ class MLEvaluator(BaseEvaluator):
     def set_gru(self, gru) -> None:
         self._gru = gru
         self._gru_verdicts.clear()
+
+    def set_topology(self, topology) -> None:
+        self._topology = topology
+
+    def _rtt_affinity(self, parent: Peer, child: Peer) -> float:
+        """Topology-engine rtt_affinity for the pair, never fatal: an
+        engine hiccup degrades the feature to its missing-value, not
+        the schedule."""
+        if self._topology is None:
+            return 0.0
+        try:
+            return self._topology.rtt_affinity(child.host.id, parent.host.id)
+        except Exception:
+            logger.warning("topology rtt_affinity failed", exc_info=True)
+            return 0.0
 
     def is_bad_node(self, peer: Peer) -> bool:
         if self._gru is None:
@@ -260,7 +279,12 @@ class MLEvaluator(BaseEvaluator):
             return super().evaluate_parents(parents, child, total_piece_count)
         try:
             feats = np.stack(
-                [pair_features(p, child, total_piece_count) for p in parents]
+                [
+                    pair_features(
+                        p, child, total_piece_count, self._rtt_affinity(p, child)
+                    )
+                    for p in parents
+                ]
             )
             costs = self._model.predict(feats)  # [P] predicted log piece cost
             order = np.argsort(costs, kind="stable")
@@ -274,10 +298,15 @@ class MLEvaluator(BaseEvaluator):
             return super().evaluate_parents(parents, child, total_piece_count)
 
 
-def pair_features(parent: Peer, child: Peer, total_piece_count: int) -> np.ndarray:
+def pair_features(
+    parent: Peer, child: Peer, total_piece_count: int, rtt_affinity: float = 0.0
+) -> np.ndarray:
     """Live (child, parent) features in schema.features.MLP_FEATURE_NAMES
     order — must stay in lockstep with the offline extraction the model was
-    trained on (schema/features.py)."""
+    trained on (schema/features.py). ``rtt_affinity`` is the topology
+    engine's estimate for the child→parent pair (TopologyEngine.
+    rtt_affinity); the 0.0 default is the schema's missing-value, which
+    is also what offline extraction emits."""
     h = parent.host
     uploads, failed = h.upload_count, h.upload_failed_count
     child_idc, parent_idc = child.host.network.idc, h.network.idc
@@ -286,10 +315,6 @@ def pair_features(parent: Peer, child: Peer, total_piece_count: int) -> np.ndarr
     # (the offline training regime): upload_success uses max(uploads, 1)
     # (fresh host → 0.0) and idc/location compare case-SENSITIVELY —
     # unlike the BaseEvaluator's hand-tuned score above.
-    from dragonfly2_tpu.schema.features import (
-        location_affinity as offline_location_affinity,
-    )
-
     loc_aff = float(
         offline_location_affinity(np.array([child_loc]), np.array([parent_loc]))[0]
     )
@@ -315,6 +340,7 @@ def pair_features(parent: Peer, child: Peer, total_piece_count: int) -> np.ndarr
             child.host.cpu.percent / 100.0,
             child.host.memory.used_percent / 100.0,
             math.log1p(max(child.task.content_length, 0)) / 30.0,
+            rtt_affinity,
         ],
         dtype=np.float32,
     )

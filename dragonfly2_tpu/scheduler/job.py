@@ -171,7 +171,9 @@ class JobWorker:
     def _recommend_seeds(self, args: dict) -> tuple[str, dict]:
         """Rank hosts as seed-peer candidates by GNN-predicted fleet RTT
         (SURVEY §7 stage 6; seed_placement.py). Uses the active gnn
-        model's weights from the manager registry."""
+        model's weights from the manager registry; with no active model
+        the topology engine's landmark-inferred RTT centrality ranks
+        instead (model-free, live the moment probes flow)."""
         if self.networktopology is None:
             return "failed", {"error": "scheduler has no network topology"}
         if self.manager is None:
@@ -181,6 +183,17 @@ class JobWorker:
         ).models
         active = [m for m in models if m.state == "active" and m.type == "gnn"]
         if not active:
+            engine = getattr(self.networktopology, "engine", None)
+            if engine is not None:
+                from dragonfly2_tpu.scheduler.seed_placement import (
+                    recommend_seeds_by_rtt,
+                )
+
+                ranking = recommend_seeds_by_rtt(
+                    engine, k=int(args.get("k", 3)), candidates=args.get("candidates")
+                )
+                if ranking:
+                    return "succeeded", {"model": "topology-rtt", "ranking": ranking}
             return "failed", {"error": "no active gnn model"}
         newest = max(active, key=lambda m: (m.updated_at_ns, m.version))
         blob = self.manager.GetModelWeights(

@@ -9,9 +9,13 @@ scheduler/networktopology/network_topology.go:52-436, probes.go:37-383):
 
 EWMA: averageRTT = 0.1·old + 0.9·new (old-average weight 0.1 — nearly
 last-sample; reference probes.go:195-196). ``find_probed_hosts`` picks ≤50
-random candidate hosts and returns the 5 least-probed. ``snapshot`` walks
-the store and appends NetworkTopologyRecord rows to scheduler storage
-every collect interval (default 2h).
+random candidate hosts and returns the 5 least-probed. ``snapshot`` appends
+NetworkTopologyRecord rows to scheduler storage every collect interval
+(default 2h) — from the device-resident adjacency when a
+``topology.TopologyEngine`` is attached (the KV store stays the durable
+multi-scheduler truth; the engine is its live computational replica and
+the export source, so snapshots stop re-walking KV), falling back to the
+KV walk otherwise.
 """
 
 from __future__ import annotations
@@ -59,6 +63,7 @@ class NetworkTopology:
         queue_length: int = DEFAULT_PROBE_QUEUE_LENGTH,
         probe_count: int = DEFAULT_PROBE_COUNT,
         candidate_hosts: int = DEFAULT_CANDIDATE_HOSTS,
+        engine=None,  # topology.TopologyEngine | None
     ):
         self.kv = kv
         self.host_manager = host_manager
@@ -66,6 +71,7 @@ class NetworkTopology:
         self.queue_length = queue_length
         self.probe_count = probe_count
         self.candidate_hosts = candidate_hosts
+        self.engine = engine
 
     # -- probe ingestion (SyncProbes server side) -------------------------
     def has_edge(self, src: str, dest: str) -> bool:
@@ -114,6 +120,11 @@ class NetworkTopology:
             {"averageRTT": avg, "updatedAt": int(probe.created_at * NS_PER_S)},
         )
         self.kv.incr(make_probed_count_key(dest))
+        if self.engine is not None:
+            # mirror into the device adjacency through the batching
+            # delta queue — same raw sample, same EWMA fold, applied at
+            # the next flush instead of per-RPC
+            self.engine.enqueue(src, dest, probe.rtt_ns, probe.created_at)
 
     def average_rtt(self, src: str, dest: str) -> int | None:
         v = self.kv.hget(make_network_topology_key(src, dest), "averageRTT")
@@ -131,13 +142,25 @@ class NetworkTopology:
     # -- probe target selection ------------------------------------------
     def find_probed_hosts(self, src_host_id: str) -> list[Host]:
         """≤candidate_hosts random hosts (excluding src) → the probe_count
-        least-probed (reference network_topology.go:183-250)."""
+        least-probed (reference network_topology.go:183-250).
+
+        The probed-count reads are batched: against the RESP backend a
+        per-key ``get`` costs one network round-trip each — up to 50 per
+        sync round — so a single ``mget`` fetches them all; the
+        in-process store (no wire, no ``mget`` needed) keeps the plain
+        per-key path."""
         hosts = [h for h in self.host_manager.all() if h.id != src_host_id]
         if not hosts:
             return []
         if len(hosts) > self.candidate_hosts:
             hosts = random.sample(hosts, self.candidate_hosts)
-        hosts.sort(key=lambda h: self.probed_count(h.id))
+        mget = getattr(self.kv, "mget", None)
+        if mget is not None:
+            counts = mget([make_probed_count_key(h.id) for h in hosts])
+            by_id = {h.id: int(c or 0) for h, c in zip(hosts, counts)}
+            hosts.sort(key=lambda h: by_id[h.id])
+        else:
+            hosts.sort(key=lambda h: self.probed_count(h.id))
         return hosts[: self.probe_count]
 
     # -- lifecycle --------------------------------------------------------
@@ -153,18 +176,71 @@ class NetworkTopology:
         )
         if keys:
             self.kv.delete(*keys)
+        if self.engine is not None:
+            self.engine.delete_host(host_id)
+
+    def _edge_field_batch(self, src: str, dests: list[str], field: str) -> list:
+        """One edge-hash field per (src, dest) — pipelined on the RESP
+        backend (one round-trip batch), per-key on in-process stores
+        (no wire to amortize)."""
+        keys = [make_network_topology_key(src, d) for d in dests]
+        hget_batch = getattr(self.kv, "hget_batch", None)
+        if hget_batch is not None:
+            return hget_batch(keys, field)
+        return [self.kv.hget(k, field) for k in keys]
+
+    def _edge_updated_at(self, src: str, dests: list[str]) -> list[int]:
+        return [int(v or 0) for v in self._edge_field_batch(src, dests, "updatedAt")]
+
+    def hydrate_engine(self) -> int:
+        """Adopt the KV graph's edges into the device adjacency —
+        restart recovery plus the merge path for edges probed via peer
+        schedulers sharing the KV store (their raw probes never pass
+        through this process's ``enqueue_probe``). Newer engine-local
+        state wins per edge. Returns edges adopted."""
+        if self.engine is None:
+            return 0
+        adopted = 0
+        by_src: dict[str, list[str]] = {}
+        for key in self.kv.scan_iter("networktopology:*:*"):
+            _, src, dest = key.split(":", 2)
+            by_src.setdefault(src, []).append(dest)
+        for src, dests in by_src.items():
+            avgs = self._edge_field_batch(src, dests, "averageRTT")
+            updates = self._edge_field_batch(src, dests, "updatedAt")
+            for dest, avg, upd in zip(dests, avgs, updates):
+                if avg is None:
+                    continue
+                if self.engine.adopt(
+                    src, dest, int(avg), int(upd or 0) / NS_PER_S
+                ):
+                    adopted += 1
+        return adopted
 
     # -- snapshot (training-data export) ----------------------------------
     def export_records(self, dest_limit: int = R.MAX_DEST_HOSTS) -> list:
-        """Walk the live probe graph into NetworkTopologyRecord rows (one
-        per source host, up to ``dest_limit`` dest hosts each) — the
-        snapshot sink and the seed-placement advisor both consume this.
+        """Live probe graph → NetworkTopologyRecord rows (one per source
+        host, up to ``dest_limit`` dest hosts each) — the snapshot sink
+        and the seed-placement advisor both consume this. With a
+        topology engine attached the rows come straight from the
+        device-resident adjacency (no KV walk); otherwise the KV store
+        is scanned.
 
         ``dest_limit`` is clamped to the record schema's fixed group
         width: the columnar flatten pads/truncates ``dest_hosts`` to
         MAX_DEST_HOSTS, so a larger limit would be silently dropped
-        downstream rather than widening coverage."""
+        downstream rather than widening coverage. Either path keeps the
+        most-recently-updated edges when truncating, so the training
+        snapshot carries fresh measurements instead of whatever key
+        sorted first."""
         dest_limit = min(dest_limit, R.MAX_DEST_HOSTS)
+        if self.engine is not None:
+            # merge KV state first: the engine only mirrors THIS
+            # process's probes, but the shared KV carries edges from
+            # peer schedulers and from before a restart — without the
+            # merge those would silently vanish from every snapshot
+            self.hydrate_engine()
+            return self.engine.export_records(self.host_manager, dest_limit)
         by_src: dict[str, list[str]] = {}
         for key in self.kv.scan_iter("networktopology:*:*"):
             _, src, dest = key.split(":", 2)
@@ -176,13 +252,20 @@ class NetworkTopology:
             sh = self.host_manager.load(src)
             if sh is None:
                 continue
+            # freshness first, then truncate: scan order is arbitrary,
+            # and truncating before looking at updatedAt would pin stale
+            # edges into every snapshot. Only updatedAt is read for ALL
+            # dests (one pipelined batch on the RESP backend); the full
+            # hash is fetched just for the dest_limit winners.
+            updated = self._edge_updated_at(src, dests)
+            ranked = sorted(zip(dests, updated), key=lambda e: -e[1])
             dest_hosts: list[R.DestHost] = []
-            for dest in dests[:dest_limit]:
-                dh = self.host_manager.load(dest)
-                if dh is None:
-                    continue
+            for dest, _ in ranked[:dest_limit]:
                 edge = self.kv.hgetall(make_network_topology_key(src, dest))
                 if not edge:
+                    continue
+                dh = self.host_manager.load(dest)
+                if dh is None:
                     continue
                 dest_hosts.append(
                     R.DestHost(

@@ -62,3 +62,26 @@ def recommend_seeds(
         {"host_id": h, "mean_predicted_rtt_log_ms": round(s, 4)}
         for s, h in scores[:k]
     ]
+
+
+def recommend_seeds_by_rtt(
+    topology_engine,
+    k: int = 3,
+    candidates: list[str] | None = None,
+) -> list[dict]:
+    """→ up to ``k`` ``{host_id, mean_rtt_ms}`` rows ranked by inferred
+    RTT centrality: the mean landmark-inferred (or directly probed) RTT
+    from every other host in the device adjacency. No trained model
+    required — this is the topology engine's own estimate, so it works
+    the moment probes flow, and it covers unprobed pairs the raw probe
+    graph can't score."""
+    if topology_engine is None:
+        return []
+    ranking = topology_engine.centrality(candidates)
+    if candidates is not None and not ranking:
+        raise ValueError(
+            "no candidate host is rankable: each is either absent from the"
+            " device adjacency (never probed / not yet flushed) or has no"
+            f" finite RTT path to the fleet (candidates={candidates!r})"
+        )
+    return ranking[:k]

@@ -56,6 +56,15 @@ class SchedulerServerConfig:
     candidate_parent_limit: int = 4
     # probe-graph CSV snapshot cadence (reference CollectInterval, 2h)
     topology_snapshot_interval: float = 2 * 3600.0
+    # device-resident topology engine (dragonfly2_tpu/topology): the
+    # probe graph as a sparse adjacency in HBM with landmark RTT
+    # inference. "auto" picks jax when importable, numpy otherwise;
+    # "off" disables the engine (KV-walk snapshots, no rtt feature).
+    topology_backend: str = "auto"
+    topology_landmarks: int = 8
+    topology_flush_threshold: int = 256
+    topology_half_life_s: float = 30 * 60.0
+    topology_max_age_s: float = 4 * 3600.0
     # shared KV backend for the Redis role (probe graph, probed counts):
     # "host:port" of utils.kvserver.KVServer (the manager embeds one) or
     # an actual Redis; empty = process-local store (single-scheduler).
@@ -113,8 +122,28 @@ class SchedulerServer:
             if config.kv_address
             else KVStore()
         )
+        self.topology_engine = None
+        if config.topology_backend != "off":
+            from dragonfly2_tpu.topology import TopologyConfig, TopologyEngine
+
+            self.topology_engine = TopologyEngine(
+                TopologyConfig(
+                    backend=config.topology_backend,
+                    num_landmarks=config.topology_landmarks,
+                    flush_threshold=config.topology_flush_threshold,
+                    half_life_s=config.topology_half_life_s,
+                    max_age_s=config.topology_max_age_s,
+                )
+            )
+        if self.topology_engine is not None:
+            # block-encode-time rtt_affinity join: training data carries
+            # the same live feature distribution the evaluator feeds
+            self.storage.rtt_lookup = self.topology_engine.rtt_affinity_batch
         self.networktopology = NetworkTopology(
-            self.kvstore, self.resource.host_manager, self.storage
+            self.kvstore,
+            self.resource.host_manager,
+            self.storage,
+            engine=self.topology_engine,
         )
         self.gc.add(
             GCTask(
@@ -124,6 +153,12 @@ class SchedulerServer:
                 self.networktopology.snapshot,
             )
         )
+        if self.topology_engine is not None:
+            # periodic flush: drains sub-threshold delta batches and
+            # advances staleness decay even on a quiet probe plane
+            self.gc.add(
+                GCTask("topology-flush", 30.0, 30.0, self.topology_engine.flush)
+            )
         from dragonfly2_tpu.scheduler import metrics as _M
 
         _M.set_version_info()
@@ -167,7 +202,7 @@ class SchedulerServer:
         # evaluator (+ live model refresh when the manager serves models)
         self.model_refresher = None
         if config.algorithm == "ml":
-            evaluator = MLEvaluator()
+            evaluator = MLEvaluator(topology=self.topology_engine)
             if self._manager_channel is not None:
                 from dragonfly2_tpu.manager.service import (
                     SERVICE_NAME as MANAGER_SERVICE,
@@ -249,14 +284,29 @@ class SchedulerServer:
         cfg = self.cfg
         from dragonfly2_tpu.scheduler.service_v1 import SCHEDULER_V1_SERVICE
 
+        services = {SERVICE_NAME: self.service, SCHEDULER_V1_SERVICE: self.service_v1}
+        if self.topology_engine is not None:
+            from dragonfly2_tpu.rpc.glue import TOPOLOGY_SERVICE
+            from dragonfly2_tpu.scheduler.topology_service import TopologyService
+
+            services[TOPOLOGY_SERVICE] = TopologyService(self.topology_engine)
         self._grpc, self.port = glue.serve(
-            {SERVICE_NAME: self.service, SCHEDULER_V1_SERVICE: self.service_v1},
+            services,
             cfg.listen,
             **glue.serve_tls_args(
                 cfg.tls_cert_file, cfg.tls_key_file, cfg.tls_client_ca_file
             ),
         )
         addr = f"{cfg.listen.rsplit(':', 1)[0]}:{self.port}"
+        if self.topology_engine is not None:
+            try:
+                # restart recovery: adopt the durable KV graph into the
+                # device adjacency before serving queries against it
+                adopted = self.networktopology.hydrate_engine()
+                if adopted:
+                    logger.info("topology engine hydrated %d edges from kv", adopted)
+            except Exception:
+                logger.warning("topology engine kv hydration failed", exc_info=True)
         if self.manager_client is not None:
             self._register_with_manager()
         self.announcer.serve()

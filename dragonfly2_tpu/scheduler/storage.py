@@ -69,7 +69,9 @@ class Storage:
         max_backups: int = 10,
         buffer_size: int = 64,
         write_blocks: bool = True,
+        rtt_lookup=None,  # topology.TopologyEngine.rtt_affinity_batch
     ):
+        self.rtt_lookup = rtt_lookup
         self.dir = Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self._download = RotatingCSVWriter(
@@ -90,11 +92,18 @@ class Storage:
         # it amortizes both the extraction here and the per-block decode
         # overhead trainer-side, and is the block size the bench
         # synthesizes so its decode rate reflects production blocks.
+        # rtt_lookup (installed by the scheduler server when the
+        # topology engine is on) joins live adjacency RTT into the
+        # rtt_affinity column at block-encode time — so the trained
+        # model sees the same feature distribution the live evaluator
+        # feeds it, instead of a constant missing-value
         self._blocks_download = (
             RotatingBlockWriter(
                 self.dir / "blocks",
                 "download",
-                wire.encode_train_block,
+                lambda recs: wire.encode_train_block(
+                    recs, rtt_lookup=self.rtt_lookup
+                ),
                 max_size,
                 max_backups,
                 max(buffer_size, BLOCK_RECORDS),

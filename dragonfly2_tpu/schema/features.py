@@ -52,6 +52,14 @@ MLP_FEATURE_NAMES = (
     "child_cpu_percent",
     "child_mem_used_percent",
     "task_size_log",
+    # live-topology signal (topology.TopologyEngine): log1p(estimated
+    # child→parent RTT ms)/10, 0.0 when no estimate exists. Download
+    # records carry no probe RTT, so the offline extraction emits the
+    # 0.0 missing-value; the live evaluator fills it from the device
+    # adjacency (direct EWMA or landmark-inferred). Appending it bumps
+    # MLP_FEATURE_DIM — older models are refused by the evaluator's
+    # feature_dim guard and retrain against the new schema.
+    "rtt_affinity",
 )
 MLP_FEATURE_DIM = len(MLP_FEATURE_NAMES)
 
@@ -98,8 +106,17 @@ class PairExamples:
     num_downloads: int = 0  # source download-record count (for min-record gates)
 
 
-def extract_pair_features(cols: dict[str, np.ndarray]) -> PairExamples:
-    """Vectorized download-record batch → MLP training pairs."""
+def extract_pair_features(
+    cols: dict[str, np.ndarray], rtt_lookup=None
+) -> PairExamples:
+    """Vectorized download-record batch → MLP training pairs.
+
+    ``rtt_lookup(child_host_ids [N], parent_host_ids [N, P]) → [N, P]``
+    fills the rtt_affinity column from a live source (the scheduler's
+    topology engine, which extracts train blocks batch-side next to the
+    device adjacency). Without it the column is the 0.0 missing-value —
+    the trainer-side CSV fallback and the native decoder have no
+    adjacency to join against."""
     if not cols:
         return PairExamples(
             features=np.zeros((0, MLP_FEATURE_DIM), dtype=np.float32),
@@ -165,6 +182,15 @@ def extract_pair_features(cols: dict[str, np.ndarray]) -> PairExamples:
         )[:, None],
         (n, P),
     )
+    # rtt_affinity: records carry no probe RTT themselves — 0.0
+    # missing-value unless a live adjacency lookup joins it in
+    # (see MLP_FEATURE_NAMES)
+    if rtt_lookup is not None:
+        rtt_aff = np.asarray(
+            rtt_lookup(cols["host.id"], pg_str("host.id")), dtype=np.float64
+        )
+    else:
+        rtt_aff = np.zeros((n, P), dtype=np.float64)
 
     feats = np.stack(
         [
@@ -186,6 +212,7 @@ def extract_pair_features(cols: dict[str, np.ndarray]) -> PairExamples:
             child_cpu,
             child_mem,
             task_size,
+            rtt_aff,
         ],
         axis=-1,
     ).astype(np.float32)  # [N, P, F]

@@ -177,7 +177,7 @@ def make_pair_tensors(
     x = rng.uniform(0, 1, size=(n, MLP_FEATURE_DIM)).astype(np.float32)
     w = np.array(
         [-1.2, -0.8, -0.9, -0.6, -1.5, -1.0, 0.9, 0.5, 0.4, 0.6, 0.3, -0.4,
-         0.7, -0.5, 0.2, 0.8, 0.6, -0.3],
+         0.7, -0.5, 0.2, 0.8, 0.6, -0.3, 0.9],  # last: rtt_affinity (higher RTT → higher cost)
         dtype=np.float32,
     )
     assert w.shape[0] == MLP_FEATURE_DIM

@@ -415,13 +415,16 @@ def read_columns(
 # ---------------------------------------------------------------------------
 
 
-def encode_train_block(recs) -> bytes:
+def encode_train_block(recs, rtt_lookup=None) -> bytes:
     """Download records → one ``train`` block: pair features/labels for
     the MLP plus piece-cost sequences for the GRU, extracted HERE — in
     batch, on the scheduler, off the trainer's critical path. The
-    extraction is the exact same vectorized code the CSV fallback runs
-    trainer-side (schema/features.py), so both payloads train on
-    bit-identical tensors."""
+    extraction is the same vectorized code the CSV fallback runs
+    trainer-side (schema/features.py); with ``rtt_lookup`` (the
+    scheduler's topology engine) the rtt_affinity column carries live
+    adjacency estimates the CSV fallback cannot reproduce — binary
+    blocks are the production payload precisely because they can join
+    scheduler-side state the raw records don't carry."""
     from dragonfly2_tpu.schema.columnar import records_to_columns
     from dragonfly2_tpu.schema.features import (
         MLP_FEATURE_DIM,
@@ -430,7 +433,7 @@ def encode_train_block(recs) -> bytes:
     )
 
     cols = records_to_columns(recs)
-    pairs = extract_pair_features(cols)
+    pairs = extract_pair_features(cols, rtt_lookup=rtt_lookup)
     seqs = extract_piece_sequences(cols)
     out = {
         "pairs.features": pairs.features,

@@ -1,0 +1,25 @@
+"""TPU-resident topology engine: the probe graph as a device sparse
+adjacency (PAPER.md:33 — "the scheduler/networktopology probe graph
+lives in HBM as a sparse adjacency").
+
+The KV store (scheduler/networktopology.py) remains the durable,
+multi-scheduler-shared record of probe state; this package maintains a
+*live computational replica* of that graph on the accelerator so
+scheduling decisions can read RTT structure without a KV walk:
+
+- ``delta.DeltaQueue`` — batches ``enqueue_probe`` updates so device
+  array refreshes amortize over many probes instead of running per-RPC.
+- ``csr.AdjacencyStore`` — host-side interned edge store + padded CSR
+  build (static shapes: capacities grow by doubling, so jit recompiles
+  are logarithmic in graph growth, per the TPU static-shape rule).
+- ``kernels`` — the device math, jitted under jax with a numpy
+  twin for accelerator-less deployments: k-hop EWMA-RTT aggregation,
+  landmark min-plus RTT inference, staleness decay.
+- ``engine.TopologyEngine`` — the facade consumers wire against:
+  est_rtt / neighbors / stats / rtt_affinity / centrality / export.
+"""
+
+from dragonfly2_tpu.topology.delta import DeltaQueue, EdgeDelta
+from dragonfly2_tpu.topology.engine import TopologyConfig, TopologyEngine
+
+__all__ = ["DeltaQueue", "EdgeDelta", "TopologyConfig", "TopologyEngine"]

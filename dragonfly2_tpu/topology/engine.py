@@ -1,0 +1,547 @@
+"""TopologyEngine: the device-resident probe graph and its query surface.
+
+Lifecycle: ``NetworkTopology.enqueue_probe`` → ``enqueue`` (delta queue)
+→ ``flush`` (drain, EWMA fold into the host store, staleness purge,
+padded CSR build, device refresh, landmark re-selection + distance
+solve) → queries (``est_rtt_ns``, ``neighbors``, ``rtt_affinity``,
+``centrality``, ``stats``) served from the resident arrays, never the
+KV store.
+
+RTT inference (unprobed pairs): L landmark hosts (highest fresh degree)
+keep min-plus distances to every host; est_rtt(a,b) = min over
+landmarks of d(a,l)+d(l,b). Direct fresh edges win over inference.
+Staleness: edges lose aggregation weight with a freshness half-life and
+are purged outright past ``max_age_s`` — a departed or quiet edge fades
+instead of pinning its last EWMA forever.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+
+import numpy as np
+
+from dragonfly2_tpu.topology import metrics as TM
+from dragonfly2_tpu.topology.csr import NS_PER_MS, AdjacencyStore
+from dragonfly2_tpu.topology.delta import DeltaQueue, EdgeDelta
+from dragonfly2_tpu.topology.kernels import INF_MS, make_kernels
+from dragonfly2_tpu.utils import dflog
+
+logger = dflog.get("topology.engine")
+
+
+@dataclass
+class TopologyConfig:
+    backend: str = "auto"  # jax | numpy | auto
+    num_landmarks: int = 8
+    landmark_iters: int = 3  # min-plus relaxation rounds ≈ hop radius
+    khop: int = 2
+    # deltas buffered before an automatic flush (callers can flush
+    # explicitly any time; the snapshot/export paths always do)
+    flush_threshold: int = 256
+    # staleness decay: half-life for aggregation weight, hard purge age
+    half_life_s: float = 30 * 60.0
+    max_age_s: float = 4 * 3600.0
+    max_pending: int = 100_000
+    inference_cache_size: int = 8192
+
+
+class TopologyEngine:
+    def __init__(self, config: TopologyConfig | None = None):
+        self.cfg = config or TopologyConfig()
+        self.kernels = make_kernels(self.cfg.backend)
+        self.store = AdjacencyStore()
+        self.deltas = DeltaQueue(self.cfg.max_pending)
+        self._lock = threading.RLock()
+        # serializes flushes so the kernel work can run OUTSIDE _lock
+        # (queries keep reading the previous arrays meanwhile) without
+        # two flushes racing the swap
+        self._flush_lock = threading.Lock()
+        self._arrays: dict | None = None  # device-resident CSR/COO
+        self._weights = None  # freshness weights at last flush
+        self._D = None  # [node_cap, L] landmark distances (ms)
+        self._khop_rtt = None  # [node_cap] aggregate (log-ms)
+        self._landmark_idx: np.ndarray | None = None
+        self._flush_count = 0
+        self._dropped_seen = 0
+        self._last_flush_at = 0.0
+        # bumped on every out-of-flush store mutation (adopt,
+        # delete_host): a flush whose build predates the bump must
+        # rebuild instead of installing pre-mutation arrays
+        self._store_version = 0
+        # (src, dest) → (rtt_ns | None, provenance)
+        self._cache: dict[tuple[str, str], tuple[float | None, str]] = {}
+        self._cache_hits = 0
+        self._cache_misses = 0
+        self._query_lat_ms: list[float] = []  # sorted ring for p50/p99
+
+    # ------------------------------------------------------------------
+    # ingestion
+    # ------------------------------------------------------------------
+    def enqueue(
+        self, src: str, dest: str, rtt_ns: int, created_at: float | None = None
+    ) -> None:
+        self.deltas.put(
+            EdgeDelta(src, dest, rtt_ns, created_at if created_at is not None else time.time())
+        )
+        TM.DELTA_QUEUE_GAUGE.set(len(self.deltas))
+        if len(self.deltas) >= self.cfg.flush_threshold:
+            self.flush()
+
+    def adopt(
+        self, src: str, dest: str, avg_rtt_ns: float, updated_at: float
+    ) -> bool:
+        """Adopt an already-EWMA'd edge from the durable KV graph —
+        hydration after a restart, and the merge path for edges probed
+        via OTHER schedulers sharing the KV store (this process never
+        saw their raw probes). Newer local state wins; the next flush
+        folds adopted edges into the device arrays."""
+        with self._lock:
+            adopted = self.store.adopt_edge(src, dest, avg_rtt_ns, updated_at)
+            if adopted:
+                self._store_version += 1
+            return adopted
+
+    def delete_host(self, host_id: str) -> None:
+        """Purge parity with NetworkTopology.delete_host: edges, pending
+        deltas and cached inferences touching the host all go."""
+        with self._lock:
+            self.deltas.discard_host(host_id)
+            if self.store.purge_host(host_id):
+                self._store_version += 1
+                self._refresh(time.time())
+            self._cache.clear()
+
+    # ------------------------------------------------------------------
+    # flush: deltas → host store → device arrays
+    # ------------------------------------------------------------------
+    def flush(self, now: float | None = None) -> int:
+        """Apply queued deltas and refresh the device arrays. Returns the
+        number of deltas applied. The rebuild always runs — edge AGE
+        advances between flushes, so skipping it would freeze staleness
+        decay on a quiet probe plane. The kernel work runs OUTSIDE the
+        query lock (``_flush_lock`` serializes flushes): est_rtt callers
+        keep reading the previous arrays until the swap."""
+        now = time.time() if now is None else now
+        with self._flush_lock:
+            t0 = time.perf_counter()
+            batch = self.deltas.drain()
+            with self._lock:
+                for d in batch:
+                    self.store.apply_probe(d.src, d.dest, d.rtt_ns, d.created_at)
+                purged = self.store.purge_stale(now, self.cfg.max_age_s)
+                arr = self._build_arrays(now)
+                built_version = self._store_version
+            computed = self._run_kernels(arr)
+            with self._lock:
+                if self._store_version == built_version:
+                    self._swap(arr, computed)
+                else:
+                    # an adopt/delete_host landed mid-kernel: the built
+                    # arrays are stale — rebuild from the current store
+                    self._refresh(now)
+                self._flush_count += 1
+                self._last_flush_at = now
+            if purged:
+                TM.STALE_PURGED_TOTAL.inc(purged)
+            TM.FLUSH_TOTAL.inc()
+            TM.FLUSH_LATENCY.observe(time.perf_counter() - t0)
+            TM.DELTA_QUEUE_GAUGE.set(len(self.deltas))
+            dropped = self.deltas.dropped
+            if dropped > self._dropped_seen:
+                TM.DELTA_DROPPED_TOTAL.inc(dropped - self._dropped_seen)
+                self._dropped_seen = dropped
+            return len(batch)
+
+    def _refresh(self, now: float) -> None:
+        """Build + kernels + swap in one step — for callers already
+        holding ``_lock`` (delete_host, first-touch builds)."""
+        arr = self._build_arrays(now)
+        self._swap(arr, self._run_kernels(arr))
+
+    def _build_arrays(self, now: float) -> dict:
+        """Padded CSR + landmark selection from the host store (caller
+        holds ``_lock``)."""
+        prev_ncap = len(self._arrays["row_ptr"]) - 1 if self._arrays else 0
+        prev_ecap = len(self._arrays["edge_src"]) if self._arrays else 0
+        arr = self.store.build_arrays(now, prev_ncap, prev_ecap)
+        ncap = len(arr["row_ptr"]) - 1
+
+        # landmarks: highest fresh-degree hosts (deterministic: degree
+        # desc, index asc), computed host-side — tiny, control-flow-y
+        e = arr["num_edges"]
+        deg = np.bincount(arr["edge_src"][:e], minlength=ncap) + np.bincount(
+            arr["edge_dst"][:e], minlength=ncap
+        )
+        live = np.zeros(ncap, dtype=bool)
+        for i, hid in enumerate(self.store.ids):
+            live[i] = bool(hid)  # tombstoned hosts keep their slot, not their rank
+        deg = np.where(live, deg, -1)
+        L = self.cfg.num_landmarks
+        order = np.argsort(-deg, kind="stable")[:L]
+        lm_idx = np.zeros(L, dtype=np.int32)
+        lm_valid = np.zeros(L, dtype=np.float32)
+        n_lm = 0
+        for idx in order:
+            if deg[idx] >= 0 and live[idx]:
+                lm_idx[n_lm] = idx
+                lm_valid[n_lm] = 1.0
+                n_lm += 1
+        arr["landmark_idx"] = lm_idx
+        arr["landmark_valid"] = lm_valid
+        arr["num_landmarks"] = n_lm
+        return arr
+
+    def _run_kernels(self, arr: dict) -> dict:
+        """Decay → k-hop aggregate → landmark distances over built
+        arrays — pure array math, no engine state, safe outside
+        ``_lock``."""
+        ncap = len(arr["row_ptr"]) - 1
+        xp = self.kernels
+        dev = self._to_backend(arr)
+        w = xp.decay_weights(dev["age_s"], dev["valid"], self.cfg.half_life_s)
+        khop = xp.khop_rtt(
+            dev["edge_src"], dev["edge_dst"], dev["rtt_log_ms"], w,
+            num_nodes=ncap, k=self.cfg.khop,
+        )
+
+        # symmetrized edge list for distance inference: probes are
+        # directed but RTT is (to first order) symmetric, and min-plus
+        # needs to traverse an edge both ways
+        sym_src = np.concatenate([arr["edge_src"], arr["edge_dst"]])
+        sym_dst = np.concatenate([arr["edge_dst"], arr["edge_src"]])
+        rtt_ms = np.expm1(arr["rtt_log_ms"]).astype(np.float32)
+        sym_rtt = np.concatenate([rtt_ms, rtt_ms])
+        sym_w = np.concatenate([arr["valid"], arr["valid"]])
+        sd = self._to_backend(
+            {"src": sym_src, "dst": sym_dst, "rtt": sym_rtt, "w": sym_w}
+        )
+        lm = self._to_backend(
+            {"li": arr["landmark_idx"], "lv": arr["landmark_valid"]}
+        )
+        D = xp.landmark_distances(
+            sd["src"], sd["dst"], sd["rtt"], sd["w"],
+            lm["li"], lm["lv"],
+            num_nodes=ncap, iters=self.cfg.landmark_iters,
+        )
+        return {"weights": w, "khop": khop, "D": D}
+
+    def _swap(self, arr: dict, computed: dict) -> None:
+        """Install a finished build (caller holds ``_lock``)."""
+        self._arrays = arr
+        self._weights = computed["weights"]
+        self._khop_rtt = computed["khop"]
+        self._D = computed["D"]
+        self._landmark_idx = arr["landmark_idx"][: arr["num_landmarks"]].copy()
+        self._cache.clear()
+        TM.EDGE_GAUGE.set(self.store.num_edges)
+        TM.HOST_GAUGE.set(len(self.store.index))
+
+    def _to_backend(self, arrays: dict) -> dict:
+        """numpy → device arrays on the jax backend (HBM when an
+        accelerator is attached); identity on the numpy backend."""
+        if self.kernels.backend != "jax":
+            return arrays
+        import jax.numpy as jnp
+
+        return {
+            k: (jnp.asarray(v) if isinstance(v, np.ndarray) else v)
+            for k, v in arrays.items()
+        }
+
+    # ------------------------------------------------------------------
+    # queries
+    # ------------------------------------------------------------------
+    def est_rtt_ns(self, src: str, dest: str) -> int | None:
+        """Best RTT estimate: direct fresh edge (EWMA) → landmark
+        inference → None (host unknown or no path). Symmetric on input
+        order for inferred pairs by construction."""
+        return self.est_rtt_detail(src, dest)[0]
+
+    def est_rtt_detail(self, src: str, dest: str) -> tuple[int | None, str]:
+        """(rtt_ns, provenance) where provenance ∈ {"self", "direct",
+        "inferred", "none"} — resolved under one lock so the answer and
+        its provenance can't disagree (a flush or delete between two
+        lookups)."""
+        if src == dest:
+            return 0, "self"
+        t0 = time.perf_counter()
+        with self._lock:
+            key = (src, dest)
+            if key in self._cache:
+                self._cache_hits += 1
+                TM.QUERY_TOTAL.labels("cache").inc()
+                self._note_latency(t0)
+                out, source = self._cache[key]
+                return self._intify(out), source
+            self._cache_misses += 1
+            out, source = self._est_rtt_locked(src, dest)
+            if len(self._cache) >= self.cfg.inference_cache_size:
+                self._cache.clear()
+            self._cache[key] = (out, source)
+            self._note_latency(t0)
+            return self._intify(out), source
+
+    def _est_rtt_locked(self, src: str, dest: str) -> tuple[float | None, str]:
+        s = self.store.index.get(src)
+        d = self.store.index.get(dest)
+        if s is None or d is None:
+            TM.QUERY_TOTAL.labels("unknown").inc()
+            return None, "none"
+        edge = self.store.edges.get((s, d)) or self.store.edges.get((d, s))
+        if edge is not None:
+            TM.QUERY_TOTAL.labels("direct").inc()
+            return float(edge[0]), "direct"
+        if self._D is None:
+            return None, "none"
+        est_ms = float(
+            np.asarray(
+                self.kernels.est_from_landmarks(
+                    self._D, *self._to_backend_idx(s, d)
+                )
+            )[0]
+        )
+        if est_ms >= INF_MS / 2:
+            TM.QUERY_TOTAL.labels("no_path").inc()
+            return None, "none"
+        TM.QUERY_TOTAL.labels("inferred").inc()
+        return est_ms * NS_PER_MS, "inferred"
+
+    def _to_backend_idx(self, s: int, d: int):
+        a = np.array([s], dtype=np.int32)
+        b = np.array([d], dtype=np.int32)
+        out = self._to_backend({"a": a, "b": b})
+        return out["a"], out["b"]
+
+    @staticmethod
+    def _intify(v: float | None) -> int | None:
+        return None if v is None else int(v)
+
+    def neighbors(self, host_id: str, limit: int = 32) -> list[dict]:
+        """Fresh out-edges of ``host_id`` from the CSR rows, nearest
+        first: [{host_id, avg_rtt_ns, age_s}]."""
+        if self._arrays is None:
+            # outside _lock: flush takes _flush_lock → _lock, so calling
+            # it under _lock would invert the order (ABBA deadlock with
+            # a concurrent flusher)
+            self.flush()
+        with self._lock:
+            idx = self.store.index.get(host_id)
+            if idx is None:
+                return []
+            arr = self._arrays
+            row_ptr = np.asarray(arr["row_ptr"])
+            lo, hi = int(row_ptr[idx]), int(row_ptr[idx + 1])
+            dst = np.asarray(arr["edge_dst"])[lo:hi]
+            out = []
+            for d in dst:
+                e = self.store.edges.get((idx, int(d)))
+                if e is None:
+                    continue
+                out.append(
+                    {
+                        "host_id": self.store.ids[int(d)],
+                        "avg_rtt_ns": int(e[0]),
+                        "age_s": max(time.time() - e[1], 0.0),
+                    }
+                )
+            out.sort(key=lambda r: r["avg_rtt_ns"])
+            return out[:limit]
+
+    def rtt_affinity(self, src: str, dest: str) -> float:
+        """The MLP feature: log1p(est RTT in ms)/10 — same normalization
+        family as the tcp-connection features — 0.0 when unknown (the
+        missing-value the schema documents, so live and trained
+        distributions agree on the missing case)."""
+        rtt = self.est_rtt_ns(src, dest)
+        if rtt is None:
+            return 0.0
+        return float(np.log1p(rtt / NS_PER_MS) / 10.0)
+
+    def rtt_affinity_batch(
+        self, child_ids: np.ndarray, parent_ids: np.ndarray
+    ) -> np.ndarray:
+        """[N] child host ids × [N, P] parent host ids → [N, P]
+        rtt_affinity — the block-encode-time join (scheduler Storage)
+        that puts the same feature distribution into the training data
+        the live evaluator feeds the model. Memoizes per distinct pair:
+        a record batch has far fewer distinct host pairs than slots."""
+        child_ids = np.asarray(child_ids)
+        parent_ids = np.asarray(parent_ids)
+        out = np.zeros(parent_ids.shape, dtype=np.float32)
+        memo: dict[tuple[str, str], float] = {}
+        for i in range(parent_ids.shape[0]):
+            c = child_ids[i]
+            for j in range(parent_ids.shape[1]):
+                p = parent_ids[i, j]
+                if not p or not c:
+                    continue
+                key = (c, p)
+                v = memo.get(key)
+                if v is None:
+                    v = memo[key] = self.rtt_affinity(c, p)
+                out[i, j] = v
+        return out
+
+    def centrality(self, candidates: list[str] | None = None) -> list[dict]:
+        """Mean inferred RTT from every live host to each candidate,
+        ascending (the seed-placement ranking): [{host_id,
+        mean_rtt_ms}]. Pairs with no path are excluded from the mean;
+        candidates unreachable from everywhere are dropped.
+
+        Snapshots the store under ``_lock``, then does the O(C·H)
+        array math UNLOCKED — a background seed-recommendation job must
+        not stall the evaluator's est_rtt hot path. ``flush`` runs
+        before taking ``_lock`` (flush takes _flush_lock → _lock; a
+        flush call under _lock would invert that order and deadlock
+        against a concurrent flusher)."""
+        if self._arrays is None:
+            self.flush()
+        with self._lock:
+            if self._D is None:
+                return []
+            D = np.asarray(self._D)
+            live = list(self.store.index.items())
+            index = dict(self.store.index)
+            edges = [(s, d, v[0]) for (s, d), v in self.store.edges.items()]
+        if not live:
+            return []
+        pool = (
+            [(h, index[h]) for h in candidates if h in index]
+            if candidates is not None
+            else live
+        )
+        idxs = np.array([i for _, i in live], dtype=np.int32)
+        pos = {int(i): p for p, i in enumerate(idxs)}
+        # direct fresh edges beat inference, as in est_rtt_ns: index
+        # them per node once (O(E)) instead of probing every pair
+        touch: dict[int, list[tuple[int, float]]] = {}
+        for s, d, rtt_ns in edges:
+            touch.setdefault(s, []).append((d, rtt_ns))
+            touch.setdefault(d, []).append((s, rtt_ns))
+        out = []
+        for hid, i in pool:
+            est = np.min(D[idxs] + D[i][None, :], axis=-1)  # [H] landmark est
+            for j, rtt_ns in touch.get(i, ()):
+                p = pos.get(int(j))
+                if p is not None:
+                    est[p] = min(est[p], rtt_ns / NS_PER_MS)
+            est[pos[int(i)]] = INF_MS  # self is not a fleet member to average
+            finite = est[est < INF_MS / 2]
+            if len(finite) == 0:
+                continue
+            out.append({"host_id": hid, "mean_rtt_ms": round(float(finite.mean()), 4)})
+        out.sort(key=lambda r: r["mean_rtt_ms"])
+        return out
+
+    def khop_rtt_log_ms(self, host_id: str) -> float | None:
+        """The k-hop EWMA-RTT aggregate for one host (log-ms)."""
+        with self._lock:
+            idx = self.store.index.get(host_id)
+            if idx is None or self._khop_rtt is None:
+                return None
+            return float(np.asarray(self._khop_rtt)[idx])
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self._cache_hits + self._cache_misses
+            hit_rate = self._cache_hits / total if total else 0.0
+            TM.INFERENCE_CACHE_HIT_RATE.set(hit_rate)
+            return {
+                "backend": self.kernels.backend,
+                "hosts": len(self.store.index),
+                "edges": self.store.num_edges,
+                "pending_deltas": len(self.deltas),
+                "dropped_deltas": self.deltas.dropped,
+                "flushes": self._flush_count,
+                "landmarks": int(len(self._landmark_idx))
+                if self._landmark_idx is not None
+                else 0,
+                "cache_hit_rate": round(hit_rate, 4),
+                "query_p50_ms": self.query_p50_ms(),
+                "last_flush_at": self._last_flush_at,
+            }
+
+    # ------------------------------------------------------------------
+    # export: the snapshot path reads the adjacency, not the KV store
+    # ------------------------------------------------------------------
+    def export_records(self, host_manager, dest_limit: int) -> list:
+        """NetworkTopologyRecord rows straight from the resident
+        adjacency — the trainer-bound GNN snapshot without a KV walk.
+        Freshest ``dest_limit`` dests per source (parity with
+        NetworkTopology.export_records' recency preference)."""
+        from dragonfly2_tpu.schema import records as R
+
+        # flush BEFORE taking _lock (flush's order is _flush_lock →
+        # _lock; the reverse would ABBA-deadlock with a concurrent
+        # flusher, e.g. the 30s GC flush task)
+        self.flush()
+        with self._lock:
+            by_src: dict[int, list[tuple[int, list[float]]]] = {}
+            for (s, d), v in self.store.edges.items():
+                by_src.setdefault(s, []).append((d, [v[0], v[1]]))
+
+            out = []
+            now_ns = int(time.time() * 1e9)
+            for s, dests in by_src.items():
+                sh = host_manager.load(self.store.ids[s])
+                if sh is None:
+                    continue
+                dests.sort(key=lambda t: -t[1][1])  # most recently updated first
+                dest_hosts = []
+                for d, v in dests[:dest_limit]:
+                    dh = host_manager.load(self.store.ids[d])
+                    if dh is None:
+                        continue
+                    dest_hosts.append(
+                        R.DestHost(
+                            id=dh.id,
+                            type=dh.type.value,
+                            hostname=dh.hostname,
+                            ip=dh.ip,
+                            port=dh.port,
+                            network=dh.network,
+                            probes=R.ProbesRecord(
+                                average_rtt=int(v[0]),
+                                created_at=int(v[1] * 1e9),
+                                updated_at=int(v[1] * 1e9),
+                            ),
+                        )
+                    )
+                if not dest_hosts:
+                    continue
+                out.append(
+                    R.NetworkTopologyRecord(
+                        id=str(uuid.uuid4()),
+                        host=R.SrcHost(
+                            id=sh.id,
+                            type=sh.type.value,
+                            hostname=sh.hostname,
+                            ip=sh.ip,
+                            port=sh.port,
+                            network=sh.network,
+                        ),
+                        dest_hosts=dest_hosts,
+                        created_at=now_ns,
+                    )
+                )
+            return out
+
+    # ------------------------------------------------------------------
+    def _note_latency(self, t0: float) -> None:
+        ms = (time.perf_counter() - t0) * 1e3
+        bisect.insort(self._query_lat_ms, ms)
+        if len(self._query_lat_ms) > 4096:
+            # drop extremes pairwise so the ring stays a sample, not a
+            # monotone accumulation
+            self._query_lat_ms = self._query_lat_ms[1:-1]
+
+    def query_p50_ms(self) -> float:
+        with self._lock:
+            if not self._query_lat_ms:
+                return 0.0
+            return round(self._query_lat_ms[len(self._query_lat_ms) // 2], 6)

@@ -194,6 +194,11 @@ class KVRequestHandler(socketserver.BaseRequestHandler):
         if op == "GET" and len(args) == 1:
             v = kv.get(args[0])
             return _bulk(None if v is None else v)
+        if op == "MGET" and args:
+            # batched read: one round-trip for N keys (the topology's
+            # probed-count fetch is the motivating caller); missing keys
+            # are nil entries, like real Redis
+            return _array([kv.get(k) for k in args])
         if op == "DEL" and args:
             return _int(kv.delete(*args))
         if op == "EXISTS" and args:

@@ -312,6 +312,15 @@ class RemoteKVStore:
     def get(self, key: str):
         return self._call("GET", key)
 
+    def mget(self, keys: list[str]) -> list:
+        """Batched GET — one round-trip for N keys (nil → None). The
+        in-process KVStore deliberately has no ``mget``: callers detect
+        the method and only batch when each key would otherwise cost a
+        network round-trip."""
+        if not keys:
+            return []
+        return list(self._call("MGET", *keys) or [])
+
     def incr(self, key: str, amount: int = 1) -> int:
         return int(self._call("INCRBY", key, amount))
 
@@ -324,6 +333,37 @@ class RemoteKVStore:
 
     def hget(self, key: str, field: str):
         return self._call("HGET", key, field)
+
+    def hget_batch(self, keys: list[str], field: str) -> list:
+        """Pipelined HGET: one write, N replies, one round-trip worth of
+        latency — the topology snapshot's updatedAt sweep would
+        otherwise pay a round-trip per edge. Replies arrive in command
+        order, so results align with ``keys``."""
+        if not keys:
+            return []
+        with self._lock:
+            out = b""
+            for k in keys:
+                frame = b"*3" + _CRLF
+                for p in ("HGET", k, field):
+                    data = p.encode()
+                    frame += b"$" + str(len(data)).encode() + _CRLF + data + _CRLF
+                out += frame
+            try:
+                self._connect().sendall(out)
+            except (ConnectionError, OSError):
+                # send-phase failure: safe to retry once on a fresh
+                # connection (partial frames are never executed)
+                self._drop_connection()
+                self._connect().sendall(out)
+            try:
+                return [self._read_reply() for _ in keys]
+            except (ConnectionError, OSError) as e:
+                # read-phase failure: replies lost; same no-resend rule
+                # as _call (HGET is read-only, but a blind resend could
+                # interleave with another caller's state)
+                self._drop_connection()
+                raise ConnectionError(f"kv pipeline reply lost ({e})") from e
 
     def hgetall(self, key: str) -> dict[str, str]:
         flat = self._call("HGETALL", key) or []

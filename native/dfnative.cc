@@ -42,7 +42,7 @@ namespace {
 constexpr int kMaxParents = 20;     // schema/records.py MAX_PARENTS
 constexpr int kMaxPieces = 10;      // MAX_PIECES_PER_PARENT
 constexpr int kMaxDestHosts = 5;    // MAX_DEST_HOSTS
-constexpr int kFeatureDim = 18;     // features.MLP_FEATURE_DIM
+constexpr int kFeatureDim = 19;     // features.MLP_FEATURE_DIM
 constexpr int kMaxLocationDepth = 5;
 constexpr double kNsPerMs = 1e6;
 
@@ -772,6 +772,7 @@ struct DfPairs {
           child_cpu_t,
           child_mem_t,
           task_len_t,
+          0.0,  // rtt_affinity: live-topology feature, 0.0 offline
       };
       // one grow per pair, then straight-line stores (push_back's
       // per-element capacity branch defeats vectorization here)

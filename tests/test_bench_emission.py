@@ -135,6 +135,34 @@ def test_emits_decode_rate_per_payload_format(monkeypatch, capfd):
         assert {"read_s", "cast_s", "enqueue_s"} <= set(detail)
 
 
+def test_emits_topology_engine_rates(monkeypatch, capfd):
+    """The artifact must carry the topology-engine soak numbers
+    (ISSUE 2: the device adjacency is a measured subsystem, not a
+    side effect): deltas-applied-per-second through flush and the
+    est_rtt query p50."""
+
+    def stub(paths, **kw):
+        return None, _stats(1000)
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert rec["topology_flush_rate"] > 0
+    assert rec["topology_query_p50"] > 0
+    assert "topology_error" not in rec
+
+
+def test_topology_rates_survive_warmup_failure(monkeypatch, capfd):
+    """host_rates (topology numbers included) ride every exit path —
+    a dead device link must not discard the scheduler-side soak."""
+
+    def stub(paths, **kw):
+        raise RuntimeError("link died in compile")
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "warmup fit failed" in rec["error"]
+    assert rec["topology_flush_rate"] > 0
+    assert rec["topology_query_p50"] > 0
+
+
 def test_binary_decode_outruns_csv_decode(tmp_path):
     """Pure-decode microbench on the SAME records: the columnar block
     decoder must be strictly faster than the CSV decoder — the whole
