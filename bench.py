@@ -196,6 +196,103 @@ def topology_bench(hosts: int = 64, probes: int = 2048, queries: int = 1024) -> 
     }
 
 
+def tracing_overhead_bench(iters: int = 1000, trials: int = 5) -> dict:
+    """Tracing cost on the scheduling hot path when nothing samples.
+
+    Two direct measurements, not a stub-vs-real diff (with the
+    is_sampling short-circuit in scheduling, a stubbed tracing module
+    executes the same instructions as the real unsampled path, so a
+    paired delta is structurally ~0 and proves nothing):
+
+    - ``schedule_op_us``: wall per schedule_candidate_parents call in an
+      in-process scheduling microbench (one child re-scheduled against a
+      feedable parent — the path every AnnouncePeer event drives), run
+      under an unsampled ambient rpc span exactly like production,
+      best-of-``trials`` (container noise is strictly additive).
+    - ``tracing_unsampled_us``: the exact span-sequence one schedule
+      performs on the unsampled path (the is_sampling guards, the no-op
+      span/context-manager calls), timed in a tight loop — stable where
+      a diff of two ~100ms walls is not.
+
+    ``tracing_overhead_pct`` is their ratio; the acceptance bar is
+    < 2%. This is conservative: it charges tracing for the whole no-op
+    sequence, including call-site work a tracing-free build would not
+    perform at all.
+    """
+    from dragonfly2_tpu.scheduler import resource as res
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+    from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+    from dragonfly2_tpu.utils import tracing
+
+    class _Stream:
+        def send(self, resp):
+            pass
+
+    def build():
+        task = res.Task("bench-task", "https://origin/x")
+        task.content_length = 64 * 1024 * 1024
+        task.total_piece_count = 16
+        ph = res.Host(id="parent-host", type=res.HostType.SUPER)
+        ch = res.Host(id="child-host")
+        parent = res.Peer("parent-peer", task, ph)
+        parent.fsm.event(res.PEER_EVENT_REGISTER_NORMAL)
+        parent.fsm.event(res.PEER_EVENT_DOWNLOAD)
+        parent.fsm.event(res.PEER_EVENT_DOWNLOAD_SUCCEEDED)
+        child = res.Peer("child-peer", task, ch)
+        child.fsm.event(res.PEER_EVENT_REGISTER_NORMAL)
+        child.store_stream(_Stream())
+        return Scheduling(BaseEvaluator(), SchedulingConfig(retry_interval=0.0)), child
+
+    prev_ratio = tracing._sample_ratio
+    sched, child = build()
+    best_op = float("inf")
+    try:
+        # the module global directly, NOT configure(): configure would
+        # also rebind export files, which this microbench must not touch
+        tracing._sample_ratio = 0.0
+        ambient = tracing.get("scheduler").start_span("rpc.AnnouncePeer")
+        # production schedules run under the rpc.AnnouncePeer server
+        # span (glue._instrument activates it); measure under the same
+        # ambient so the per-schedule cost is the path that actually
+        # runs, not the root-transition path
+        with tracing.use_span(ambient):
+            for _ in range(iters // 5):  # warm (fsm/task state, caches)
+                sched.schedule_candidate_parents(child, set())
+            for _ in range(max(trials, 1)):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    sched.schedule_candidate_parents(child, set())
+                best_op = min(best_op, (time.perf_counter() - t0) / iters)
+            # the per-schedule tracing sequence, mirroring what
+            # schedule_candidate_parents + find_candidate_parents
+            # execute on the unsampled path
+            seq_iters = 50_000
+            best_seq = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(seq_iters):
+                    if tracing.is_sampling():
+                        s = tracing.get("scheduler").start_span("schedule")
+                        cm = tracing.use_span(s)
+                    else:
+                        s = tracing.NOOP_SPAN
+                        cm = tracing.noop_cm()
+                    with cm:
+                        if tracing.is_sampling():  # the evaluate-site guard
+                            pass
+                        s.set(candidates=3, retries=0)
+                    s.end("ok")
+                best_seq = min(best_seq, (time.perf_counter() - t0) / seq_iters)
+    finally:
+        tracing._sample_ratio = prev_ratio
+    overhead_pct = best_seq / best_op * 100.0 if best_op else 0.0
+    return {
+        "tracing_overhead_pct": round(overhead_pct, 2),
+        "tracing_unsampled_us": round(best_seq * 1e6, 3),
+        "schedule_op_us": round(best_op * 1e6, 2),
+    }
+
+
 def main() -> None:
     if os.environ.get("DF_BENCH_CPU_FALLBACK"):
         # the sitecustomize pins the axon platform at interpreter start;
@@ -349,6 +446,18 @@ def main() -> None:
             # the headline metric must survive a topology-bench failure
             host_rates["topology_error"] = str(e)
             _phase(f"topology bench failed: {e}")
+        # tracing-overhead microbench rides host_rates the same way: the
+        # disabled/unsampled span path must stay < 2% of the scheduling
+        # hot-path wall, and the artifact carries the measured number
+        try:
+            host_rates.update(tracing_overhead_bench())
+            _phase(
+                f"tracing: unsampled overhead {host_rates['tracing_overhead_pct']:.2f}%"
+                f" of schedule wall ({host_rates['schedule_op_us']:.1f} us/op)"
+            )
+        except Exception as e:
+            host_rates["tracing_error"] = str(e)
+            _phase(f"tracing bench failed: {e}")
         _phase(
             f"host split: decode(binary) {decode_only_rate_binary / 1e3:.1f}k/s,"
             f" decode(csv) {host_rates.get('decode_only_rate_csv', 0) / 1e3:.1f}k/s,"

@@ -191,8 +191,14 @@ class PeerTaskConductor:
     def _stream_loop(self) -> None:
         """Own thread: consumes scheduler responses, queues decisions for
         the run loop (reference receivePeerPacket :659)."""
+        from dragonfly2_tpu.utils import tracing
+
         try:
-            responses = self.scheduler.AnnouncePeer(self._req_iter())
+            # the peer_task span is this thread's context for the
+            # AnnouncePeer call, so the scheduler's rpc.AnnouncePeer span
+            # (and its scheduling children) join the download's trace
+            with tracing.use_span(getattr(self, "_span", None)):
+                responses = self.scheduler.AnnouncePeer(self._req_iter())
             for resp in responses:
                 which = resp.WhichOneof("response")
                 self._decisions.put((which, getattr(resp, which)))
@@ -205,6 +211,12 @@ class PeerTaskConductor:
     # main run loop
     # ------------------------------------------------------------------
     def _run(self) -> None:
+        from dragonfly2_tpu.utils import tracing
+
+        with tracing.use_span(getattr(self, "_span", None)):
+            self._run_traced()
+
+    def _run_traced(self) -> None:
         try:
             self._send(
                 register_peer=scheduler_pb2.RegisterPeerRequest(

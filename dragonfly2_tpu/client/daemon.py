@@ -368,6 +368,8 @@ class Daemon:
             from dragonfly2_tpu.utils.metrics import MetricsServer, default_registry
 
             self._metrics = MetricsServer(default_registry, host=self.cfg.metrics_host, port=self.cfg.metrics_port)
+            # liveness on the scrape port (/healthz): the gRPC plane up
+            self._metrics.register_health("dfdaemon", lambda: self._server is not None)
             self.metrics_addr = self._metrics.start()
             logger.info("daemon metrics on %s", self.metrics_addr)
 

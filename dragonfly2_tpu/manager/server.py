@@ -150,6 +150,8 @@ class ManagerServer:
             from dragonfly2_tpu.utils.metrics import MetricsServer, default_registry
 
             self._metrics = MetricsServer(default_registry, host=self.cfg.metrics_host, port=self.cfg.metrics_port)
+            # liveness on the scrape port (/healthz): the gRPC plane up
+            self._metrics.register_health("manager", lambda: self._grpc is not None)
             self.metrics_addr = self._metrics.start()
             logger.info("manager metrics on %s", self.metrics_addr)
         if self.cfg.kv_port >= 0:

@@ -163,7 +163,13 @@ SERVICES: dict[str, dict[str, Method]] = {
 
 class ServiceClient:
     """Callable stubs for one service over one channel:
-    ``client.AnnouncePeer(iter_of_requests)`` etc."""
+    ``client.AnnouncePeer(iter_of_requests)`` etc. Every method is
+    wrapped with client-side observability (reference: otelgrpc +
+    grpc-prometheus CLIENT interceptors, pkg/rpc/interceptor.go): a
+    ``traceparent`` header carrying the caller's current span rides the
+    invocation metadata, and outcomes land in the
+    ``rpc_client_handled_total``/``rpc_client_handling_seconds``
+    series."""
 
     def __init__(self, channel: grpc.Channel, service: str):
         methods = SERVICES[service]
@@ -174,7 +180,7 @@ class ServiceClient:
                 request_serializer=m.request.SerializeToString,
                 response_deserializer=m.response.FromString,
             )
-            setattr(self, name, callable_)
+            setattr(self, name, _instrument_client(service, name, m.kind, callable_))
 
 
 # Per-RPC server observability (reference: every server wires
@@ -203,11 +209,165 @@ _RPC_HANDLED = None
 _RPC_LATENCY = None
 
 
+# Client-side twins of the server series (today only the server side is
+# instrumented in the reference-parity set; the client series close the
+# loop so a call that never reaches a server still lands somewhere).
+def _rpc_client_metrics():
+    global _RPC_CLIENT_HANDLED, _RPC_CLIENT_LATENCY
+    if _RPC_CLIENT_HANDLED is None:
+        from dragonfly2_tpu.utils.metrics import default_registry as r
+
+        _RPC_CLIENT_HANDLED = r.counter(
+            "rpc_client_handled_total",
+            "RPCs completed on the client, by outcome code",
+            ("service", "method", "code"),
+        )
+        _RPC_CLIENT_LATENCY = r.histogram(
+            "rpc_client_handling_seconds",
+            "Client-side RPC latency (streams: until exhausted)",
+            ("service", "method"),
+        )
+    return _RPC_CLIENT_HANDLED, _RPC_CLIENT_LATENCY
+
+
+_RPC_CLIENT_HANDLED = None
+_RPC_CLIENT_LATENCY = None
+
+
+def _incoming_traceparent(context) -> "str | None":
+    try:
+        for k, v in context.invocation_metadata() or ():
+            if k == "traceparent":
+                return v
+    except Exception:
+        return None
+    return None
+
+
+def _code_of_rpc_error(e: Exception) -> str:
+    code = e.code() if hasattr(e, "code") else None
+    if code is None:
+        return "UNKNOWN"
+    return code.name if hasattr(code, "name") else str(code)
+
+
+class _InstrumentedStream:
+    """Response-stream proxy: times the call to iterator exhaustion and
+    records the outcome code once, while delegating everything else
+    (``cancel``, ``code``, ``add_callback``…) to the underlying gRPC
+    call object so existing stream handling keeps working. A stream the
+    caller walks away from without exhausting (dfget returns on the
+    first ``done=True`` result) finalizes at garbage collection with
+    code ABANDONED — otherwise its span and client series never
+    complete."""
+
+    def __init__(self, call, finish: Callable[[str], None]):
+        self._call = call
+        self._finish = finish
+        self._closed = False
+
+    def _close(self, code: str) -> None:
+        if not self._closed:
+            self._closed = True
+            self._finish(code)
+
+    def __del__(self):
+        try:
+            self._close("ABANDONED")
+        except Exception:
+            pass  # interpreter teardown — never raise from __del__
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._call)
+        except StopIteration:
+            self._close("OK")
+            raise
+        except grpc.RpcError as e:
+            self._close(_code_of_rpc_error(e))
+            raise
+        except Exception:
+            self._close("UNKNOWN")
+            raise
+
+    def cancel(self):
+        self._close("CANCELLED")
+        return self._call.cancel()
+
+    def __getattr__(self, attr):
+        return getattr(self._call, attr)
+
+
+def _instrument_client(
+    service: str, name: str, kind: str, callable_: Callable
+) -> Callable:
+    """Client-side call wrapper: injects the W3C ``traceparent`` header
+    (from the caller's current span — a fresh root when none is active,
+    so a CLI invocation still starts a trace) into invocation metadata,
+    opens a client span, and records the rpc_client_* series.
+    Response-streaming calls are timed to iterator exhaustion, like the
+    server side."""
+    from dragonfly2_tpu.utils import tracing
+
+    streaming_out = kind in (UNARY_STREAM, STREAM_STREAM)
+
+    def call(request_or_iterator, timeout=None, metadata=None, **kwargs):
+        handled, latency = _rpc_client_metrics()
+        parent = tracing.current_span()
+        # record under the calling service's tracer when one is active
+        # (the span rides its export file); a bare client gets its own
+        tracer = (
+            parent._tracer
+            if parent is not None and parent._tracer is not None
+            else tracing.get("client")
+        )
+        span = tracer.start_span(f"rpc.{name}", parent=parent, span_kind="client")
+        md = list(metadata or ())
+        # an explicitly provided traceparent wins — never stack a second
+        if not any(k == tracing.TRACEPARENT_HEADER for k, _ in md):
+            md.append((tracing.TRACEPARENT_HEADER, tracing.format_traceparent(span)))
+        t0 = time.perf_counter()
+
+        def finish(code: str) -> None:
+            latency.labels(service, name).observe(time.perf_counter() - t0)
+            handled.labels(service, name, code).inc()
+            # an abandoned stream is normal API use (the caller got what
+            # it needed), not a failed call
+            span.end(
+                status="ok"
+                if code == "OK"
+                else ("abandoned" if code == "ABANDONED" else "error")
+            )
+
+        try:
+            result = callable_(
+                request_or_iterator, timeout=timeout, metadata=md, **kwargs
+            )
+        except grpc.RpcError as e:
+            finish(_code_of_rpc_error(e))
+            raise
+        except Exception:
+            finish("UNKNOWN")
+            raise
+        if streaming_out:
+            return _InstrumentedStream(result, finish)
+        finish("OK")
+        return result
+
+    return call
+
+
 def _instrument(service: str, name: str, kind: str, fn: Callable) -> Callable:
     """Wrap a handler behavior with counters + latency + a trace span.
     Response-streaming methods are timed to iterator exhaustion — the
     handler returns a generator, so wrapping the call alone would record
-    only argument binding."""
+    only argument binding. The span parents under the caller's via the
+    incoming ``traceparent`` metadata (absent/malformed → a new root),
+    and is installed as the current span while the handler runs so
+    application spans parent under it automatically."""
     from dragonfly2_tpu.utils import tracing
 
     handled, latency = _rpc_metrics()
@@ -216,7 +376,8 @@ def _instrument(service: str, name: str, kind: str, fn: Callable) -> Callable:
 
     def wrapped(request_or_iterator, context):
         tracer = tracing.get(short)
-        span = tracer.start_span(f"rpc.{name}")
+        remote = tracing.parse_traceparent(_incoming_traceparent(context))
+        span = tracer.start_span(f"rpc.{name}", parent=remote)
         t0 = time.perf_counter()
 
         def finish(code: str) -> None:
@@ -226,7 +387,8 @@ def _instrument(service: str, name: str, kind: str, fn: Callable) -> Callable:
 
         if not streaming_out:
             try:
-                resp = fn(request_or_iterator, context)
+                with tracing.use_span(span):
+                    resp = fn(request_or_iterator, context)
             except Exception:
                 finish(_code_of(context))
                 raise
@@ -237,12 +399,23 @@ def _instrument(service: str, name: str, kind: str, fn: Callable) -> Callable:
             # finally so abandonment is recorded too: a peer cancelling
             # mid-stream closes this generator (GeneratorExit, which
             # `except Exception` would miss) — exactly the broken-stream
-            # case the series exists to surface
+            # case the series exists to surface. The span activates
+            # around each resumption (not across yields): gRPC worker
+            # threads are pooled, and a context left set at a yield
+            # would leak into whatever runs on the thread next.
             code = "OK"
+            gen = fn(request_or_iterator, context)
             try:
-                yield from fn(request_or_iterator, context)
+                while True:
+                    with tracing.use_span(span):
+                        try:
+                            item = next(gen)
+                        except StopIteration:
+                            break
+                    yield item
             except GeneratorExit:
                 code = "CANCELLED"
+                gen.close()
                 raise
             except Exception:
                 code = _code_of(context)

@@ -167,8 +167,18 @@ class Announcer:
                 for chunk in self._chunks(path):
                     yield gnn_arm(chunk)
 
+        from dragonfly2_tpu.utils import tracing
+
         try:
-            self._trainer.Train(requests(), timeout=3600)
+            # the upload span is current for the Train call, so the
+            # trainer's rpc.Train span (and the async fit under it)
+            # lands in this round's trace
+            with tracing.get("scheduler").span(
+                "train_upload",
+                format=wire.FORMAT_NAME if binary else wire.CSV_FORMAT_NAME,
+                files=len(mlp_files) + len(gnn_files),
+            ):
+                self._trainer.Train(requests(), timeout=3600)
         except Exception:
             # no negotiation reset needed: every round re-probes anyway,
             # so a retry after a rolled-back trainer degrades to CSV

@@ -22,7 +22,7 @@ import numpy as np
 from dragonfly2_tpu.schema.features import (
     location_affinity as offline_location_affinity,
 )
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, tracing
 
 logger = dflog.get("scheduler.evaluator")
 
@@ -278,12 +278,19 @@ class MLEvaluator(BaseEvaluator):
         if self._model is None or not parents:
             return super().evaluate_parents(parents, child, total_piece_count)
         try:
+            if self._topology is not None:
+                # one span over the whole batch of per-pair engine
+                # lookups (a span per pair would dominate the hot path)
+                with tracing.maybe_span(
+                    "scheduler", "topology.rtt_affinity", pairs=len(parents)
+                ):
+                    rtts = [self._rtt_affinity(p, child) for p in parents]
+            else:
+                rtts = [0.0] * len(parents)
             feats = np.stack(
                 [
-                    pair_features(
-                        p, child, total_piece_count, self._rtt_affinity(p, child)
-                    )
-                    for p in parents
+                    pair_features(p, child, total_piece_count, rtt)
+                    for p, rtt in zip(parents, rtts)
                 ]
             )
             costs = self._model.predict(feats)  # [P] predicted log piece cost

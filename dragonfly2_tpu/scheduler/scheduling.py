@@ -29,7 +29,7 @@ from dragonfly2_tpu.scheduler.resource import (
     Peer,
 )
 from dragonfly2_tpu.scheduler import metrics as M
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, tracing
 
 logger = dflog.get("scheduling")
 
@@ -103,17 +103,28 @@ class Scheduling:
         decide back-to-source (peer demand or retry exhaustion) and push
         NeedBackToSourceResponse. Raises SchedulingError when the retry
         limit is exhausted and back-to-source isn't possible."""
-        from dragonfly2_tpu.utils import tracing
-
         blocklist = blocklist or set()
         n = 0
         _t0 = time.perf_counter()
-        _span = tracing.get("scheduler").start_span(
-            "schedule", peer_id=peer.id, task_id=peer.task.id
-        )
+        # the per-schedule span only exists when something will record
+        # it: the unsampled/disabled path (is_sampling False — this IS
+        # the hot path when no collector is drinking) pays a predicate
+        # and no-op calls, < 2% of the schedule wall (bench.py
+        # tracing_overhead_pct keeps that measured)
+        if tracing.is_sampling():
+            _span = tracing.get("scheduler").start_span(
+                "schedule", peer_id=peer.id, task_id=peer.task.id
+            )
+            _cm = tracing.use_span(_span)
+        else:
+            _span = tracing.NOOP_SPAN
+            _cm = tracing.noop_cm()
         M.CONCURRENT_SCHEDULE_GAUGE.inc()
         try:
-            self._schedule_loop(peer, blocklist, cancelled, n, _t0, _span)
+            # active while the loop runs so evaluator/topology child
+            # spans parent under the scheduling decision automatically
+            with _cm:
+                self._schedule_loop(peer, blocklist, cancelled, n, _t0, _span)
         except BaseException:
             _span.end("error")
             raise
@@ -212,7 +223,13 @@ class Scheduling:
             return [], False
 
         total = peer.task.total_piece_count
-        candidates = self.evaluator.evaluate_parents(candidates, peer, total)
+        # duplicated call instead of maybe_span: the unsampled branch
+        # then pays ONE predicate — not even the attrs dict build
+        if tracing.is_sampling():
+            with tracing.get("scheduler").span("evaluate", candidates=len(candidates)):
+                candidates = self.evaluator.evaluate_parents(candidates, peer, total)
+        else:
+            candidates = self.evaluator.evaluate_parents(candidates, peer, total)
         limit = self._candidate_parent_limit()
         return candidates[:limit], True
 

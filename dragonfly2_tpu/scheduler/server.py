@@ -320,6 +320,8 @@ class SchedulerServer:
             from dragonfly2_tpu.utils.metrics import MetricsServer, default_registry
 
             self._metrics = MetricsServer(default_registry, host=cfg.metrics_host, port=cfg.metrics_port)
+            # liveness on the scrape port (/healthz): the gRPC plane up
+            self._metrics.register_health("scheduler", lambda: self._grpc is not None)
             self.metrics_addr = self._metrics.start()
             logger.info("scheduler metrics on %s", self.metrics_addr)
         logger.info("scheduler gRPC on %s", addr)

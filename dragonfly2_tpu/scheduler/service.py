@@ -216,13 +216,20 @@ class SchedulerService:
     # AnnouncePeer bidi stream
     # ------------------------------------------------------------------
     def AnnouncePeer(self, request_iterator, context):
+        from dragonfly2_tpu.utils import tracing
+
         adapter = _StreamAdapter()
         state: dict = {"peer": None}
+        # the rpc.AnnouncePeer span is current on the handler thread;
+        # hand it to the pump thread so scheduling spans (fired from
+        # request handling) stay in the caller's trace
+        rpc_span = tracing.current_span()
 
         def pump():
             try:
-                for req in request_iterator:
-                    self._handle_announce(req, adapter, state)
+                with tracing.use_span(rpc_span):
+                    for req in request_iterator:
+                        self._handle_announce(req, adapter, state)
             except grpc.RpcError:
                 pass  # client hung up — normal stream teardown
             except Exception:

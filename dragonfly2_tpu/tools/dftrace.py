@@ -1,0 +1,263 @@
+"""dftrace — merge per-service trace exports into one trace tree.
+
+Every service process exports its finished spans to
+``$DF_TRACE_DIR/<service>.spans.jsonl`` (compact schema) or
+``<service>.otlp.jsonl`` (OTLP/JSON requests) — see utils/tracing. Each
+file holds ONE service's island of spans; the W3C trace-context
+propagation stitches them together by trace_id/parent_id, and this tool
+is the offline join: it reads every export in the directory, groups
+spans into traces, prints the tree for a trace, marks the critical path
+(the child chain that dominates each span's wall time), and flags the
+slowest span per tree level — the "which hop ate the latency" question
+a dashboard can't answer without a collector.
+
+Usage:
+    python -m dragonfly2_tpu.tools.dftrace [DIR] [--trace ID] [--list]
+
+DIR defaults to $DF_TRACE_DIR. With no --trace, the most recently
+finished trace is shown; --list summarizes every trace instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+@dataclass
+class SpanRec:
+    name: str
+    service: str
+    trace_id: str
+    span_id: str
+    parent_id: str
+    start_ns: int
+    end_ns: int
+    status: str
+    attributes: dict = field(default_factory=dict)
+    children: list = field(default_factory=list)
+
+    @property
+    def duration_ms(self) -> float:
+        return max(self.end_ns - self.start_ns, 0) / 1e6
+
+
+def _from_compact(line: dict) -> SpanRec:
+    return SpanRec(
+        name=line.get("name", ""),
+        service=line.get("service", ""),
+        trace_id=line.get("trace_id", ""),
+        span_id=line.get("span_id", ""),
+        parent_id=line.get("parent_id", ""),
+        start_ns=int(line.get("start_ns", 0)),
+        end_ns=int(line.get("end_ns", 0)),
+        status=line.get("status", ""),
+        attributes=line.get("attributes", {}) or {},
+    )
+
+
+_OTLP_STATUS = {1: "ok", 2: "error"}
+
+
+def _from_otlp_request(req: dict) -> list[SpanRec]:
+    out = []
+    for rs in req.get("resourceSpans", []):
+        service = ""
+        for attr in rs.get("resource", {}).get("attributes", []):
+            if attr.get("key") == "service.name":
+                service = attr.get("value", {}).get("stringValue", "")
+                # the exporter prefixes its product name; keep the tail
+                service = service.rsplit("-", 1)[-1] if "-" in service else service
+        for ss in rs.get("scopeSpans", []):
+            for sp in ss.get("spans", []):
+                attrs = {
+                    a.get("key"): next(iter(a.get("value", {}).values()), None)
+                    for a in sp.get("attributes", [])
+                }
+                out.append(
+                    SpanRec(
+                        name=sp.get("name", ""),
+                        service=service,
+                        trace_id=sp.get("traceId", ""),
+                        span_id=sp.get("spanId", ""),
+                        parent_id=sp.get("parentSpanId", ""),
+                        start_ns=int(sp.get("startTimeUnixNano", 0)),
+                        end_ns=int(sp.get("endTimeUnixNano", 0)),
+                        status=_OTLP_STATUS.get(
+                            sp.get("status", {}).get("code", 0), "unset"
+                        ),
+                        attributes=attrs,
+                    )
+                )
+    return out
+
+
+def load_spans(trace_dir: str) -> list[SpanRec]:
+    """Every span from every export file in ``trace_dir`` (both
+    formats). Unparseable lines are skipped, not fatal — a torn last
+    line from a live process must not block reading the rest."""
+    spans: list[SpanRec] = []
+    for path in sorted(Path(trace_dir).glob("*.jsonl")):
+        for raw in path.read_text().splitlines():
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                obj = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if "resourceSpans" in obj:
+                spans.extend(_from_otlp_request(obj))
+            elif "trace_id" in obj:
+                spans.append(_from_compact(obj))
+    return spans
+
+
+def build_traces(spans: list[SpanRec]) -> dict[str, list[SpanRec]]:
+    """Group by trace_id and link children (sorted by start time).
+    Returns trace_id -> roots (spans whose parent isn't in the trace —
+    a true root, or an orphan whose parent's process never exported)."""
+    by_trace: dict[str, dict[str, SpanRec]] = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, {})[s.span_id] = s
+    roots: dict[str, list[SpanRec]] = {}
+    for tid, members in by_trace.items():
+        rs = []
+        for s in members.values():
+            parent = members.get(s.parent_id) if s.parent_id else None
+            if parent is None:
+                rs.append(s)
+            else:
+                parent.children.append(s)
+        for s in members.values():
+            s.children.sort(key=lambda c: c.start_ns)
+        rs.sort(key=lambda c: c.start_ns)
+        roots[tid] = rs
+    return roots
+
+
+def critical_path(root: SpanRec) -> list[SpanRec]:
+    """Root-to-leaf chain following the longest-duration child at each
+    step — the spans whose latency bounds the whole trace."""
+    path = [root]
+    node = root
+    while node.children:
+        node = max(node.children, key=lambda c: c.duration_ms)
+        path.append(node)
+    return path
+
+
+def slowest_per_level(roots: list[SpanRec]) -> dict[int, SpanRec]:
+    """The slowest span at each tree depth across the whole trace."""
+    slow: dict[int, SpanRec] = {}
+
+    def walk(node: SpanRec, depth: int) -> None:
+        cur = slow.get(depth)
+        if cur is None or node.duration_ms > cur.duration_ms:
+            slow[depth] = node
+        for c in node.children:
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    return slow
+
+
+def render_trace(tid: str, roots: list[SpanRec], out=None) -> None:
+    out = out or sys.stdout
+    crit: set[str] = set()
+    for r in roots:
+        crit.update(s.span_id for s in critical_path(r))
+    slow = {s.span_id: d for d, s in slowest_per_level(roots).items()}
+    n = sum(1 for r in roots for _ in _iter_tree(r))
+    total = max((s.duration_ms for r in roots for s in _iter_tree(r)), default=0.0)
+    print(f"trace {tid}  ({n} spans, {total:.2f} ms)", file=out)
+
+    def line(s: SpanRec, depth: int) -> None:
+        marks = []
+        if s.span_id in crit:
+            marks.append("*")
+        if s.span_id in slow:
+            marks.append(f"slowest@L{slow[s.span_id]}")
+        if s.status == "error":
+            marks.append("ERROR")
+        mark = ("  [" + " ".join(marks) + "]") if marks else ""
+        print(
+            f"{'  ' * depth}{s.name}  ({s.service})  {s.duration_ms:.2f} ms{mark}",
+            file=out,
+        )
+        for c in s.children:
+            line(c, depth + 1)
+
+    for r in roots:
+        line(r, 0)
+    for r in roots:
+        chain = critical_path(r)
+        if len(chain) > 1:
+            print(
+                "critical path: "
+                + " -> ".join(f"{s.name}({s.duration_ms:.2f}ms)" for s in chain),
+                file=out,
+            )
+
+
+def _iter_tree(node: SpanRec):
+    yield node
+    for c in node.children:
+        yield from _iter_tree(c)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dftrace", description="merge per-service trace exports into one tree"
+    )
+    p.add_argument(
+        "dir",
+        nargs="?",
+        default=os.environ.get("DF_TRACE_DIR", ""),
+        help="trace export dir (default $DF_TRACE_DIR)",
+    )
+    p.add_argument("--trace", default="", help="trace id to show (default: latest)")
+    p.add_argument("--list", action="store_true", help="summarize every trace")
+    args = p.parse_args(argv)
+    if not args.dir:
+        p.error("no trace dir: pass DIR or set DF_TRACE_DIR")
+    if not os.path.isdir(args.dir):
+        p.error(f"not a directory: {args.dir}")
+
+    traces = build_traces(load_spans(args.dir))
+    if not traces:
+        print("no spans found", file=sys.stderr)
+        return 1
+
+    def latest_end(roots: list[SpanRec]) -> int:
+        return max((s.end_ns for r in roots for s in _iter_tree(r)), default=0)
+
+    if args.list:
+        for tid, roots in sorted(
+            traces.items(), key=lambda kv: latest_end(kv[1]), reverse=True
+        ):
+            n = sum(1 for r in roots for _ in _iter_tree(r))
+            names = ", ".join(r.name for r in roots[:3])
+            total = max(
+                (s.duration_ms for r in roots for s in _iter_tree(r)), default=0.0
+            )
+            print(f"{tid}  {n:4d} spans  {total:10.2f} ms  roots: {names}")
+        return 0
+
+    tid = args.trace
+    if not tid:
+        tid = max(traces, key=lambda t: latest_end(traces[t]))
+    if tid not in traces:
+        print(f"trace {tid} not found", file=sys.stderr)
+        return 1
+    render_trace(tid, traces[tid])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,6 +64,12 @@ class StreamStats:
     # carries the bottleneck, not a guess.
     decode_wait_s: float = 0.0
     buffer_wait_s: float = 0.0
+    # dispatcher-side split, per superbatch (single writer — the
+    # dispatcher thread): h2d_s — host→device transfer dispatch;
+    # step_s — compiled-step dispatch + the prior step's confirmation
+    # wait (the device-compute leg as the host observes it)
+    h2d_s: float = 0.0
+    step_s: float = 0.0
     # producer-side per-stage split, summed across the worker pool (so
     # with W workers the totals can exceed wall time): read_s — I/O +
     # block decode + checksum (binary) / fused read+parse (CSV, where
@@ -475,6 +481,17 @@ def stream_train_mlp(
             return jnp.asarray(buf)
 
     stats = StreamStats()
+    # exemplar for the live pipeline histograms: the owning trace
+    # (the fit span activated by Training._timed_fit) — None when no
+    # sampled trace owns this run, which skips exemplar recording
+    from dragonfly2_tpu.utils import tracing
+
+    _owner = tracing.current_span()
+    trace_exemplar = (
+        {"trace_id": _owner.trace_id}
+        if _owner is not None and _owner.sampled
+        else None
+    )
     # Pipelined packing: fixed [batch_size·k, F+1] (features ‖ label)
     # buffers cycle through a free pool → packing → a dispatcher thread
     # that runs transfer + step. A DEDICATED dispatcher thread matters on
@@ -536,14 +553,26 @@ def stream_train_mlp(
                     break
                 arg = b if k == 1 else b.reshape(k, batch_size, -1)
                 fn = step if k == 1 else scan_step
+                t_h = time.perf_counter()
+                dev = put(arg)
+                t_s = time.perf_counter()
+                dt_h = t_s - t_h
+                stats.h2d_s += dt_h
+                M.INGEST_H2D_SECONDS.observe(dt_h, exemplar=trace_exemplar)
                 state["params"], state["opt_state"], loss = fn(
-                    state["params"], state["opt_state"], put(arg)
+                    state["params"], state["opt_state"], dev
                 )
                 loss_ring.append(loss)
                 stats.steps += k
                 if prev_loss is not None:
                     jax.block_until_ready(prev_loss)
                     free_bufs.put(prev_buf)
+                # step split = this dispatch + the prior step's
+                # confirmation wait: how long the device leg held the
+                # pipeline for one superbatch, as the host sees it
+                dt_s = time.perf_counter() - t_s
+                stats.step_s += dt_s
+                M.INGEST_STEP_SECONDS.observe(dt_s, exemplar=trace_exemplar)
                 prev_loss, prev_buf = loss, b
             if prev_loss is not None:
                 jax.block_until_ready(prev_loss)
@@ -595,7 +624,9 @@ def stream_train_mlp(
                 feats, labels, rows = next(shard_iter)
             except StopIteration:
                 break
-            stats.decode_wait_s += time.perf_counter() - w0
+            dt_w = time.perf_counter() - w0
+            stats.decode_wait_s += dt_w
+            M.INGEST_DECODE_WAIT_SECONDS.observe(dt_w, exemplar=trace_exemplar)
             if budget_end is not None and time.perf_counter() > budget_end:
                 stats.truncated = True
                 break  # generator abandonment releases the producers

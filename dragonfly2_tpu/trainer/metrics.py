@@ -15,6 +15,29 @@ FIT_DURATION = _r.histogram(
 INGEST_RECORDS_TOTAL = _r.counter(
     "trainer_ingest_records_total", "Download records decoded for training"
 )
+# Live pipeline splits of the streaming train loop (trainer/ingest.py),
+# observed per shard / per superbatch WHILE a fit runs — the same
+# decode/transfer/compute attribution StreamStats totals per run, but
+# scrapeable mid-fit. Exemplars carry the owning fit's trace_id
+# (OpenMetrics exposition), so a slow bucket links to its trace.
+_INGEST_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, float("inf"),
+)
+INGEST_DECODE_WAIT_SECONDS = _r.histogram(
+    "trainer_ingest_decode_wait_seconds",
+    "Packing thread blocked on the decode queue, per shard",
+    buckets=_INGEST_BUCKETS,
+)
+INGEST_H2D_SECONDS = _r.histogram(
+    "trainer_ingest_h2d_seconds",
+    "Host-to-device superbatch transfer dispatch",
+    buckets=_INGEST_BUCKETS,
+)
+INGEST_STEP_SECONDS = _r.histogram(
+    "trainer_ingest_step_seconds",
+    "Compiled train-step dispatch + prior-step confirmation, per superbatch",
+    buckets=_INGEST_BUCKETS,
+)
 DATASET_BYTES_TOTAL = _r.counter(
     "trainer_dataset_bytes_total", "Dataset bytes received on Train streams", ("kind",)
 )

@@ -38,6 +38,10 @@ class TrainerServerConfig:
     incremental: bool = False
     streaming: bool = True
     streaming_workers: int = 1
+    # on-demand jax.profiler capture: a non-empty dir writes one XLA
+    # trace per fit under <profile_dir>/<model> (view with TensorBoard);
+    # settable per-deploy via config file or DF_TRAINER_PROFILE_DIR
+    profile_dir: str = ""
     # run fits inline with the Train RPC (tests/debug) instead of async
     synchronous: bool = False
     # Prometheus /metrics endpoint (reference trainer :8000): -1 = disabled
@@ -92,6 +96,7 @@ class TrainerServer:
                 clear_after_train=not config.incremental,
                 streaming=config.streaming,
                 streaming_workers=config.streaming_workers,
+                profile_dir=config.profile_dir,
             ),
         )
         self.service = TrainerService(
@@ -113,6 +118,8 @@ class TrainerServer:
             from dragonfly2_tpu.utils.metrics import MetricsServer, default_registry
 
             self._metrics = MetricsServer(default_registry, host=self.cfg.metrics_host, port=self.cfg.metrics_port)
+            # liveness on the scrape port (/healthz): the gRPC plane up
+            self._metrics.register_health("trainer", lambda: self._grpc is not None)
             self.metrics_addr = self._metrics.start()
             logger.info("trainer metrics on %s", self.metrics_addr)
         logger.info("trainer gRPC on %s", addr)

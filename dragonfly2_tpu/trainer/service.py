@@ -96,14 +96,24 @@ class TrainerService:
             if self.synchronous:
                 self.training.train(ip, hostname)
             else:
+                from dragonfly2_tpu.utils import tracing
+
+                # the async fit must stay in the uploader's trace: hand
+                # the rpc.Train span to the worker thread (contextvars
+                # don't cross threads on their own)
                 threading.Thread(
-                    target=self._train_safely, args=(ip, hostname), daemon=True
+                    target=self._train_safely,
+                    args=(ip, hostname, tracing.current_span()),
+                    daemon=True,
                 ).start()
         return trainer_pb2.TrainResponse()
 
-    def _train_safely(self, ip: str, hostname: str) -> None:
+    def _train_safely(self, ip: str, hostname: str, parent_span=None) -> None:
+        from dragonfly2_tpu.utils import tracing
+
         try:
-            outcome = self.training.train(ip, hostname)
+            with tracing.use_span(parent_span):
+                outcome = self.training.train(ip, hostname)
             if not outcome.ok:
                 self.train_failure_total += 1
         except Exception:

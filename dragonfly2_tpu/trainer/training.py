@@ -133,19 +133,32 @@ class Training:
     def train(self, ip: str, hostname: str) -> TrainingOutcome:
         """Fit MLP + GNN for one uploading scheduler host, concurrently
         (reference training.go:60-78 errgroup)."""
+        from dragonfly2_tpu.utils import tracing
+
         host_id = host_id_v2(ip, hostname)
         outcome = TrainingOutcome()
+        # the caller's span (rpc.Train when driven by the Train stream):
+        # fit spans in the pool threads parent under it explicitly —
+        # contextvars don't cross ThreadPoolExecutor boundaries
+        parent_span = tracing.current_span()
         # which payload form the MLP leg consumed (None until decided):
         # the post-fit clear drops exactly that form, so other-era data
         # from a format switch survives to train next round
         mlp_info: dict = {}
         with concurrent.futures.ThreadPoolExecutor(max_workers=3) as pool:
             f_mlp = pool.submit(
-                self._timed_fit, "mlp", self._train_mlp, host_id, ip, hostname, mlp_info
+                self._timed_fit, "mlp", parent_span, self._train_mlp,
+                host_id, ip, hostname, mlp_info,
             )
-            f_gnn = pool.submit(self._timed_fit, "gnn", self._train_gnn, host_id, ip, hostname)
+            f_gnn = pool.submit(
+                self._timed_fit, "gnn", parent_span, self._train_gnn,
+                host_id, ip, hostname,
+            )
             f_gru = (
-                pool.submit(self._timed_fit, "gru", self._train_gru, host_id, ip, hostname)
+                pool.submit(
+                    self._timed_fit, "gru", parent_span, self._train_gru,
+                    host_id, ip, hostname,
+                )
                 if self.config.gru
                 else None
             )
@@ -178,12 +191,14 @@ class Training:
                 self.storage.clear_network_topology(host_id)
         return outcome
 
-    def _timed_fit(self, model: str, fn, *args):
+    def _timed_fit(self, model: str, parent_span, fn, *args):
         from dragonfly2_tpu.utils import tracing
 
-        span = tracing.get("trainer").start_span("fit", model=model)
+        span = tracing.get("trainer").start_span("fit", parent=parent_span, model=model)
         profiler_cm = self._maybe_profile(model)
-        with M.FIT_DURATION.labels(model).time(), profiler_cm:
+        # the fit span is active while fn runs so the ingest pipeline can
+        # stamp its exemplars with the owning trace_id
+        with M.FIT_DURATION.labels(model).time(), profiler_cm, tracing.use_span(span):
             try:
                 result = fn(*args)
             except Exception:

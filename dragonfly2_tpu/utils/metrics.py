@@ -96,6 +96,20 @@ class Counter(_Metric):
             )
         return out
 
+    def expose_om(self) -> list[str]:
+        # OpenMetrics counters: the FAMILY name drops the _total suffix,
+        # samples keep it — same series name on the wire either way
+        family = self.name[:-6] if self.name.endswith("_total") else self.name
+        sample = f"{family}_total"
+        out = [f"# TYPE {family} counter"]
+        if self.help:
+            out.insert(0, f"# HELP {family} {self.help}")
+        for key, child in self._snapshot():
+            out.append(
+                f"{sample}{self._fmt_labels(self.label_names, key)} {child.value}"
+            )
+        return out
+
 
 class _GaugeChild:
     __slots__ = ("_value", "_lock")
@@ -105,7 +119,10 @@ class _GaugeChild:
         self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
-        self._value = float(v)
+        # same lock as inc: an unlocked set racing a read-modify-write
+        # inc can lose whichever lands second
+        with self._lock:
+            self._value = float(v)
 
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
@@ -146,24 +163,39 @@ class Gauge(_Metric):
             )
         return out
 
+    def expose_om(self) -> list[str]:
+        out = self.expose()
+        if not self.help:
+            out = out[1:]
+        return out
+
 
 class _HistogramChild:
-    __slots__ = ("buckets", "counts", "total", "count", "_lock")
+    __slots__ = ("buckets", "counts", "total", "count", "exemplars", "_lock")
 
     def __init__(self, buckets):
         self.buckets = buckets
         self.counts = [0] * len(buckets)
         self.total = 0.0
         self.count = 0
+        # bucket index -> (labels, value, unix_ts): the most recent
+        # exemplar per bucket (OpenMetrics keeps one; trace_id exemplars
+        # let a dashboard jump from a latency bucket to the owning trace)
+        self.exemplars: dict[int, tuple[dict, float, float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: "dict | None" = None) -> None:
         with self._lock:
             self.total += v
             self.count += 1
+            first = None
             for i, b in enumerate(self.buckets):
                 if v <= b:
+                    if first is None:
+                        first = i
                     self.counts[i] += 1
+            if exemplar and first is not None:
+                self.exemplars[first] = (dict(exemplar), v, time.time())
 
     def time(self):
         return _Timer(self)
@@ -194,25 +226,42 @@ class Histogram(_Metric):
     def _new_child(self):
         return _HistogramChild(self.buckets)
 
-    def observe(self, v: float) -> None:
-        self._default_child().observe(v)
+    def observe(self, v: float, exemplar: "dict | None" = None) -> None:
+        self._default_child().observe(v, exemplar=exemplar)
 
     def time(self):
         return self._default_child().time()
 
     def expose(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        return self._expose_lines(exemplars=False)
+
+    def expose_om(self) -> list[str]:
+        return self._expose_lines(exemplars=True)
+
+    def _expose_lines(self, exemplars: bool) -> list[str]:
+        out = [f"# TYPE {self.name} histogram"]
+        if self.help:
+            out.insert(0, f"# HELP {self.name} {self.help}")
         for key, child in self._snapshot():
             base = self._fmt_labels(self.label_names, key)
-            for b, c in zip(child.buckets, child.counts):
+            with child._lock:
+                counts = list(child.counts)
+                ex = dict(child.exemplars) if exemplars else {}
+                total, count = child.total, child.count
+            for i, (b, c) in enumerate(zip(child.buckets, counts)):
                 le = "+Inf" if b == float("inf") else repr(b)
                 if base:
                     lbl = base[:-1] + f',le="{le}"}}'
                 else:
                     lbl = f'{{le="{le}"}}'
-                out.append(f"{self.name}_bucket{lbl} {c}")
-            out.append(f"{self.name}_sum{base} {child.total}")
-            out.append(f"{self.name}_count{base} {child.count}")
+                line = f"{self.name}_bucket{lbl} {c}"
+                if i in ex:
+                    labels, v, ts = ex[i]
+                    pairs = ",".join(f'{k}="{val}"' for k, val in labels.items())
+                    line += f" # {{{pairs}}} {v} {ts:.3f}"
+                out.append(line)
+            out.append(f"{self.name}_sum{base} {total}")
+            out.append(f"{self.name}_count{base} {count}")
         return out
 
 
@@ -253,10 +302,32 @@ class Registry:
             lines.extend(metric.expose())
         return "\n".join(lines) + "\n"
 
+    def expose_openmetrics(self) -> str:
+        """OpenMetrics text exposition: the format that carries
+        exemplars (trace_id on histogram buckets). Served by
+        MetricsServer when the scraper negotiates it via Accept."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        lines: list[str] = []
+        for metric in metrics:
+            lines.extend(metric.expose_om())
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+
+OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
 
 class MetricsServer:
     """GET /metrics on its own port (reference runs one per service on
-    :8000, trainer/metrics/metrics.go:38)."""
+    :8000, trainer/metrics/metrics.go:38). A scraper sending
+    ``Accept: application/openmetrics-text`` gets the OpenMetrics form
+    (with exemplars); everyone else the classic 0.0.4 text.
+
+    GET /healthz answers per-service liveness as JSON on the same port
+    deploys already scrape: services register named probes via
+    ``register_health``; 200 while every probe passes, 503 otherwise.
+    Unknown paths stay 404."""
 
     def __init__(self, registry: Registry, host: str = "127.0.0.1", port: int = 0):
         self.registry = registry
@@ -264,22 +335,63 @@ class MetricsServer:
         self.port = port
         self._httpd = None
         self._thread = None
+        self._started_at = time.time()
+        self._health: dict[str, object] = {}
+
+    def register_health(self, service: str, probe) -> None:
+        """Register a liveness probe: a zero-arg callable returning a
+        truthy value (or raising) — e.g. ``lambda: server.running``."""
+        self._health[service] = probe
+
+    def health_snapshot(self) -> tuple[bool, dict]:
+        services = {}
+        ok = True
+        for name, probe in sorted(self._health.items()):
+            try:
+                alive = bool(probe())
+            except Exception:
+                alive = False
+            services[name] = "ok" if alive else "down"
+            ok = ok and alive
+        return ok, {
+            "status": "ok" if ok else "degraded",
+            "uptime_s": round(time.time() - self._started_at, 3),
+            "services": services,
+        }
 
     def start(self) -> str:
         registry = self.registry
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 pass
 
             def do_GET(self):
+                if self.path == "/healthz":
+                    import json
+
+                    ok, body = server.health_snapshot()
+                    data = json.dumps(body).encode()
+                    self.send_response(200 if ok else 503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 if self.path != "/metrics":
                     self.send_response(404)
                     self.end_headers()
                     return
-                data = registry.expose().encode()
+                accept = self.headers.get("Accept", "")
+                if "application/openmetrics-text" in accept:
+                    data = registry.expose_openmetrics().encode()
+                    ctype = OPENMETRICS_CONTENT_TYPE
+                else:
+                    data = registry.expose().encode()
+                    ctype = "text/plain; version=0.0.4"
                 self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

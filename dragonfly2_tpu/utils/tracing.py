@@ -15,8 +15,19 @@ events, and three sinks:
 - optional OTLP/HTTP push (``DF_TRACE_OTLP_ENDPOINT``): batched POSTs
   of the same request shape to a collector's ``/v1/traces``.
 
+Cross-process propagation is W3C trace-context: ``format_traceparent``
+/ ``parse_traceparent`` carry ``00-<trace32>-<span16>-<flags>`` over
+gRPC invocation metadata (rpc/glue injects client-side and extracts
+server-side), and a contextvar-held current span lets application code
+parent automatically — ``start_span`` with no explicit parent becomes a
+child of whatever span is active on this thread/context. Per-span
+sampling (the traceparent flags byte) is decided once at the root and
+inherited down the tree; unsampled spans propagate their ids but are
+dropped by all three sinks.
+
 Env: ``DF_TRACE_DIR`` (file export dir), ``DF_TRACE_FORMAT``
-(``jsonl``|``otlp``, default jsonl), ``DF_TRACE_OTLP_ENDPOINT``. The
+(``jsonl``|``otlp``, default jsonl), ``DF_TRACE_OTLP_ENDPOINT``,
+``DF_TRACE_SAMPLE`` (root sampling ratio in [0,1], default 1). The
 compute plane adds `jax.profiler` traces via trainer config
 (profile_dir), the XLA-side equivalent.
 """
@@ -24,14 +35,152 @@ compute plane adds `jax.profiler` traces via trainer config
 from __future__ import annotations
 
 import collections
+import contextvars
 import json
 import os
+import random
+import re
 import threading
 import time
 import uuid
 from dataclasses import dataclass, field
 
 _RING_SIZE = 1024
+
+TRACEPARENT_HEADER = "traceparent"
+
+
+# Span ids come from the stdlib Mersenne generator, not uuid4: trace ids
+# need uniqueness, not unpredictability, and uuid4 costs ~30x more per
+# id (an os.urandom syscall each) — real money on the scheduling hot
+# path. The shared Random's C-level methods are GIL-atomic in CPython.
+def _gen_trace_id() -> str:
+    return f"{random.getrandbits(128):032x}"
+
+
+def _gen_span_id() -> str:
+    return f"{random.getrandbits(64):016x}"
+
+# the current span for this thread/context — the implicit parent for
+# spans started without an explicit one (contextvars, not a threading
+# local: generator-based gRPC handlers resume on the same thread but
+# must not leak context between resumptions)
+_current: "contextvars.ContextVar[Span | None]" = contextvars.ContextVar(
+    "df_current_span", default=None
+)
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """A remote parent: just the propagated identity (what a
+    ``traceparent`` header carries), no recording behavior."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool = True
+
+
+def format_traceparent(span: "Span | SpanContext") -> str:
+    """W3C traceparent (version 00) for ``span``:
+    ``00-<trace32>-<span16>-<flags>`` with the sampled bit from the
+    span's sampling decision."""
+    flags = "01" if getattr(span, "sampled", True) else "00"
+    return f"00-{span.trace_id}-{span.span_id}-{flags}"
+
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+def parse_traceparent(header: "str | None") -> "SpanContext | None":
+    """Parse a ``traceparent`` header into a SpanContext, or None for
+    absent/malformed input — the caller starts a new root instead of
+    crashing (W3C: invalid trace-context is discarded, never fatal)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, span_id, flags = m.groups()
+    # version ff is forbidden; all-zero ids are the spec's invalid values
+    if version == "ff" or trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return SpanContext(trace_id, span_id, sampled=bool(int(flags, 16) & 0x01))
+
+
+def current_span() -> "Span | None":
+    return _current.get()
+
+
+def is_sampling() -> bool:
+    """True when a span started now would be recorded: the current span
+    is sampled, or there is no current span and the root ratio can
+    sample. Hot paths use this to skip span construction entirely —
+    pair with ``NOOP_SPAN``/``noop_cm`` for the not-sampling branch."""
+    cur = _current.get()
+    if cur is not None:
+        return cur.sampled
+    return _sample_ratio > 0.0
+
+
+class _NoopCm:
+    """Context manager that does nothing — not even contextvar writes.
+    Safe exactly when ``is_sampling()`` is False: the context is either
+    already the unsampled span (nested case) or has no span and a zero
+    ratio, so every span started inside is unsampled anyway."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return _UNSAMPLED
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NOOP_CM = _NoopCm()
+
+
+def maybe_span(service: str, name: str, **attrs):
+    """``get(service).span(name, **attrs)`` when sampling, a free no-op
+    context manager otherwise — the form for hot-path child spans whose
+    construction cost must vanish on the unsampled/disabled path."""
+    if is_sampling():
+        return get(service).span(name, **attrs)
+    return _NOOP_CM
+
+
+def noop_cm() -> _NoopCm:
+    return _NOOP_CM
+
+
+class use_span:
+    """Make ``span`` the current span for the duration of the block —
+    the explicit hand-off for code that crosses threads (capture
+    ``current_span()`` in the spawning thread, activate it in the
+    worker). A plain class, not @contextmanager: the generator protocol
+    costs ~3x more per entry and this sits on scheduling's hot path."""
+
+    __slots__ = ("_span", "_token")
+
+    def __init__(self, span: "Span | None"):
+        self._span = span
+
+    def __enter__(self) -> "Span | None":
+        # already current (re-activation on the same context — the
+        # unsampled hot path, where one shared span is everywhere):
+        # nothing to change, nothing to undo
+        if _current.get() is self._span:
+            self._token = None
+        else:
+            self._token = _current.set(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._token is not None:
+            _current.reset(self._token)
+        return False
 
 
 @dataclass
@@ -44,9 +193,11 @@ class Span:
     start_ns: int = 0
     end_ns: int = 0
     status: str = "ok"
+    sampled: bool = True
     attributes: dict = field(default_factory=dict)
     events: list = field(default_factory=list)
     _tracer: "Tracer | None" = None
+    _ctx_token: object = field(default=None, repr=False, compare=False)
 
     # ------------------------------------------------------------------
     def set(self, **attrs) -> "Span":
@@ -66,7 +217,13 @@ class Span:
 
     def child(self, name: str, **attrs) -> "Span":
         if self._tracer is None:
-            return Span(name, self.trace_id, uuid.uuid4().hex[:16])
+            return Span(
+                name,
+                self.trace_id,
+                _gen_span_id(),
+                parent_id=self.span_id,
+                sampled=self.sampled,
+            )
         return self._tracer.start_span(
             name, parent=self, **attrs
         )
@@ -75,13 +232,80 @@ class Span:
     def duration_ms(self) -> float:
         return (self.end_ns - self.start_ns) / 1e6 if self.end_ns else 0.0
 
-    # context-manager sugar
+    # context-manager sugar: entering a span also makes it the current
+    # span, so everything started inside the block parents under it
     def __enter__(self) -> "Span":
+        self._ctx_token = _current.set(self)
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._ctx_token is not None:
+            _current.reset(self._ctx_token)
+            self._ctx_token = None
         self.end("error" if exc_type is not None else "ok")
         return False
+
+
+class _UnsampledSpan(Span):
+    """The unsampled fast path: ONE shared instance serves every
+    unsampled trace. Unsampled spans are never recorded by any sink —
+    their only job is answering ``current_span()``/``format_traceparent``
+    so the sampled=false decision propagates downstream — so fixed ids
+    and no-op mutators are indistinguishable from per-span state, and
+    the hot path pays an allocation-free branch instead of id
+    generation. Entering uses a per-context depth counter (the shared
+    instance cannot hold per-entry state): only the outermost entry
+    flips the current span."""
+
+    def set(self, **attrs) -> "Span":
+        return self
+
+    def event(self, name: str, **attrs) -> None:
+        pass
+
+    def end(self, status: str = "ok") -> None:
+        pass
+
+    def child(self, name: str, **attrs) -> "Span":
+        return self
+
+    def __enter__(self) -> "Span":
+        d = _unsampled_depth.get()
+        if d == 0:
+            _unsampled_token.set(_current.set(self))
+        _unsampled_depth.set(d + 1)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        d = _unsampled_depth.get() - 1
+        _unsampled_depth.set(d)
+        if d == 0:
+            token = _unsampled_token.get()
+            if token is not None:
+                _current.reset(token)
+                _unsampled_token.set(None)
+        return False
+
+
+# per-context nesting state for the shared unsampled span: only the
+# OUTERMOST with-entry flips the current span; nested entries (the hot
+# case — every span inside an unsampled trace is the same object) cost
+# two contextvar ops and no allocation
+_unsampled_depth: "contextvars.ContextVar[int]" = contextvars.ContextVar(
+    "df_unsampled_depth", default=0
+)
+_unsampled_token: "contextvars.ContextVar[object]" = contextvars.ContextVar(
+    "df_unsampled_token", default=None
+)
+_UNSAMPLED = _UnsampledSpan(
+    name="unsampled",
+    trace_id=uuid.uuid4().hex,
+    span_id=uuid.uuid4().hex[:16],
+    sampled=False,
+)
+# public alias: the placeholder for "no span here" code paths guarded
+# by is_sampling() — every Span method is a safe no-op on it
+NOOP_SPAN = _UNSAMPLED
 
 
 # ---------------------------------------------------------------------------
@@ -165,6 +389,7 @@ class _OtlpHttpPusher:
     MAX_BATCH = 256
 
     def __init__(self, endpoint: str, service: str):
+        self.endpoint_raw = endpoint  # as configured, for change detection
         self.endpoint = endpoint.rstrip("/")
         if not self.endpoint.endswith("/v1/traces"):
             self.endpoint += "/v1/traces"
@@ -212,6 +437,10 @@ class _OtlpHttpPusher:
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=10)
+        # a span enqueued between the worker's final flush and this
+        # join would sit in the deque forever — drain it here, so
+        # everything enqueued before stop() returns is flushed
+        self._flush_once()
 
 
 class Tracer:
@@ -235,23 +464,55 @@ class Tracer:
             os.makedirs(os.path.dirname(export_path) or ".", exist_ok=True)
             self._file = open(export_path, "a", buffering=1)
 
-    def start_span(self, name: str, parent: Span | None = None, **attrs) -> Span:
+    def start_span(
+        self, name: str, parent: "Span | SpanContext | None" = None, **attrs
+    ) -> Span:
+        """Start a span. ``parent`` may be a local Span, a SpanContext
+        extracted from a ``traceparent`` header, or None — in which case
+        the contextvar-held current span (if any) is the parent, so
+        application code parents automatically. A true root draws the
+        sampling decision from the configured ratio; children always
+        inherit the root's."""
+        if parent is None:
+            parent = _current.get()
+        if parent is not None:
+            if not getattr(parent, "sampled", True):
+                # the whole subtree of an unsampled root is unsampled
+                # and unrecorded — the shared no-op span carries the
+                # decision without per-span allocation
+                return _UNSAMPLED
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+        else:
+            ratio = _sample_ratio
+            if not (ratio >= 1.0 or (ratio > 0.0 and random.random() < ratio)):
+                return _UNSAMPLED
+            trace_id = _gen_trace_id()
+            parent_id = ""
         return Span(
             name=name,
-            trace_id=parent.trace_id if parent else uuid.uuid4().hex,
-            span_id=uuid.uuid4().hex[:16],
-            parent_id=parent.span_id if parent else "",
+            trace_id=trace_id,
+            span_id=_gen_span_id(),
+            parent_id=parent_id,
             service=self.service,
             start_ns=time.time_ns(),
             attributes=dict(attrs),
             _tracer=self,
         )
 
-    def span(self, name: str, parent: Span | None = None, **attrs) -> Span:
+    def span(
+        self, name: str, parent: "Span | SpanContext | None" = None, **attrs
+    ) -> Span:
         """Context-manager form: ``with tracer.span("x") as sp: ...``."""
         return self.start_span(name, parent=parent, **attrs)
 
     def _record(self, span: Span) -> None:
+        if not span.sampled:
+            # the sampling flag is honored by ALL sinks (ring included):
+            # an unsampled span exists only to propagate its ids, and
+            # skipping before the lock keeps the unsampled hot path at
+            # a dict-build + branch
+            return
         with self._lock:
             self.finished.append(span)
             if self._file is not None:
@@ -274,8 +535,11 @@ class Tracer:
                         default=str,
                     )
                 self._file.write(line + "\n")
-        if self._pusher is not None:
-            self._pusher.enqueue(span)
+            # enqueue under the lock (it's a deque append): _reconfigure
+            # swaps the pusher under this same lock, so a span can never
+            # land on a pusher that was already swapped out and stopped
+            if self._pusher is not None:
+                self._pusher.enqueue(span)
 
     def close(self) -> None:
         with self._lock:
@@ -285,50 +549,101 @@ class Tracer:
         if self._pusher is not None:
             self._pusher.stop()
 
+    def _reconfigure(
+        self, export_path: "str | None", fmt: str, otlp_endpoint: "str | None"
+    ) -> None:
+        """Rebind this tracer's sinks to fresh export options — called
+        by ``configure()`` on every CACHED tracer, so a later configure
+        actually takes effect instead of tracers keeping the path/
+        format/endpoint they were created with."""
+        old_pusher = None
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            self.export_path = export_path
+            self.fmt = fmt
+            if export_path:
+                os.makedirs(os.path.dirname(export_path) or ".", exist_ok=True)
+                self._file = open(export_path, "a", buffering=1)
+            # swap under the same lock _record enqueues under, so no
+            # span can land on the outgoing pusher after its final drain
+            current = self._pusher.endpoint_raw if self._pusher is not None else None
+            if (otlp_endpoint or None) != current:
+                old_pusher = self._pusher
+                self._pusher = (
+                    _OtlpHttpPusher(otlp_endpoint, self.service)
+                    if otlp_endpoint
+                    else None
+                )
+        if old_pusher is not None:
+            old_pusher.stop()  # outside the lock: join can take seconds
+
 
 _tracers: dict[str, Tracer] = {}
 _config_lock = threading.Lock()
 _export_dir: str | None = os.environ.get("DF_TRACE_DIR") or None
 _export_fmt: str = os.environ.get("DF_TRACE_FORMAT", "jsonl")
 _otlp_endpoint: str | None = os.environ.get("DF_TRACE_OTLP_ENDPOINT") or None
+try:
+    _sample_ratio: float = min(
+        1.0, max(0.0, float(os.environ.get("DF_TRACE_SAMPLE", "1")))
+    )
+except ValueError:
+    _sample_ratio = 1.0
 
 
 _UNSET = object()
+
+
+def _path_for(service: str) -> "str | None":
+    suffix = "otlp.jsonl" if _export_fmt == "otlp" else "spans.jsonl"
+    return os.path.join(_export_dir, f"{service}.{suffix}") if _export_dir else None
 
 
 def configure(
     export_dir: str | None,
     fmt=_UNSET,
     otlp_endpoint=_UNSET,
+    sample_ratio=_UNSET,
 ) -> None:
-    """Set export options for tracers created after this call (one file
-    per service). ``fmt``: "jsonl" (compact debug schema) or "otlp"
-    (one ExportTraceServiceRequest per line — collector/Jaeger
-    ingestible). ``otlp_endpoint`` additionally pushes batches to a
-    collector's /v1/traces over HTTP. Consistent None semantics: an
+    """Set export options for every tracer — CACHED tracers are rebound
+    in place (one file per service). ``fmt``: "jsonl" (compact debug
+    schema) or "otlp" (one ExportTraceServiceRequest per line —
+    collector/Jaeger ingestible). ``otlp_endpoint`` additionally pushes
+    batches to a collector's /v1/traces over HTTP. ``sample_ratio``
+    sets the root-span sampling probability (children inherit; spans
+    already started keep their decision). Consistent None semantics: an
     EXPLICIT None clears the option (export_dir=None → ring only,
     otlp_endpoint=None → push off); an omitted argument leaves the
     current value untouched."""
-    global _export_dir, _export_fmt, _otlp_endpoint
+    global _export_dir, _export_fmt, _otlp_endpoint, _sample_ratio
     with _config_lock:
         _export_dir = export_dir
         if fmt is not _UNSET:
             _export_fmt = fmt or "jsonl"
         if otlp_endpoint is not _UNSET:
             _otlp_endpoint = otlp_endpoint
+        if sample_ratio is not _UNSET:
+            _sample_ratio = min(1.0, max(0.0, float(sample_ratio)))
+        for service, tracer in _tracers.items():
+            tracer._reconfigure(_path_for(service), _export_fmt, _otlp_endpoint)
 
 
 def get(service: str) -> Tracer:
+    # lock-free fast path (GIL-safe dict read): get() sits on every
+    # span-creating hot path, and configure() rebinds cached tracers in
+    # place rather than replacing them, so a hit never needs the lock
+    tracer = _tracers.get(service)
+    if tracer is not None:
+        return tracer
     with _config_lock:
         tracer = _tracers.get(service)
         if tracer is None:
-            suffix = "otlp.jsonl" if _export_fmt == "otlp" else "spans.jsonl"
-            path = (
-                os.path.join(_export_dir, f"{service}.{suffix}")
-                if _export_dir
-                else None
-            )
             tracer = _tracers[service] = Tracer(
-                service, path, fmt=_export_fmt, otlp_endpoint=_otlp_endpoint
+                service,
+                _path_for(service),
+                fmt=_export_fmt,
+                otlp_endpoint=_otlp_endpoint,
             )
         return tracer

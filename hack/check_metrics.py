@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""Metric-registration lint: walk the package source for Prometheus
+series registrations (``.counter(...)``/``.gauge(...)``/
+``.histogram(...)`` calls with a literal name) and fail on
+
+- duplicate names registered from more than one call site — two modules
+  silently sharing (or fighting over) one series,
+- kind mismatches — one name registered as different metric kinds,
+- names violating the ``dragonfly_<service>_...`` convention: the
+  registry prefixes every name with ``dragonfly_``, so a registered
+  name must start with a known service segment, use only
+  ``[a-z0-9_]``, and counters must end in ``_total`` (which the
+  OpenMetrics exposition depends on).
+
+Run standalone (``python hack/check_metrics.py``) or via the tier-1
+test that wraps :func:`check`.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+PACKAGE = Path(__file__).resolve().parent.parent / "dragonfly2_tpu"
+
+# the service segment a series name must start with — one per process
+# role plus the shared rpc glue series
+ALLOWED_SERVICES = ("scheduler", "trainer", "daemon", "manager", "topology", "rpc")
+
+KINDS = ("counter", "gauge", "histogram")
+
+
+def _registrations(path: Path) -> list[tuple[str, str, int]]:
+    """(name, kind, lineno) for every literal metric registration in
+    ``path``. Only attribute calls are considered (``_r.counter(...)``),
+    which is how every registration in the package is written; local
+    ``Registry("...")`` instances in tests/bench are out of scope."""
+    try:
+        tree = ast.parse(path.read_text())
+    except SyntaxError:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr in KINDS):
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            out.append((first.value, fn.attr, node.lineno))
+    return out
+
+
+def check(package_dir: Path = PACKAGE) -> list[str]:
+    """Returns a list of human-readable failures (empty = clean)."""
+    failures: list[str] = []
+    seen: dict[str, tuple[str, str]] = {}  # name -> (kind, site)
+    for path in sorted(package_dir.rglob("*.py")):
+        rel = path.relative_to(package_dir.parent)
+        for name, kind, lineno in _registrations(path):
+            site = f"{rel}:{lineno}"
+            if not name.replace("_", "").replace("-", "").isascii() or not all(
+                c.islower() or c.isdigit() or c == "_" for c in name
+            ):
+                failures.append(
+                    f"{site}: {name!r} has characters outside [a-z0-9_]"
+                )
+            service = name.split("_", 1)[0]
+            if service not in ALLOWED_SERVICES:
+                failures.append(
+                    f"{site}: {name!r} does not start with a known service"
+                    f" segment {ALLOWED_SERVICES} (full name is"
+                    f" dragonfly_{name})"
+                )
+            if kind == "counter" and not name.endswith("_total"):
+                failures.append(
+                    f"{site}: counter {name!r} must end in _total"
+                    " (OpenMetrics counter naming)"
+                )
+            prev = seen.get(name)
+            if prev is not None:
+                prev_kind, prev_site = prev
+                if prev_kind != kind:
+                    failures.append(
+                        f"{site}: {name!r} registered as {kind} but"
+                        f" {prev_site} registered it as {prev_kind}"
+                    )
+                else:
+                    failures.append(
+                        f"{site}: duplicate registration of {name!r}"
+                        f" (first at {prev_site})"
+                    )
+            else:
+                seen[name] = (kind, site)
+    # OpenMetrics family collisions: a counter 'x_total' exposes under
+    # family 'x' — a sibling metric literally named 'x' would produce a
+    # duplicate family the strict parser rejects on every scrape
+    for name, (kind, site) in seen.items():
+        if kind == "counter" and name.endswith("_total"):
+            family = name[: -len("_total")]
+            if family in seen:
+                failures.append(
+                    f"{site}: counter {name!r} exposes as OpenMetrics"
+                    f" family {family!r}, colliding with the metric of"
+                    f" that name at {seen[family][1]}"
+                )
+    return failures
+
+
+def main() -> int:
+    failures = check()
+    for f in failures:
+        print(f"check_metrics: {f}", file=sys.stderr)
+    if failures:
+        print(f"check_metrics: {len(failures)} failure(s)", file=sys.stderr)
+        return 1
+    print(f"check_metrics: OK ({PACKAGE})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

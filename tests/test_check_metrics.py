@@ -1,0 +1,51 @@
+"""hack/check_metrics.py — the metric-registration lint stays green on
+the real package and actually catches the defect classes it exists for
+(duplicates, kind mismatches, naming-convention violations)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "hack"))
+
+import check_metrics  # noqa: E402
+
+
+def test_package_registrations_are_clean():
+    failures = check_metrics.check()
+    assert failures == [], "\n".join(failures)
+
+
+def test_lint_catches_defects(tmp_path):
+    pkg = tmp_path / "fakepkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "from x import default_registry as _r\n"
+        '_r.counter("scheduler_good_total", "ok")\n'
+        '_r.counter("scheduler_dup_total", "first")\n'
+        '_r.gauge("nosuchservice_thing", "bad prefix")\n'
+        '_r.counter("trainer_missing_suffix", "counter sans _total")\n'
+        '_r.gauge("daemon_BadCase", "uppercase")\n'
+    )
+    (pkg / "b.py").write_text(
+        "from x import default_registry as _r\n"
+        '_r.counter("scheduler_dup_total", "second site")\n'
+        '_r.gauge("scheduler_good_total", "kind clash")\n'
+        '_r.gauge("manager_reqs", "family base")\n'
+        '_r.counter("manager_reqs_total", "collides with manager_reqs in OM")\n'
+    )
+    failures = check_metrics.check(pkg)
+    text = "\n".join(failures)
+    assert "colliding with the metric of" in text  # x vs x_total
+    assert "duplicate registration of 'scheduler_dup_total'" in text
+    assert "registered as gauge" in text  # kind mismatch across files
+    assert "nosuchservice_thing" in text
+    assert "must end in _total" in text
+    assert "daemon_BadCase" in text
+    # the clean one appears in no failure line
+    assert "scheduler_good_total' does not" not in text
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    assert check_metrics.main() == 0
+    out = capsys.readouterr()
+    assert "OK" in out.out

@@ -2,6 +2,7 @@
 exposition format, labels, histograms, /metrics server, and end-to-end
 series movement through a real P2P download."""
 
+import urllib.error
 import urllib.request
 
 import pytest
@@ -59,6 +60,125 @@ def test_registry_dedupes_and_rejects_kind_change():
     assert a is b
     with pytest.raises(ValueError):
         r.gauge("x_total")
+
+
+def test_gauge_set_and_inc_share_the_lock():
+    """set/inc consistency: a set must never lose a racing inc (both
+    sides hold the child lock now)."""
+    import threading
+
+    r = Registry("t2b")
+    g = r.gauge("contended")
+    g.set(0)
+
+    def bump():
+        for _ in range(5000):
+            g.inc()
+
+    threads = [threading.Thread(target=bump) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert g.value == 20000.0
+    g.set(7)
+    g.inc(2)
+    assert g.value == 9.0
+
+
+def test_openmetrics_exposition_with_exemplars_parses():
+    """The OpenMetrics form (the format that carries exemplars) must be
+    ingestible by a real OpenMetrics parser: counter families drop the
+    _total suffix, histogram buckets carry `# {trace_id=...}` exemplars,
+    and the payload ends with # EOF."""
+    from prometheus_client.openmetrics import parser
+
+    r = Registry("om")
+    r.counter("req_total", "requests").inc(3)
+    r.gauge("live", "liveness", ("svc",)).labels("a").set(2)
+    h = r.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar={"trace_id": "ab" * 16})
+    h.observe(0.5)
+    text = r.expose_openmetrics()
+    assert text.endswith("# EOF\n")
+    fams = {f.name: f for f in parser.text_string_to_metric_families(text)}
+    assert fams["om_req"].type == "counter"
+    assert fams["om_req"].samples[0].name == "om_req_total"
+    assert fams["om_req"].samples[0].value == 3.0
+    assert fams["om_live"].type == "gauge"
+    hist = fams["om_lat_seconds"]
+    assert hist.type == "histogram"
+    by_le = {s.labels.get("le"): s for s in hist.samples if s.name.endswith("_bucket")}
+    ex = by_le["0.1"].exemplar
+    assert ex is not None
+    assert ex.labels == {"trace_id": "ab" * 16}
+    assert ex.value == 0.05
+    # the classic 0.0.4 text form is unchanged (no exemplars, no EOF)
+    classic = r.expose()
+    assert "# EOF" not in classic and "# {" not in classic
+    assert "om_req_total 3.0" in classic
+
+
+def test_metrics_server_content_negotiation_and_healthz():
+    """One port serves all three: classic text, OpenMetrics on Accept,
+    and /healthz liveness JSON; unknown paths stay 404."""
+    import json
+
+    r = Registry("t5b")
+    r.counter("up_total").inc()
+    srv = MetricsServer(r)
+    alive = {"ok": True}
+    srv.register_health("scheduler", lambda: alive["ok"])
+    srv.register_health("kv", lambda: True)
+    addr = srv.start()
+    try:
+        req = urllib.request.Request(
+            f"http://{addr}/metrics",
+            headers={"Accept": "application/openmetrics-text; version=1.0.0"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.headers["Content-Type"].startswith("application/openmetrics-text")
+            assert resp.read().decode().endswith("# EOF\n")
+        with urllib.request.urlopen(f"http://{addr}/healthz", timeout=5) as resp:
+            assert resp.status == 200
+            body = json.loads(resp.read())
+        assert body["status"] == "ok"
+        assert body["services"] == {"kv": "ok", "scheduler": "ok"}
+        assert body["uptime_s"] >= 0
+        # a failing probe flips the status and the HTTP code
+        alive["ok"] = False
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"http://{addr}/healthz", timeout=5)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["services"]["scheduler"] == "down"
+        # unknown paths unchanged
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"http://{addr}/nope", timeout=5)
+        assert exc.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_healthz_on_server_assembly(tmp_path):
+    """A real assembly registers its liveness probe: the trainer's
+    /healthz answers on the metrics port it already scrapes."""
+    import json
+
+    from dragonfly2_tpu.trainer.server import TrainerServer, TrainerServerConfig
+
+    server = TrainerServer(
+        TrainerServerConfig(data_dir=str(tmp_path / "t"), metrics_port=0)
+    )
+    server.serve()
+    try:
+        with urllib.request.urlopen(
+            f"http://{server.metrics_addr}/healthz", timeout=5
+        ) as resp:
+            body = json.loads(resp.read())
+        assert body["status"] == "ok"
+        assert body["services"] == {"trainer": "ok"}
+    finally:
+        server.stop()
 
 
 def test_metrics_server_scrape():
@@ -238,6 +358,7 @@ def test_documented_series_exist():
     from dragonfly2_tpu.utils.metrics import default_registry
 
     glue._rpc_metrics()  # rpc series register lazily on first server build
+    glue._rpc_client_metrics()  # client twins register on first client call
 
     doc = open(
         os.path.join(os.path.dirname(__file__), "..", "docs", "metrics.md")

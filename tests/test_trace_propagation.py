@@ -1,0 +1,458 @@
+"""W3C trace-context propagation: traceparent encode/parse, contextvar
+parenting, sampling, client-side RPC instrumentation, and the
+end-to-end single-trace guarantee — one trace_id from the dfget client
+call through the daemon conductor, the scheduler's rpc/scheduling
+spans, and the trainer's fit."""
+
+import json
+import threading
+
+import pytest
+
+from dragonfly2_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def _full_sampling():
+    """Tests assert recorded spans; pin the ratio in case another test
+    (or env) lowered it."""
+    prev = tracing._sample_ratio
+    tracing._sample_ratio = 1.0
+    yield
+    tracing._sample_ratio = prev
+
+
+# ---------------------------------------------------------------------------
+# traceparent encode/parse
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_round_trip():
+    tr = tracing.Tracer("svc")
+    span = tr.start_span("x")
+    header = tracing.format_traceparent(span)
+    assert header == f"00-{span.trace_id}-{span.span_id}-01"
+    ctx = tracing.parse_traceparent(header)
+    assert ctx is not None
+    assert ctx.trace_id == span.trace_id
+    assert ctx.span_id == span.span_id
+    assert ctx.sampled is True
+
+    # unsampled flags round-trip too
+    tracing._sample_ratio = 0.0
+    unsampled = tr.start_span("y")
+    header = tracing.format_traceparent(unsampled)
+    assert header.endswith("-00")
+    ctx = tracing.parse_traceparent(header)
+    assert ctx is not None and ctx.sampled is False
+
+
+@pytest.mark.parametrize(
+    "header",
+    [
+        None,
+        "",
+        "garbage",
+        "00-abc-def-01",  # ids too short
+        "zz-" + "a" * 32 + "-" + "b" * 16 + "-01",  # bad version chars
+        "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",  # forbidden version
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",  # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "00-" + "A" * 32 + "-" + "b" * 16,  # missing flags
+    ],
+)
+def test_malformed_traceparent_falls_back_to_new_root(header):
+    assert tracing.parse_traceparent(header) is None
+    # and a span started with that parse result is a fresh root — no crash
+    span = tracing.Tracer("svc").start_span("x", parent=tracing.parse_traceparent(header))
+    assert span.parent_id == ""
+    assert len(span.trace_id) == 32
+
+
+def test_parse_accepts_uppercase_and_whitespace():
+    ctx = tracing.parse_traceparent("  00-" + "A" * 32 + "-" + "B" * 16 + "-01\n")
+    assert ctx is not None and ctx.trace_id == "a" * 32
+
+
+# ---------------------------------------------------------------------------
+# contextvar parenting + sampling
+# ---------------------------------------------------------------------------
+
+
+def test_contextvar_auto_parenting():
+    tr = tracing.Tracer("svc")
+    with tr.span("root") as root:
+        auto = tr.start_span("auto")
+        assert auto.trace_id == root.trace_id
+        assert auto.parent_id == root.span_id
+    # block exited: no current span, a fresh start is a root again
+    fresh = tr.start_span("fresh")
+    assert fresh.parent_id == "" and fresh.trace_id != root.trace_id
+
+
+def test_use_span_hands_context_across_threads():
+    tr = tracing.Tracer("svc")
+    root = tr.start_span("root")
+    seen = {}
+
+    def worker():
+        with tracing.use_span(root):
+            seen["span"] = tr.start_span("in-thread")
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen["span"].trace_id == root.trace_id
+    assert seen["span"].parent_id == root.span_id
+
+
+def test_unsampled_spans_skip_all_sinks(tmp_path):
+    tr = tracing.Tracer("unsampled-svc", export_path=str(tmp_path / "s.jsonl"))
+    tracing._sample_ratio = 0.0
+    with tr.span("root") as root:
+        assert root.sampled is False
+        child = tr.start_span("child")
+        assert child.sampled is False  # inherits the root's decision
+        child.end()
+    assert len(tr.finished) == 0  # ring skipped
+    assert (tmp_path / "s.jsonl").read_text() == ""  # file skipped
+    # sampled spans still record
+    tracing._sample_ratio = 1.0
+    tr.start_span("real").end()
+    assert len(tr.finished) == 1
+    tr.close()
+
+
+def test_remote_unsampled_parent_suppresses_subtree():
+    tr = tracing.Tracer("svc")
+    ctx = tracing.parse_traceparent("00-" + "a" * 32 + "-" + "b" * 16 + "-00")
+    span = tr.start_span("rpc.X", parent=ctx)
+    assert span.sampled is False
+    span.end()
+    assert all(s.name != "rpc.X" for s in tr.finished)
+
+
+def test_is_sampling_and_maybe_span():
+    tracing._sample_ratio = 1.0
+    assert tracing.is_sampling() is True
+    tr = tracing.get("maybe-test")
+    with tracing.maybe_span("maybe-test", "visible") as sp:
+        assert sp.sampled
+    assert tr.finished[-1].name == "visible"
+    n = len(tr.finished)
+    tracing._sample_ratio = 0.0
+    assert tracing.is_sampling() is False
+    with tracing.maybe_span("maybe-test", "invisible") as sp:
+        assert not sp.sampled
+    assert len(tr.finished) == n  # nothing recorded
+    # under an unsampled current span, is_sampling follows the span
+    tracing._sample_ratio = 1.0
+    with tracing.use_span(tracing.NOOP_SPAN):
+        assert tracing.is_sampling() is False
+
+
+# ---------------------------------------------------------------------------
+# configure() staleness (satellite): cached tracers must rebind
+# ---------------------------------------------------------------------------
+
+
+def test_configure_rebinds_cached_tracers(tmp_path):
+    service = "rebind-test"
+    try:
+        tracing.configure(str(tmp_path / "dir1"))
+        tr = tracing.get(service)
+        tr.start_span("first").end()
+        # a LATER configure must take effect on the already-cached tracer
+        tracing.configure(str(tmp_path / "dir2"))
+        assert tracing.get(service) is tr  # same instance, rebound
+        tr.start_span("second").end()
+        lines1 = (tmp_path / "dir1" / f"{service}.spans.jsonl").read_text().splitlines()
+        lines2 = (tmp_path / "dir2" / f"{service}.spans.jsonl").read_text().splitlines()
+        assert [json.loads(l)["name"] for l in lines1] == ["first"]
+        assert [json.loads(l)["name"] for l in lines2] == ["second"]
+        # clearing the dir drops file export without killing the tracer
+        tracing.configure(None)
+        tr.start_span("third").end()
+        assert tr.export_path is None
+        assert len((tmp_path / "dir2" / f"{service}.spans.jsonl").read_text().splitlines()) == 1
+    finally:
+        tracing.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# real-gRPC propagation
+# ---------------------------------------------------------------------------
+
+
+def _scheduler_stack(tmp_path=None, storage=None):
+    from dragonfly2_tpu.rpc.glue import serve
+    from dragonfly2_tpu.scheduler import resource as res
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+    from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+    from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
+
+    service = SchedulerService(
+        res.Resource(),
+        Scheduling(BaseEvaluator(), SchedulingConfig(retry_interval=0.0)),
+        storage=storage,
+    )
+    server, port = serve({SERVICE_NAME: service})
+    return server, port, SERVICE_NAME
+
+
+def test_client_wrapper_injects_and_server_parents(tmp_path):
+    """Unary RPC: the client span joins the caller's trace, the server
+    span parents under the CLIENT span via the traceparent header, and
+    the rpc_client_* series tick."""
+    from dragonfly2_tpu.rpc import gen  # noqa: F401 — flat pb2 imports
+    import common_pb2
+    import scheduler_pb2
+
+    from dragonfly2_tpu.rpc import glue
+
+    server, port, svc_name = _scheduler_stack()
+    chan = glue.dial(f"127.0.0.1:{port}")
+    try:
+        client = glue.ServiceClient(chan, svc_name)
+        handled, latency = glue._rpc_client_metrics()
+        before = handled.labels(svc_name, "AnnounceHost", "OK")._value
+        with tracing.get("testsvc").span("caller") as caller:
+            client.AnnounceHost(
+                scheduler_pb2.AnnounceHostRequest(
+                    host=common_pb2.HostInfo(id="h-trace", ip="127.0.0.1", hostname="x")
+                )
+            )
+        assert handled.labels(svc_name, "AnnounceHost", "OK")._value == before + 1
+        assert latency.labels(svc_name, "AnnounceHost").count >= 1
+        # client span recorded in the caller's tracer, in the caller's trace
+        client_spans = [
+            s
+            for s in tracing.get("testsvc").finished
+            if s.name == "rpc.AnnounceHost" and s.trace_id == caller.trace_id
+        ]
+        assert client_spans and client_spans[-1].parent_id == caller.span_id
+        # server span parented under the CLIENT span — one continuous trace
+        server_spans = [
+            s
+            for s in tracing.get("Scheduler").finished
+            if s.name == "rpc.AnnounceHost" and s.trace_id == caller.trace_id
+        ]
+        assert server_spans
+        assert server_spans[-1].parent_id == client_spans[-1].span_id
+    finally:
+        chan.close()
+        server.stop(0)
+
+
+def test_malformed_header_on_the_wire_starts_new_root():
+    """A garbage traceparent in invocation metadata must not crash the
+    handler — the server span becomes a fresh root."""
+    from dragonfly2_tpu.rpc import gen  # noqa: F401 — flat pb2 imports
+    import common_pb2
+    import scheduler_pb2
+
+    from dragonfly2_tpu.rpc import glue
+
+    server, port, svc_name = _scheduler_stack()
+    chan = glue.dial(f"127.0.0.1:{port}")
+    try:
+        # a raw callable, bypassing the instrumented client wrapper, so
+        # the malformed header is what actually rides the wire
+        raw = chan.unary_unary(
+            f"/{svc_name}/AnnounceHost",
+            request_serializer=scheduler_pb2.AnnounceHostRequest.SerializeToString,
+            response_deserializer=scheduler_pb2.Empty.FromString,
+        )
+        raw(
+            scheduler_pb2.AnnounceHostRequest(
+                host=common_pb2.HostInfo(id="h-mal", ip="127.0.0.1", hostname="m")
+            ),
+            metadata=(("traceparent", "00-not-a-trace-01"),),
+        )
+        spans = [s for s in tracing.get("Scheduler").finished if s.name == "rpc.AnnounceHost"]
+        assert spans and spans[-1].parent_id == ""  # fresh root, handled OK
+    finally:
+        chan.close()
+        server.stop(0)
+
+
+def test_explicit_caller_traceparent_wins():
+    """A caller that already set a traceparent header keeps it — the
+    wrapper must not stack a second one."""
+    from dragonfly2_tpu.rpc import gen  # noqa: F401 — flat pb2 imports
+    import common_pb2
+    import scheduler_pb2
+
+    from dragonfly2_tpu.rpc import glue
+
+    server, port, svc_name = _scheduler_stack()
+    chan = glue.dial(f"127.0.0.1:{port}")
+    try:
+        client = glue.ServiceClient(chan, svc_name)
+        explicit = "00-" + "c" * 32 + "-" + "d" * 16 + "-01"
+        client.AnnounceHost(
+            scheduler_pb2.AnnounceHostRequest(
+                host=common_pb2.HostInfo(id="h-exp", ip="127.0.0.1", hostname="e")
+            ),
+            metadata=(("traceparent", explicit),),
+        )
+        spans = [s for s in tracing.get("Scheduler").finished if s.name == "rpc.AnnounceHost"]
+        assert spans and spans[-1].trace_id == "c" * 32
+        assert spans[-1].parent_id == "d" * 16
+    finally:
+        chan.close()
+        server.stop(0)
+
+
+def test_abandoned_response_stream_finalizes_span_and_series(tmp_path):
+    """A caller that stops iterating a response stream early (dfget
+    returns on the first done=True) must still complete the client span
+    and the rpc_client series — finalized at GC with code ABANDONED."""
+    import gc
+
+    from dragonfly2_tpu.client import dfget
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.rpc import glue
+
+    server, port, _ = _scheduler_stack()
+    d = Daemon(
+        DaemonConfig(
+            data_dir=str(tmp_path / "daemon"),
+            scheduler_address=f"127.0.0.1:{port}",
+            hostname="host-abandon",
+            piece_length=32 * 1024,
+            announce_interval=60.0,
+        )
+    )
+    d.start()
+    try:
+        import os
+
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(os.urandom(8 * 1024))
+        handled, _ = glue._rpc_client_metrics()
+        child = handled.labels(glue.DFDAEMON_SERVICE, "Download", "ABANDONED")
+        before = child.value
+        with tracing.get("abandontest").span("dl") as root:
+            dfget.download(
+                f"127.0.0.1:{d.port}", f"file://{origin}", str(tmp_path / "out.bin")
+            )
+        gc.collect()
+        assert child.value == before + 1
+        spans = [
+            s
+            for s in tracing.get("abandontest").finished
+            if s.name == "rpc.Download" and s.trace_id == root.trace_id
+        ]
+        assert spans and spans[-1].status == "abandoned"
+    finally:
+        d.stop()
+        server.stop(0)
+
+
+def test_single_trace_across_download_schedule_and_fit(tmp_path):
+    """The acceptance chain: ONE trace_id spans the dfget client call,
+    the daemon's conductor span, the scheduler's rpc.AnnouncePeer +
+    schedule spans, and — through the announcer's upload — the
+    trainer's rpc.Train + fit spans."""
+    import os
+
+    from dragonfly2_tpu.client import dfget
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.rpc.glue import TRAINER_SERVICE, dial, serve
+    from dragonfly2_tpu.scheduler.announcer import Announcer
+    from dragonfly2_tpu.scheduler.storage import Storage
+    from dragonfly2_tpu.schema import synth
+    from dragonfly2_tpu.trainer.service import TrainerService
+    from dragonfly2_tpu.trainer.storage import TrainerStorage
+    from dragonfly2_tpu.trainer.train import FitConfig, GNNFitConfig
+    from dragonfly2_tpu.trainer.training import Training, TrainingConfig
+
+    # scheduler with a record sink, pre-seeded so the fit has data
+    storage = Storage(tmp_path / "rec", buffer_size=1)
+    for r in synth.make_download_records(60, seed=5):
+        storage.create_download(r)
+    storage.flush()
+    server, port, _ = _scheduler_stack(storage=storage)
+
+    # trainer with synchronous fits (the fit runs inside the Train RPC)
+    t_storage = TrainerStorage(tmp_path / "trainer")
+    training = Training(
+        t_storage,
+        manager_client=None,
+        config=TrainingConfig(
+            mlp=FitConfig(hidden_dims=(16,), batch_size=64, epochs=2, seed=0),
+            gnn=GNNFitConfig(hidden_dims=(8,), batch_size=64, epochs=5, seed=0),
+            gru=False,
+        ),
+    )
+    t_server, t_port = serve(
+        {TRAINER_SERVICE: TrainerService(t_storage, training, synchronous=True)}
+    )
+    t_channel = dial(f"127.0.0.1:{t_port}")
+
+    d = Daemon(
+        DaemonConfig(
+            data_dir=str(tmp_path / "daemon"),
+            scheduler_address=f"127.0.0.1:{port}",
+            hostname="host-onetrace",
+            piece_length=32 * 1024,
+            announce_interval=60.0,
+        )
+    )
+    d.start()
+    try:
+        payload = os.urandom(64 * 1024)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        out = tmp_path / "out.bin"
+        with tracing.get("e2e-test").span("one-trace") as root:
+            dfget.download(f"127.0.0.1:{d.port}", f"file://{origin}", str(out))
+            storage.flush()
+            ann = Announcer(
+                storage,
+                ip="10.1.1.1",
+                hostname="sched-trace",
+                trainer_channel=t_channel,
+            )
+            assert ann.train_once()
+        assert out.read_bytes() == payload
+    finally:
+        d.stop()
+        t_channel.close()
+        t_server.stop(0)
+        server.stop(0)
+
+    t = root.trace_id
+
+    def in_trace(service, name):
+        return [
+            s
+            for s in tracing.get(service).finished
+            if s.name == name and s.trace_id == t
+        ]
+
+    # dfdaemon: the conductor's peer_task span, parented under the
+    # daemon's rpc.Download server span
+    peer_tasks = in_trace("dfdaemon", "peer_task")
+    assert peer_tasks, "conductor span missing from the trace"
+    downloads = in_trace("Dfdaemon", "rpc.Download")
+    assert downloads
+    assert peer_tasks[-1].parent_id in {s.span_id for s in downloads}
+
+    # scheduler: rpc.AnnouncePeer (parent: the conductor's client call)
+    # and the scheduling decision under it
+    announces = in_trace("Scheduler", "rpc.AnnouncePeer")
+    assert announces, "scheduler rpc span missing from the trace"
+    schedules = in_trace("scheduler", "schedule")
+    assert schedules, "scheduling span missing from the trace"
+    assert schedules[-1].parent_id in {s.span_id for s in announces}
+
+    # trainer: rpc.Train under the announcer's upload span, fit under it
+    trains = in_trace("Trainer", "rpc.Train")
+    assert trains, "trainer rpc span missing from the trace"
+    uploads = in_trace("scheduler", "train_upload")
+    assert uploads
+    fits = in_trace("trainer", "fit")
+    assert fits, "fit span missing from the trace"
+    assert {s.parent_id for s in fits} <= {s.span_id for s in trains}
