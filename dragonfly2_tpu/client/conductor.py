@@ -36,9 +36,21 @@ from dragonfly2_tpu.client.piece_manager import (
 from dragonfly2_tpu.client.pieces import PieceRange, parse_byte_range, piece_ranges
 from dragonfly2_tpu.client.storage import StorageManager
 from dragonfly2_tpu.client import metrics as M
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, flight
 
 logger = dflog.get("client.conductor")
+
+# flight-recorder emitters: the peer/piece lifecycle as the daemon saw
+# it — the always-on black box a wedged peer postmortem replays
+EV_PEER_START = flight.event_type("daemon.peer_start")
+EV_PEER_DECISION = flight.event_type("daemon.peer_decision")
+EV_PEER_FINISHED = flight.event_type("daemon.peer_finished")
+EV_PEER_FAILED = flight.event_type("daemon.peer_failed")
+EV_PEER_BACK_TO_SOURCE = flight.event_type("daemon.peer_back_to_source")
+EV_PIECE_DONE = flight.event_type("daemon.piece_done")
+EV_PIECE_FAILED = flight.event_type("daemon.piece_failed")
+EV_PARENT_BLOCKED = flight.event_type("daemon.parent_blocked")
+EV_RESCHEDULE = flight.event_type("daemon.reschedule")
 
 
 @dataclass
@@ -133,6 +145,8 @@ class PeerTaskConductor:
         self._span = tracing.get("dfdaemon").start_span(
             "peer_task", task_id=self.task_id, peer_id=self.peer_id, url=self.url
         )
+        with tracing.use_span(self._span):
+            EV_PEER_START(task_id=self.task_id, peer_id=self.peer_id, url=self.url)
         self._started_at = time.monotonic()
         self._stream_thread = threading.Thread(
             target=self._stream_loop, name=f"announce-{self.peer_id[:8]}", daemon=True
@@ -239,7 +253,9 @@ class PeerTaskConductor:
         while not self._done.is_set():
             try:
                 which, body = self._decisions.get(timeout=self.opts.schedule_timeout)
+                EV_PEER_DECISION(peer_id=self.peer_id, decision=which)
             except queue.Empty:
+                EV_PEER_DECISION(peer_id=self.peer_id, decision="schedule_timeout")
                 # No decision in time: back-source if allowed, else fail
                 # (reference needBackSource fallback :485-523).
                 if self.opts.disable_back_source:
@@ -290,6 +306,7 @@ class PeerTaskConductor:
     # ------------------------------------------------------------------
     def _back_to_source(self) -> None:
         M.BACK_TO_SOURCE_TOTAL.inc()
+        EV_PEER_BACK_TO_SOURCE(peer_id=self.peer_id, task_id=self.task_id)
         if getattr(self, "_span", None) is not None:
             self._span.event("back_to_source")
         self._send(
@@ -433,6 +450,12 @@ class PeerTaskConductor:
                     # deprioritize it or it wins every retry on EWMA weight
                     hard_failures += 1
                     failed_here.add(parent.peer_id)
+                    EV_PIECE_FAILED(
+                        peer_id=self.peer_id,
+                        piece=pr.number,
+                        parent_id=parent.peer_id,
+                        error=str(e),
+                    )
                     self._send(
                         download_piece_failed=scheduler_pb2.DownloadPieceFailedRequest(
                             piece_number=pr.number, parent_id=parent.peer_id, temporary=True
@@ -445,6 +468,11 @@ class PeerTaskConductor:
                         self._parent_failures[parent.peer_id] = n
                         if n >= self.opts.parent_fail_limit:
                             self._blocked_parents.add(parent.peer_id)
+                            EV_PARENT_BLOCKED(
+                                peer_id=self.peer_id,
+                                parent_id=parent.peer_id,
+                                failures=n,
+                            )
             logger.warning("piece %d failed from all parents: %s", pr.number, last_err)
             with lock:
                 failed.append(pr)
@@ -504,6 +532,9 @@ class PeerTaskConductor:
         return content_length, piece_length
 
     def _reschedule(self, blocked: list[str], description: str) -> None:
+        EV_RESCHEDULE(
+            peer_id=self.peer_id, blocked=list(blocked), reason=description
+        )
         self._send(
             reschedule=scheduler_pb2.RescheduleRequest(
                 blocked_parent_ids=blocked, description=description
@@ -512,6 +543,14 @@ class PeerTaskConductor:
 
     # ------------------------------------------------------------------
     def _piece_done(self, r: PieceResult) -> None:
+        EV_PIECE_DONE(
+            peer_id=self.peer_id,
+            piece=r.number,
+            parent_id=r.parent_id,
+            length=r.length,
+            traffic=r.traffic_type,
+            cost_ms=round(r.cost_ns / 1e6, 3),
+        )
         with self._lock:
             self._completed += r.length
         self._send(
@@ -554,6 +593,12 @@ class PeerTaskConductor:
             self._span.set(piece_count=piece_count).end("ok")
         self._release_shaper()
         cost_ns = int((time.monotonic() - self._started_at) * 1e9)
+        EV_PEER_FINISHED(
+            peer_id=self.peer_id,
+            task_id=self.task_id,
+            pieces=piece_count,
+            cost_ms=round(cost_ns / 1e6, 3),
+        )
         self._send(
             download_peer_finished=scheduler_pb2.DownloadPeerFinishedRequest(
                 content_length=(
@@ -582,6 +627,9 @@ class PeerTaskConductor:
             self._span.set(error=description).end("error")
         self._release_shaper()
         M.TASK_FAILURE_TOTAL.inc()
+        EV_PEER_FAILED(
+            peer_id=self.peer_id, task_id=self.task_id, error=description
+        )
         self._error = description
         self._send(
             download_peer_failed=scheduler_pb2.DownloadPeerFailedRequest(

@@ -311,8 +311,20 @@ class Daemon:
                     except FileNotFoundError:
                         pass  # raced: its owner already removed it
             extra.append(f"unix:{sock}")
+        # flight recorder: crash dumps on SIGTERM/fatal + the Diagnose
+        # snapshot RPC on the daemon's gRPC plane
+        from dragonfly2_tpu.rpc.diagnose import DiagnoseService
+        from dragonfly2_tpu.utils import flight
+
+        flight.install("daemon")
+        flight.register_probe(
+            "daemon.tasks",
+            lambda: {"conductors": len(self.task_manager.conductors)},
+        )
         self._server, self.port = glue.serve(
-            {DFDAEMON_SERVICE: service}, address=self.cfg.listen, extra_addresses=extra
+            {DFDAEMON_SERVICE: service, glue.DIAGNOSE_SERVICE: DiagnoseService()},
+            address=self.cfg.listen,
+            extra_addresses=extra,
         )
         # announce before the proxy/gateway open for business: a gateway
         # PUT may AnnounceTask immediately, which requires a known host

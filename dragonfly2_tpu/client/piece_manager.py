@@ -18,9 +18,14 @@ from dataclasses import dataclass, field
 from dragonfly2_tpu.client import downloader, source
 from dragonfly2_tpu.client.pieces import PieceRange, compute_piece_length, piece_ranges
 from dragonfly2_tpu.client.storage import TaskStorage
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, flight
 
 logger = dflog.get("client.piece")
+
+# origin-path flight events: back-to-source is the expensive fallback,
+# so every origin hit is worth a permanent ring entry
+EV_SOURCE_START = flight.event_type("daemon.source_download_start")
+EV_SOURCE_DONE = flight.event_type("daemon.source_download_done")
 
 TRAFFIC_BACK_TO_SOURCE = "back_to_source"
 TRAFFIC_REMOTE_PEER = "remote_peer"
@@ -155,6 +160,10 @@ class PieceManager:
         (dfget --range / UrlMeta.range): the task's content IS that
         slice — pieces number from its start, and the task completes at
         ``length`` bytes."""
+        t_start = time.monotonic()
+        EV_SOURCE_START(
+            task_id=ts.meta.task_id, url=url, offset=offset, length=length
+        )
         client = source.client_for(url)
         meta = client.metadata(url, headers)
         content_length = meta.content_length
@@ -220,6 +229,12 @@ class PieceManager:
             with ThreadPoolExecutor(max_workers=self.source_concurrency) as pool:
                 list(pool.map(fetch, ranges))
             ts.mark_done(content_length, expected_digest=expected_digest)
+            EV_SOURCE_DONE(
+                task_id=ts.meta.task_id,
+                mode="concurrent",
+                bytes=content_length,
+                wall_s=round(time.monotonic() - t_start, 3),
+            )
             return content_length
 
         # sequential stream → pieces (write offsets are slice-relative)
@@ -268,6 +283,12 @@ class PieceManager:
                 f"ranged origin delivered {write_off} bytes, expected {content_length}"
             )
         ts.mark_done(write_off, expected_digest=expected_digest)
+        EV_SOURCE_DONE(
+            task_id=ts.meta.task_id,
+            mode="sequential",
+            bytes=write_off,
+            wall_s=round(time.monotonic() - t_start, 3),
+        )
         return write_off
 
 

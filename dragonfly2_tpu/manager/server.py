@@ -12,7 +12,7 @@ from dragonfly2_tpu.manager.models_registry import ModelRegistry
 from dragonfly2_tpu.manager.objectstorage import new_object_storage
 from dragonfly2_tpu.manager.service import ManagerService
 from dragonfly2_tpu.rpc import glue
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, flight
 
 logger = dflog.get("manager.server")
 
@@ -125,8 +125,12 @@ class ManagerServer:
     def serve(self) -> str:
         from dragonfly2_tpu.manager.service import SERVICE_NAME
 
+        # flight recorder: crash dumps + the Diagnose snapshot RPC
+        flight.install("manager")
+        from dragonfly2_tpu.rpc.diagnose import DiagnoseService
+
         self._grpc, port = glue.serve(
-            {SERVICE_NAME: self.service},
+            {SERVICE_NAME: self.service, glue.DIAGNOSE_SERVICE: DiagnoseService()},
             self.cfg.listen,
             **glue.serve_tls_args(
                 self.cfg.tls_cert_file, self.cfg.tls_key_file, self.cfg.tls_client_ca_file

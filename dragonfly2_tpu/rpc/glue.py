@@ -20,6 +20,7 @@ import grpc
 from dragonfly2_tpu.rpc import gen  # noqa: F401 — sets up flat imports
 import common_pb2  # noqa: E402
 import dfdaemon_pb2  # noqa: E402
+import diagnose_pb2  # noqa: E402
 import manager_pb2  # noqa: E402
 import scheduler_pb2  # noqa: E402
 import scheduler_v1_pb2  # noqa: E402
@@ -34,6 +35,9 @@ TOPOLOGY_SERVICE = "dragonfly2_tpu.topology.Topology"
 TRAINER_SERVICE = "dragonfly2_tpu.trainer.Trainer"
 MANAGER_SERVICE = "dragonfly2_tpu.manager.Manager"
 DFDAEMON_SERVICE = "dragonfly2_tpu.dfdaemon.Dfdaemon"
+# flight-recorder snapshots (utils/flight); every server assembly binds
+# it so any live process can explain itself without restarting
+DIAGNOSE_SERVICE = "dragonfly2_tpu.diagnose.Diagnose"
 
 UNARY = "unary_unary"
 UNARY_STREAM = "unary_stream"
@@ -143,6 +147,11 @@ SERVICES: dict[str, dict[str, Method]] = {
         "UpdateModel": Method(UNARY, manager_pb2.UpdateModelRequest, manager_pb2.Model),
         "IssueCertificate": Method(
             UNARY, manager_pb2.CertificateRequest, manager_pb2.CertificateResponse
+        ),
+    },
+    DIAGNOSE_SERVICE: {
+        "Diagnose": Method(
+            UNARY, diagnose_pb2.DiagnoseRequest, diagnose_pb2.DiagnoseResponse
         ),
     },
     DFDAEMON_SERVICE: {

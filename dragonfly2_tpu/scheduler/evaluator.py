@@ -22,9 +22,16 @@ import numpy as np
 from dragonfly2_tpu.schema.features import (
     location_affinity as offline_location_affinity,
 )
-from dragonfly2_tpu.utils import dflog, tracing
+from dragonfly2_tpu.utils import dflog, flight, tracing
 
 logger = dflog.get("scheduler.evaluator")
+
+# per-decision "explain" record: the top-k candidates' predicted costs
+# and full feature vectors (rtt_affinity included) — the evidence for
+# WHY the model ranked a parent first, kept in the always-on ring so a
+# misplaced-parent postmortem doesn't depend on a sampled trace
+EV_EXPLAIN = flight.event_type("scheduler.evaluate_explain")
+EXPLAIN_TOP_K = 4
 
 from dragonfly2_tpu.scheduler.resource import (
     PEER_STATE_BACK_TO_SOURCE,
@@ -295,6 +302,26 @@ class MLEvaluator(BaseEvaluator):
             )
             costs = self._model.predict(feats)  # [P] predicted log piece cost
             order = np.argsort(costs, kind="stable")
+            if flight.enabled():
+                # top-k explain event: scores + the full feature rows the
+                # model saw (schema order, rtt_affinity last). Guarded so
+                # DF_FLIGHT=0 pays one predicate; the list build is tiny
+                # next to the predict() dispatch above.
+                EV_EXPLAIN(
+                    peer_id=child.id,
+                    task_id=child.task.id,
+                    candidates=len(parents),
+                    feature_dim=int(feats.shape[1]),
+                    top=[
+                        {
+                            "parent_id": parents[int(i)].id,
+                            "predicted_log_cost": round(float(costs[int(i)]), 6),
+                            "rtt_affinity": round(float(feats[int(i), -1]), 6),
+                            "features": [round(float(v), 5) for v in feats[int(i)]],
+                        }
+                        for i in order[:EXPLAIN_TOP_K]
+                    ],
+                )
             return [parents[int(i)] for i in order]
         except Exception:
             # degraded mode: never fail scheduling because of the model —

@@ -29,9 +29,16 @@ from dragonfly2_tpu.scheduler.resource import (
     Peer,
 )
 from dragonfly2_tpu.scheduler import metrics as M
-from dragonfly2_tpu.utils import dflog, tracing
+from dragonfly2_tpu.utils import dflog, flight, tracing
 
 logger = dflog.get("scheduling")
+
+# flight-recorder emitters: one event per scheduling decision, always on
+# (the per-decision record the sampled trace usually misses); bench.py
+# recorder_overhead_pct keeps the emit cost < 2% of the schedule op
+EV_SCHEDULE = flight.event_type("scheduler.schedule")
+EV_BACK_TO_SOURCE = flight.event_type("scheduler.schedule_back_to_source")
+EV_SCHEDULE_FAILED = flight.event_type("scheduler.schedule_failed")
 
 # defaults (reference scheduler/config/constants.go)
 DEFAULT_RETRY_LIMIT = 5
@@ -149,6 +156,10 @@ class Scheduling:
             # IS the seed (its registration carries need_back_to_source)
             if peer.need_back_to_source and peer.task.can_back_to_source():
                 _span.set(back_to_source="peer demand", retries=n)
+                EV_BACK_TO_SOURCE(
+                    peer_id=peer.id, task_id=peer.task.id,
+                    reason="peer demand", retries=n,
+                )
                 self._send(
                     peer,
                     NeedBackToSourceResponse("peer's NeedBackToSource is true"),
@@ -158,6 +169,10 @@ class Scheduling:
             if not seeding and peer.task.can_back_to_source():
                 if n >= self.config.retry_back_to_source_limit:
                     _span.set(back_to_source="retry limit", retries=n)
+                    EV_BACK_TO_SOURCE(
+                        peer_id=peer.id, task_id=peer.task.id,
+                        reason="retry limit", retries=n,
+                    )
                     self._send(
                         peer,
                         NeedBackToSourceResponse(
@@ -167,6 +182,10 @@ class Scheduling:
                     return
 
             if not seeding and n >= self.config.retry_limit:
+                EV_SCHEDULE_FAILED(
+                    peer_id=peer.id, task_id=peer.task.id, retries=n,
+                    reason="retry limit exhausted",
+                )
                 raise SchedulingError(
                     f"scheduling exceeded RetryLimit {self.config.retry_limit}"
                 )
@@ -199,6 +218,12 @@ class Scheduling:
 
             M.SCHEDULE_DURATION.observe(time.perf_counter() - _t0)
             _span.set(candidates=len(candidate_parents), retries=n).end("ok")
+            EV_SCHEDULE(
+                peer_id=peer.id,
+                task_id=peer.task.id,
+                retries=n,
+                parent_ids=[p.id for p in candidate_parents],
+            )
             self._send(peer, NormalTaskResponse(candidate_parents))
 
             for parent in candidate_parents:

@@ -17,7 +17,7 @@ from dragonfly2_tpu.scheduler.networktopology import NetworkTopology
 from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
 from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
 from dragonfly2_tpu.scheduler.storage import Storage
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, flight
 from dragonfly2_tpu.utils.gc import GC, GCTask
 from dragonfly2_tpu.utils import kvstore
 from dragonfly2_tpu.utils.kvstore import KVStore
@@ -290,6 +290,23 @@ class SchedulerServer:
             from dragonfly2_tpu.scheduler.topology_service import TopologyService
 
             services[TOPOLOGY_SERVICE] = TopologyService(self.topology_engine)
+        # flight recorder: crash dumps on SIGTERM/fatal, live snapshots
+        # via the Diagnose RPC on the same gRPC plane
+        flight.install("scheduler")
+        if self.topology_engine is not None:
+            flight.register_probe("scheduler.topology", self.topology_engine.stats)
+        flight.register_probe(
+            "scheduler.resource",
+            lambda: {
+                "peers": len(self.resource.peer_manager.all()),
+                "tasks": len(self.resource.task_manager.all()),
+                "hosts": len(self.resource.host_manager.all()),
+            },
+        )
+        from dragonfly2_tpu.rpc.diagnose import DiagnoseService
+        from dragonfly2_tpu.rpc.glue import DIAGNOSE_SERVICE
+
+        services[DIAGNOSE_SERVICE] = DiagnoseService()
         self._grpc, self.port = glue.serve(
             services,
             cfg.listen,

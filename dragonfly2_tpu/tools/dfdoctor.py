@@ -1,0 +1,302 @@
+"""dfdoctor — postmortem correlation of flight-recorder dumps, live
+Diagnose snapshots, and trace exports.
+
+Every service keeps an always-on ring of lifecycle events
+(utils/flight) and dumps it to ``$DF_DIAG_DIR`` as jsonl on SIGTERM,
+fatal exceptions, and stall-watchdog triggers; every service also
+exports sampled spans under ``$DF_TRACE_DIR`` (utils/tracing). Each
+artifact is one process's island. This tool is the join that answers
+"explain what just happened":
+
+- collects every dump in the diag dir (torn last lines skipped — a
+  process killed mid-write must not block reading the rest),
+- optionally snapshots LIVE services over the Diagnose RPC
+  (``--rpc host:port``, repeatable),
+- merges events with the trace exports by ``trace_id``,
+- renders a correlated timeline per incident (each crash/stall dump is
+  an incident) with the stall/crash window flagged and the suspect
+  trace — e.g. the stalled fit's trace_id — named.
+
+Usage:
+    python -m dragonfly2_tpu.tools.dfdoctor [--diag DIR] [--traces DIR]
+        [--rpc HOST:PORT]... [--window S] [--list]
+
+DIR defaults to $DF_DIAG_DIR / $DF_TRACE_DIR.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from dragonfly2_tpu.tools.dftrace import SpanRec, load_spans
+
+_META_KEYS = ("ts_ns", "type", "trace_id", "span_id", "category", "service", "source")
+
+
+@dataclass
+class Incident:
+    reason: str
+    service: str
+    pid: int
+    dumped_at_ns: int
+    source: str
+    meta: dict = field(default_factory=dict)
+
+
+def load_dumps(diag_dir: str) -> tuple[list[dict], list[Incident]]:
+    """Every event and dump-meta record from every ``*.jsonl`` dump.
+    Unparseable lines (torn by the death that caused the dump) are
+    skipped, never fatal."""
+    events: list[dict] = []
+    incidents: list[Incident] = []
+    for path in sorted(Path(diag_dir).glob("*.jsonl")):
+        service = ""
+        for raw in path.read_text().splitlines():
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                obj = json.loads(raw)
+            except json.JSONDecodeError:
+                continue  # torn line
+            if not isinstance(obj, dict):
+                continue
+            if "meta" in obj:
+                m = obj["meta"]
+                service = m.get("service", "")
+                incidents.append(
+                    Incident(
+                        reason=m.get("reason", ""),
+                        service=service,
+                        pid=int(m.get("pid", 0)),
+                        dumped_at_ns=int(m.get("dumped_at_ns", 0)),
+                        source=path.name,
+                        meta=m,
+                    )
+                )
+            elif "ts_ns" in obj:
+                obj.setdefault("service", service)
+                obj["source"] = path.name
+                events.append(obj)
+    return events, incidents
+
+
+def collect_rpc(addresses: list[str]) -> list[dict]:
+    """Live ring snapshots over the Diagnose RPC, one per address.
+    An unreachable service is reported and skipped — a postmortem must
+    work with whatever is still answering."""
+    events: list[dict] = []
+    for addr in addresses:
+        try:
+            from dragonfly2_tpu.rpc import gen  # noqa: F401
+            import diagnose_pb2  # noqa: E402
+
+            from dragonfly2_tpu.rpc import glue
+
+            channel = glue.dial(addr, retries=1)
+            try:
+                client = glue.ServiceClient(channel, glue.DIAGNOSE_SERVICE)
+                resp = client.Diagnose(
+                    diagnose_pb2.DiagnoseRequest(include_stacks=False), timeout=5
+                )
+            finally:
+                channel.close()
+            snap = json.loads(resp.snapshot_json)
+            for cat, ring in snap.get("rings", {}).items():
+                for ev in ring:
+                    ev.setdefault("category", cat)
+                    ev.setdefault("service", resp.service)
+                    ev["source"] = f"rpc:{addr}"
+                    events.append(ev)
+        except Exception as e:
+            print(f"dfdoctor: {addr} unreachable ({e}); skipping", file=sys.stderr)
+    return events
+
+
+_CRISIS_MARKERS = (".stall", "failed", "fatal", "error", "back_to_source")
+
+
+def suspect_trace(events: list[dict], spans: list[SpanRec]) -> tuple[str, str]:
+    """(trace_id, label) for the trace most implicated by ``events``.
+    Crisis-shaped events (stall verdicts, failures) name their own trace
+    — the newest such event wins, because a busy window is full of
+    HEALTHY traffic and a raw majority vote would elect an innocent
+    bystander. Without any, fall back to the most frequent non-empty
+    trace_id. The label is the trace's span names from the export."""
+    traced = [e for e in events if e.get("trace_id")]
+    if not traced:
+        return "", ""
+    crisis = [
+        e
+        for e in traced
+        if any(m in str(e.get("type", "")) for m in _CRISIS_MARKERS)
+    ]
+    if crisis:
+        tid = max(crisis, key=lambda e: int(e.get("ts_ns", 0)))["trace_id"]
+    else:
+        tid = collections.Counter(e["trace_id"] for e in traced).most_common(1)[0][0]
+    names = sorted({s.name for s in spans if s.trace_id == tid})
+    return tid, ", ".join(names)
+
+
+def _detail(ev: dict, limit: int = 4) -> str:
+    parts = []
+    for k, v in ev.items():
+        if k in _META_KEYS:
+            continue
+        if isinstance(v, (list, dict)):
+            v = json.dumps(v, default=str)
+        s = f"{k}={v}"
+        parts.append(s if len(s) <= 60 else s[:57] + "...")
+        if len(parts) >= limit:
+            break
+    return " ".join(parts)
+
+
+def render_incident(
+    incident: Incident,
+    events: list[dict],
+    spans: list[SpanRec],
+    window_s: float,
+    out=None,
+) -> None:
+    out = out or sys.stdout
+    t1 = incident.dumped_at_ns
+    t0 = t1 - int(window_s * 1e9)
+    in_window = [e for e in events if t0 <= int(e.get("ts_ns", 0)) <= t1]
+    win_spans = [s for s in spans if t0 <= s.start_ns <= t1 or t0 <= s.end_ns <= t1]
+    tid, label = suspect_trace(in_window, spans)
+    print(
+        f"incident: {incident.reason}  service={incident.service}"
+        f" pid={incident.pid}  ({incident.source})",
+        file=out,
+    )
+    if tid:
+        print(
+            f"  suspect trace: {tid}" + (f"  ({label})" if label else ""),
+            file=out,
+        )
+    rows: list[tuple[int, str]] = []
+    for e in in_window:
+        ts = int(e.get("ts_ns", 0))
+        short = (e.get("trace_id") or "")[:16]
+        detail = _detail(e)
+        rows.append(
+            (
+                ts,
+                f"event {e.get('type', '?')}  [{e.get('service', '')}]"
+                + (f"  trace={short}" if short else "")
+                + (f"  {detail}" if detail else ""),
+            )
+        )
+    for s in win_spans:
+        short = s.trace_id[:16]
+        rows.append(
+            (
+                s.start_ns,
+                f"span  {s.name}  [{s.service}]  trace={short}"
+                f"  {s.duration_ms:.2f} ms"
+                + ("  ERROR" if s.status == "error" else ""),
+            )
+        )
+    rows.sort()
+    print(
+        f"  timeline ({len(in_window)} events, {len(win_spans)} spans,"
+        f" last {window_s:.0f}s before the dump):",
+        file=out,
+    )
+    for ts, line in rows:
+        print(f"    {(ts - t1) / 1e9:+9.3f}s  {line}", file=out)
+    print(
+        f"    ========  {incident.reason} window flagged: dump at +0.000s"
+        f"  ========",
+        file=out,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dfdoctor",
+        description="merge flight-recorder dumps + traces into a postmortem timeline",
+    )
+    p.add_argument(
+        "--diag",
+        default=os.environ.get("DF_DIAG_DIR", ""),
+        help="dump dir (default $DF_DIAG_DIR)",
+    )
+    p.add_argument(
+        "--traces",
+        default=os.environ.get("DF_TRACE_DIR", ""),
+        help="trace export dir (default $DF_TRACE_DIR)",
+    )
+    p.add_argument(
+        "--rpc",
+        action="append",
+        default=[],
+        metavar="HOST:PORT",
+        help="also snapshot a live service over the Diagnose RPC (repeatable)",
+    )
+    p.add_argument(
+        "--window",
+        type=float,
+        default=120.0,
+        help="seconds of history rendered before each dump (default 120)",
+    )
+    p.add_argument("--list", action="store_true", help="summarize dumps and exit")
+    args = p.parse_args(argv)
+    if not args.diag and not args.rpc:
+        p.error("nothing to read: pass --diag/--rpc or set DF_DIAG_DIR")
+
+    events: list[dict] = []
+    incidents: list[Incident] = []
+    if args.diag and os.path.isdir(args.diag):
+        events, incidents = load_dumps(args.diag)
+    events.extend(collect_rpc(args.rpc))
+    spans = (
+        load_spans(args.traces)
+        if args.traces and os.path.isdir(args.traces)
+        else []
+    )
+
+    print(
+        f"dfdoctor: {len(incidents)} dump(s), {len(events)} events,"
+        f" {len(spans)} spans"
+    )
+    if args.list:
+        for inc in sorted(incidents, key=lambda i: i.dumped_at_ns):
+            n = sum(1 for e in events if e.get("source") == inc.source)
+            print(
+                f"  {inc.source}  reason={inc.reason}  service={inc.service}"
+                f"  pid={inc.pid}  events={n}"
+            )
+        return 0
+    if not incidents and not events:
+        print("nothing to correlate", file=sys.stderr)
+        return 1
+    if not incidents:
+        # live snapshots only: render everything as one window ending now
+        import time
+
+        incidents = [
+            Incident(
+                reason="live-snapshot",
+                service="",
+                pid=0,
+                dumped_at_ns=time.time_ns(),
+                source="rpc",
+            )
+        ]
+    for inc in sorted(incidents, key=lambda i: i.dumped_at_ns):
+        render_incident(inc, events, spans, args.window)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

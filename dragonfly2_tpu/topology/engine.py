@@ -29,9 +29,16 @@ from dragonfly2_tpu.topology import metrics as TM
 from dragonfly2_tpu.topology.csr import NS_PER_MS, AdjacencyStore
 from dragonfly2_tpu.topology.delta import DeltaQueue, EdgeDelta
 from dragonfly2_tpu.topology.kernels import INF_MS, make_kernels
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, flight
 
 logger = dflog.get("topology.engine")
+
+# flight-recorder events: every flush (the device-array refresh — the
+# moment a wrong RTT estimate was born), plus the non-direct inference
+# outcomes (the estimates worth re-probing); direct/cache hits are too
+# hot and too boring for a permanent record
+EV_FLUSH = flight.event_type("topology.flush")
+EV_INFERENCE = flight.event_type("topology.inference")
 
 
 @dataclass
@@ -149,6 +156,13 @@ class TopologyEngine:
             if purged:
                 TM.STALE_PURGED_TOTAL.inc(purged)
             TM.FLUSH_TOTAL.inc()
+            EV_FLUSH(
+                applied=len(batch),
+                purged=purged,
+                hosts=len(self.store.index),
+                edges=self.store.num_edges,
+                wall_ms=round((time.perf_counter() - t0) * 1e3, 3),
+            )
             TM.FLUSH_LATENCY.observe(time.perf_counter() - t0)
             TM.DELTA_QUEUE_GAUGE.set(len(self.deltas))
             dropped = self.deltas.dropped
@@ -280,6 +294,16 @@ class TopologyEngine:
                 return self._intify(out), source
             self._cache_misses += 1
             out, source = self._est_rtt_locked(src, dest)
+            if source != "direct":
+                # the inferred/no-path answers are the ones an operator
+                # wants on record (an inferred estimate says "probe this
+                # pair to confirm"); direct hits would flood the ring
+                EV_INFERENCE(
+                    src=src,
+                    dest=dest,
+                    provenance=source,
+                    rtt_ns=self._intify(out),
+                )
             if len(self._cache) >= self.cfg.inference_cache_size:
                 self._cache.clear()
             self._cache[key] = (out, source)

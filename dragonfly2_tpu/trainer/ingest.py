@@ -43,9 +43,17 @@ import numpy as np
 from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
 from dragonfly2_tpu.schema import native, wire
 from dragonfly2_tpu.trainer import metrics as M
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, flight
 
 logger = dflog.get("trainer.ingest")
+
+# flight-recorder events: the per-superbatch h2d/step split (the live
+# form of the StreamStats totals), the end-of-stream milestone with the
+# full decode/transfer/compute attribution, and the stall verdicts the
+# watchdogs reach — all under the owning fit's trace_id
+EV_SUPERBATCH = flight.event_type("trainer.superbatch")
+EV_STREAM_DONE = flight.event_type("trainer.stream_done")
+EV_STALL = flight.event_type("trainer.stall")
 
 
 @dataclass
@@ -408,6 +416,7 @@ def stream_train_mlp(
     transfer_dtype=np.float16,
     time_budget_s: float | None = None,
     steps_per_call: int = 1,
+    stall_profile_dir: str = "",
 ) -> tuple[object, StreamStats]:
     """Fit the MLP parent scorer directly off disk bytes. Returns
     (params, StreamStats with holdout mse/mae in .metrics).
@@ -441,6 +450,13 @@ def stream_train_mlp(
     (``lax.scan`` device-side) — same math, 1/k the per-call overhead.
     Up to k·B trailing pairs are dropped at stream end (vs B with k=1),
     so keep k modest relative to the dataset.
+
+    Stall watchdogs (utils/flight) ride the pipeline: a step-time or
+    decode-wait observation regressing past ``DF_STALL_FACTOR`` × the
+    trailing median dumps the flight rings to ``DF_DIAG_DIR`` while the
+    stall is live, and — with ``stall_profile_dir`` set (the trainer
+    passes its ``profile_dir``) — forces one ``jax.profiler`` capture
+    of the stalled device leg.
     """
     import jax
     import jax.numpy as jnp
@@ -491,6 +507,21 @@ def stream_train_mlp(
         {"trace_id": _owner.trace_id}
         if _owner is not None and _owner.sampled
         else None
+    )
+    # stall watchdogs: step-time regression (the device leg wedging —
+    # the classic "TPU fit stalls and nobody sampled it") and decode
+    # starvation. One shared profiler callback: the first stall forces
+    # one jax.profiler capture via the trainer's profile_dir plumbing.
+    _on_stall = (
+        (lambda: flight.one_shot_profile(stall_profile_dir))
+        if stall_profile_dir
+        else None
+    )
+    step_watch = flight.StallWatchdog(
+        "trainer.step", floor_s=0.25, on_stall=_on_stall, event=EV_STALL
+    )
+    decode_watch = flight.StallWatchdog(
+        "trainer.decode_wait", floor_s=0.5, on_stall=_on_stall, event=EV_STALL
     )
     # Pipelined packing: fixed [batch_size·k, F+1] (features ‖ label)
     # buffers cycle through a free pool → packing → a dispatcher thread
@@ -545,7 +576,12 @@ def stream_train_mlp(
     def _dispatch_loop():
         prev_loss = prev_buf = None
         saw_sentinel = False
+        # the owning fit span activates on this thread too (contextvars
+        # don't cross threads), so the superbatch flight events and any
+        # stall verdict carry the fit's trace_id
+        span_cm = tracing.use_span(_owner)
         try:
+            span_cm.__enter__()
             while True:
                 b = filled_bufs.get()
                 if b is None:
@@ -573,6 +609,10 @@ def stream_train_mlp(
                 dt_s = time.perf_counter() - t_s
                 stats.step_s += dt_s
                 M.INGEST_STEP_SECONDS.observe(dt_s, exemplar=trace_exemplar)
+                EV_SUPERBATCH(
+                    h2d_s=round(dt_h, 6), step_s=round(dt_s, 6), steps=k
+                )
+                step_watch.observe(dt_s)
                 prev_loss, prev_buf = loss, b
             if prev_loss is not None:
                 jax.block_until_ready(prev_loss)
@@ -592,6 +632,8 @@ def stream_train_mlp(
                 if b is None:
                     break
                 free_bufs.put(b)
+        finally:
+            span_cm.__exit__(None, None, None)
 
     # native-side f16 emit skips the GIL-held f32→f16 numpy convert in
     # the packing loop below — the consumer thread is the bottleneck on
@@ -627,6 +669,7 @@ def stream_train_mlp(
             dt_w = time.perf_counter() - w0
             stats.decode_wait_s += dt_w
             M.INGEST_DECODE_WAIT_SECONDS.observe(dt_w, exemplar=trace_exemplar)
+            decode_watch.observe(dt_w)
             if budget_end is not None and time.perf_counter() > budget_end:
                 stats.truncated = True
                 break  # generator abandonment releases the producers
@@ -716,6 +759,23 @@ def stream_train_mlp(
         stats.steps += 1
     stats.losses = [float(jax.block_until_ready(v)) for v in loss_ring]
     stats.wall_s = time.perf_counter() - t0
+    # round milestone: the whole run's decode/transfer/compute split in
+    # one ring entry — what bounded THIS fit, on permanent record
+    EV_STREAM_DONE(
+        records=stats.download_records,
+        pairs=stats.pairs,
+        steps=stats.steps,
+        wall_s=round(stats.wall_s, 3),
+        decode_wait_s=round(stats.decode_wait_s, 3),
+        buffer_wait_s=round(stats.buffer_wait_s, 3),
+        h2d_s=round(stats.h2d_s, 3),
+        step_s=round(stats.step_s, 3),
+        read_s=round(stats.read_s, 3),
+        cast_s=round(stats.cast_s, 3),
+        enqueue_s=round(stats.enqueue_s, 3),
+        truncated=stats.truncated,
+        stalls=step_watch.stalls + decode_watch.stalls,
+    )
 
     if eval_x:
         xe = np.concatenate(eval_x)

@@ -11,7 +11,7 @@ from dragonfly2_tpu.trainer.service import SERVICE_NAME, TrainerService
 from dragonfly2_tpu.trainer.storage import TrainerStorage
 from dragonfly2_tpu.trainer.train import FitConfig, GNNFitConfig
 from dragonfly2_tpu.trainer.training import Training, TrainingConfig
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, flight
 
 logger = dflog.get("trainer.server")
 
@@ -105,8 +105,16 @@ class TrainerServer:
         self._grpc = None
 
     def serve(self) -> str:
+        # flight recorder: stall/crash dumps + the Diagnose snapshot RPC
+        flight.install("trainer")
+        flight.register_probe(
+            "trainer.storage",
+            lambda: {"host_ids": self.storage.host_ids()},
+        )
+        from dragonfly2_tpu.rpc.diagnose import DiagnoseService
+
         self._grpc, port = glue.serve(
-            {SERVICE_NAME: self.service},
+            {SERVICE_NAME: self.service, glue.DIAGNOSE_SERVICE: DiagnoseService()},
             self.cfg.listen,
             **glue.serve_tls_args(
                 self.cfg.tls_cert_file, self.cfg.tls_key_file, self.cfg.tls_client_ca_file

@@ -16,6 +16,7 @@ manager/models/model.go:20-26 state machine).
 from __future__ import annotations
 
 import concurrent.futures
+import time
 from dataclasses import dataclass, field
 from typing import Any, Protocol
 
@@ -27,10 +28,15 @@ from dragonfly2_tpu.schema.features import build_probe_graph, extract_pair_featu
 from dragonfly2_tpu.trainer.storage import TrainerStorage
 from dragonfly2_tpu.trainer.train import FitConfig, GNNFitConfig, train_gnn, train_mlp
 from dragonfly2_tpu.trainer import metrics as M
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, flight
 from dragonfly2_tpu.utils.idgen import gnn_model_id_v1, host_id_v2, mlp_model_id_v1
 
 logger = dflog.get("trainer")
+
+# round milestones in the flight ring: one event per fit leg (with its
+# outcome) and one per training round — the trainer's black box
+EV_FIT = flight.event_type("trainer.fit")
+EV_ROUND = flight.event_type("trainer.round")
 
 
 class BelowMinRecords(ValueError):
@@ -179,6 +185,13 @@ class Training:
                     logger.exception("trainGRU failed for %s", host_id)
                     outcome.gru_error = str(e)
 
+        EV_ROUND(
+            host_id=host_id,
+            ok=outcome.ok,
+            mlp_error=outcome.mlp_error or "",
+            gnn_error=outcome.gnn_error or "",
+            gru_error=outcome.gru_error or "",
+        )
         if self.config.clear_after_train and not self.config.incremental:
             # the reference retrains from scratch each round and drops
             # consumed uploads (trainer/trainer.go:156-161). Only the
@@ -196,15 +209,24 @@ class Training:
 
         span = tracing.get("trainer").start_span("fit", parent=parent_span, model=model)
         profiler_cm = self._maybe_profile(model)
+        t0 = time.perf_counter()
         # the fit span is active while fn runs so the ingest pipeline can
         # stamp its exemplars with the owning trace_id
         with M.FIT_DURATION.labels(model).time(), profiler_cm, tracing.use_span(span):
             try:
                 result = fn(*args)
-            except Exception:
+            except Exception as e:
+                EV_FIT(
+                    model=model, outcome="failure", error=str(e),
+                    wall_s=round(time.perf_counter() - t0, 3),
+                )
                 span.end("error")
                 M.FIT_TOTAL.labels(model, "failure").inc()
                 raise
+            EV_FIT(
+                model=model, outcome="success",
+                wall_s=round(time.perf_counter() - t0, 3),
+            )
         span.end("ok")
         M.FIT_TOTAL.labels(model, "success").inc()
         return result
@@ -428,6 +450,9 @@ class Training:
             mesh=self.mesh,
             steps_per_call=self.config.streaming_steps_per_call,
             time_budget_s=self.config.streaming_time_budget_s,
+            # a stalled fit forces one jax.profiler capture through the
+            # same profile_dir plumbing on-demand profiling uses
+            stall_profile_dir=self.config.profile_dir,
         )
         # rows counted once per pass — gate on a single pass's worth.
         # A time-budget truncation may have stopped mid-pass; dividing

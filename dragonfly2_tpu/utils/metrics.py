@@ -327,7 +327,11 @@ class MetricsServer:
     GET /healthz answers per-service liveness as JSON on the same port
     deploys already scrape: services register named probes via
     ``register_health``; 200 while every probe passes, 503 otherwise.
-    Unknown paths stay 404."""
+
+    GET /debug/ring serves the local flight-recorder rings
+    (utils/flight) as JSON — ``?category=<name>`` narrows to one ring
+    and 404s for unknown categories, the same not-found behavior as
+    unknown paths. Unknown paths stay 404."""
 
     def __init__(self, registry: Registry, host: str = "127.0.0.1", port: int = 0):
         self.registry = registry
@@ -368,7 +372,10 @@ class MetricsServer:
                 pass
 
             def do_GET(self):
-                if self.path == "/healthz":
+                from urllib.parse import parse_qs, urlparse
+
+                url = urlparse(self.path)
+                if url.path == "/healthz":
                     import json
 
                     ok, body = server.health_snapshot()
@@ -379,7 +386,37 @@ class MetricsServer:
                     self.end_headers()
                     self.wfile.write(data)
                     return
-                if self.path != "/metrics":
+                if url.path == "/debug/ring":
+                    import json
+
+                    # lazy import: flight registers its own series in
+                    # this module's default registry at import time
+                    from dragonfly2_tpu.utils import flight
+
+                    rec = flight.recorder()
+                    # keep_blank_values: ?category= must 404 like any
+                    # other unknown category, not serve every ring
+                    cat = parse_qs(url.query, keep_blank_values=True).get(
+                        "category", [None]
+                    )[0]
+                    if cat is not None and cat not in rec.categories():
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    data = json.dumps(
+                        {
+                            "service": rec.service,
+                            "rings": rec.snapshot([cat] if cat else None),
+                        },
+                        default=str,
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if url.path != "/metrics":
                     self.send_response(404)
                     self.end_headers()
                     return

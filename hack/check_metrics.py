@@ -12,6 +12,13 @@ series registrations (``.counter(...)``/``.gauge(...)``/
   ``[a-z0-9_]``, and counters must end in ``_total`` (which the
   OpenMetrics exposition depends on).
 
+The same census discipline covers the flight recorder's typed event
+emitters (``flight.event_type("...")`` registrations, utils/flight):
+duplicate event names across the package, names without a
+``<service>.`` prefix, and characters outside ``[a-z0-9_.]`` all fail —
+the dfdoctor timeline keys on these names, so they must stay as
+disciplined as the metric series.
+
 Run standalone (``python hack/check_metrics.py``) or via the tier-1
 test that wraps :func:`check`.
 """
@@ -25,8 +32,15 @@ from pathlib import Path
 PACKAGE = Path(__file__).resolve().parent.parent / "dragonfly2_tpu"
 
 # the service segment a series name must start with — one per process
-# role plus the shared rpc glue series
-ALLOWED_SERVICES = ("scheduler", "trainer", "daemon", "manager", "topology", "rpc")
+# role plus the shared rpc glue and flight-recorder series
+ALLOWED_SERVICES = (
+    "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "flight",
+)
+
+# flight-recorder event names are <service>.<what>; the service segment
+# is the ring category, so it must be a real process role (the shared
+# "rpc"/"flight" series prefixes make no sense as a ring)
+EVENT_SERVICES = ("scheduler", "trainer", "daemon", "manager", "topology")
 
 KINDS = ("counter", "gauge", "histogram")
 
@@ -55,12 +69,56 @@ def _registrations(path: Path) -> list[tuple[str, str, int]]:
     return out
 
 
+def _event_registrations(path: Path) -> list[tuple[str, int]]:
+    """(name, lineno) for every literal flight-recorder event-type
+    registration (``flight.event_type("...")`` / ``.event_type(...)``
+    attribute calls) in ``path``."""
+    try:
+        tree = ast.parse(path.read_text())
+    except SyntaxError:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "event_type"):
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            out.append((first.value, node.lineno))
+    return out
+
+
 def check(package_dir: Path = PACKAGE) -> list[str]:
     """Returns a list of human-readable failures (empty = clean)."""
     failures: list[str] = []
     seen: dict[str, tuple[str, str]] = {}  # name -> (kind, site)
+    seen_events: dict[str, str] = {}  # event name -> site
     for path in sorted(package_dir.rglob("*.py")):
         rel = path.relative_to(package_dir.parent)
+        for name, lineno in _event_registrations(path):
+            site = f"{rel}:{lineno}"
+            if not all(c.islower() or c.isdigit() or c in "._" for c in name):
+                failures.append(
+                    f"{site}: event {name!r} has characters outside [a-z0-9_.]"
+                )
+            service = name.split(".", 1)[0]
+            if "." not in name or service not in EVENT_SERVICES:
+                failures.append(
+                    f"{site}: event {name!r} must be <service>.<what> with"
+                    f" service in {EVENT_SERVICES}"
+                )
+            prev_site = seen_events.get(name)
+            if prev_site is not None:
+                failures.append(
+                    f"{site}: duplicate event registration of {name!r}"
+                    f" (first at {prev_site})"
+                )
+            else:
+                seen_events[name] = site
         for name, kind, lineno in _registrations(path):
             site = f"{rel}:{lineno}"
             if not name.replace("_", "").replace("-", "").isascii() or not all(

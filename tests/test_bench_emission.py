@@ -167,6 +167,58 @@ def test_emits_tracing_overhead(monkeypatch, capfd):
     assert rec["schedule_op_us"] > 0
 
 
+def test_emits_recorder_overhead(monkeypatch, capfd):
+    """The artifact carries the flight-recorder overhead measurement
+    (ISSUE 4: the always-on emitters are a measured cost on the
+    scheduling hot path): the relative overhead plus the absolute
+    per-emit cost and the schedule-op wall it was charged against."""
+
+    def stub(paths, **kw):
+        return None, _stats(1000)
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "recorder_error" not in rec
+    assert rec["recorder_overhead_pct"] >= 0.0
+    assert 0.0 < rec["recorder_emit_us"] < 50.0
+    assert rec["schedule_op_with_recorder_us"] > 0
+
+
+def test_recorder_overhead_survives_warmup_failure(monkeypatch, capfd):
+    """host_rates (recorder numbers included) ride every exit path."""
+
+    def stub(paths, **kw):
+        raise RuntimeError("link died in compile")
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "warmup fit failed" in rec["error"]
+    assert rec["recorder_overhead_pct"] >= 0.0
+    assert rec["recorder_emit_us"] > 0
+
+
+def test_recorder_overhead_under_two_percent():
+    """Acceptance bar (ISSUE 4): the always-on flight-recorder emitters
+    cost < 2% of the scheduling hot-path wall. Best-of-3 bench calls so
+    container CPU contention can't fail a genuinely-cheap path."""
+    vals = [
+        bench.recorder_overhead_bench()["recorder_overhead_pct"] for _ in range(3)
+    ]
+    assert min(vals) < 2.0, f"flight-recorder overhead too high: {vals}"
+
+
+def test_recorder_bench_restores_enabled_state():
+    """The microbench toggles the recorder's enabled flag; a bench run
+    must leave recording in its prior state."""
+    from dragonfly2_tpu.utils import flight
+
+    prev = flight.enabled()
+    try:
+        flight.set_enabled(True)
+        bench.recorder_overhead_bench(iters=50, trials=1)
+        assert flight.enabled()
+    finally:
+        flight.set_enabled(prev)
+
+
 def test_tracing_overhead_under_two_percent():
     """Acceptance bar: the disabled/unsampled tracing path costs < 2%
     of the scheduling hot-path wall. Best-of-3 bench calls so container

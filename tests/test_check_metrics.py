@@ -45,6 +45,32 @@ def test_lint_catches_defects(tmp_path):
     assert "scheduler_good_total' does not" not in text
 
 
+def test_lint_catches_event_defects(tmp_path):
+    """Flight-recorder event-type registrations ride the same census:
+    duplicates, missing/unknown service prefix, bad characters."""
+    pkg = tmp_path / "fakepkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "from dragonfly2_tpu.utils import flight\n"
+        'EV_GOOD = flight.event_type("scheduler.decision")\n'
+        'EV_DUP = flight.event_type("daemon.piece")\n'
+        'EV_NOPREFIX = flight.event_type("justaname")\n'
+        'EV_BADSVC = flight.event_type("nosuchservice.thing")\n'
+        'EV_BADCHAR = flight.event_type("trainer.BadCase")\n'
+    )
+    (pkg / "b.py").write_text(
+        "from dragonfly2_tpu.utils import flight\n"
+        'EV_DUP2 = flight.event_type("daemon.piece")\n'
+    )
+    failures = check_metrics.check(pkg)
+    text = "\n".join(failures)
+    assert "duplicate event registration of 'daemon.piece'" in text
+    assert "'justaname' must be <service>.<what>" in text
+    assert "'nosuchservice.thing' must be <service>.<what>" in text
+    assert "'trainer.BadCase' has characters outside" in text
+    assert "scheduler.decision" not in text
+
+
 def test_cli_exit_codes(tmp_path, capsys):
     assert check_metrics.main() == 0
     out = capsys.readouterr()

@@ -1,0 +1,268 @@
+"""dfdoctor (tools/dfdoctor): dump collection (torn-line tolerant),
+live Diagnose-RPC collection, trace merging, and the acceptance e2e —
+a forced trainer stall plus a SIGTERM'd scheduler, merged with a trace
+export into one correlated timeline naming the stalled fit's trace_id."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from dragonfly2_tpu.tools import dfdoctor
+from dragonfly2_tpu.utils import flight, tracing
+
+
+def _write_dump(path, service, reason, events, dumped_at_ns=None, torn=False):
+    dumped_at_ns = dumped_at_ns or time.time_ns()
+    with open(path, "w") as f:
+        f.write(
+            json.dumps(
+                {
+                    "meta": {
+                        "reason": reason,
+                        "service": service,
+                        "pid": 4242,
+                        "dumped_at_ns": dumped_at_ns,
+                        "runtime": {},
+                    }
+                }
+            )
+            + "\n"
+        )
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+        if torn:
+            f.write('{"category": "trainer", "ts_ns": 123, "ty')  # killed mid-write
+    return dumped_at_ns
+
+
+class TestLoadDumps:
+    def test_torn_lines_are_skipped_not_fatal(self, tmp_path):
+        now = time.time_ns()
+        _write_dump(
+            tmp_path / "a.jsonl",
+            "trainer",
+            "stall-trainer.step",
+            [
+                {
+                    "category": "trainer",
+                    "ts_ns": now - 1_000_000,
+                    "type": "trainer.superbatch",
+                    "trace_id": "ab" * 16,
+                    "span_id": "cd" * 8,
+                    "step_s": 0.5,
+                }
+            ],
+            dumped_at_ns=now,
+            torn=True,
+        )
+        events, incidents = dfdoctor.load_dumps(str(tmp_path))
+        assert len(events) == 1  # the torn line vanished, the rest read
+        assert events[0]["service"] == "trainer"
+        assert len(incidents) == 1
+        assert incidents[0].reason == "stall-trainer.step"
+
+    def test_suspect_trace_is_most_implicated(self):
+        evs = [
+            {"ts_ns": 1, "trace_id": "aaa"},
+            {"ts_ns": 2, "trace_id": "bbb"},
+            {"ts_ns": 3, "trace_id": "bbb"},
+            {"ts_ns": 4, "trace_id": ""},
+        ]
+        tid, _ = dfdoctor.suspect_trace(evs, [])
+        assert tid == "bbb"
+
+
+class TestCli:
+    def test_timeline_names_trace_and_flags_window(self, tmp_path, capsys):
+        diag = tmp_path / "diag"
+        diag.mkdir()
+        now = time.time_ns()
+        tid = "f00d" * 8
+        _write_dump(
+            diag / "trainer-1-2-stall.jsonl",
+            "trainer",
+            "stall-trainer.step",
+            [
+                {
+                    "category": "trainer",
+                    "ts_ns": now - 2_000_000_000,
+                    "type": "trainer.superbatch",
+                    "trace_id": tid,
+                    "span_id": "00" * 8,
+                    "step_s": 0.01,
+                },
+                {
+                    "category": "trainer",
+                    "ts_ns": now - 1_000_000,
+                    "type": "trainer.stall",
+                    "trace_id": tid,
+                    "span_id": "00" * 8,
+                    "observed_s": 0.9,
+                },
+            ],
+            dumped_at_ns=now,
+        )
+        traces = tmp_path / "traces"
+        traces.mkdir()
+        (traces / "trainer.spans.jsonl").write_text(
+            json.dumps(
+                {
+                    "name": "fit",
+                    "service": "trainer",
+                    "trace_id": tid,
+                    "span_id": "00" * 8,
+                    "parent_id": "",
+                    "start_ns": now - 3_000_000_000,
+                    "end_ns": now - 500_000,
+                    "status": "ok",
+                    "attributes": {"model": "mlp"},
+                    "events": [],
+                }
+            )
+            + "\n"
+        )
+        rc = dfdoctor.main(["--diag", str(diag), "--traces", str(traces)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "incident: stall-trainer.step" in out
+        assert f"suspect trace: {tid}" in out
+        assert "(fit)" in out  # labeled from the trace export
+        assert "window flagged" in out
+        assert "trainer.stall" in out
+        assert "span  fit" in out  # the merged trace span in the timeline
+
+    def test_list_mode(self, tmp_path, capsys):
+        diag = tmp_path / "diag"
+        diag.mkdir()
+        _write_dump(diag / "s.jsonl", "scheduler", "sigterm", [])
+        rc = dfdoctor.main(["--diag", str(diag), "--list"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "reason=sigterm" in out and "service=scheduler" in out
+
+    def test_rpc_collection(self, tmp_path, capsys):
+        from dragonfly2_tpu.rpc import glue
+        from dragonfly2_tpu.rpc.diagnose import DiagnoseService
+
+        rec = flight.FlightRecorder(ring_size=8)
+        rec.service = "scheduler"
+        rec.event_type("scheduler.live_probe")(depth=9)
+        server, port = glue.serve(
+            {glue.DIAGNOSE_SERVICE: DiagnoseService(recorder=rec)}
+        )
+        try:
+            rc = dfdoctor.main(["--rpc", f"127.0.0.1:{port}"])
+        finally:
+            server.stop(grace=0)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "scheduler.live_probe" in out
+        assert "live-snapshot" in out
+
+
+class TestAcceptanceE2E:
+    """ISSUE 4 acceptance: a forced trainer stall and a SIGTERM'd
+    scheduler each produce dumps that dfdoctor merges with a trace
+    export into one correlated timeline naming the stalled fit's
+    trace_id."""
+
+    def test_stall_and_sigterm_merge_into_one_timeline(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        import numpy as np
+
+        from dragonfly2_tpu.schema import synth, wire
+        from dragonfly2_tpu.trainer import ingest
+
+        diag = tmp_path / "diag"
+        traces = tmp_path / "traces"
+        monkeypatch.setenv("DF_DIAG_DIR", str(diag))
+        monkeypatch.setenv("DF_STALL_FACTOR", "3.0")
+
+        # ---- incident 1: a forced trainer stall under a traced fit ----
+        calls = {"n": 0}
+
+        def fake_get_step(lr, wd, warmup_steps=64):
+            class _Opt:
+                def init(self, params):
+                    return {}
+
+            def step(params, opt_state, xy):
+                calls["n"] += 1
+                if calls["n"] == 12:
+                    time.sleep(0.4)
+                return params, opt_state, np.float32(0.1)
+
+            return _Opt(), step
+
+        monkeypatch.setattr(ingest, "_get_step", fake_get_step)
+        real_watchdog = flight.StallWatchdog
+
+        def small_floor_watchdog(name, **kw):
+            kw["floor_s"] = 0.05
+            kw["cooldown_s"] = 3600.0
+            return real_watchdog(name, **kw)
+
+        monkeypatch.setattr(flight, "StallWatchdog", small_floor_watchdog)
+
+        data = tmp_path / "d.dfb"
+        data.write_bytes(
+            wire.encode_train_block(synth.make_download_records(400, seed=0))
+        )
+        tracing.configure(str(traces), fmt="jsonl")
+        try:
+            with tracing.get("trainer").start_span("fit", model="mlp") as span:
+                ingest.stream_train_mlp(
+                    str(data),
+                    passes=4,
+                    batch_size=64,
+                    eval_every=0,
+                    params={"unused": np.zeros(1)},
+                    workers=1,
+                )
+            fit_trace = span.trace_id
+        finally:
+            tracing.configure(None)
+        assert any(
+            json.loads(l).get("meta", {}).get("reason", "").startswith("stall-")
+            for p in diag.glob("*.jsonl")
+            for l in [p.read_text().splitlines()[0]]
+        ), "no stall dump"
+
+        # ---- incident 2: a SIGTERM'd live scheduler ----
+        from test_flight_recorder import _SCHEDULER_CHILD
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu", DF_DIAG_DIR=str(diag))
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _SCHEDULER_CHILD, str(tmp_path / "data")],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=str(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        )
+        try:
+            assert "READY" in proc.stdout.readline(), proc.stderr.read()
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+        # ---- the join: one correlated timeline from both dumps + traces
+        rc = dfdoctor.main(["--diag", str(diag), "--traces", str(traces)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "incident: stall-trainer.step" in out
+        assert "incident: sigterm" in out
+        # the stalled fit's trace named in the correlated timeline
+        assert f"suspect trace: {fit_trace}" in out
+        assert "(fit)" in out
+        assert "window flagged" in out
+        # both services' events merged into the same report
+        assert "trainer.stall" in out
+        assert "scheduler.child_probe" in out

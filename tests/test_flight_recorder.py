@@ -1,0 +1,507 @@
+"""Flight recorder (utils/flight): bounded rings with automatic trace
+identity, crash dumps (SIGTERM'd live scheduler subprocess included),
+the stall watchdog, the Diagnose RPC, the /debug/ring endpoint, and the
+logs↔traces correlation in dflog."""
+
+import io
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dragonfly2_tpu.utils import dflog, flight, tracing
+
+
+def _fresh(ring_size=16):
+    return flight.FlightRecorder(ring_size=ring_size)
+
+
+# ---------------------------------------------------------------------------
+# rings
+# ---------------------------------------------------------------------------
+
+
+class TestRings:
+    def test_ring_is_bounded_and_counts_drops(self):
+        rec = _fresh(ring_size=16)
+        ev = rec.event_type("scheduler.test_ring")
+        for i in range(40):
+            ev(i=i)
+        snap = rec.snapshot()["scheduler"]
+        assert len(snap) == 16
+        # the ring keeps the NEWEST events
+        assert [e["i"] for e in snap] == list(range(24, 40))
+        assert rec.dropped("scheduler") == 40 - 16
+
+    def test_events_carry_current_trace_identity(self):
+        rec = _fresh()
+        ev = rec.event_type("scheduler.test_trace")
+        with tracing.get("scheduler").start_span("owning") as span:
+            ev(inside=True)
+        ev(inside=False)
+        evs = rec.snapshot()["scheduler"]
+        assert evs[0]["trace_id"] == span.trace_id
+        assert evs[0]["span_id"] == span.span_id
+        assert evs[1]["trace_id"] == "" and evs[1]["span_id"] == ""
+
+    def test_unsampled_span_yields_no_fake_identity(self):
+        # the shared unsampled span has fixed placeholder ids — stamping
+        # them on events would correlate unrelated operations
+        rec = _fresh()
+        ev = rec.event_type("scheduler.test_unsampled")
+        prev = tracing._sample_ratio
+        tracing._sample_ratio = 0.0
+        try:
+            with tracing.get("scheduler").start_span("unsampled"):
+                ev(x=1)
+        finally:
+            tracing._sample_ratio = prev
+        assert rec.snapshot()["scheduler"][0]["trace_id"] == ""
+
+    def test_disable_flag_suppresses_recording(self):
+        rec = _fresh()
+        ev = rec.event_type("scheduler.test_disable")
+        prev = flight.enabled()
+        try:
+            flight.set_enabled(False)
+            ev(x=1)
+            assert rec.snapshot().get("scheduler", []) == []
+            flight.set_enabled(True)
+            ev(x=2)
+            assert len(rec.snapshot()["scheduler"]) == 1
+        finally:
+            flight.set_enabled(prev)
+
+    def test_categories_are_isolated(self):
+        rec = _fresh(ring_size=4)
+        sch = rec.event_type("scheduler.test_iso")
+        trn = rec.event_type("trainer.test_iso")
+        for i in range(10):
+            sch(i=i)
+        trn(kept=True)
+        snap = rec.snapshot()
+        # scheduler chatter never evicted the trainer's single event
+        assert len(snap["trainer"]) == 1 and snap["trainer"][0]["kept"]
+        assert rec.snapshot(["trainer"]).keys() == {"trainer"}
+
+
+# ---------------------------------------------------------------------------
+# dumps
+# ---------------------------------------------------------------------------
+
+
+class TestDumps:
+    def test_dump_writes_meta_then_events(self, tmp_path):
+        rec = _fresh()
+        rec.service = "testsvc"
+        ev = rec.event_type("scheduler.test_dump")
+        with tracing.get("scheduler").start_span("owner") as span:
+            ev(n=1)
+        path = rec.dump("unit-test", diag_dir=str(tmp_path))
+        assert path is not None and os.path.exists(path)
+        lines = open(path).read().splitlines()
+        meta = json.loads(lines[0])["meta"]
+        assert meta["reason"] == "unit-test"
+        assert meta["service"] == "testsvc"
+        assert meta["pid"] == os.getpid()
+        assert "thread_stacks" in meta["runtime"]
+        events = [json.loads(l) for l in lines[1:]]
+        assert events[0]["category"] == "scheduler"
+        assert events[0]["type"] == "scheduler.test_dump"
+        assert events[0]["trace_id"] == span.trace_id
+
+    def test_dump_without_diag_dir_is_noop(self, monkeypatch):
+        monkeypatch.delenv("DF_DIAG_DIR", raising=False)
+        assert _fresh().dump("nowhere") is None
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+    )
+    def test_uncaught_thread_exception_writes_fatal_dump(
+        self, tmp_path, monkeypatch
+    ):
+        # sys.excepthook never fires for worker threads — and that's
+        # where the conductor/pump/GC crashes live; threading.excepthook
+        # must be chained too
+        import threading
+
+        monkeypatch.setenv("DF_DIAG_DIR", str(tmp_path))
+        prev_sys, prev_thread = sys.excepthook, threading.excepthook
+        prev_term = signal.getsignal(signal.SIGTERM)
+        rec = _fresh()
+        try:
+            rec.install("testsvc")
+            rec.event_type("scheduler.pre_crash")(n=1)
+
+            def boom():
+                raise RuntimeError("worker died")
+
+            t = threading.Thread(target=boom)
+            t.start()
+            t.join()
+            dumps = list(tmp_path.glob("*fatal-RuntimeError*.jsonl"))
+            assert dumps, list(tmp_path.iterdir())
+            meta = json.loads(dumps[0].read_text().splitlines()[0])["meta"]
+            assert meta["reason"] == "fatal:RuntimeError"
+        finally:
+            sys.excepthook = prev_sys
+            threading.excepthook = prev_thread
+            signal.signal(signal.SIGTERM, prev_term)
+
+    def test_probe_results_ride_the_dump(self, tmp_path):
+        rec = _fresh()
+        rec.register_probe("good", lambda: {"depth": 3})
+        rec.register_probe("broken", lambda: 1 / 0)
+        state = rec.runtime_state(include_stacks=False)
+        assert state["probes"]["good"] == {"depth": 3}
+        assert "error" in state["probes"]["broken"]
+        assert "thread_stacks" not in state
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestStallWatchdog:
+    def test_synthetic_step_time_spike_triggers_dump(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DF_DIAG_DIR", str(tmp_path))
+        rec = _fresh()
+        ev = rec.event_type("trainer.test_stall")
+        fired = []
+        w = flight.StallWatchdog(
+            "test.step",
+            factor=4.0,
+            min_samples=6,
+            floor_s=0.05,
+            on_stall=lambda: fired.append(1),
+            event=ev,
+            recorder=rec,
+        )
+        # steady baseline: ~10ms steps, no verdicts
+        for _ in range(10):
+            assert not w.observe(0.01)
+        # the spike: 0.5s >> 4 × 10ms (and past the absolute floor)
+        assert w.observe(0.5)
+        assert fired == [1]
+        dumps = list(tmp_path.glob("*.jsonl"))
+        assert len(dumps) == 1
+        meta = json.loads(dumps[0].read_text().splitlines()[0])["meta"]
+        assert meta["reason"] == "stall-test.step"
+        stall_events = [
+            e for e in rec.snapshot()["trainer"] if e["type"] == "trainer.test_stall"
+        ]
+        assert stall_events and stall_events[0]["observed_s"] == 0.5
+
+    def test_cooldown_limits_dump_rate(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DF_DIAG_DIR", str(tmp_path))
+        rec = _fresh()
+        w = flight.StallWatchdog(
+            "test.cool", factor=3.0, min_samples=4, floor_s=0.01,
+            cooldown_s=3600.0, recorder=rec,
+        )
+        for _ in range(6):
+            w.observe(0.01)
+        assert w.observe(1.0)
+        assert not w.observe(1.0)  # inside the cooldown: no second dump
+        assert len(list(tmp_path.glob("*.jsonl"))) == 1
+
+    def test_floor_suppresses_microsecond_jitter(self):
+        w = flight.StallWatchdog(
+            "test.floor", factor=2.0, min_samples=4, floor_s=0.5, recorder=_fresh()
+        )
+        for _ in range(8):
+            w.observe(0.001)
+        # 100× the median but under the absolute floor: not a stall
+        assert not w.observe(0.1)
+
+    def test_factor_zero_disables(self):
+        w = flight.StallWatchdog("test.off", factor=0.0, recorder=_fresh())
+        for _ in range(20):
+            assert not w.observe(100.0)
+
+
+# ---------------------------------------------------------------------------
+# ingest wiring: a forced (stubbed-step) trainer stall produces a dump
+# naming the owning fit's trace
+# ---------------------------------------------------------------------------
+
+
+class TestIngestStall:
+    def test_forced_trainer_stall_dumps_with_fit_trace(self, tmp_path, monkeypatch):
+        import numpy as np
+
+        from dragonfly2_tpu.schema import synth, wire
+        from dragonfly2_tpu.trainer import ingest
+
+        monkeypatch.setenv("DF_DIAG_DIR", str(tmp_path / "diag"))
+        monkeypatch.setenv("DF_STALL_FACTOR", "3.0")
+
+        calls = {"n": 0}
+
+        def fake_get_step(lr, wd, warmup_steps=64):
+            class _Opt:
+                def init(self, params):
+                    return {}
+
+            def step(params, opt_state, xy):
+                calls["n"] += 1
+                if calls["n"] == 12:
+                    time.sleep(0.4)  # the wedged superbatch
+                return params, opt_state, np.float32(0.1)
+
+            return _Opt(), step
+
+        monkeypatch.setattr(ingest, "_get_step", fake_get_step)
+        # tiny watchdog floor so the synthetic 0.4s spike clears it
+        # without 250ms-baseline steps
+        real_watchdog = flight.StallWatchdog
+
+        def small_floor_watchdog(name, **kw):
+            kw["floor_s"] = 0.05
+            kw["cooldown_s"] = 3600.0
+            return real_watchdog(name, **kw)
+
+        monkeypatch.setattr(flight, "StallWatchdog", small_floor_watchdog)
+
+        block = wire.encode_train_block(synth.make_download_records(400, seed=0))
+        data = tmp_path / "d.dfb"
+        data.write_bytes(block)
+
+        with tracing.get("trainer").start_span("fit", model="mlp") as span:
+            ingest.stream_train_mlp(
+                str(data),
+                passes=4,
+                batch_size=64,
+                eval_every=0,
+                params={"unused": np.zeros(1)},
+                workers=1,
+            )
+        dumps = list((tmp_path / "diag").glob("*.jsonl"))
+        assert dumps, "stall watchdog produced no dump"
+        lines = dumps[0].read_text().splitlines()
+        meta = json.loads(lines[0])["meta"]
+        assert meta["reason"].startswith("stall-trainer.step")
+        events = [json.loads(l) for l in lines[1:]]
+        stall = [e for e in events if e["type"] == "trainer.stall"]
+        assert stall, "no trainer.stall event in the dump"
+        # the stall names the owning fit's trace — the correlation
+        # dfdoctor keys on. The ring is process-wide, so a full-suite
+        # run may hold older stalls from other tests: the NEWEST stall
+        # is this run's.
+        assert stall[-1]["trace_id"] == span.trace_id
+        supers = [e for e in events if e["type"] == "trainer.superbatch"]
+        assert supers and any(e["trace_id"] == span.trace_id for e in supers)
+
+
+# ---------------------------------------------------------------------------
+# Diagnose RPC + /debug/ring
+# ---------------------------------------------------------------------------
+
+
+class TestDiagnoseSurfaces:
+    def test_diagnose_rpc_over_real_grpc(self):
+        from dragonfly2_tpu.rpc import gen  # noqa: F401
+        import diagnose_pb2  # noqa: E402
+
+        from dragonfly2_tpu.rpc import glue
+        from dragonfly2_tpu.rpc.diagnose import DiagnoseService
+
+        rec = _fresh()
+        rec.service = "testsvc"
+        rec.event_type("scheduler.test_rpc")(n=7)
+        rec.register_probe("queue", lambda: {"depth": 2})
+        server, port = glue.serve(
+            {glue.DIAGNOSE_SERVICE: DiagnoseService(recorder=rec)}
+        )
+        try:
+            channel = glue.dial(f"127.0.0.1:{port}")
+            client = glue.ServiceClient(channel, glue.DIAGNOSE_SERVICE)
+            resp = client.Diagnose(
+                diagnose_pb2.DiagnoseRequest(include_stacks=True), timeout=5
+            )
+            assert resp.service == "testsvc"
+            assert resp.pid == os.getpid()
+            snap = json.loads(resp.snapshot_json)
+            evs = snap["rings"]["scheduler"]
+            assert evs[0]["type"] == "scheduler.test_rpc" and evs[0]["n"] == 7
+            assert snap["runtime"]["probes"]["queue"] == {"depth": 2}
+            assert snap["runtime"]["thread_stacks"]
+            # category filter narrows the snapshot
+            resp2 = client.Diagnose(
+                diagnose_pb2.DiagnoseRequest(categories=["nosuch"]), timeout=5
+            )
+            assert json.loads(resp2.snapshot_json)["rings"] == {}
+            channel.close()
+        finally:
+            server.stop(grace=0)
+
+    def test_debug_ring_endpoint(self):
+        import urllib.error
+        import urllib.request
+
+        from dragonfly2_tpu.utils.metrics import MetricsServer, Registry
+
+        # the endpoint reads the PROCESS-WIDE recorder (what the service
+        # actually records into), so emit through the module API
+        flight.event_type("scheduler.test_http")(hello=True)
+        server = MetricsServer(Registry("t"))
+        addr = server.start()
+        try:
+            body = json.loads(
+                urllib.request.urlopen(
+                    f"http://{addr}/debug/ring?category=scheduler"
+                ).read()
+            )
+            assert "scheduler" in body["rings"]
+            assert any(
+                e["type"] == "scheduler.test_http" for e in body["rings"]["scheduler"]
+            )
+            # unfiltered form serves every ring
+            body = json.loads(
+                urllib.request.urlopen(f"http://{addr}/debug/ring").read()
+            )
+            assert "scheduler" in body["rings"]
+            # unknown category: the same 404 as unknown paths
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://{addr}/debug/ring?category=nosuchring"
+                )
+            assert exc.value.code == 404
+            # a BLANK category is an unknown category, not "all rings"
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"http://{addr}/debug/ring?category=")
+            assert exc.value.code == 404
+        finally:
+            server.stop()
+
+    def test_recorder_series_exposed_after_snapshot(self):
+        from dragonfly2_tpu.utils.metrics import default_registry
+
+        rec = flight.recorder()
+        rec.event_type("scheduler.test_series")(x=1)
+        rec.snapshot()
+        text = default_registry.expose()
+        assert "dragonfly_flight_ring_depth" in text
+        assert "dragonfly_flight_dumps_total" in text
+
+
+# ---------------------------------------------------------------------------
+# crash dump: SIGTERM a live scheduler subprocess
+# ---------------------------------------------------------------------------
+
+_SCHEDULER_CHILD = """
+import os, sys, time
+from dragonfly2_tpu.scheduler.server import SchedulerServer, SchedulerServerConfig
+from dragonfly2_tpu.utils import flight
+
+srv = SchedulerServer(
+    SchedulerServerConfig(data_dir=sys.argv[1], topology_backend="off")
+)
+srv.serve()
+flight.event_type("scheduler.child_probe")(note="alive", pid=os.getpid())
+print("READY", flush=True)
+time.sleep(120)
+"""
+
+
+class TestCrashDump:
+    def test_sigterm_live_scheduler_dumps_ring(self, tmp_path):
+        diag = tmp_path / "diag"
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            DF_DIAG_DIR=str(diag),
+            DF_FLIGHT="1",
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _SCHEDULER_CHILD, str(tmp_path / "data")],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=str(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        )
+        try:
+            line = proc.stdout.readline()
+            assert "READY" in line, proc.stderr.read()
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=30)
+            # the handler re-raises the default disposition after dumping
+            assert rc != 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        dumps = sorted(diag.glob("scheduler-*.jsonl"))
+        assert dumps, f"no dump written to {diag}"
+        # torn-line tolerant parse: a line killed mid-write is skipped,
+        # the rest must still be well-formed jsonl
+        parsed = []
+        for raw in dumps[0].read_text().splitlines():
+            try:
+                parsed.append(json.loads(raw))
+            except json.JSONDecodeError:
+                continue
+        assert parsed, "dump held no parseable lines"
+        meta = parsed[0]["meta"]
+        assert meta["reason"] == "sigterm"
+        assert meta["service"] == "scheduler"
+        events = [p for p in parsed[1:] if "type" in p]
+        assert any(e["type"] == "scheduler.child_probe" for e in events)
+
+
+# ---------------------------------------------------------------------------
+# dflog: logs↔traces correlation
+# ---------------------------------------------------------------------------
+
+
+class TestDflogTraceInjection:
+    def _capture(self):
+        """A handler configured exactly as dflog.configure wires it."""
+        buf = io.StringIO()
+        handler = logging.StreamHandler(buf)
+        handler.setFormatter(logging.Formatter(dflog._FORMAT))
+        handler.addFilter(dflog._TraceContextFilter())
+        return buf, handler
+
+    def test_record_inside_span_carries_trace_id(self):
+        buf, handler = self._capture()
+        logger = logging.getLogger("dragonfly2_tpu.test_dflog_in")
+        logger.addHandler(handler)
+        logger.propagate = False
+        try:
+            with tracing.get("scheduler").start_span("op") as span:
+                logger.warning("inside")
+            out = buf.getvalue()
+            assert f"trace_id={span.trace_id}" in out
+            assert f"span_id={span.span_id}" in out
+        finally:
+            logger.removeHandler(handler)
+
+    def test_record_outside_span_stays_clean(self):
+        buf, handler = self._capture()
+        logger = logging.getLogger("dragonfly2_tpu.test_dflog_out")
+        logger.addHandler(handler)
+        logger.propagate = False
+        try:
+            logger.warning("outside")
+            out = buf.getvalue()
+            assert "outside" in out
+            assert "trace_id=" not in out
+        finally:
+            logger.removeHandler(handler)
+
+    def test_with_context_uses_module_level_adapter(self):
+        # the per-call class definition was hoisted: every adapter is
+        # the same type now
+        a = dflog.with_context("x", peer="p1")
+        b = dflog.with_context("y", host="h1")
+        assert type(a) is type(b) is dflog._Ctx
+        msg, _ = a.process("hello", {})
+        assert msg == "peer=p1 hello"

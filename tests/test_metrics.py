@@ -354,6 +354,7 @@ def test_documented_series_exist():
     import dragonfly2_tpu.manager.metrics  # noqa: F401
     import dragonfly2_tpu.scheduler.metrics  # noqa: F401
     import dragonfly2_tpu.trainer.metrics  # noqa: F401
+    import dragonfly2_tpu.utils.flight  # noqa: F401 — flight_* series
     from dragonfly2_tpu.rpc import glue
     from dragonfly2_tpu.utils.metrics import default_registry
 
