@@ -285,6 +285,75 @@ def recorder_overhead_bench(iters: int = 1000, trials: int = 5) -> dict:
     }
 
 
+def resilience_overhead_bench(iters: int = 1000, trials: int = 5) -> dict:
+    """Resilience-layer cost on the fault-free path.
+
+    Direct measurement, same discipline as the tracing/recorder benches:
+    the policy layer's whole per-call sequence (fault-point gate, policy
+    lookup, breaker allow, deadline/budget math, metadata stamp, breaker
+    + budget success bookkeeping) runs against a no-op inner callable in
+    a tight loop, and its per-call cost is charged against the measured
+    scheduling op. Conservative: every RPC carries the full sequence
+    even where a resilience-free build would call the stub directly.
+
+    - ``resilience_call_us``: added cost per call = wrapped no-op minus
+      bare no-op, best-of-trials.
+    - ``resilience_overhead_pct``: that cost over the schedule-op wall;
+      acceptance bar < 2%.
+    """
+    from dragonfly2_tpu.rpc import resilience
+
+    sched, child = _scheduling_microbench()
+    best_op = float("inf")
+    for _ in range(iters // 5):  # warm
+        sched.schedule_candidate_parents(child, set())
+    for _ in range(max(trials, 1)):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            sched.schedule_candidate_parents(child, set())
+        best_op = min(best_op, (time.perf_counter() - t0) / iters)
+
+    def inner(request, timeout=None, metadata=None):
+        return request
+
+    wrapped = resilience.wrap_call(
+        "dragonfly2_tpu.scheduler.Scheduler",
+        "StatTask",
+        "unary_unary",
+        "bench-resilience-target",
+        inner,
+    )
+    call_iters = 20_000
+    best_bare = best_wrapped = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(call_iters):
+            inner(None)
+        best_bare = min(best_bare, (time.perf_counter() - t0) / call_iters)
+        t0 = time.perf_counter()
+        for _ in range(call_iters):
+            wrapped(None)
+        best_wrapped = min(best_wrapped, (time.perf_counter() - t0) / call_iters)
+    delta = max(best_wrapped - best_bare, 0.0)
+    overhead_pct = delta / best_op * 100.0 if best_op else 0.0
+    return {
+        "resilience_overhead_pct": round(overhead_pct, 2),
+        "resilience_call_us": round(delta * 1e6, 3),
+        "schedule_op_resilience_us": round(best_op * 1e6, 2),
+    }
+
+
+def chaos_soak_bench() -> dict:
+    """The canned chaos soak (tools/stress.chaos_soak) at bench scale:
+    scheduler restart + 5%% seeded RPC errors + parent kill over a small
+    download series. ``chaos_success_rate`` must be 1.0 with zero hangs
+    — the resilience layer's end-to-end acceptance check, riding the
+    bench artifact so every run re-proves it."""
+    from dragonfly2_tpu.tools.stress import chaos_soak
+
+    return chaos_soak(downloads=4, piece=16 * 1024, deadline_s=30.0)
+
+
 def tracing_overhead_bench(iters: int = 1000, trials: int = 5) -> dict:
     """Tracing cost on the scheduling hot path when nothing samples.
 
@@ -538,6 +607,30 @@ def main() -> None:
         except Exception as e:
             host_rates["recorder_error"] = str(e)
             _phase(f"recorder bench failed: {e}")
+        # resilience-layer overhead rides host_rates the same way: the
+        # fault-free pre-flight (breaker/budget/deadline) must stay < 2%
+        # of the scheduling hot-path wall
+        try:
+            host_rates.update(resilience_overhead_bench())
+            _phase(
+                f"resilience: call {host_rates['resilience_call_us']:.2f} us ="
+                f" {host_rates['resilience_overhead_pct']:.2f}% of schedule wall"
+            )
+        except Exception as e:
+            host_rates["resilience_error"] = str(e)
+            _phase(f"resilience bench failed: {e}")
+        # chaos soak: the canned fault schedule against a real in-process
+        # swarm — success rate and hang count ride every exit path
+        try:
+            host_rates.update(chaos_soak_bench())
+            _phase(
+                f"chaos soak: success {host_rates['chaos_success_rate']:.2f}"
+                f" hangs {host_rates['chaos_hangs']}"
+                f" ({host_rates['chaos_wall_s']:.1f}s)"
+            )
+        except Exception as e:
+            host_rates["chaos_error"] = str(e)
+            _phase(f"chaos soak failed: {e}")
         _phase(
             f"host split: decode(binary) {decode_only_rate_binary / 1e3:.1f}k/s,"
             f" decode(csv) {host_rates.get('decode_only_rate_csv', 0) / 1e3:.1f}k/s,"

@@ -36,9 +36,13 @@ from dragonfly2_tpu.client.piece_manager import (
 from dragonfly2_tpu.client.pieces import PieceRange, parse_byte_range, piece_ranges
 from dragonfly2_tpu.client.storage import StorageManager
 from dragonfly2_tpu.client import metrics as M
-from dragonfly2_tpu.utils import dflog, flight
+from dragonfly2_tpu.utils import dflog, faults, flight
 
 logger = dflog.get("client.conductor")
+
+# fault point: the announce-stream open — chaos schedules kill the
+# scheduler link here to drill the reconnect-with-resume path
+FP_ANNOUNCE_STREAM = faults.point("daemon.announce_stream")
 
 # flight-recorder emitters: the peer/piece lifecycle as the daemon saw
 # it — the always-on black box a wedged peer postmortem replays
@@ -51,6 +55,7 @@ EV_PIECE_DONE = flight.event_type("daemon.piece_done")
 EV_PIECE_FAILED = flight.event_type("daemon.piece_failed")
 EV_PARENT_BLOCKED = flight.event_type("daemon.parent_blocked")
 EV_RESCHEDULE = flight.event_type("daemon.reschedule")
+EV_ANNOUNCE_RECONNECT = flight.event_type("daemon.announce_reconnect")
 
 
 @dataclass
@@ -77,6 +82,12 @@ class ConductorOptions:
     wait_piece_timeout: float = 5.0
     disable_back_source: bool = False
     piece_length: int = 0  # 0 = derive from content length
+    # announce-stream resume: a broken scheduler stream (restart, network
+    # blip) re-opens and re-registers this many times before the old
+    # fail/back-to-source behavior kicks in — the peer task survives the
+    # scheduler's incident instead of paying an origin round trip for it
+    stream_reconnect_attempts: int = 3
+    stream_reconnect_backoff: float = 0.2
 
 
 class PeerTaskConductor:
@@ -133,6 +144,7 @@ class PeerTaskConductor:
         self._started_at = 0.0
         self._stream_thread: threading.Thread | None = None
         self._run_thread: threading.Thread | None = None
+        self._stream_reconnects = 0
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -188,9 +200,13 @@ class PeerTaskConductor:
     # ------------------------------------------------------------------
     # announce stream plumbing
     # ------------------------------------------------------------------
-    def _req_iter(self):
+    def _req_iter(self, requests):
+        # the queue is a parameter, not read off self per iteration: a
+        # reconnect swaps self._requests, and the dead stream's feeder
+        # must keep draining ITS queue (where its None sentinel went),
+        # never steal the replacement stream's re-register
         while True:
-            r = self._requests.get()
+            r = requests.get()
             if r is None:
                 return
             yield r
@@ -207,12 +223,14 @@ class PeerTaskConductor:
         the run loop (reference receivePeerPacket :659)."""
         from dragonfly2_tpu.utils import tracing
 
+        requests = self._requests  # bound once, before any later swap
         try:
+            FP_ANNOUNCE_STREAM()
             # the peer_task span is this thread's context for the
             # AnnouncePeer call, so the scheduler's rpc.AnnouncePeer span
             # (and its scheduling children) join the download's trace
             with tracing.use_span(getattr(self, "_span", None)):
-                responses = self.scheduler.AnnouncePeer(self._req_iter())
+                responses = self.scheduler.AnnouncePeer(self._req_iter(requests))
             for resp in responses:
                 which = resp.WhichOneof("response")
                 self._decisions.put((which, getattr(resp, which)))
@@ -230,18 +248,22 @@ class PeerTaskConductor:
         with tracing.use_span(getattr(self, "_span", None)):
             self._run_traced()
 
+    def _register_request(self) -> "scheduler_pb2.RegisterPeerRequest":
+        """The registration message — shared by first registration and
+        the announce-stream reconnect re-register, so the two can never
+        drift apart field by field."""
+        return scheduler_pb2.RegisterPeerRequest(
+            task_id=self.task_id,
+            peer_id=self.peer_id,
+            url=self.url,
+            url_meta=self.url_meta,
+            task_type=self.task_type,
+            need_back_to_source=self.need_back_to_source,
+        )
+
     def _run_traced(self) -> None:
         try:
-            self._send(
-                register_peer=scheduler_pb2.RegisterPeerRequest(
-                    task_id=self.task_id,
-                    peer_id=self.peer_id,
-                    url=self.url,
-                    url_meta=self.url_meta,
-                    task_type=self.task_type,
-                    need_back_to_source=self.need_back_to_source,
-                )
-            )
+            self._send(register_peer=self._register_request())
             self._drive()
         except Exception as e:
             logger.exception("conductor %s failed", self.peer_id)
@@ -297,11 +319,55 @@ class PeerTaskConductor:
                     return
                 continue  # rescheduled — wait for next decision
             if which == "stream_error":
+                # resilience: re-open the stream and re-register before
+                # giving up — pieces already on disk are resumed by
+                # _download_from_parents, and the scheduler re-dispatches
+                # a known peer_id by its current state, so a scheduler
+                # restart costs a reconnect, not the whole peer task
+                if self._reconnect_stream(str(body)):
+                    continue
                 if self.opts.disable_back_source:
                     self._fail(f"announce stream error: {body}")
                 else:
                     self._back_to_source()
                 return
+
+    # ------------------------------------------------------------------
+    def _reconnect_stream(self, cause: str) -> bool:
+        """Announce-stream resume: jittered wait, fresh request queue, a
+        new stream thread, and a re-register carrying the same peer_id.
+        False once the attempt budget is spent (callers then run the old
+        fail/back-to-source path)."""
+        if self._stream_reconnects >= self.opts.stream_reconnect_attempts:
+            return False
+        self._stream_reconnects += 1
+        attempt = self._stream_reconnects
+        EV_ANNOUNCE_RECONNECT(
+            peer_id=self.peer_id, attempt=attempt, cause=cause[:200]
+        )
+        logger.warning(
+            "announce stream for %s reconnecting (attempt %d/%d): %s",
+            self.peer_id, attempt, self.opts.stream_reconnect_attempts, cause,
+        )
+        from dragonfly2_tpu.rpc import resilience
+
+        time.sleep(
+            resilience.full_jitter_backoff(
+                attempt - 1, base_s=self.opts.stream_reconnect_backoff, cap_s=2.0
+            )
+        )
+        # release the dead stream's request feeder (gRPC's sender thread
+        # may still be blocked on the old queue), then swap in a fresh one
+        self._requests.put(None)
+        self._requests = queue.Queue()
+        self._stream_thread = threading.Thread(
+            target=self._stream_loop,
+            name=f"announce-{self.peer_id[:8]}-r{attempt}",
+            daemon=True,
+        )
+        self._stream_thread.start()
+        self._send(register_peer=self._register_request())
+        return True
 
     # ------------------------------------------------------------------
     def _back_to_source(self) -> None:
@@ -507,9 +573,14 @@ class PeerTaskConductor:
             if not c.host.port:
                 continue
             try:
-                channel = glue.dial(f"{c.host.ip}:{c.host.port}", retries=1)
+                addr = f"{c.host.ip}:{c.host.port}"
+                channel = glue.dial(addr, retries=1)
                 try:
-                    parent = glue.ServiceClient(channel, glue.DFDAEMON_SERVICE)
+                    # target=addr: each parent gets its own breaker —
+                    # one dead parent must not fail-fast the healthy ones
+                    parent = glue.ServiceClient(
+                        channel, glue.DFDAEMON_SERVICE, target=addr
+                    )
                     packet = parent.GetPieceTasks(
                         dfdaemon_pb2.PieceTaskRequest(
                             task_id=self.task_id,

@@ -17,8 +17,8 @@ from dataclasses import dataclass, field
 
 from dragonfly2_tpu.client import downloader, source
 from dragonfly2_tpu.client.pieces import PieceRange, compute_piece_length, piece_ranges
-from dragonfly2_tpu.client.storage import TaskStorage
-from dragonfly2_tpu.utils import dflog, flight
+from dragonfly2_tpu.client.storage import StorageError, TaskStorage
+from dragonfly2_tpu.utils import dflog, faults, flight
 
 logger = dflog.get("client.piece")
 
@@ -26,6 +26,11 @@ logger = dflog.get("client.piece")
 # so every origin hit is worth a permanent ring entry
 EV_SOURCE_START = flight.event_type("daemon.source_download_start")
 EV_SOURCE_DONE = flight.event_type("daemon.source_download_done")
+
+# fault point: the parent piece fetch — chaos schedules model flaky/lying
+# parents here (errors, latency, payload truncation/corruption); the
+# digest check downstream must catch every mutated payload
+FP_PIECE_READ = faults.point("daemon.piece_read")
 
 TRAFFIC_BACK_TO_SOURCE = "back_to_source"
 TRAFFIC_REMOTE_PEER = "remote_peer"
@@ -109,9 +114,14 @@ class PieceManager:
         peer_id: str,
     ) -> "PieceResult":
         t0 = time.monotonic()
+        try:
+            FP_PIECE_READ()
+        except faults.InjectedFault as e:
+            raise downloader.PieceDownloadError(str(e)) from e
         data, digest, content_type = downloader.download_piece(
             parent.upload_addr, ts.meta.task_id, pr.number, peer_id=peer_id
         )
+        data = FP_PIECE_READ.mutate(data)
         if self.download_delay_s > 0:
             time.sleep(self.download_delay_s)  # inside the cost window
         dt_transfer = time.monotonic() - t0
@@ -129,15 +139,23 @@ class PieceManager:
             raise downloader.PieceDownloadError(
                 f"piece {pr.number}: want {pr.length}B got {len(data)}B"
             )
-        pm = ts.write_piece(
-            pr.number,
-            pr.offset,
-            data,
-            digest=digest,
-            traffic_type=TRAFFIC_REMOTE_PEER,
-            cost_ns=int(dt * 1e9),
-            parent_id=parent.peer_id,
-        )
+        try:
+            pm = ts.write_piece(
+                pr.number,
+                pr.offset,
+                data,
+                digest=digest,
+                traffic_type=TRAFFIC_REMOTE_PEER,
+                cost_ns=int(dt * 1e9),
+                parent_id=parent.peer_id,
+            )
+        except StorageError as e:
+            # a digest mismatch means THIS parent served corrupt bytes —
+            # that's a retryable piece failure (another parent or the
+            # origin may hold good bytes), not a terminal task error
+            raise downloader.PieceDownloadError(
+                f"piece {pr.number} from {parent.peer_id}: {e}"
+            ) from e
         return PieceResult(pm.number, pm.offset, pm.length, pm.digest, pm.traffic_type, pm.cost_ns, parent.peer_id)
 
     # ------------------------------------------------------------------

@@ -72,7 +72,11 @@ class PieceTaskSynchronizer:
             logger.debug("sync dial %s failed: %s", daemon_addr, e)
             return
         try:
-            client = glue.ServiceClient(channel, glue.DFDAEMON_SERVICE)
+            # target=daemon_addr: per-parent breaker/budget — one dead
+            # parent must not trip the others' circuit
+            client = glue.ServiceClient(
+                channel, glue.DFDAEMON_SERVICE, target=daemon_addr
+            )
             first = [True]
 
             def watermark() -> int:

@@ -178,10 +178,18 @@ class ServiceClient:
     ``traceparent`` header carrying the caller's current span rides the
     invocation metadata, and outcomes land in the
     ``rpc_client_handled_total``/``rpc_client_handling_seconds``
-    series."""
+    series — and with the resilience policy layer (rpc/resilience.py):
+    per-service deadlines with downstream budget propagation, jittered
+    capped retries under a token budget, and a per-target circuit
+    breaker. ``target`` labels the breaker/budget (pass the dialed
+    address when known — SchedulerSelector does); it defaults to the
+    service's short name so single-target clients still get a breaker."""
 
-    def __init__(self, channel: grpc.Channel, service: str):
+    def __init__(self, channel: grpc.Channel, service: str, target: str = ""):
+        from dragonfly2_tpu.rpc import resilience
+
         methods = SERVICES[service]
+        target = target or service.rsplit(".", 1)[-1]
         for name, m in methods.items():
             factory = getattr(channel, m.kind)
             callable_ = factory(
@@ -189,7 +197,17 @@ class ServiceClient:
                 request_serializer=m.request.SerializeToString,
                 response_deserializer=m.response.FromString,
             )
-            setattr(self, name, _instrument_client(service, name, m.kind, callable_))
+            setattr(
+                self,
+                name,
+                resilience.wrap_call(
+                    service,
+                    name,
+                    m.kind,
+                    target,
+                    _instrument_client(service, name, m.kind, callable_),
+                ),
+            )
 
 
 # Per-RPC server observability (reference: every server wires
@@ -384,6 +402,8 @@ def _instrument(service: str, name: str, kind: str, fn: Callable) -> Callable:
     streaming_out = kind in (UNARY_STREAM, STREAM_STREAM)
 
     def wrapped(request_or_iterator, context):
+        from dragonfly2_tpu.rpc import resilience
+
         tracer = tracing.get(short)
         remote = tracing.parse_traceparent(_incoming_traceparent(context))
         span = tracer.start_span(f"rpc.{name}", parent=remote)
@@ -394,9 +414,26 @@ def _instrument(service: str, name: str, kind: str, fn: Callable) -> Callable:
             handled.labels(service, name, code).inc()
             span.end(status="ok" if code == "OK" else "error")
 
+        # deadline-budget propagation (resilience layer): a request whose
+        # caller already stopped waiting is shed before the handler runs —
+        # finishing it would burn capacity the live requests need. The
+        # remaining budget becomes this handler's ambient deadline, so
+        # downstream client calls inherit (and further shrink) it.
+        budget_ms = resilience.incoming_budget_ms(context.invocation_metadata())
+        if resilience.shed_check(service, name, budget_ms):
+            finish("DEADLINE_EXCEEDED")
+            context.abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED, "deadline budget exhausted; shed"
+            )
+        deadline_at = (
+            time.monotonic() + budget_ms / 1000.0 if budget_ms is not None else None
+        )
+
         if not streaming_out:
             try:
-                with tracing.use_span(span):
+                with tracing.use_span(span), resilience.absolute_deadline_scope(
+                    deadline_at
+                ):
                     resp = fn(request_or_iterator, context)
             except Exception:
                 finish(_code_of(context))
@@ -416,7 +453,12 @@ def _instrument(service: str, name: str, kind: str, fn: Callable) -> Callable:
             gen = fn(request_or_iterator, context)
             try:
                 while True:
-                    with tracing.use_span(span):
+                    # the deadline scope re-enters per resumption like the
+                    # span: pooled gRPC threads must never inherit a stale
+                    # deadline left across a yield
+                    with tracing.use_span(span), resilience.absolute_deadline_scope(
+                        deadline_at
+                    ):
                         try:
                             item = next(gen)
                         except StopIteration:
@@ -507,16 +549,22 @@ def dial(
     address: str,
     retries: int = 3,
     backoff: float = 0.2,
+    backoff_cap: float = 2.0,
     tls_ca: bytes | None = None,
     tls_client: "tuple[bytes, bytes] | None" = None,  # (key_pem, cert_pem)
     tls_server_name: str | None = None,
     ready_timeout: float = 5.0,
 ) -> grpc.Channel:
-    """Channel with connection wait + simple retry-on-dial (reference
-    pkg/rpc client dialing uses retry/backoff interceptors). ``tls_ca``
-    switches to TLS verifying the server against that root;
-    ``tls_client`` adds the client pair for mTLS; ``tls_server_name``
-    overrides SNI/verification for certs issued to a different name."""
+    """Channel with connection wait + retry-on-dial (reference pkg/rpc
+    client dialing uses retry/backoff interceptors). Dial retries sleep
+    the resilience layer's capped full-jitter backoff — the raw
+    ``backoff * 2**attempt`` this used to run synchronizes every
+    reconnecting client into lockstep thundering herds against a
+    restarting server. ``tls_ca`` switches to TLS verifying the server
+    against that root; ``tls_client`` adds the client pair for mTLS;
+    ``tls_server_name`` overrides SNI/verification for certs issued to a
+    different name."""
+    from dragonfly2_tpu.rpc import resilience
     options = [
         ("grpc.max_send_message_length", 256 * 1024 * 1024),
         ("grpc.max_receive_message_length", 256 * 1024 * 1024),
@@ -541,7 +589,11 @@ def dial(
             last = e
             channel.close()  # else the failed channel keeps reconnect threads alive
             if attempt + 1 < retries:  # no pointless sleep after the last try
-                time.sleep(backoff * (2**attempt))
+                time.sleep(
+                    resilience.full_jitter_backoff(
+                        attempt, base_s=backoff, cap_s=backoff_cap
+                    )
+                )
     raise ConnectionError(f"failed to dial {address}: {last}")
 
 
@@ -710,7 +762,11 @@ class SchedulerSelector:
                 channel.close()
                 raise ConnectionError(f"{addr} removed from the scheduler set")
             self._channels[addr] = channel
-            client = self._clients[addr] = ServiceClient(channel, self.service)
+            # target=addr: each scheduler gets its own circuit breaker and
+            # retry budget — one dark member must not trip the others'
+            client = self._clients[addr] = ServiceClient(
+                channel, self.service, target=addr
+            )
             self._fail_until.pop(addr, None)
             return client
 

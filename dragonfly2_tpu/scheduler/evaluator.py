@@ -202,10 +202,15 @@ class MLEvaluator(BaseEvaluator):
     # invalidated naturally by the piece count changing)
     GRU_CACHE_MAX = 4096
 
+    # degraded-mode component name on /healthz + the
+    # resilience_degraded_mode gauge
+    DEGRADED_COMPONENT = "scheduler.evaluator"
+
     def __init__(self, model=None, gru=None, topology=None):
         self._model = model  # ml.scorer.MLPScorer-compatible
         self._gru = gru  # trainer.serving.GRUScorer-compatible
         self._topology = topology  # topology.TopologyEngine-compatible
+        self._degraded = False  # local edge detector: flag flips are rare
         # peer.id -> (piece_count, verdict): is_bad_node runs once per
         # candidate per scheduling attempt (per piece event), and a jit
         # dispatch per call would multiply hot-path latency — the verdict
@@ -279,10 +284,25 @@ class MLEvaluator(BaseEvaluator):
                 return
         self._model = model
 
+    def _set_degraded(self, reason: "str | None") -> None:
+        """Edge-triggered degraded-mode flag: the ML→base fallback is a
+        *visible* state (resilience registry → /healthz + gauge + flight
+        event), not a silent ranking change. Only flips pay the registry
+        lock; the steady state costs one predicate."""
+        want = reason is not None
+        if want == self._degraded:
+            return
+        self._degraded = want
+        from dragonfly2_tpu.rpc import resilience
+
+        resilience.set_degraded(self.DEGRADED_COMPONENT, reason)
+
     def evaluate_parents(
         self, parents: list[Peer], child: Peer, total_piece_count: int
     ) -> list[Peer]:
         if self._model is None or not parents:
+            if self._model is None:
+                self._set_degraded("no model loaded; base evaluator ranking")
             return super().evaluate_parents(parents, child, total_piece_count)
         try:
             if self._topology is not None:
@@ -322,6 +342,7 @@ class MLEvaluator(BaseEvaluator):
                         for i in order[:EXPLAIN_TOP_K]
                     ],
                 )
+            self._set_degraded(None)
             return [parents[int(i)] for i in order]
         except Exception:
             # degraded mode: never fail scheduling because of the model —
@@ -329,6 +350,7 @@ class MLEvaluator(BaseEvaluator):
             logger.warning(
                 "ml evaluator predict failed; using base ranking", exc_info=True
             )
+            self._set_degraded("ml predict failed; base evaluator ranking")
             return super().evaluate_parents(parents, child, total_piece_count)
 
 

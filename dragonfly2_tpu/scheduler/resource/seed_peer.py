@@ -76,9 +76,14 @@ class SeedPeerClient:
         from dragonfly2_tpu.rpc import glue
 
         try:
-            channel = glue.dial(f"{host.ip}:{host.port}", retries=2)
+            addr = f"{host.ip}:{host.port}"
+            channel = glue.dial(addr, retries=2)
             try:
-                daemon = glue.ServiceClient(channel, glue.DFDAEMON_SERVICE)
+                # target=addr: per-seed-host breaker, not one shared
+                # 'Dfdaemon' circuit across every seed peer
+                daemon = glue.ServiceClient(
+                    channel, glue.DFDAEMON_SERVICE, target=addr
+                )
                 stream = daemon.Download(
                     dfdaemon_pb2.DownloadRequest(
                         url=url,

@@ -29,7 +29,7 @@ from dragonfly2_tpu.scheduler.resource import (
     Peer,
 )
 from dragonfly2_tpu.scheduler import metrics as M
-from dragonfly2_tpu.utils import dflog, flight, tracing
+from dragonfly2_tpu.utils import dflog, faults, flight, tracing
 
 logger = dflog.get("scheduling")
 
@@ -39,6 +39,10 @@ logger = dflog.get("scheduling")
 EV_SCHEDULE = flight.event_type("scheduler.schedule")
 EV_BACK_TO_SOURCE = flight.event_type("scheduler.schedule_back_to_source")
 EV_SCHEDULE_FAILED = flight.event_type("scheduler.schedule_failed")
+
+# fault point: one scheduling decision — chaos schedules inject latency
+# (a wedged scheduler) or errors here; single predicate when disarmed
+FP_SCHEDULE = faults.point("scheduler.schedule")
 
 # defaults (reference scheduler/config/constants.go)
 DEFAULT_RETRY_LIMIT = 5
@@ -112,6 +116,7 @@ class Scheduling:
         limit is exhausted and back-to-source isn't possible."""
         blocklist = blocklist or set()
         n = 0
+        FP_SCHEDULE()
         _t0 = time.perf_counter()
         # the per-schedule span only exists when something will record
         # it: the unsampled/disabled path (is_sampling False — this IS

@@ -101,7 +101,9 @@ def collect_rpc(addresses: list[str]) -> list[dict]:
 
             channel = glue.dial(addr, retries=1)
             try:
-                client = glue.ServiceClient(channel, glue.DIAGNOSE_SERVICE)
+                client = glue.ServiceClient(
+                    channel, glue.DIAGNOSE_SERVICE, target=addr
+                )
                 resp = client.Diagnose(
                     diagnose_pb2.DiagnoseRequest(include_stacks=False), timeout=5
                 )

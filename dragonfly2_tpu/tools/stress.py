@@ -13,6 +13,15 @@ Two drive modes:
 Stops at --requests or --duration, whichever comes first. Prints one
 JSON line of aggregate statistics (rps, MB/s, latency percentiles);
 --output saves per-request samples as CSV for offline analysis.
+
+Third mode: ``--chaos`` runs a self-contained chaos soak — an
+in-process scheduler + two daemons driven through a canned, seeded
+fault schedule (5% RPC errors on every send, a parent upload-server
+kill, a scheduler restart mid-swarm) while a download series runs; the
+resilience layer (rpc/resilience.py) must carry every download to
+correct bytes with zero hangs. Prints the soak statistics as one JSON
+line (``chaos_success_rate``, ``chaos_hangs``, …) — the same numbers
+bench.py folds into its artifact.
 """
 
 from __future__ import annotations
@@ -183,9 +192,171 @@ def run(
     return stats
 
 
+# ---------------------------------------------------------------------------
+# chaos soak: a download swarm under a canned, seeded fault schedule
+# ---------------------------------------------------------------------------
+
+
+def chaos_soak(
+    downloads: int = 6,
+    piece: int = 16 * 1024,
+    pieces_per_task: int = 3,
+    rpc_error_rate: float = 0.05,
+    seed: int = 7,
+    restart_scheduler: bool = True,
+    kill_parent: bool = True,
+    deadline_s: float = 45.0,
+) -> dict:
+    """Run ``downloads`` tasks through a two-daemon cluster while the
+    canned fault schedule fires: seeded ``rpc_error_rate`` UNAVAILABLE
+    on every RPC send attempt, the P2P parent's upload server killed and
+    the scheduler restarted (fresh state, same port) midway. Every
+    download runs under a propagated deadline budget and a hard watchdog
+    join — a hang is counted, never waited out.
+
+    Returns the chaos-soak statistics bench.py re-emits:
+    ``chaos_success_rate`` (correct-bytes completions / downloads),
+    ``chaos_hangs``, ``chaos_faults_injected``, ``chaos_wall_s``.
+    """
+    import shutil
+
+    from dragonfly2_tpu.client import dfget
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.rpc import resilience
+    from dragonfly2_tpu.rpc.glue import serve
+    from dragonfly2_tpu.scheduler import resource as res
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+    from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+    from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
+    from dragonfly2_tpu.scheduler.storage import Storage
+    from dragonfly2_tpu.utils import faults
+
+    def _scheduler(root, port=0):
+        service = SchedulerService(
+            res.Resource(),
+            Scheduling(
+                BaseEvaluator(),
+                SchedulingConfig(retry_interval=0.0, retry_back_to_source_limit=2),
+            ),
+            storage=Storage(root, buffer_size=1),
+        )
+        return serve({SERVICE_NAME: service}, address=f"127.0.0.1:{port}")
+
+    tmp = tempfile.mkdtemp(prefix="dfchaos-")
+    injected_before = _faults_injected_total()
+    t_start = time.perf_counter()
+    successes = hangs = 0
+    server = daemons = None
+    try:
+        server, port = _scheduler(os.path.join(tmp, "rec"))
+        daemons = []
+        for name in ("a", "b"):
+            d = Daemon(
+                DaemonConfig(
+                    data_dir=os.path.join(tmp, f"daemon-{name}"),
+                    scheduler_address=f"127.0.0.1:{port}",
+                    hostname=f"chaos-{name}",
+                    piece_length=piece,
+                    announce_interval=0.5,
+                    schedule_timeout=5.0,
+                )
+            )
+            d.start()
+            daemons.append(d)
+        a, b = daemons
+
+        payloads = []
+        for i in range(downloads):
+            p = os.path.join(tmp, f"origin-{i}.bin")
+            data = os.urandom(piece * pieces_per_task)
+            with open(p, "wb") as f:
+                f.write(data)
+            payloads.append((f"file://{p}", data))
+
+        # seed the first task on A so B's downloads exercise the P2P path
+        # (and later, the killed-parent fallback)
+        out0 = os.path.join(tmp, "seed.bin")
+        dfget.download(f"127.0.0.1:{a.port}", payloads[0][0], out0)
+        successes += int(open(out0, "rb").read() == payloads[0][1])
+
+        # arm the canned schedule: seeded wire errors on every send path
+        faults.configure(
+            f"seed={seed};rpc.unary_send=error:UNAVAILABLE@{rpc_error_rate}"
+        )
+
+        for i in range(1, downloads):
+            if i == max(1, downloads // 2):
+                if kill_parent:
+                    a.upload.stop()  # children now see connect failures
+                if restart_scheduler:
+                    server.stop(0)
+                    time.sleep(0.2)
+                    server, _ = _scheduler(
+                        os.path.join(tmp, "rec2"), port=port
+                    )
+            url, data = payloads[i]
+            out = os.path.join(tmp, f"out-{i}.bin")
+            result: dict = {}
+
+            def work(url=url, out=out, result=result):
+                try:
+                    # the whole download runs under one budget: every
+                    # downstream RPC inherits (and shrinks) it
+                    with resilience.deadline_scope(deadline_s):
+                        dfget.download(f"127.0.0.1:{b.port}", url, out)
+                    result["ok"] = True
+                except Exception as e:
+                    result["error"] = str(e)
+
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+            t.join(deadline_s + 15.0)  # hard watchdog over the budget
+            if t.is_alive():
+                hangs += 1
+                continue
+            if result.get("ok") and open(out, "rb").read() == data:
+                successes += 1
+    finally:
+        faults.clear()
+        for d in daemons or []:
+            try:
+                d.stop()
+            except Exception:
+                pass
+        if server is not None:
+            try:
+                server.stop(0)
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "chaos_downloads": downloads,
+        "chaos_success_rate": round(successes / downloads, 4),
+        "chaos_hangs": hangs,
+        "chaos_faults_injected": _faults_injected_total() - injected_before,
+        "chaos_wall_s": round(time.perf_counter() - t_start, 2),
+    }
+
+
+def _faults_injected_total() -> int:
+    from dragonfly2_tpu.utils import faults
+
+    return int(
+        sum(c.value for _, c in faults.INJECTED_TOTAL._snapshot())
+    )
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="df-stress", description=__doc__)
-    p.add_argument("--url", required=True, help="target url; {i} varies per request")
+    p.add_argument("--url", help="target url; {i} varies per request")
+    p.add_argument(
+        "--chaos",
+        action="store_true",
+        help="run the self-contained chaos soak instead of driving a cluster",
+    )
+    p.add_argument("--chaos-downloads", type=int, default=6)
+    p.add_argument("--chaos-error-rate", type=float, default=0.05)
+    p.add_argument("--chaos-seed", type=int, default=7)
     p.add_argument("--daemon", default="", help="dfdaemon gRPC address (Download path)")
     p.add_argument("--proxy", default="", help="daemon proxy address (HTTP path)")
     p.add_argument("-c", "--connections", type=int, default=8)
@@ -194,6 +365,16 @@ def main(argv=None) -> int:
     p.add_argument("--tag", default="stress")
     p.add_argument("--output", default="", help="per-request CSV path")
     args = p.parse_args(argv)
+    if args.chaos:
+        stats = chaos_soak(
+            downloads=args.chaos_downloads,
+            rpc_error_rate=args.chaos_error_rate,
+            seed=args.chaos_seed,
+        )
+        print(json.dumps(stats))
+        return 0 if stats["chaos_success_rate"] == 1.0 and not stats["chaos_hangs"] else 1
+    if not args.url:
+        p.error("--url is required (unless --chaos)")
     if args.requests <= 0 and args.duration <= 0:
         p.error("one of --requests/--duration is required")
     stats = run(

@@ -42,6 +42,11 @@ class TrainerServerConfig:
     # trace per fit under <profile_dir>/<model> (view with TensorBoard);
     # settable per-deploy via config file or DF_TRAINER_PROFILE_DIR
     profile_dir: str = ""
+    # elastic restart: per-(model, host) fit snapshots under this dir —
+    # a crashed fit resumes from its last epoch after the process comes
+    # back (trainer/checkpoint.py); "" keeps the reference's
+    # retrain-from-zero behavior
+    checkpoint_dir: str = ""
     # run fits inline with the Train RPC (tests/debug) instead of async
     synchronous: bool = False
     # Prometheus /metrics endpoint (reference trainer :8000): -1 = disabled
@@ -97,6 +102,7 @@ class TrainerServer:
                 streaming=config.streaming,
                 streaming_workers=config.streaming_workers,
                 profile_dir=config.profile_dir,
+                checkpoint_dir=config.checkpoint_dir,
             ),
         )
         self.service = TrainerService(

@@ -28,6 +28,12 @@ from jax.sharding import PartitionSpec as P
 from dragonfly2_tpu.models import gnn as gnn_mod
 from dragonfly2_tpu.models import gru as gru_mod
 from dragonfly2_tpu.models import mlp as mlp_mod
+from dragonfly2_tpu.utils import faults
+
+# fault point: fires once per fit epoch (the checkpoint granularity) —
+# an ``abort`` rule here is the crash drill for checkpoint/resume, a
+# ``delay`` rule models a stalling device link
+FP_FIT_STEP = faults.point("trainer.fit_step")
 
 
 @dataclass
@@ -162,6 +168,7 @@ def train_mlp(
 
         history: list[float] = []
         for epoch in range(start_epoch, cfg.epochs):
+            FP_FIT_STEP()
             # per-epoch rng: a resumed run replays the exact shuffle schedule
             rng = np.random.default_rng(cfg.seed + 1 + epoch)
             order = train_idx[rng.permutation(len(train_idx))][:used]

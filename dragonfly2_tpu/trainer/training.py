@@ -107,6 +107,11 @@ class TrainingConfig:
     )
     # jax.profiler trace dir per fit ("" = off); view with TensorBoard
     profile_dir: str = ""
+    # elastic restart: per-(model, host) orbax snapshots under this dir
+    # (trainer/checkpoint.py) — a mid-fit crash resumes from the last
+    # epoch snapshot on the next round instead of retraining from zero;
+    # "" disables (the reference's behavior)
+    checkpoint_dir: str = ""
 
 
 @dataclass
@@ -341,7 +346,12 @@ class Training:
             )
         if pairs.features.shape[0] == 0:
             raise BelowMinRecords("no trainable (download, parent) pairs")
-        result = train_mlp(pairs.features, pairs.labels, mesh=self.mesh, config=self.config.mlp)
+        result = train_mlp(
+            pairs.features,
+            pairs.labels,
+            mesh=self.mesh,
+            config=self._fit_config(self.config.mlp, "mlp", host_id),
+        )
         if self.manager_client is not None:
             self.manager_client.create_model(
                 model_id=mlp_model_id_v1(ip, hostname),
@@ -356,6 +366,23 @@ class Training:
             # a crashed round re-decodes from the previous offset
             self.storage.commit_download_offset(host_id, boundary, binary=binary)
         return result.metrics
+
+    def _fit_config(self, cfg, model: str, host_id: str):
+        """Stamp the per-(model, host) checkpoint dir onto a fit config
+        when elastic restart is enabled — the fit loop then snapshots
+        every epoch and resumes from the newest snapshot after a crash
+        (trainer/checkpoint.py; cleared on successful completion)."""
+        if not self.config.checkpoint_dir:
+            return cfg
+        import os
+        from dataclasses import replace
+
+        return replace(
+            cfg,
+            checkpoint_dir=os.path.join(
+                self.config.checkpoint_dir, f"{model}-{host_id}"
+            ),
+        )
 
     def _pending_bytes(self, host_id: str, binary: bool) -> int:
         import os
@@ -541,7 +568,9 @@ class Training:
                 f"{graph.num_records} network topology records for host {host_id}"
                 f" < min {self.config.min_topology_records}"
             )
-        result = train_gnn(graph, mesh=self.mesh, config=self.config.gnn)
+        result = train_gnn(
+            graph, mesh=self.mesh, config=self._fit_config(self.config.gnn, "gnn", host_id)
+        )
         if self.manager_client is not None:
             self.manager_client.create_model(
                 model_id=gnn_model_id_v1(ip, hostname),
@@ -628,7 +657,7 @@ class Training:
             seqs.labels,
             lengths=seqs.lengths,
             mesh=self.mesh,
-            config=self.config.gru_config,
+            config=self._fit_config(self.config.gru_config, "gru", host_id),
         )
         if self.manager_client is not None:
             self.manager_client.create_model(

@@ -26,6 +26,13 @@ import threading
 import time
 from typing import Any
 
+from dragonfly2_tpu.utils import faults
+
+# fault point: one shared-KV round trip (RemoteKVStore only — the
+# in-process store has no wire to fail); kill_conn drills the
+# reconnect-on-restart path deterministically
+FP_KV_ROUNDTRIP = faults.point("kv.roundtrip")
+
 
 class KVStore:
     def __init__(self) -> None:
@@ -266,6 +273,13 @@ class RemoteKVStore:
 
     def _call(self, *parts):
         with self._lock:
+            try:
+                FP_KV_ROUNDTRIP()
+            except Exception as e:
+                # kill_conn drills the reconnect path exactly like a
+                # server restart: drop the socket, surface the error
+                self._drop_connection()
+                raise ConnectionError(f"kv fault injected: {e}") from e
             try:
                 self._send(*parts)
             except (ConnectionError, OSError):

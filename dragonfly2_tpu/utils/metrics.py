@@ -326,12 +326,22 @@ class MetricsServer:
 
     GET /healthz answers per-service liveness as JSON on the same port
     deploys already scrape: services register named probes via
-    ``register_health``; 200 while every probe passes, 503 otherwise.
+    ``register_health``; 200 while every probe passes, 503 otherwise
+    (hard-down ONLY — a *degraded* component answers 200). The body also
+    carries the resilience plane's state (rpc/resilience): per-target
+    circuit-breaker states, retry-budget fill, and the degraded-mode
+    component map (e.g. the scheduler's ML→base evaluator fallback), so
+    the port operators already scrape explains both "is it up" and "is
+    it limping".
 
     GET /debug/ring serves the local flight-recorder rings
     (utils/flight) as JSON — ``?category=<name>`` narrows to one ring
     and 404s for unknown categories, the same not-found behavior as
-    unknown paths. Unknown paths stay 404."""
+    unknown paths. GET /debug/faults serves the fault-injection plane's
+    state (utils/faults: registered points, armed rules with call/fire
+    counts); POST /debug/faults with a spec-string body arms a schedule
+    live (empty body disarms) — the chaos toggle without a restart.
+    Unknown paths stay 404."""
 
     def __init__(self, registry: Registry, host: str = "127.0.0.1", port: int = 0):
         self.registry = registry
@@ -357,11 +367,28 @@ class MetricsServer:
                 alive = False
             services[name] = "ok" if alive else "down"
             ok = ok and alive
-        return ok, {
-            "status": "ok" if ok else "degraded",
+        body = {
+            # hard-down only: degraded components (the resilience map
+            # below) keep the 200 — a scheduler limping on the base
+            # evaluator must not be LB-ejected like a dead one
+            "status": "ok" if ok else "down",
             "uptime_s": round(time.time() - self._started_at, 3),
             "services": services,
         }
+        try:
+            # lazy: resilience registers its own series in this module's
+            # default registry at import time
+            from dragonfly2_tpu.rpc import resilience
+
+            snap = resilience.snapshot()
+            body["resilience"] = {
+                "breakers": snap["breakers"],
+                "retry_budget_fill": snap["retry_budget_fill"],
+            }
+            body["degraded"] = snap["degraded"]
+        except Exception:
+            pass  # liveness must answer even if the resilience plane can't
+        return ok, body
 
     def start(self) -> str:
         registry = self.registry
@@ -370,6 +397,30 @@ class MetricsServer:
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 pass
+
+            def do_POST(self):
+                import json
+
+                if self.path.split("?", 1)[0] != "/debug/faults":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                from dragonfly2_tpu.utils import faults
+
+                length = int(self.headers.get("Content-Length") or 0)
+                spec = self.rfile.read(length).decode("utf-8", "replace").strip()
+                try:
+                    n = faults.configure(spec)
+                except Exception as e:
+                    data = json.dumps({"error": str(e)}).encode()
+                    self.send_response(400)
+                else:
+                    data = json.dumps({"rules": n, "active": faults.active()}).encode()
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
 
             def do_GET(self):
                 from urllib.parse import parse_qs, urlparse
@@ -410,6 +461,18 @@ class MetricsServer:
                         },
                         default=str,
                     ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if url.path == "/debug/faults":
+                    import json
+
+                    from dragonfly2_tpu.utils import faults
+
+                    data = json.dumps(faults.snapshot(), default=str).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(data)))

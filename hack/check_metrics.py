@@ -19,6 +19,14 @@ duplicate event names across the package, names without a
 the dfdoctor timeline keys on these names, so they must stay as
 disciplined as the metric series.
 
+Fault-injection points (``faults.point("...")`` registrations,
+utils/faults) are linted the same way: duplicates, names that aren't
+``<layer>.<what>`` with a known layer — plus one extra rule: every
+registered point must be *referenced by at least one test* (its literal
+name appearing under ``tests/``). An unexercised injection point is
+dead chaos surface: the schedule grammar accepts it, nothing proves the
+layer actually survives it.
+
 Run standalone (``python hack/check_metrics.py``) or via the tier-1
 test that wraps :func:`check`.
 """
@@ -32,15 +40,25 @@ from pathlib import Path
 PACKAGE = Path(__file__).resolve().parent.parent / "dragonfly2_tpu"
 
 # the service segment a series name must start with — one per process
-# role plus the shared rpc glue and flight-recorder series
+# role plus the shared rpc glue, flight-recorder, fault-plane and
+# resilience-layer series
 ALLOWED_SERVICES = (
     "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "flight",
+    "faults", "resilience",
 )
 
 # flight-recorder event names are <service>.<what>; the service segment
-# is the ring category, so it must be a real process role (the shared
-# "rpc"/"flight" series prefixes make no sense as a ring)
-EVENT_SERVICES = ("scheduler", "trainer", "daemon", "manager", "topology")
+# is the ring category — the process roles plus the cross-layer "rpc"
+# (resilience decisions: retries, breaker trips, sheds) and "faults"
+# (injections) rings, which must not evict any role's own history
+EVENT_SERVICES = (
+    "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "faults",
+)
+
+# fault-point names are <layer>.<what>; mirrors utils/faults.POINT_LAYERS
+FAULT_LAYERS = ("rpc", "daemon", "scheduler", "trainer", "manager", "kv")
+
+TESTS_DIR = PACKAGE.parent / "tests"
 
 KINDS = ("counter", "gauge", "histogram")
 
@@ -92,13 +110,69 @@ def _event_registrations(path: Path) -> list[tuple[str, int]]:
     return out
 
 
+def _fault_point_registrations(path: Path) -> list[tuple[str, int]]:
+    """(name, lineno) for every literal fault-point registration
+    (``faults.point("...")`` / ``.point(...)`` attribute calls with a
+    literal string) in ``path``. The plane's own ``_plane.point(name)``
+    forwarder passes a variable, so only true declarations match."""
+    try:
+        tree = ast.parse(path.read_text())
+    except SyntaxError:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "point"):
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            out.append((first.value, node.lineno))
+    return out
+
+
+def _tests_corpus(tests_dir: Path = TESTS_DIR) -> str:
+    """Concatenated test source — the referenced-by-test rule greps
+    fault-point names against this."""
+    if not tests_dir.is_dir():
+        return ""
+    return "\n".join(
+        p.read_text() for p in sorted(tests_dir.glob("*.py"))
+    )
+
+
 def check(package_dir: Path = PACKAGE) -> list[str]:
     """Returns a list of human-readable failures (empty = clean)."""
     failures: list[str] = []
     seen: dict[str, tuple[str, str]] = {}  # name -> (kind, site)
     seen_events: dict[str, str] = {}  # event name -> site
+    seen_points: dict[str, str] = {}  # fault point -> site
     for path in sorted(package_dir.rglob("*.py")):
         rel = path.relative_to(package_dir.parent)
+        for name, lineno in _fault_point_registrations(path):
+            site = f"{rel}:{lineno}"
+            if not all(c.islower() or c.isdigit() or c in "._" for c in name):
+                failures.append(
+                    f"{site}: fault point {name!r} has characters outside"
+                    " [a-z0-9_.]"
+                )
+            layer = name.split(".", 1)[0]
+            if "." not in name or layer not in FAULT_LAYERS:
+                failures.append(
+                    f"{site}: fault point {name!r} must be <layer>.<what>"
+                    f" with layer in {FAULT_LAYERS}"
+                )
+            prev_site = seen_points.get(name)
+            if prev_site is not None:
+                failures.append(
+                    f"{site}: duplicate fault-point registration of {name!r}"
+                    f" (first at {prev_site})"
+                )
+            else:
+                seen_points[name] = site
         for name, lineno in _event_registrations(path):
             site = f"{rel}:{lineno}"
             if not all(c.islower() or c.isdigit() or c in "._" for c in name):
@@ -165,6 +239,18 @@ def check(package_dir: Path = PACKAGE) -> list[str]:
                     f"{site}: counter {name!r} exposes as OpenMetrics"
                     f" family {family!r}, colliding with the metric of"
                     f" that name at {seen[family][1]}"
+                )
+    # referenced-by-test: a fault point the test matrix never arms is
+    # dead chaos surface — the spec grammar accepts it, nothing proves
+    # the layer survives it
+    if seen_points:
+        corpus = _tests_corpus(package_dir.parent / "tests")
+        for name, site in sorted(seen_points.items()):
+            if name not in corpus:
+                failures.append(
+                    f"{site}: fault point {name!r} is not referenced by any"
+                    " test under tests/ (add it to the fault matrix in"
+                    " tests/test_fault_injection.py)"
                 )
     return failures
 

@@ -71,6 +71,57 @@ def test_lint_catches_event_defects(tmp_path):
     assert "scheduler.decision" not in text
 
 
+def test_lint_catches_fault_point_defects(tmp_path):
+    """Fault-point registrations (faults.point) ride the census too:
+    duplicates, names that aren't <layer>.<what> with a known layer —
+    plus the referenced-by-test rule (an unexercised injection point is
+    dead chaos surface)."""
+    pkg = tmp_path / "fakepkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "from dragonfly2_tpu.utils import faults\n"
+        'FP_GOOD = faults.point("daemon.piece_read")\n'
+        'FP_DUP = faults.point("kv.roundtrip")\n'
+        'FP_NOPREFIX = faults.point("justaname")\n'
+        'FP_BADLAYER = faults.point("warp.core")\n'
+        'FP_BADCHAR = faults.point("trainer.BadCase")\n'
+        'FP_DEAD = faults.point("scheduler.never_armed")\n'
+    )
+    (pkg / "b.py").write_text(
+        "from dragonfly2_tpu.utils import faults\n"
+        'FP_DUP2 = faults.point("kv.roundtrip")\n'
+    )
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_chaos.py").write_text(
+        '# arms daemon.piece_read and kv.roundtrip in a schedule\n'
+        'SPEC = "daemon.piece_read=error;kv.roundtrip=kill_conn"\n'
+        'SPEC2 = "warp.core=abort"  # referenced, still bad-layer\n'
+    )
+    failures = check_metrics.check(pkg)
+    text = "\n".join(failures)
+    assert "duplicate fault-point registration of 'kv.roundtrip'" in text
+    assert "'justaname' must be <layer>.<what>" in text
+    assert "'warp.core' must be <layer>.<what>" in text
+    assert "'trainer.BadCase' has characters outside" in text
+    assert "'scheduler.never_armed' is not referenced by any test" in text
+    # the good, test-referenced point appears in no failure line
+    assert "daemon.piece_read" not in text
+
+
+def test_fault_point_unreferenced_when_no_tests_dir(tmp_path):
+    """With no tests/ next to the package every point is unreferenced —
+    the rule fails loud instead of passing vacuously."""
+    pkg = tmp_path / "fakepkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        "from dragonfly2_tpu.utils import faults\n"
+        'FP = faults.point("rpc.unary_send")\n'
+    )
+    failures = check_metrics.check(pkg)
+    assert any("'rpc.unary_send' is not referenced" in f for f in failures)
+
+
 def test_cli_exit_codes(tmp_path, capsys):
     assert check_metrics.main() == 0
     out = capsys.readouterr()

@@ -261,3 +261,727 @@ def test_no_content_length_origin_completes(tmp_path):
         sched["server"].stop(0)
         origin.shutdown()
         origin.server_close()
+
+
+# ---------------------------------------------------------------------------
+# The deterministic fault plane (utils/faults) + resilience layer
+# (rpc/resilience): the ISSUE-5 fault matrix. Every registered injection
+# point is armed here — hack/check_metrics.py fails the build for any
+# point no test exercises.
+# ---------------------------------------------------------------------------
+
+import threading
+
+import grpc
+
+from dragonfly2_tpu.rpc import resilience
+from dragonfly2_tpu.utils import faults
+
+
+@pytest.fixture()
+def clean_resilience():
+    """Disarm the fault plane and drop breaker/budget/degraded/policy
+    state after the test — resilience registries are process-global."""
+    saved_policies = dict(resilience._POLICIES)
+    yield
+    faults.clear()
+    resilience._POLICIES.clear()
+    resilience._POLICIES.update(saved_policies)
+    resilience.reset()
+
+
+# -- spec grammar + determinism ---------------------------------------------
+
+
+def test_fault_spec_grammar(clean_resilience):
+    n = faults.configure(
+        "seed=42;rpc.unary_send=error:UNAVAILABLE@0.05;"
+        "daemon.piece_read=delay:200@0.1;trainer.fit_step=abort#2;"
+        "kv.roundtrip=kill_conn#3+2"
+    )
+    assert n == 4
+    snap = faults.snapshot()
+    assert snap["active"] and snap["seed"] == 42
+    by_point = {r["point"]: r for r in snap["rules"]}
+    assert by_point["rpc.unary_send"]["code"] == "UNAVAILABLE"
+    assert by_point["rpc.unary_send"]["rate"] == 0.05
+    assert by_point["daemon.piece_read"]["delay_ms"] == 200.0
+    assert by_point["trainer.fit_step"] == dict(
+        by_point["trainer.fit_step"], action="abort", after=2, count=1
+    )
+    assert by_point["kv.roundtrip"]["after"] == 3
+    assert by_point["kv.roundtrip"]["count"] == 2
+    faults.clear()
+    assert not faults.active()
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "rpc.unary_send=explode",  # unknown action
+        "warp.core=error",  # unknown layer
+        "rpc.unary_send=error@1.5",  # rate outside [0, 1]
+        "rpc.unary_send",  # no '='
+        "scheduler=delay:10",  # no '.' in point name
+    ],
+)
+def test_malformed_fault_specs_fail_loudly(clean_resilience, spec):
+    """A typo'd chaos schedule must error, not run fault-free and
+    'pass'."""
+    with pytest.raises(ValueError):
+        faults.configure(spec)
+
+
+def test_seeded_schedule_is_deterministic(clean_resilience):
+    """Same seed → the exact same fire/pass decision sequence; a chaos
+    run replays bit-identically."""
+
+    def pattern(seed):
+        faults.configure(f"seed={seed};kv.roundtrip=error@0.3")
+        pt = faults.point("kv.roundtrip")
+        out = []
+        for _ in range(64):
+            try:
+                pt()
+                out.append(0)
+            except faults.InjectedFault:
+                out.append(1)
+        return out
+
+    a = pattern(42)
+    b = pattern(42)
+    c = pattern(7)
+    assert a == b
+    assert a != c  # P(collision) = 0.58^64 — a broken RNG seed, not luck
+    assert 1 in a and 0 in a
+
+
+def test_fault_window_after_count(clean_resilience):
+    """``#after+count`` fires on exact call indices — the fully
+    deterministic window form."""
+    faults.configure("daemon.piece_read=error#2+2")
+    pt = faults.point("daemon.piece_read")
+    fired = []
+    for i in range(6):
+        try:
+            pt()
+            fired.append(False)
+        except faults.InjectedFault:
+            fired.append(True)
+    assert fired == [False, False, True, True, False, False]
+
+
+def test_json_schedule_file(clean_resilience, tmp_path):
+    import json as _json
+
+    doc = {
+        "seed": 9,
+        "rules": [
+            {"point": "rpc.unary_send", "action": "error", "code": "ABORTED"},
+            {"point": "daemon.piece_read", "action": "delay", "delay_ms": 5},
+        ],
+    }
+    path = tmp_path / "sched.json"
+    path.write_text(_json.dumps(doc))
+    assert faults.configure(str(path)) == 2
+    with pytest.raises(faults.InjectedFault) as ei:
+        faults.point("rpc.unary_send")()
+    assert ei.value.code() == grpc.StatusCode.ABORTED
+
+
+def test_disarmed_point_is_noop(clean_resilience):
+    faults.clear()
+    pt = faults.point("daemon.piece_read")
+    pt()  # must not raise
+    data = b"x" * 512
+    assert pt.mutate(data) is data
+    assert not faults.active()
+
+
+def test_payload_truncate_and_corrupt(clean_resilience):
+    data = bytes(range(256)) * 4
+    faults.configure("seed=5;daemon.piece_read=truncate")
+    assert faults.point("daemon.piece_read").mutate(data) == data[: len(data) // 2]
+    faults.configure("seed=5;daemon.piece_read=corrupt")
+    mutated = faults.point("daemon.piece_read").mutate(data)
+    assert mutated != data and len(mutated) == len(data)
+    # deterministic: the same seed flips the same bytes
+    faults.configure("seed=5;daemon.piece_read=corrupt")
+    assert faults.point("daemon.piece_read").mutate(data) == mutated
+
+
+# -- resilience primitives ---------------------------------------------------
+
+
+def test_injected_rpc_fault_retries_transparently(clean_resilience):
+    """An ``rpc.unary_send`` injected wire error rides the same retry
+    machinery a real UNAVAILABLE does: the caller sees one successful
+    call, the retry counter sees the attempt."""
+    resilience.set_policy(
+        "test.svc",
+        resilience.Policy(max_attempts=3, backoff_base_s=0.0, backoff_cap_s=0.0),
+    )
+    calls = {"n": 0}
+
+    def inner(request, timeout=None, metadata=None):
+        calls["n"] += 1
+        return "ok"
+
+    wrapped = resilience.wrap_call("test.svc", "Get", "unary_unary", "t1", inner)
+    faults.configure("seed=1;rpc.unary_send=error:UNAVAILABLE#0+1")
+    assert wrapped(None) == "ok"
+    # the injected fault burned attempt 0 BEFORE inner ran; the retry
+    # passed the (now-closed) window and reached the stub exactly once
+    assert calls["n"] == 1
+
+
+def test_retry_budget_bounds_amplification(clean_resilience):
+    """During a hard outage the token bucket drains and retries stop —
+    first tries still flow, the *extra* load is bounded."""
+    resilience.set_policy(
+        "test.svc",
+        resilience.Policy(
+            max_attempts=3,
+            backoff_base_s=0.0,
+            backoff_cap_s=0.0,
+            breaker_failures=10**9,  # isolate the budget from the breaker
+            retry_budget_cap=3.0,
+            retry_budget_ratio=0.0,
+        ),
+    )
+    calls = {"n": 0}
+
+    def always_down(request, timeout=None, metadata=None):
+        calls["n"] += 1
+        raise resilience.ResilienceError(grpc.StatusCode.UNAVAILABLE, "down")
+
+    wrapped = resilience.wrap_call("test.svc", "Get", "unary_unary", "t2", always_down)
+    first_tries = 10
+    for _ in range(first_tries):
+        with pytest.raises(grpc.RpcError):
+            wrapped(None)
+    # 10 first tries + exactly cap(3) retries — never 10 × max_attempts
+    assert calls["n"] == first_tries + 3
+
+
+def test_client_side_deadline_shed(clean_resilience):
+    """A call whose inherited budget is already exhausted never touches
+    the wire."""
+    calls = {"n": 0}
+
+    def inner(request, timeout=None, metadata=None):
+        calls["n"] += 1
+        return "ok"
+
+    wrapped = resilience.wrap_call("test.svc", "Get", "unary_unary", "t3", inner)
+    with resilience.deadline_scope(-0.01):
+        with pytest.raises(grpc.RpcError) as ei:
+            wrapped(None)
+    assert ei.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    assert calls["n"] == 0
+
+
+def test_hedged_read_beats_slow_primary(clean_resilience):
+    """With hedging enabled for an idempotent read, a stalled primary is
+    raced by a second attempt after hedge_delay_s and the fast answer
+    wins — tail-at-scale's canonical p99 cure."""
+    resilience.HEDGEABLE["test.svc"] = frozenset({"Get"})
+    try:
+        resilience.set_policy(
+            "test.svc", resilience.Policy(hedge_delay_s=0.02, max_attempts=1)
+        )
+        calls = {"n": 0}
+        lock = threading.Lock()
+
+        def inner(request, timeout=None, metadata=None):
+            with lock:
+                calls["n"] += 1
+                me = calls["n"]
+            if me == 1:  # primary stalls well past the hedge delay
+                time.sleep(0.5)
+                return "slow"
+            return "fast"
+
+        wrapped = resilience.wrap_call(
+            "test.svc", "Get", "unary_unary", "t-hedge", inner
+        )
+        t0 = time.monotonic()
+        assert wrapped(None) == "fast"
+        assert time.monotonic() - t0 < 0.4  # did not wait out the primary
+        assert calls["n"] == 2
+    finally:
+        resilience.HEDGEABLE.pop("test.svc", None)
+
+
+def test_hedge_survives_primary_error(clean_resilience):
+    """A primary that errors while the hedge is still in flight must NOT
+    be raised immediately — the hedge gets the remaining window, and its
+    success is the call's success (no retry consumed)."""
+    resilience.HEDGEABLE["test.svc"] = frozenset({"Get"})
+    try:
+        resilience.set_policy(
+            "test.svc", resilience.Policy(hedge_delay_s=0.02, max_attempts=1)
+        )
+        calls = {"n": 0}
+        lock = threading.Lock()
+
+        def inner(request, timeout=None, metadata=None):
+            with lock:
+                calls["n"] += 1
+                me = calls["n"]
+            if me == 1:  # errors AFTER the hedge launched
+                time.sleep(0.1)
+                raise resilience.ResilienceError(
+                    grpc.StatusCode.UNAVAILABLE, "primary died"
+                )
+            time.sleep(0.2)  # hedge still running when the primary dies
+            return "ok"
+
+        wrapped = resilience.wrap_call(
+            "test.svc", "Get", "unary_unary", "t-hedge2", inner
+        )
+        # max_attempts=1: if the primary's error were raised (the old
+        # early-return), nothing would retry and this call would fail
+        assert wrapped(None) == "ok"
+        assert calls["n"] == 2
+    finally:
+        resilience.HEDGEABLE.pop("test.svc", None)
+
+
+def test_half_open_probe_released_on_client_shed(clean_resilience):
+    """An admitted half-open probe that exits via the client-side
+    deadline shed must free the probe slot: otherwise one shed probe
+    leaves ``_probe_inflight`` stuck and the breaker rejects the target
+    forever, even after the server recovers."""
+    resilience.set_policy(
+        "test.svc",
+        resilience.Policy(breaker_failures=1, breaker_open_s=0.0, max_attempts=1),
+    )
+    calls = {"n": 0}
+
+    def inner(request, timeout=None, metadata=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise resilience.ResilienceError(grpc.StatusCode.UNAVAILABLE, "down")
+        return "ok"
+
+    wrapped = resilience.wrap_call("test.svc", "Get", "unary_unary", "t3b", inner)
+    with pytest.raises(grpc.RpcError):
+        wrapped(None)  # trips the breaker (threshold 1) -> OPEN
+    assert resilience._breakers["t3b"].state == resilience.OPEN
+    # cool-down is 0: the next call is admitted as the half-open probe,
+    # but its inherited budget is exhausted -> client-side shed raise
+    with resilience.deadline_scope(-0.01):
+        with pytest.raises(grpc.RpcError) as ei:
+            wrapped(None)
+    assert ei.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    assert calls["n"] == 1  # the shed never touched the wire
+    # the probe slot must be free again: this probe reaches the target
+    # and its success closes the breaker
+    assert wrapped(None) == "ok"
+    assert resilience._breakers["t3b"].state == resilience.CLOSED
+
+
+def test_deadline_budget_propagates_and_shrinks(clean_resilience):
+    """The downstream header carries the *remaining* budget, capped by
+    the per-service default."""
+    seen = {}
+
+    def inner(request, timeout=None, metadata=None):
+        seen["timeout"] = timeout
+        seen["metadata"] = metadata
+        return "ok"
+
+    resilience.set_policy("test.svc", resilience.Policy(deadline_s=30.0))
+    wrapped = resilience.wrap_call("test.svc", "Get", "unary_unary", "t4", inner)
+    with resilience.deadline_scope(2.0):
+        wrapped(None)
+    hdr = dict(seen["metadata"])[resilience.DEADLINE_HEADER]
+    assert 0 < int(hdr) <= 2000  # the inherited 2s, not the 30s default
+    assert seen["timeout"] <= 2.0
+
+
+def test_retry_refreshes_deadline_header(clean_resilience):
+    """Each retry re-stamps df-deadline-ms with the budget actually
+    left — a server shown attempt 0's figure keeps (and propagates)
+    work for seconds after the client gave up."""
+    seen = []
+
+    def inner(request, timeout=None, metadata=None):
+        seen.append(dict(metadata)[resilience.DEADLINE_HEADER])
+        if len(seen) == 1:
+            raise resilience.ResilienceError(grpc.StatusCode.UNAVAILABLE, "blip")
+        return "ok"
+
+    resilience.set_policy(
+        "test.svc",
+        resilience.Policy(
+            deadline_s=1.0, backoff_base_s=0.15, backoff_cap_s=0.15
+        ),
+    )
+    wrapped = resilience.wrap_call("test.svc", "Get", "unary_unary", "t4b", inner)
+    assert wrapped(None) == "ok"
+    assert len(seen) == 2
+    # the retry slept ≥ some of the jittered backoff; its header must be
+    # strictly tighter than attempt 0's 1000ms, not a stale copy
+    assert int(seen[1]) < int(seen[0])
+    # a caller-stamped header is never rewritten — not even on a retry
+    seen.clear()
+    wrapped(None, metadata=[(resilience.DEADLINE_HEADER, "777")])
+    assert seen == ["777", "777"]
+
+
+def test_injected_fault_is_a_wire_error(clean_resilience):
+    """InjectedFault that exhausts retries must land in the same
+    ``except grpc.RpcError`` fallbacks a real wire error would — call
+    sites (announcer CSV fallback, dfcache) classify on that type."""
+    assert issubclass(faults.InjectedFault, grpc.RpcError)
+    e = faults.InjectedFault("rpc.unary_send", "error", "NOT_FOUND")
+    assert e.code() == grpc.StatusCode.NOT_FOUND
+    try:
+        raise e
+    except grpc.RpcError as caught:
+        assert caught is e
+
+
+def test_server_side_shed_over_grpc(clean_resilience, tmp_path):
+    """A request arriving with an exhausted ``df-deadline-ms`` budget is
+    shed before the handler runs — the caller stopped waiting, finishing
+    the work only burns capacity."""
+    from dragonfly2_tpu.rpc.glue import ServiceClient, dial
+    from dragonfly2_tpu.rpc.resilience import DEADLINE_HEADER, DEADLINE_SHED_TOTAL
+    from dragonfly2_tpu.scheduler.service import SERVICE_NAME as SCHED
+
+    import scheduler_pb2
+
+    s = _scheduler(tmp_path)
+    channel = dial(f"127.0.0.1:{s['port']}")
+    try:
+        client = ServiceClient(channel, SCHED, target=f"127.0.0.1:{s['port']}")
+        shed_before = sum(c.value for _, c in DEADLINE_SHED_TOTAL._snapshot())
+        with pytest.raises(grpc.RpcError) as ei:
+            client.StatTask(
+                scheduler_pb2.StatTaskRequest(task_id="t"),
+                metadata=((DEADLINE_HEADER, "0"),),
+            )
+        assert ei.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+        assert "shed" in (ei.value.details() or "")
+        assert sum(c.value for _, c in DEADLINE_SHED_TOTAL._snapshot()) > shed_before
+        # a live budget is NOT shed: the handler runs (NOT_FOUND is the
+        # handler's own answer for an unknown task)
+        with pytest.raises(grpc.RpcError) as ei:
+            client.StatTask(
+                scheduler_pb2.StatTaskRequest(task_id="t"),
+                metadata=((DEADLINE_HEADER, "5000"),),
+            )
+        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        channel.close()
+        s["server"].stop(0)
+
+
+def test_breaker_trips_and_recovers_half_open_grpc(clean_resilience, tmp_path):
+    """Real-gRPC breaker lifecycle: consecutive UNAVAILABLEs open it,
+    open calls fail fast with no wire attempt, and after the cool-down a
+    half-open probe against the restarted scheduler closes it."""
+    from dragonfly2_tpu.rpc.glue import ServiceClient, dial
+    from dragonfly2_tpu.scheduler.service import SERVICE_NAME as SCHED
+
+    import scheduler_pb2
+
+    s = _scheduler(tmp_path)
+    port = s["port"]
+    target = f"127.0.0.1:{port}"
+    resilience.tune_policy(
+        SCHED, max_attempts=1, breaker_failures=2, breaker_open_s=0.5, deadline_s=2.0
+    )
+    channel = dial(target)
+    req = scheduler_pb2.StatTaskRequest(task_id="t")
+    try:
+        client = ServiceClient(channel, SCHED, target=target)
+        # live server: NOT_FOUND is an *answer* — the breaker stays closed
+        with pytest.raises(grpc.RpcError):
+            client.StatTask(req)
+        assert resilience._breakers[target].state == resilience.CLOSED
+
+        s["server"].stop(0)
+        time.sleep(0.1)
+        for _ in range(2):  # two consecutive UNAVAILABLEs → OPEN
+            with pytest.raises(grpc.RpcError):
+                client.StatTask(req)
+        assert resilience._breakers[target].state == resilience.OPEN
+
+        # open breaker: fail-fast, no network wait
+        t0 = time.perf_counter()
+        with pytest.raises(grpc.RpcError) as ei:
+            client.StatTask(req)
+        assert time.perf_counter() - t0 < 0.05
+        assert "circuit breaker open" in (ei.value.details() or "")
+
+        # restart on the same port; after the cool-down the half-open
+        # probe (riding the channel's own reconnect) closes the breaker
+        s2 = _scheduler(tmp_path / "restart", port=port)
+        try:
+            time.sleep(0.6)
+            deadline = time.time() + 10
+            ok = False
+            while time.time() < deadline:
+                try:
+                    client.StatTask(req)
+                except grpc.RpcError as e:
+                    if e.code() == grpc.StatusCode.NOT_FOUND:
+                        ok = True  # the restarted scheduler answered
+                        break
+                    time.sleep(0.2)
+            assert ok, "restarted scheduler never answered through the breaker"
+            assert resilience._breakers[target].state == resilience.CLOSED
+        finally:
+            s2["server"].stop(0)
+    finally:
+        channel.close()
+
+
+def test_announce_stream_error_resumes_not_back_to_source(clean_resilience, tmp_path):
+    """A broken announce stream re-opens and re-registers (same peer_id)
+    instead of failing the peer task to the origin: the download
+    completes P2P with zero back-to-source traffic."""
+    from dragonfly2_tpu.client import metrics as CM
+    from dragonfly2_tpu.utils import flight
+
+    s = _scheduler(tmp_path)
+    a = _daemon(tmp_path, "a", s["port"])
+    b = _daemon(tmp_path, "b", s["port"])
+    try:
+        payload = os.urandom(4 * PIECE)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        url = f"file://{origin}"
+        dfget.download(f"127.0.0.1:{a.port}", url, str(tmp_path / "a.bin"))
+
+        # break the NEXT stream open (deterministic window: call #0 of
+        # the armed rule is B's initial open; the resume is call #1)
+        faults.configure("daemon.announce_stream=error:UNAVAILABLE#0+1")
+        bts_before = CM.BACK_TO_SOURCE_TOTAL.value
+        out_b = tmp_path / "b.bin"
+        dfget.download(f"127.0.0.1:{b.port}", url, str(out_b))
+        assert out_b.read_bytes() == payload
+        assert CM.BACK_TO_SOURCE_TOTAL.value == bts_before
+        snap = faults.snapshot()
+        assert sum(r["fired"] for r in snap["rules"]) == 1
+        events = flight.snapshot(["daemon"]).get("daemon", [])
+        assert any(e["type"] == "daemon.announce_reconnect" for e in events)
+    finally:
+        faults.clear()
+        for d in (b, a):
+            try:
+                d.stop()
+            except Exception:
+                pass
+        s["server"].stop(0)
+
+
+def test_scheduler_restart_mid_download_stream_resumes(clean_resilience, tmp_path):
+    """The acceptance drill: scheduler restarts while a P2P download is
+    in flight (piece fetches slowed by the fault plane to hold the swarm
+    open). The announce stream reconnects and re-registers against the
+    restarted scheduler; the download completes correct bytes with no
+    hang and no origin fallback."""
+    from dragonfly2_tpu.client import metrics as CM
+
+    s = _scheduler(tmp_path)
+    port = s["port"]
+    a = _daemon(tmp_path, "a", port, announce_interval=0.3)
+    b = _daemon(tmp_path, "b", port, announce_interval=0.3)
+    s2 = {}
+    try:
+        payload = os.urandom(6 * PIECE)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        url = f"file://{origin}"
+        dfget.download(f"127.0.0.1:{a.port}", url, str(tmp_path / "a.bin"))
+
+        # stretch B's piece fetches so the restart lands mid-download
+        faults.configure("daemon.piece_read=delay:150")
+        bts_before = CM.BACK_TO_SOURCE_TOTAL.value
+        out_b = tmp_path / "b.bin"
+        result = {}
+
+        def work():
+            try:
+                dfget.download(f"127.0.0.1:{b.port}", url, str(out_b))
+                result["ok"] = True
+            except Exception as e:
+                result["error"] = str(e)
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        time.sleep(0.3)  # inside the ~0.9s slowed download window
+        s["server"].stop(0)
+        s2.update(_scheduler(tmp_path / "restart", port=port))
+        t.join(30.0)
+        assert not t.is_alive(), "download hung across the scheduler restart"
+        assert result.get("ok"), result.get("error")
+        assert out_b.read_bytes() == payload
+        assert CM.BACK_TO_SOURCE_TOTAL.value == bts_before
+    finally:
+        faults.clear()
+        for d in (b, a):
+            try:
+                d.stop()
+            except Exception:
+                pass
+        for srv in (s2.get("server"), ):
+            if srv is not None:
+                srv.stop(0)
+
+
+def test_corrupt_piece_payloads_never_reach_disk(clean_resilience, tmp_path):
+    """Every P2P piece payload corrupted in flight: the digest check
+    converts each to a retryable piece failure and the task still lands
+    correct bytes (via the origin once parents are exhausted)."""
+    s = _scheduler(tmp_path)
+    a = _daemon(tmp_path, "a", s["port"])
+    b = _daemon(tmp_path, "b", s["port"])
+    try:
+        payload = os.urandom(3 * PIECE)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        url = f"file://{origin}"
+        dfget.download(f"127.0.0.1:{a.port}", url, str(tmp_path / "a.bin"))
+
+        faults.configure("seed=3;daemon.piece_read=corrupt")
+        out_b = tmp_path / "b.bin"
+        dfget.download(f"127.0.0.1:{b.port}", url, str(out_b))
+        assert out_b.read_bytes() == payload
+        snap = faults.snapshot()
+        assert sum(r["fired"] for r in snap["rules"]) >= 1
+    finally:
+        faults.clear()
+        for d in (b, a):
+            try:
+                d.stop()
+            except Exception:
+                pass
+        s["server"].stop(0)
+
+
+def test_wedged_scheduler_delay_bounded_by_deadline(clean_resilience, tmp_path):
+    """A ``scheduler.schedule`` latency injection (a wedged scheduler)
+    slows decisions without wedging the swarm: the download completes
+    and the injected delay actually fired."""
+    s = _scheduler(tmp_path)
+    d = _daemon(tmp_path, "w", s["port"])
+    try:
+        payload = os.urandom(2 * PIECE)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        faults.configure("scheduler.schedule=delay:100#0+2")
+        out = tmp_path / "out.bin"
+        dfget.download(f"127.0.0.1:{d.port}", f"file://{origin}", str(out))
+        assert out.read_bytes() == payload
+        snap = faults.snapshot()
+        assert sum(r["fired"] for r in snap["rules"]) >= 1
+    finally:
+        faults.clear()
+        d.stop()
+        s["server"].stop(0)
+
+
+def test_kv_kill_conn_drills_reconnect(clean_resilience):
+    """A ``kv.roundtrip`` kill_conn drops the socket exactly like a KV
+    server restart: the faulted call surfaces ConnectionError, the NEXT
+    call reconnects and the data is intact."""
+    from dragonfly2_tpu.utils.kvserver import KVServer
+    from dragonfly2_tpu.utils.kvstore import RemoteKVStore
+
+    server = KVServer()
+    port = server.serve()
+    try:
+        kv = RemoteKVStore(f"127.0.0.1:{port}")
+        kv.set("k", "v1")
+        faults.configure("kv.roundtrip=kill_conn#0+1")
+        with pytest.raises(ConnectionError):
+            kv.get("k")
+        assert kv.get("k") == "v1"  # reconnected; server state intact
+    finally:
+        faults.clear()
+        server.stop()
+
+
+def test_ml_evaluator_degraded_mode_is_visible(clean_resilience):
+    """The scheduler's ML→base fallback is a *visible* state: the
+    resilience registry (→ /healthz) and the degraded-mode gauge flip
+    when the model is unavailable."""
+    from dragonfly2_tpu.scheduler.evaluator import MLEvaluator
+
+    ev = MLEvaluator(model=None)
+    assert ev.evaluate_parents([], None, 0) == []
+    deg = resilience.degraded()
+    assert MLEvaluator.DEGRADED_COMPONENT in deg
+    assert "no model" in deg[MLEvaluator.DEGRADED_COMPONENT]
+    snap = resilience.snapshot()
+    assert MLEvaluator.DEGRADED_COMPONENT in snap["degraded"]
+
+    # recovery clears the flag (edge-triggered, so this exact transition
+    # is what production sees when a model loads)
+    ev._set_degraded(None)
+    assert MLEvaluator.DEGRADED_COMPONENT not in resilience.degraded()
+
+
+def test_trainer_sigkill_mid_fit_resumes_from_checkpoint(clean_resilience, tmp_path):
+    """The crash drill: a ``trainer.fit_step=abort`` rule SIGKILLs the
+    fit process at epoch 2 (no atexit, no finally — the way an OOM kill
+    dies). The restarted fit resumes from epoch 2's snapshot and reaches
+    the exact params of an uninterrupted run."""
+    import subprocess
+    import sys
+
+    from dragonfly2_tpu.schema.synth import make_pair_tensors
+    from dragonfly2_tpu.trainer.checkpoint import params_equal
+    from dragonfly2_tpu.trainer.train import FitConfig, train_mlp
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    script = (
+        "from dragonfly2_tpu.schema.synth import make_pair_tensors\n"
+        "from dragonfly2_tpu.trainer.train import FitConfig, train_mlp\n"
+        "x, y = make_pair_tensors(1024, seed=0)\n"
+        "train_mlp(x, y, config=FitConfig(epochs=4, hidden_dims=(16,),"
+        f" batch_size=256, seed=3, checkpoint_dir={ckpt_dir!r}))\n"
+        "raise SystemExit('fit survived an armed abort rule')\n"
+    )
+    env = dict(os.environ, DF_FAULTS="trainer.fit_step=abort#2")
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        env=env,
+        capture_output=True,
+        timeout=300,
+    )
+    assert proc.returncode == -9, (  # SIGKILL, not a clean exit
+        proc.returncode,
+        proc.stdout[-500:],
+        proc.stderr[-500:],
+    )
+
+    # the resumed run (this process; no faults armed) finishes epochs
+    # 2..3 only, landing on the uninterrupted run's exact params
+    x, y = make_pair_tensors(1024, seed=0)
+    base = dict(hidden_dims=(16,), batch_size=256, seed=3)
+    full = train_mlp(x, y, config=FitConfig(epochs=4, **base))
+    resumed = train_mlp(
+        x, y, config=FitConfig(epochs=4, checkpoint_dir=ckpt_dir, **base)
+    )
+    assert len(resumed.history) == 2
+    assert params_equal(full.params, resumed.params, atol=1e-6)
+
+
+def test_chaos_soak_acceptance(clean_resilience, tmp_path):
+    """ISSUE 5 acceptance: the canned fault schedule (scheduler restart
+    + 5% RPC error + parent kill) over a download swarm — every download
+    completes correct bytes, zero hangs, every wait bounded by a
+    propagated deadline."""
+    from dragonfly2_tpu.tools.stress import chaos_soak
+
+    stats = chaos_soak(downloads=4, piece=16 * 1024, deadline_s=30.0)
+    assert stats["chaos_success_rate"] == 1.0, stats
+    assert stats["chaos_hangs"] == 0, stats
+    assert stats["chaos_faults_injected"] >= 1, stats

@@ -352,8 +352,10 @@ def test_documented_series_exist():
     # importing the modules registers their series
     import dragonfly2_tpu.client.metrics  # noqa: F401
     import dragonfly2_tpu.manager.metrics  # noqa: F401
+    import dragonfly2_tpu.rpc.resilience  # noqa: F401 — rpc_retries_* etc.
     import dragonfly2_tpu.scheduler.metrics  # noqa: F401
     import dragonfly2_tpu.trainer.metrics  # noqa: F401
+    import dragonfly2_tpu.utils.faults  # noqa: F401 — faults_* series
     import dragonfly2_tpu.utils.flight  # noqa: F401 — flight_* series
     from dragonfly2_tpu.rpc import glue
     from dragonfly2_tpu.utils.metrics import default_registry
@@ -373,3 +375,86 @@ def test_documented_series_exist():
     }
     missing = documented - registered
     assert not missing, f"documented but not registered: {sorted(missing)}"
+
+
+def test_healthz_carries_resilience_state():
+    """/healthz explains both "is it up" and "is it limping": breaker
+    states, retry-budget fill, and the degraded-component map ride the
+    liveness body — and a *degraded* component keeps the 200 (only a
+    hard-down probe flips 503)."""
+    import json
+
+    from dragonfly2_tpu.rpc import resilience
+
+    r = Registry("t_res")
+    srv = MetricsServer(r)
+    srv.register_health("scheduler", lambda: True)
+    addr = srv.start()
+    try:
+        resilience.reset()
+        # populate one breaker, one budget, one degraded component
+        pol = resilience.Policy(breaker_failures=1, breaker_open_s=60.0)
+        br = resilience.breaker_for("10.0.0.9:8002", pol)
+        br.on_failure()  # trips at threshold 1 → open
+        resilience.budget_for("svc", "10.0.0.9:8002", pol).try_spend()
+        resilience.set_degraded("scheduler.evaluator", "no model loaded")
+        with urllib.request.urlopen(f"http://{addr}/healthz", timeout=5) as resp:
+            assert resp.status == 200  # degraded ≠ down
+            body = json.loads(resp.read())
+        assert body["status"] == "ok"
+        assert body["resilience"]["breakers"]["10.0.0.9:8002"]["state"] == "open"
+        fill = body["resilience"]["retry_budget_fill"]["svc@10.0.0.9:8002"]
+        assert 0.0 < fill < 1.0
+        assert body["degraded"] == {"scheduler.evaluator": "no model loaded"}
+    finally:
+        resilience.reset()
+        srv.stop()
+
+
+def test_debug_faults_endpoint_arms_and_disarms():
+    """GET /debug/faults shows the plane's live state; POST arms a
+    schedule without a restart (empty body disarms, malformed 400s)."""
+    import json
+
+    from dragonfly2_tpu.utils import faults
+
+    r = Registry("t_flt")
+    srv = MetricsServer(r)
+    addr = srv.start()
+    try:
+        spec = "seed=11;rpc.unary_send=error:UNAVAILABLE@0.5"
+        req = urllib.request.Request(
+            f"http://{addr}/debug/faults", data=spec.encode(), method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert json.loads(resp.read()) == {"rules": 1, "active": True}
+        assert faults.active()
+        with urllib.request.urlopen(f"http://{addr}/debug/faults", timeout=5) as resp:
+            snap = json.loads(resp.read())
+        assert snap["active"] and snap["seed"] == 11
+        assert snap["rules"][0]["point"] == "rpc.unary_send"
+        # malformed spec: 400, plane untouched
+        bad = urllib.request.Request(
+            f"http://{addr}/debug/faults", data=b"warp.core=explode", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(bad, timeout=5)
+        assert exc.value.code == 400
+        assert faults.active()
+        # empty body disarms
+        off = urllib.request.Request(
+            f"http://{addr}/debug/faults", data=b"", method="POST"
+        )
+        with urllib.request.urlopen(off, timeout=5) as resp:
+            assert json.loads(resp.read()) == {"rules": 0, "active": False}
+        assert not faults.active()
+        # POST elsewhere stays 404
+        nope = urllib.request.Request(
+            f"http://{addr}/nope", data=b"x", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(nope, timeout=5)
+        assert exc.value.code == 404
+    finally:
+        faults.clear()
+        srv.stop()
