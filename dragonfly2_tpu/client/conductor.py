@@ -12,6 +12,8 @@ RegisterPeerTask/ReportPieceResult pair; piece *bytes* still ride HTTP
 from the parent's upload server.
 """
 
+# dfanalyze: hot — per-piece accounting and the per-peer run loop
+
 from __future__ import annotations
 
 import queue
@@ -22,7 +24,11 @@ from dataclasses import dataclass, field
 
 from dragonfly2_tpu.rpc import gen  # noqa: F401
 import common_pb2  # noqa: E402
+import dfdaemon_pb2  # noqa: E402
 import scheduler_pb2  # noqa: E402
+
+from dragonfly2_tpu.rpc import glue, resilience
+from dragonfly2_tpu.utils import tracing
 
 from dragonfly2_tpu.client.downloader import PieceDownloadError
 from dragonfly2_tpu.client.synchronizer import PieceTaskSynchronizer
@@ -152,8 +158,6 @@ class PeerTaskConductor:
     def start(self) -> None:
         M.TASK_TOTAL.labels("file").inc()
         # span per peer task (reference peertask_conductor.go:123-124)
-        from dragonfly2_tpu.utils import tracing
-
         self._span = tracing.get("dfdaemon").start_span(
             "peer_task", task_id=self.task_id, peer_id=self.peer_id, url=self.url
         )
@@ -221,8 +225,6 @@ class PeerTaskConductor:
     def _stream_loop(self) -> None:
         """Own thread: consumes scheduler responses, queues decisions for
         the run loop (reference receivePeerPacket :659)."""
-        from dragonfly2_tpu.utils import tracing
-
         requests = self._requests  # bound once, before any later swap
         try:
             FP_ANNOUNCE_STREAM()
@@ -243,8 +245,6 @@ class PeerTaskConductor:
     # main run loop
     # ------------------------------------------------------------------
     def _run(self) -> None:
-        from dragonfly2_tpu.utils import tracing
-
         with tracing.use_span(getattr(self, "_span", None)):
             self._run_traced()
 
@@ -349,8 +349,6 @@ class PeerTaskConductor:
             "announce stream for %s reconnecting (attempt %d/%d): %s",
             self.peer_id, attempt, self.opts.stream_reconnect_attempts, cause,
         )
-        from dragonfly2_tpu.rpc import resilience
-
         time.sleep(
             resilience.full_jitter_backoff(
                 attempt - 1, base_s=self.opts.stream_reconnect_backoff, cap_s=2.0
@@ -566,9 +564,6 @@ class PeerTaskConductor:
     ) -> tuple[int, int]:
         """GetPieceTasks against candidate parents' daemon gRPC ports to
         learn (content_length, piece_length)."""
-        from dragonfly2_tpu.rpc import glue
-        import dfdaemon_pb2  # noqa: E402 — flat proto import
-
         for c in candidates:
             if not c.host.port:
                 continue

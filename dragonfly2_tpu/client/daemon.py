@@ -427,8 +427,9 @@ class Daemon:
                     client.LeaveHost(
                         scheduler_pb2.LeaveHostRequest(host_id=self.host_id)
                     )
-                except Exception:
-                    pass  # best-effort; TTL GC reaps the host eventually
+                except Exception as e:
+                    # best-effort; TTL GC reaps the host eventually
+                    logger.debug("LeaveHost on shutdown failed: %s", e)
         if getattr(self, "_metrics", None) is not None:
             self._metrics.stop()
         if getattr(self, "shaper", None) is not None:

@@ -59,8 +59,8 @@ class PieceTaskSynchronizer:
         for call in self._calls:
             try:
                 call.cancel()  # unblocks a thread stuck on a hung parent
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("piece-sync cancel failed: %s", e)
         for t in self._threads:
             t.join(timeout=2.0)
 

@@ -148,9 +148,15 @@ class P2PTransport:
                 # still VERSION the cache — as task-identity salt — or an
                 # object overwrite would serve stale slice bytes forever
                 tag_salt, digest = digest, ""
+                # read the verdict under the lock, fetch OUTSIDE it — a
+                # direct origin fetch under _no_range_lock would serialize
+                # every range-fallback request behind one slow origin
                 with self._no_range_lock:
-                    if self._no_range.get(target, 0.0) > time.monotonic():
-                        return self._direct(target, headers, head)
+                    range_refused = (
+                        self._no_range.get(target, 0.0) > time.monotonic()
+                    )
+                if range_refused:
+                    return self._direct(target, headers, head)
         try:
             return self._via_p2p(
                 target, headers, digest, byte_range=byte_range, tag_salt=tag_salt

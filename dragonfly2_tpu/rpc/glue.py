@@ -8,10 +8,15 @@ generic RPC handlers — byte-identical on the wire to plugin-generated
 code (role parity: reference pkg/rpc client/server glue).
 """
 
+# dfanalyze: hot — _instrument/_instrument_client wrap every RPC
+
 from __future__ import annotations
 
+import bisect
+import hashlib
 import time
 import threading
+from concurrent import futures
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -26,6 +31,14 @@ import scheduler_pb2  # noqa: E402
 import scheduler_v1_pb2  # noqa: E402
 import topology_pb2  # noqa: E402
 import trainer_pb2  # noqa: E402
+
+# resilience imports only grpc + utils (never this module), so the
+# module-scope import is cycle-free; it used to be re-imported inside
+# every server handler invocation, which is exactly the per-call tax
+# dfanalyze's hygiene pass now fails
+from dragonfly2_tpu.rpc import resilience
+from dragonfly2_tpu.utils import dflog, tracing
+from dragonfly2_tpu.utils.metrics import default_registry as _registry
 
 # Canonical service names — every client/server refers to these, so a
 # rename can never leave a client dialing a service no server registers.
@@ -186,8 +199,6 @@ class ServiceClient:
     service's short name so single-target clients still get a breaker."""
 
     def __init__(self, channel: grpc.Channel, service: str, target: str = ""):
-        from dragonfly2_tpu.rpc import resilience
-
         methods = SERVICES[service]
         target = target or service.rsplit(".", 1)[-1]
         for name, m in methods.items():
@@ -217,8 +228,7 @@ class ServiceClient:
 def _rpc_metrics():
     global _RPC_HANDLED, _RPC_LATENCY
     if _RPC_HANDLED is None:
-        from dragonfly2_tpu.utils.metrics import default_registry as r
-
+        r = _registry
         _RPC_HANDLED = r.counter(
             "rpc_server_handled_total",
             "RPCs completed on the server, by outcome code",
@@ -242,8 +252,7 @@ _RPC_LATENCY = None
 def _rpc_client_metrics():
     global _RPC_CLIENT_HANDLED, _RPC_CLIENT_LATENCY
     if _RPC_CLIENT_HANDLED is None:
-        from dragonfly2_tpu.utils.metrics import default_registry as r
-
+        r = _registry
         _RPC_CLIENT_HANDLED = r.counter(
             "rpc_client_handled_total",
             "RPCs completed on the client, by outcome code",
@@ -337,8 +346,6 @@ def _instrument_client(
     opens a client span, and records the rpc_client_* series.
     Response-streaming calls are timed to iterator exhaustion, like the
     server side."""
-    from dragonfly2_tpu.utils import tracing
-
     streaming_out = kind in (UNARY_STREAM, STREAM_STREAM)
 
     def call(request_or_iterator, timeout=None, metadata=None, **kwargs):
@@ -395,15 +402,11 @@ def _instrument(service: str, name: str, kind: str, fn: Callable) -> Callable:
     incoming ``traceparent`` metadata (absent/malformed → a new root),
     and is installed as the current span while the handler runs so
     application spans parent under it automatically."""
-    from dragonfly2_tpu.utils import tracing
-
     handled, latency = _rpc_metrics()
     short = service.rsplit(".", 1)[-1]
     streaming_out = kind in (UNARY_STREAM, STREAM_STREAM)
 
     def wrapped(request_or_iterator, context):
-        from dragonfly2_tpu.rpc import resilience
-
         tracer = tracing.get(short)
         remote = tracing.parse_traceparent(_incoming_traceparent(context))
         span = tracer.start_span(f"rpc.{name}", parent=remote)
@@ -525,8 +528,6 @@ def serve(
     local-CLI path (reference pkg/rpc/mux.go serves tcp+unix+vsock from
     one grpc.Server); extras are plaintext, the filesystem is their
     access control."""
-    from concurrent import futures
-
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     for service, impl in implementations.items():
         server.add_generic_rpc_handlers((make_handler(service, impl),))
@@ -564,7 +565,6 @@ def dial(
     against that root; ``tls_client`` adds the client pair for mTLS;
     ``tls_server_name`` overrides SNI/verification for certs issued to a
     different name."""
-    from dragonfly2_tpu.rpc import resilience
     options = [
         ("grpc.max_send_message_length", 256 * 1024 * 1024),
         ("grpc.max_receive_message_length", 256 * 1024 * 1024),
@@ -611,8 +611,6 @@ class ConsistentHashRing:
     VNODES = 100
 
     def __init__(self, addresses: list[str] | None = None):
-        import hashlib
-
         self._hash = lambda s: int.from_bytes(
             hashlib.md5(s.encode()).digest()[:8], "big"
         )
@@ -621,8 +619,6 @@ class ConsistentHashRing:
             self.add(addr)
 
     def add(self, address: str) -> None:
-        import bisect
-
         for v in range(self.VNODES):
             h = self._hash(f"{address}#{v}")
             bisect.insort(self._ring, (h, address))
@@ -633,8 +629,6 @@ class ConsistentHashRing:
     def pick(self, key: str) -> str:
         if not self._ring:
             raise ValueError("no addresses in the ring")
-        import bisect
-
         h = self._hash(key)
         i = bisect.bisect_left(self._ring, (h, ""))
         if i == len(self._ring):
@@ -815,8 +809,6 @@ class SchedulerSelector:
         raise ConnectionError(f"no scheduler reachable: {last}")
 
     def all(self) -> list[ServiceClient]:
-        from dragonfly2_tpu.utils import dflog
-
         out = []
         for addr in self.addresses:
             try:

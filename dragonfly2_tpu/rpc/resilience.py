@@ -35,8 +35,11 @@ breaker/budget/degraded state on the port they already scrape.
 nothing else in the stack needs to know this module exists.
 """
 
+# dfanalyze: hot — wrap_call's `call` wraps every RPC the stack makes
+
 from __future__ import annotations
 
+import concurrent.futures
 import contextvars
 import random
 import threading
@@ -724,8 +727,6 @@ def _hedged(inner, request, t_remaining, md, kwargs, service, method, hedge_dela
     attempts run the full traced inner callable; the loser's result is
     discarded (unary responses are plain messages — nothing to cancel
     that matters at this layer)."""
-    import concurrent.futures
-
     # shutdown(wait=False) at the end: the loser attempt may still be
     # waiting out its own timeout, and blocking on it would hand back the
     # exact tail latency hedging exists to cut

@@ -11,6 +11,8 @@
   Falls back to the base score when no model is loaded or inference fails.
 """
 
+# dfanalyze: hot — evaluate_parents/is_bad_node run per schedule op
+
 from __future__ import annotations
 
 import math
@@ -19,10 +21,13 @@ from typing import Protocol
 
 import numpy as np
 
+from dragonfly2_tpu.rpc import resilience
 from dragonfly2_tpu.schema.features import (
+    MLP_FEATURE_DIM,
     location_affinity as offline_location_affinity,
 )
 from dragonfly2_tpu.utils import dflog, flight, tracing
+from dragonfly2_tpu.utils.dfplugin import registry as plugin_registry
 
 logger = dflog.get("scheduler.evaluator")
 
@@ -271,8 +276,6 @@ class MLEvaluator(BaseEvaluator):
         # changes when the schema grows, e.g. 12 → 18)
         dim = getattr(model, "feature_dim", None)
         if model is not None and dim is not None:
-            from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
-
             if dim != MLP_FEATURE_DIM:
                 logger.warning(
                     "rejecting model with feature_dim=%d (current schema is %d);"
@@ -293,8 +296,6 @@ class MLEvaluator(BaseEvaluator):
         if want == self._degraded:
             return
         self._degraded = want
-        from dragonfly2_tpu.rpc import resilience
-
         resilience.set_degraded(self.DEGRADED_COMPONENT, reason)
 
     def evaluate_parents(
@@ -410,9 +411,7 @@ def new_evaluator(algorithm: str = "default", model=None) -> Evaluator:
     if algorithm == "ml":
         return MLEvaluator(model)
     if algorithm not in ("", "default"):
-        from dragonfly2_tpu.utils.dfplugin import registry
-
-        plugin = registry.evaluator(algorithm)
+        plugin = plugin_registry.evaluator(algorithm)
         if plugin is not None:
             return plugin
     return BaseEvaluator()

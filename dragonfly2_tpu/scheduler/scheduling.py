@@ -13,6 +13,8 @@ transport-independent and testable in-process, the same way the reference
 tests drive it against scripted mocks.
 """
 
+# dfanalyze: hot — one schedule_candidate_parents call per peer decision
+
 from __future__ import annotations
 
 import threading

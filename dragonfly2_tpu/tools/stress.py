@@ -321,8 +321,8 @@ def chaos_soak(
         for d in daemons or []:
             try:
                 d.stop()
-            except Exception:
-                pass
+            except Exception as e:
+                print(f"stress: daemon stop during teardown failed: {e}", file=sys.stderr)
         if server is not None:
             try:
                 server.stop(0)

@@ -15,6 +15,8 @@ are purged outright past ``max_age_s`` — a departed or quiet edge fades
 instead of pinning its last EWMA forever.
 """
 
+# dfanalyze: hot — est_rtt_ns/rtt_affinity run per schedule decision
+
 from __future__ import annotations
 
 import bisect
@@ -25,6 +27,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from dragonfly2_tpu.schema import records as R
 from dragonfly2_tpu.topology import metrics as TM
 from dragonfly2_tpu.topology.csr import NS_PER_MS, AdjacencyStore
 from dragonfly2_tpu.topology.delta import DeltaQueue, EdgeDelta
@@ -498,8 +501,6 @@ class TopologyEngine:
         adjacency — the trainer-bound GNN snapshot without a KV walk.
         Freshest ``dest_limit`` dests per source (parity with
         NetworkTopology.export_records' recency preference)."""
-        from dragonfly2_tpu.schema import records as R
-
         # flush BEFORE taking _lock (flush's order is _flush_lock →
         # _lock; the reverse would ABBA-deadlock with a concurrent
         # flusher, e.g. the 30s GC flush task)

@@ -42,6 +42,8 @@ JSON file form: ``{"seed": 42, "rules": [{"point": ..., "action": ...,
 "code": ..., "delay_ms": ..., "rate": ..., "after": ..., "count": ...}]}``.
 """
 
+# dfanalyze: hot — a disarmed point is one predicate on every RPC attempt
+
 from __future__ import annotations
 
 import json

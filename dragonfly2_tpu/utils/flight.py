@@ -36,6 +36,8 @@ Env: ``DF_DIAG_DIR`` (dump directory; no dumps when unset),
 ``0`` disables the watchdogs).
 """
 
+# dfanalyze: hot — the ~1µs emit rides every lifecycle event
+
 from __future__ import annotations
 
 import collections

@@ -32,6 +32,8 @@ compute plane adds `jax.profiler` traces via trainer config
 (profile_dir), the XLA-side equivalent.
 """
 
+# dfanalyze: hot — span start/stop wraps every RPC and schedule op
+
 from __future__ import annotations
 
 import collections

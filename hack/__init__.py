@@ -1,0 +1,2 @@
+# Makes hack/ importable so `python -m hack.dfanalyze` works from the
+# repo root. The scripts in here still run standalone too.

@@ -1,258 +1,37 @@
 #!/usr/bin/env python3
-"""Metric-registration lint: walk the package source for Prometheus
-series registrations (``.counter(...)``/``.gauge(...)``/
-``.histogram(...)`` calls with a literal name) and fail on
-
-- duplicate names registered from more than one call site — two modules
-  silently sharing (or fighting over) one series,
-- kind mismatches — one name registered as different metric kinds,
-- names violating the ``dragonfly_<service>_...`` convention: the
-  registry prefixes every name with ``dragonfly_``, so a registered
-  name must start with a known service segment, use only
-  ``[a-z0-9_]``, and counters must end in ``_total`` (which the
-  OpenMetrics exposition depends on).
-
-The same census discipline covers the flight recorder's typed event
-emitters (``flight.event_type("...")`` registrations, utils/flight):
-duplicate event names across the package, names without a
-``<service>.`` prefix, and characters outside ``[a-z0-9_.]`` all fail —
-the dfdoctor timeline keys on these names, so they must stay as
-disciplined as the metric series.
-
-Fault-injection points (``faults.point("...")`` registrations,
-utils/faults) are linted the same way: duplicates, names that aren't
-``<layer>.<what>`` with a known layer — plus one extra rule: every
-registered point must be *referenced by at least one test* (its literal
-name appearing under ``tests/``). An unexercised injection point is
-dead chaos surface: the schedule grammar accepts it, nothing proves the
-layer actually survives it.
-
-Run standalone (``python hack/check_metrics.py``) or via the tier-1
-test that wraps :func:`check`.
+"""Thin shim: the metric/event/fault-point census now lives in
+``hack/dfanalyze/passes/metrics.py`` (one pass of the dfanalyze
+framework — run ``python -m hack.dfanalyze`` for the full suite). This
+entry point keeps the old CLI (``python hack/check_metrics.py``) and the
+``check()`` API that ``tests/test_check_metrics.py`` and muscle memory
+depend on.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-PACKAGE = Path(__file__).resolve().parent.parent / "dragonfly2_tpu"
-
-# the service segment a series name must start with — one per process
-# role plus the shared rpc glue, flight-recorder, fault-plane and
-# resilience-layer series
-ALLOWED_SERVICES = (
-    "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "flight",
-    "faults", "resilience",
-)
-
-# flight-recorder event names are <service>.<what>; the service segment
-# is the ring category — the process roles plus the cross-layer "rpc"
-# (resilience decisions: retries, breaker trips, sheds) and "faults"
-# (injections) rings, which must not evict any role's own history
-EVENT_SERVICES = (
-    "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "faults",
-)
-
-# fault-point names are <layer>.<what>; mirrors utils/faults.POINT_LAYERS
-FAULT_LAYERS = ("rpc", "daemon", "scheduler", "trainer", "manager", "kv")
-
-TESTS_DIR = PACKAGE.parent / "tests"
-
-KINDS = ("counter", "gauge", "histogram")
-
-
-def _registrations(path: Path) -> list[tuple[str, str, int]]:
-    """(name, kind, lineno) for every literal metric registration in
-    ``path``. Only attribute calls are considered (``_r.counter(...)``),
-    which is how every registration in the package is written; local
-    ``Registry("...")`` instances in tests/bench are out of scope."""
+# prefer the canonical hack.dfanalyze tree (what tests/conftest import)
+# so one process never holds two copies of the framework; the top-level
+# fallback covers the standalone `python hack/check_metrics.py` run,
+# where only this script's directory is on sys.path
+try:
+    from hack.dfanalyze.passes import metrics as _impl
+except ImportError:
     try:
-        tree = ast.parse(path.read_text())
-    except SyntaxError:
-        return []
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if not (isinstance(fn, ast.Attribute) and fn.attr in KINDS):
-            continue
-        if not node.args:
-            continue
-        first = node.args[0]
-        if isinstance(first, ast.Constant) and isinstance(first.value, str):
-            out.append((first.value, fn.attr, node.lineno))
-    return out
+        from dfanalyze.passes import metrics as _impl
+    except ImportError:
+        sys.path.insert(0, str(Path(__file__).resolve().parent))
+        from dfanalyze.passes import metrics as _impl
 
-
-def _event_registrations(path: Path) -> list[tuple[str, int]]:
-    """(name, lineno) for every literal flight-recorder event-type
-    registration (``flight.event_type("...")`` / ``.event_type(...)``
-    attribute calls) in ``path``."""
-    try:
-        tree = ast.parse(path.read_text())
-    except SyntaxError:
-        return []
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if not (isinstance(fn, ast.Attribute) and fn.attr == "event_type"):
-            continue
-        if not node.args:
-            continue
-        first = node.args[0]
-        if isinstance(first, ast.Constant) and isinstance(first.value, str):
-            out.append((first.value, node.lineno))
-    return out
-
-
-def _fault_point_registrations(path: Path) -> list[tuple[str, int]]:
-    """(name, lineno) for every literal fault-point registration
-    (``faults.point("...")`` / ``.point(...)`` attribute calls with a
-    literal string) in ``path``. The plane's own ``_plane.point(name)``
-    forwarder passes a variable, so only true declarations match."""
-    try:
-        tree = ast.parse(path.read_text())
-    except SyntaxError:
-        return []
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if not (isinstance(fn, ast.Attribute) and fn.attr == "point"):
-            continue
-        if not node.args:
-            continue
-        first = node.args[0]
-        if isinstance(first, ast.Constant) and isinstance(first.value, str):
-            out.append((first.value, node.lineno))
-    return out
-
-
-def _tests_corpus(tests_dir: Path = TESTS_DIR) -> str:
-    """Concatenated test source — the referenced-by-test rule greps
-    fault-point names against this."""
-    if not tests_dir.is_dir():
-        return ""
-    return "\n".join(
-        p.read_text() for p in sorted(tests_dir.glob("*.py"))
-    )
-
-
-def check(package_dir: Path = PACKAGE) -> list[str]:
-    """Returns a list of human-readable failures (empty = clean)."""
-    failures: list[str] = []
-    seen: dict[str, tuple[str, str]] = {}  # name -> (kind, site)
-    seen_events: dict[str, str] = {}  # event name -> site
-    seen_points: dict[str, str] = {}  # fault point -> site
-    for path in sorted(package_dir.rglob("*.py")):
-        rel = path.relative_to(package_dir.parent)
-        for name, lineno in _fault_point_registrations(path):
-            site = f"{rel}:{lineno}"
-            if not all(c.islower() or c.isdigit() or c in "._" for c in name):
-                failures.append(
-                    f"{site}: fault point {name!r} has characters outside"
-                    " [a-z0-9_.]"
-                )
-            layer = name.split(".", 1)[0]
-            if "." not in name or layer not in FAULT_LAYERS:
-                failures.append(
-                    f"{site}: fault point {name!r} must be <layer>.<what>"
-                    f" with layer in {FAULT_LAYERS}"
-                )
-            prev_site = seen_points.get(name)
-            if prev_site is not None:
-                failures.append(
-                    f"{site}: duplicate fault-point registration of {name!r}"
-                    f" (first at {prev_site})"
-                )
-            else:
-                seen_points[name] = site
-        for name, lineno in _event_registrations(path):
-            site = f"{rel}:{lineno}"
-            if not all(c.islower() or c.isdigit() or c in "._" for c in name):
-                failures.append(
-                    f"{site}: event {name!r} has characters outside [a-z0-9_.]"
-                )
-            service = name.split(".", 1)[0]
-            if "." not in name or service not in EVENT_SERVICES:
-                failures.append(
-                    f"{site}: event {name!r} must be <service>.<what> with"
-                    f" service in {EVENT_SERVICES}"
-                )
-            prev_site = seen_events.get(name)
-            if prev_site is not None:
-                failures.append(
-                    f"{site}: duplicate event registration of {name!r}"
-                    f" (first at {prev_site})"
-                )
-            else:
-                seen_events[name] = site
-        for name, kind, lineno in _registrations(path):
-            site = f"{rel}:{lineno}"
-            if not name.replace("_", "").replace("-", "").isascii() or not all(
-                c.islower() or c.isdigit() or c == "_" for c in name
-            ):
-                failures.append(
-                    f"{site}: {name!r} has characters outside [a-z0-9_]"
-                )
-            service = name.split("_", 1)[0]
-            if service not in ALLOWED_SERVICES:
-                failures.append(
-                    f"{site}: {name!r} does not start with a known service"
-                    f" segment {ALLOWED_SERVICES} (full name is"
-                    f" dragonfly_{name})"
-                )
-            if kind == "counter" and not name.endswith("_total"):
-                failures.append(
-                    f"{site}: counter {name!r} must end in _total"
-                    " (OpenMetrics counter naming)"
-                )
-            prev = seen.get(name)
-            if prev is not None:
-                prev_kind, prev_site = prev
-                if prev_kind != kind:
-                    failures.append(
-                        f"{site}: {name!r} registered as {kind} but"
-                        f" {prev_site} registered it as {prev_kind}"
-                    )
-                else:
-                    failures.append(
-                        f"{site}: duplicate registration of {name!r}"
-                        f" (first at {prev_site})"
-                    )
-            else:
-                seen[name] = (kind, site)
-    # OpenMetrics family collisions: a counter 'x_total' exposes under
-    # family 'x' — a sibling metric literally named 'x' would produce a
-    # duplicate family the strict parser rejects on every scrape
-    for name, (kind, site) in seen.items():
-        if kind == "counter" and name.endswith("_total"):
-            family = name[: -len("_total")]
-            if family in seen:
-                failures.append(
-                    f"{site}: counter {name!r} exposes as OpenMetrics"
-                    f" family {family!r}, colliding with the metric of"
-                    f" that name at {seen[family][1]}"
-                )
-    # referenced-by-test: a fault point the test matrix never arms is
-    # dead chaos surface — the spec grammar accepts it, nothing proves
-    # the layer survives it
-    if seen_points:
-        corpus = _tests_corpus(package_dir.parent / "tests")
-        for name, site in sorted(seen_points.items()):
-            if name not in corpus:
-                failures.append(
-                    f"{site}: fault point {name!r} is not referenced by any"
-                    " test under tests/ (add it to the fault matrix in"
-                    " tests/test_fault_injection.py)"
-                )
-    return failures
+PACKAGE = _impl.PACKAGE
+ALLOWED_SERVICES = _impl.ALLOWED_SERVICES
+EVENT_SERVICES = _impl.EVENT_SERVICES
+FAULT_LAYERS = _impl.FAULT_LAYERS
+TESTS_DIR = _impl.TESTS_DIR
+KINDS = _impl.KINDS
+check = _impl.check
 
 
 def main() -> int:

@@ -1,0 +1,249 @@
+"""dfanalyze — pluggable static analysis for the dragonfly2_tpu package.
+
+Grown out of ``hack/check_metrics.py`` (now one pass here) after three
+rounds of review-time tax on defects a tool should catch: PR 2's ABBA
+deadlock between ``_flush_lock`` and ``_lock`` in ``topology/engine.py``,
+and repeated hand-hoisting of per-call imports out of the schedule hot
+path. The reference tree leans on Go's race detector and ``go vet`` for
+this class of bug; this is our equivalent, AST-shaped for lock-heavy
+threaded Python.
+
+Passes (see ``hack/dfanalyze/passes/``):
+
+- ``lock-order``   — per-module lock-acquisition graph; ABBA cycles and
+                     plain-Lock re-entry fail.
+- ``blocking``     — gRPC calls, file/socket I/O, queue waits,
+                     ``time.sleep`` and jax dispatch while a lock is held.
+- ``hygiene``      — hot-path lints: function-local imports in modules
+                     tagged ``# dfanalyze: hot``, bare ``except: pass``
+                     in loops, fire-and-forget ContextVar ``set()``.
+- ``metrics``      — the metric/event/fault-point census (the absorbed
+                     check_metrics).
+- ``typecheck``    — mypy with a checked-in baseline (skips cleanly when
+                     mypy isn't installed in the image).
+
+Audited exceptions live in ``hack/dfanalyze/allowlist.txt``; every entry
+needs a justifying comment, and entries no pass matches fail the run
+(stale allowlists rot into blanket mufflers otherwise). The runtime
+lock-witness (``hack/dfanalyze/witness.py``, armed via
+``DF_LOCK_WITNESS=1`` through ``tests/conftest.py``) records the orders
+the AST can't see and ``--witness-report`` cross-checks them against the
+static graph.
+
+Run ``python -m hack.dfanalyze`` (or ``--json`` for machines).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+DEFAULT_PACKAGE = REPO_ROOT / "dragonfly2_tpu"
+ALLOWLIST_PATH = Path(__file__).resolve().parent / "allowlist.txt"
+
+
+@dataclass
+class Finding:
+    pass_id: str
+    key: str  # stable allowlist key — no spaces, no line numbers
+    file: str
+    line: int
+    message: str
+    allowlisted: bool = False
+
+    def as_dict(self) -> dict:
+        return {
+            "pass": self.pass_id,
+            "key": self.key,
+            "file": self.file,
+            "line": self.line,
+            "message": self.message,
+            "allowlisted": self.allowlisted,
+        }
+
+
+@dataclass
+class PassResult:
+    pass_id: str
+    findings: list[Finding] = field(default_factory=list)
+    skipped: str = ""  # non-empty = skip reason (e.g. "mypy not installed")
+
+
+@dataclass
+class Allowlist:
+    entries: dict[tuple[str, str], str] = field(default_factory=dict)  # (pass,key)->comment
+    used: set = field(default_factory=set)
+    errors: list[str] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: Path = ALLOWLIST_PATH) -> "Allowlist":
+        al = cls()
+        if not path.is_file():
+            return al
+        for i, raw in enumerate(path.read_text().splitlines(), 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if " # " not in line:
+                al.errors.append(
+                    f"allowlist.txt:{i}: entry has no ' # why' comment —"
+                    " audited exceptions must say why they are safe"
+                )
+                continue
+            body, comment = line.split(" # ", 1)
+            parts = body.split()
+            if len(parts) != 2 or not comment.strip():
+                al.errors.append(
+                    f"allowlist.txt:{i}: expected '<pass-id> <key>  # why'"
+                )
+                continue
+            al.entries[(parts[0], parts[1])] = comment.strip()
+        return al
+
+    def match(self, f: Finding) -> bool:
+        k = (f.pass_id, f.key)
+        if k in self.entries:
+            self.used.add(k)
+            return True
+        return False
+
+    def stale(self, ran_passes: set[str]) -> list[str]:
+        out = []
+        for (pass_id, key) in sorted(self.entries):
+            if pass_id in ran_passes and (pass_id, key) not in self.used:
+                out.append(f"{pass_id} {key}")
+        return out
+
+
+def run(
+    package_dir: Path | None = None,
+    pass_ids: list[str] | None = None,
+    allowlist: Allowlist | None = None,
+    witness_report: Path | None = None,
+) -> dict:
+    """Run the selected passes; returns the machine-readable report.
+    ``report["ok"]`` is the exit condition: no unallowlisted findings, no
+    stale allowlist entries, no malformed allowlist lines."""
+    from .passes import ALL_PASSES  # late: passes import this module
+
+    package_dir = Path(package_dir or DEFAULT_PACKAGE)
+    allowlist = allowlist or Allowlist.load()
+    errors: list[str] = []
+    selected = [
+        p for p in ALL_PASSES if pass_ids is None or p.id in pass_ids
+    ]
+    if pass_ids is not None:
+        # a typo'd --pass must FAIL, not silently select nothing and
+        # report the repo clean forever
+        known = {p.id for p in ALL_PASSES}
+        for pid in pass_ids:
+            if pid not in known:
+                errors.append(
+                    f"unknown pass id {pid!r} (known: {sorted(known)})"
+                )
+    results: list[PassResult] = []
+    for p in selected:
+        results.append(p.run(package_dir))
+    if witness_report is not None:
+        from .passes import lockorder
+
+        if not Path(witness_report).is_file():
+            # an explicit cross-check request with no dump is an error —
+            # a cwd/path mismatch must not read as "zero inversions"
+            errors.append(
+                f"witness report not found: {witness_report} (run the"
+                " suite with DF_LOCK_WITNESS=1 first; the dump lands in"
+                " the pytest cwd or DF_LOCK_WITNESS_OUT)"
+            )
+        else:
+            results.append(
+                lockorder.witness_crosscheck(package_dir, Path(witness_report))
+            )
+
+    unallowlisted = 0
+    for r in results:
+        for f in r.findings:
+            f.allowlisted = allowlist.match(f)
+            if not f.allowlisted:
+                unallowlisted += 1
+    stale = allowlist.stale({r.pass_id for r in results if not r.skipped})
+    report = {
+        "package": str(package_dir),
+        "passes": [
+            {
+                "id": r.pass_id,
+                "status": (
+                    "skipped"
+                    if r.skipped
+                    else ("findings" if any(not f.allowlisted for f in r.findings) else "ok")
+                ),
+                "skipped": r.skipped,
+                "findings": [f.as_dict() for f in r.findings],
+            }
+            for r in results
+        ],
+        "summary": {
+            "findings": sum(len(r.findings) for r in results),
+            "unallowlisted": unallowlisted,
+            "allowlisted": sum(
+                1 for r in results for f in r.findings if f.allowlisted
+            ),
+            "stale_allowlist": stale,
+            "allowlist_errors": allowlist.errors,
+            "errors": errors,
+        },
+    }
+    report["ok"] = (
+        unallowlisted == 0 and not stale and not allowlist.errors and not errors
+    )
+    return report
+
+
+def render_text(report: dict) -> str:
+    lines = []
+    for p in report["passes"]:
+        if p["skipped"]:
+            lines.append(f"dfanalyze[{p['id']}]: SKIPPED — {p['skipped']}")
+            continue
+        shown = 0
+        for f in p["findings"]:
+            if f["allowlisted"]:
+                continue
+            shown += 1
+            lines.append(
+                f"dfanalyze[{p['id']}]: {f['file']}:{f['line']}: {f['message']}"
+            )
+            lines.append(f"    allowlist key: {p['id']} {f['key']}")
+        allowed = sum(1 for f in p["findings"] if f["allowlisted"])
+        status = "OK" if shown == 0 else f"{shown} finding(s)"
+        extra = f" ({allowed} allowlisted)" if allowed else ""
+        lines.append(f"dfanalyze[{p['id']}]: {status}{extra}")
+    s = report["summary"]
+    for e in s.get("errors", ()):
+        lines.append(f"dfanalyze: ERROR: {e}")
+    for e in s["allowlist_errors"]:
+        lines.append(f"dfanalyze: {e}")
+    for e in s["stale_allowlist"]:
+        lines.append(
+            f"dfanalyze: stale allowlist entry (matched nothing): {e}"
+        )
+    if report["ok"]:
+        verdict = "OK"
+    else:
+        parts = []
+        if s["unallowlisted"]:
+            parts.append(f"{s['unallowlisted']} unallowlisted finding(s)")
+        if s["stale_allowlist"]:
+            parts.append(f"{len(s['stale_allowlist'])} stale allowlist entr(ies)")
+        n_err = len(s.get("errors", ())) + len(s["allowlist_errors"])
+        if n_err:
+            parts.append(f"{n_err} error(s)")
+        verdict = "FAILED: " + ", ".join(parts)
+    lines.append(f"dfanalyze: {verdict} over {report['package']}")
+    return "\n".join(lines)
+
+
+def to_json(report: dict) -> str:
+    return json.dumps(report, indent=2, sort_keys=True)

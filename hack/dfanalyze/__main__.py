@@ -1,0 +1,68 @@
+"""CLI: ``python -m hack.dfanalyze [options] [package_dir]``.
+
+Exit 0 only when every pass is clean: zero unallowlisted findings, no
+stale allowlist entries, no malformed allowlist lines. ``--json`` emits
+the machine-readable report on stdout (CI and hack/lint.sh consume it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import DEFAULT_PACKAGE, render_text, run, to_json
+from .passes import ALL_PASSES
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dfanalyze",
+        description="project-wide static analysis for dragonfly2_tpu",
+    )
+    ap.add_argument(
+        "package_dir", nargs="?", default=str(DEFAULT_PACKAGE),
+        help="package to analyze (default: the repo's dragonfly2_tpu/)",
+    )
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument(
+        "--pass", dest="passes", action="append", metavar="ID",
+        help="run only this pass (repeatable); default: all",
+    )
+    ap.add_argument(
+        "--witness-report", metavar="FILE",
+        help="cross-check a lock-witness dump (DF_LOCK_WITNESS run) against"
+        " the static lock graph",
+    )
+    ap.add_argument(
+        "--update-mypy-baseline", action="store_true",
+        help="rewrite the typecheck baseline from a fresh mypy run",
+    )
+    ap.add_argument("--list-passes", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for p in ALL_PASSES:
+            print(f"{p.id:12s} {p.description}")
+        return 0
+    if args.update_mypy_baseline:
+        from .passes import typecheck
+
+        n = typecheck.update_baseline(Path(args.package_dir))
+        print(f"dfanalyze[typecheck]: baseline rewritten with {n} violation(s)")
+        return 0
+
+    report = run(
+        package_dir=Path(args.package_dir),
+        pass_ids=args.passes,
+        witness_report=Path(args.witness_report) if args.witness_report else None,
+    )
+    if args.json:
+        print(to_json(report))
+    else:
+        print(render_text(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

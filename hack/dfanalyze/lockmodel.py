@@ -1,0 +1,456 @@
+"""AST lock model shared by the lock-order and blocking-under-lock passes.
+
+For every module under the package this builds, statically:
+
+- a **lock registry**: every ``self.X = threading.Lock()`` /
+  ``threading.RLock()`` (class attr), module-level ``X = threading.Lock()``
+  and function-local lock, identified by a stable node name
+  (``<relpath>::<Class>.<attr>``) plus its creation site — the creation
+  site is the join key the runtime lock-witness uses to map observed
+  acquisition orders back onto this static model;
+- a **lock-acquisition graph**: an edge ``A -> B`` whenever lock ``B`` is
+  acquired while ``A`` is held, either by direct ``with`` nesting or via
+  a call into another method/function *of the same class or module* that
+  (transitively) acquires ``B``. Cross-object calls are deliberately out
+  of scope — the witness covers orders the AST can't see;
+- **blocking-call sites under a held lock**: gRPC-stub calls (CamelCase
+  attribute calls on non-module receivers), ``time.sleep``, queue
+  get/put, ``.wait``/``.join``/``.acquire``, socket/file I/O, and jax
+  dispatch — each classified with a category so the blocking pass can
+  report "what kind of wait is happening inside this critical section".
+
+Everything here is heuristic-by-design: the allowlist
+(``hack/dfanalyze/allowlist.txt``) is where audited exceptions live, and
+the witness run is the dynamic backstop.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+LOCK_FACTORIES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+}
+
+# a with-target whose name smells like a lock is treated as one even when
+# its definition site wasn't seen (parameter-passed locks, locks defined
+# on another object) — better an implicit node than a hole in the graph
+_LOCKISH = re.compile(r"lock|mutex", re.I)
+
+_CAMEL = re.compile(r"^[A-Z][A-Za-z0-9]*[a-z][A-Za-z0-9]*$")
+_LOWER_IDENT = re.compile(r"^[a-z_][a-z0-9_]*$")
+_QUEUEISH = re.compile(
+    r"(?:^q$|_q$|queue|bufs|jobs|requests|decisions|deltas|inbox)", re.I
+)
+_THREADISH = re.compile(r"thread|pool|worker|proc", re.I)
+
+_SOCKET_ATTRS = {"recv", "recv_into", "accept", "connect", "sendall", "makefile"}
+_PATH_IO_ATTRS = {"read_text", "read_bytes", "write_text", "write_bytes"}
+_OS_BLOCKING = {"os.read", "os.write", "os.sendfile", "os.fsync"}
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass(frozen=True)
+class LockDef:
+    node: str  # stable name, e.g. "pkg/topology/engine.py::TopologyEngine._lock"
+    kind: str  # "lock" | "rlock" | "unknown" (implicit)
+    file: str  # repo-relative path
+    line: int  # creation/assignment site (witness join key)
+
+
+@dataclass(frozen=True)
+class Edge:
+    src: str
+    dst: str
+    file: str
+    line: int
+    via: str  # "" for direct `with` nesting, else the callee qualname
+
+
+@dataclass(frozen=True)
+class BlockingSite:
+    lock: str  # the held lock's node name
+    category: str  # sleep | rpc | queue | wait | thread-join | lock-acquire | socket | file-io | jax
+    desc: str  # the call chain as written, e.g. "self.kernels.est_from_landmarks"
+    fn: str  # qualname of the function HOLDING the lock
+    via: str  # "" when direct, else the callee qualname the call lives in
+    file: str
+    line: int
+
+
+@dataclass
+class _FnInfo:
+    qual: str
+    direct_acquires: set[str] = field(default_factory=set)
+    # (held-locks-at-call, resolved-callee-qual or None, file, line)
+    calls: list = field(default_factory=list)
+    # direct nesting edges observed in this function
+    edges: list = field(default_factory=list)
+    # blocking-classified calls made while locks were held HERE
+    blocking: list = field(default_factory=list)  # (held, cat, desc, file, line)
+    # every blocking-classified call in this function, held or not — a
+    # caller holding a lock around a call into this function blocks on
+    # these even though this function itself takes no lock
+    blocking_any: list = field(default_factory=list)  # (cat, desc, file, line)
+    # calls made regardless of held state, for the transitive fixpoint
+    all_callees: set = field(default_factory=set)
+
+
+@dataclass
+class ModuleModel:
+    path: str  # repo-relative
+    locks: dict[str, LockDef] = field(default_factory=dict)
+    edges: list[Edge] = field(default_factory=list)
+    blocking: list[BlockingSite] = field(default_factory=list)
+
+
+class _ModuleWalker:
+    def __init__(self, tree: ast.Module, relpath: str):
+        self.relpath = relpath
+        self.locks: dict[str, LockDef] = {}
+        self.fns: dict[str, _FnInfo] = {}
+        self.import_roots: set[str] = set()
+        self.module_locks: dict[str, str] = {}  # name -> node
+        self._collect_imports(tree)
+        self._collect_module_locks(tree)
+        self._collect_class_locks(tree)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                class_locks = self.class_locks.get(node.name, {})
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._walk_function(
+                            item, f"{node.name}.{item.name}", node.name,
+                            class_locks, {},
+                        )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_function(node, node.name, None, {}, {})
+
+    # -- collection --------------------------------------------------------
+    def _collect_imports(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_roots.add((a.asname or a.name).split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    self.import_roots.add(a.asname or a.name)
+
+    def _lock_kind(self, value: ast.AST) -> str | None:
+        if isinstance(value, ast.Call):
+            chain = dotted(value.func)
+            if chain in LOCK_FACTORIES:
+                return LOCK_FACTORIES[chain]
+            if chain in ("Lock", "RLock"):  # from threading import Lock
+                return "lock" if chain == "Lock" else "rlock"
+        return None
+
+    def _collect_module_locks(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                kind = self._lock_kind(node.value)
+                if kind and isinstance(t, ast.Name):
+                    n = f"{self.relpath}::{t.id}"
+                    self.locks[n] = LockDef(n, kind, self.relpath, node.lineno)
+                    self.module_locks[t.id] = n
+
+    def _collect_class_locks(self, tree: ast.Module) -> None:
+        self.class_locks: dict[str, dict[str, str]] = {}
+        for cls in tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            attrs: dict[str, str] = {}
+            for node in ast.walk(cls):
+                if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                    continue
+                t = node.targets[0]
+                kind = self._lock_kind(node.value)
+                if (
+                    kind
+                    and isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    n = f"{self.relpath}::{cls.name}.{t.attr}"
+                    self.locks[n] = LockDef(n, kind, self.relpath, node.lineno)
+                    attrs[t.attr] = n
+            self.class_locks[cls.name] = attrs
+
+    # -- per-function walk -------------------------------------------------
+    def _walk_function(
+        self,
+        fn: ast.FunctionDef | ast.AsyncFunctionDef,
+        qual: str,
+        cls: str | None,
+        class_locks: dict[str, str],
+        enclosing_locals: dict[str, str],
+    ) -> None:
+        info = _FnInfo(qual)
+        self.fns[qual] = info
+        local_locks: dict[str, str] = dict(enclosing_locals)
+
+        def resolve_lock(expr: ast.AST) -> str | None:
+            if isinstance(expr, ast.Name):
+                if expr.id in local_locks:
+                    return local_locks[expr.id]
+                if expr.id in self.module_locks:
+                    return self.module_locks[expr.id]
+                if _LOCKISH.search(expr.id):
+                    n = f"{self.relpath}::{qual}.{expr.id}"
+                    self.locks.setdefault(
+                        n, LockDef(n, "unknown", self.relpath, expr.lineno)
+                    )
+                    return n
+                return None
+            chain = dotted(expr)
+            if chain is None:
+                return None
+            if chain.startswith("self.") and chain.count(".") == 1:
+                attr = chain.split(".", 1)[1]
+                if attr in class_locks:
+                    return class_locks[attr]
+                if _LOCKISH.search(attr):
+                    n = f"{self.relpath}::{cls}.{attr}" if cls else f"{self.relpath}::{chain}"
+                    self.locks.setdefault(
+                        n, LockDef(n, "unknown", self.relpath, expr.lineno)
+                    )
+                    return n
+                return None
+            if _LOCKISH.search(chain.rsplit(".", 1)[-1]):
+                n = f"{self.relpath}::{chain}"
+                self.locks.setdefault(
+                    n, LockDef(n, "unknown", self.relpath, expr.lineno)
+                )
+                return n
+            return None
+
+        def resolve_callee(call: ast.Call) -> str | None:
+            f = call.func
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self"
+                and cls is not None
+            ):
+                return f"{cls}.{f.attr}"
+            if isinstance(f, ast.Name):
+                # nested function of this one, or module-level function
+                if f"{qual}.<locals>.{f.id}" in self.fns:
+                    return f"{qual}.<locals>.{f.id}"
+                return f.id  # resolved against self.fns at fixpoint time
+            return None
+
+        def classify(call: ast.Call) -> tuple[str, str] | None:
+            chain = dotted(call.func)
+            if chain == "time.sleep":
+                return "sleep", chain
+            if chain == "open":
+                return "file-io", chain
+            if chain in _OS_BLOCKING:
+                return "file-io", chain
+            if chain:
+                root = chain.split(".")[0]
+                if root in ("jax", "jnp") or ".block_until_ready" in chain:
+                    return "jax", chain
+                if root in ("socket", "requests", "subprocess") or chain.endswith(
+                    ".urlopen"
+                ):
+                    return "socket", chain
+            if not isinstance(call.func, ast.Attribute):
+                return None
+            attr = call.func.attr
+            recv = dotted(call.func.value)
+            recv_last = recv.rsplit(".", 1)[-1] if recv else ""
+            recv_root = recv.split(".")[0] if recv else ""
+            if recv_last in ("kernels", "xp") or (recv or "").endswith(".kernels"):
+                return "jax", chain or f"?.{attr}"
+            has_timeout = any(k.arg == "timeout" for k in call.keywords)
+            if attr in ("get", "put") and (_QUEUEISH.search(recv_last) or has_timeout):
+                return "queue", chain or f"?.{attr}"
+            if attr == "wait" and not isinstance(call.func.value, ast.Constant):
+                return "wait", chain or f"?.{attr}"
+            if attr == "join" and recv and _THREADISH.search(recv_last):
+                return "thread-join", chain
+            if attr == "acquire":
+                return "lock-acquire", chain or f"?.{attr}"
+            if attr in _SOCKET_ATTRS:
+                return "socket", chain or f"?.{attr}"
+            if attr in _PATH_IO_ATTRS:
+                return "file-io", chain or f"?.{attr}"
+            if (
+                _CAMEL.match(attr)
+                and recv
+                and _LOWER_IDENT.match(recv_last)
+                and not recv_last.endswith("_pb2")
+                and recv_root not in self.import_roots
+            ):
+                return "rpc", chain
+            return None
+
+        def walk(node: ast.AST, held: tuple[str, ...]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # runs later, not under the current held set
+                self._walk_function(
+                    node, f"{qual}.<locals>.{node.name}", cls, class_locks, local_locks
+                )
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in node.items:
+                    for sub in ast.iter_child_nodes(item.context_expr):
+                        walk(sub, held)
+                    lock = resolve_lock(item.context_expr)
+                    if lock is not None:
+                        info.direct_acquires.add(lock)
+                        for h in new_held:
+                            info.edges.append(
+                                Edge(h, lock, self.relpath, item.context_expr.lineno, "")
+                            )
+                        new_held = new_held + (lock,)
+                for stmt in node.body:
+                    walk(stmt, new_held)
+                return
+            if isinstance(node, ast.Assign):
+                kind = self._lock_kind(node.value)
+                if (
+                    kind
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                ):
+                    name = node.targets[0].id
+                    n = f"{self.relpath}::{qual}.{name}"
+                    self.locks.setdefault(n, LockDef(n, kind, self.relpath, node.lineno))
+                    local_locks[name] = n
+            if isinstance(node, ast.Call):
+                callee = resolve_callee(node)
+                if callee is not None:
+                    info.all_callees.add(callee)
+                    if held:
+                        info.calls.append((held, callee, self.relpath, node.lineno))
+                hit = classify(node)
+                if hit is not None:
+                    info.blocking_any.append(
+                        (hit[0], hit[1], self.relpath, node.lineno)
+                    )
+                    if held:
+                        info.blocking.append(
+                            (held, hit[0], hit[1], self.relpath, node.lineno)
+                        )
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in fn.body:
+            walk(stmt, ())
+
+
+def build_module_model(path: Path, relpath: str) -> ModuleModel | None:
+    try:
+        tree = ast.parse(path.read_text())
+    except SyntaxError:
+        return None
+    w = _ModuleWalker(tree, relpath)
+    model = ModuleModel(relpath, dict(w.locks))
+
+    # fixpoint: transitive lock acquisition + blocking per function. The
+    # callee key for a bare Name call is the plain function name, which
+    # only resolves when such a module-level function exists.
+    acq: dict[str, set[str]] = {q: set(f.direct_acquires) for q, f in w.fns.items()}
+    blk: dict[str, list] = {
+        q: [(c, d, fl, ln, "") for c, d, fl, ln in f.blocking_any]
+        for q, f in w.fns.items()
+    }
+    changed = True
+    iters = 0
+    while changed and iters < 50:
+        changed = False
+        iters += 1
+        for q, f in w.fns.items():
+            # sorted: the surviving `via` attribution for a deduplicated
+            # (category, desc) pair must be deterministic — allowlist
+            # keys are derived from it
+            for callee in sorted(f.all_callees):
+                if callee not in w.fns:
+                    continue
+                if not acq[callee] <= acq[q]:
+                    acq[q] |= acq[callee]
+                    changed = True
+                have = {(c, d) for c, d, *_ in blk[q]}
+                for c, d, fl, ln, via in blk[callee]:
+                    if (c, d) not in have:
+                        blk[q].append((c, d, fl, ln, via or callee))
+                        have.add((c, d))
+                        changed = True
+
+    seen_blocking: set[tuple] = set()
+    for q, f in w.fns.items():
+        model.edges.extend(f.edges)
+        for held, callee, fl, ln in f.calls:
+            if callee not in w.fns:
+                continue
+            for lock in sorted(acq[callee]):
+                for h in held:
+                    model.edges.append(Edge(h, lock, fl, ln, callee))
+            for c, d, bfl, bln, via in blk[callee]:
+                for h in held:
+                    key = (h, c, d, q)
+                    if key not in seen_blocking:
+                        seen_blocking.add(key)
+                        model.blocking.append(
+                            BlockingSite(h, c, d, q, via or callee, fl, ln)
+                        )
+        for held, c, d, fl, ln in f.blocking:
+            for h in held:
+                key = (h, c, d, q)
+                if key not in seen_blocking:
+                    seen_blocking.add(key)
+                    model.blocking.append(BlockingSite(h, c, d, q, "", fl, ln))
+    return model
+
+
+# one dfanalyze run builds the model for lock-order, blocking AND the
+# witness cross-check — parse + fixpoint once per file-set, not three
+# times. Keyed by the file snapshot (path, mtime, size) so tests that
+# rewrite fixture packages in place get a fresh build.
+_model_cache: dict[str, tuple[tuple, list[ModuleModel]]] = {}
+
+
+def build_package_model(package_dir: Path) -> list[ModuleModel]:
+    root = package_dir.parent
+    paths = [
+        p
+        for p in sorted(package_dir.rglob("*.py"))
+        if "__pycache__" not in p.parts
+    ]
+    snapshot = tuple(
+        (p.as_posix(), st.st_mtime_ns, st.st_size)
+        for p in paths
+        for st in [p.stat()]
+    )
+    key = str(package_dir.resolve())
+    cached = _model_cache.get(key)
+    if cached is not None and cached[0] == snapshot:
+        return cached[1]
+    models = []
+    for path in paths:
+        m = build_module_model(path, path.relative_to(root).as_posix())
+        if m is not None:
+            models.append(m)
+    _model_cache.clear()  # keep one entry: runs alternate repo/fixture dirs
+    _model_cache[key] = (snapshot, models)
+    return models

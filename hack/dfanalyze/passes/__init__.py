@@ -1,0 +1,21 @@
+"""Pass registry — ``python -m hack.dfanalyze --list-passes``."""
+
+from __future__ import annotations
+
+from . import blocking, hygiene, lockorder, metrics, typecheck
+
+
+class _Pass:
+    def __init__(self, mod):
+        self.id = mod.ID
+        self.description = (mod.__doc__ or "").strip().splitlines()[0]
+        self.run = mod.run
+
+
+ALL_PASSES = [
+    _Pass(lockorder),
+    _Pass(blocking),
+    _Pass(hygiene),
+    _Pass(metrics),
+    _Pass(typecheck),
+]

@@ -1,0 +1,54 @@
+"""blocking: calls that can wait on the outside world while a lock is held.
+
+A critical section that sleeps, talks gRPC, waits on a queue, does
+file/socket I/O, or dispatches jax work holds every other thread that
+needs the lock for the full duration of that wait — the exact shape that
+turned one wedged scheduler into pile-on stalls before the resilience
+plane, and the reason ``topology/engine.py`` moved its kernel work
+outside the query lock. Categories (see ``lockmodel.classify``):
+
+``sleep`` ``rpc`` ``queue`` ``wait`` ``thread-join`` ``lock-acquire``
+``socket`` ``file-io`` ``jax``
+
+Calls into same-class/module helpers are followed transitively, so a
+lock held around ``self._refresh()`` still surfaces the jax dispatch
+inside it. Audited exceptions (e.g. a storage object whose lock exists
+precisely to serialize its file I/O) go in the allowlist with a comment.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from .. import Finding, PassResult
+from ..lockmodel import build_package_model
+
+ID = "blocking"
+
+
+def run(package_dir: Path) -> PassResult:
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for m in build_package_model(package_dir):
+        for b in m.blocking:
+            lock_short = b.lock.rsplit("::", 1)[-1]
+            # one finding (and one allowlist entry) per call CHAIN, not
+            # per individual call inside it: auditing "flush dispatches
+            # kernels under _flush_lock" covers every kernel in there
+            tail = f"via.{b.via}" if b.via else b.desc
+            key = f"{m.path}:{b.fn}:{lock_short}:{b.category}:{tail}"
+            if key in seen:
+                continue
+            seen.add(key)
+            via = f" (via {b.via}())" if b.via else ""
+            findings.append(
+                Finding(
+                    ID,
+                    key,
+                    b.file,
+                    b.line,
+                    f"{b.category} call {b.desc}() while holding"
+                    f" {lock_short} in {b.fn}{via}",
+                )
+            )
+    return PassResult(ID, findings)

@@ -1,0 +1,190 @@
+"""hygiene: hot-path lints the last three rounds paid review tax for.
+
+- **function-local imports in hot modules** — modules carrying a
+  ``# dfanalyze: hot`` marker are on a per-call path (schedule ops,
+  per-RPC wrappers, per-piece accounting); an ``import`` inside one of
+  their functions is a dict lookup + lock in the steady state and a
+  filesystem walk on the first call, both of which PRs 2–3 repeatedly
+  hand-hoisted. Deliberate lazy imports (heavy deps like jax behind a
+  backend switch, true import cycles) get allowlisted with the reason.
+- **bare ``except: pass`` in loops** — a loop that swallows every
+  exception silently is how a dead socket spins a core or a poison item
+  recirculates forever; name the exception or log it.
+- **fire-and-forget ContextVar ``set()``** — a ``var.set(...)`` whose
+  token is discarded can never be ``reset()``; on a pooled thread the
+  value leaks into whatever request the worker picks up next (the bug
+  class the tracing layer's ``use_span`` exists to prevent).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .. import Finding, PassResult
+
+ID = "hygiene"
+
+HOT_MARKER = "dfanalyze: hot"
+
+
+def _is_except_pass(handler: ast.ExceptHandler) -> bool:
+    if not (len(handler.body) == 1 and isinstance(handler.body[0], ast.Pass)):
+        return False
+    t = handler.type
+    if t is None:
+        return True
+    return isinstance(t, ast.Name) and t.id in ("Exception", "BaseException")
+
+
+def _module_findings(tree: ast.Module, rel: str, text: str) -> list[Finding]:
+    findings: list[Finding] = []
+    hot = HOT_MARKER in text
+
+    def walk(node: ast.AST, qual: str, in_fn: bool, loop_depth: int, ordinal: dict):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{qual}.{child.name}" if qual else child.name
+                walk(child, q, True, 0, ordinal)
+                continue
+            if isinstance(child, ast.ClassDef):
+                q = f"{qual}.{child.name}" if qual else child.name
+                walk(child, q, in_fn, loop_depth, ordinal)
+                continue
+            if isinstance(child, (ast.Import, ast.ImportFrom)) and in_fn and hot:
+                if isinstance(child, ast.Import):
+                    mods = [a.name for a in child.names]
+                else:
+                    mods = [child.module or "."]
+                for mod in mods:
+                    findings.append(
+                        Finding(
+                            ID,
+                            f"import:{rel}:{qual}:{mod}",
+                            rel,
+                            child.lineno,
+                            f"function-local import of {mod} in {qual}() —"
+                            " module is tagged hot; hoist to module scope"
+                            " (or allowlist a deliberate lazy import)",
+                        )
+                    )
+            if isinstance(child, ast.ExceptHandler) and loop_depth > 0:
+                if _is_except_pass(child):
+                    tname = (
+                        "bare"
+                        if child.type is None
+                        else child.type.id  # type: ignore[union-attr]
+                    )
+                    n = ordinal.get((qual, tname), 0)
+                    ordinal[(qual, tname)] = n + 1
+                    suffix = f":{n}" if n else ""
+                    findings.append(
+                        Finding(
+                            ID,
+                            f"except-pass:{rel}:{qual}:{tname}{suffix}",
+                            rel,
+                            child.lineno,
+                            f"`except {'' if child.type is None else tname}:"
+                            f" pass` inside a loop in {qual}() swallows"
+                            " every failure silently — narrow it or log",
+                        )
+                    )
+            next_loop = loop_depth + (
+                1 if isinstance(child, (ast.For, ast.While, ast.AsyncFor)) else 0
+            )
+            walk(child, qual, in_fn, next_loop, ordinal)
+
+    walk(tree, "", False, 0, {})
+
+    # ContextVar discipline: find module-level ContextVars, then flag
+    # set() calls whose token is dropped, and vars set but never reset
+    cvars: set[str] = set()
+    for node in tree.body:
+        targets: list = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, (ast.Name, ast.Attribute))
+        ):
+            chain = value.func.attr if isinstance(value.func, ast.Attribute) else value.func.id
+            if chain == "ContextVar":
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        cvars.add(t.id)
+    if cvars:
+        has_reset: set[str] = set()
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "reset"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in cvars
+            ):
+                has_reset.add(node.func.value.id)
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "set"
+                and isinstance(node.value.func.value, ast.Name)
+                and node.value.func.value.id in cvars
+            ):
+                continue
+            var = node.value.func.value.id
+            findings.append(
+                Finding(
+                    ID,
+                    f"contextvar:{rel}:{var}:discarded",
+                    rel,
+                    node.lineno,
+                    f"ContextVar {var}.set() discards its token — the value"
+                    " can never be reset() and leaks across pooled-thread"
+                    " reuse",
+                )
+            )
+        for var in sorted(cvars - has_reset):
+            sets = [
+                n.lineno
+                for n in ast.walk(tree)
+                if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "set"
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == var
+            ]
+            if sets:
+                findings.append(
+                    Finding(
+                        ID,
+                        f"contextvar:{rel}:{var}:noreset",
+                        rel,
+                        sets[0],
+                        f"ContextVar {var} is set() but never reset() in this"
+                        " module — pooled threads keep the stale value",
+                    )
+                )
+    return findings
+
+
+def run(package_dir: Path) -> PassResult:
+    findings: list[Finding] = []
+    root = package_dir.parent
+    for path in sorted(package_dir.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        text = path.read_text()
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            continue
+        findings.extend(
+            _module_findings(tree, path.relative_to(root).as_posix(), text)
+        )
+    return PassResult(ID, findings)

@@ -1,0 +1,207 @@
+"""lock-order: ABBA-cycle detection over the static lock-acquisition graph.
+
+Builds the per-module lock model (``lockmodel``), merges every module's
+``A held while acquiring B`` edges into one directed graph, and fails on
+
+- **cycles** — two locks acquired in both orders somewhere in the package
+  (the PR 2 shape: ``flush`` took ``_flush_lock -> _lock`` while
+  ``export_records`` took ``_lock -> flush() -> _flush_lock``), and
+- **plain-Lock re-entry** — ``with self._lock`` reached again (directly
+  or via a same-class call chain) while already held, on a
+  non-reentrant ``threading.Lock``.
+
+Cycle findings carry every participating edge with its site and the call
+chain (``via``) that created it. Allowlist keys are canonical node
+sequences, no line numbers, so they survive unrelated edits.
+
+``witness_crosscheck`` is the dynamic half: it loads a lock-witness
+report (``hack/dfanalyze/witness.py`` dumps observed acquisition orders,
+keyed by lock *creation site*), maps observed locks onto static nodes by
+creation site, and re-runs cycle detection over the union graph — orders
+only runtime can see (callbacks, plugin code, cross-object nesting)
+still get caught.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from .. import Finding, PassResult
+from ..lockmodel import Edge, build_package_model
+
+ID = "lock-order"
+
+
+def _canonical_cycle(nodes: list[str]) -> str:
+    """Rotate the cycle so the lexicographically smallest node leads —
+    one stable key per cycle regardless of discovery order."""
+    i = nodes.index(min(nodes))
+    rot = nodes[i:] + nodes[:i]
+    return "->".join(rot + [rot[0]])
+
+
+def _find_cycles(graph: dict[str, set[str]]) -> list[list[str]]:
+    """Simple-cycle enumeration, bounded: lock graphs here are tiny
+    (tens of nodes). Returns each elementary cycle once."""
+    cycles: list[list[str]] = []
+    seen_keys: set[str] = set()
+
+    def dfs(start: str, node: str, path: list[str], visited: set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start and len(path) > 1:
+                key = _canonical_cycle(path)
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    cycles.append(list(path))
+            elif nxt not in visited and nxt > start:
+                # only enumerate cycles whose minimum node is `start`
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def _graph_findings(
+    edges: list[Edge],
+    kinds: dict[str, str],
+    pass_id: str,
+    extra_note: str = "",
+) -> list[Finding]:
+    graph: dict[str, set[str]] = {}
+    evidence: dict[tuple[str, str], Edge] = {}
+    findings: list[Finding] = []
+    for e in edges:
+        if e.src == e.dst:
+            # re-entry: fatal on a plain Lock, by-design on an RLock
+            if kinds.get(e.src) == "lock":
+                key = f"self:{e.src}"
+                if all(f.key != key for f in findings):
+                    via = f" via {e.via}()" if e.via else ""
+                    findings.append(
+                        Finding(
+                            pass_id,
+                            key,
+                            e.file,
+                            e.line,
+                            f"non-reentrant Lock {_short(e.src)} re-acquired while"
+                            f" held{via} — self-deadlock",
+                        )
+                    )
+            continue
+        graph.setdefault(e.src, set()).add(e.dst)
+        evidence.setdefault((e.src, e.dst), e)
+    for cyc in _find_cycles(graph):
+        key = f"cycle:{_canonical_cycle(cyc)}"
+        pairs = list(zip(cyc, cyc[1:] + cyc[:1]))
+        detail = "; ".join(
+            f"{_short(a)}->{_short(b)} at {ev.file}:{ev.line}"
+            + (f" via {ev.via}()" if ev.via else "")
+            for a, b in pairs
+            for ev in [evidence[(a, b)]]
+        )
+        first = evidence[pairs[0]]
+        findings.append(
+            Finding(
+                pass_id,
+                key,
+                first.file,
+                first.line,
+                f"ABBA lock-order cycle{extra_note}: "
+                + " -> ".join(_short(n) for n in cyc + [cyc[0]])
+                + f" ({detail})",
+            )
+        )
+    return findings
+
+
+def _short(node: str) -> str:
+    return node.rsplit("::", 1)[-1]
+
+
+def run(package_dir: Path) -> PassResult:
+    models = build_package_model(package_dir)
+    edges: list[Edge] = []
+    kinds: dict[str, str] = {}
+    for m in models:
+        edges.extend(m.edges)
+        for n, d in m.locks.items():
+            kinds[n] = d.kind
+    return PassResult(ID, _graph_findings(edges, kinds, ID))
+
+
+# -- witness cross-check -----------------------------------------------------
+
+WITNESS_ID = "lock-witness"
+
+
+def witness_crosscheck(package_dir: Path, report_path: Path) -> PassResult:
+    """Union the witnessed (dynamic) acquisition orders with the static
+    graph and re-run cycle detection. Dynamic locks map onto static nodes
+    by creation site; a site the static registry doesn't know keeps its
+    ``file:line`` identity so the finding still names a real place."""
+    if not report_path.is_file():
+        return PassResult(
+            WITNESS_ID, skipped=f"no witness report at {report_path}"
+        )
+    data = json.loads(report_path.read_text())
+    models = build_package_model(package_dir)
+    kinds: dict[str, str] = {}
+    by_site: dict[tuple[str, int], str] = {}
+    edges: list[Edge] = []
+    for m in models:
+        edges.extend(m.edges)
+        for n, d in m.locks.items():
+            kinds[n] = d.kind
+            by_site[(d.file, d.line)] = n
+
+    def site_node(site: str) -> str:
+        # witness sites are "<abspath-or-relpath>:<line>". Normalize on
+        # the LAST "dragonfly2_tpu/" occurrence — a checkout whose
+        # ancestor directory is itself named dragonfly2_tpu must not
+        # unjoin every dynamic lock from its static node
+        path, _, line = site.rpartition(":")
+        rel = path
+        if "dragonfly2_tpu/" in path:
+            rel = "dragonfly2_tpu/" + path.rsplit("dragonfly2_tpu/", 1)[1]
+        try:
+            return by_site.get((rel, int(line)), f"{rel}::{line}")
+        except ValueError:
+            return site
+
+    for entry in data.get("edges", []):
+        src = site_node(entry["from"])
+        dst = site_node(entry["to"])
+        if src == dst:
+            # one instance: RLock re-entry (by design) or impossible for
+            # a plain Lock (acquire would have deadlocked, not recorded);
+            # two instances at one site: the cross-instance loop below
+            continue
+        f, _, ln = entry["from"].rpartition(":")
+        edges.append(Edge(src, dst, f, int(ln or 0), "witness"))
+    findings = _graph_findings(
+        edges, kinds, WITNESS_ID, extra_note=" (static+witnessed)"
+    )
+    # same-site cross-instance nesting: report separately (an RLock does
+    # NOT make this safe — distinct instances are distinct locks)
+    for entry in data.get("edges", []):
+        if not entry.get("same_site"):
+            continue
+        node = site_node(entry["from"])
+        key = f"cross-instance:{node}"
+        if all(x.key != key for x in findings):
+            f, _, ln = entry["from"].rpartition(":")
+            findings.append(
+                Finding(
+                    WITNESS_ID,
+                    key,
+                    f,
+                    int(ln or 0),
+                    f"witness saw two instances of {_short(node)} nested —"
+                    " cross-instance ordering needs an audited hierarchy",
+                )
+            )
+    return PassResult(WITNESS_ID, findings)

@@ -1,0 +1,211 @@
+"""metrics: the registration census — Prometheus series, flight-recorder
+event types, and fault-injection points (the absorbed
+``hack/check_metrics.py``; that script is now a thin shim over this).
+
+Walks the package source for ``.counter(...)``/``.gauge(...)``/
+``.histogram(...)`` calls with a literal name and fails on duplicates,
+kind mismatches, names violating the ``dragonfly_<service>_...``
+convention (counters must end ``_total``), and OpenMetrics family
+collisions (``x`` next to ``x_total``). Flight events must be
+``<service>.<what>``; fault points must be ``<layer>.<what>`` and be
+referenced by at least one test (an unexercised injection point is dead
+chaos surface). ``check()`` keeps the original string-list contract the
+tier-1 test asserts on; ``run()`` adapts it to dfanalyze findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .. import DEFAULT_PACKAGE, Finding, PassResult
+
+ID = "metrics"
+
+PACKAGE = DEFAULT_PACKAGE
+
+# the service segment a series name must start with — one per process
+# role plus the shared rpc glue, flight-recorder, fault-plane and
+# resilience-layer series
+ALLOWED_SERVICES = (
+    "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "flight",
+    "faults", "resilience",
+)
+
+# flight-recorder event names are <service>.<what>; the service segment
+# is the ring category — the process roles plus the cross-layer "rpc"
+# (resilience decisions: retries, breaker trips, sheds) and "faults"
+# (injections) rings, which must not evict any role's own history
+EVENT_SERVICES = (
+    "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "faults",
+)
+
+# fault-point names are <layer>.<what>; mirrors utils/faults.POINT_LAYERS
+FAULT_LAYERS = ("rpc", "daemon", "scheduler", "trainer", "manager", "kv")
+
+TESTS_DIR = PACKAGE.parent / "tests"
+
+KINDS = ("counter", "gauge", "histogram")
+
+
+def _literal_attr_calls(path: Path, attrs) -> list[tuple[str, str, int]]:
+    """(literal-first-arg, attr, lineno) for every attribute call in
+    ``path`` whose attr is in ``attrs`` and whose first arg is a string
+    literal. Only attribute calls are considered (``_r.counter(...)``),
+    which is how every registration in the package is written; local
+    ``Registry("...")`` instances in tests/bench are out of scope, and a
+    forwarder passing a variable (``_plane.point(name)``) never matches."""
+    try:
+        tree = ast.parse(path.read_text())
+    except SyntaxError:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr in attrs):
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            out.append((first.value, fn.attr, node.lineno))
+    return out
+
+
+def _tests_corpus(tests_dir: Path = TESTS_DIR) -> str:
+    """Concatenated test source — the referenced-by-test rule greps
+    fault-point names against this."""
+    if not tests_dir.is_dir():
+        return ""
+    return "\n".join(p.read_text() for p in sorted(tests_dir.glob("*.py")))
+
+
+def check(package_dir: Path = PACKAGE) -> list[str]:
+    """Returns a list of human-readable failures (empty = clean)."""
+    failures: list[str] = []
+    seen: dict[str, tuple[str, str]] = {}  # name -> (kind, site)
+    seen_events: dict[str, str] = {}  # event name -> site
+    seen_points: dict[str, str] = {}  # fault point -> site
+    for path in sorted(package_dir.rglob("*.py")):
+        rel = path.relative_to(package_dir.parent)
+        for name, _attr, lineno in _literal_attr_calls(path, ("point",)):
+            site = f"{rel}:{lineno}"
+            if not all(c.islower() or c.isdigit() or c in "._" for c in name):
+                failures.append(
+                    f"{site}: fault point {name!r} has characters outside"
+                    " [a-z0-9_.]"
+                )
+            layer = name.split(".", 1)[0]
+            if "." not in name or layer not in FAULT_LAYERS:
+                failures.append(
+                    f"{site}: fault point {name!r} must be <layer>.<what>"
+                    f" with layer in {FAULT_LAYERS}"
+                )
+            prev_site = seen_points.get(name)
+            if prev_site is not None:
+                failures.append(
+                    f"{site}: duplicate fault-point registration of {name!r}"
+                    f" (first at {prev_site})"
+                )
+            else:
+                seen_points[name] = site
+        for name, _attr, lineno in _literal_attr_calls(path, ("event_type",)):
+            site = f"{rel}:{lineno}"
+            if not all(c.islower() or c.isdigit() or c in "._" for c in name):
+                failures.append(
+                    f"{site}: event {name!r} has characters outside [a-z0-9_.]"
+                )
+            service = name.split(".", 1)[0]
+            if "." not in name or service not in EVENT_SERVICES:
+                failures.append(
+                    f"{site}: event {name!r} must be <service>.<what> with"
+                    f" service in {EVENT_SERVICES}"
+                )
+            prev_site = seen_events.get(name)
+            if prev_site is not None:
+                failures.append(
+                    f"{site}: duplicate event registration of {name!r}"
+                    f" (first at {prev_site})"
+                )
+            else:
+                seen_events[name] = site
+        for name, kind, lineno in _literal_attr_calls(path, KINDS):
+            site = f"{rel}:{lineno}"
+            if not name.replace("_", "").replace("-", "").isascii() or not all(
+                c.islower() or c.isdigit() or c == "_" for c in name
+            ):
+                failures.append(
+                    f"{site}: {name!r} has characters outside [a-z0-9_]"
+                )
+            service = name.split("_", 1)[0]
+            if service not in ALLOWED_SERVICES:
+                failures.append(
+                    f"{site}: {name!r} does not start with a known service"
+                    f" segment {ALLOWED_SERVICES} (full name is"
+                    f" dragonfly_{name})"
+                )
+            if kind == "counter" and not name.endswith("_total"):
+                failures.append(
+                    f"{site}: counter {name!r} must end in _total"
+                    " (OpenMetrics counter naming)"
+                )
+            prev = seen.get(name)
+            if prev is not None:
+                prev_kind, prev_site = prev
+                if prev_kind != kind:
+                    failures.append(
+                        f"{site}: {name!r} registered as {kind} but"
+                        f" {prev_site} registered it as {prev_kind}"
+                    )
+                else:
+                    failures.append(
+                        f"{site}: duplicate registration of {name!r}"
+                        f" (first at {prev_site})"
+                    )
+            else:
+                seen[name] = (kind, site)
+    # OpenMetrics family collisions: a counter 'x_total' exposes under
+    # family 'x' — a sibling metric literally named 'x' would produce a
+    # duplicate family the strict parser rejects on every scrape
+    for name, (kind, site) in seen.items():
+        if kind == "counter" and name.endswith("_total"):
+            family = name[: -len("_total")]
+            if family in seen:
+                failures.append(
+                    f"{site}: counter {name!r} exposes as OpenMetrics"
+                    f" family {family!r}, colliding with the metric of"
+                    f" that name at {seen[family][1]}"
+                )
+    # referenced-by-test: a fault point the test matrix never arms is
+    # dead chaos surface — the spec grammar accepts it, nothing proves
+    # the layer survives it
+    if seen_points:
+        corpus = _tests_corpus(package_dir.parent / "tests")
+        for name, site in sorted(seen_points.items()):
+            if name not in corpus:
+                failures.append(
+                    f"{site}: fault point {name!r} is not referenced by any"
+                    " test under tests/ (add it to the fault matrix in"
+                    " tests/test_fault_injection.py)"
+                )
+    return failures
+
+
+_SITE_RE = re.compile(r"^(?P<file>[^:]+):(?P<line>\d+): (?P<msg>.*)$", re.S)
+
+
+def run(package_dir: Path) -> PassResult:
+    findings = []
+    for failure in check(package_dir):
+        m = _SITE_RE.match(failure)
+        file, line, msg = (
+            (m.group("file"), int(m.group("line")), m.group("msg"))
+            if m
+            else ("", 0, failure)
+        )
+        key = re.sub(r"[^A-Za-z0-9_.<>'-]+", "-", msg).strip("-")[:100]
+        findings.append(Finding(ID, key, file, line, msg))
+    return PassResult(ID, findings)

@@ -1,0 +1,148 @@
+"""typecheck: mypy as a dfanalyze pass, with a checked-in baseline.
+
+Strict on ``dragonfly2_tpu/utils/`` and ``dragonfly2_tpu/rpc/`` (the
+layers every process links — ``py.typed`` already ships, so their
+annotations are API), permissive elsewhere; configuration lives in
+``hack/dfanalyze/mypy.ini``. The baseline
+(``hack/dfanalyze/baselines/mypy_baseline.txt``) pins the legacy
+violation set: a run only FAILS on lines not in the baseline, so new
+violations are stopped while the legacy debt is tracked and burned down
+deliberately (regenerate with
+``python -m hack.dfanalyze --update-mypy-baseline`` after paying some
+off — shrinking is the only allowed direction of travel).
+
+The container image doesn't bake mypy in (and the no-new-deps rule says
+don't install it): when ``mypy`` isn't importable the pass reports
+SKIPPED and passes — the baseline machinery is exercised by unit tests
+against a stubbed runner either way, so the wiring can't rot while the
+tool is absent.
+"""
+
+from __future__ import annotations
+
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+from .. import Finding, PassResult
+
+ID = "typecheck"
+
+HERE = Path(__file__).resolve().parent.parent
+CONFIG = HERE / "mypy.ini"
+BASELINE = HERE / "baselines" / "mypy_baseline.txt"
+
+# mypy output lines: path:line: error: message  [code]
+_LINE_RE = re.compile(
+    r"^(?P<file>[^:]+\.py):(?P<line>\d+):(?:\d+:)? (?P<sev>error|note):"
+    r" (?P<msg>.*?)(?:  \[(?P<code>[a-z0-9-]+)\])?$"
+)
+
+
+def mypy_available() -> bool:
+    try:
+        import mypy  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def run_mypy(package_dir: Path) -> list[str]:
+    """Raw mypy error lines for the package (notes dropped)."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "mypy",
+            "--config-file",
+            str(CONFIG),
+            str(package_dir),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=str(package_dir.parent),
+    )
+    out = []
+    for line in proc.stdout.splitlines():
+        m = _LINE_RE.match(line.strip())
+        if m and m.group("sev") == "error":
+            out.append(line.strip())
+    return out
+
+
+def normalize(line: str) -> str:
+    """Baseline key: file + error code + message, line number dropped —
+    legacy violations must not churn the baseline when unrelated edits
+    shift them down a few lines."""
+    m = _LINE_RE.match(line)
+    if not m:
+        return line
+    code = m.group("code") or "misc"
+    return f"{m.group('file')}|{code}|{m.group('msg')}"
+
+
+def load_baseline(path: Path = BASELINE) -> set[str]:
+    if not path.is_file():
+        return set()
+    return {
+        ln
+        for ln in path.read_text().splitlines()
+        if ln.strip() and not ln.startswith("#")
+    }
+
+
+def write_baseline(lines: list[str], path: Path = BASELINE) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    header = (
+        "# mypy baseline — legacy violations tracked, new ones fail.\n"
+        "# One normalized line per violation: file|code|message.\n"
+        "# Regenerate (after burning some down):\n"
+        "#   python -m hack.dfanalyze --update-mypy-baseline\n"
+    )
+    path.write_text(header + "\n".join(sorted(set(lines))) + ("\n" if lines else ""))
+
+
+def findings_against_baseline(
+    raw_lines: list[str], baseline: set[str]
+) -> list[Finding]:
+    findings = []
+    for line in raw_lines:
+        norm = normalize(line)
+        if norm in baseline:
+            continue
+        m = _LINE_RE.match(line)
+        file, lineno = (m.group("file"), int(m.group("line"))) if m else ("", 0)
+        key = "mypy:" + re.sub(r"[^A-Za-z0-9_.|-]+", "-", norm)[:120]
+        findings.append(
+            Finding(
+                ID,
+                key,
+                file,
+                lineno,
+                f"new mypy violation (not in baseline): {line}",
+            )
+        )
+    return findings
+
+
+def run(package_dir: Path) -> PassResult:
+    if not mypy_available():
+        return PassResult(
+            ID,
+            skipped="mypy not installed in this image — baseline unchanged"
+            " (pip install mypy locally to run this pass)",
+        )
+    raw = run_mypy(package_dir)
+    return PassResult(ID, findings_against_baseline(raw, load_baseline()))
+
+
+def update_baseline(package_dir: Path) -> int:
+    """--update-mypy-baseline: rewrite the baseline from a fresh run.
+    Returns the number of baselined violations."""
+    if not mypy_available():
+        raise SystemExit("dfanalyze[typecheck]: mypy not installed")
+    raw = run_mypy(package_dir)
+    write_baseline([normalize(l) for l in raw])
+    return len(raw)

@@ -1,0 +1,245 @@
+"""Runtime lock-witness: record the acquisition orders that actually
+happen, so dynamic orders the AST can't see (callbacks, plugin code,
+cross-object nesting) still get caught.
+
+``install()`` replaces ``threading.Lock``/``threading.RLock`` with
+factories that wrap ONLY locks created from source files under the
+package root (creation site sniffed from the caller's frame, once, at
+creation) — stdlib and third-party locks come back raw, so a witnessed
+tier-1 run instruments exactly the package's own locking and nothing
+else. Each wrapped acquire records, for every lock already held by the
+acquiring thread, the ordered pair ``held-site -> acquired-site``; the
+creation site (``file:line`` of the ``threading.Lock()`` call) is the
+join key the static pass uses to map observed pairs onto its lock nodes
+(``dfanalyze --witness-report``).
+
+Same-site pairs are kept with a ``same_site`` marker when the two locks
+are *distinct instances* from one creation site (two conductors' locks
+nested) — an order a per-class static graph cannot express and a real
+deadlock shape; plain re-entry of one RLock instance is dropped.
+
+Opt-in: ``DF_LOCK_WITNESS=1`` makes ``tests/conftest.py`` call
+``install()`` before the package imports and dump the report to
+``DF_LOCK_WITNESS_OUT`` (default ``dfanalyze-witness.json``) at session
+end. The emit path is a few dict operations per acquire; the report is
+bounded by the number of distinct (site, site) pairs.
+"""
+
+from __future__ import annotations
+
+import _thread
+import json
+import os
+import sys
+import threading
+
+_raw_lock = _thread.allocate_lock
+_raw_rlock = threading.RLock  # the C implementation behind threading.RLock
+
+_state_lock = _thread.allocate_lock()
+_tls = threading.local()
+
+_installed = False
+_package_roots: tuple[str, ...] = ()
+_edges: dict[tuple[str, str], bool] = {}  # (held, acquired) -> same_site seen
+_locks: dict[str, dict] = {}  # site -> {"kind": ..., "instances": n}
+
+
+def _held_stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _record(site: str, kind: str) -> None:
+    with _state_lock:  # creation is rare; the count must not race
+        info = _locks.setdefault(site, {"kind": kind, "instances": 0})
+        info["instances"] += 1
+
+
+def _note_acquired(wrapper) -> None:
+    stack = _held_stack()
+    if any(h._freed for h in stack):
+        # a lock this thread acquired was released by ANOTHER thread
+        # (legal for threading.Lock — the hand-off pattern): purge it, or
+        # every later acquire here records phantom "still held" pairs
+        stack[:] = [h for h in stack if not h._freed]
+    for held in stack:
+        key = (held._site, wrapper._site)
+        same = held._site == wrapper._site and held is not wrapper
+        cur = _edges.get(key)
+        if cur is None or (same and not cur):
+            with _state_lock:
+                _edges[key] = _edges.get(key, False) or same
+    stack.append(wrapper)
+
+
+def _note_released(wrapper) -> None:
+    stack = _held_stack()
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i] is wrapper:
+            del stack[i]
+            return
+
+
+class _WitnessLock:
+    """threading.Lock twin; supports Condition's duck-typing surface."""
+
+    __slots__ = ("_raw", "_site", "_freed")
+
+    def __init__(self, site: str):
+        self._raw = _raw_lock()
+        self._site = site
+        self._freed = False
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            self._freed = False
+            _note_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        # releases may come from a DIFFERENT thread than the acquirer
+        # (legal for Lock): flag first so the acquirer's held-stack entry
+        # is purged at its next acquire even when the pop below misses
+        self._freed = True
+        _note_released(self)
+        self._raw.release()
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<WitnessLock {self._site} {self._raw!r}>"
+
+
+class _WitnessRLock:
+    __slots__ = ("_raw", "_site", "_owner", "_count", "_freed")
+
+    def __init__(self, site: str):
+        self._raw = _raw_rlock()
+        self._site = site
+        self._owner = None
+        self._count = 0
+        self._freed = False
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            me = _thread.get_ident()
+            if self._owner == me:
+                self._count += 1  # re-entry: not a new hold for ordering
+            else:
+                self._owner = me
+                self._count = 1
+                self._freed = False
+                _note_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        me = _thread.get_ident()
+        if self._owner == me:
+            self._count -= 1
+            if self._count == 0:
+                self._owner = None
+                self._freed = True
+                _note_released(self)
+        self._raw.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # Condition support
+    def _is_owned(self) -> bool:
+        return self._owner == _thread.get_ident()
+
+    def __repr__(self) -> str:
+        return f"<WitnessRLock {self._site} {self._raw!r}>"
+
+
+def _site_of_caller() -> str | None:
+    f = sys._getframe(1)
+    # frame 1 is the factory below's caller already resolved by callers
+    while f is not None and f.f_code.co_filename == __file__:
+        f = f.f_back
+    if f is None:
+        return None
+    fn = f.f_code.co_filename
+    for root in _package_roots:
+        if root in fn:
+            return f"{fn}:{f.f_lineno}"
+    return None
+
+
+def _lock_factory():
+    site = _site_of_caller()
+    if site is None:
+        return _raw_lock()
+    _record(site, "lock")
+    return _WitnessLock(site)
+
+
+def _rlock_factory():
+    site = _site_of_caller()
+    if site is None:
+        return _raw_rlock()
+    _record(site, "rlock")
+    return _WitnessRLock(site)
+
+
+def install(package_roots: tuple[str, ...] = ("dragonfly2_tpu/",)) -> None:
+    """Patch the threading factories. Call BEFORE the package imports —
+    module-level locks (registries) are created at import time."""
+    global _installed, _package_roots
+    if _installed:
+        return
+    _package_roots = tuple(package_roots)
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _raw_lock
+    threading.RLock = _raw_rlock
+    _installed = False
+
+
+def active() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    with _state_lock:
+        _edges.clear()
+        _locks.clear()
+
+
+def snapshot() -> dict:
+    with _state_lock:
+        return {
+            "locks": {s: dict(v) for s, v in _locks.items()},
+            "edges": [
+                {"from": a, "to": b, "same_site": same}
+                for (a, b), same in sorted(_edges.items())
+            ],
+        }
+
+
+def dump(path: str | None = None) -> str:
+    path = path or os.environ.get("DF_LOCK_WITNESS_OUT", "dfanalyze-witness.json")
+    with open(path, "w") as f:
+        json.dump(snapshot(), f, indent=2, sort_keys=True)
+    return path

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# hack/lint.sh — the single entry point builders and reviewers run before
+# pushing: dfanalyze (lock-order, blocking-under-lock, hygiene, metrics
+# census, mypy baseline), the legacy check_metrics shim, and a pytest
+# collection smoke. Exits nonzero on any regression.
+#
+# The collection smoke tolerates ONLY the known environment-caused
+# collection errors (modules this image can't import: cryptography,
+# jax.shard_map/pallas — see ROADMAP "pre-existing env failures"); any
+# NEW file failing collection fails the lint.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== dfanalyze (static passes)"
+python -m hack.dfanalyze
+
+echo "== check_metrics (legacy shim entry point)"
+python hack/check_metrics.py
+
+echo "== pytest collection smoke"
+KNOWN_ENV_ERRORS="tests/test_cert_issuance.py tests/test_ops.py tests/test_security.py tests/test_trainer.py"
+out=$(JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --collect-only \
+    --continue-on-collection-errors -p no:cacheprovider 2>&1) || true
+new_errors=0
+while read -r f; do
+    case " $KNOWN_ENV_ERRORS " in
+        *" $f "*) ;;
+        *) echo "lint.sh: NEW collection error in $f"; new_errors=1 ;;
+    esac
+done < <(printf '%s\n' "$out" | grep -aE '^ERROR tests/' | awk '{print $2}' | sort -u)
+# -q collect output is one "tests/test_x.py: N" line per module
+collected=$(printf '%s\n' "$out" | grep -aE '^tests/[a-z0-9_]+\.py: [0-9]+$' \
+    | awk -F': ' '{s+=$2} END {print s+0}')
+echo "lint.sh: $collected test nodes collected"
+if [ "$collected" -lt 400 ]; then
+    # tier-1 collects 600+; a hard drop means collection itself broke
+    echo "lint.sh: collection regressed (expected >= 400 nodes)"
+    printf '%s\n' "$out" | tail -20
+    exit 1
+fi
+if [ "$new_errors" -ne 0 ]; then
+    exit 1
+fi
+
+echo "lint.sh: all clean"

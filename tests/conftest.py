@@ -26,7 +26,37 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Opt-in runtime lock-witness (hack/dfanalyze/witness.py): DF_LOCK_WITNESS=1
+# wraps every threading.Lock/RLock *created by package code* so the tier-1
+# run records real acquisition orders; the session-finish hook dumps them
+# to DF_LOCK_WITNESS_OUT (default dfanalyze-witness.json) for
+#   python -m hack.dfanalyze --witness-report <dump>
+# to cross-check against the static lock graph. Must install before the
+# package imports: module-level locks are created at import time.
+def _witness_enabled() -> bool:
+    # same off-values as the other DF_* flags (utils/flight.py): "0",
+    # "false", "no" disable — exporting DF_LOCK_WITNESS=0 must not
+    # install the witness
+    return os.environ.get("DF_LOCK_WITNESS", "").lower() not in (
+        "", "0", "false", "no",
+    )
+
+
+if _witness_enabled():
+    from hack.dfanalyze import witness as _lock_witness  # noqa: E402
+
+    _lock_witness.install()
+
 import pytest  # noqa: E402
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _witness_enabled():
+        from hack.dfanalyze import witness as _w
+
+        if _w.active():
+            path = _w.dump()
+            print(f"\nlock-witness: acquisition orders dumped to {path}")
 
 
 @pytest.fixture(scope="session")
