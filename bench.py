@@ -354,6 +354,19 @@ def chaos_soak_bench() -> dict:
     return chaos_soak(downloads=4, piece=16 * 1024, deadline_s=30.0)
 
 
+def fleet_shard_kill_bench() -> dict:
+    """The scheduler-fleet failover soak (tools/stress.shard_kill_soak)
+    at bench scale: 3 real scheduler shards under KV leases, a
+    simulated-peer announce load, one shard SIGKILL'd mid-load.
+    ``fleet_success_rate`` must be 1.0 with zero hangs and
+    ``fleet_blackout_ms`` bounded by one lease TTL + one membership poll
+    — the fleet's acceptance check, re-proven on every bench run, with
+    aggregate ``schedule_ops_per_s`` as the scale-out headline."""
+    from dragonfly2_tpu.tools.stress import shard_kill_soak
+
+    return shard_kill_soak(peers=150, shards=3, workers=12)
+
+
 def tracing_overhead_bench(iters: int = 1000, trials: int = 5) -> dict:
     """Tracing cost on the scheduling hot path when nothing samples.
 
@@ -631,6 +644,20 @@ def main() -> None:
         except Exception as e:
             host_rates["chaos_error"] = str(e)
             _phase(f"chaos soak failed: {e}")
+        # fleet shard-kill soak: 3 scheduler shards under KV leases, one
+        # SIGKILL'd mid announce load — success rate, blackout ms, and
+        # aggregate schedule ops/s ride every exit path
+        try:
+            host_rates.update(fleet_shard_kill_bench())
+            _phase(
+                f"fleet shard-kill: success {host_rates['fleet_success_rate']:.2f}"
+                f" hangs {host_rates['fleet_hangs']}"
+                f" blackout {host_rates['fleet_blackout_ms']:.0f}ms"
+                f" ({host_rates['schedule_ops_per_s']:.0f} schedule ops/s)"
+            )
+        except Exception as e:
+            host_rates["fleet_error"] = str(e)
+            _phase(f"fleet shard-kill soak failed: {e}")
         _phase(
             f"host split: decode(binary) {decode_only_rate_binary / 1e3:.1f}k/s,"
             f" decode(csv) {host_rates.get('decode_only_rate_csv', 0) / 1e3:.1f}k/s,"

@@ -28,6 +28,7 @@ import dfdaemon_pb2  # noqa: E402
 import scheduler_pb2  # noqa: E402
 
 from dragonfly2_tpu.rpc import glue, resilience
+from dragonfly2_tpu.scheduler import fleet
 from dragonfly2_tpu.utils import tracing
 
 from dragonfly2_tpu.client.downloader import PieceDownloadError
@@ -62,6 +63,7 @@ EV_PIECE_FAILED = flight.event_type("daemon.piece_failed")
 EV_PARENT_BLOCKED = flight.event_type("daemon.parent_blocked")
 EV_RESCHEDULE = flight.event_type("daemon.reschedule")
 EV_ANNOUNCE_RECONNECT = flight.event_type("daemon.announce_reconnect")
+EV_WRONG_SHARD_REPICK = flight.event_type("daemon.wrong_shard_repick")
 
 
 @dataclass
@@ -94,6 +96,13 @@ class ConductorOptions:
     # scheduler's incident instead of paying an origin round trip for it
     stream_reconnect_attempts: int = 3
     stream_reconnect_backoff: float = 0.2
+    # WRONG_SHARD retry budget (docs/fleet.md): a refused announce
+    # re-picks from the refreshed ring for this long before the regular
+    # reconnect/back-to-source ladder takes over. Sized to cover one
+    # lease TTL + one membership poll — the window in which a SIGKILL'd
+    # owner is still leased and every member keeps pointing at it
+    wrong_shard_retry_window: float = 15.0
+    wrong_shard_backoff: float = 0.1
 
 
 class PeerTaskConductor:
@@ -151,6 +160,15 @@ class PeerTaskConductor:
         self._stream_thread: threading.Thread | None = None
         self._run_thread: threading.Thread | None = None
         self._stream_reconnects = 0
+        self._wrong_shard_deadline = 0.0
+        self._wrong_shard_retries = 0
+        self._owner_hint = ""  # WRONG_SHARD told us who owns the shard
+        self._outage_started = 0.0  # announce-plane blackout clock
+        # members this conductor's streams just failed against: a cached
+        # channel to a dead scheduler fails at CALL time, not dial time,
+        # so the selector needs this feedback to walk past it
+        self._avoid_addrs: set[str] = set()
+        self._last_sched_addr = ""
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -222,17 +240,49 @@ class PeerTaskConductor:
             )
         )
 
+    def _resolve_scheduler(self):
+        """The client for THIS stream attempt. A multi-scheduler selector
+        re-resolves per connect — the fleet ring moves at runtime, and a
+        reconnect after an owner move must land on the new owner, not the
+        member the conductor was born under. A WRONG_SHARD owner hint
+        (when fresher than our ring) wins outright."""
+        sched = self.scheduler
+        if not hasattr(sched, "for_task"):
+            return sched  # plain single-scheduler client
+        if self._owner_hint and hasattr(sched, "client_for"):
+            hint, self._owner_hint = self._owner_hint, ""
+            # never chase a hint into a member we just failed against:
+            # during a failover the whole fleet keeps naming the dead
+            # owner until its lease expires
+            if hint not in self._avoid_addrs:
+                try:
+                    client = sched.client_for(hint)
+                    self._last_sched_addr = hint
+                    return client
+                except Exception as e:
+                    logger.warning(
+                        "wrong-shard owner hint %s undialable: %s", hint, e
+                    )
+        if hasattr(sched, "resolve_for_task"):
+            addr, client = sched.resolve_for_task(
+                self.task_id, avoid=self._avoid_addrs
+            )
+            self._last_sched_addr = addr
+            return client
+        return sched.for_task(self.task_id)
+
     def _stream_loop(self) -> None:
         """Own thread: consumes scheduler responses, queues decisions for
         the run loop (reference receivePeerPacket :659)."""
         requests = self._requests  # bound once, before any later swap
         try:
             FP_ANNOUNCE_STREAM()
+            client = self._resolve_scheduler()
             # the peer_task span is this thread's context for the
             # AnnouncePeer call, so the scheduler's rpc.AnnouncePeer span
             # (and its scheduling children) join the download's trace
             with tracing.use_span(getattr(self, "_span", None)):
-                responses = self.scheduler.AnnouncePeer(self._req_iter(requests))
+                responses = client.AnnouncePeer(self._req_iter(requests))
             for resp in responses:
                 which = resp.WhichOneof("response")
                 self._decisions.put((which, getattr(resp, which)))
@@ -276,6 +326,22 @@ class PeerTaskConductor:
             try:
                 which, body = self._decisions.get(timeout=self.opts.schedule_timeout)
                 EV_PEER_DECISION(peer_id=self.peer_id, decision=which)
+                if which != "stream_error":
+                    self._avoid_addrs.clear()  # the member we're on works
+                    # a later failover gets its own retry window AND its
+                    # own backoff ramp — the budget bounds one outage,
+                    # not the task's lifetime
+                    self._wrong_shard_deadline = 0.0
+                    self._wrong_shard_retries = 0
+                    if self._outage_started:
+                        # announce plane recovered: the blackout is the
+                        # gap from first stream error to this decision
+                        fleet.BLACKOUT_MS.observe(
+                            (time.monotonic() - self._outage_started) * 1e3
+                        )
+                        self._outage_started = 0.0
+                elif not self._outage_started:
+                    self._outage_started = time.monotonic()
             except queue.Empty:
                 EV_PEER_DECISION(peer_id=self.peer_id, decision="schedule_timeout")
                 # No decision in time: back-source if allowed, else fail
@@ -319,6 +385,22 @@ class PeerTaskConductor:
                     return
                 continue  # rescheduled — wait for next decision
             if which == "stream_error":
+                # WRONG_SHARD refusal (fleet sharding, docs/fleet.md):
+                # this member isn't the task's ring owner — refresh
+                # membership, re-pick, and resume with the same peer_id.
+                # Its retry budget is time-based and separate from the
+                # reconnect attempts: during a failover the whole fleet
+                # may point at a still-leased dead owner until the lease
+                # expires, and those refusals must not burn the budget
+                # that guards against a genuinely broken scheduler.
+                ws = fleet.parse_wrong_shard(str(body))
+                if ws is not None and self._wrong_shard_repick(*ws):
+                    continue
+                if ws is None and self._last_sched_addr:
+                    # a wire-dead member, not a refusal: route the next
+                    # resolve past it (its cached channel can't raise at
+                    # resolve time, only here)
+                    self._avoid_addrs.add(self._last_sched_addr)
                 # resilience: re-open the stream and re-register before
                 # giving up — pieces already on disk are resumed by
                 # _download_from_parents, and the scheduler re-dispatches
@@ -333,6 +415,22 @@ class PeerTaskConductor:
                 return
 
     # ------------------------------------------------------------------
+    def _restart_stream(self, tag: str) -> None:
+        """Swap in a fresh request queue + stream thread and re-register
+        with the SAME peer_id (shared by reconnect and wrong-shard
+        re-pick so the two resume paths can never drift). The old
+        stream's feeder is released first — gRPC's sender thread may
+        still be blocked on the old queue."""
+        self._requests.put(None)
+        self._requests = queue.Queue()
+        self._stream_thread = threading.Thread(
+            target=self._stream_loop,
+            name=f"announce-{self.peer_id[:8]}-{tag}",
+            daemon=True,
+        )
+        self._stream_thread.start()
+        self._send(register_peer=self._register_request())
+
     def _reconnect_stream(self, cause: str) -> bool:
         """Announce-stream resume: jittered wait, fresh request queue, a
         new stream thread, and a re-register carrying the same peer_id.
@@ -354,17 +452,52 @@ class PeerTaskConductor:
                 attempt - 1, base_s=self.opts.stream_reconnect_backoff, cap_s=2.0
             )
         )
-        # release the dead stream's request feeder (gRPC's sender thread
-        # may still be blocked on the old queue), then swap in a fresh one
-        self._requests.put(None)
-        self._requests = queue.Queue()
-        self._stream_thread = threading.Thread(
-            target=self._stream_loop,
-            name=f"announce-{self.peer_id[:8]}-r{attempt}",
-            daemon=True,
+        self._restart_stream(f"r{attempt}")
+        return True
+
+    def _wrong_shard_repick(self, owner: str, ring_version: int) -> bool:
+        """WRONG_SHARD retry: refresh membership, detect staleness via
+        the ring version, adopt the refuser's owner hint when our ring
+        did NOT move (the refusal came from a fresher view than ours),
+        and resume the stream on the re-picked member. Time-bounded, not
+        attempt-bounded — see the _drive caller."""
+        now = time.monotonic()
+        if self._wrong_shard_deadline == 0.0:
+            self._wrong_shard_deadline = now + self.opts.wrong_shard_retry_window
+        if now >= self._wrong_shard_deadline:
+            logger.warning(
+                "wrong-shard retries for %s exhausted after %.1fs",
+                self.peer_id, self.opts.wrong_shard_retry_window,
+            )
+            return False
+        self._wrong_shard_retries += 1
+        fleet.WRONG_SHARD_TOTAL.labels("daemon").inc()
+        sched = self.scheduler
+        refreshed = False
+        if hasattr(sched, "refresh_membership"):
+            refreshed = sched.refresh_membership()
+        if not refreshed and owner and owner not in self._avoid_addrs:
+            # our ring didn't move: the refuser knows something our
+            # membership feed hasn't delivered yet — believe its hint
+            # (unless it names a member we've already failed against:
+            # then the hint is the still-leased corpse, and the right
+            # move is to keep riding the retry window until it expires)
+            self._owner_hint = owner
+        EV_WRONG_SHARD_REPICK(
+            peer_id=self.peer_id,
+            owner=owner,
+            ring_version=ring_version,
+            attempt=self._wrong_shard_retries,
+            ring_refreshed=refreshed,
         )
-        self._stream_thread.start()
-        self._send(register_peer=self._register_request())
+        time.sleep(
+            resilience.full_jitter_backoff(
+                min(self._wrong_shard_retries - 1, 4),
+                base_s=self.opts.wrong_shard_backoff,
+                cap_s=1.0,
+            )
+        )
+        self._restart_stream(f"ws{self._wrong_shard_retries}")
         return True
 
     # ------------------------------------------------------------------

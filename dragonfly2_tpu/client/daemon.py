@@ -50,6 +50,13 @@ class DaemonConfig:
     # scoped); empty = static scheduler_address only
     manager_address: str = ""
     dynconfig_interval: float = 300.0
+    # shared KV for scheduler-fleet membership (scheduler/fleet.py,
+    # docs/fleet.md): when set, the daemon follows the fleet's leased
+    # member set directly — the ring reconciles within one poll of a
+    # join/leave/death instead of waiting out a dynconfig interval
+    kv_address: str = ""
+    kv_secret: str = ""
+    fleet_poll_interval: float = 1.0
     # client-side roots (and optional mTLS pair) for the manager dial —
     # same shape as the scheduler/trainer manager clients
     manager_tls_ca_file: str = ""
@@ -158,6 +165,8 @@ class Daemon:
         self._stop = threading.Event()
         self._dynconfig = None
         self._manager_channel = None
+        self._fleet_kv = None
+        self._fleet_watcher = None
         self._threads: list[threading.Thread] = []
         self.gc = GC()
         self.task_manager: TaskManager | None = None
@@ -246,6 +255,26 @@ class Daemon:
                 )
             )
             self._dynconfig.start()
+        if self.cfg.kv_address:
+            # live fleet membership (docs/fleet.md): the leased member
+            # set in the shared KV feeds the selector's ring, and the
+            # watcher doubles as the WRONG_SHARD retry's pull-now source
+            from dragonfly2_tpu.scheduler.fleet import FleetWatcher
+            from dragonfly2_tpu.utils import kvstore
+
+            self._fleet_kv = kvstore.RemoteKVStore(
+                self.cfg.kv_address, secret=self.cfg.kv_secret
+            )
+            self._fleet_watcher = FleetWatcher(
+                self._fleet_kv,
+                self._selector.update_addresses,
+                poll_interval=self.cfg.fleet_poll_interval,
+            )
+            self._selector.set_membership_source(self._fleet_watcher.read_members)
+            # adopt whatever is leased right now; the static list stays
+            # as bootstrap when no member has joined yet
+            self._fleet_watcher.poll_once()
+            self._fleet_watcher.start()
         # fail fast when no scheduler is reachable; NOT pinned — the
         # probe loop re-resolves the primary per round because dynconfig
         # membership changes can close any cached channel
@@ -416,6 +445,10 @@ class Daemon:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._fleet_watcher is not None:
+            self._fleet_watcher.stop()
+        if self._fleet_kv is not None:
+            self._fleet_kv.close()
         if self._dynconfig is not None:
             self._dynconfig.stop()
         if self._manager_channel is not None:

@@ -119,7 +119,11 @@ class TaskManager:
                 url=req.url,
                 url_meta=url_meta,
                 storage=self.storage,
-                scheduler_client=self._scheduler_for(task_id),
+                # the selector itself, not a resolved client: the
+                # conductor re-resolves the task's ring owner per stream
+                # connect, so fleet membership moves (WRONG_SHARD
+                # re-pick, successor failover) land mid-task
+                scheduler_client=self.scheduler,
                 piece_manager=self.pm,
                 options=opts,
                 task_type=req.task_type,

@@ -171,6 +171,10 @@ class ManagerServer:
                 else self.cfg.kv_host
             )
             self.kv_addr = f"{advertise}:{kv_port}"
+            # scheduler-fleet view: the embedded KV is where fleet
+            # leases live, so the dynconfig scheduler list can scope to
+            # live members (ManagerService._fleet_members)
+            self.service.fleet_kv = self._kv.store
             logger.info(
                 "manager kv (RESP) bound %s:%d, advertising %s",
                 self.cfg.kv_host, kv_port, self.kv_addr,

@@ -26,7 +26,14 @@ KEEPALIVE_TIMEOUT = 60.0
 
 
 class ManagerService:
-    def __init__(self, db: Database, models: ModelRegistry, ca=None, ca_token: str = ""):
+    def __init__(
+        self,
+        db: Database,
+        models: ModelRegistry,
+        ca=None,
+        ca_token: str = "",
+        fleet_kv=None,
+    ):
         from dragonfly2_tpu.manager.searcher import new_searcher
 
         self.db = db
@@ -39,6 +46,13 @@ class ManagerService:
         # — dev mode only; production sets one)
         self.ca = ca
         self.ca_token = ca_token
+        # scheduler-fleet view (scheduler/fleet.py): a KV store holding
+        # the fleet's leased member set — when live leases exist, the
+        # dynconfig scheduler list is scoped to them, so daemons polling
+        # the manager also converge within one lease TTL of a member
+        # death instead of the 60s keepalive timeout. None/empty fleet →
+        # the keepalive-based registry stands alone (compat).
+        self.fleet_kv = fleet_kv
 
     # -- scheduler registry ------------------------------------------------
     def UpdateScheduler(self, request, context):
@@ -86,6 +100,26 @@ class ManagerService:
             scoped = [r for r in rows if r["scheduler_cluster_id"] == cluster.id]
             if scoped:
                 rows = scoped
+        live = self._fleet_members()
+        if live:
+            # fleet view in dynconfig: only members holding a live lease
+            # are handed to daemons. An empty/unreadable lease plane
+            # falls through to the keepalive registry — a KV outage must
+            # not strand every daemon schedulerless.
+            leased = [r for r in rows if f"{r['ip']}:{r['port']}" in live]
+            if leased:
+                rows = leased
+            elif rows:
+                # leases exist but match NO registered row: an
+                # address-mismatch misconfiguration (lease advertises a
+                # port the registration didn't carry) that silently
+                # disables fast convergence — say so instead
+                logger.warning(
+                    "fleet leases %s match no registered scheduler %s;"
+                    " serving the keepalive registry unscoped",
+                    sorted(live),
+                    sorted(f"{r['ip']}:{r['port']}" for r in rows),
+                )
         return manager_pb2.ListSchedulersResponse(
             schedulers=[
                 manager_pb2.Scheduler(
@@ -124,6 +158,17 @@ class ManagerService:
             clusters,
             PeerInfo(ip=request.ip, idc=request.idc, location=request.location),
         )
+
+    def _fleet_members(self) -> "set[str] | None":
+        if self.fleet_kv is None:
+            return None
+        try:
+            from dragonfly2_tpu.scheduler.fleet import read_members
+
+            return set(read_members(self.fleet_kv))
+        except Exception as e:
+            logger.warning("fleet membership read failed: %s", e)
+            return None
 
     def _expire_stale(self) -> None:
         cutoff = time.time() - KEEPALIVE_TIMEOUT

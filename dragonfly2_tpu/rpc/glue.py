@@ -602,38 +602,88 @@ def dial(
 # ---------------------------------------------------------------------------
 
 
+def _ring_hash(s: str) -> int:
+    return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "big")
+
+
 class ConsistentHashRing:
     """Pins a task ID to one scheduler across a multi-scheduler cluster
     (reference pkg/balancer/consistent_hashing.go:33-38) — every peer
     announcing task T talks to the same scheduler, so that scheduler sees
-    the whole swarm for T."""
+    the whole swarm for T.
+
+    Mutations bump ``version`` (monotonic): the scheduler fleet's
+    WRONG_SHARD retry loop compares versions to tell "my membership was
+    stale and refreshing fixed it" from "the refusal came from a view I
+    already hold" (scheduler/fleet.py, docs/fleet.md). A per-address
+    vnode-hash index makes membership checks O(1) and ``add``
+    idempotent without re-hashing; ``remove`` is one filtered pass over
+    the flat ring — with a Python list that moves fewer elements than
+    per-vnode bisect+pop would (each pop memmoves the tail, ~VNODES·R/2
+    moves vs R), and never re-hashes anything."""
 
     VNODES = 100
 
     def __init__(self, addresses: list[str] | None = None):
-        self._hash = lambda s: int.from_bytes(
-            hashlib.md5(s.encode()).digest()[:8], "big"
-        )
         self._ring: list[tuple[int, str]] = []
+        self._vnodes: dict[str, list[int]] = {}  # addr → its vnode hashes
+        self.version = 0
         for addr in addresses or []:
             self.add(addr)
 
+    def __contains__(self, address: str) -> bool:
+        return address in self._vnodes
+
+    def __len__(self) -> int:
+        return len(self._vnodes)
+
+    def addresses(self) -> list[str]:
+        return list(self._vnodes)
+
     def add(self, address: str) -> None:
-        for v in range(self.VNODES):
-            h = self._hash(f"{address}#{v}")
+        if address in self._vnodes:
+            return  # idempotent: a re-add must not double the vnodes
+        hashes = [_ring_hash(f"{address}#{v}") for v in range(self.VNODES)]
+        self._vnodes[address] = hashes
+        for h in hashes:
             bisect.insort(self._ring, (h, address))
+        self.version += 1
 
     def remove(self, address: str) -> None:
-        self._ring = [(h, a) for h, a in self._ring if a != address]
+        if self._vnodes.pop(address, None) is None:
+            return  # unknown member: no-op, no version bump
+        self._ring = [e for e in self._ring if e[1] != address]
+        self.version += 1
 
     def pick(self, key: str) -> str:
         if not self._ring:
             raise ValueError("no addresses in the ring")
-        h = self._hash(key)
+        h = _ring_hash(key)
         i = bisect.bisect_left(self._ring, (h, ""))
         if i == len(self._ring):
             i = 0
         return self._ring[i][1]
+
+    def successors(self, key: str, limit: int = 0) -> list[str]:
+        """Distinct addresses in ring order starting at ``key``'s owner —
+        element 0 is ``pick(key)``, the rest are the failover order a
+        member death hands the key to (bounded hand-off: only keys whose
+        owner died move, and they move to their successor)."""
+        if not self._ring:
+            return []
+        h = _ring_hash(key)
+        i = bisect.bisect_left(self._ring, (h, ""))
+        out: list[str] = []
+        seen: set[str] = set()
+        n = len(self._ring)
+        for step in range(n):
+            addr = self._ring[(i + step) % n][1]
+            if addr not in seen:
+                seen.add(addr)
+                out.append(addr)
+                if limit and len(out) >= limit:
+                    break
+        return out
 
 
 def serve_tls_args(
@@ -726,6 +776,10 @@ class SchedulerSelector:
         self._clients: dict[str, ServiceClient] = {}
         self._fail_until: dict[str, float] = {}
         self._lock = threading.Lock()
+        # optional live-membership feed (scheduler/fleet.py watcher):
+        # () -> list[str] of currently-leased scheduler addresses, pulled
+        # on demand by the WRONG_SHARD retry path
+        self._membership_source: "Callable[[], list[str]] | None" = None
 
     def _client(self, addr: str) -> ServiceClient:
         with self._lock:
@@ -791,17 +845,113 @@ class SchedulerSelector:
         for ch in dead_channels:
             ch.close()
 
+    # -- live-membership hooks (scheduler fleet, docs/fleet.md) ---------
+    def set_membership_source(self, fn) -> None:
+        """Wire a ``() -> list[str]`` returning the currently-leased
+        scheduler addresses (the daemon's fleet watcher). The WRONG_SHARD
+        retry loop pulls it to reconcile NOW instead of waiting out the
+        next poll tick."""
+        self._membership_source = fn
+
+    def refresh_membership(self) -> bool:
+        """Pull live membership once and reconcile the ring; True when
+        the ring actually changed (the retry loop's staleness signal: an
+        unchanged version means the refusal didn't come from membership
+        lag on this side)."""
+        fn = self._membership_source
+        if fn is None:
+            return False
+        before = self.ring_version()
+        try:
+            members = fn()
+        except Exception as e:
+            dflog.get("rpc.selector").warning("membership refresh failed: %s", e)
+            return False
+        if members:
+            self.update_addresses(members)
+            with self._lock:
+                # a live lease is fresh evidence the member is worth
+                # dialing again: without this, one transient dial blip
+                # puts a healthy owner in FAIL_COOLDOWN (60s) — far past
+                # the wrong-shard retry window — and every task it owns
+                # falls to back-to-source from this daemon
+                for addr in members:
+                    self._fail_until.pop(addr, None)
+        return self.ring_version() != before
+
+    def ring_version(self) -> int:
+        with self._lock:
+            return self.ring.version
+
+    def ensure_address(self, address: str) -> None:
+        """Adopt one address into the set (WRONG_SHARD owner hint: the
+        refusing scheduler told us who owns the shard — believe it even
+        before the membership poll catches up)."""
+        address = address.strip()
+        if not address:
+            return
+        with self._lock:
+            if address in self.ring:
+                return
+            self.ring.add(address)
+            self.addresses = self.addresses + [address]
+
+    def client_for(self, address: str) -> ServiceClient:
+        """Client for one specific member (WRONG_SHARD owner hint path);
+        adopts the address into the set first so the ring agrees with
+        where traffic actually goes. The hint is authoritative — the
+        refusing scheduler just vouched for the owner's lease — so any
+        dial-failure cooldown on it is cleared rather than honored."""
+        self.ensure_address(address)
+        with self._lock:
+            self._fail_until.pop(address, None)
+        return self._client(address)
+
+    def resolve_for_task(
+        self, task_id: str, avoid: "set[str] | None" = None
+    ) -> tuple[str, ServiceClient]:
+        """(address, client) for the task's ring owner — failing over
+        along the ring successors when the owner is unreachable (a
+        SIGKILL'd member must not error every task it owned until
+        membership catches up; its keys hand off to their successor,
+        reference consistent-hash balancer failover).
+
+        Two health signals reorder the walk, because a cached channel to
+        a dead member dials nothing and so never *raises* here: members
+        the caller just failed against (``avoid`` — the conductor's
+        stream-error feedback) and members whose circuit breaker is open
+        inside its cool-down sort behind healthy candidates. They stay
+        IN the walk as a last resort, so a fully-dark ring still probes
+        rather than erroring blind."""
+        avoid = avoid or set()
+        with self._lock:
+            candidates = self.ring.successors(task_id)
+        if len(candidates) > 1:
+            candidates.sort(
+                key=lambda a: (a in avoid) + 2 * resilience.target_wide_open(a)
+            )
+        last: Exception | None = None
+        for addr in candidates:
+            try:
+                return addr, self._client(addr)
+            except Exception as e:
+                last = e
+        raise ConnectionError(f"no scheduler reachable for task: {last}")
+
     def for_task(self, task_id: str) -> ServiceClient:
-        return self._client(self.ring.pick(task_id))
+        return self.resolve_for_task(task_id)[1]
 
     def addr_for_task(self, task_id: str) -> str:
-        return self.ring.pick(task_id)
+        with self._lock:
+            return self.ring.pick(task_id)
 
     def primary(self) -> ServiceClient:
         """First REACHABLE scheduler (probe loops etc.); raises only when
         every address is down."""
+        with self._lock:
+            addresses = list(self.addresses)
         last: Exception | None = None
-        for addr in self.addresses:
+        for addr in addresses:
             try:
                 return self._client(addr)
             except Exception as e:
@@ -809,8 +959,13 @@ class SchedulerSelector:
         raise ConnectionError(f"no scheduler reachable: {last}")
 
     def all(self) -> list[ServiceClient]:
+        # snapshot under the lock: update_addresses swaps self.addresses
+        # from the membership reconcile thread, and the fan-out must see
+        # one consistent set, not a torn read mid-swap
+        with self._lock:
+            addresses = list(self.addresses)
         out = []
-        for addr in self.addresses:
+        for addr in addresses:
             try:
                 out.append(self._client(addr))
             except Exception:

@@ -363,6 +363,20 @@ class CircuitBreaker:
                 self.opened_at = time.monotonic()
                 self._transition(OPEN)
 
+    def wide_open(self) -> bool:
+        """OPEN and still inside the cool-down (no probe due yet): a
+        router holding alternatives should send traffic elsewhere. Once
+        the cool-down expires this reads False, so affinity traffic can
+        come back and serve as the half-open probe. Read-only — never
+        transitions or consumes the probe slot."""
+        if self.state != OPEN:
+            return False
+        with self._lock:
+            return (
+                self.state == OPEN
+                and time.monotonic() - self.opened_at < self.open_s
+            )
+
     def snapshot(self) -> dict:
         return {
             "state": _STATE_NAMES[self.state],
@@ -428,6 +442,15 @@ def breaker_for(target: str, policy: Policy) -> CircuitBreaker:
                 ),
             )
     return br
+
+
+def target_wide_open(target: str) -> bool:
+    """Router-facing breaker peek: True while ``target``'s breaker is
+    OPEN inside its cool-down. Read-only (no transition, no probe slot);
+    an unknown target reads False. SchedulerSelector.for_task uses this
+    to deprioritize a dark member in favor of its ring successor."""
+    br = _breakers.get(target)
+    return br is not None and br.wide_open()
 
 
 def budget_for(service: str, target: str, policy: Policy) -> RetryBudget:

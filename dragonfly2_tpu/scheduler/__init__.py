@@ -5,3 +5,9 @@ the resource FSMs), collects download records and network-topology probes,
 and feeds them to the TPU trainer (reference scheduler/ package tree,
 SURVEY.md §2.2).
 """
+
+# IMPORT-LIGHT CONTRACT: client daemons and the manager import
+# dragonfly2_tpu.scheduler.fleet (the fleet membership/WRONG_SHARD
+# protocol is role-neutral, but the ISSUE pins its home here), so this
+# package __init__ must never grow imports — anything added here lands
+# in every client process.

@@ -73,6 +73,19 @@ class SchedulerServerConfig:
     # AUTH secret for the shared KV (KVServer requirepass / Redis AUTH);
     # empty = unauthenticated (loopback/dev deployments)
     kv_secret: str = ""
+    # scheduler-fleet membership (scheduler/fleet.py, docs/fleet.md):
+    # register this scheduler under a heartbeat-renewed lease in the
+    # shared KV so daemons/the manager follow LIVE membership and each
+    # member refuses announces for shards it doesn't own (WRONG_SHARD).
+    # Needs a shared kv_address to mean anything across processes.
+    fleet_enabled: bool = False
+    fleet_lease_ttl: float = 3.0
+    fleet_renew_interval: float = 1.0
+    fleet_poll_interval: float = 1.0
+    fleet_grace_s: float = 10.0
+    # address other fleet members/daemons reach this scheduler at;
+    # 0 = advertise_ip:<bound port>
+    advertise_port: int = 0
     # Prometheus /metrics endpoint (reference :8000): -1 = disabled
     metrics_port: int = -1
     # df_plugin_*.py modules loaded at startup (reference internal/dfplugin)
@@ -278,6 +291,7 @@ class SchedulerServer:
 
         self._grpc = None
         self.port: int | None = None
+        self.fleet = None
 
     # ------------------------------------------------------------------
     def serve(self) -> str:
@@ -315,6 +329,37 @@ class SchedulerServer:
             ),
         )
         addr = f"{cfg.listen.rsplit(':', 1)[0]}:{self.port}"
+        if cfg.fleet_enabled:
+            # join the fleet only once the gRPC plane is up: a member
+            # that announces itself before it can serve would black-hole
+            # every shard the ring hands it
+            from dragonfly2_tpu.scheduler.fleet import FleetConfig, FleetMembership
+
+            # the heartbeat gets its OWN connection when the KV is
+            # remote: RemoteKVStore serializes one in-flight command per
+            # socket, and a slow topology read holding that lock for up
+            # to the socket timeout (5s) would starve the renew past the
+            # lease TTL — a false member death, a WRONG_SHARD storm, and
+            # a rebalance back, all from someone else's slow query
+            fleet_kv = (
+                kvstore.RemoteKVStore(cfg.kv_address, secret=cfg.kv_secret)
+                if cfg.kv_address
+                else self.kvstore
+            )
+            self.fleet = FleetMembership(
+                fleet_kv,
+                f"{cfg.advertise_ip}:{cfg.advertise_port or self.port}",
+                FleetConfig(
+                    lease_ttl=cfg.fleet_lease_ttl,
+                    renew_interval=cfg.fleet_renew_interval,
+                    poll_interval=cfg.fleet_poll_interval,
+                    grace_s=cfg.fleet_grace_s,
+                ),
+            )
+            self.fleet.join()
+            self.service.fleet = self.fleet
+            self.service_v1.fleet = self.fleet
+            flight.register_probe("scheduler.fleet", self.fleet.snapshot)
         if self.topology_engine is not None:
             try:
                 # restart recovery: adopt the durable KV graph into the
@@ -356,7 +401,10 @@ class SchedulerServer:
             manager_pb2.UpdateSchedulerRequest(
                 hostname=self.cfg.hostname,
                 ip=self.cfg.advertise_ip,
-                port=int(self.port or 0),
+                # the DIALABLE port — must match the fleet lease address
+                # (advertise_ip:advertise_port) or the manager's
+                # lease-scoped dynconfig can never match this row
+                port=int(self.cfg.advertise_port or self.port or 0),
                 idc=self.cfg.idc,
                 location=self.cfg.location,
                 scheduler_cluster_id=self.cfg.cluster_id,
@@ -368,6 +416,12 @@ class SchedulerServer:
         # storage → gc → announcer → clients → graceful grpc stop
         if getattr(self, "_metrics", None) is not None:
             self._metrics.stop()
+        if self.fleet is not None:
+            # graceful leave FIRST: peers stop routing new shards here
+            # while the grpc grace period drains in-flight streams
+            self.fleet.leave()
+            if self.fleet.kv is not self.kvstore:
+                self.fleet.kv.close()  # the heartbeat's own RESP socket
         if self.job_worker is not None:
             self.job_worker.stop()
         if self.model_refresher is not None:

@@ -23,6 +23,7 @@ import common_pb2  # noqa: E402
 import scheduler_pb2  # noqa: E402
 
 from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.fleet import WrongShardError
 from dragonfly2_tpu.scheduler.networktopology import NetworkTopology, Probe
 from dragonfly2_tpu.scheduler.scheduling import (
     NeedBackToSourceResponse,
@@ -206,11 +207,13 @@ class SchedulerService:
         scheduling: Scheduling,
         storage: Storage | None = None,
         networktopology: NetworkTopology | None = None,
+        fleet=None,  # scheduler.fleet.FleetMembership; None = no sharding
     ):
         self.resource = resource
         self.scheduling = scheduling
         self.storage = storage
         self.networktopology = networktopology
+        self.fleet = fleet
 
     # ------------------------------------------------------------------
     # AnnouncePeer bidi stream
@@ -230,6 +233,11 @@ class SchedulerService:
                 with tracing.use_span(rpc_span):
                     for req in request_iterator:
                         self._handle_announce(req, adapter, state)
+            except WrongShardError as e:
+                # typed refusal: surfaced to the handler thread, which
+                # aborts the stream with FAILED_PRECONDITION so the
+                # daemon's retry loop can parse the owner hint
+                adapter.out.put(e)
             except grpc.RpcError:
                 pass  # client hung up — normal stream teardown
             except Exception:
@@ -247,6 +255,8 @@ class SchedulerService:
             resp = adapter.out.get()
             if resp is None:
                 return
+            if isinstance(resp, WrongShardError):
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(resp))
             yield resp
 
     def _handle_announce(self, req, adapter: _StreamAdapter, state: dict) -> None:
@@ -325,14 +335,24 @@ class SchedulerService:
 
     def _register_peer(self, req, adapter: _StreamAdapter) -> res.Peer | None:
         reg = req.register_peer
+        meta = url_meta_of(reg.url_meta)
+        task_id = reg.task_id or task_id_v1(reg.url, meta)
+        if self.fleet is not None:
+            # shard ownership gate, BEFORE any state mutates: a task
+            # owned by another live member is refused with the typed
+            # WRONG_SHARD status (raises through the pump); tasks this
+            # member already serves drain behind the rebalance grace
+            existing = self.resource.task_manager.load(task_id)
+            self.fleet.check_owner(
+                task_id,
+                task_in_flight=existing is not None and existing.peer_count() > 0,
+            )
         host = self.resource.host_manager.load(req.host_id)
         if host is None:
             logger.warning("register from unannounced host %s", req.host_id)
             host = res.Host(id=req.host_id)
             self.resource.host_manager.store(host)
 
-        meta = url_meta_of(reg.url_meta)
-        task_id = reg.task_id or task_id_v1(reg.url, meta)
         task, _ = load_or_create_task(self.resource, reg.url, meta, task_id, reg.task_type)
 
         peer = res.Peer(

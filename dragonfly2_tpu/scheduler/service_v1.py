@@ -25,6 +25,7 @@ import scheduler_v1_pb2 as v1  # noqa: E402
 
 from dragonfly2_tpu.rpc.glue import SCHEDULER_V1_SERVICE
 from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.fleet import WrongShardError
 from dragonfly2_tpu.scheduler import metrics as M
 from dragonfly2_tpu.scheduler.scheduling import (
     NeedBackToSourceResponse,
@@ -128,11 +129,13 @@ class SchedulerServiceV1:
         scheduling: Scheduling,
         storage: Storage | None = None,
         networktopology=None,
+        fleet=None,  # scheduler.fleet.FleetMembership; None = no sharding
     ):
         self.resource = resource
         self.scheduling = scheduling
         self.storage = storage
         self.networktopology = networktopology
+        self.fleet = fleet
 
     # ------------------------------------------------------------------
     # RegisterPeerTask (unary, size-scope dispatch)
@@ -140,14 +143,24 @@ class SchedulerServiceV1:
     def RegisterPeerTask(self, request: v1.PeerTaskRequest, context):
         try:
             return self._register_peer_task(request)
+        except WrongShardError as e:
+            # same typed refusal the v2 stream gets — a redirect, not a
+            # registration failure, so the failure counter stays honest
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         except Exception:
             M.REGISTER_PEER_FAILURE_TOTAL.inc()
             raise
 
     def _register_peer_task(self, request: v1.PeerTaskRequest):
-        host = self._store_host(request.peer_host)
         meta = url_meta_of(request.url_meta)
         task_id = request.task_id or task_id_v1(request.url, meta)
+        if self.fleet is not None:
+            existing = self.resource.task_manager.load(task_id)
+            self.fleet.check_owner(
+                task_id,
+                task_in_flight=existing is not None and existing.peer_count() > 0,
+            )
+        host = self._store_host(request.peer_host)
         task, _ = load_or_create_task(
             self.resource, request.url, meta, task_id, request.task_type
         )

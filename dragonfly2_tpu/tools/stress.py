@@ -22,6 +22,16 @@ resilience layer (rpc/resilience.py) must carry every download to
 correct bytes with zero hangs. Prints the soak statistics as one JSON
 line (``chaos_success_rate``, ``chaos_hangs``, …) — the same numbers
 bench.py folds into its artifact.
+
+Fourth mode: ``--chaos --shard-kill`` runs the scheduler-fleet failover
+soak (scheduler/fleet.py, docs/fleet.md): N real scheduler processes
+join the fleet under KV leases, a simulated-peer announce load drives
+the consistent-hash ring through a SchedulerSelector following live
+membership, and one shard is SIGKILL'd mid-load. Every announce must
+land (success rate 1.0, zero hangs) and the measured failover blackout
+(``fleet_blackout_ms``) must stay bounded by one lease TTL + one
+membership poll. ``--shard-peers`` scales the simulated swarm (the
+ROADMAP's 10k-peer form).
 """
 
 from __future__ import annotations
@@ -346,6 +356,297 @@ def _faults_injected_total() -> int:
     )
 
 
+# ---------------------------------------------------------------------------
+# shard-kill soak: scheduler-fleet failover under simulated announce load
+# ---------------------------------------------------------------------------
+
+
+def _spawn_scheduler(workdir: str, kv_addr: str, lease_ttl: float,
+                     renew: float, poll: float):
+    """One real scheduler process joined to the fleet; returns
+    (Popen, addr). Killed with SIGKILL later — which is the point."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(
+        os.environ,
+        PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        PYTHONUNBUFFERED="1",
+        DF_JAX_PLATFORM=os.environ.get("DF_JAX_PLATFORM", "cpu"),
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dragonfly2_tpu.scheduler",
+            "--set", f"data_dir={workdir}",
+            "--set", f"kv_address={kv_addr}",
+            "--set", "fleet_enabled=true",
+            "--set", f"fleet_lease_ttl={lease_ttl}",
+            "--set", f"fleet_renew_interval={renew}",
+            "--set", f"fleet_poll_interval={poll}",
+            "--set", "fleet_grace_s=2.0",
+            # the soak drives the announce plane, not the topology/ML
+            # planes — keep shard boot light and jax out of the children
+            "--set", "topology_backend=off",
+            "--set", "storage_buffer_size=1",
+            "--set", "retry_interval=0.0",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    # stdout is pumped from a thread so the READY wait can time out: a
+    # child that wedges during boot WITHOUT printing (stuck dial,
+    # deadlock) would otherwise block readline() forever and hang the
+    # soak instead of degrading to its error exit. The pump keeps
+    # draining after READY so the child can never block on a full pipe.
+    import queue as _queue
+
+    lines: "_queue.Queue[str | None]" = _queue.Queue()
+
+    def pump():
+        for line in proc.stdout:
+            lines.put(line)
+        lines.put(None)
+
+    threading.Thread(target=pump, daemon=True).start()
+    deadline = time.monotonic() + 60.0
+    addr = None
+    while time.monotonic() < deadline:
+        try:
+            line = lines.get(timeout=0.5)
+        except _queue.Empty:
+            if proc.poll() is not None:
+                break  # died before READY
+            continue
+        if line is None:
+            break  # stdout closed before READY
+        if line.startswith("READY scheduler "):
+            addr = line.split()[-1].strip()
+            break
+    if addr is None:
+        proc.kill()
+        raise RuntimeError("scheduler shard failed to become READY")
+    return proc, addr
+
+
+def shard_kill_soak(
+    peers: int = 240,
+    shards: int = 3,
+    workers: int = 12,
+    lease_ttl: float = 2.0,
+    renew_interval: float = 0.5,
+    poll_interval: float = 0.4,
+    op_deadline_s: float = 25.0,
+    wall_deadline_s: float = 180.0,
+) -> dict:
+    """The fleet-failover acceptance soak: ``shards`` real scheduler
+    processes under KV leases, ``peers`` simulated announce ops riding
+    the consistent-hash ring, one shard SIGKILL'd mid-load.
+
+    Each op is one AnnouncePeer register→decision round trip pinned to
+    the task's ring owner, retried through WRONG_SHARD refusals and dead
+    members until it lands or its deadline expires. Gates:
+    ``fleet_success_rate`` must be 1.0 with ``fleet_hangs`` 0, and
+    ``fleet_blackout_ms`` (SIGKILL → first successful announce for a
+    task the victim owned) must stay inside one lease TTL + one
+    membership poll + scheduling slack.
+    """
+    import queue as _queue
+    import shutil
+
+    import grpc
+
+    from dragonfly2_tpu.rpc import gen  # noqa: F401
+    import common_pb2  # noqa: E402
+    import scheduler_pb2  # noqa: E402
+
+    from dragonfly2_tpu.rpc.glue import SchedulerSelector
+    from dragonfly2_tpu.scheduler import fleet
+    from dragonfly2_tpu.utils import kvstore
+    from dragonfly2_tpu.utils.kvserver import KVServer
+
+    tmp = tempfile.mkdtemp(prefix="dfshardkill-")
+    t_start = time.perf_counter()
+    kv_server = KVServer()
+    kv_port = kv_server.serve()
+    kv_addr = f"127.0.0.1:{kv_port}"
+    procs: list = []
+    sel = watcher = None
+    watcher_kv = None
+    try:
+        addrs = []
+        for i in range(shards):
+            proc, addr = _spawn_scheduler(
+                os.path.join(tmp, f"sched-{i}"), kv_addr,
+                lease_ttl, renew_interval, poll_interval,
+            )
+            procs.append(proc)
+            addrs.append(addr)
+
+        # wait until every shard's lease is visible — the soak measures
+        # failover, not boot
+        watcher_kv = kvstore.RemoteKVStore(kv_addr)
+        deadline = time.monotonic() + 30.0
+        while set(fleet.read_members(watcher_kv)) != set(addrs):
+            if time.monotonic() > deadline:
+                raise RuntimeError("fleet never converged to all shards")
+            time.sleep(0.1)
+
+        sel = SchedulerSelector(addrs)
+        watcher = fleet.FleetWatcher(
+            watcher_kv, sel.update_addresses, poll_interval=poll_interval
+        )
+        sel.set_membership_source(watcher.read_members)
+        watcher.poll_once()
+        watcher.start()
+
+        counters = {"ok": 0, "failed": 0, "wrong_shard": 0}
+        counters_lock = threading.Lock()
+
+        def announce_op(task_key: str, peer_idx: int, deadline_s: float) -> bool:
+            """One register→decision round trip; retried through
+            refusals/dead members until it lands or times out."""
+            url = f"http://soak/{task_key}"
+            task_id = f"shardkill-{task_key}"
+            peer_id = f"sim-{task_key}-{peer_idx}"
+            avoid: set = set()
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                try:
+                    addr, client = sel.resolve_for_task(task_id, avoid=avoid)
+                except Exception:
+                    time.sleep(0.1)
+                    continue
+                q: "_queue.Queue" = _queue.Queue()
+                q.put(
+                    scheduler_pb2.AnnouncePeerRequest(
+                        host_id=f"host-sim-{peer_idx % 64}",
+                        task_id=task_id,
+                        peer_id=peer_id,
+                        register_peer=scheduler_pb2.RegisterPeerRequest(
+                            task_id=task_id,
+                            peer_id=peer_id,
+                            url=url,
+                            url_meta=common_pb2.UrlMeta(),
+                            # immediate NeedBackToSource decision: the
+                            # soak measures the announce plane, not
+                            # parent selection
+                            need_back_to_source=True,
+                        ),
+                    )
+                )
+                try:
+                    responses = client.AnnouncePeer(iter(q.get, None))
+                    first = next(responses)
+                    q.put(None)
+                    for _ in responses:
+                        pass
+                    assert first.WhichOneof("response")
+                    return True
+                except (grpc.RpcError, StopIteration, AssertionError) as e:
+                    # release gRPC's request-sender thread: it blocks in
+                    # q.get() until the None sentinel, and a refused/
+                    # dead-member attempt would otherwise leak one such
+                    # thread per retry for the process lifetime
+                    q.put(None)
+                    ws = fleet.parse_wrong_shard(str(e))
+                    if ws is not None:
+                        with counters_lock:
+                            counters["wrong_shard"] += 1
+                        sel.refresh_membership()
+                    else:
+                        # wire-dead member: route the next resolve past it
+                        avoid.add(addr)
+                    time.sleep(0.05)
+            return False
+
+        # pre-kill: find probe tasks the victim owns (blackout yardstick)
+        victim_idx = 0
+        victim_addr = addrs[victim_idx]
+        probe_key = next(
+            f"probe-{i}" for i in range(10_000)
+            if sel.addr_for_task(f"shardkill-probe-{i}") == victim_addr
+        )
+
+        next_op = [0]
+
+        def worker() -> None:
+            while True:
+                with counters_lock:
+                    i = next_op[0]
+                    if i >= peers:
+                        return
+                    next_op[0] += 1
+                ok = announce_op(f"t{i % max(peers // 4, 1)}", i, op_deadline_s)
+                with counters_lock:
+                    counters["ok" if ok else "failed"] += 1
+
+        threads = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(workers)
+        ]
+        for t in threads:
+            t.start()
+
+        # let the swarm run, then SIGKILL the victim mid-load
+        while True:
+            with counters_lock:
+                done = counters["ok"] + counters["failed"]
+            if done >= max(peers // 3, 1):
+                break
+            time.sleep(0.05)
+        procs[victim_idx].kill()  # SIGKILL: no graceful leave, lease stays
+        t_kill = time.monotonic()
+
+        # blackout: SIGKILL → first successful announce for a task the
+        # victim owned (rides the WRONG_SHARD window while the dead
+        # lease drains)
+        blackout_ms = -1.0
+        if announce_op(probe_key, 999_999, op_deadline_s):
+            blackout_ms = (time.monotonic() - t_kill) * 1e3
+
+        hangs = 0
+        hard_deadline = t_start + wall_deadline_s
+        for t in threads:
+            t.join(max(1.0, hard_deadline - time.perf_counter()))
+            if t.is_alive():
+                hangs += 1
+
+        wall = time.perf_counter() - t_start
+        with counters_lock:
+            ok, failed = counters["ok"], counters["failed"]
+            wrong_shard = counters["wrong_shard"]
+        total = ok + failed
+        return {
+            "fleet_shards": shards,
+            "fleet_peers": peers,
+            "fleet_success_rate": round(ok / total, 4) if total else 0.0,
+            "fleet_hangs": hangs,
+            "fleet_blackout_ms": round(blackout_ms, 1),
+            "fleet_wrong_shard_retries": wrong_shard,
+            "schedule_ops_per_s": round(ok / wall, 1) if wall else 0.0,
+            "fleet_wall_s": round(wall, 2),
+        }
+    finally:
+        if watcher is not None:
+            watcher.stop()
+        if sel is not None:
+            sel.close()
+        if watcher_kv is not None:
+            watcher_kv.close()
+        for proc in procs:
+            try:
+                proc.kill()
+                proc.wait(timeout=5)
+            except Exception as e:
+                print(
+                    f"stress: shard teardown kill failed: {e}", file=sys.stderr
+                )
+        kv_server.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="df-stress", description=__doc__)
     p.add_argument("--url", help="target url; {i} varies per request")
@@ -357,6 +658,15 @@ def main(argv=None) -> int:
     p.add_argument("--chaos-downloads", type=int, default=6)
     p.add_argument("--chaos-error-rate", type=float, default=0.05)
     p.add_argument("--chaos-seed", type=int, default=7)
+    p.add_argument(
+        "--shard-kill",
+        action="store_true",
+        help="with --chaos: the scheduler-fleet failover soak (N shards"
+        " under KV leases, one SIGKILL'd mid announce load)",
+    )
+    p.add_argument("--shard-peers", type=int, default=240,
+                   help="simulated announce peers for --shard-kill")
+    p.add_argument("--shards", type=int, default=3)
     p.add_argument("--daemon", default="", help="dfdaemon gRPC address (Download path)")
     p.add_argument("--proxy", default="", help="daemon proxy address (HTTP path)")
     p.add_argument("-c", "--connections", type=int, default=8)
@@ -365,6 +675,15 @@ def main(argv=None) -> int:
     p.add_argument("--tag", default="stress")
     p.add_argument("--output", default="", help="per-request CSV path")
     args = p.parse_args(argv)
+    if args.chaos and args.shard_kill:
+        stats = shard_kill_soak(peers=args.shard_peers, shards=args.shards)
+        print(json.dumps(stats))
+        ok = (
+            stats["fleet_success_rate"] == 1.0
+            and not stats["fleet_hangs"]
+            and stats["fleet_blackout_ms"] >= 0
+        )
+        return 0 if ok else 1
     if args.chaos:
         stats = chaos_soak(
             downloads=args.chaos_downloads,

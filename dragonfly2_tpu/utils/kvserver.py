@@ -19,9 +19,9 @@ structure (the topology's probe entries are JSON strings, which is also
 what the reference stores — probes.go marshals JSON into Redis lists).
 
 Commands implemented (the subset the system uses, plus introspection):
-AUTH PING ECHO SET GET DEL EXISTS EXPIRE INCR INCRBY HSET HGET HGETALL
-RPUSH LPOP LLEN LRANGE KEYS SCAN FLUSHALL. Unknown commands get -ERR,
-never a dropped connection.
+AUTH PING ECHO SET (PX/EX) GET MGET DEL EXISTS EXPIRE PEXPIRE INCR
+INCRBY HSET HGET HDEL HGETALL RPUSH LPOP LLEN LRANGE KEYS SCAN
+FLUSHALL. Unknown commands get -ERR, never a dropped connection.
 
 Hardening: the server binds loopback by default (network exposure is an
 explicit config decision), and a configured ``secret`` gates every data
@@ -189,7 +189,20 @@ class KVRequestHandler(socketserver.BaseRequestHandler):
         if op == "ECHO" and len(args) == 1:
             return _bulk(args[0])
         if op == "SET" and len(args) >= 2:
-            kv.set(args[0], args[1])
+            # PX/EX options (the lease-write form RemoteKVStore.set_with_ttl
+            # sends): SET + expiry as one atomic command, like real Redis.
+            # A trailing option with no operand must be a -ERR, never an
+            # IndexError that kills the connection.
+            opts = [a.upper() for a in args[2:]]
+            for opt, scale in (("PX", 1000.0), ("EX", 1.0)):
+                if opt in opts:
+                    at = 2 + opts.index(opt) + 1
+                    if at >= len(args):
+                        raise ValueError(f"syntax error: {opt} needs a value")
+                    kv.set_with_ttl(args[0], args[1], float(args[at]) / scale)
+                    break
+            else:
+                kv.set(args[0], args[1])
             return _OK
         if op == "GET" and len(args) == 1:
             v = kv.get(args[0])
@@ -217,6 +230,8 @@ class KVRequestHandler(socketserver.BaseRequestHandler):
         if op == "HGET" and len(args) == 2:
             v = kv.hget(args[0], args[1])
             return _bulk(None if v is None else v)
+        if op == "HDEL" and len(args) >= 2:
+            return _int(kv.hdel(args[0], *args[1:]))
         if op == "HGETALL" and len(args) == 1:
             h = kv.hgetall(args[0])
             flat: list = []

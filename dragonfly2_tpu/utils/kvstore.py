@@ -96,6 +96,15 @@ class KVStore:
             self._data[key] = value
             self._expires.pop(key, None)  # redis SET clears TTL
 
+    def set_with_ttl(self, key: str, value: Any, ttl_seconds: float) -> None:
+        """Atomic SET + expiry (redis ``SET key value PX ms``) — the
+        lease-write primitive: a heartbeat that crashed between SET and
+        EXPIRE would leave an immortal lease that no failure detector
+        ever clears, so the two must be one operation."""
+        with self._lock:
+            self._data[key] = value
+            self._expires[key] = time.monotonic() + ttl_seconds
+
     def get(self, key: str) -> Any:
         with self._lock:
             return self._data.get(key) if self._alive(key) else None
@@ -122,6 +131,17 @@ class KVStore:
         with self._lock:
             h = self._data.get(key) if self._alive(key) else None
             return None if h is None else h.get(field)
+
+    def hdel(self, key: str, *fields: str) -> int:
+        with self._lock:
+            h = self._data.get(key) if self._alive(key) else None
+            if not isinstance(h, dict):
+                return 0
+            n = 0
+            for f in fields:
+                if h.pop(f, None) is not None:
+                    n += 1
+            return n
 
     def hgetall(self, key: str) -> dict[str, Any]:
         with self._lock:
@@ -323,6 +343,11 @@ class RemoteKVStore:
     def set(self, key: str, value: Any) -> None:
         self._call("SET", key, value)
 
+    def set_with_ttl(self, key: str, value: Any, ttl_seconds: float) -> None:
+        # one atomic round-trip (SET ... PX) — see KVStore.set_with_ttl
+        # for why the lease write must never be SET-then-PEXPIRE
+        self._call("SET", key, value, "PX", max(1, int(ttl_seconds * 1000)))
+
     def get(self, key: str):
         return self._call("GET", key)
 
@@ -347,6 +372,9 @@ class RemoteKVStore:
 
     def hget(self, key: str, field: str):
         return self._call("HGET", key, field)
+
+    def hdel(self, key: str, *fields: str) -> int:
+        return int(self._call("HDEL", key, *fields)) if fields else 0
 
     def hget_batch(self, keys: list[str], field: str) -> list:
         """Pipelined HGET: one write, N replies, one round-trip worth of
@@ -432,3 +460,9 @@ def make_probes_key(src_host_id: str, dest_host_id: str) -> str:
 
 def make_probed_count_key(host_id: str) -> str:
     return make_namespace("probedcount", host_id)
+
+
+def make_fleet_member_key(address: str) -> str:
+    """Scheduler-fleet lease key (scheduler/fleet.py): one leased key per
+    live scheduler, expiring when its heartbeat stops."""
+    return make_namespace("fleet", "member", address)

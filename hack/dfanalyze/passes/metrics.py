@@ -30,7 +30,7 @@ PACKAGE = DEFAULT_PACKAGE
 # resilience-layer series
 ALLOWED_SERVICES = (
     "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "flight",
-    "faults", "resilience",
+    "faults", "resilience", "fleet",
 )
 
 # flight-recorder event names are <service>.<what>; the service segment
@@ -39,10 +39,11 @@ ALLOWED_SERVICES = (
 # (injections) rings, which must not evict any role's own history
 EVENT_SERVICES = (
     "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "faults",
+    "fleet",
 )
 
 # fault-point names are <layer>.<what>; mirrors utils/faults.POINT_LAYERS
-FAULT_LAYERS = ("rpc", "daemon", "scheduler", "trainer", "manager", "kv")
+FAULT_LAYERS = ("rpc", "daemon", "scheduler", "trainer", "manager", "kv", "fleet")
 
 TESTS_DIR = PACKAGE.parent / "tests"
 

@@ -14,6 +14,11 @@ import sys
 # and forces the platform, so an env var alone isn't enough — override the
 # config after import, before any device query.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# grpc's C core logs INFO lines (GOAWAY on abrupt server stops — which
+# the fleet/resilience failover tests do on purpose) straight to stderr,
+# where they interleave into pytest's progress lines and corrupt the
+# tier-1 dot count. Errors still print.
+os.environ.setdefault("GRPC_VERBOSITY", "ERROR")
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -60,10 +60,26 @@ def _fake_chaos_soak():
     }
 
 
+def _fake_fleet_soak():
+    # the real soak spawns 3 scheduler processes and SIGKILLs one
+    # (~10s); the soak itself is covered by tests/test_stress_tool.py
+    return {
+        "fleet_shards": 3,
+        "fleet_peers": 150,
+        "fleet_success_rate": 1.0,
+        "fleet_hangs": 0,
+        "fleet_blackout_ms": 2100.0,
+        "fleet_wrong_shard_retries": 42,
+        "schedule_ops_per_s": 55.0,
+        "fleet_wall_s": 0.1,
+    }
+
+
 def _run_main(monkeypatch, capfd, fit_stub):
     monkeypatch.setattr(bench, "synthesize_dataset", _fake_synthesize)
     monkeypatch.setattr(bench, "synthesize_dataset_binary", _fake_synthesize_binary)
     monkeypatch.setattr(bench, "chaos_soak_bench", _fake_chaos_soak)
+    monkeypatch.setattr(bench, "fleet_shard_kill_bench", _fake_fleet_soak)
     monkeypatch.setattr(ingest, "stream_train_mlp", fit_stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     monkeypatch.delenv("DF_BENCH_CPU_FALLBACK", raising=False)
@@ -326,6 +342,11 @@ def test_emits_resilience_overhead_and_chaos_keys(monkeypatch, capfd):
     assert "chaos_error" not in rec
     assert rec["chaos_success_rate"] == 1.0
     assert rec["chaos_hangs"] == 0
+    assert "fleet_error" not in rec
+    assert rec["fleet_success_rate"] == 1.0
+    assert rec["fleet_hangs"] == 0
+    assert rec["fleet_blackout_ms"] > 0
+    assert rec["schedule_ops_per_s"] > 0
 
 
 def test_resilience_and_chaos_keys_survive_warmup_failure(monkeypatch, capfd):
@@ -339,6 +360,7 @@ def test_resilience_and_chaos_keys_survive_warmup_failure(monkeypatch, capfd):
     assert "warmup fit failed" in rec["error"]
     assert rec["resilience_overhead_pct"] >= 0.0
     assert rec["chaos_success_rate"] == 1.0
+    assert rec["fleet_blackout_ms"] > 0  # fleet soak keys ride it too
 
 
 def test_chaos_soak_failure_rides_exit_path(monkeypatch, capfd):
@@ -354,6 +376,7 @@ def test_chaos_soak_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "synthesize_dataset", _fake_synthesize)
     monkeypatch.setattr(bench, "synthesize_dataset_binary", _fake_synthesize_binary)
     monkeypatch.setattr(bench, "chaos_soak_bench", broken_soak)
+    monkeypatch.setattr(bench, "fleet_shard_kill_bench", _fake_fleet_soak)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -362,6 +385,32 @@ def test_chaos_soak_failure_rides_exit_path(monkeypatch, capfd):
     rec = json.loads(lines[0])
     assert "no loopback in sandbox" in rec["chaos_error"]
     assert rec["resilience_overhead_pct"] >= 0.0  # its sibling still ran
+    assert rec["fleet_success_rate"] == 1.0  # and so did the fleet soak
+
+
+def test_fleet_soak_failure_rides_exit_path(monkeypatch, capfd):
+    """A fleet shard-kill soak that can't run (no subprocess spawn in a
+    sandbox) must degrade to a ``fleet_error`` key on the one JSON line,
+    leaving its siblings intact."""
+
+    def stub(paths, **kw):
+        return None, _stats(1000)
+
+    def broken_fleet():
+        raise RuntimeError("scheduler shard failed to become READY")
+
+    monkeypatch.setattr(bench, "synthesize_dataset", _fake_synthesize)
+    monkeypatch.setattr(bench, "synthesize_dataset_binary", _fake_synthesize_binary)
+    monkeypatch.setattr(bench, "chaos_soak_bench", _fake_chaos_soak)
+    monkeypatch.setattr(bench, "fleet_shard_kill_bench", broken_fleet)
+    monkeypatch.setattr(ingest, "stream_train_mlp", stub)
+    monkeypatch.setenv("DF_BENCH_REPEATS", "3")
+    bench.main()
+    lines = [l for l in capfd.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert "failed to become READY" in rec["fleet_error"]
+    assert rec["chaos_success_rate"] == 1.0
 
 
 def test_resilience_overhead_under_two_percent():

@@ -131,6 +131,97 @@ class S:
     assert res.findings == []
 
 
+FLEET_SHAPE_FIXTURE = '''
+import threading
+
+class Membership:
+    """The scheduler/fleet.py shape: KV I/O strictly OUTSIDE the lock,
+    ring mutation + owner checks under it, never nesting into a second
+    lock."""
+
+    def __init__(self, kv, ring):
+        self._lock = threading.Lock()
+        self.kv = kv
+        self.ring = ring
+        self._members = ()
+
+    def reconcile(self):
+        members = tuple(self.kv.scan_iter("fleet:member:*"))  # outside
+        with self._lock:
+            self._members = members
+
+    def check_owner(self, task_id):
+        with self._lock:
+            return self.ring.pick(task_id)
+
+
+class Selector:
+    """The glue.SchedulerSelector shape: the ring lock releases BEFORE
+    the dial — no call chain ever holds Membership._lock and
+    Selector._lock together."""
+
+    def __init__(self, membership):
+        self._lock = threading.Lock()
+        self.membership = membership
+
+    def resolve(self, task_id):
+        with self._lock:
+            candidates = list(self._ring_candidates(task_id))
+        return candidates[0]
+
+    def _ring_candidates(self, task_id):
+        return [task_id]
+'''
+
+
+def test_lockorder_fleet_shape_is_clean(fakepkg):
+    """The fleet's lock model (Membership._lock, Selector._lock — KV
+    I/O outside, no nesting between the two) must analyze clean; this
+    fixture documents the intended shape so a regression that nests
+    them shows up against a named baseline."""
+    (fakepkg / "fleet.py").write_text(FLEET_SHAPE_FIXTURE)
+    res = lockorder.run(fakepkg)
+    assert res.findings == [], [f.message for f in res.findings]
+
+
+def test_lockorder_catches_a_fleet_nesting_regression(fakepkg):
+    """The defect the clean shape guards against: a reconcile that
+    calls into the selector while holding the membership lock, while
+    the selector's refresh calls back into membership under its own
+    lock — the ABBA the fleet plane must never grow."""
+    (fakepkg / "fleet_bad.py").write_text(
+        '''
+import threading
+
+class BadFleet:
+    def __init__(self):
+        self._lock = threading.Lock()       # membership state
+        self._ring_lock = threading.Lock()  # selector ring
+
+    def reconcile(self):
+        with self._lock:
+            self._push_ring()  # membership -> ring
+
+    def _push_ring(self):
+        with self._ring_lock:
+            pass
+
+    def resolve(self):
+        with self._ring_lock:
+            self._owner()  # ring -> membership: the inversion
+
+    def _owner(self):
+        with self._lock:
+            pass
+'''
+    )
+    res = lockorder.run(fakepkg)
+    cycles = [f for f in res.findings if f.key.startswith("cycle:")]
+    assert cycles, [f.message for f in res.findings]
+    assert "BadFleet._lock" in cycles[0].message
+    assert "BadFleet._ring_lock" in cycles[0].message
+
+
 def test_blocking_catches_calls_under_lock(fakepkg):
     (fakepkg / "svc.py").write_text(
         """

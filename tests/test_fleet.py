@@ -1,0 +1,777 @@
+"""Scheduler fleet: leased KV membership, sharded task ownership, and
+bounded-blackout failover (scheduler/fleet.py, docs/fleet.md).
+
+Covers the acceptance drills: lease expiry → ring eviction within one
+TTL, a WRONG_SHARD refusal → daemon re-pick over real gRPC, a
+join-triggered rebalance moving only remapped tasks, the announce
+stream surviving an owner death with the same peer_id, and a
+``DF_FAULTS`` schedule on ``fleet.lease_renew`` flapping a member
+without data loss.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.client import dfget
+from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+from dragonfly2_tpu.rpc.glue import ConsistentHashRing, SchedulerSelector, serve
+from dragonfly2_tpu.scheduler import fleet, resource as res
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.fleet import (
+    FleetConfig,
+    FleetMembership,
+    FleetWatcher,
+    WrongShardError,
+)
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
+from dragonfly2_tpu.scheduler.storage import Storage
+from dragonfly2_tpu.utils import faults
+from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
+from dragonfly2_tpu.utils.kvstore import KVStore
+
+PIECE = 32 * 1024
+
+
+@pytest.fixture
+def clean_faults():
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# ring: version + indexed remove + successors (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_version_is_monotonic_and_remove_uses_index():
+    ring = ConsistentHashRing(["a:1", "b:2", "c:3"])
+    assert ring.version == 3 and len(ring) == 3
+    before = {f"t-{i}": ring.pick(f"t-{i}") for i in range(200)}
+    ring.remove("b:2")
+    assert ring.version == 4
+    assert "b:2" not in ring
+    # only b's keys remapped; everything else stays put
+    for k, owner in before.items():
+        if owner != "b:2":
+            assert ring.pick(k) == owner
+        else:
+            assert ring.pick(k) != "b:2"
+    # the internal vnode list is consistent: re-add is exact, idempotent
+    ring.add("b:2")
+    ring.add("b:2")
+    assert ring.version == 5
+    assert len(ring._ring) == 3 * ConsistentHashRing.VNODES
+    assert {k: ring.pick(k) for k in before} == before
+
+    ring.remove("nope:0")  # unknown member: no-op, no version bump
+    assert ring.version == 5
+
+
+def test_ring_successors_start_at_owner_and_cover_all_members():
+    ring = ConsistentHashRing(["a:1", "b:2", "c:3"])
+    for i in range(50):
+        key = f"task-{i}"
+        succ = ring.successors(key)
+        assert succ[0] == ring.pick(key)
+        assert sorted(succ) == ["a:1", "b:2", "c:3"]
+    assert ring.successors("k", limit=2) == ring.successors("k")[:2]
+    assert ConsistentHashRing().successors("k") == []
+
+
+# ---------------------------------------------------------------------------
+# selector: snapshot-under-lock + membership hooks (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_selector_fanout_is_consistent_under_concurrent_reconcile():
+    """all()/primary() snapshot the address set under the lock, so a
+    racing membership reconcile can never hand the fan-out a torn view
+    (the pre-fix shape iterated self.addresses while update_addresses
+    swapped it)."""
+    sel = SchedulerSelector(["h0:1", "h1:1"])
+    sel._client = lambda addr: addr  # no real dialing in a lock test
+    sets = [[f"h{i}:1", f"h{i+1}:1"] for i in range(50)]
+    stop = threading.Event()
+    errors: list = []
+
+    def reconcile():
+        i = 0
+        while not stop.is_set():
+            sel.update_addresses(sets[i % len(sets)])
+            i += 1
+
+    def fan_out():
+        while not stop.is_set():
+            try:
+                got = sel.all()
+                # an untorn snapshot is one of the pushed sets — exactly
+                # two consecutive members, never a mix of two pushes
+                assert len(got) == 2, got
+                a, b = sorted(int(x.split(":")[0][1:]) for x in got)
+                assert b == a + 1, got
+                assert sel.primary() in sum(sets, [])
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=reconcile, daemon=True)] + [
+        threading.Thread(target=fan_out, daemon=True) for _ in range(3)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join(2.0)
+    assert not errors, errors
+
+
+def test_selector_refresh_membership_reports_ring_staleness():
+    sel = SchedulerSelector(["a:1"])
+    assert sel.refresh_membership() is False  # no source wired
+    sel.set_membership_source(lambda: ["a:1", "b:2"])
+    assert sel.refresh_membership() is True  # ring moved
+    assert sel.refresh_membership() is False  # already converged
+    assert sorted(sel.addresses) == ["a:1", "b:2"]
+    v = sel.ring_version()
+    sel.ensure_address("c:3")  # WRONG_SHARD owner hint adoption
+    assert sel.ring_version() == v + 1 and "c:3" in sel.addresses
+    sel.ensure_address("c:3")
+    assert sel.ring_version() == v + 1  # idempotent
+
+
+def test_refresh_membership_clears_cooldown_for_leased_members():
+    """A transient dial blip parks a member in FAIL_COOLDOWN (60s) —
+    far past the wrong-shard retry window. A live lease is fresh
+    evidence: refresh_membership and the client_for hint path must
+    clear the cooldown so failover can actually reach the owner."""
+    sel = SchedulerSelector(["a:1", "b:2"])
+    sel.set_membership_source(lambda: ["a:1", "b:2"])
+    far = time.monotonic() + 60.0
+    sel._fail_until["a:1"] = far
+    sel.refresh_membership()
+    assert "a:1" not in sel._fail_until
+
+    sel._fail_until["b:2"] = far
+    sel._client = lambda addr: addr  # no real dial
+    assert sel.client_for("b:2") == "b:2"
+    assert "b:2" not in sel._fail_until
+
+
+# ---------------------------------------------------------------------------
+# leases: expiry evicts within one TTL
+# ---------------------------------------------------------------------------
+
+
+def test_lease_expiry_evicts_member_within_ttl():
+    kv = KVStore()
+    cfg = FleetConfig(lease_ttl=0.4, renew_interval=0.1, poll_interval=0.1)
+    a = FleetMembership(kv, "127.0.0.1:1", cfg)
+    b = FleetMembership(kv, "127.0.0.1:2", cfg)
+    a.join()
+    b.join()
+    try:
+        a.reconcile()
+        assert a.members() == ["127.0.0.1:1", "127.0.0.1:2"]
+
+        # SIGKILL shape: b stops heartbeating but never deletes its lease
+        b.abandon()
+        t0 = time.monotonic()
+        while "127.0.0.1:2" in fleet.read_members(kv):
+            assert time.monotonic() - t0 < 2 * cfg.lease_ttl, (
+                "lease outlived its TTL"
+            )
+            time.sleep(0.05)
+        # a's poll loop folds the eviction into its ring
+        deadline = time.monotonic() + 2.0
+        while a.members() != ["127.0.0.1:1"] and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert a.members() == ["127.0.0.1:1"]
+        assert "127.0.0.1:2" not in a.ring
+        # a now owns everything: no task can be refused
+        for i in range(20):
+            a.check_owner(f"task-{i}")
+    finally:
+        a.leave()
+        b.leave()
+
+
+def test_graceful_leave_deletes_the_lease_immediately():
+    kv = KVStore()
+    m = FleetMembership(kv, "127.0.0.1:9", FleetConfig(lease_ttl=30.0))
+    m.join()
+    assert fleet.read_members(kv) == ["127.0.0.1:9"]
+    m.leave()
+    assert fleet.read_members(kv) == []  # no 30s TTL wait
+
+
+# ---------------------------------------------------------------------------
+# ownership: join-triggered rebalance moves only remapped tasks
+# ---------------------------------------------------------------------------
+
+
+def test_join_rebalance_refuses_only_remapped_tasks():
+    kv = KVStore()
+    cfg = FleetConfig(lease_ttl=5.0, grace_s=0.0)
+    a = FleetMembership(kv, "127.0.0.1:1", cfg)
+    a.join()
+    try:
+        tasks = [f"task-{i}" for i in range(300)]
+        for t in tasks:
+            a.check_owner(t)  # sole member owns everything
+
+        b = FleetMembership(kv, "127.0.0.1:2", cfg)
+        b.join()
+        try:
+            a.reconcile()
+            moved = stayed = 0
+            for t in tasks:
+                owner = a.owner_of(t)
+                if owner == "127.0.0.1:1":
+                    a.check_owner(t)  # unmoved: still served here
+                    stayed += 1
+                else:
+                    with pytest.raises(WrongShardError) as exc:
+                        a.check_owner(t)
+                    assert exc.value.owner == "127.0.0.1:2"
+                    moved += 1
+            # bounded hand-off: a join moves roughly half, never all
+            assert 0 < moved < len(tasks) and stayed > 0
+        finally:
+            b.leave()
+    finally:
+        a.leave()
+
+
+def test_grace_window_drains_in_flight_tasks_on_the_old_owner():
+    kv = KVStore()
+    cfg = FleetConfig(lease_ttl=5.0, grace_s=5.0)
+    a = FleetMembership(kv, "127.0.0.1:1", cfg)
+    a.join()
+    b = FleetMembership(kv, "127.0.0.1:2", cfg)
+    b.join()
+    try:
+        a.reconcile()
+        remapped = next(
+            t for t in (f"task-{i}" for i in range(300))
+            if a.owner_of(t) == "127.0.0.1:2"
+        )
+        # fresh task: refused outright
+        with pytest.raises(WrongShardError):
+            a.check_owner(remapped)
+        # in-flight task: drains here while the grace window is open
+        a.check_owner(remapped, task_in_flight=True)
+        # grace over → even in-flight registers move
+        a._ring_changed_at = time.monotonic() - cfg.grace_s - 1.0
+        with pytest.raises(WrongShardError):
+            a.check_owner(remapped, task_in_flight=True)
+    finally:
+        b.leave()
+        a.leave()
+
+
+def test_wrong_shard_wire_protocol_round_trips():
+    s = fleet.format_wrong_shard("10.0.0.3:8002", 17)
+    assert fleet.parse_wrong_shard(s) == ("10.0.0.3:8002", 17)
+    # gRPC wraps details in debug context; parse anywhere in the text
+    wrapped = f'<RpcError ... details = "{s}" ...>'
+    assert fleet.parse_wrong_shard(wrapped) == ("10.0.0.3:8002", 17)
+    assert fleet.parse_wrong_shard("deadline exceeded") is None
+    assert fleet.parse_wrong_shard("") is None
+
+
+# ---------------------------------------------------------------------------
+# real-gRPC drills
+# ---------------------------------------------------------------------------
+
+
+def _fleet_scheduler(tmp_path, name, kv, cfg=None, port=0):
+    resource = res.Resource()
+    storage = Storage(tmp_path / f"rec-{name}", buffer_size=1)
+    service = SchedulerService(
+        resource,
+        Scheduling(
+            BaseEvaluator(),
+            SchedulingConfig(retry_interval=0.0, retry_back_to_source_limit=2),
+        ),
+        storage=storage,
+    )
+    server, bound = serve({SERVICE_NAME: service}, address=f"127.0.0.1:{port}")
+    addr = f"127.0.0.1:{bound}"
+    membership = FleetMembership(
+        kv, addr, cfg or FleetConfig(lease_ttl=1.0, renew_interval=0.25,
+                                     poll_interval=0.2, grace_s=0.0)
+    )
+    membership.join()
+    service.fleet = membership
+    return {
+        "resource": resource, "server": server, "port": bound,
+        "addr": addr, "fleet": membership, "service": service,
+    }
+
+
+def _daemon(tmp_path, name, addresses, **kw):
+    d = Daemon(
+        DaemonConfig(
+            data_dir=str(tmp_path / f"daemon-{name}"),
+            scheduler_address=addresses,
+            hostname=f"host-{name}",
+            piece_length=PIECE,
+            announce_interval=kw.pop("announce_interval", 0.5),
+            schedule_timeout=kw.pop("schedule_timeout", 8.0),
+            **kw,
+        )
+    )
+    d.start()
+    return d
+
+
+def test_wrong_shard_refusal_daemon_repicks_over_grpc(tmp_path):
+    """A daemon with a stale one-member view announces to the wrong
+    scheduler; the typed WRONG_SHARD refusal sends it through refresh →
+    re-pick, and the download lands on the real owner."""
+    kv = KVStore()
+    s1 = _fleet_scheduler(tmp_path, "one", kv)
+    s2 = _fleet_scheduler(tmp_path, "two", kv)
+    s1["fleet"].reconcile()
+    s2["fleet"].reconcile()
+    d = None
+    try:
+        payload = os.urandom(3 * PIECE)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        url = f"file://{origin}"
+        task_id = task_id_v1(url, URLMeta())
+        owner_addr = s1["fleet"].owner_of(task_id)
+        owner, non_owner = (
+            (s1, s2) if owner_addr == s1["addr"] else (s2, s1)
+        )
+
+        refused_before = _wrong_shard_count("scheduler")
+        repicked_before = _wrong_shard_count("daemon")
+        # stale daemon: static list holds ONLY the non-owner; the live
+        # member feed is wired but not yet polled
+        d = _daemon(tmp_path, "stale", non_owner["addr"])
+        d._selector.set_membership_source(lambda: fleet.read_members(kv))
+
+        out = tmp_path / "out.bin"
+        dfget.download(f"127.0.0.1:{d.port}", url, str(out))
+        assert out.read_bytes() == payload
+
+        # the task landed on its ring owner, not where the daemon aimed
+        assert [t.id for t in owner["resource"].task_manager.all()] == [task_id]
+        assert non_owner["resource"].task_manager.all() == []
+        assert _wrong_shard_count("scheduler") > refused_before
+        assert _wrong_shard_count("daemon") > repicked_before
+    finally:
+        if d is not None:
+            d.stop()
+        for s in (s1, s2):
+            s["fleet"].leave()
+            s["server"].stop(0)
+
+
+def _wrong_shard_count(side: str) -> float:
+    return sum(
+        c.value
+        for labels, c in fleet.WRONG_SHARD_TOTAL._snapshot()
+        if labels == (side,)
+    )
+
+
+def _two_shard_cluster(tmp_path, kv, cfg):
+    s1 = _fleet_scheduler(tmp_path, "one", kv, cfg)
+    s2 = _fleet_scheduler(tmp_path, "two", kv, cfg)
+    s1["fleet"].reconcile()
+    s2["fleet"].reconcile()
+    return s1, s2
+
+
+def _teardown(daemons, schedulers):
+    for d in daemons:
+        if d is not None:
+            try:
+                d.stop()
+            except Exception:
+                pass
+    for s in schedulers:
+        try:
+            s["fleet"].abandon()
+            s["server"].stop(0)
+        except Exception:
+            pass
+
+
+def test_owner_sigkill_mid_download_is_lossless(tmp_path, clean_faults):
+    """The task's owner dies abruptly (gRPC plane gone, lease left to
+    expire) while a P2P download is in flight: the piece plane keeps
+    pulling from the live parent and the download completes — correct
+    bytes, no hang, no origin fallback. The announce plane's loss is
+    absorbed, not amplified."""
+    from dragonfly2_tpu.client import metrics as CM
+
+    kv = KVStore()
+    cfg = FleetConfig(
+        lease_ttl=0.8, renew_interval=0.2, poll_interval=0.15, grace_s=10.0
+    )
+    s1, s2 = _two_shard_cluster(tmp_path, kv, cfg)
+    addrs = f"{s1['addr']},{s2['addr']}"
+    a = b = None
+    try:
+        a = _daemon(tmp_path, "a", addrs, announce_interval=0.3)
+        b = _daemon(tmp_path, "b", addrs, announce_interval=0.3)
+        for d in (a, b):
+            d._selector.set_membership_source(lambda: fleet.read_members(kv))
+
+        payload = os.urandom(6 * PIECE)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        url = f"file://{origin}"
+        task_id = task_id_v1(url, URLMeta())
+        owner_addr = s1["fleet"].owner_of(task_id)
+        owner = s1 if owner_addr == s1["addr"] else s2
+
+        # seed on A so B's download runs P2P
+        dfget.download(f"127.0.0.1:{a.port}", url, str(tmp_path / "a.bin"))
+
+        # stretch B's piece fetches so the kill lands mid-download
+        faults.configure("daemon.piece_read=delay:150")
+        bts_before = CM.BACK_TO_SOURCE_TOTAL.value
+        out = tmp_path / "b.bin"
+        result: dict = {}
+
+        def work():
+            try:
+                dfget.download(f"127.0.0.1:{b.port}", url, str(out))
+                result["ok"] = True
+            except Exception as e:
+                result["error"] = str(e)
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        time.sleep(0.3)  # inside the ~0.9s slowed download window
+        # SIGKILL shape: serving plane gone, lease abandoned (expires)
+        owner["server"].stop(None)
+        owner["fleet"].abandon()
+
+        t.join(30.0)
+        assert not t.is_alive(), "download hung across the owner's death"
+        assert result.get("ok"), result.get("error")
+        assert out.read_bytes() == payload
+        assert CM.BACK_TO_SOURCE_TOTAL.value == bts_before
+    finally:
+        faults.clear()
+        _teardown((b, a), (s2, s1))
+
+
+def test_dead_member_task_fails_over_within_bounded_blackout(tmp_path):
+    """A task owned by a freshly-dead member (lease still live) must
+    still schedule: for_task walks to the ring successor, the successor
+    refuses WRONG_SHARD while the corpse is leased, and the daemon rides
+    the retry window until expiry flips ownership — bounded by one lease
+    TTL + one poll, never an error or a hang."""
+    kv = KVStore()
+    cfg = FleetConfig(
+        lease_ttl=0.8, renew_interval=0.2, poll_interval=0.15, grace_s=0.0
+    )
+    s1, s2 = _two_shard_cluster(tmp_path, kv, cfg)
+    addrs = f"{s1['addr']},{s2['addr']}"
+    d = None
+    try:
+        d = _daemon(tmp_path, "d", addrs, announce_interval=0.3)
+        d._selector.set_membership_source(lambda: fleet.read_members(kv))
+
+        # find a payload whose task pins to s1, then kill s1
+        for i in range(50):
+            origin = tmp_path / f"o-{i}.bin"
+            url = f"file://{origin}"
+            if s1["fleet"].owner_of(task_id_v1(url, URLMeta())) == s1["addr"]:
+                break
+        payload = os.urandom(2 * PIECE)
+        origin.write_bytes(payload)
+        task_id = task_id_v1(url, URLMeta())
+
+        s1["server"].stop(None)
+        s1["fleet"].abandon()
+        t_kill = time.monotonic()
+
+        out = tmp_path / "out.bin"
+        dfget.download(f"127.0.0.1:{d.port}", url, str(out))
+        blackout_s = time.monotonic() - t_kill
+        assert out.read_bytes() == payload
+        # the survivor owns the task now
+        assert task_id in {t.id for t in s2["resource"].task_manager.all()}
+        # bounded blackout: TTL + poll + scheduling/backoff slack
+        assert blackout_s < cfg.lease_ttl + cfg.poll_interval + 8.0, blackout_s
+    finally:
+        _teardown((d,), (s2, s1))
+
+
+def test_announce_stream_resumes_on_successor_with_same_peer_id(tmp_path):
+    """Protocol-level owner-move drill (what the conductor's
+    _restart_stream does): peer P registers with the owner, the owner
+    dies, and the SAME peer_id re-registers through for_task — which now
+    resolves the ring successor — and gets re-dispatched. The move is a
+    reconnect, not a new identity."""
+    import queue as _queue
+
+    import common_pb2
+    import scheduler_pb2
+
+    kv = KVStore()
+    cfg = FleetConfig(
+        lease_ttl=0.6, renew_interval=0.2, poll_interval=0.15, grace_s=10.0
+    )
+    s1, s2 = _two_shard_cluster(tmp_path, kv, cfg)
+    sel = SchedulerSelector([s1["addr"], s2["addr"]])
+    sel.set_membership_source(lambda: fleet.read_members(kv))
+    try:
+        url = "http://origin/fleet-resume.bin"
+        task_id = task_id_v1(url, URLMeta())
+        owner_addr = sel.addr_for_task(task_id)
+        owner, survivor = (s1, s2) if owner_addr == s1["addr"] else (s2, s1)
+        peer_id = "peer-fleet-resume-1"
+
+        def announce_once():
+            q: "_queue.Queue" = _queue.Queue()
+            q.put(
+                scheduler_pb2.AnnouncePeerRequest(
+                    host_id="host-x", task_id=task_id, peer_id=peer_id,
+                    register_peer=scheduler_pb2.RegisterPeerRequest(
+                        task_id=task_id, peer_id=peer_id, url=url,
+                        url_meta=common_pb2.UrlMeta(),
+                    ),
+                )
+            )
+            responses = sel.for_task(task_id).AnnouncePeer(iter(q.get, None))
+            first = next(responses)
+            q.put(None)
+            for _ in responses:
+                pass
+            return first
+
+        first = announce_once()
+        assert first.WhichOneof("response")
+        assert peer_id in {p.id for p in owner["resource"].peer_manager.all()}
+
+        # owner dies; its lease drains out
+        owner["server"].stop(None)
+        owner["fleet"].abandon()
+        deadline = time.monotonic() + 3.0
+        while owner["addr"] in fleet.read_members(kv):
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        survivor["fleet"].reconcile()
+        assert sel.refresh_membership() is True
+
+        # same peer_id, new stream: for_task now resolves the successor
+        resumed = announce_once()
+        assert resumed.WhichOneof("response")
+        assert peer_id in {
+            p.id for p in survivor["resource"].peer_manager.all()
+        }
+    finally:
+        sel.close()
+        _teardown((), (s2, s1))
+
+
+# ---------------------------------------------------------------------------
+# fault plane: fleet.lease_renew / fleet.membership_read
+# ---------------------------------------------------------------------------
+
+
+def test_lease_renew_faults_flap_member_without_data_loss(
+    tmp_path, clean_faults
+):
+    """A DF_FAULTS schedule on ``fleet.lease_renew`` starves a member's
+    heartbeat: its lease expires (flap out), later beats succeed (flap
+    back in). The flapped member keeps serving what it holds — a member
+    that lost its own lease must never refuse announces toward a ring it
+    is no longer part of — and a download through the flap completes."""
+    kv = KVStore()
+    cfg = FleetConfig(
+        lease_ttl=0.4, renew_interval=0.1, poll_interval=0.1, grace_s=0.0
+    )
+    s = _fleet_scheduler(tmp_path, "solo", kv, cfg)
+    d = None
+    try:
+        # join's beat was call #0; beats 1..8 fail → ~0.8s without
+        # renewal against a 0.4s TTL → the lease must lapse, then heal
+        faults.configure("fleet.lease_renew=error:UNAVAILABLE#1+8")
+        deadline = time.monotonic() + 3.0
+        flapped_out = False
+        while time.monotonic() < deadline and not flapped_out:
+            flapped_out = fleet.read_members(kv) == []
+            time.sleep(0.05)
+        assert flapped_out, "lease never lapsed under the renew faults"
+
+        # during the flap: the member serves on — a download completes
+        d = _daemon(tmp_path, "d", s["addr"])
+        payload = os.urandom(2 * PIECE)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        out = tmp_path / "out.bin"
+        dfget.download(f"127.0.0.1:{d.port}", f"file://{origin}", str(out))
+        assert out.read_bytes() == payload
+
+        # beats heal → the member re-leases itself
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if fleet.read_members(kv) == [s["addr"]]:
+                break
+            time.sleep(0.05)
+        assert fleet.read_members(kv) == [s["addr"]], "member never rejoined"
+    finally:
+        faults.clear()
+        if d is not None:
+            d.stop()
+        s["fleet"].leave()
+        s["server"].stop(0)
+
+
+def test_membership_read_faults_keep_the_stale_view(clean_faults):
+    """An unreachable membership plane (``fleet.membership_read``
+    errors) must never strand a watcher: the stale member set stands
+    until reads heal."""
+    kv = KVStore()
+    FleetMembership(kv, "127.0.0.1:1", FleetConfig(lease_ttl=30.0)).join()
+    seen: list = []
+    w = FleetWatcher(kv, seen.append, poll_interval=0.05)
+    assert w.poll_once() == ["127.0.0.1:1"]
+    assert seen == [["127.0.0.1:1"]]
+
+    faults.configure("fleet.membership_read=error:UNAVAILABLE")
+    assert w.poll_once() is None  # read failed, stale view kept
+    assert seen == [["127.0.0.1:1"]]
+    with pytest.raises(Exception):
+        fleet.read_members(kv)
+    faults.clear()
+    assert w.poll_once() == ["127.0.0.1:1"]
+
+
+def test_watcher_ignores_an_empty_member_set():
+    """No live leases ≠ no schedulers: the watcher must not push an
+    empty set into the selector (which would strand the daemon on
+    whatever it had — deliberately, but via the selector's own guard);
+    it simply keeps the last non-empty view."""
+    kv = KVStore()
+    pushes: list = []
+    w = FleetWatcher(kv, pushes.append, poll_interval=0.05)
+    assert w.poll_once() == []
+    assert pushes == []
+
+
+# ---------------------------------------------------------------------------
+# full assemblies: SchedulerServer fleet_enabled + Daemon kv_address
+# ---------------------------------------------------------------------------
+
+
+def test_server_assemblies_join_and_follow_the_fleet(tmp_path):
+    """The config-path integration: a real SchedulerServer with
+    ``fleet_enabled`` joins on serve (lease visible over the RESP
+    server) and leaves on stop; a real Daemon with ``kv_address``
+    adopts the leased member set through its FleetWatcher and a
+    download flows."""
+    from dragonfly2_tpu.scheduler.server import (
+        SchedulerServer,
+        SchedulerServerConfig,
+    )
+    from dragonfly2_tpu.utils import kvstore
+    from dragonfly2_tpu.utils.kvserver import KVServer
+
+    kv_server = KVServer()
+    kv_port = kv_server.serve()
+    kv_addr = f"127.0.0.1:{kv_port}"
+    s = SchedulerServer(
+        SchedulerServerConfig(
+            data_dir=str(tmp_path / "sched"),
+            kv_address=kv_addr,
+            fleet_enabled=True,
+            fleet_lease_ttl=1.0,
+            fleet_renew_interval=0.3,
+            fleet_poll_interval=0.2,
+            topology_backend="off",
+            storage_buffer_size=1,
+        )
+    )
+    d = None
+    remote = kvstore.RemoteKVStore(kv_addr)
+    try:
+        addr = s.serve()
+        assert fleet.read_members(remote) == [addr]
+
+        d = Daemon(
+            DaemonConfig(
+                data_dir=str(tmp_path / "daemon"),
+                scheduler_address=addr,
+                kv_address=kv_addr,
+                fleet_poll_interval=0.2,
+                hostname="fleet-host",
+                piece_length=PIECE,
+                announce_interval=60.0,
+                schedule_timeout=8.0,
+            )
+        )
+        d.start()
+        assert d._fleet_watcher is not None
+        assert d._selector.addresses == [addr]
+
+        payload = os.urandom(2 * PIECE)
+        origin = tmp_path / "o.bin"
+        origin.write_bytes(payload)
+        out = tmp_path / "out.bin"
+        dfget.download(f"127.0.0.1:{d.port}", f"file://{origin}", str(out))
+        assert out.read_bytes() == payload
+    finally:
+        if d is not None:
+            d.stop()
+        s.stop()
+        # graceful stop = graceful leave: the lease is gone NOW, not
+        # after the TTL
+        assert fleet.read_members(remote) == []
+        remote.close()
+        kv_server.stop()
+
+
+# ---------------------------------------------------------------------------
+# manager: fleet view in dynconfig
+# ---------------------------------------------------------------------------
+
+
+def test_manager_list_schedulers_scopes_to_live_leases(tmp_path):
+    import manager_pb2
+
+    from dragonfly2_tpu.manager.database import Database
+    from dragonfly2_tpu.manager.models_registry import ModelRegistry
+    from dragonfly2_tpu.manager.objectstorage import FSObjectStorage
+    from dragonfly2_tpu.manager.service import ManagerService
+
+    kv = KVStore()
+    db = Database(tmp_path / "m.db")
+    service = ManagerService(
+        db, ModelRegistry(db, FSObjectStorage(tmp_path / "o")), fleet_kv=kv
+    )
+    for i in (1, 2):
+        service.UpdateScheduler(
+            manager_pb2.UpdateSchedulerRequest(
+                hostname=f"s{i}", ip=f"10.0.0.{i}", port=8000 + i
+            ),
+            None,
+        )
+    req = manager_pb2.ListSchedulersRequest()
+    # no leases at all → keepalive registry stands alone
+    assert len(service.ListSchedulers(req, None).schedulers) == 2
+
+    # only s1 holds a live lease → dynconfig scopes to it
+    fleet.write_lease(kv, "10.0.0.1:8001", 30.0)
+    live = service.ListSchedulers(req, None).schedulers
+    assert [s.hostname for s in live] == ["s1"]
+
+    # a lease for an unknown member must not blank the list
+    kv.flushall()
+    fleet.write_lease(kv, "10.9.9.9:1", 30.0)
+    assert len(service.ListSchedulers(req, None).schedulers) == 2

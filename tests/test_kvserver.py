@@ -86,6 +86,25 @@ class TestRESPCommands:
         kv.set("still", "alive")  # same connection keeps working
         assert kv.get("still") == "alive"
 
+    def test_set_with_ttl_is_one_atomic_command(self, served):
+        """The fleet lease write: SET k v PX ms expires without a
+        separate PEXPIRE round trip (scheduler/fleet.py heartbeat)."""
+        _, kv = served
+        kv.set_with_ttl("lease", "x", 0.05)
+        assert kv.get("lease") == "x"
+        time.sleep(0.1)
+        assert kv.get("lease") is None
+        # EX form too (seconds)
+        kv._call("SET", "lease2", "y", "EX", "1")
+        assert kv.get("lease2") == "y"
+
+    def test_set_with_dangling_ttl_option_is_error_not_disconnect(self, served):
+        _, kv = served
+        with pytest.raises(ValueError):
+            kv._call("SET", "k", "v", "PX")  # option with no operand
+        kv.set("still", "here")  # connection survived the syntax error
+        assert kv.get("still") == "here"
+
     def test_flushall(self, served):
         _, kv = served
         kv.set("a", "1")

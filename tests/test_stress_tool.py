@@ -117,6 +117,40 @@ def test_stress_requires_exactly_one_target():
         stress.run("http://x", requests=1)
 
 
+def test_shard_kill_soak_success_and_bounded_blackout():
+    """Acceptance (ISSUE 8): 3 real scheduler shards under KV leases,
+    simulated-peer announce load, one shard SIGKILL'd mid-load —
+    success rate must be 1.0 with zero hangs, and the measured
+    ``fleet_blackout_ms`` bounded by one lease TTL + one membership
+    poll + announce/backoff slack. Deterministic: the blackout ends
+    when the dead lease expires, not on a race."""
+    lease_ttl, poll = 1.5, 0.3
+    stats = stress.shard_kill_soak(
+        peers=60,
+        shards=3,
+        workers=8,
+        lease_ttl=lease_ttl,
+        renew_interval=0.4,
+        poll_interval=poll,
+    )
+    assert stats["fleet_success_rate"] == 1.0, stats
+    assert stats["fleet_hangs"] == 0
+    assert stats["fleet_shards"] == 3
+    # blackout: bounded below by ~nothing, above by TTL + poll + slack
+    assert 0 <= stats["fleet_blackout_ms"] <= (lease_ttl + poll + 3.0) * 1e3, stats
+    assert stats["schedule_ops_per_s"] > 0
+    assert stats["fleet_wrong_shard_retries"] > 0  # the window was real
+    json.dumps(stats)  # one JSON-serializable line
+
+
+def test_shard_kill_cli_gates_on_success(capsys):
+    rc = stress.main(["--chaos", "--shard-kill", "--shard-peers", "30"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(line)
+    assert rc == 0, parsed
+    assert parsed["fleet_success_rate"] == 1.0
+
+
 def test_soak_ingest_tool_reports_bounded_memory():
     """The soak tool streams a multi-shard dataset and reports flat RSS
     (working set independent of decoded bytes — the 1B-record property).
