@@ -367,6 +367,59 @@ def fleet_shard_kill_bench() -> dict:
     return shard_kill_soak(peers=150, shards=3, workers=12)
 
 
+def telemetry_overhead_bench(iters: int = 200, trials: int = 5) -> dict:
+    """Telemetry-plane cost per push (ISSUE 9: the cluster telemetry
+    reporter must stay invisible next to the hot paths).
+
+    The reporter's entire per-push work — registry snapshot, changed-set
+    delta, JSON encode — runs in a tight loop against a registry
+    populated by the real scheduling microbench (so the snapshot walks
+    genuine series, not an empty registry). Steady state is measured:
+    after the first build the payload is the compact changed-only form,
+    exactly what a quiet production interval ships.
+
+    - ``telemetry_snapshot_us``: wall per full build+encode, best-of-
+      ``trials``.
+    - ``telemetry_push_overhead_pct``: that cost as a fraction of one
+      core over the default push interval — the duty cycle the
+      background pusher actually costs the process. Acceptance < 2%.
+    """
+    import json as _json
+
+    from dragonfly2_tpu.utils import telemetry as T
+
+    # real series content: exercise the scheduling hot path so the
+    # scheduler's own counters/histograms have live children to walk
+    sched, child = _scheduling_microbench()
+    for _ in range(200):
+        sched.schedule_candidate_parents(child, set())
+    rep = T.TelemetryReporter(
+        client=None,
+        service="scheduler",
+        instance="bench",
+        prefixes=("dragonfly_scheduler_", "dragonfly_fleet_", "dragonfly_rpc_"),
+    )
+    payload, cur = rep.build_payload()  # the one full push
+    series = (
+        len(cur["counters"]) + len(cur["gauges"]) + len(cur["hists"])
+    )
+    rep._prev = cur
+    rep._full_next = False
+    best = float("inf")
+    for _ in range(max(trials, 1)):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            payload, cur = rep.build_payload()
+            _json.dumps(payload, default=str)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    overhead_pct = best / T.DEFAULT_INTERVAL_S * 100.0
+    return {
+        "telemetry_push_overhead_pct": round(overhead_pct, 4),
+        "telemetry_snapshot_us": round(best * 1e6, 2),
+        "telemetry_series": series,
+    }
+
+
 def tracing_overhead_bench(iters: int = 1000, trials: int = 5) -> dict:
     """Tracing cost on the scheduling hot path when nothing samples.
 
@@ -620,6 +673,18 @@ def main() -> None:
         except Exception as e:
             host_rates["recorder_error"] = str(e)
             _phase(f"recorder bench failed: {e}")
+        # telemetry-plane overhead rides host_rates the same way: the
+        # reporter's per-push snapshot+encode must stay < 2% duty cycle
+        try:
+            host_rates.update(telemetry_overhead_bench())
+            _phase(
+                f"telemetry: push {host_rates['telemetry_snapshot_us']:.1f} us"
+                f" over {host_rates['telemetry_series']} series ="
+                f" {host_rates['telemetry_push_overhead_pct']:.4f}% duty cycle"
+            )
+        except Exception as e:
+            host_rates["telemetry_error"] = str(e)
+            _phase(f"telemetry bench failed: {e}")
         # resilience-layer overhead rides host_rates the same way: the
         # fault-free pre-flight (breaker/budget/deadline) must stay < 2%
         # of the scheduling hot-path wall

@@ -114,6 +114,9 @@ class DaemonConfig:
     # Prometheus /metrics endpoint: -1 = disabled
     metrics_port: int = -1
     metrics_host: str = "127.0.0.1"
+    # cluster telemetry push cadence over the manager channel
+    # (utils/telemetry.py, docs/telemetry.md); <= 0 disables
+    telemetry_interval: float = 15.0
     # global download budget in bytes/s shared across tasks (cross-task
     # sampling traffic shaper, reference traffic_shaper.go); 0 = off
     total_download_rate: float = 0.0
@@ -167,6 +170,7 @@ class Daemon:
         self._manager_channel = None
         self._fleet_kv = None
         self._fleet_watcher = None
+        self._telemetry_reporter = None
         self._threads: list[threading.Thread] = []
         self.gc = GC()
         self.task_manager: TaskManager | None = None
@@ -355,6 +359,33 @@ class Daemon:
             address=self.cfg.listen,
             extra_addresses=extra,
         )
+        from dragonfly2_tpu.utils.metrics import set_build_info
+
+        set_build_info("daemon")
+        if self._manager_channel is not None and self.cfg.telemetry_interval > 0:
+            # cluster telemetry: the daemon's data-plane rates to the
+            # manager over the dynconfig channel it already holds
+            from dragonfly2_tpu.utils.telemetry import TelemetryReporter
+            from dragonfly2_tpu.version import __version__
+
+            def _sections():
+                return {
+                    "build": {"service": "daemon", "version": __version__},
+                    "endpoints": {
+                        "rpc": f"{self.cfg.ip}:{self.port}",
+                        "metrics": getattr(self, "metrics_addr", "") or "",
+                    },
+                }
+
+            self._telemetry_reporter = TelemetryReporter(
+                glue.ServiceClient(self._manager_channel, glue.TELEMETRY_SERVICE),
+                service="daemon",
+                instance=f"{self.cfg.ip}:{self.port}",
+                prefixes=("dragonfly_daemon_",),
+                interval=self.cfg.telemetry_interval,
+                collect_sections=_sections,
+            )
+            self._telemetry_reporter.start()
         # announce before the proxy/gateway open for business: a gateway
         # PUT may AnnounceTask immediately, which requires a known host
         self.announce_host()
@@ -445,6 +476,8 @@ class Daemon:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._telemetry_reporter is not None:
+            self._telemetry_reporter.stop()
         if self._fleet_watcher is not None:
             self._fleet_watcher.stop()
         if self._fleet_kv is not None:

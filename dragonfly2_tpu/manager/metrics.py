@@ -15,3 +15,25 @@ KEEPALIVE_TOTAL = _r.counter(
 MODEL_CREATED_TOTAL = _r.counter(
     "manager_model_created_total", "Models uploaded by trainers", ("type",)
 )
+
+# -- cluster telemetry plane (manager/telemetry.py, docs/telemetry.md) --
+TELEMETRY_REPORTS_TOTAL = _r.counter(
+    "manager_telemetry_reports_total",
+    "Telemetry reports received, by outcome",
+    ("service", "outcome"),  # outcome: applied | registered | duplicate
+)
+TELEMETRY_REPORTERS = _r.gauge(
+    "manager_telemetry_reporters",
+    "Reporters known to the telemetry plane",
+    ("service",),
+)
+SLO_BURN_RATE = _r.gauge(
+    "manager_slo_burn_rate",
+    "Error-budget burn rate per SLO and evaluation window",
+    ("slo", "window"),
+)
+SLO_BREACHED = _r.gauge(
+    "manager_slo_breached",
+    "1 while the SLO's multi-window burn rate is in breach",
+    ("slo",),
+)

@@ -151,6 +151,18 @@ class RestApi:
     def healthy(self, req):
         return {"status": "ok"}
 
+    # -- cluster telemetry (manager/telemetry.py, docs/telemetry.md) -----
+    @route("GET", "/api/v1/telemetry", auth=False)
+    def get_telemetry(self, req):
+        """Cluster-wide telemetry snapshot: per-service inventory, swarm
+        table, per-shard/per-trainer windowed aggregates, SLO burn
+        state. Unauthenticated like the health probes — it is the
+        observability surface dfstat/dfdoctor poll."""
+        plane = getattr(self.service, "telemetry", None)
+        if plane is None:
+            raise ApiError(503, "telemetry plane not enabled on this manager")
+        return plane.snapshot()
+
     # -- scheduler clusters ----------------------------------------------
     @route("GET", "/api/v1/scheduler-clusters")
     def list_scheduler_clusters(self, req):

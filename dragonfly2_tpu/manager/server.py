@@ -91,6 +91,13 @@ class ManagerServer:
             ca=self._load_ca(config),
             ca_token=config.issue_certs_token,
         )
+        # cluster telemetry plane (manager/telemetry.py): in-memory by
+        # design — reporters re-register and re-baseline after a manager
+        # restart, so the aggregates and the dedup state die together
+        from dragonfly2_tpu.manager.telemetry import TelemetryPlane
+
+        self.telemetry = TelemetryPlane()
+        self.service.telemetry = self.telemetry
         self._grpc = None
         self._rest = None
         self.rest_addr: str | None = None
@@ -127,10 +134,19 @@ class ManagerServer:
 
         # flight recorder: crash dumps + the Diagnose snapshot RPC
         flight.install("manager")
+        from dragonfly2_tpu.manager.telemetry import TelemetryService
         from dragonfly2_tpu.rpc.diagnose import DiagnoseService
+        from dragonfly2_tpu.utils.metrics import set_build_info
 
+        set_build_info("manager")
         self._grpc, port = glue.serve(
-            {SERVICE_NAME: self.service, glue.DIAGNOSE_SERVICE: DiagnoseService()},
+            {
+                SERVICE_NAME: self.service,
+                glue.DIAGNOSE_SERVICE: DiagnoseService(),
+                # telemetry rides the same channel every service already
+                # dials for KeepAlive/dynconfig
+                glue.TELEMETRY_SERVICE: TelemetryService(self.telemetry),
+            },
             self.cfg.listen,
             **glue.serve_tls_args(
                 self.cfg.tls_cert_file, self.cfg.tls_key_file, self.cfg.tls_client_ca_file
@@ -156,6 +172,11 @@ class ManagerServer:
             self._metrics = MetricsServer(default_registry, host=self.cfg.metrics_host, port=self.cfg.metrics_port)
             # liveness on the scrape port (/healthz): the gRPC plane up
             self._metrics.register_health("manager", lambda: self._grpc is not None)
+            # SLO state rides the liveness body next to the resilience
+            # map — a burning SLO is degraded, never a 503
+            self._metrics.register_status_section(
+                "slo", self.telemetry.health_section
+            )
             self.metrics_addr = self._metrics.start()
             logger.info("manager metrics on %s", self.metrics_addr)
         if self.cfg.kv_port >= 0:

@@ -29,6 +29,7 @@ import diagnose_pb2  # noqa: E402
 import manager_pb2  # noqa: E402
 import scheduler_pb2  # noqa: E402
 import scheduler_v1_pb2  # noqa: E402
+import telemetry_pb2  # noqa: E402
 import topology_pb2  # noqa: E402
 import trainer_pb2  # noqa: E402
 
@@ -51,6 +52,9 @@ DFDAEMON_SERVICE = "dragonfly2_tpu.dfdaemon.Dfdaemon"
 # flight-recorder snapshots (utils/flight); every server assembly binds
 # it so any live process can explain itself without restarting
 DIAGNOSE_SERVICE = "dragonfly2_tpu.diagnose.Diagnose"
+# cluster telemetry plane (docs/telemetry.md): services push metric
+# snapshots to the manager over the channel they already hold
+TELEMETRY_SERVICE = "dragonfly2_tpu.telemetry.Telemetry"
 
 UNARY = "unary_unary"
 UNARY_STREAM = "unary_stream"
@@ -165,6 +169,11 @@ SERVICES: dict[str, dict[str, Method]] = {
     DIAGNOSE_SERVICE: {
         "Diagnose": Method(
             UNARY, diagnose_pb2.DiagnoseRequest, diagnose_pb2.DiagnoseResponse
+        ),
+    },
+    TELEMETRY_SERVICE: {
+        "ReportTelemetry": Method(
+            UNARY, telemetry_pb2.TelemetryReport, telemetry_pb2.TelemetryAck
         ),
     },
     DFDAEMON_SERVICE: {

@@ -46,6 +46,9 @@ class SchedulerServerConfig:
     train_interval: float = 7 * 24 * 3600.0
     keepalive_interval: float = 30.0
     job_poll_interval: float = 5.0
+    # cluster telemetry push cadence (utils/telemetry.py → the manager's
+    # ReportTelemetry; docs/telemetry.md); <= 0 disables the reporter
+    telemetry_interval: float = 15.0
     # record sink rotation
     storage_max_size: int = 100 * 1024 * 1024
     storage_buffer_size: int = 64
@@ -292,6 +295,7 @@ class SchedulerServer:
         self._grpc = None
         self.port: int | None = None
         self.fleet = None
+        self.telemetry_reporter = None
 
     # ------------------------------------------------------------------
     def serve(self) -> str:
@@ -371,12 +375,30 @@ class SchedulerServer:
                 logger.warning("topology engine kv hydration failed", exc_info=True)
         if self.manager_client is not None:
             self._register_with_manager()
+        if self._manager_channel is not None and cfg.telemetry_interval > 0:
+            # cluster telemetry: periodic registry snapshot + live swarm
+            # table to the manager, riding the channel just dialed
+            from dragonfly2_tpu.utils.telemetry import TelemetryReporter
+
+            self.telemetry_reporter = TelemetryReporter(
+                glue.ServiceClient(self._manager_channel, glue.TELEMETRY_SERVICE),
+                service="scheduler",
+                instance=f"{cfg.advertise_ip}:{cfg.advertise_port or self.port}",
+                shard=f"{cfg.advertise_ip}:{cfg.advertise_port or self.port}",
+                prefixes=("dragonfly_scheduler_", "dragonfly_fleet_"),
+                interval=cfg.telemetry_interval,
+                collect_sections=self._telemetry_sections,
+            )
+            self.telemetry_reporter.start()
         self.announcer.serve()
         if self.model_refresher is not None:
             self.model_refresher.start()
         if self.job_worker is not None:
             self.job_worker.start()
         self.gc.start()
+        from dragonfly2_tpu.utils.metrics import set_build_info
+
+        set_build_info("scheduler")
         if cfg.metrics_port >= 0:
             from dragonfly2_tpu.scheduler import metrics  # noqa: F401
             from dragonfly2_tpu.utils.metrics import MetricsServer, default_registry
@@ -388,6 +410,61 @@ class SchedulerServer:
             logger.info("scheduler metrics on %s", self.metrics_addr)
         logger.info("scheduler gRPC on %s", addr)
         return addr
+
+    def _telemetry_sections(self) -> dict:
+        """The scheduler's structured telemetry sections: the live
+        per-task swarm table (peer/seeder counts, piece completion,
+        stragglers) plus identity/endpoints. Gauges are refreshed first
+        so the pushed registry snapshot is as current as the table."""
+        from dragonfly2_tpu.scheduler import metrics as _M
+        from dragonfly2_tpu.scheduler import resource as res
+        from dragonfly2_tpu.version import __version__
+
+        _M.refresh_resource_gauges(self.resource)
+        by_task: dict[str, list] = {}
+        for p in self.resource.peer_manager.all():
+            by_task.setdefault(p.task.id, []).append(p)
+        swarms = []
+        for task_id, peers in sorted(by_task.items())[:256]:
+            active = [
+                p
+                for p in peers
+                if not p.fsm.is_state(res.PEER_STATE_FAILED, res.PEER_STATE_LEAVE)
+            ]
+            seeders = sum(
+                1
+                for p in active
+                if p.host.type.is_seed or p.fsm.is_state(res.PEER_STATE_SUCCEEDED)
+            )
+            done = {p.id: p.finished_piece_count() for p in active}
+            running = [p for p in active if p.fsm.is_state(res.PEER_STATE_RUNNING)]
+            # stragglers: running peers at less than half the swarm's
+            # best progress — the tail the operator wants named
+            best = max((done[p.id] for p in running), default=0)
+            stragglers = sorted(
+                p.id for p in running if best >= 2 and done[p.id] * 2 < best
+            )[:5]
+            total = max(
+                int(peers[0].task.total_piece_count or 0), 0
+            ) if peers else 0
+            swarms.append(
+                {
+                    "task_id": task_id,
+                    "peers": len(active),
+                    "seeders": seeders,
+                    "done_pieces": int(sum(done.values())),
+                    "total_pieces": total,
+                    "stragglers": stragglers,
+                }
+            )
+        return {
+            "swarms": swarms,
+            "build": {"service": "scheduler", "version": __version__},
+            "endpoints": {
+                "rpc": f"{self.cfg.advertise_ip}:{self.cfg.advertise_port or self.port}",
+                "metrics": getattr(self, "metrics_addr", "") or "",
+            },
+        }
 
     def _register_with_manager(self) -> None:
         """Register with the manager before serving traffic (reference
@@ -422,6 +499,8 @@ class SchedulerServer:
             self.fleet.leave()
             if self.fleet.kv is not self.kvstore:
                 self.fleet.kv.close()  # the heartbeat's own RESP socket
+        if self.telemetry_reporter is not None:
+            self.telemetry_reporter.stop()
         if self.job_worker is not None:
             self.job_worker.stop()
         if self.model_refresher is not None:

@@ -87,6 +87,31 @@ def load_dumps(diag_dir: str) -> tuple[list[dict], list[Incident]]:
     return events, incidents
 
 
+def discover_from_manager(manager: str) -> list[str]:
+    """Live service RPC addresses from the manager's telemetry plane
+    (/api/v1/telemetry ``services[].endpoints.rpc``) — the discovery
+    that replaces hand-typed repeated ``--rpc`` flags. Stale reporters
+    are skipped (their process stopped pushing; a Diagnose dial would
+    only burn the timeout). Unreachable manager → empty list with a
+    note, matching collect_rpc's degrade-don't-die behavior."""
+    from dragonfly2_tpu.tools.dfstat import fetch
+
+    try:
+        snap = fetch(manager)
+    except Exception as e:
+        print(
+            f"dfdoctor: manager {manager} unreachable ({e}); no discovery",
+            file=sys.stderr,
+        )
+        return []
+    out: list[str] = []
+    for svc in snap.get("services", []):
+        addr = (svc.get("endpoints") or {}).get("rpc", "")
+        if addr and not svc.get("stale"):
+            out.append(addr)
+    return sorted(set(out))
+
+
 def collect_rpc(addresses: list[str]) -> list[dict]:
     """Live ring snapshots over the Diagnose RPC, one per address.
     An unreachable service is reported and skipped — a postmortem must
@@ -245,6 +270,13 @@ def main(argv: list[str] | None = None) -> int:
         help="also snapshot a live service over the Diagnose RPC (repeatable)",
     )
     p.add_argument(
+        "--from-manager",
+        default="",
+        metavar="HOST:PORT",
+        help="discover live service addresses from the manager telemetry"
+        " plane (/api/v1/telemetry) instead of repeated --rpc flags",
+    )
+    p.add_argument(
         "--window",
         type=float,
         default=120.0,
@@ -252,8 +284,21 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument("--list", action="store_true", help="summarize dumps and exit")
     args = p.parse_args(argv)
-    if not args.diag and not args.rpc:
-        p.error("nothing to read: pass --diag/--rpc or set DF_DIAG_DIR")
+    if args.from_manager:
+        discovered = discover_from_manager(args.from_manager)
+        if discovered:
+            print(
+                f"dfdoctor: manager names {len(discovered)} live service(s):"
+                f" {', '.join(discovered)}",
+                file=sys.stderr,
+            )
+        args.rpc = list(args.rpc) + [
+            a for a in discovered if a not in args.rpc
+        ]
+    if not args.diag and not args.rpc and not args.from_manager:
+        p.error(
+            "nothing to read: pass --diag/--rpc/--from-manager or set DF_DIAG_DIR"
+        )
 
     events: list[dict] = []
     incidents: list[Incident] = []

@@ -362,9 +362,13 @@ def _faults_injected_total() -> int:
 
 
 def _spawn_scheduler(workdir: str, kv_addr: str, lease_ttl: float,
-                     renew: float, poll: float):
+                     renew: float, poll: float, manager_addr: str = "",
+                     telemetry_interval: float = 0.5):
     """One real scheduler process joined to the fleet; returns
-    (Popen, addr). Killed with SIGKILL later — which is the point."""
+    (Popen, addr). Killed with SIGKILL later — which is the point.
+    With ``manager_addr`` the shard also registers with the manager and
+    pushes telemetry every ``telemetry_interval`` — the soak then checks
+    the manager's view of the kill against the measured blackout."""
     import subprocess
 
     repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -374,22 +378,28 @@ def _spawn_scheduler(workdir: str, kv_addr: str, lease_ttl: float,
         PYTHONUNBUFFERED="1",
         DF_JAX_PLATFORM=os.environ.get("DF_JAX_PLATFORM", "cpu"),
     )
+    args = [
+        sys.executable, "-m", "dragonfly2_tpu.scheduler",
+        "--set", f"data_dir={workdir}",
+        "--set", f"kv_address={kv_addr}",
+        "--set", "fleet_enabled=true",
+        "--set", f"fleet_lease_ttl={lease_ttl}",
+        "--set", f"fleet_renew_interval={renew}",
+        "--set", f"fleet_poll_interval={poll}",
+        "--set", "fleet_grace_s=2.0",
+        # the soak drives the announce plane, not the topology/ML
+        # planes — keep shard boot light and jax out of the children
+        "--set", "topology_backend=off",
+        "--set", "storage_buffer_size=1",
+        "--set", "retry_interval=0.0",
+    ]
+    if manager_addr:
+        args += [
+            "--set", f"manager_address={manager_addr}",
+            "--set", f"telemetry_interval={telemetry_interval}",
+        ]
     proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "dragonfly2_tpu.scheduler",
-            "--set", f"data_dir={workdir}",
-            "--set", f"kv_address={kv_addr}",
-            "--set", "fleet_enabled=true",
-            "--set", f"fleet_lease_ttl={lease_ttl}",
-            "--set", f"fleet_renew_interval={renew}",
-            "--set", f"fleet_poll_interval={poll}",
-            "--set", "fleet_grace_s=2.0",
-            # the soak drives the announce plane, not the topology/ML
-            # planes — keep shard boot light and jax out of the children
-            "--set", "topology_backend=off",
-            "--set", "storage_buffer_size=1",
-            "--set", "retry_interval=0.0",
-        ],
+        args,
         env=env,
         stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL,
@@ -439,6 +449,7 @@ def shard_kill_soak(
     poll_interval: float = 0.4,
     op_deadline_s: float = 25.0,
     wall_deadline_s: float = 180.0,
+    telemetry: bool = True,
 ) -> dict:
     """The fleet-failover acceptance soak: ``shards`` real scheduler
     processes under KV leases, ``peers`` simulated announce ops riding
@@ -451,6 +462,16 @@ def shard_kill_soak(
     ``fleet_blackout_ms`` (SIGKILL → first successful announce for a
     task the victim owned) must stay inside one lease TTL + one
     membership poll + scheduling slack.
+
+    With ``telemetry`` (default) an in-process manager rides along and
+    every shard pushes telemetry to it: the soak then ALSO measures the
+    manager's view of the kill — ``fleet_manager_blackout_ms`` (SIGKILL
+    → the victim's shard reported stale at /api/v1/telemetry) and the
+    manager-aggregated ``fleet_manager_schedule_ops_per_s`` — so the
+    control plane's picture is checked against the daemon-measured
+    blackout, not assumed. Telemetry failures degrade to a
+    ``fleet_telemetry_error`` key; the failover gates never depend on
+    the observability plane being up.
     """
     import queue as _queue
     import shutil
@@ -474,12 +495,35 @@ def shard_kill_soak(
     procs: list = []
     sel = watcher = None
     watcher_kv = None
+    manager = None
+    manager_grpc_addr = ""
+    telemetry_error = ""
+    if telemetry:
+        try:
+            from dragonfly2_tpu.manager.server import (
+                ManagerServer,
+                ManagerServerConfig,
+            )
+
+            manager = ManagerServer(
+                ManagerServerConfig(
+                    data_dir=os.path.join(tmp, "manager"),
+                    rest_port=0,
+                    db_cache_ttl=0.0,
+                    issue_certs=False,
+                )
+            )
+            manager_grpc_addr = manager.serve()
+        except Exception as e:
+            telemetry_error = f"manager boot failed: {e}"
+            manager = None
     try:
         addrs = []
         for i in range(shards):
             proc, addr = _spawn_scheduler(
                 os.path.join(tmp, f"sched-{i}"), kv_addr,
                 lease_ttl, renew_interval, poll_interval,
+                manager_addr=manager_grpc_addr,
             )
             procs.append(proc)
             addrs.append(addr)
@@ -606,6 +650,39 @@ def shard_kill_soak(
         if announce_op(probe_key, 999_999, op_deadline_s):
             blackout_ms = (time.monotonic() - t_kill) * 1e3
 
+        # the manager's view of the same kill: the victim's telemetry
+        # pushes stop, so its shard row flips stale at /api/v1/telemetry
+        # within (staleness window + push interval) of the SIGKILL
+        manager_blackout_ms = -1.0
+        manager_ops = -1.0
+        manager_shards = 0
+        if manager is not None:
+            from dragonfly2_tpu.tools.dfstat import fetch as _manager_fetch
+
+            def _manager_snapshot():
+                return _manager_fetch(manager.rest_addr)
+
+            try:
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    snap = _manager_snapshot()
+                    by_shard = {s["shard"]: s for s in snap.get("shards", [])}
+                    manager_shards = len(by_shard)
+                    victim_row = by_shard.get(victim_addr)
+                    if victim_row is not None and victim_row["stale"]:
+                        manager_blackout_ms = (time.monotonic() - t_kill) * 1e3
+                        break
+                    time.sleep(0.25)
+                else:
+                    telemetry_error = (
+                        telemetry_error
+                        or "manager never marked the killed shard stale"
+                    )
+                snap = _manager_snapshot()
+                manager_ops = snap["cluster"]["schedule_ops_per_s"]["1m"]
+            except Exception as e:
+                telemetry_error = telemetry_error or f"manager view failed: {e}"
+
         hangs = 0
         hard_deadline = t_start + wall_deadline_s
         for t in threads:
@@ -618,7 +695,7 @@ def shard_kill_soak(
             ok, failed = counters["ok"], counters["failed"]
             wrong_shard = counters["wrong_shard"]
         total = ok + failed
-        return {
+        stats = {
             "fleet_shards": shards,
             "fleet_peers": peers,
             "fleet_success_rate": round(ok / total, 4) if total else 0.0,
@@ -628,6 +705,13 @@ def shard_kill_soak(
             "schedule_ops_per_s": round(ok / wall, 1) if wall else 0.0,
             "fleet_wall_s": round(wall, 2),
         }
+        if manager is not None or telemetry_error:
+            stats["fleet_manager_shards"] = manager_shards
+            stats["fleet_manager_blackout_ms"] = round(manager_blackout_ms, 1)
+            stats["fleet_manager_schedule_ops_per_s"] = manager_ops
+        if telemetry_error:
+            stats["fleet_telemetry_error"] = telemetry_error
+        return stats
     finally:
         if watcher is not None:
             watcher.stop()
@@ -643,6 +727,11 @@ def shard_kill_soak(
                 print(
                     f"stress: shard teardown kill failed: {e}", file=sys.stderr
                 )
+        if manager is not None:
+            try:
+                manager.stop()
+            except Exception:
+                pass
         kv_server.stop()
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -41,3 +41,11 @@ INGEST_STEP_SECONDS = _r.histogram(
 DATASET_BYTES_TOTAL = _r.counter(
     "trainer_dataset_bytes_total", "Dataset bytes received on Train streams", ("kind",)
 )
+# unix timestamp of the last SUCCESSFUL fit per model: the telemetry
+# plane's fit-freshness source (freshness = now - value; 0 = never) —
+# a gauge, so the manager can compute staleness without rate math
+LAST_FIT_TIMESTAMP = _r.gauge(
+    "trainer_last_fit_timestamp_seconds",
+    "Unix time of the last successful fit",
+    ("model",),
+)

@@ -52,6 +52,8 @@ class TrainerServerConfig:
     # Prometheus /metrics endpoint (reference trainer :8000): -1 = disabled
     metrics_port: int = -1
     metrics_host: str = "127.0.0.1"
+    # cluster telemetry push cadence (utils/telemetry.py); <= 0 disables
+    telemetry_interval: float = 15.0
     # gRPC TLS: PEM file paths; tls_client_ca_file enforces mTLS
     tls_cert_file: str = ""
     tls_key_file: str = ""
@@ -109,6 +111,7 @@ class TrainerServer:
             self.storage, self.training, synchronous=config.synchronous
         )
         self._grpc = None
+        self.telemetry_reporter = None
 
     def serve(self) -> str:
         # flight recorder: stall/crash dumps + the Diagnose snapshot RPC
@@ -127,6 +130,33 @@ class TrainerServer:
             ),
         )
         addr = f"{self.cfg.listen.rsplit(':', 1)[0]}:{port}"
+        from dragonfly2_tpu.utils.metrics import set_build_info
+
+        set_build_info("trainer")
+        if self._manager_channel is not None and self.cfg.telemetry_interval > 0:
+            # cluster telemetry: ingest throughput + fit freshness to the
+            # manager over the channel already dialed for CreateModel
+            from dragonfly2_tpu.utils.telemetry import TelemetryReporter
+            from dragonfly2_tpu.version import __version__
+
+            def sections():
+                return {
+                    "build": {"service": "trainer", "version": __version__},
+                    "endpoints": {
+                        "rpc": addr,
+                        "metrics": getattr(self, "metrics_addr", "") or "",
+                    },
+                }
+
+            self.telemetry_reporter = TelemetryReporter(
+                glue.ServiceClient(self._manager_channel, glue.TELEMETRY_SERVICE),
+                service="trainer",
+                instance=addr,
+                prefixes=("dragonfly_trainer_",),
+                interval=self.cfg.telemetry_interval,
+                collect_sections=sections,
+            )
+            self.telemetry_reporter.start()
         if self.cfg.metrics_port >= 0:
             from dragonfly2_tpu.trainer import metrics  # noqa: F401
             from dragonfly2_tpu.utils.metrics import MetricsServer, default_registry
@@ -140,6 +170,8 @@ class TrainerServer:
         return addr
 
     def stop(self) -> None:
+        if self.telemetry_reporter is not None:
+            self.telemetry_reporter.stop()
         if getattr(self, "_metrics", None) is not None:
             self._metrics.stop()
         if self._grpc is not None:

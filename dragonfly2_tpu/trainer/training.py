@@ -234,6 +234,9 @@ class Training:
             )
         span.end("ok")
         M.FIT_TOTAL.labels(model, "success").inc()
+        # fit-freshness source for the cluster telemetry plane: the SLO
+        # engine alarms when (now - this) outgrows the train cadence
+        M.LAST_FIT_TIMESTAMP.labels(model).set(time.time())
         return result
 
     def _maybe_profile(self, model: str):

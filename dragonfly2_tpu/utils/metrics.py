@@ -351,11 +351,20 @@ class MetricsServer:
         self._thread = None
         self._started_at = time.time()
         self._health: dict[str, object] = {}
+        self._status_sections: dict[str, object] = {}
 
     def register_health(self, service: str, probe) -> None:
         """Register a liveness probe: a zero-arg callable returning a
         truthy value (or raising) — e.g. ``lambda: server.running``."""
         self._health[service] = probe
+
+    def register_status_section(self, name: str, fn) -> None:
+        """Attach an extra section to the /healthz body: a zero-arg
+        callable whose dict result lands under ``name`` (e.g. the
+        manager's SLO state next to the resilience map). Sections are
+        informational — they can never flip the 200/503, and a failing
+        section is dropped, not fatal (liveness must always answer)."""
+        self._status_sections[name] = fn
 
     def health_snapshot(self) -> tuple[bool, dict]:
         services = {}
@@ -388,6 +397,13 @@ class MetricsServer:
             body["degraded"] = snap["degraded"]
         except Exception:
             pass  # liveness must answer even if the resilience plane can't
+        for name, fn in sorted(self._status_sections.items()):
+            try:
+                body[name] = fn()
+            except Exception as e:
+                # informational sections never break liveness, but a
+                # broken one is named in the body instead of vanishing
+                body.setdefault("status_section_errors", {})[name] = str(e)
         return ok, body
 
     def start(self) -> str:
@@ -513,3 +529,22 @@ class MetricsServer:
 # process-wide default registry: each service defines its series here and
 # the assembly exposes them on its /metrics port
 default_registry = Registry()
+
+# cross-service identity series: every exporter carries one
+# dragonfly_build_info{service,version} = 1 sample, so dashboards can
+# join any series to the build that produced it (uptime_s alone carries
+# no identity). A process hosting several services (tests, all-in-one
+# deploys) sets one sample per service name.
+BUILD_INFO = default_registry.gauge(
+    "build_info",
+    "Build identity of this exporter (value is always 1)",
+    ("service", "version"),
+)
+
+
+def set_build_info(service: str) -> None:
+    """Stamp the exporter identity sample; every server assembly calls
+    this on serve with its own service name."""
+    from dragonfly2_tpu.version import __version__
+
+    BUILD_INFO.labels(service, __version__).set(1)

@@ -27,10 +27,11 @@ PACKAGE = DEFAULT_PACKAGE
 
 # the service segment a series name must start with — one per process
 # role plus the shared rpc glue, flight-recorder, fault-plane and
-# resilience-layer series
+# resilience-layer series; "build" is the cross-service identity gauge
+# (dragonfly_build_info{service,version} — every exporter carries it)
 ALLOWED_SERVICES = (
     "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "flight",
-    "faults", "resilience", "fleet",
+    "faults", "resilience", "fleet", "build",
 )
 
 # flight-recorder event names are <service>.<what>; the service segment
@@ -44,6 +45,11 @@ EVENT_SERVICES = (
 
 # fault-point names are <layer>.<what>; mirrors utils/faults.POINT_LAYERS
 FAULT_LAYERS = ("rpc", "daemon", "scheduler", "trainer", "manager", "kv", "fleet")
+
+# telemetry aggregate fields are <scope>.<what>; mirrors
+# utils/telemetry.TELEMETRY_SCOPES (the manager-derived fields dfstat
+# renders — the census keeps the plane's vocabulary from drifting)
+TELEMETRY_SCOPES = ("cluster", "swarm", "shard", "trainer", "daemon", "slo")
 
 TESTS_DIR = PACKAGE.parent / "tests"
 
@@ -90,8 +96,30 @@ def check(package_dir: Path = PACKAGE) -> list[str]:
     seen: dict[str, tuple[str, str]] = {}  # name -> (kind, site)
     seen_events: dict[str, str] = {}  # event name -> site
     seen_points: dict[str, str] = {}  # fault point -> site
+    seen_tfields: dict[str, str] = {}  # telemetry field -> site
     for path in sorted(package_dir.rglob("*.py")):
         rel = path.relative_to(package_dir.parent)
+        for name, _attr, lineno in _literal_attr_calls(path, ("tfield",)):
+            site = f"{rel}:{lineno}"
+            if not all(c.islower() or c.isdigit() or c in "._" for c in name):
+                failures.append(
+                    f"{site}: telemetry field {name!r} has characters outside"
+                    " [a-z0-9_.]"
+                )
+            scope = name.split(".", 1)[0]
+            if "." not in name or scope not in TELEMETRY_SCOPES:
+                failures.append(
+                    f"{site}: telemetry field {name!r} must be <scope>.<what>"
+                    f" with scope in {TELEMETRY_SCOPES}"
+                )
+            prev_site = seen_tfields.get(name)
+            if prev_site is not None:
+                failures.append(
+                    f"{site}: duplicate telemetry-field registration of"
+                    f" {name!r} (first at {prev_site})"
+                )
+            else:
+                seen_tfields[name] = site
         for name, _attr, lineno in _literal_attr_calls(path, ("point",)):
             site = f"{rel}:{lineno}"
             if not all(c.islower() or c.isdigit() or c in "._" for c in name):
@@ -124,6 +152,19 @@ def check(package_dir: Path = PACKAGE) -> list[str]:
                 failures.append(
                     f"{site}: event {name!r} must be <service>.<what> with"
                     f" service in {EVENT_SERVICES}"
+                )
+            # SLO breach events belong to the manager's burn-rate engine
+            # alone: a stray scheduler.slo_* would fork the vocabulary
+            # dfdoctor/dfstat key on (manager.slo_burn / manager.slo_clear).
+            # Segment test, not substring: "daemon.slow_parent" is fine.
+            what = name.split(".", 1)[1] if "." in name else ""
+            if (
+                (what == "slo" or what.startswith("slo_"))
+                and not name.startswith("manager.slo_")
+            ):
+                failures.append(
+                    f"{site}: event {name!r} uses the reserved slo_ segment;"
+                    " SLO events must be manager.slo_<what>"
                 )
             prev_site = seen_events.get(name)
             if prev_site is not None:

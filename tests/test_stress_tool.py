@@ -123,7 +123,13 @@ def test_shard_kill_soak_success_and_bounded_blackout():
     success rate must be 1.0 with zero hangs, and the measured
     ``fleet_blackout_ms`` bounded by one lease TTL + one membership
     poll + announce/backoff slack. Deterministic: the blackout ends
-    when the dead lease expires, not on a race."""
+    when the dead lease expires, not on a race.
+
+    With the telemetry plane riding along (ISSUE 9), the manager's
+    view of the kill must MATCH the daemon-measured one: the victim's
+    shard flips stale within the staleness envelope of the same
+    SIGKILL, and the manager aggregates live schedule ops across the
+    surviving shards."""
     lease_ttl, poll = 1.5, 0.3
     stats = stress.shard_kill_soak(
         peers=60,
@@ -140,6 +146,19 @@ def test_shard_kill_soak_success_and_bounded_blackout():
     assert 0 <= stats["fleet_blackout_ms"] <= (lease_ttl + poll + 3.0) * 1e3, stats
     assert stats["schedule_ops_per_s"] > 0
     assert stats["fleet_wrong_shard_retries"] > 0  # the window was real
+    # the manager's view of the member kill (telemetry plane): all 3
+    # shards reported in, and the victim flipped stale within the
+    # staleness envelope of the SAME SIGKILL the announce plane measured:
+    # last push ≤0.5s before the kill + staleness floor 5s + soak poll
+    # 0.25s + scheduling slack — i.e. the manager detects the kill at
+    # its own (coarser) granularity, never misses it, never pre-dates it
+    assert "fleet_telemetry_error" not in stats, stats
+    assert stats["fleet_manager_shards"] == 3
+    # staleness floor is 5s (max(3×0.5s push interval, 5.0)): detection
+    # can't physically land before ~4.5s (last push up to 0.5s pre-kill)
+    # and must land within floor + push/poll/scheduling slack
+    assert 3_000 <= stats["fleet_manager_blackout_ms"] <= 9_000, stats
+    assert stats["fleet_manager_schedule_ops_per_s"] > 0
     json.dumps(stats)  # one JSON-serializable line
 
 

@@ -1,0 +1,742 @@
+"""Cluster telemetry plane (utils/telemetry.py reporter →
+manager/telemetry.py aggregates + SLO burn-rate engine → dfstat/
+dfdoctor surfaces; docs/telemetry.md).
+
+Covers the push protocol's lossy-delivery legs (manager restart
+re-registration without double counting, duplicate delivery dedup),
+the windowed aggregation + quantile math, SLO burn evaluation, the
+/healthz SLO section, OpenMetrics negotiation on the manager port, the
+build-info identity gauge — and one end-to-end test: a multi-service
+run (daemon + 2 schedulers + trainer) pushes telemetry, the manager's
+/api/v1/telemetry shows the per-swarm/per-shard aggregates, and an
+injected fault drives an SLO burn that appears in /healthz, a
+``manager.slo_burn`` flight event, and dfstat output.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from dragonfly2_tpu.manager.telemetry import (
+    SLOSpec,
+    TelemetryPlane,
+    TelemetryService,
+    quantile_from_buckets,
+)
+from dragonfly2_tpu.utils.metrics import Registry
+from dragonfly2_tpu.utils.telemetry import (
+    TELEMETRY_SCOPES,
+    TelemetryReporter,
+    _TelemetryFields,
+    changed_only,
+    registry_snapshot,
+)
+
+
+class _DirectClient:
+    """ReportTelemetry straight into a TelemetryService — the protocol
+    without a socket."""
+
+    def __init__(self, service: TelemetryService):
+        self.service = service
+
+    def ReportTelemetry(self, req, timeout=None):
+        class _Ctx:
+            def abort(self, code, msg):
+                raise RuntimeError(msg)
+
+        return self.service.ReportTelemetry(req, _Ctx())
+
+
+def _counted(plane: TelemetryPlane, key_prefix: str) -> float:
+    """Total delta the plane folded for counter series starting with
+    ``key_prefix`` (bucket walk — the number windowed rates are built
+    from)."""
+    total = 0.0
+    for rep in plane._reporters.values():
+        for b in rep.buckets:
+            for key, d in b.counters.items():
+                if key.startswith(key_prefix):
+                    total += d
+    return total
+
+
+# -- units: snapshot / delta ---------------------------------------------
+
+
+def test_registry_snapshot_and_changed_only():
+    r = Registry("t9")
+    c = r.counter("scheduler_ops_total", "", ("kind",))
+    g = r.gauge("scheduler_depth")
+    h = r.histogram("scheduler_lat_seconds", buckets=(0.1, 1.0))
+    c.labels("a").inc(3)
+    g.set(7)
+    h.observe(0.05)
+    snap = registry_snapshot(r)
+    assert snap["counters"]["t9_scheduler_ops_total{kind=a}"] == 3.0
+    assert snap["gauges"]["t9_scheduler_depth"] == 7.0
+    assert snap["hists"]["t9_scheduler_lat_seconds"]["count"] == 1
+    # nothing moved: the compact form is empty
+    again = registry_snapshot(r)
+    delta = changed_only(again, snap)
+    assert not delta["counters"] and not delta["gauges"] and not delta["hists"]
+    c.labels("a").inc()
+    delta = changed_only(registry_snapshot(r), snap)
+    # cumulative value rides the compact form — the manager subtracts
+    assert delta["counters"] == {"t9_scheduler_ops_total{kind=a}": 4.0}
+    # prefix filter drops foreign series
+    assert registry_snapshot(r, prefixes=("nope_",))["counters"] == {}
+
+
+def test_quantile_from_buckets():
+    buckets = {"0.1": 50.0, "0.5": 90.0, "1.0": 100.0, "+Inf": 100.0}
+    assert quantile_from_buckets(buckets, 0.5) == 0.1
+    assert 0.1 < quantile_from_buckets(buckets, 0.9) <= 0.5
+    # +Inf clamps to the last finite edge
+    assert quantile_from_buckets(buckets, 0.999) <= 1.0
+    assert quantile_from_buckets({}, 0.99) == 0.0
+
+
+def test_tfield_census_rules():
+    f = _TelemetryFields()
+    assert f.tfield("shard.ops") == "ops"
+    with pytest.raises(ValueError):
+        f.tfield("warpcore.ops")  # unknown scope
+    with pytest.raises(ValueError):
+        f.tfield("shard.ops")  # duplicate
+    assert set(TELEMETRY_SCOPES) >= {"swarm", "shard", "slo"}
+
+
+# -- units: push protocol -------------------------------------------------
+
+
+def _reporter_and_plane():
+    plane = TelemetryPlane(slos=[])
+    reg = Registry("t9p")
+    counter = reg.counter("scheduler_work_total")
+    rep = TelemetryReporter(
+        _DirectClient(TelemetryService(plane)),
+        service="scheduler",
+        instance="127.0.0.1:1",
+        shard="127.0.0.1:1",
+        interval=0.01,
+        registry=reg,
+    )
+    return plane, reg, counter, rep
+
+
+def test_push_protocol_counts_deltas_once():
+    plane, reg, counter, rep = _reporter_and_plane()
+    counter.inc(5)
+    assert rep.push_once()  # registration push: baseline only
+    assert _counted(plane, "t9p_scheduler_work_total") == 0.0
+    counter.inc(3)
+    assert rep.push_once()
+    assert _counted(plane, "t9p_scheduler_work_total") == 3.0
+    # an unchanged interval folds nothing
+    assert rep.push_once()
+    assert _counted(plane, "t9p_scheduler_work_total") == 3.0
+
+
+def test_duplicate_delivery_is_dropped():
+    from dragonfly2_tpu.rpc import gen  # noqa: F401 — flat imports
+    import telemetry_pb2
+
+    plane, reg, counter, rep = _reporter_and_plane()
+    service = rep.client.service
+    counter.inc(2)
+    rep.push_once()
+    counter.inc(4)
+    rep.push_once()
+    assert _counted(plane, "t9p_scheduler_work_total") == 4.0
+    # replay the last report's seq (retry after a lost ack)
+    replay = telemetry_pb2.TelemetryReport(
+        service="scheduler",
+        instance="127.0.0.1:1",
+        epoch=rep.epoch,
+        seq=rep.seq,  # same seq as the applied push
+        interval_s=0.01,
+        payload_json=json.dumps(
+            {"counters": {"t9p_scheduler_work_total": 6.0}}
+        ),
+    )
+    ack = _DirectClient(service).ReportTelemetry(replay)
+    assert ack.last_seq == rep.seq
+    assert _counted(plane, "t9p_scheduler_work_total") == 4.0  # unchanged
+
+
+def test_manager_restart_no_double_counting():
+    """The satellite contract: the delta push survives a manager restart
+    — the reporter re-registers and totals never double count."""
+    plane1, reg, counter, rep = _reporter_and_plane()
+    counter.inc(10)
+    rep.push_once()  # baseline
+    counter.inc(3)
+    rep.push_once()
+    assert _counted(plane1, "t9p_scheduler_work_total") == 3.0
+
+    # manager restarts: fresh plane, same reporter keeps pushing
+    plane2 = TelemetryPlane(slos=[])
+    rep.client = _DirectClient(TelemetryService(plane2))
+    assert rep.push_once()  # re-registration (ack.registered=True)
+    assert rep._full_next  # the reporter owes a full snapshot
+    counter.inc(2)
+    rep.push_once()  # the full push: plane2 baselines every series
+    counter.inc(4)
+    rep.push_once()
+    counted = _counted(plane2, "t9p_scheduler_work_total")
+    # post-restart deltas counted exactly once, never the pre-restart
+    # history (13) and never more than the post-restart increments (6)
+    assert counted == 4.0
+    (r2,) = plane2._reporters.values()
+    assert r2.counters_cum["t9p_scheduler_work_total"] == 19.0
+
+
+def test_lost_registration_ack_cannot_replay_history():
+    """A lost registration ack must not strand the reporter changed-only
+    forever: the manager keeps answering registered=True until a FULL
+    payload lands, and unknown series stay baselined in the meantime —
+    a quiet counter's later first tick can never replay its cumulative
+    history as one burn spike."""
+    from dragonfly2_tpu.rpc import gen  # noqa: F401 — flat imports
+    import telemetry_pb2
+
+    plane = TelemetryPlane(slos=[])
+    client = _DirectClient(TelemetryService(plane))
+
+    def send(seq, payload):
+        return client.ReportTelemetry(
+            telemetry_pb2.TelemetryReport(
+                service="scheduler", instance="i", epoch="e1", seq=seq,
+                interval_s=0.01, payload_json=json.dumps(payload),
+            )
+        )
+
+    # registration push: changed-only subset (the manager just restarted
+    # mid-stream) — baselined, and the ack asks for a full
+    ack = send(1, {"counters": {"t9x_scheduler_a_total": 50.0}})
+    assert ack.registered
+    # the ack was LOST: the reporter keeps pushing changed-only; a
+    # series with history ticks for the first time post-restart
+    ack = send(2, {"counters": {"t9x_scheduler_quiet_total": 121.0}})
+    assert ack.registered  # still asking — full never arrived
+    assert _counted(plane, "t9x_scheduler_quiet_total") == 0.0  # no replay
+    # the full snapshot finally lands: baselines settle, asking stops
+    ack = send(3, {
+        "full": True,
+        "counters": {"t9x_scheduler_a_total": 50.0,
+                     "t9x_scheduler_quiet_total": 121.0},
+    })
+    assert not ack.registered
+    # from here, genuinely new activity counts from zero
+    ack = send(4, {"counters": {"t9x_scheduler_quiet_total": 124.0}})
+    assert not ack.registered
+    assert _counted(plane, "t9x_scheduler_quiet_total") == 3.0
+
+
+def test_p99_when_every_observation_exceeds_finite_edges():
+    """A window whose observations all land past the largest finite
+    bucket edge must report p99 = that edge (the Prometheus clamp), not
+    0.0 — 0 ms precisely during the stall being diagnosed is the worst
+    possible lie."""
+    plane = TelemetryPlane(slos=[])
+    reg = Registry("dragonfly")
+    h = reg.histogram("scheduler_schedule_duration_seconds", buckets=(0.1, 1.0))
+    rep = TelemetryReporter(
+        _DirectClient(TelemetryService(plane)),
+        service="scheduler",
+        instance="slow",
+        registry=reg,
+    )
+    rep.push_once()  # full baseline
+    for _ in range(5):
+        h.observe(30.0)  # every decision beyond the last finite edge
+    rep.push_once()
+    snap = plane.snapshot()
+    (shard,) = snap["shards"]
+    assert shard["decision_p99_ms"] == 1000.0  # clamped, not 0
+
+
+def test_reporter_epoch_change_rebaselines():
+    """A restarted reporter (new epoch) must re-baseline, not produce
+    negative/huge deltas from counters running backwards."""
+    plane, reg, counter, rep = _reporter_and_plane()
+    counter.inc(50)
+    rep.push_once()
+    counter.inc(1)
+    rep.push_once()
+    assert _counted(plane, "t9p_scheduler_work_total") == 1.0
+    # "restart": fresh reporter, fresh registry (counters reset to 2)
+    reg2 = Registry("t9p")
+    c2 = reg2.counter("scheduler_work_total")
+    c2.inc(2)
+    rep2 = TelemetryReporter(
+        rep.client,
+        service="scheduler",
+        instance="127.0.0.1:1",
+        interval=0.01,
+        registry=reg2,
+    )
+    rep2.push_once()  # new epoch → baseline
+    c2.inc(7)
+    rep2.push_once()
+    assert _counted(plane, "t9p_scheduler_work_total") == 7.0
+
+
+def test_failed_push_keeps_baseline_for_next_interval():
+    plane, reg, counter, rep = _reporter_and_plane()
+    counter.inc(1)
+    rep.push_once()
+    good_client = rep.client
+
+    class _Down:
+        def ReportTelemetry(self, req, timeout=None):
+            raise ConnectionError("manager down")
+
+    counter.inc(5)
+    rep.client = _Down()
+    assert not rep.push_once()
+    counter.inc(2)
+    rep.client = good_client
+    assert rep.push_once()
+    # both intervals' worth arrives once the manager is back
+    assert _counted(plane, "t9p_scheduler_work_total") == 7.0
+
+
+# -- units: SLO engine ----------------------------------------------------
+
+
+def _ratio_slo(**kw):
+    return SLOSpec(
+        name="download_success",
+        kind="ratio",
+        objective=0.99,
+        service="scheduler",
+        good_series="t9s_scheduler_good_total",
+        bad_series="t9s_scheduler_bad_total",
+        **kw,
+    )
+
+
+def test_slo_burn_breach_and_flight_event():
+    from dragonfly2_tpu.utils import flight
+
+    plane = TelemetryPlane(slos=[_ratio_slo()])
+    svc = TelemetryService(plane)
+    reg = Registry("t9s")
+    good = reg.counter("scheduler_good_total")
+    bad = reg.counter("scheduler_bad_total")
+    rep = TelemetryReporter(
+        _DirectClient(svc), service="scheduler", instance="i", registry=reg
+    )
+    good.inc()
+    bad.inc()
+    rep.push_once()  # baseline
+    good.inc(1)
+    bad.inc(9)  # 90% error rate vs 1% budget → burn 90x
+    rep.push_once()
+    snap = plane.snapshot()
+    (slo,) = snap["slos"]
+    assert slo["breached"], slo
+    assert slo["burn"]["5m"] > 1.0 and slo["burn"]["1h"] > 1.0
+    section = plane.health_section()
+    assert section["breached"] == ["download_success"]
+    events = flight.snapshot(["manager"]).get("manager", [])
+    burns = [e for e in events if e["type"] == "manager.slo_burn"]
+    assert burns and burns[-1]["slo"] == "download_success"
+    # recovery: a healthy stretch clears the breach (fast window decays)
+    for rep_state in plane._reporters.values():
+        rep_state.buckets.clear()  # drop the bad window wholesale
+    plane.evaluate_slos()
+    assert not plane.health_section()["breached"]
+    clears = [
+        e
+        for e in flight.snapshot(["manager"]).get("manager", [])
+        if e["type"] == "manager.slo_clear"
+    ]
+    assert clears and clears[-1]["slo"] == "download_success"
+
+
+def test_latency_slo_uses_histogram_window():
+    spec = SLOSpec(
+        name="schedule_p99",
+        kind="latency",
+        objective=0.9,
+        service="scheduler",
+        hist_series="t9l_scheduler_lat_seconds",
+        threshold_s=0.1,
+    )
+    plane = TelemetryPlane(slos=[spec])
+    reg = Registry("t9l")
+    h = reg.histogram("scheduler_lat_seconds", buckets=(0.1, 1.0))
+    rep = TelemetryReporter(
+        _DirectClient(TelemetryService(plane)),
+        service="scheduler",
+        instance="i",
+        registry=reg,
+    )
+    h.observe(0.01)
+    rep.push_once()
+    for _ in range(8):
+        h.observe(0.5)  # 8 slow
+    h.observe(0.01)  # 1 fast
+    rep.push_once()
+    snap = plane.snapshot()
+    (slo,) = snap["slos"]
+    assert slo["breached"]  # ~89% above threshold vs 10% budget
+
+
+def test_freshness_slo():
+    spec = SLOSpec(
+        name="fit_freshness",
+        kind="freshness",
+        objective=0.9,
+        service="trainer",
+        gauge_series="t9f_trainer_last_fit_timestamp_seconds",
+        threshold_s=60.0,
+    )
+    plane = TelemetryPlane(slos=[spec])
+    reg = Registry("t9f")
+    g = reg.gauge("trainer_last_fit_timestamp_seconds", "", ("model",))
+    rep = TelemetryReporter(
+        _DirectClient(TelemetryService(plane)),
+        service="trainer",
+        instance="t",
+        registry=reg,
+    )
+    rep.push_once()
+    # never fit: no budget burned pre-launch
+    assert not plane.snapshot()["slos"][0]["breached"]
+    g.labels("mlp").set(time.time() - 3600)  # an hour stale vs 60s bar
+    rep.push_once()
+    assert plane.snapshot()["slos"][0]["breached"]
+    g.labels("mlp").set(time.time())
+    rep.push_once()
+    assert not plane.snapshot()["slos"][0]["breached"]
+
+
+def test_freshness_slo_stalest_model_wins():
+    """Per-model timestamp gauges reduce by MIN (the stalest model is
+    the alarm) — a fresh sibling must not mask a stale model, and the
+    reduction must never sum unix timestamps."""
+    spec = SLOSpec(
+        name="fit_freshness",
+        kind="freshness",
+        objective=0.9,
+        service="trainer",
+        gauge_series="dragonfly_trainer_last_fit_timestamp_seconds",
+        threshold_s=60.0,
+    )
+    plane = TelemetryPlane(slos=[spec])
+    # a private registry under the production namespace, so the
+    # snapshot's trainer view (keyed on the dragonfly_ name) sees it
+    reg = Registry("dragonfly")
+    g = reg.gauge("trainer_last_fit_timestamp_seconds", "", ("model",))
+    rep = TelemetryReporter(
+        _DirectClient(TelemetryService(plane)),
+        service="trainer",
+        instance="t",
+        registry=reg,
+    )
+    g.labels("mlp").set(time.time())  # fresh
+    g.labels("gnn").set(time.time() - 3600)  # an hour stale vs 60s bar
+    rep.push_once()
+    snap = plane.snapshot()
+    assert snap["slos"][0]["breached"]
+    # fit_freshness_s reports the worst age, not a summed timestamp
+    (trainer,) = snap["trainers"]
+    assert 3000 < trainer["fit_freshness_s"] < 10_000
+
+
+def test_stale_reporter_evicted():
+    """A reporter silent past EVICT_AFTER_S is dropped wholesale —
+    ephemeral-port restarts must not grow the plane forever."""
+    plane, reg, counter, rep = _reporter_and_plane()
+    rep.push_once()
+    assert len(plane._reporters) == 1
+    ((key, state),) = plane._reporters.items()
+    state.last_report -= TelemetryPlane.EVICT_AFTER_S + 1
+    # any later report sweeps the dead row out
+    other = TelemetryReporter(
+        rep.client,
+        service="daemon",
+        instance="127.0.0.1:2",
+        interval=0.01,
+        registry=Registry("t9e"),
+    )
+    other.push_once()
+    assert key not in plane._reporters
+    assert len(plane._reporters) == 1
+
+
+# -- dfstat ---------------------------------------------------------------
+
+
+def test_dfstat_render():
+    from dragonfly2_tpu.tools.dfstat import render
+
+    snap = {
+        "cluster": {"schedule_ops_per_s": {"1m": 12.5}, "peers": 4, "tasks": 2},
+        "services": [{}, {}],
+        "slos": [
+            {"name": "download_success", "objective": 0.99,
+             "burn": {"5m": 7.0, "1h": 3.0}, "breached": True},
+            {"name": "schedule_p99", "objective": 0.99,
+             "burn": {"5m": 0.0, "1h": 0.0}, "breached": False},
+        ],
+        "shards": [
+            {"shard": "10.0.0.1:8002", "stale": False,
+             "schedule_ops_per_s": {"1m": 10.0},
+             "announce_ops_per_s": {"1m": 3.0},
+             "decision_p99_ms": 4.2, "peers": 3, "tasks": 2},
+            {"shard": "10.0.0.2:8002", "stale": True,
+             "schedule_ops_per_s": {"1m": 0.0},
+             "announce_ops_per_s": {"1m": 0.0},
+             "decision_p99_ms": 0.0, "peers": 0, "tasks": 0},
+        ],
+        "swarms": [
+            {"task_id": "task-abc", "peers": 3, "seeders": 1,
+             "done_pieces": 9, "total_pieces": 4,
+             "stragglers": ["peer-slow"]},
+        ],
+        "trainers": [
+            {"instance": "10.0.0.3:9000", "stale": False,
+             "ingest_records_per_s": {"1m": 1000.0},
+             "fit_freshness_s": 42.0},
+        ],
+        "daemons": [],
+    }
+    out = render(snap)
+    assert "BREACH" in out and "download_success" in out
+    assert "10.0.0.1:8002" in out and "stale" in out
+    assert "task-abc" in out and "peer-slow" in out
+    assert "42s" in out
+    # breach-free SLO renders ok
+    assert "ok" in out
+
+
+# -- /healthz + build info ------------------------------------------------
+
+
+def test_healthz_carries_slo_section():
+    """Satellite: the /healthz body carries SLO state alongside the
+    existing breaker/degraded map — and a breach keeps the 200."""
+    from dragonfly2_tpu.utils.metrics import MetricsServer
+
+    plane = TelemetryPlane(slos=[_ratio_slo()])
+    reg = Registry("t9h")
+    srv = MetricsServer(reg)
+    srv.register_health("manager", lambda: True)
+    srv.register_status_section("slo", plane.health_section)
+    addr = srv.start()
+    try:
+        with urllib.request.urlopen(f"http://{addr}/healthz", timeout=5) as resp:
+            assert resp.status == 200
+            body = json.loads(resp.read())
+        assert body["slo"]["breached"] == []
+        assert "download_success" in body["slo"]["slos"]
+        # drive a breach and confirm it surfaces WITHOUT flipping 503
+        greg = Registry("t9s")
+        good = greg.counter("scheduler_good_total")
+        bad = greg.counter("scheduler_bad_total")
+        rep = TelemetryReporter(
+            _DirectClient(TelemetryService(plane)),
+            service="scheduler",
+            instance="i",
+            registry=greg,
+        )
+        good.inc()
+        rep.push_once()
+        bad.inc(20)
+        rep.push_once()
+        with urllib.request.urlopen(f"http://{addr}/healthz", timeout=5) as resp:
+            assert resp.status == 200  # degraded, not down
+            body = json.loads(resp.read())
+        assert body["slo"]["breached"] == ["download_success"]
+        assert body["slo"]["slos"]["download_success"]["burn"]["5m"] > 1.0
+    finally:
+        srv.stop()
+
+
+def test_build_info_gauge():
+    from dragonfly2_tpu.utils.metrics import default_registry, set_build_info
+    from dragonfly2_tpu.version import __version__
+
+    set_build_info("testsvc")
+    text = default_registry.expose()
+    assert (
+        f'dragonfly_build_info{{service="testsvc",version="{__version__}"}} 1.0'
+        in text
+    )
+
+
+# -- the end-to-end acceptance run ---------------------------------------
+
+
+def test_cluster_telemetry_end_to_end(tmp_path):
+    """daemon + 2 schedulers + trainer push telemetry; the manager's
+    /api/v1/telemetry shows the per-swarm/per-shard aggregates; an
+    injected fault (downloads of a dead origin) drives an SLO burn that
+    appears in /healthz, a manager.slo_burn flight event, and dfstat
+    output; dfdoctor discovers the live services from the manager."""
+    from dragonfly2_tpu.client import dfget
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.manager.server import ManagerServer, ManagerServerConfig
+    from dragonfly2_tpu.scheduler.server import (
+        SchedulerServer,
+        SchedulerServerConfig,
+    )
+    from dragonfly2_tpu.tools.dfdoctor import discover_from_manager
+    from dragonfly2_tpu.tools.dfstat import fetch, render
+    from dragonfly2_tpu.trainer.server import TrainerServer, TrainerServerConfig
+    from dragonfly2_tpu.utils import flight
+
+    manager = ManagerServer(
+        ManagerServerConfig(
+            data_dir=str(tmp_path / "manager"),
+            rest_port=0,
+            metrics_port=0,
+            db_cache_ttl=0.0,
+            issue_certs=False,
+        )
+    )
+    maddr = manager.serve()
+    schedulers = []
+    daemon = None
+    trainer = None
+    try:
+        for name in ("sch-a", "sch-b"):
+            s = SchedulerServer(
+                SchedulerServerConfig(
+                    data_dir=str(tmp_path / name),
+                    manager_address=maddr,
+                    hostname=name,
+                    telemetry_interval=0.25,
+                    topology_backend="off",
+                )
+            )
+            s.serve()
+            schedulers.append(s)
+        trainer = TrainerServer(
+            TrainerServerConfig(
+                data_dir=str(tmp_path / "trainer"),
+                manager_address=maddr,
+                telemetry_interval=0.25,
+            )
+        )
+        trainer.serve()
+        daemon = Daemon(
+            DaemonConfig(
+                data_dir=str(tmp_path / "daemon"),
+                scheduler_address=",".join(
+                    f"127.0.0.1:{s.port}" for s in schedulers
+                ),
+                manager_address=maddr,
+                hostname="d1",
+                telemetry_interval=0.25,
+                piece_length=16 * 1024,
+                announce_interval=60.0,
+            )
+        )
+        daemon.start()
+        time.sleep(0.7)  # first pushes land: baselines established
+
+        # one good download (the swarm the table must show)...
+        payload = os.urandom(48 * 1024)
+        origin = tmp_path / "origin.bin"
+        origin.write_bytes(payload)
+        out = tmp_path / "out.bin"
+        dfget.download(f"127.0.0.1:{daemon.port}", f"file://{origin}", str(out))
+        assert out.read_bytes() == payload
+        # ...then the injected fault: downloads of a dead origin → peer
+        # download failures → the download_success SLO burns
+        for i in range(4):
+            with pytest.raises(Exception):
+                dfget.download(
+                    f"127.0.0.1:{daemon.port}",
+                    f"file://{tmp_path}/no-such-origin-{i}.bin",
+                    str(tmp_path / f"fail-{i}.bin"),
+                )
+        time.sleep(1.0)  # two+ push intervals: deltas + SLO evaluation
+
+        snap = fetch(manager.rest_addr)
+        by_service = {}
+        for svc in snap["services"]:
+            by_service.setdefault(svc["service"], []).append(svc)
+        assert len(by_service["scheduler"]) == 2
+        assert len(by_service["trainer"]) == 1
+        assert len(by_service["daemon"]) == 1
+        assert all(not s["stale"] for s in snap["services"])
+        # per-shard aggregates: both shards listed, the loaded one ticks
+        assert len(snap["shards"]) == 2
+        assert sum(
+            sh["schedule_ops_per_s"]["1m"] for sh in snap["shards"]
+        ) > 0
+        # the swarm table names the good task with its peer
+        swarm_tasks = {sw["task_id"]: sw for sw in snap["swarms"]}
+        assert any(sw["peers"] >= 1 for sw in swarm_tasks.values())
+        # the SLO burn: failures dominate the window in BOTH windows
+        slos = {s["name"]: s for s in snap["slos"]}
+        assert slos["download_success"]["breached"], slos["download_success"]
+
+        # breach surfaces in /healthz (degraded, not down)...
+        with urllib.request.urlopen(
+            f"http://{manager.metrics_addr}/healthz", timeout=5
+        ) as resp:
+            assert resp.status == 200
+            health = json.loads(resp.read())
+        assert "download_success" in health["slo"]["breached"]
+        # ...and the existing resilience sections still ride along
+        assert "services" in health and "uptime_s" in health
+
+        # ...as a manager.slo_burn flight event (dfdoctor's postmortem
+        # food)...
+        events = flight.snapshot(["manager"]).get("manager", [])
+        assert any(
+            e["type"] == "manager.slo_burn"
+            and e.get("slo") == "download_success"
+            for e in events
+        )
+
+        # ...and in dfstat's rendered frame
+        frame = render(snap)
+        assert "download_success" in frame and "BREACH" in frame
+        assert any(sw["task_id"][:16] in frame for sw in snap["swarms"])
+
+        # OpenMetrics content-type negotiation on the manager port, with
+        # the manager_slo series riding the payload (satellite)
+        req = urllib.request.Request(
+            f"http://{manager.metrics_addr}/metrics",
+            headers={"Accept": "application/openmetrics-text; version=1.0.0"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text"
+            )
+            text = resp.read().decode()
+        assert text.endswith("# EOF\n")
+        assert "dragonfly_manager_slo_breached" in text
+        assert "dragonfly_manager_telemetry_reports" in text
+        assert 'dragonfly_build_info{service="manager"' in text
+        # classic negotiation unchanged
+        with urllib.request.urlopen(
+            f"http://{manager.metrics_addr}/metrics", timeout=5
+        ) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+
+        # dfdoctor discovery: every live service's RPC endpoint
+        discovered = discover_from_manager(manager.rest_addr)
+        for s in schedulers:
+            assert f"127.0.0.1:{s.port}" in discovered
+        assert f"127.0.0.1:{daemon.port}" in discovered
+    finally:
+        if daemon is not None:
+            daemon.stop()
+        if trainer is not None:
+            trainer.stop()
+        for s in schedulers:
+            s.stop()
+        manager.stop()
