@@ -367,6 +367,62 @@ def fleet_shard_kill_bench() -> dict:
     return shard_kill_soak(peers=150, shards=3, workers=12)
 
 
+def jit_hygiene_bench(
+    batch: int = 1024, steps_per_call: int = 4, superbatches: int = 4
+) -> dict:
+    """Dispatch-plane hygiene on the production step machinery
+    (ISSUE 11): run the ingest step-cache's scan step over superbatches
+    twice and witness the second, warm pass with the jit-witness taps
+    (hack/dfanalyze/jitwitness.py).
+
+    - ``jit_recompiles_per_fit``: XLA compilations during the warm
+      pass. ``ingest._step_cache`` means a warm fit must reuse every
+      executable — a nonzero value here is a retrace storm (unstable
+      shapes/statics), the regression class dfanalyze's jaxhygiene pass
+      exists to catch.
+    - ``h2d_transfers_per_superbatch``: host→device conversions per
+      dispatched superbatch. The pipeline feeds the device exactly once
+      per superbatch (the packed [k·B, F+1] buffer), so steady state is
+      1.0 — growth means casts/feeds crept out of the fused transfer.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from hack.dfanalyze import jitwitness
+    from dragonfly2_tpu.models import mlp as mlp_mod
+    from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
+    from dragonfly2_tpu.trainer import ingest
+
+    k = max(steps_per_call, 1)
+    optimizer, scan_step = ingest._get_scan_step(3e-3, 1e-4, k)
+    params = mlp_mod.init_mlp(jax.random.PRNGKey(0), [MLP_FEATURE_DIM, 64, 1])
+    opt_state = optimizer.init(params)
+    rng = np.random.default_rng(0)
+    bufs = [
+        rng.random((k, batch, MLP_FEATURE_DIM + 1)).astype(np.float16)
+        for _ in range(2)
+    ]
+
+    def fit(params, opt_state):
+        loss = None
+        for i in range(superbatches):
+            dev = jnp.asarray(bufs[i % 2])  # the one fused H2D per superbatch
+            params, opt_state, loss = scan_step(params, opt_state, dev)
+        if loss is not None:
+            jax.block_until_ready(loss)
+        return params, opt_state
+
+    params, opt_state = fit(params, opt_state)  # cold: compiles happen here
+    with jitwitness.compile_tap() as ct, jitwitness.transfer_tap() as tt:
+        fit(params, opt_state)
+    return {
+        "jit_recompiles_per_fit": ct.count,
+        "h2d_transfers_per_superbatch": round(tt.h2d / superbatches, 3),
+    }
+
+
 def telemetry_overhead_bench(iters: int = 200, trials: int = 5) -> dict:
     """Telemetry-plane cost per push (ISSUE 9: the cluster telemetry
     reporter must stay invisible next to the hot paths).
@@ -685,6 +741,20 @@ def main() -> None:
         except Exception as e:
             host_rates["telemetry_error"] = str(e)
             _phase(f"telemetry bench failed: {e}")
+        # jit-hygiene microbench rides host_rates the same way: a warm
+        # fit must hit the step cache (0 recompiles) and feed the device
+        # once per superbatch — the dispatch-plane regression counters
+        # land in the artifact on every exit path
+        try:
+            host_rates.update(jit_hygiene_bench())
+            _phase(
+                f"jit hygiene: {host_rates['jit_recompiles_per_fit']} recompiles"
+                " on a warm fit,"
+                f" {host_rates['h2d_transfers_per_superbatch']:.2f} H2D/superbatch"
+            )
+        except Exception as e:
+            host_rates["jit_hygiene_error"] = str(e)
+            _phase(f"jit hygiene bench failed: {e}")
         # resilience-layer overhead rides host_rates the same way: the
         # fault-free pre-flight (breaker/budget/deadline) must stay < 2%
         # of the scheduling hot-path wall

@@ -7,6 +7,8 @@ mesh's `sp` axis and attention runs blockwise over the ICI ring
 chips instead of one chip's HBM.
 """
 
+# dfanalyze: device-hot — jitted/device-feeding compute plane
+
 from __future__ import annotations
 
 import jax

@@ -14,6 +14,8 @@ the gather runs through ops.ring.ring_gather_rows so the full feature
 table never materializes on one chip.
 """
 
+# dfanalyze: device-hot — jitted/device-feeding compute plane
+
 from __future__ import annotations
 
 from typing import Sequence

@@ -13,6 +13,8 @@ in float32): same masked-mean aggregation, same bf16 matmul policy, same
 L2-normalized embeddings and pairwise head.
 """
 
+# dfanalyze: device-hot — jitted/device-feeding compute plane
+
 from __future__ import annotations
 
 import functools

@@ -6,6 +6,8 @@ predicted next-piece cost / back-to-source risk (BASELINE.json config
 — XLA-friendly sequential control flow, no Python loops in jit.
 """
 
+# dfanalyze: device-hot — jitted/device-feeding compute plane
+
 from __future__ import annotations
 
 import jax

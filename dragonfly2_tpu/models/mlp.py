@@ -7,6 +7,8 @@ cost. The scheduler's `ml` evaluator ranks candidate parents by ascending
 predicted cost (reference evaluator.go:53's TODO algorithm).
 """
 
+# dfanalyze: device-hot — jitted/device-feeding compute plane
+
 from __future__ import annotations
 
 from typing import Sequence

@@ -18,6 +18,8 @@ or explicit arguments. No-op when unset (single-host dev boxes, tests,
 the driver's virtual-device runs).
 """
 
+# dfanalyze: device-hot — jitted/device-feeding compute plane
+
 from __future__ import annotations
 
 import os

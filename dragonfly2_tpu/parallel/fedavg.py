@@ -13,6 +13,8 @@ Two operating modes:
   jobs); averaging happens on host arrays.
 """
 
+# dfanalyze: device-hot — jitted/device-feeding compute plane
+
 from __future__ import annotations
 
 from typing import Any, Sequence

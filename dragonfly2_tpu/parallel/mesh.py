@@ -1,5 +1,7 @@
 """Device mesh construction."""
 
+# dfanalyze: device-hot — jitted/device-feeding compute plane
+
 from __future__ import annotations
 
 import numpy as np

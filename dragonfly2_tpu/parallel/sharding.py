@@ -2,6 +2,8 @@
 let XLA insert the collectives (the scaling-book recipe: pick a mesh,
 annotate shardings, let the compiler do layout)."""
 
+# dfanalyze: device-hot — jitted/device-feeding compute plane
+
 from __future__ import annotations
 
 from typing import Any

@@ -12,6 +12,8 @@
 """
 
 # dfanalyze: hot — evaluate_parents/is_bad_node run per schedule op
+# dfanalyze: device-hot — the ML ranking path dispatches the jitted
+# scorer per schedule op; retraces or stray host syncs multiply here
 
 from __future__ import annotations
 
@@ -315,10 +317,20 @@ class MLEvaluator(BaseEvaluator):
                     rtts = [self._rtt_affinity(p, child) for p in parents]
             else:
                 rtts = [0.0] * len(parents)
+            # one vectorized location-affinity call for the whole
+            # candidate set: the per-pair form built two 1-element
+            # string arrays per parent per schedule op, which the
+            # numpy-fallback path pays on every decision
+            loc_aff = offline_location_affinity(
+                np.array([child.host.network.location] * len(parents)),
+                np.array([p.host.network.location for p in parents]),
+            )
             feats = np.stack(
                 [
-                    pair_features(p, child, total_piece_count, rtt)
-                    for p, rtt in zip(parents, rtts)
+                    pair_features(
+                        p, child, total_piece_count, rtt, loc_affinity=float(la)
+                    )
+                    for p, rtt, la in zip(parents, rtts, loc_aff)
                 ]
             )
             costs = self._model.predict(feats)  # [P] predicted log piece cost
@@ -356,14 +368,21 @@ class MLEvaluator(BaseEvaluator):
 
 
 def pair_features(
-    parent: Peer, child: Peer, total_piece_count: int, rtt_affinity: float = 0.0
+    parent: Peer,
+    child: Peer,
+    total_piece_count: int,
+    rtt_affinity: float = 0.0,
+    loc_affinity: float | None = None,
 ) -> np.ndarray:
     """Live (child, parent) features in schema.features.MLP_FEATURE_NAMES
     order — must stay in lockstep with the offline extraction the model was
     trained on (schema/features.py). ``rtt_affinity`` is the topology
     engine's estimate for the child→parent pair (TopologyEngine.
     rtt_affinity); the 0.0 default is the schema's missing-value, which
-    is also what offline extraction emits."""
+    is also what offline extraction emits. ``loc_affinity`` lets a batch
+    caller pass the vectorized ``location_affinity`` result instead of
+    paying a per-pair 1-element array round trip; None computes it here
+    (same math either way — the lockstep contract is with features.py)."""
     h = parent.host
     uploads, failed = h.upload_count, h.upload_failed_count
     child_idc, parent_idc = child.host.network.idc, h.network.idc
@@ -372,8 +391,14 @@ def pair_features(
     # (the offline training regime): upload_success uses max(uploads, 1)
     # (fresh host → 0.0) and idc/location compare case-SENSITIVELY —
     # unlike the BaseEvaluator's hand-tuned score above.
-    loc_aff = float(
-        offline_location_affinity(np.array([child_loc]), np.array([parent_loc]))[0]
+    loc_aff = (
+        float(
+            offline_location_affinity(
+                np.array([child_loc]), np.array([parent_loc])
+            )[0]
+        )
+        if loc_affinity is None
+        else loc_affinity
     )
     return np.array(
         [

@@ -16,6 +16,8 @@ instead of pinning its last EWMA forever.
 """
 
 # dfanalyze: hot — est_rtt_ns/rtt_affinity run per schedule decision
+# dfanalyze: device-hot — queries dispatch the jitted kernels against
+# the resident arrays; a whole-array host pull per query multiplies
 
 from __future__ import annotations
 
@@ -71,7 +73,11 @@ class TopologyEngine:
         # (queries keep reading the previous arrays meanwhile) without
         # two flushes racing the swap
         self._flush_lock = threading.Lock()
-        self._arrays: dict | None = None  # device-resident CSR/COO
+        # host-side numpy CSR/COO build (the query surface reads these
+        # directly); only the COPIES _to_backend hands the kernels live
+        # on device — keep it that way, or neighbors() grows a per-query
+        # D2H pull back
+        self._arrays: dict | None = None
         self._weights = None  # freshness weights at last flush
         self._D = None  # [node_cap, L] landmark distances (ms)
         self._khop_rtt = None  # [node_cap] aggregate (log-ms)
@@ -251,7 +257,11 @@ class TopologyEngine:
         """Install a finished build (caller holds ``_lock``)."""
         self._arrays = arr
         self._weights = computed["weights"]
-        self._khop_rtt = computed["khop"]
+        # khop lands host-side HERE, once per flush: its only consumer
+        # (khop_rtt_log_ms) reads single elements per query, and pulling
+        # the whole device array back per query was a D2H round trip on
+        # the schedule-decision path
+        self._khop_rtt = np.asarray(computed["khop"])
         self._D = computed["D"]
         self._landmark_idx = arr["landmark_idx"][: arr["num_landmarks"]].copy()
         self._cache.clear()
@@ -360,10 +370,13 @@ class TopologyEngine:
             idx = self.store.index.get(host_id)
             if idx is None:
                 return []
+            # the built arrays are host numpy by construction (_swap
+            # installs the build dict; only the kernel inputs go to the
+            # backend) — no conversion on the query path
             arr = self._arrays
-            row_ptr = np.asarray(arr["row_ptr"])
+            row_ptr = arr["row_ptr"]
             lo, hi = int(row_ptr[idx]), int(row_ptr[idx + 1])
-            dst = np.asarray(arr["edge_dst"])[lo:hi]
+            dst = arr["edge_dst"][lo:hi]
             out = []
             for d in dst:
                 e = self.store.edges.get((idx, int(d)))
@@ -471,7 +484,7 @@ class TopologyEngine:
             idx = self.store.index.get(host_id)
             if idx is None or self._khop_rtt is None:
                 return None
-            return float(np.asarray(self._khop_rtt)[idx])
+            return float(self._khop_rtt[idx])  # host copy since _swap
 
     def stats(self) -> dict:
         with self._lock:

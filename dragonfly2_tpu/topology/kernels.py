@@ -17,6 +17,9 @@ product. Aggregation math is log1p-ms like every other RTT feature in
 schema/features.py.
 """
 
+# dfanalyze: device-hot — these kernels run per topology flush and per
+# inference query; wrapper churn or host syncs here tax every schedule
+
 from __future__ import annotations
 
 import numpy as np
@@ -98,66 +101,81 @@ class NumpyKernels:
         return np.min(D[src_idx] + D[dst_idx], axis=-1)
 
 
+_jit_cache: dict = {}
+
+
+def _jitted_kernels():
+    """The four jitted kernels, built once per PROCESS (not per
+    JaxKernels instance): every engine, bench, and test instance shares
+    one compiled-executable cache per (capacity, trip-count) tuple —
+    the per-instance form recompiled identical kernels on every engine
+    construction. Lazy so the numpy backend never imports jax."""
+    fns = _jit_cache.get("kernels")
+    if fns is not None:
+        return fns
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("half_life_s",))
+    def decay(age_s, valid, half_life_s):
+        return _freshness(age_s, valid, half_life_s, jnp)
+
+    @functools.partial(jax.jit, static_argnames=("num_nodes", "k"))
+    def khop(edge_src, edge_dst, rtt_log_ms, weights, num_nodes, k):
+        seg = functools.partial(
+            jax.ops.segment_sum, num_segments=num_nodes
+        )
+        w_rtt = seg(weights * rtt_log_ms, edge_src)
+        w_tot = seg(weights, edge_src)
+        h0 = w_rtt / jnp.maximum(w_tot, 1e-9)
+        has = (w_tot > 1e-9).astype(jnp.float32)
+        h0 = h0 * has
+
+        def hop(h, _):
+            nbr = seg(weights * h[edge_dst], edge_src) / jnp.maximum(w_tot, 1e-9)
+            return (0.5 * h0 + 0.5 * nbr) * has, None
+
+        h, _ = jax.lax.scan(hop, h0, None, length=k)
+        return h
+
+    @functools.partial(jax.jit, static_argnames=("num_nodes", "iters"))
+    def landmarks(
+        edge_src, edge_dst, rtt_ms, weights,
+        landmark_idx, landmark_valid, num_nodes, iters,
+    ):
+        L = landmark_idx.shape[0]
+        cost = jnp.where(weights > 0, rtt_ms, INF_MS).astype(jnp.float32)
+        D = jnp.full((num_nodes, L), INF_MS, dtype=jnp.float32)
+        D = D.at[landmark_idx, jnp.arange(L)].min(
+            jnp.where(landmark_valid > 0, 0.0, INF_MS).astype(jnp.float32)
+        )
+
+        def relax(D, _):
+            cand = cost[:, None] + D[edge_dst]
+            relaxed = jax.ops.segment_min(cand, edge_src, num_segments=num_nodes)
+            return jnp.minimum(D, relaxed), None
+
+        D, _ = jax.lax.scan(relax, D, None, length=iters)
+        return D
+
+    @jax.jit
+    def est(D, src_idx, dst_idx):
+        return jnp.min(D[src_idx] + D[dst_idx], axis=-1)
+
+    fns = _jit_cache["kernels"] = (decay, khop, landmarks, est)
+    return fns
+
+
 class JaxKernels:
-    """jitted twins — compiled once per (capacity, trip-count) tuple."""
+    """jitted twins — compiled once per (capacity, trip-count) tuple,
+    shared process-wide (``_jitted_kernels``)."""
 
     backend = "jax"
 
     def __init__(self):
-        import functools
-
-        import jax
-        import jax.numpy as jnp
-
-        self._jnp = jnp
-
-        @functools.partial(jax.jit, static_argnames=("half_life_s",))
-        def decay(age_s, valid, half_life_s):
-            return _freshness(age_s, valid, half_life_s, jnp)
-
-        @functools.partial(jax.jit, static_argnames=("num_nodes", "k"))
-        def khop(edge_src, edge_dst, rtt_log_ms, weights, num_nodes, k):
-            seg = functools.partial(
-                jax.ops.segment_sum, num_segments=num_nodes
-            )
-            w_rtt = seg(weights * rtt_log_ms, edge_src)
-            w_tot = seg(weights, edge_src)
-            h0 = w_rtt / jnp.maximum(w_tot, 1e-9)
-            has = (w_tot > 1e-9).astype(jnp.float32)
-            h0 = h0 * has
-
-            def hop(h, _):
-                nbr = seg(weights * h[edge_dst], edge_src) / jnp.maximum(w_tot, 1e-9)
-                return (0.5 * h0 + 0.5 * nbr) * has, None
-
-            h, _ = jax.lax.scan(hop, h0, None, length=k)
-            return h
-
-        @functools.partial(jax.jit, static_argnames=("num_nodes", "iters"))
-        def landmarks(
-            edge_src, edge_dst, rtt_ms, weights,
-            landmark_idx, landmark_valid, num_nodes, iters,
-        ):
-            L = landmark_idx.shape[0]
-            cost = jnp.where(weights > 0, rtt_ms, INF_MS).astype(jnp.float32)
-            D = jnp.full((num_nodes, L), INF_MS, dtype=jnp.float32)
-            D = D.at[landmark_idx, jnp.arange(L)].min(
-                jnp.where(landmark_valid > 0, 0.0, INF_MS).astype(jnp.float32)
-            )
-
-            def relax(D, _):
-                cand = cost[:, None] + D[edge_dst]
-                relaxed = jax.ops.segment_min(cand, edge_src, num_segments=num_nodes)
-                return jnp.minimum(D, relaxed), None
-
-            D, _ = jax.lax.scan(relax, D, None, length=iters)
-            return D
-
-        @jax.jit
-        def est(D, src_idx, dst_idx):
-            return jnp.min(D[src_idx] + D[dst_idx], axis=-1)
-
-        self._decay, self._khop, self._landmarks, self._est = decay, khop, landmarks, est
+        self._decay, self._khop, self._landmarks, self._est = _jitted_kernels()
 
     def decay_weights(self, age_s, valid, half_life_s: float):
         return self._decay(age_s, valid, half_life_s=float(half_life_s))

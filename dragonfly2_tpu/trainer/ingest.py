@@ -29,6 +29,10 @@ transfer/step, one awaiting confirmation) and a capped eval holdout —
 independent of file size.
 """
 
+# dfanalyze: device-hot — the dispatcher thread drives the jitted train
+# step per superbatch; a fresh jit wrapper or stray host sync here costs
+# a compile/transfer per dispatch
+
 from __future__ import annotations
 
 import os
@@ -780,7 +784,11 @@ def stream_train_mlp(
     if eval_x:
         xe = np.concatenate(eval_x)
         ye = np.concatenate(eval_y)
-        pred = np.asarray(jax.jit(mlp_mod.score_parents)(params, jnp.asarray(xe)))
+        # the fit-end eval rides the shared memoized jit: a fresh
+        # jax.jit wrapper per fit recompiled this same executable
+        from dragonfly2_tpu.utils.jitcache import jit_once
+
+        pred = np.asarray(jit_once(mlp_mod.score_parents)(params, jnp.asarray(xe)))
         err = pred - ye
         stats.metrics = {
             "mse": float(np.mean(err**2)),

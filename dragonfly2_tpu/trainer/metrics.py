@@ -41,6 +41,20 @@ INGEST_STEP_SECONDS = _r.histogram(
 DATASET_BYTES_TOTAL = _r.counter(
     "trainer_dataset_bytes_total", "Dataset bytes received on Train streams", ("kind",)
 )
+# dispatch-plane hygiene counters, fed by the jit witness's bench taps
+# (hack/dfanalyze/jitwitness.py): XLA compilations and host→device
+# conversions observed while a tap is armed. Steady state on a warm fit
+# is ZERO recompiles and one H2D per superbatch — a moving recompile
+# counter mid-fit is the retrace storm bench.py's
+# jit_recompiles_per_fit key exists to catch.
+JIT_RECOMPILES_TOTAL = _r.counter(
+    "trainer_jit_recompiles_total",
+    "XLA compilations observed by the jit witness taps",
+)
+H2D_TRANSFERS_TOTAL = _r.counter(
+    "trainer_h2d_transfers_total",
+    "Host-to-device conversions observed by the jit witness taps",
+)
 # unix timestamp of the last SUCCESSFUL fit per model: the telemetry
 # plane's fit-freshness source (freshness = now - value; 0 = never) —
 # a gauge, so the manager can compute staleness without rate math

@@ -12,12 +12,32 @@ Serialization: flat ``{dotted/path: ndarray}`` npz — same trick as the
 columnar codec, readable anywhere numpy exists.
 """
 
+# dfanalyze: device-hot — scorers dispatch jitted forwards per schedule
+# decision; a per-instance jit wrapper recompiles on every model refresh
+
 from __future__ import annotations
 
 import io
 from typing import Any
 
 import numpy as np
+
+# one compiled wrapper per forward function, shared across scorer
+# instances: model_refresher installs a fresh scorer per refresh, and a
+# per-instance jax.jit would recompile the same forward on every hot swap
+from dragonfly2_tpu.utils.jitcache import jit_once as _jit_once
+
+
+def _device_params(params: Any) -> Any:
+    """Pin a parameter pytree on device ONCE, at scorer construction.
+    The deserialized pytree is numpy, and feeding numpy leaves into a
+    jitted forward re-uploads the whole model on EVERY predict — the
+    implicit-transfer class the jit witness flags. Resident params ride
+    HBM across predicts; only the features move per call."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(jnp.asarray, params)
 
 
 def serialize_params(params: Any) -> bytes:
@@ -76,12 +96,10 @@ class MLPScorer:
     scheduler's MLEvaluator calls ``predict`` on."""
 
     def __init__(self, params: Any):
-        import jax
-
         from dragonfly2_tpu.models.mlp import score_parents
 
-        self._params = params
-        self._fn = jax.jit(score_parents)
+        self._params = _device_params(params)
+        self._fn = _jit_once(score_parents)
 
     @property
     def feature_dim(self) -> int:
@@ -100,21 +118,20 @@ class GNNScorer:
     pairs by predicted RTT (for seed placement / cross-host ranking)."""
 
     def __init__(self, params: Any, graph):
-        import jax
         import jax.numpy as jnp
 
         from dragonfly2_tpu.models.gnn import apply_graphsage, predict_edge
 
-        self._params = params
+        self._params = _device_params(params)
         self._node_index = {hid: i for i, hid in enumerate(graph.node_ids)}
-        emb = jax.jit(apply_graphsage)(
-            params,
+        emb = _jit_once(apply_graphsage)(
+            self._params,
             jnp.asarray(graph.node_features),
             jnp.asarray(graph.neighbors),
             jnp.asarray(graph.neighbor_mask),
         )
         self._emb = emb
-        self._predict = jax.jit(predict_edge)
+        self._predict = _jit_once(predict_edge)
 
     def has_host(self, host_id: str) -> bool:
         return host_id in self._node_index
@@ -134,12 +151,10 @@ class GRUScorer:
     prediction from its own history is flagged)."""
 
     def __init__(self, params: Any):
-        import jax
-
         from dragonfly2_tpu.models.gru import predict_next_cost
 
-        self._params = params
-        self._fn = jax.jit(predict_next_cost)
+        self._params = _device_params(params)
+        self._fn = _jit_once(predict_next_cost)
 
     def predict_next_log_cost(self, cost_prefixes_ms: list) -> np.ndarray:
         """[B] predicted next log1p piece cost (ms) from per-parent piece

@@ -1,0 +1,27 @@
+"""Process-wide jit-wrapper memoization.
+
+``jax.jit(fn)`` built fresh at a call site carries its own (empty)
+compile cache — per-call construction recompiles identical executables,
+the regression class dfanalyze's jaxhygiene pass fails on. ``jit_once``
+is the shared fix: one wrapper per function object, every caller
+(trainer eval paths, serving scorers) sharing one executable cache per
+argument shape. Lazy jax import — callers like trainer/serving must
+stay importable where jax isn't.
+"""
+
+# dfanalyze: device-hot — this module exists to construct jit wrappers
+
+from __future__ import annotations
+
+_jit_cache: dict = {}
+
+
+def jit_once(fn):
+    """The memoized ``jax.jit(fn)``: same function object → same
+    wrapper, process-wide."""
+    cached = _jit_cache.get(fn)
+    if cached is None:
+        import jax
+
+        cached = _jit_cache[fn] = jax.jit(fn)
+    return cached

@@ -17,6 +17,11 @@ Passes (see ``hack/dfanalyze/passes/``):
 - ``hygiene``      — hot-path lints: function-local imports in modules
                      tagged ``# dfanalyze: hot``, bare ``except: pass``
                      in loops, fire-and-forget ContextVar ``set()``.
+- ``jaxhygiene``   — XLA-dispatch hygiene: host-sync/side-effect/branch
+                     constructs inside jit-traced functions, per-call
+                     jit-wrapper construction and whole-array host pulls
+                     in ``# dfanalyze: device-hot`` modules, unstable
+                     static args.
 - ``metrics``      — the metric/event/fault-point census (the absorbed
                      check_metrics).
 - ``typecheck``    — mypy with a checked-in baseline (skips cleanly when
@@ -28,7 +33,9 @@ needs a justifying comment, and entries no pass matches fail the run
 lock-witness (``hack/dfanalyze/witness.py``, armed via
 ``DF_LOCK_WITNESS=1`` through ``tests/conftest.py``) records the orders
 the AST can't see and ``--witness-report`` cross-checks them against the
-static graph.
+static graph; the jit witness (``hack/dfanalyze/jitwitness.py``, armed
+via ``DF_JIT_WITNESS=1``) records what actually compiled/transferred and
+``--jit-witness-report`` joins that onto the static jit sites.
 
 Run ``python -m hack.dfanalyze`` (or ``--json`` for machines).
 """
@@ -42,6 +49,17 @@ from pathlib import Path
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 DEFAULT_PACKAGE = REPO_ROOT / "dragonfly2_tpu"
 ALLOWLIST_PATH = Path(__file__).resolve().parent / "allowlist.txt"
+
+# Witness cross-checks run over whatever the DYNAMIC run happened to
+# cover — a subset pytest run legitimately exercises none of the
+# allowlisted storms/orders, and even a full tier-1's coverage varies
+# with timing and skips, so staleness cannot be decided mechanically
+# from any one run. Witness-pass entries are therefore exempt from the
+# stale-entry rule; pruning them is a REVIEW job — each entry's
+# mandatory `# why` names the code it excuses, so delete the entry when
+# that code changes (e.g. the make_epoch_fn per-fit wrapper gets
+# memoized → drop its jit-rewrap entry in the same PR).
+DYNAMIC_PASSES = frozenset({"lock-witness", "jit-witness"})
 
 
 @dataclass
@@ -122,6 +140,7 @@ def run(
     pass_ids: list[str] | None = None,
     allowlist: Allowlist | None = None,
     witness_report: Path | None = None,
+    jit_witness_report: Path | None = None,
 ) -> dict:
     """Run the selected passes; returns the machine-readable report.
     ``report["ok"]`` is the exit condition: no unallowlisted findings, no
@@ -161,6 +180,23 @@ def run(
             results.append(
                 lockorder.witness_crosscheck(package_dir, Path(witness_report))
             )
+    if jit_witness_report is not None:
+        from .passes import jaxhygiene
+
+        if not Path(jit_witness_report).is_file():
+            # same contract as the lock witness: an explicit cross-check
+            # request with no dump must fail, not read as "zero storms"
+            errors.append(
+                f"jit-witness report not found: {jit_witness_report} (run the"
+                " suite with DF_JIT_WITNESS=1 first; the dump lands in the"
+                " pytest cwd or DF_JIT_WITNESS_OUT)"
+            )
+        else:
+            results.append(
+                jaxhygiene.witness_crosscheck(
+                    package_dir, Path(jit_witness_report)
+                )
+            )
 
     unallowlisted = 0
     for r in results:
@@ -168,7 +204,9 @@ def run(
             f.allowlisted = allowlist.match(f)
             if not f.allowlisted:
                 unallowlisted += 1
-    stale = allowlist.stale({r.pass_id for r in results if not r.skipped})
+    stale = allowlist.stale(
+        {r.pass_id for r in results if not r.skipped} - DYNAMIC_PASSES
+    )
     report = {
         "package": str(package_dir),
         "passes": [

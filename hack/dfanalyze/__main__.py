@@ -35,6 +35,12 @@ def main(argv: list[str] | None = None) -> int:
         " the static lock graph",
     )
     ap.add_argument(
+        "--jit-witness-report", metavar="FILE",
+        help="cross-check a jit-witness dump (DF_JIT_WITNESS run) against"
+        " the static jit sites: retrace storms, wrapper churn, implicit"
+        " transfers in device-hot modules",
+    )
+    ap.add_argument(
         "--update-mypy-baseline", action="store_true",
         help="rewrite the typecheck baseline from a fresh mypy run",
     )
@@ -56,6 +62,9 @@ def main(argv: list[str] | None = None) -> int:
         package_dir=Path(args.package_dir),
         pass_ids=args.passes,
         witness_report=Path(args.witness_report) if args.witness_report else None,
+        jit_witness_report=(
+            Path(args.jit_witness_report) if args.jit_witness_report else None
+        ),
     )
     if args.json:
         print(to_json(report))

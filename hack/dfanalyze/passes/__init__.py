@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from . import blocking, hygiene, lockorder, metrics, typecheck
+from . import blocking, hygiene, jaxhygiene, lockorder, metrics, typecheck
 
 
 class _Pass:
@@ -16,6 +16,7 @@ ALL_PASSES = [
     _Pass(lockorder),
     _Pass(blocking),
     _Pass(hygiene),
+    _Pass(jaxhygiene),
     _Pass(metrics),
     _Pass(typecheck),
 ]

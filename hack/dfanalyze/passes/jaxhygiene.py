@@ -1,0 +1,668 @@
+"""jaxhygiene: XLA-dispatch hygiene for the jitted hot paths.
+
+The north star moves the Trainer fit, topology kernels, and scheduler
+evaluator onto a resident XLA path, and the two regressions that class
+of code grows are *silent recompiles* (a fresh ``jax.jit`` wrapper per
+call compiles per call; an unstable static arg retraces per value) and
+*silent host round-trips* (``float(tracer)``, ``.item()``, a whole-array
+``np.asarray`` to read one element). Both are invisible in review and
+expensive on a real device link — this pass makes them lint failures,
+the same way ABBA lock cycles became one.
+
+Two scopes, by construction:
+
+- **jit-traced functions** — defs decorated ``@jax.jit`` /
+  ``@functools.partial(jax.jit, ...)`` or wrapped via ``jax.jit(f)``
+  anywhere in the module. Inside their (traced) bodies the pass flags
+  host-sync constructs (``float``/``int``/``bool`` on non-constants,
+  ``.item()``/``.tolist()``, numpy ops on traced values), branching on
+  non-static parameters (a data-dependent ``if`` either crashes under
+  trace or silently bakes one branch in), and Python side effects
+  (``print``, logging, ``time.*``, host randomness — they run at trace
+  time, not per step).
+- **device-hot modules** — modules carrying a ``# dfanalyze: device-hot``
+  marker (the per-dispatch analogue of ``# dfanalyze: hot``). Anywhere
+  in them the pass flags jit-wrapper construction inside functions
+  (``jax.jit(...)``, ``functools.partial(jax.jit, ...)`` or a bare
+  ``@jax.jit`` on a nested def — one wrapper per enclosing call = one
+  compile cache per call), ``block_until_ready`` outside allowlisted
+  timing/confirmation sites, and the whole-array host pull
+  ``np.asarray(x)[i]``. Construction inside a loop is flagged
+  package-wide. The one audited escape hatch: a construction whose
+  enclosing function stores into a ``*cache*``-named subscript
+  (``_step_cache[key] = ...``) is a memoized factory and exempt.
+
+Static-arg stability: a jitted function whose ``static_argnums``/
+``static_argnames`` parameter defaults to — or is called with — a
+list/dict/set literal (or a fresh ``np.array``) either crashes on
+hashing or retraces per call; both ends are flagged.
+
+The runtime half (``hack/dfanalyze/jitwitness.py``, armed via
+``DF_JIT_WITNESS=1``) records what actually compiled and transferred;
+``witness_crosscheck`` joins that dump back onto the static jit sites
+here and fails on retrace storms, per-call wrapper churn, and implicit
+transfers feeding jits from device-hot modules.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+
+from .. import Finding, PassResult
+
+ID = "jaxhygiene"
+
+DEVICE_HOT_MARKER = "dfanalyze: device-hot"
+
+# host-sync builtins: on a traced value these force device→host (or
+# crash under trace); on a constant they're pointless but harmless
+_SYNC_BUILTINS = ("float", "int", "bool")
+_SYNC_ATTRS = ("item", "tolist")
+_LOGGERISH = ("logger", "log", "logging")
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return isinstance(node, (ast.Name, ast.Attribute)) and _dotted(node) in (
+        "jax.jit",
+        "jit",
+        "pjit",
+        "jax.pjit",
+    )
+
+
+def _jit_construction(node: ast.AST) -> ast.Call | None:
+    """The Call that builds a jit wrapper: ``jax.jit(...)`` or
+    ``functools.partial(jax.jit, ...)``. Returns the call carrying the
+    jit kwargs (the partial itself for the partial form)."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jax_jit(node.func):
+        return node
+    if _dotted(node.func) in ("functools.partial", "partial") and node.args:
+        if _is_jax_jit(node.args[0]):
+            return node
+    return None
+
+
+def _static_params(call: ast.Call | None, fn: ast.FunctionDef | None) -> set[str]:
+    """Parameter names pinned static by static_argnums/static_argnames
+    on the jit construction ``call`` wrapping ``fn``."""
+    out: set[str] = set()
+    if call is None:
+        return out
+    argnames = [a.arg for a in (fn.args.posonlyargs + fn.args.args)] if fn else []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for v in _const_strs(kw.value):
+                out.add(v)
+        elif kw.arg == "static_argnums" and fn is not None:
+            for i in _const_ints(kw.value):
+                if 0 <= i < len(argnames):
+                    out.add(argnames[i])
+    return out
+
+
+def _const_strs(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+    return []
+
+
+def _const_ints(node: ast.AST) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        ]
+    return []
+
+
+def _nonhashable_literal(node: ast.AST) -> str | None:
+    if isinstance(node, ast.List):
+        return "list"
+    if isinstance(node, ast.Dict):
+        return "dict"
+    if isinstance(node, ast.Set):
+        return "set"
+    if isinstance(node, ast.Call) and _dotted(node.func) in (
+        "np.array",
+        "np.asarray",
+        "numpy.array",
+        "numpy.asarray",
+    ):
+        return "ndarray"
+    return None
+
+
+class _Jitted:
+    """One jit-wrapped function: the def, its static params, and the
+    name call sites use (the decorated name, or the assigned alias for
+    ``g = jax.jit(f, ...)``)."""
+
+    def __init__(self, fn, static, call_name, construction):
+        self.fn = fn
+        self.static = static
+        self.call_name = call_name
+        self.construction = construction  # the jit Call (kwargs live here)
+
+
+class _ModuleScan:
+    def __init__(self, tree: ast.Module, rel: str, text: str):
+        self.tree = tree
+        self.rel = rel
+        self.hot = DEVICE_HOT_MARKER in text
+        self.findings: list[Finding] = []
+        self._seen_keys: set[str] = set()
+        # bare name -> FunctionDef, module-wide (nested defs included):
+        # jax.jit(f) resolution is by name, heuristic like the lockmodel
+        self.defs: dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, node)
+        self.jitted: list[_Jitted] = []
+        # every wrapped-function NAME with a jit site here, including
+        # jax.jit(f) where f's def lives in another module (the traced
+        # body can't be analyzed, but the runtime witness joins compile
+        # counts by this name)
+        self.jit_names: list[tuple[str, int]] = []
+        self._collect_jitted()
+
+    # -- collection --------------------------------------------------------
+    def _collect_jitted(self) -> None:
+        marked: set = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    call = _jit_construction(dec)
+                    if call is not None or _is_jax_jit(dec):
+                        self.jit_names.append((node.name, node.lineno))
+                        if node not in marked:
+                            marked.add(node)
+                            self.jitted.append(
+                                _Jitted(
+                                    node,
+                                    _static_params(call, node),
+                                    node.name,
+                                    call,
+                                )
+                            )
+            call = _jit_construction(node) if isinstance(node, ast.Call) else None
+            if call is not None and call is node and _is_jax_jit(call.func):
+                # jax.jit(f, ...): resolve f by name when it's a def here
+                if call.args and isinstance(call.args[0], ast.Name):
+                    self.jit_names.append((call.args[0].id, call.lineno))
+                    fn = self.defs.get(call.args[0].id)
+                    if fn is not None and fn not in marked:
+                        marked.add(fn)
+                        self.jitted.append(
+                            _Jitted(fn, _static_params(call, fn), fn.name, call)
+                        )
+                elif call.args and isinstance(call.args[0], ast.Attribute):
+                    # jax.jit(mod.fn): the compile log names the bare fn
+                    self.jit_names.append((call.args[0].attr, call.lineno))
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and "jit" in node.func.id.lower()
+                and node.args
+            ):
+                # the memoized-helper idiom (_jit_once(score_parents)):
+                # the helper's own jax.jit(fn) sees only a parameter, so
+                # the NAME join happens at the helper's call sites
+                a = node.args[0]
+                if isinstance(a, ast.Name):
+                    self.jit_names.append((a.id, node.lineno))
+                elif isinstance(a, ast.Attribute):
+                    self.jit_names.append((a.attr, node.lineno))
+
+    # -- emission ----------------------------------------------------------
+    def _add(self, key: str, line: int, message: str) -> None:
+        if key in self._seen_keys:
+            return  # one finding (the first site) per stable key
+        self._seen_keys.add(key)
+        self.findings.append(Finding(ID, key, self.rel, line, message))
+
+    # -- traced-body analysis ----------------------------------------------
+    def scan_traced_bodies(self) -> None:
+        for j in self.jitted:
+            static = j.static
+            params = {
+                a.arg for a in j.fn.args.posonlyargs + j.fn.args.args + j.fn.args.kwonlyargs
+            }
+            traced = params - static
+            qual = j.fn.name
+            for node in ast.walk(j.fn):
+                self._scan_traced_node(node, qual, traced)
+            # unstable static arg, declaration side: a static param whose
+            # default is non-hashable can never produce a cache hit
+            defaults = j.fn.args.defaults
+            argnames = [a.arg for a in j.fn.args.posonlyargs + j.fn.args.args]
+            for name, d in zip(argnames[len(argnames) - len(defaults):], defaults):
+                lit = _nonhashable_literal(d)
+                if name in static and lit is not None:
+                    self._add(
+                        f"unstable-static:{self.rel}:{qual}:{name}",
+                        d.lineno,
+                        f"static arg {name!r} of jitted {qual}() defaults to a"
+                        f" {lit} — non-hashable statics crash the jit cache or"
+                        " retrace every call",
+                    )
+
+    def _scan_traced_node(self, node: ast.AST, qual: str, traced: set[str]) -> None:
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func)
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _SYNC_BUILTINS
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)
+            ):
+                self._add(
+                    f"host-sync:{self.rel}:{qual}:{node.func.id}",
+                    node.lineno,
+                    f"{node.func.id}() on a traced value inside jitted {qual}()"
+                    " forces a device→host sync (or a trace-time crash) —"
+                    " keep the value on device or hoist out of the jit",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_ATTRS
+                and not node.args
+            ):
+                self._add(
+                    f"host-sync:{self.rel}:{qual}:{node.func.attr}",
+                    node.lineno,
+                    f".{node.func.attr}() inside jitted {qual}() is a"
+                    " device→host sync under trace",
+                )
+            elif chain is not None and chain.split(".")[0] in ("np", "numpy"):
+                root2 = ".".join(chain.split(".")[:2])
+                if root2 in ("np.random", "numpy.random"):
+                    self._add(
+                        f"side-effect:{self.rel}:{qual}:{chain}",
+                        node.lineno,
+                        f"host randomness {chain}() inside jitted {qual}() runs"
+                        " ONCE at trace time, then is baked constant — use"
+                        " jax.random with an explicit key",
+                    )
+                elif not _all_const_args(node):
+                    self._add(
+                        f"host-sync:{self.rel}:{qual}:{chain}",
+                        node.lineno,
+                        f"numpy op {chain}() on a traced value inside jitted"
+                        f" {qual}() pulls the array to host mid-trace — use"
+                        " the jnp twin",
+                    )
+            elif isinstance(node.func, ast.Name) and node.func.id == "print":
+                self._add(
+                    f"side-effect:{self.rel}:{qual}:print",
+                    node.lineno,
+                    f"print() inside jitted {qual}() runs at trace time only"
+                    " — use jax.debug.print for per-step output",
+                )
+            elif chain is not None and (
+                chain.split(".")[0] in _LOGGERISH or chain.startswith("time.")
+                or chain.split(".")[0] == "random"
+            ):
+                self._add(
+                    f"side-effect:{self.rel}:{qual}:{chain}",
+                    node.lineno,
+                    f"{chain}() inside jitted {qual}() is a Python side effect"
+                    " under trace — it fires once at compile, never per step",
+                )
+        elif isinstance(node, (ast.If, ast.While)):
+            names = {
+                n.id for n in ast.walk(node.test) if isinstance(n, ast.Name)
+            }
+            hit = sorted(names & traced)
+            if hit:
+                self._add(
+                    f"traced-branch:{self.rel}:{qual}:{hit[0]}",
+                    node.lineno,
+                    f"branch on traced value {hit[0]!r} inside jitted {qual}()"
+                    " — data-dependent Python control flow either crashes"
+                    " under trace or bakes one branch in; use lax.cond/where,"
+                    " or pin the arg static",
+                )
+
+    # -- call-site static-arg stability -------------------------------------
+    def scan_static_callsites(self) -> None:
+        by_name = {j.call_name: j for j in self.jitted if j.static}
+        # g = jax.jit(f, static_...): calls go through g, not f
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                call = _jit_construction(node.value)
+                if call is not None and call.args and isinstance(call.args[0], ast.Name):
+                    fn = self.defs.get(call.args[0].id)
+                    if fn is not None:
+                        statics = _static_params(call, fn)
+                        if statics:
+                            by_name[node.targets[0].id] = _Jitted(
+                                fn, statics, node.targets[0].id, call
+                            )
+        if not by_name:
+            return
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+                continue
+            j = by_name.get(node.func.id)
+            if j is None:
+                continue
+            argnames = [a.arg for a in j.fn.args.posonlyargs + j.fn.args.args]
+            for i, a in enumerate(node.args):
+                lit = _nonhashable_literal(a)
+                if lit is not None and i < len(argnames) and argnames[i] in j.static:
+                    self._add(
+                        f"unstable-static:{self.rel}:{j.call_name}:{argnames[i]}",
+                        a.lineno,
+                        f"call passes a {lit} for static arg {argnames[i]!r} of"
+                        f" jitted {j.call_name}() — non-hashable statics crash"
+                        " the jit cache or retrace every call",
+                    )
+            for kw in node.keywords:
+                lit = _nonhashable_literal(kw.value)
+                if lit is not None and kw.arg in j.static:
+                    self._add(
+                        f"unstable-static:{self.rel}:{j.call_name}:{kw.arg}",
+                        kw.value.lineno,
+                        f"call passes a {lit} for static arg {kw.arg!r} of"
+                        f" jitted {j.call_name}() — non-hashable statics crash"
+                        " the jit cache or retrace every call",
+                    )
+
+    # -- construction sites & device-hot module rules -----------------------
+    def scan_constructions(self) -> None:
+        self._walk_ctx(self.tree, qual="", in_fn=False, loop=0, memo=False)
+
+    def _fn_is_memoized(self, fn: ast.AST) -> bool:
+        """A function storing into a ``*cache*``-named subscript is a
+        memoized factory — its jit constructions run once per config,
+        not once per call (the ``_step_cache[key] = ...`` idiom)."""
+        for node in ast.walk(fn):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            for t in targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and "cache" in t.value.id.lower()
+                ):
+                    return True
+        return False
+
+    def _walk_ctx(self, node, qual: str, in_fn: bool, loop: int, memo: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{qual}.{child.name}" if qual else child.name
+                child_memo = memo or self._fn_is_memoized(child)
+                if in_fn and not child_memo:
+                    # a jit decorator on a def nested inside a function
+                    # builds a fresh wrapper per enclosing call
+                    for dec in child.decorator_list:
+                        if _is_jax_jit(dec) or _jit_construction(dec) is not None:
+                            self._flag_construction(qual or child.name, dec.lineno, loop)
+                # walk the BODY only: decorators were just handled, and
+                # walking them again through the generic Call branch would
+                # double-flag every decorated nested def
+                body = ast.Module(body=list(child.body), type_ignores=[])
+                self._walk_ctx(body, q, True, 0, child_memo)
+                continue
+            if isinstance(child, ast.ClassDef):
+                q = f"{qual}.{child.name}" if qual else child.name
+                self._walk_ctx(child, q, in_fn, loop, memo)
+                continue
+            if isinstance(child, ast.Call):
+                if _jit_construction(child) is not None and in_fn:
+                    if not memo or loop > 0:
+                        self._flag_construction(qual, child.lineno, loop)
+                self._scan_hot_call(child, qual)
+            if isinstance(child, ast.Subscript) and self.hot:
+                v = child.value
+                if isinstance(v, ast.Call) and _dotted(v.func) in (
+                    "np.asarray",
+                    "np.array",
+                    "numpy.asarray",
+                    "numpy.array",
+                ):
+                    self._add(
+                        f"host-pull:{self.rel}:{qual or '<module>'}:{_dotted(v.func)}",
+                        child.lineno,
+                        f"{_dotted(v.func)}(...)[...] in {qual or self.rel} pulls"
+                        " the WHOLE array device→host to read a slice — keep a"
+                        " host copy at the producer, or index on device",
+                    )
+            nxt = loop + (
+                1 if isinstance(child, (ast.For, ast.While, ast.AsyncFor)) else 0
+            )
+            self._walk_ctx(child, qual, in_fn, nxt, memo)
+
+    def _flag_construction(self, qual: str, line: int, loop: int) -> None:
+        q = qual or "<module>"
+        if loop > 0:
+            self._add(
+                f"jit-in-loop:{self.rel}:{q}",
+                line,
+                f"jax.jit wrapper constructed inside a loop in {q}() — a"
+                " fresh wrapper per iteration compiles per iteration; hoist"
+                " the construction out of the loop",
+            )
+        elif self.hot:
+            self._add(
+                f"jit-per-call:{self.rel}:{q}",
+                line,
+                f"jax.jit wrapper constructed inside {q}() in a device-hot"
+                " module — a fresh wrapper per call compiles per call; hoist"
+                " to module scope or store it in a *cache*-named dict the"
+                " analyzer can see",
+            )
+
+    def _scan_hot_call(self, call: ast.Call, qual: str) -> None:
+        if not self.hot:
+            return
+        chain = _dotted(call.func)
+        if chain == "jax.block_until_ready" or (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "block_until_ready"
+        ):
+            desc = chain or "?.block_until_ready"
+            q = qual or "<module>"
+            self._add(
+                f"block-until-ready:{self.rel}:{q}:{desc}",
+                call.lineno,
+                f"{desc}() in {q}() in a device-hot module blocks the host on"
+                " the device pipeline — sanctioned timing/confirmation sites"
+                " get allowlisted with why; anything else is a stall",
+            )
+
+
+def _all_const_args(call: ast.Call) -> bool:
+    return all(isinstance(a, ast.Constant) for a in call.args) and all(
+        isinstance(k.value, ast.Constant) for k in call.keywords
+    )
+
+
+def _scan_module(path: Path, rel: str) -> _ModuleScan | None:
+    text = path.read_text()
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return None
+    scan = _ModuleScan(tree, rel, text)
+    scan.scan_traced_bodies()
+    scan.scan_static_callsites()
+    scan.scan_constructions()
+    return scan
+
+
+def run(package_dir: Path) -> PassResult:
+    findings: list[Finding] = []
+    root = package_dir.parent
+    for path in sorted(package_dir.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        scan = _scan_module(path, path.relative_to(root).as_posix())
+        if scan is not None:
+            findings.extend(scan.findings)
+    return PassResult(ID, findings)
+
+
+# -- static facts the witness join needs -------------------------------------
+
+
+def collect_jit_sites(package_dir: Path) -> dict[str, list[tuple[str, int]]]:
+    """Wrapped-function name → [(relpath, line)] for every jit site the
+    AST can see — the join key for the runtime witness's per-function
+    compile counts (the compile log names the wrapped function)."""
+    root = package_dir.parent
+    out: dict[str, list[tuple[str, int]]] = {}
+    for path in sorted(package_dir.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        text = path.read_text()
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            continue
+        rel = path.relative_to(root).as_posix()
+        scan = _ModuleScan(tree, rel, text)
+        for name, line in scan.jit_names:
+            out.setdefault(name, []).append((rel, line))
+    return out
+
+
+def device_hot_files(package_dir: Path) -> set[str]:
+    root = package_dir.parent
+    out = set()
+    for path in sorted(package_dir.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        if DEVICE_HOT_MARKER in path.read_text():
+            out.add(path.relative_to(root).as_posix())
+    return out
+
+
+# -- witness cross-check -----------------------------------------------------
+
+WITNESS_ID = "jit-witness"
+
+# distinct compiled signatures one function may accumulate across a
+# witnessed run before it reads as a retrace storm. Shape-diverse-by-
+# design functions (static capacity args that grow) get allowlisted
+# with the reason, same as every other audited exception.
+MAX_SIGNATURES = 8
+# jit-wrapper constructions one site may perform: memoized factories
+# build one wrapper per *config*, not per call, so a handful is normal —
+# dozens means a per-call rebuild
+MAX_WRAPPERS = 8
+
+
+def witness_crosscheck(package_dir: Path, report_path: Path) -> PassResult:
+    """Join a jit-witness dump (``DF_JIT_WITNESS=1`` run) onto the static
+    jit sites: fail on retrace storms (one function, many compiled
+    signatures), wrapper churn (one construction site, many wrappers),
+    and implicit host→device transfers feeding jits from device-hot
+    modules. Compile counts for functions with no static jit site in the
+    package (jax-internal eager ops, test-defined jits) are ignored —
+    the join is what scopes the witness to our code."""
+    if not report_path.is_file():
+        return PassResult(WITNESS_ID, skipped=f"no witness report at {report_path}")
+    data = json.loads(report_path.read_text())
+    sites = collect_jit_sites(package_dir)
+    hot = device_hot_files(package_dir)
+    findings: list[Finding] = []
+
+    for name, info in sorted(data.get("compiles", {}).items()):
+        where = sites.get(name)
+        if not where:
+            continue
+        sigs = info.get("signatures", [])
+        if len(sigs) > MAX_SIGNATURES:
+            file, line = where[0]
+            findings.append(
+                Finding(
+                    WITNESS_ID,
+                    f"retrace:{name}",
+                    file,
+                    line,
+                    f"jitted {name}() compiled {len(sigs)} distinct signatures"
+                    f" ({info.get('count', len(sigs))} compiles) — a retrace"
+                    f" storm past the {MAX_SIGNATURES}-signature warmup"
+                    " allowance; stabilize shapes/static args or allowlist"
+                    " the by-design shape diversity with why",
+                )
+            )
+
+    for rec in data.get("wrapper_sites", []):
+        n = rec.get("count", 0)
+        target = rec.get("target", "?")
+        if n <= MAX_WRAPPERS:
+            continue
+        file, _, line = rec.get("site", "").rpartition(":")
+        findings.append(
+            Finding(
+                WITNESS_ID,
+                f"jit-rewrap:{file}:{target}",
+                file,
+                int(line or 0),
+                f"jax.jit({target}) constructed {n}× at one site — each fresh"
+                " wrapper carries its own compile cache, so this recompiles"
+                " per construction; memoize the wrapper",
+            )
+        )
+
+    for t in data.get("transfers", []):
+        if t.get("explicit"):
+            continue
+        file = t.get("file", "")
+        if file not in hot:
+            continue
+        findings.append(
+            Finding(
+                WITNESS_ID,
+                f"transfer:{file}:{t.get('fn', '?')}",
+                file,
+                int(t.get("line", 0)),
+                f"implicit host→device transfer feeding jitted"
+                f" {t.get('target', '?')}() from {t.get('fn', '?')}() in a"
+                f" device-hot module ({t.get('count', 1)}× witnessed) — convert"
+                " explicitly at the boundary (jnp.asarray/device_put) so the"
+                " transfer is visible and batchable",
+            )
+        )
+    # one finding per stable key (a site witnessed by many tests is one fact)
+    seen: set[str] = set()
+    uniq = []
+    for f in findings:
+        if f.key not in seen:
+            seen.add(f.key)
+            uniq.append(f)
+    return PassResult(WITNESS_ID, uniq)

@@ -1,8 +1,11 @@
 #!/usr/bin/env bash
 # hack/lint.sh — the single entry point builders and reviewers run before
-# pushing: dfanalyze (lock-order, blocking-under-lock, hygiene, metrics
-# census, mypy baseline), the legacy check_metrics shim, and a pytest
-# collection smoke. Exits nonzero on any regression.
+# pushing: dfanalyze (lock-order, blocking-under-lock, hygiene,
+# jaxhygiene XLA-dispatch lints, metrics census, mypy baseline), the
+# legacy check_metrics shim, and a pytest collection smoke. Exits
+# nonzero on any regression. Opt-in deep checks ride any pytest run:
+# DF_LOCK_WITNESS=1 (lock orders) and DF_JIT_WITNESS=1 (jit
+# compiles/transfers, cross-checked via --jit-witness-report).
 #
 # The collection smoke tolerates ONLY the known environment-caused
 # collection errors (modules this image can't import: cryptography,

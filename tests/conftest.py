@@ -38,19 +38,38 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 #   python -m hack.dfanalyze --witness-report <dump>
 # to cross-check against the static lock graph. Must install before the
 # package imports: module-level locks are created at import time.
-def _witness_enabled() -> bool:
+def _flag_enabled(name: str) -> bool:
     # same off-values as the other DF_* flags (utils/flight.py): "0",
     # "false", "no" disable — exporting DF_LOCK_WITNESS=0 must not
     # install the witness
-    return os.environ.get("DF_LOCK_WITNESS", "").lower() not in (
-        "", "0", "false", "no",
-    )
+    return os.environ.get(name, "").lower() not in ("", "0", "false", "no")
+
+
+def _witness_enabled() -> bool:
+    return _flag_enabled("DF_LOCK_WITNESS")
+
+
+def _jit_witness_enabled() -> bool:
+    return _flag_enabled("DF_JIT_WITNESS")
 
 
 if _witness_enabled():
     from hack.dfanalyze import witness as _lock_witness  # noqa: E402
 
     _lock_witness.install()
+
+# Opt-in runtime jit witness (hack/dfanalyze/jitwitness.py): records
+# per-function XLA compile counts, jit-wrapper construction sites, and
+# implicit host→device transfer sites from package code; dumped at
+# session end for
+#   python -m hack.dfanalyze --jit-witness-report <dump>
+# Must install before the package imports so module-level jit
+# constructions are witnessed (jax itself is already imported above,
+# which the witness requires).
+if _jit_witness_enabled():
+    from hack.dfanalyze import jitwitness as _jit_witness  # noqa: E402
+
+    _jit_witness.install()
 
 import pytest  # noqa: E402
 
@@ -62,6 +81,12 @@ def pytest_sessionfinish(session, exitstatus):
         if _w.active():
             path = _w.dump()
             print(f"\nlock-witness: acquisition orders dumped to {path}")
+    if _jit_witness_enabled():
+        from hack.dfanalyze import jitwitness as _jw
+
+        if _jw.active():
+            path = _jw.dump()
+            print(f"\njit-witness: compile/transfer record dumped to {path}")
 
 
 @pytest.fixture(scope="session")

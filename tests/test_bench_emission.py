@@ -413,6 +413,42 @@ def test_fleet_soak_failure_rides_exit_path(monkeypatch, capfd):
     assert rec["chaos_success_rate"] == 1.0
 
 
+def test_emits_jit_hygiene_keys(monkeypatch, capfd):
+    """The artifact carries the dispatch-plane hygiene measurement
+    (ISSUE 11): zero recompiles on a warm fit and ~one H2D per
+    superbatch, riding host_rates."""
+
+    def stub(paths, **kw):
+        return None, _stats(1000)
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "jit_hygiene_error" not in rec
+    assert rec["jit_recompiles_per_fit"] == 0  # warm fit reuses every executable
+    assert 0.0 < rec["h2d_transfers_per_superbatch"] <= 2.0
+
+
+def test_jit_hygiene_keys_survive_warmup_failure(monkeypatch, capfd):
+    """host_rates (jit-hygiene numbers included) ride every exit path —
+    a dead device link must not discard the dispatch-plane counters."""
+
+    def stub(paths, **kw):
+        raise RuntimeError("link died in compile")
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "warmup fit failed" in rec["error"]
+    assert rec["jit_recompiles_per_fit"] == 0
+    assert rec["h2d_transfers_per_superbatch"] > 0
+
+
+def test_jit_hygiene_bench_steady_state():
+    """Acceptance bar (ISSUE 11): the production step cache serves a
+    warm fit with ZERO recompiles, and the packed superbatch feed costs
+    exactly one H2D per dispatch."""
+    out = bench.jit_hygiene_bench(batch=256, steps_per_call=2, superbatches=3)
+    assert out["jit_recompiles_per_fit"] == 0
+    assert out["h2d_transfers_per_superbatch"] == 1.0
+
+
 def test_emits_telemetry_overhead(monkeypatch, capfd):
     """The artifact carries the telemetry-plane measurement (ISSUE 9:
     the reporter's per-push snapshot+encode is a measured duty cycle,

@@ -13,8 +13,8 @@ from pathlib import Path
 import pytest
 
 from hack import dfanalyze
-from hack.dfanalyze import witness
-from hack.dfanalyze.passes import blocking, hygiene, lockorder, typecheck
+from hack.dfanalyze import jitwitness, witness
+from hack.dfanalyze.passes import blocking, hygiene, jaxhygiene, lockorder, typecheck
 
 REPO = Path(__file__).resolve().parent.parent
 
@@ -324,6 +324,219 @@ class C:
 
 
 # ---------------------------------------------------------------------------
+# jaxhygiene: planted fixtures for every finding kind
+# ---------------------------------------------------------------------------
+
+
+def test_jaxhygiene_catches_host_sync_and_side_effects_under_trace(fakepkg):
+    (fakepkg / "traced.py").write_text(
+        """
+import jax
+import numpy as np
+
+@jax.jit
+def bad_step(params, x):
+    v = float(x)          # host sync under trace
+    y = x.item()          # host sync under trace
+    z = np.asarray(x)     # numpy pull mid-trace
+    print(x)              # trace-time-only side effect
+    return v + y + z
+"""
+    )
+    res = jaxhygiene.run(fakepkg)
+    keys = {f.key for f in res.findings}
+    assert "host-sync:fakepkg/traced.py:bad_step:float" in keys
+    assert "host-sync:fakepkg/traced.py:bad_step:item" in keys
+    assert "host-sync:fakepkg/traced.py:bad_step:np.asarray" in keys
+    assert "side-effect:fakepkg/traced.py:bad_step:print" in keys
+
+
+def test_jaxhygiene_catches_traced_branch_but_not_static_branch(fakepkg):
+    (fakepkg / "branchy.py").write_text(
+        """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def step(x, mode):
+    if mode:        # static arg: legal python control flow
+        x = x + 1
+    if x > 0:       # traced value: crashes or bakes one branch in
+        x = x * 2
+    return x
+"""
+    )
+    res = jaxhygiene.run(fakepkg)
+    keys = {f.key for f in res.findings}
+    assert "traced-branch:fakepkg/branchy.py:step:x" in keys
+    assert not any(k.endswith(":mode") for k in keys)
+
+
+def test_jaxhygiene_catches_jit_in_loop(fakepkg):
+    (fakepkg / "loopy.py").write_text(
+        """
+import jax
+
+def churn(fns, xs):
+    out = []
+    for f, x in zip(fns, xs):
+        out.append(jax.jit(f)(x))  # a compile per iteration
+    return out
+"""
+    )
+    res = jaxhygiene.run(fakepkg)
+    assert any(f.key.startswith("jit-in-loop:fakepkg/loopy.py:churn") for f in res.findings)
+
+
+def test_jaxhygiene_catches_jit_per_call_only_in_device_hot(fakepkg):
+    src = """
+import jax
+
+def fwd(params, x):
+    return x
+
+def rank(params, feats):
+    return jax.jit(fwd)(params, feats)  # fresh wrapper per rank() call
+"""
+    (fakepkg / "cold.py").write_text(src)
+    (fakepkg / "hot.py").write_text("# dfanalyze: device-hot\n" + src)
+    res = jaxhygiene.run(fakepkg)
+    keys = {f.key for f in res.findings}
+    assert "jit-per-call:fakepkg/hot.py:rank" in keys
+    assert not any("cold.py" in k for k in keys)
+
+
+def test_jaxhygiene_memoized_factory_is_exempt(fakepkg):
+    (fakepkg / "memo.py").write_text(
+        """# dfanalyze: device-hot
+import jax
+
+_step_cache: dict = {}
+
+def get_step(lr):
+    if lr in _step_cache:
+        return _step_cache[lr]
+
+    @jax.jit
+    def step(params, x):
+        return params, x * lr
+
+    _step_cache[lr] = step
+    return step
+"""
+    )
+    res = jaxhygiene.run(fakepkg)
+    assert res.findings == [], [f.message for f in res.findings]
+
+
+def test_jaxhygiene_catches_unstable_static_args(fakepkg):
+    (fakepkg / "statics.py").write_text(
+        """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("shape", "opts"))
+def build(x, shape, opts=[]):
+    return x
+
+def caller(x):
+    return build(x, shape=[4, 4])  # a list never hits the jit cache
+"""
+    )
+    res = jaxhygiene.run(fakepkg)
+    keys = {f.key for f in res.findings}
+    assert "unstable-static:fakepkg/statics.py:build:opts" in keys  # bad default
+    assert "unstable-static:fakepkg/statics.py:build:shape" in keys  # bad call site
+
+
+def test_jaxhygiene_catches_block_until_ready_and_host_pull(fakepkg):
+    (fakepkg / "sync.py").write_text(
+        """# dfanalyze: device-hot
+import jax
+import numpy as np
+
+def wait_all(xs, arr, i):
+    jax.block_until_ready(xs)
+    return np.asarray(arr)[i]  # whole-array D2H to read one element
+"""
+    )
+    res = jaxhygiene.run(fakepkg)
+    keys = {f.key for f in res.findings}
+    assert (
+        "block-until-ready:fakepkg/sync.py:wait_all:jax.block_until_ready" in keys
+    )
+    assert "host-pull:fakepkg/sync.py:wait_all:np.asarray" in keys
+
+
+def test_jaxhygiene_clean_device_hot_module(fakepkg):
+    """The idioms the fixes in this PR converged on — module-scope jits,
+    explicit boundary conversion, device-side indexing — analyze clean."""
+    (fakepkg / "clean.py").write_text(
+        """# dfanalyze: device-hot
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def step(params, x):
+    return params, x * 2
+
+def feed(params, buf):
+    return step(params, jnp.asarray(buf))
+
+def read_one(arr, i):
+    return float(np.asarray(arr[i]))  # index on device, pull one element
+"""
+    )
+    res = jaxhygiene.run(fakepkg)
+    assert res.findings == [], [f.message for f in res.findings]
+
+
+def test_jaxhygiene_allowlist_suppresses_and_goes_stale(fakepkg, tmp_path):
+    (fakepkg / "hot.py").write_text(
+        """# dfanalyze: device-hot
+import jax
+
+def fwd(x):
+    return x
+
+def rank(feats):
+    return jax.jit(fwd)(feats)
+"""
+    )
+    key = "jit-per-call:fakepkg/hot.py:rank"
+    al_file = tmp_path / "allow.txt"
+    al_file.write_text(f"jaxhygiene {key}  # audited: test fixture\n")
+    al = dfanalyze.Allowlist.load(al_file)
+    report = dfanalyze.run(package_dir=fakepkg, allowlist=al)
+    assert report["ok"], json.dumps(report["summary"], indent=2)
+    assert report["summary"]["allowlisted"] == 1
+
+    (fakepkg / "hot.py").write_text("x = 1\n")
+    al2 = dfanalyze.Allowlist.load(al_file)
+    report2 = dfanalyze.run(package_dir=fakepkg, allowlist=al2)
+    assert not report2["ok"]
+    assert report2["summary"]["stale_allowlist"] == [f"jaxhygiene {key}"]
+
+
+def test_collect_jit_sites_and_device_hot_files(fakepkg):
+    (fakepkg / "a.py").write_text(
+        """# dfanalyze: device-hot
+import jax
+
+@jax.jit
+def fwd(x):
+    return x
+"""
+    )
+    (fakepkg / "b.py").write_text("import jax\n\ndef g(x):\n    return x\n\nh = jax.jit(g)\n")
+    sites = jaxhygiene.collect_jit_sites(fakepkg)
+    assert "fwd" in sites and sites["fwd"][0][0] == "fakepkg/a.py"
+    assert "g" in sites
+    assert jaxhygiene.device_hot_files(fakepkg) == {"fakepkg/a.py"}
+
+
+# ---------------------------------------------------------------------------
 # allowlist discipline
 # ---------------------------------------------------------------------------
 
@@ -475,6 +688,211 @@ def test_witness_lock_passes_as_real_lock(fresh_witness):
     cond = threading.Condition(threading.RLock())
     with cond:
         cond.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# runtime jit witness
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fresh_jitwitness():
+    """Install the jit witness scoped to THIS file's jax usage. Under a
+    DF_JIT_WITNESS=1 session the witness is already live package-wide
+    (and uninstalling it would blind the rest of the session), so these
+    meta-tests skip — the session itself is the witness test."""
+    pytest.importorskip("jax")
+    if jitwitness.active():
+        pytest.skip("jit witness already active session-wide")
+    jitwitness.reset()
+    jitwitness.install(package_roots=("tests/",))
+    yield
+    jitwitness.uninstall()
+    jitwitness.reset()
+
+
+def test_jitwitness_records_compiles_rewraps_and_transfers(fresh_jitwitness):
+    import numpy as np
+
+    import jax
+
+    def wfx_fn(x):
+        return x * 2
+
+    for i in range(3):
+        fn = jax.jit(wfx_fn)  # fresh wrapper each round: 3 at one site
+        fn(np.ones((2 + i,), np.float32))  # numpy in: implicit transfer
+    snap = jitwitness.snapshot()
+    assert snap["compiles"]["wfx_fn"]["count"] == 3
+    assert len(snap["compiles"]["wfx_fn"]["signatures"]) == 3
+    wfx_sites = [w for w in snap["wrapper_sites"] if w["target"] == "wfx_fn"]
+    assert len(wfx_sites) == 1 and wfx_sites[0]["count"] == 3
+    implicit = [t for t in snap["transfers"] if not t["explicit"]]
+    assert implicit and implicit[0]["target"] == "wfx_fn"
+
+
+def test_jitwitness_warm_cache_records_nothing_new(fresh_jitwitness):
+    import jax
+    import jax.numpy as jnp
+
+    def wfy_fn(x):
+        return x + 1
+
+    fn = jax.jit(wfy_fn)
+    x = jnp.ones((4,))
+    fn(x)
+    jitwitness.reset()  # past the warmup
+    fn(x)  # cached executable, jax array in
+    snap = jitwitness.snapshot()
+    assert "wfy_fn" not in snap["compiles"]
+    assert [t for t in snap["transfers"] if not t["explicit"]] == []
+
+
+def test_jitwitness_device_put_is_explicit(fresh_jitwitness):
+    import numpy as np
+
+    import jax
+
+    jax.device_put(np.ones((3,), np.float32))
+    snap = jitwitness.snapshot()
+    assert snap["transfers"] and all(t["explicit"] for t in snap["transfers"])
+
+
+def test_jitwitness_roundtrip_crosscheck(fresh_jitwitness, fakepkg, tmp_path):
+    """The full loop: real compiles/wrappers/transfers recorded here,
+    dumped, then joined onto a planted static package whose jit site
+    names match — retrace storm, wrapper churn, and the device-hot
+    implicit transfer all surface as findings."""
+    import numpy as np
+
+    import jax
+
+    def wfz_fn(x):
+        return x * 3
+
+    for i in range(jaxhygiene.MAX_SIGNATURES + 2):
+        jax.jit(wfz_fn)(np.ones((2 + i,), np.float32))
+    snap = jitwitness.snapshot()
+    # the witnessed facts join onto the static package by function name
+    # and device-hot file; rewrite the recorded sites onto the fixture
+    (fakepkg / "plane.py").write_text(
+        """# dfanalyze: device-hot
+import jax
+
+def wfz_fn(x):
+    return x * 3
+
+ranked = jax.jit(wfz_fn)
+"""
+    )
+    snap["wrapper_sites"] = [
+        {"site": "fakepkg/plane.py:7", "target": "wfz_fn", "count": 99}
+    ]
+    snap["transfers"] = [
+        {
+            "file": "fakepkg/plane.py",
+            "fn": "rank",
+            "line": 8,
+            "target": "wfz_fn",
+            "explicit": False,
+            "count": 12,
+        }
+    ]
+    report = tmp_path / "jit-witness.json"
+    report.write_text(json.dumps(snap))
+    res = jaxhygiene.witness_crosscheck(fakepkg, report)
+    keys = {f.key for f in res.findings}
+    assert "retrace:wfz_fn" in keys
+    assert "jit-rewrap:fakepkg/plane.py:wfz_fn" in keys
+    assert "transfer:fakepkg/plane.py:rank" in keys
+
+
+def test_jitwitness_crosscheck_ignores_foreign_and_quiet_functions(
+    fakepkg, tmp_path
+):
+    """jax-internal eager ops (not a package jit site) and package
+    functions under the signature allowance must NOT fail the join."""
+    (fakepkg / "plane.py").write_text(
+        "import jax\n\ndef quiet_fn(x):\n    return x\n\nf = jax.jit(quiet_fn)\n"
+    )
+    dump = {
+        "compiles": {
+            "convert_element_type": {
+                "count": 500,
+                "signatures": [f"[s{i}]" for i in range(40)],
+            },
+            "quiet_fn": {"count": 3, "signatures": ["[a]", "[b]", "[c]"]},
+        },
+        # a shared memoization helper builds MANY distinct functions'
+        # wrappers at one line, one each — per-(site, target) records
+        # under the allowance must not read as churn
+        "wrapper_sites": [
+            {"site": "fakepkg/plane.py:5", "target": f"fwd_{i}", "count": 1}
+            for i in range(12)
+        ],
+        "transfers": [],
+    }
+    report = tmp_path / "jit-witness.json"
+    report.write_text(json.dumps(dump))
+    res = jaxhygiene.witness_crosscheck(fakepkg, report)
+    assert res.findings == [], [f.message for f in res.findings]
+
+
+def test_witness_allowlist_entries_never_stale_on_subset_runs(fakepkg, tmp_path):
+    """A subset witness run legitimately exercises none of the
+    allowlisted storms — witness-pass entries are exempt from the
+    stale rule (the full witnessed tier-1 audits them for rot)."""
+    (fakepkg / "ok.py").write_text("x = 1\n")
+    dump = tmp_path / "jw.json"
+    dump.write_text(json.dumps({"compiles": {}, "wrapper_sites": [], "transfers": []}))
+    al_file = tmp_path / "allow.txt"
+    al_file.write_text(
+        "jit-witness retrace:never_seen_here  # audited: full-session-only storm\n"
+    )
+    al = dfanalyze.Allowlist.load(al_file)
+    report = dfanalyze.run(
+        package_dir=fakepkg, allowlist=al, jit_witness_report=dump
+    )
+    assert report["ok"], json.dumps(report["summary"], indent=2)
+    assert report["summary"]["stale_allowlist"] == []
+
+
+def test_jit_witness_report_flag_requires_dump(fakepkg, capsys):
+    from hack.dfanalyze.__main__ import main
+
+    (fakepkg / "ok.py").write_text("x = 1\n")
+    rc = main(["--jit-witness-report", str(fakepkg / "missing.json"), str(fakepkg)])
+    assert rc == 1
+    assert "jit-witness report not found" in capsys.readouterr().out
+
+
+def test_bench_taps_count_compiles_and_h2d():
+    """The bench taps (compile_tap/transfer_tap) behind bench.py's
+    jit_recompiles_per_fit and h2d_transfers_per_superbatch keys: a
+    fresh shape compiles and counts, a warm shape counts zero, and the
+    H2D tap sees exactly the numpy→device conversions."""
+    pytest.importorskip("jax")
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from dragonfly2_tpu.trainer import metrics as M
+
+    @jax.jit
+    def tap_probe(x):
+        return x * 5
+
+    base_compiles = M.JIT_RECOMPILES_TOTAL.value
+    with jitwitness.compile_tap() as ct, jitwitness.transfer_tap() as tt:
+        tap_probe(jnp.asarray(np.ones((7,), np.float32)))  # compile + 1 h2d
+    assert ct.count >= 1
+    assert tt.h2d == 1
+    assert M.JIT_RECOMPILES_TOTAL.value >= base_compiles + 1  # census-covered series
+    with jitwitness.compile_tap() as ct2, jitwitness.transfer_tap() as tt2:
+        tap_probe(jnp.asarray(np.ones((7,), np.float32)))  # warm: no compile
+    assert ct2.count == 0
+    assert tt2.h2d == 1
 
 
 # ---------------------------------------------------------------------------
