@@ -476,6 +476,67 @@ def telemetry_overhead_bench(iters: int = 200, trials: int = 5) -> dict:
     }
 
 
+def prof_overhead_bench(iters: int = 2000, trials: int = 5) -> dict:
+    """Continuous-profiler cost (ISSUE 12: the dfprof sampler must stay
+    invisible next to the hot paths).
+
+    Direct measurement, same discipline as the tracing/recorder/
+    telemetry benches: one sampler sweep (``sys._current_frames()`` +
+    per-thread package-frame fold into the trie + ring append) runs in
+    a tight loop against a process exercising the real scheduling
+    microbench on a worker thread (so the sweep walks genuine package
+    stacks, not an idle interpreter), and its best-of-``trials``
+    per-sweep cost is charged at the configured ``DF_PROF_HZ``.
+
+    - ``prof_sample_us``: wall per sweep, best-of-``trials``.
+    - ``prof_overhead_pct``: sweep cost × rate as a fraction of one
+      core — the duty cycle the background sampler actually costs the
+      process. Acceptance bar < 2%.
+    - ``prof_phase_us``: one phase-ledger ``observe`` (the per-leg cost
+      the instrumented hot paths pay) — informational, the sampler gate
+      is the acceptance key.
+    """
+    import threading
+
+    from dragonfly2_tpu.utils import profiling
+
+    sched, child = _scheduling_microbench()
+    prof = profiling.SamplingProfiler()
+    stop = threading.Event()
+
+    def churn():
+        while not stop.is_set():
+            sched.schedule_candidate_parents(child, set())
+
+    t = threading.Thread(target=churn, name="scheduler.bench-churn", daemon=True)
+    t.start()
+    best = float("inf")
+    try:
+        for _ in range(max(trials, 1)):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                prof.sample_once()
+            best = min(best, (time.perf_counter() - t0) / iters)
+    finally:
+        stop.set()
+        t.join(timeout=2.0)
+    ph = profiling.phase_type("scheduler.bench_phase")
+    ph_iters = 50_000
+    best_ph = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(ph_iters):
+            ph.observe(0.0001)
+        best_ph = min(best_ph, (time.perf_counter() - t0) / ph_iters)
+    hz = prof.hz
+    return {
+        "prof_overhead_pct": round(best * hz * 100.0, 3),
+        "prof_sample_us": round(best * 1e6, 2),
+        "prof_phase_us": round(best_ph * 1e6, 3),
+        "prof_hz": hz,
+    }
+
+
 def tracing_overhead_bench(iters: int = 1000, trials: int = 5) -> dict:
     """Tracing cost on the scheduling hot path when nothing samples.
 
@@ -741,6 +802,19 @@ def main() -> None:
         except Exception as e:
             host_rates["telemetry_error"] = str(e)
             _phase(f"telemetry bench failed: {e}")
+        # dfprof sampler overhead rides host_rates the same way: the
+        # continuous profiler's sweep duty cycle must stay < 2% of one
+        # core at the configured rate, and the artifact carries it
+        try:
+            host_rates.update(prof_overhead_bench())
+            _phase(
+                f"dfprof: sweep {host_rates['prof_sample_us']:.1f} us x"
+                f" {host_rates['prof_hz']:.0f} Hz ="
+                f" {host_rates['prof_overhead_pct']:.3f}% duty cycle"
+            )
+        except Exception as e:
+            host_rates["prof_error"] = str(e)
+            _phase(f"dfprof bench failed: {e}")
         # jit-hygiene microbench rides host_rates the same way: a warm
         # fit must hit the step cache (0 recompiles) and feed the device
         # once per superbatch — the dispatch-plane regression counters

@@ -43,9 +43,14 @@ from dragonfly2_tpu.client.piece_manager import (
 from dragonfly2_tpu.client.pieces import PieceRange, parse_byte_range, piece_ranges
 from dragonfly2_tpu.client.storage import StorageManager
 from dragonfly2_tpu.client import metrics as M
-from dragonfly2_tpu.utils import dflog, faults, flight
+from dragonfly2_tpu.utils import dflog, faults, flight, profiling
 
 logger = dflog.get("client.conductor")
+
+# dfprof phase: time spent waiting for an in-progress parent to produce
+# a piece it hasn't written yet — the piece path's third wall leg next
+# to daemon.piece_read / daemon.piece_write (piece_manager)
+PH_PARENT_WAIT = profiling.phase_type("daemon.parent_wait")
 
 # fault point: the announce-stream open — chaos schedules kill the
 # scheduler link here to drill the reconnect-with-resume path
@@ -183,11 +188,13 @@ class PeerTaskConductor:
             EV_PEER_START(task_id=self.task_id, peer_id=self.peer_id, url=self.url)
         self._started_at = time.monotonic()
         self._stream_thread = threading.Thread(
-            target=self._stream_loop, name=f"announce-{self.peer_id[:8]}", daemon=True
+            target=self._stream_loop,
+            name=f"daemon.announce-{self.peer_id[:8]}",
+            daemon=True,
         )
         self._stream_thread.start()
         self._run_thread = threading.Thread(
-            target=self._run, name=f"conductor-{self.peer_id[:8]}", daemon=True
+            target=self._run, name=f"daemon.conductor-{self.peer_id[:8]}", daemon=True
         )
         self._run_thread.start()
 
@@ -425,7 +432,7 @@ class PeerTaskConductor:
         self._requests = queue.Queue()
         self._stream_thread = threading.Thread(
             target=self._stream_loop,
-            name=f"announce-{self.peer_id[:8]}-{tag}",
+            name=f"daemon.announce-{self.peer_id[:8]}-{tag}",
             daemon=True,
         )
         self._stream_thread.start()
@@ -637,7 +644,8 @@ class PeerTaskConductor:
                             parent.peer_id, now + self.opts.wait_piece_timeout
                         )
                         if now < deadline:
-                            time.sleep(self.opts.not_found_backoff)
+                            with PH_PARENT_WAIT:
+                                time.sleep(self.opts.not_found_backoff)
                             continue
                         # waited out the piece — fall through as a hard
                         # failure so the task reschedules instead of

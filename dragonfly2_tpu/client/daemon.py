@@ -347,9 +347,11 @@ class Daemon:
         # flight recorder: crash dumps on SIGTERM/fatal + the Diagnose
         # snapshot RPC on the daemon's gRPC plane
         from dragonfly2_tpu.rpc.diagnose import DiagnoseService
-        from dragonfly2_tpu.utils import flight
+        from dragonfly2_tpu.utils import flight, profiling
 
         flight.install("daemon")
+        # continuous profiler: always-on sampler + phase ledger
+        profiling.install("daemon")
         flight.register_probe(
             "daemon.tasks",
             lambda: {"conductors": len(self.task_manager.conductors)},

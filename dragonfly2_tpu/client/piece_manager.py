@@ -18,9 +18,15 @@ from dataclasses import dataclass, field
 from dragonfly2_tpu.client import downloader, source
 from dragonfly2_tpu.client.pieces import PieceRange, compute_piece_length, piece_ranges
 from dragonfly2_tpu.client.storage import StorageError, TaskStorage
-from dragonfly2_tpu.utils import dflog, faults, flight
+from dragonfly2_tpu.utils import dflog, faults, flight, profiling
 
 logger = dflog.get("client.piece")
+
+# dfprof phases: the piece path's wall split — network read from the
+# parent vs the verified write into the piece store (the wait-for-parent
+# leg is accounted conductor-side, where the waiting happens)
+PH_PIECE_READ = profiling.phase_type("daemon.piece_read")
+PH_PIECE_WRITE = profiling.phase_type("daemon.piece_write")
 
 # origin-path flight events: back-to-source is the expensive fallback,
 # so every origin hit is worth a permanent ring entry
@@ -118,9 +124,10 @@ class PieceManager:
             FP_PIECE_READ()
         except faults.InjectedFault as e:
             raise downloader.PieceDownloadError(str(e)) from e
-        data, digest, content_type = downloader.download_piece(
-            parent.upload_addr, ts.meta.task_id, pr.number, peer_id=peer_id
-        )
+        with PH_PIECE_READ:
+            data, digest, content_type = downloader.download_piece(
+                parent.upload_addr, ts.meta.task_id, pr.number, peer_id=peer_id
+            )
         data = FP_PIECE_READ.mutate(data)
         if self.download_delay_s > 0:
             time.sleep(self.download_delay_s)  # inside the cost window
@@ -140,15 +147,16 @@ class PieceManager:
                 f"piece {pr.number}: want {pr.length}B got {len(data)}B"
             )
         try:
-            pm = ts.write_piece(
-                pr.number,
-                pr.offset,
-                data,
-                digest=digest,
-                traffic_type=TRAFFIC_REMOTE_PEER,
-                cost_ns=int(dt * 1e9),
-                parent_id=parent.peer_id,
-            )
+            with PH_PIECE_WRITE:
+                pm = ts.write_piece(
+                    pr.number,
+                    pr.offset,
+                    data,
+                    digest=digest,
+                    traffic_type=TRAFFIC_REMOTE_PEER,
+                    cost_ns=int(dt * 1e9),
+                    parent_id=parent.peer_id,
+                )
         except StorageError as e:
             # a digest mismatch means THIS parent served corrupt bytes —
             # that's a retryable piece failure (another parent or the

@@ -1586,7 +1586,7 @@ class RestServer:
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_port
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="manager-rest", daemon=True
+            target=self._httpd.serve_forever, name="manager.rest", daemon=True
         )
         self._thread.start()
         return f"{self.host}:{self.port}"

@@ -12,7 +12,7 @@ from dragonfly2_tpu.manager.models_registry import ModelRegistry
 from dragonfly2_tpu.manager.objectstorage import new_object_storage
 from dragonfly2_tpu.manager.service import ManagerService
 from dragonfly2_tpu.rpc import glue
-from dragonfly2_tpu.utils import dflog, flight
+from dragonfly2_tpu.utils import dflog, flight, profiling
 
 logger = dflog.get("manager.server")
 
@@ -134,6 +134,8 @@ class ManagerServer:
 
         # flight recorder: crash dumps + the Diagnose snapshot RPC
         flight.install("manager")
+        # continuous profiler: always-on sampler + phase ledger
+        profiling.install("manager")
         from dragonfly2_tpu.manager.telemetry import TelemetryService
         from dragonfly2_tpu.rpc.diagnose import DiagnoseService
         from dragonfly2_tpu.utils.metrics import set_build_info

@@ -16,7 +16,7 @@ from dragonfly2_tpu.rpc import gen  # noqa: F401 — sets up flat imports
 import diagnose_pb2  # noqa: E402
 
 from dragonfly2_tpu.rpc.glue import DIAGNOSE_SERVICE as SERVICE_NAME  # noqa: F401
-from dragonfly2_tpu.utils import flight
+from dragonfly2_tpu.utils import flight, profiling
 
 
 class DiagnoseService:
@@ -32,6 +32,12 @@ class DiagnoseService:
             "rings": rec.snapshot(categories),
             "runtime": rec.runtime_state(include_stacks=request.include_stacks),
         }
+        try:
+            # the dfprof capture (tools/dfprof.py --rpc): sampler stats,
+            # collapsed stacks, phase ledger — never fatal to Diagnose
+            snap["profile"] = profiling.profile_snapshot()
+        except Exception as e:
+            snap["profile_error"] = str(e)
         return diagnose_pb2.DiagnoseResponse(
             service=rec.service,
             pid=os.getpid(),

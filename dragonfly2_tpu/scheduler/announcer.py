@@ -202,12 +202,16 @@ class Announcer:
 
     # -- background loops --------------------------------------------------
     def serve(self) -> None:
-        t = threading.Thread(target=self._train_loop, name="announcer-train", daemon=True)
+        t = threading.Thread(
+            target=self._train_loop, name="scheduler.announcer-train", daemon=True
+        )
         t.start()
         self._threads.append(t)
         if self.manager_client is not None:
             k = threading.Thread(
-                target=self._keepalive_loop, name="announcer-keepalive", daemon=True
+                target=self._keepalive_loop,
+                name="scheduler.announcer-keepalive",
+                daemon=True,
             )
             k.start()
             self._threads.append(k)

@@ -28,10 +28,14 @@ from dragonfly2_tpu.schema.features import (
     MLP_FEATURE_DIM,
     location_affinity as offline_location_affinity,
 )
-from dragonfly2_tpu.utils import dflog, flight, tracing
+from dragonfly2_tpu.utils import dflog, flight, profiling, tracing
 from dragonfly2_tpu.utils.dfplugin import registry as plugin_registry
 
 logger = dflog.get("scheduler.evaluator")
+
+# dfprof phase: the per-decision topology-engine lookup leg (one ledger
+# entry per candidate batch, like the batch span below)
+PH_TOPOLOGY_RTT = profiling.phase_type("scheduler.topology_rtt")
 
 # per-decision "explain" record: the top-k candidates' predicted costs
 # and full feature vectors (rtt_affinity included) — the evidence for
@@ -314,7 +318,8 @@ class MLEvaluator(BaseEvaluator):
                 with tracing.maybe_span(
                     "scheduler", "topology.rtt_affinity", pairs=len(parents)
                 ):
-                    rtts = [self._rtt_affinity(p, child) for p in parents]
+                    with PH_TOPOLOGY_RTT:
+                        rtts = [self._rtt_affinity(p, child) for p in parents]
             else:
                 rtts = [0.0] * len(parents)
             # one vectorized location-affinity call for the whole

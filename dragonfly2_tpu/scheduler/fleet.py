@@ -203,7 +203,10 @@ class FleetMembership:
             "fleet join %s (ttl=%.1fs, %d members)",
             self.self_addr, self.cfg.lease_ttl, len(self._members),
         )
-        for fn, name in ((self._renew_loop, "fleet-renew"), (self._poll_loop, "fleet-poll")):
+        for fn, name in (
+            (self._renew_loop, "scheduler.fleet-renew"),
+            (self._poll_loop, "scheduler.fleet-poll"),
+        ):
             t = threading.Thread(target=fn, name=name, daemon=True)
             t.start()
             self._threads.append(t)
@@ -371,7 +374,7 @@ class FleetWatcher:
 
     def start(self) -> None:
         self._thread = threading.Thread(
-            target=self._loop, name="fleet-watch", daemon=True
+            target=self._loop, name="fleet.watch", daemon=True
         )
         self._thread.start()
 

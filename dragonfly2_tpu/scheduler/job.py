@@ -49,7 +49,9 @@ class JobWorker:
 
     # ------------------------------------------------------------------
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._loop, name="job-worker", daemon=True)
+        self._thread = threading.Thread(
+            target=self._loop, name="scheduler.job-worker", daemon=True
+        )
         self._thread.start()
 
     def stop(self) -> None:

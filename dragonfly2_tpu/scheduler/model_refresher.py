@@ -148,7 +148,7 @@ class ModelRefresher:
     def start(self) -> None:
         self.refresh_once()
         self._thread = threading.Thread(
-            target=self._loop, name="model-refresher", daemon=True
+            target=self._loop, name="scheduler.model-refresher", daemon=True
         )
         self._thread.start()
 

@@ -31,9 +31,15 @@ from dragonfly2_tpu.scheduler.resource import (
     Peer,
 )
 from dragonfly2_tpu.scheduler import metrics as M
-from dragonfly2_tpu.utils import dflog, faults, flight, tracing
+from dragonfly2_tpu.utils import dflog, faults, flight, profiling, tracing
 
 logger = dflog.get("scheduling")
+
+# dfprof phase ledger: the schedule op's wall split (whole decision vs
+# the evaluator leg; the topology and storage legs are declared at
+# their own sites) — live counters on /debug/prof, always on
+PH_SCHEDULE = profiling.phase_type("scheduler.schedule_op")
+PH_EVALUATE = profiling.phase_type("scheduler.evaluate")
 
 # flight-recorder emitters: one event per scheduling decision, always on
 # (the per-decision record the sampled trace usually misses); bench.py
@@ -145,6 +151,10 @@ class Scheduling:
         finally:
             M.CONCURRENT_SCHEDULE_GAUGE.dec()
             _span.end("ok")  # idempotent; attributes set at decision points
+            # observe-only off the existing timer (one ~0.6µs ledger
+            # add, no enter bookkeeping): concurrency is already
+            # visible via CONCURRENT_SCHEDULE_GAUGE
+            PH_SCHEDULE.observe(time.perf_counter() - _t0)
 
     def _schedule_loop(self, peer, blocklist, cancelled, n, _t0, _span):
         while True:
@@ -257,11 +267,13 @@ class Scheduling:
         total = peer.task.total_piece_count
         # duplicated call instead of maybe_span: the unsampled branch
         # then pays ONE predicate — not even the attrs dict build
+        _e0 = time.perf_counter()
         if tracing.is_sampling():
             with tracing.get("scheduler").span("evaluate", candidates=len(candidates)):
                 candidates = self.evaluator.evaluate_parents(candidates, peer, total)
         else:
             candidates = self.evaluator.evaluate_parents(candidates, peer, total)
+        PH_EVALUATE.observe(time.perf_counter() - _e0)
         limit = self._candidate_parent_limit()
         return candidates[:limit], True
 

@@ -17,7 +17,7 @@ from dragonfly2_tpu.scheduler.networktopology import NetworkTopology
 from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
 from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
 from dragonfly2_tpu.scheduler.storage import Storage
-from dragonfly2_tpu.utils import dflog, flight
+from dragonfly2_tpu.utils import dflog, flight, profiling
 from dragonfly2_tpu.utils.gc import GC, GCTask
 from dragonfly2_tpu.utils import kvstore
 from dragonfly2_tpu.utils.kvstore import KVStore
@@ -311,6 +311,8 @@ class SchedulerServer:
         # flight recorder: crash dumps on SIGTERM/fatal, live snapshots
         # via the Diagnose RPC on the same gRPC plane
         flight.install("scheduler")
+        # continuous profiler: always-on sampler + phase ledger
+        profiling.install("scheduler")
         if self.topology_engine is not None:
             flight.register_probe("scheduler.topology", self.topology_engine.stats)
         flight.register_probe(

@@ -249,7 +249,8 @@ class SchedulerService:
                     peer.delete_stream()
                 adapter.close()
 
-        t = threading.Thread(target=pump, daemon=True)
+        # <service>.<role>: dfprof/flight/Diagnose attribute by role
+        t = threading.Thread(target=pump, name="scheduler.announce-pump", daemon=True)
         t.start()
         while True:
             resp = adapter.out.get()

@@ -298,7 +298,9 @@ class SchedulerServiceV1:
                     adapter.close()
                 ready.set()  # wake the response side even on empty streams
 
-        t = threading.Thread(target=pump, daemon=True)
+        t = threading.Thread(
+            target=pump, name="scheduler.announce-pump-v1", daemon=True
+        )
         t.start()
         # Block until the first request installs the adapter; a client that
         # opens the stream and sends nothing just ends it.

@@ -25,8 +25,14 @@ from dragonfly2_tpu.schema.columnar import RotatingBlockWriter, RotatingCSVWrite
 from dragonfly2_tpu.scheduler.resource import Peer
 from dragonfly2_tpu.scheduler.resource.host import Host
 from dragonfly2_tpu.scheduler.resource.task import Task
+from dragonfly2_tpu.utils import profiling
 
 NS_PER_S = 1_000_000_000
+
+# dfprof phase: the per-download training-record append (the storage/KV
+# leg of a decision's lifecycle, next to scheduler.evaluate and
+# scheduler.topology_rtt in the ledger)
+PH_STORE_RECORD = profiling.phase_type("scheduler.store_record")
 
 BLOCK_RECORDS = wire.BLOCK_RECORDS  # block batch floor for the binary sink
 
@@ -146,10 +152,11 @@ class Storage:
 
     # -- writes ----------------------------------------------------------
     def create_download(self, rec: R.DownloadRecord) -> None:
-        with self._lock:
-            self._download.create(rec)
-            if self._blocks_download is not None:
-                self._blocks_download.create(rec)
+        with PH_STORE_RECORD:
+            with self._lock:
+                self._download.create(rec)
+                if self._blocks_download is not None:
+                    self._blocks_download.create(rec)
 
     def create_network_topology(self, rec: R.NetworkTopologyRecord) -> None:
         with self._lock:

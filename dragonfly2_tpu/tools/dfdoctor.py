@@ -240,6 +240,34 @@ def render_incident(
     )
     for ts, line in rows:
         print(f"    {(ts - t1) / 1e9:+9.3f}s  {line}", file=out)
+    # dfprof window attached by the dump (utils/profiling): the hot
+    # frames at death, merged into the same incident view
+    prof = incident.meta.get("profile") or {}
+    if prof.get("collapsed"):
+        from dragonfly2_tpu.tools.dfprof import parse_collapsed, self_total
+
+        folded = parse_collapsed(prof["collapsed"])
+        total = sum(folded.values())
+        hot = sorted(
+            self_total(folded).items(), key=lambda kv: kv[1]["self"], reverse=True
+        )
+        print(
+            f"  hot frames (dfprof window, last {prof.get('window_s', '?')}s,"
+            f" {total} samples):",
+            file=out,
+        )
+        for frame, rec in hot[:3]:
+            pct = rec["self"] / total * 100.0 if total else 0.0
+            print(f"    {pct:5.1f}%  {frame}", file=out)
+        phases = prof.get("phases") or {}
+        if phases:
+            worst = sorted(
+                phases.items(), key=lambda kv: -kv[1].get("total_s", 0.0)
+            )[:3]
+            shares = "  ".join(
+                f"{name}={s.get('share', 0.0):.0%}" for name, s in worst
+            )
+            print(f"  phase shares: {shares}", file=out)
     print(
         f"    ========  {incident.reason} window flagged: dump at +0.000s"
         f"  ========",

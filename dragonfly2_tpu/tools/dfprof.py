@@ -1,0 +1,232 @@
+"""dfprof — render, capture, and diff continuous-profiler output.
+
+The capture shape is what every dfprof surface serves
+(utils/profiling.profile_snapshot): JSON with a flamegraph-compatible
+``collapsed`` stack text plus the phase ledger. Sources:
+
+- a saved capture file — JSON from ``GET /debug/prof`` or a Diagnose
+  snapshot's ``profile`` section, or bare collapsed-stack text;
+- ``--rpc host:port`` — a live capture over the Diagnose RPC (the same
+  plane dfdoctor collects from);
+- a flight-recorder dump's ``meta.profile`` window (dfdoctor renders
+  those inline; this tool reads the same shape).
+
+Usage:
+    python -m dragonfly2_tpu.tools.dfprof CAPTURE [--top N] [--collapsed]
+    python -m dragonfly2_tpu.tools.dfprof --rpc HOST:PORT [--save F]
+    python -m dragonfly2_tpu.tools.dfprof --diff BEFORE AFTER [--top N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def parse_collapsed(text: str) -> dict[tuple[str, ...], int]:
+    """Collapsed-stack text → {(frame, ...): count}. Torn/blank lines
+    are skipped, never fatal (captures ride crash dumps)."""
+    out: dict[tuple[str, ...], int] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        stack, _, count = line.rpartition(" ")
+        if not stack or not count.isdigit():
+            continue
+        key = tuple(stack.split(";"))
+        out[key] = out.get(key, 0) + int(count)
+    return out
+
+
+def self_total(folded: dict) -> dict[str, dict]:
+    """Per-frame self/total sample counts from folded stacks: self =
+    samples where the frame is the leaf, total = samples with the frame
+    anywhere on the stack (deduped per stack)."""
+    out: dict[str, dict] = {}
+    for stack, n in folded.items():
+        for frame in set(stack):
+            rec = out.setdefault(frame, {"self": 0, "total": 0})
+            rec["total"] += n
+        out.setdefault(stack[-1], {"self": 0, "total": 0})["self"] += n
+    return out
+
+
+def load_capture(path: str) -> dict:
+    """A capture dict with at least ``collapsed``; JSON captures keep
+    their ``phases``/stats, bare collapsed text becomes a minimal one."""
+    text = Path(path).read_text()
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        return {"collapsed": text, "phases": {}}
+    if isinstance(obj, dict) and "profile" in obj and "collapsed" not in obj:
+        obj = obj["profile"]  # a Diagnose snapshot / dump meta
+    if not isinstance(obj, dict) or "collapsed" not in obj:
+        raise ValueError(f"{path}: not a dfprof capture (no 'collapsed' key)")
+    return obj
+
+
+def capture_rpc(addr: str, timeout: float = 10.0) -> dict:
+    """Live capture: the Diagnose RPC's ``profile`` section."""
+    from dragonfly2_tpu.rpc import gen  # noqa: F401 — flat imports
+    import diagnose_pb2  # noqa: E402
+
+    from dragonfly2_tpu.rpc import glue
+
+    channel = glue.dial(addr, retries=1)
+    try:
+        client = glue.ServiceClient(channel, glue.DIAGNOSE_SERVICE, target=addr)
+        resp = client.Diagnose(
+            diagnose_pb2.DiagnoseRequest(include_stacks=False), timeout=timeout
+        )
+    finally:
+        channel.close()
+    snap = json.loads(resp.snapshot_json)
+    prof = snap.get("profile")
+    if not prof:
+        raise ValueError(
+            f"{addr}: Diagnose answered without a profile section"
+            f" ({snap.get('profile_error', 'profiler not installed?')})"
+        )
+    prof.setdefault("service", snap.get("service", ""))
+    return prof
+
+
+def render_top(folded: dict, top: int, out) -> None:
+    rows = sorted(
+        self_total(folded).items(),
+        key=lambda kv: (kv[1]["self"], kv[1]["total"]),
+        reverse=True,
+    )
+    total_samples = sum(folded.values())
+    print(
+        f"top {min(top, len(rows))} frames by self samples"
+        f" ({total_samples} samples, {len(folded)} distinct stacks):",
+        file=out,
+    )
+    print(f"  {'self':>7} {'self%':>6} {'total':>7}  frame", file=out)
+    for frame, rec in rows[:top]:
+        pct = rec["self"] / total_samples * 100.0 if total_samples else 0.0
+        print(
+            f"  {rec['self']:>7} {pct:>5.1f}% {rec['total']:>7}  {frame}",
+            file=out,
+        )
+
+
+def render_phases(phases: dict, out) -> None:
+    if not phases:
+        return
+    print("phase ledger:", file=out)
+    print(
+        f"  {'phase':<28} {'count':>8} {'total_s':>10} {'mean_s':>9}"
+        f" {'share':>6} {'active':>6}",
+        file=out,
+    )
+    for name in sorted(phases, key=lambda n: -phases[n].get("total_s", 0.0)):
+        s = phases[name]
+        print(
+            f"  {name:<28} {s.get('count', 0):>8} {s.get('total_s', 0.0):>10.3f}"
+            f" {s.get('mean_s', 0.0):>9.6f} {s.get('share', 0.0):>6.0%}"
+            f" {s.get('active', 0):>6}",
+            file=out,
+        )
+
+
+def render_capture(cap: dict, top: int, collapsed_only: bool, out) -> None:
+    if collapsed_only:
+        print(cap.get("collapsed", ""), file=out)
+        return
+    svc = cap.get("service", "")
+    hz = cap.get("hz", "")
+    window = cap.get("window_s")
+    head = "dfprof capture"
+    if svc:
+        head += f"  service={svc}"
+    if hz:
+        head += f"  hz={hz}"
+    if window:
+        head += f"  window={window}s"
+    if cap.get("dropped"):
+        head += f"  dropped={cap['dropped']}"
+    print(head, file=out)
+    render_top(parse_collapsed(cap.get("collapsed", "")), top, out)
+    render_phases(cap.get("phases", {}), out)
+
+
+def render_diff(before: dict, after: dict, top: int, out) -> None:
+    """Per-frame self-sample movement between two captures — where the
+    new hot time went (positive) and where it left (negative)."""
+    a = self_total(parse_collapsed(before.get("collapsed", "")))
+    b = self_total(parse_collapsed(after.get("collapsed", "")))
+    deltas = {
+        frame: b.get(frame, {}).get("self", 0) - a.get(frame, {}).get("self", 0)
+        for frame in set(a) | set(b)
+    }
+    movers = sorted(deltas.items(), key=lambda kv: abs(kv[1]), reverse=True)
+    movers = [(f, d) for f, d in movers if d][:top]
+    print(f"top {len(movers)} self-sample movers (after - before):", file=out)
+    for frame, d in movers:
+        print(f"  {d:>+8}  {frame}", file=out)
+    pa, pb = before.get("phases", {}), after.get("phases", {})
+    moved = {
+        name: round(
+            pb.get(name, {}).get("total_s", 0.0)
+            - pa.get(name, {}).get("total_s", 0.0),
+            6,
+        )
+        for name in set(pa) | set(pb)
+    }
+    moved = {k: v for k, v in moved.items() if v}
+    if moved:
+        print("phase total_s movement:", file=out)
+        for name in sorted(moved, key=lambda n: -abs(moved[n])):
+            print(f"  {moved[name]:>+12.3f}s  {name}", file=out)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dfprof",
+        description="render/capture/diff dfprof continuous-profiler output",
+    )
+    p.add_argument("capture", nargs="?", help="capture file (JSON or collapsed text)")
+    # note: no --seconds here — the Diagnose capture is all-time;
+    # windowed captures come from GET /debug/prof?seconds=N
+    p.add_argument("--rpc", metavar="HOST:PORT", help="live capture via Diagnose")
+    p.add_argument("--diff", nargs=2, metavar=("BEFORE", "AFTER"))
+    p.add_argument("--top", type=int, default=15)
+    p.add_argument(
+        "--collapsed", action="store_true", help="print raw collapsed stacks only"
+    )
+    p.add_argument("--save", metavar="FILE", help="also write the capture as JSON")
+    args = p.parse_args(argv)
+
+    try:
+        if args.diff:
+            render_diff(
+                load_capture(args.diff[0]),
+                load_capture(args.diff[1]),
+                args.top,
+                sys.stdout,
+            )
+            return 0
+        if args.rpc:
+            cap = capture_rpc(args.rpc)
+        elif args.capture:
+            cap = load_capture(args.capture)
+        else:
+            p.error("nothing to read: pass a capture file, --rpc, or --diff")
+            return 2
+    except Exception as e:
+        print(f"dfprof: {e}", file=sys.stderr)
+        return 1
+    if args.save:
+        Path(args.save).write_text(json.dumps(cap, indent=2, default=str))
+    render_capture(cap, args.top, args.collapsed, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -154,6 +154,7 @@ def run(
         threading.Thread(
             target=worker,
             args=(target, url, stop, samples, lock, tag, idx, connections),
+            name=f"stress.download-{idx}",
             daemon=True,
         )
         for idx in range(connections)
@@ -318,7 +319,7 @@ def chaos_soak(
                 except Exception as e:
                     result["error"] = str(e)
 
-            t = threading.Thread(target=work, daemon=True)
+            t = threading.Thread(target=work, name="stress.chaos-download", daemon=True)
             t.start()
             t.join(deadline_s + 15.0)  # hard watchdog over the budget
             if t.is_alive():
@@ -419,7 +420,7 @@ def _spawn_scheduler(workdir: str, kv_addr: str, lease_ttl: float,
             lines.put(line)
         lines.put(None)
 
-    threading.Thread(target=pump, daemon=True).start()
+    threading.Thread(target=pump, name="stress.ready-pump", daemon=True).start()
     deadline = time.monotonic() + 60.0
     addr = None
     while time.monotonic() < deadline:
@@ -627,8 +628,8 @@ def shard_kill_soak(
                     counters["ok" if ok else "failed"] += 1
 
         threads = [
-            threading.Thread(target=worker, daemon=True)
-            for _ in range(workers)
+            threading.Thread(target=worker, name=f"stress.announce-{i}", daemon=True)
+            for i in range(workers)
         ]
         for t in threads:
             t.start()

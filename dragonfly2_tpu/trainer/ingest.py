@@ -47,7 +47,7 @@ import numpy as np
 from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
 from dragonfly2_tpu.schema import native, wire
 from dragonfly2_tpu.trainer import metrics as M
-from dragonfly2_tpu.utils import dflog, flight
+from dragonfly2_tpu.utils import dflog, flight, profiling
 
 logger = dflog.get("trainer.ingest")
 
@@ -58,6 +58,14 @@ logger = dflog.get("trainer.ingest")
 EV_SUPERBATCH = flight.event_type("trainer.superbatch")
 EV_STREAM_DONE = flight.event_type("trainer.stream_done")
 EV_STALL = flight.event_type("trainer.stall")
+
+# dfprof phase ledger: the StreamStats wall split as LIVE cross-service
+# phases — buffer_wait's share of the trainer group on /debug/prof must
+# agree with the per-fit StreamStats ratio (acceptance-tested)
+PH_DECODE_WAIT = profiling.phase_type("trainer.decode_wait")
+PH_BUFFER_WAIT = profiling.phase_type("trainer.buffer_wait")
+PH_H2D = profiling.phase_type("trainer.h2d")
+PH_STEP = profiling.phase_type("trainer.step")
 
 
 @dataclass
@@ -267,7 +275,9 @@ def stream_shards(
         t = threading.Thread(
             target=produce,
             args=(spans[w::workers],),
-            name=f"ingest-decode-{w}",
+            # <service>.<role> so dfprof/flight/Diagnose attribute by
+            # role; the numeric suffix folds away in thread_role()
+            name=f"trainer.ingest-decode-{w}",
             daemon=True,
         )
         t.start()
@@ -599,6 +609,7 @@ def stream_train_mlp(
                 dt_h = t_s - t_h
                 stats.h2d_s += dt_h
                 M.INGEST_H2D_SECONDS.observe(dt_h, exemplar=trace_exemplar)
+                PH_H2D.observe(dt_h)
                 state["params"], state["opt_state"], loss = fn(
                     state["params"], state["opt_state"], dev
                 )
@@ -613,6 +624,7 @@ def stream_train_mlp(
                 dt_s = time.perf_counter() - t_s
                 stats.step_s += dt_s
                 M.INGEST_STEP_SECONDS.observe(dt_s, exemplar=trace_exemplar)
+                PH_STEP.observe(dt_s)
                 EV_SUPERBATCH(
                     h2d_s=round(dt_h, 6), step_s=round(dt_s, 6), steps=k
                 )
@@ -673,6 +685,7 @@ def stream_train_mlp(
             dt_w = time.perf_counter() - w0
             stats.decode_wait_s += dt_w
             M.INGEST_DECODE_WAIT_SECONDS.observe(dt_w, exemplar=trace_exemplar)
+            PH_DECODE_WAIT.observe(dt_w)
             decode_watch.observe(dt_w)
             if budget_end is not None and time.perf_counter() > budget_end:
                 stats.truncated = True
@@ -731,13 +744,22 @@ def stream_train_mlp(
                     if disp_thread is None:
                         state["params"], state["opt_state"] = params, opt_state
                         disp_thread = threading.Thread(
-                            target=_dispatch_loop, name="ingest-dispatch", daemon=True
+                            target=_dispatch_loop,
+                            name="trainer.ingest-dispatch",
+                            daemon=True,
                         )
                         disp_thread.start()
                     w0 = time.perf_counter()
                     filled_bufs.put(buf)  # may block at queue depth
                     buf = free_bufs.get()
-                    stats.buffer_wait_s += time.perf_counter() - w0
+                    dt_b = time.perf_counter() - w0
+                    stats.buffer_wait_s += dt_b
+                    # the largest wall component finally has a live
+                    # series + ledger phase next to its trio of siblings
+                    M.INGEST_BUFFER_WAIT_SECONDS.observe(
+                        dt_b, exemplar=trace_exemplar
+                    )
+                    PH_BUFFER_WAIT.observe(dt_b)
                     fill = 0
                     if disp_errors:
                         break

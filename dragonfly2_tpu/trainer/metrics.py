@@ -1,6 +1,7 @@
 """Trainer Prometheus series (reference trainer/metrics/metrics.go:38-52
 plus fit-duration/ingest visibility the TPU trainer adds)."""
 
+from dragonfly2_tpu.utils import profiling
 from dragonfly2_tpu.utils.metrics import default_registry as _r
 
 TRAIN_TOTAL = _r.counter("trainer_train_total", "Train RPC streams accepted")
@@ -38,6 +39,20 @@ INGEST_STEP_SECONDS = _r.histogram(
     "Compiled train-step dispatch + prior-step confirmation, per superbatch",
     buckets=_INGEST_BUCKETS,
 )
+# the packing thread blocked on the superbatch pool — the single
+# largest wall component in BENCH_r06 (~79%), live per superbatch like
+# its decode_wait/h2d/step siblings, exemplars carrying the fit's
+# trace_id the same way
+INGEST_BUFFER_WAIT_SECONDS = _r.histogram(
+    "trainer_ingest_buffer_wait_seconds",
+    "Packing thread blocked on the superbatch buffer pool, per superbatch",
+    buckets=_INGEST_BUCKETS,
+)
+# device-side attribution for the jit-witness taps
+# (hack/dfanalyze/jitwitness.py): transfers are timed, compiles are
+# count-markers — both land in the dfprof phase ledger per fit
+PH_JIT_COMPILE = profiling.phase_type("trainer.jit_compile")
+PH_DEVICE_TRANSFER = profiling.phase_type("trainer.device_transfer")
 DATASET_BYTES_TOTAL = _r.counter(
     "trainer_dataset_bytes_total", "Dataset bytes received on Train streams", ("kind",)
 )

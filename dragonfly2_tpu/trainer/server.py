@@ -11,7 +11,7 @@ from dragonfly2_tpu.trainer.service import SERVICE_NAME, TrainerService
 from dragonfly2_tpu.trainer.storage import TrainerStorage
 from dragonfly2_tpu.trainer.train import FitConfig, GNNFitConfig
 from dragonfly2_tpu.trainer.training import Training, TrainingConfig
-from dragonfly2_tpu.utils import dflog, flight
+from dragonfly2_tpu.utils import dflog, flight, profiling
 
 logger = dflog.get("trainer.server")
 
@@ -116,6 +116,9 @@ class TrainerServer:
     def serve(self) -> str:
         # flight recorder: stall/crash dumps + the Diagnose snapshot RPC
         flight.install("trainer")
+        # continuous profiler: always-on sampler + phase ledger
+        # (/debug/prof, Diagnose profile section, dump windows)
+        profiling.install("trainer")
         flight.register_probe(
             "trainer.storage",
             lambda: {"host_ids": self.storage.host_ids()},

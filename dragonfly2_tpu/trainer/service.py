@@ -104,6 +104,7 @@ class TrainerService:
                 threading.Thread(
                     target=self._train_safely,
                     args=(ip, hostname, tracing.current_span()),
+                    name="trainer.fit",
                     daemon=True,
                 ).start()
         return trainer_pb2.TrainResponse()

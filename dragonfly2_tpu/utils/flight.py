@@ -67,6 +67,21 @@ DUMPS_TOTAL = _r.counter(
 
 _DEFAULT_RING = 512
 
+# dump augments: zero-arg callables whose dict result is merged into
+# every dump's meta line (utils/profiling attaches the last-N-seconds
+# sample window here, so a stall dump names its hot frames). Module
+# level, not per-recorder: the profile window belongs to the PROCESS,
+# and test recorders must dump it the same way the real one does.
+_dump_augments: list = []
+
+
+def register_dump_augment(fn) -> None:
+    """Attach extra state to every future dump's meta line. ``fn`` is a
+    zero-arg callable returning a dict (merged into meta) — failures
+    are swallowed at dump time, never fatal mid-crash."""
+    if fn not in _dump_augments:
+        _dump_augments.append(fn)
+
 
 def _env_ring_size() -> int:
     try:
@@ -261,24 +276,24 @@ class FlightRecorder:
                 f"{self.service or 'proc'}-{os.getpid()}-{time.time_ns()}-{slug}.jsonl",
             )
             snap = self.snapshot()
+            meta = {
+                "reason": reason,
+                "service": self.service,
+                "pid": os.getpid(),
+                "dumped_at_ns": time.time_ns(),
+                "ring_size": self.ring_size,
+                "events": {c: len(e) for c, e in snap.items()},
+                "runtime": self.runtime_state(),
+            }
+            for fn in list(_dump_augments):
+                try:
+                    meta.update(fn() or {})
+                except Exception:
+                    # augments are best-effort evidence; a broken one
+                    # must not cost the dump itself
+                    continue
             with open(path, "w") as f:
-                f.write(
-                    json.dumps(
-                        {
-                            "meta": {
-                                "reason": reason,
-                                "service": self.service,
-                                "pid": os.getpid(),
-                                "dumped_at_ns": time.time_ns(),
-                                "ring_size": self.ring_size,
-                                "events": {c: len(e) for c, e in snap.items()},
-                                "runtime": self.runtime_state(),
-                            }
-                        },
-                        default=str,
-                    )
-                    + "\n"
-                )
+                f.write(json.dumps({"meta": meta}, default=str) + "\n")
                 for cat, events in snap.items():
                     for ev in events:
                         f.write(json.dumps({"category": cat, **ev}, default=str) + "\n")

@@ -337,7 +337,11 @@ class MetricsServer:
     GET /debug/ring serves the local flight-recorder rings
     (utils/flight) as JSON — ``?category=<name>`` narrows to one ring
     and 404s for unknown categories, the same not-found behavior as
-    unknown paths. GET /debug/faults serves the fault-injection plane's
+    unknown paths. GET /debug/prof serves the continuous profiler
+    (utils/profiling) — collapsed flamegraph stacks plus the phase
+    ledger as JSON; ``?seconds=N`` narrows to the recent-sample window,
+    ``?format=collapsed`` returns the bare stack text, and unknown
+    parameters/values are 400. GET /debug/faults serves the fault-injection plane's
     state (utils/faults: registered points, armed rules with call/fire
     counts); POST /debug/faults with a spec-string body arms a schedule
     live (empty body disarms) — the chaos toggle without a restart.
@@ -479,6 +483,54 @@ class MetricsServer:
                     ).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if url.path == "/debug/prof":
+                    import json
+
+                    # lazy import: profiling registers its own series in
+                    # this module's default registry at import time
+                    from dragonfly2_tpu.utils import profiling
+
+                    params = parse_qs(url.query, keep_blank_values=True)
+                    unknown = set(params) - {"seconds", "format"}
+                    seconds = None
+                    fmt = params.get("format", ["json"])[0]
+                    err = ""
+                    if unknown:
+                        err = f"unknown parameters: {sorted(unknown)}"
+                    elif fmt not in ("json", "collapsed"):
+                        err = f"unknown format {fmt!r} (json|collapsed)"
+                    elif "seconds" in params:
+                        import math
+
+                        try:
+                            seconds = float(params["seconds"][0])
+                        except ValueError:
+                            seconds = -1.0
+                        # nan/inf parse fine but blow up the ns window
+                        # math downstream — same 400 as any bad value
+                        if not math.isfinite(seconds) or seconds <= 0:
+                            err = "seconds must be a positive finite number"
+                    if err:
+                        data = json.dumps({"error": err}).encode()
+                        self.send_response(400)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                        return
+                    snap = profiling.profile_snapshot(seconds)
+                    if fmt == "collapsed":
+                        data = (snap["collapsed"] + "\n").encode()
+                        ctype = "text/plain; charset=utf-8"
+                    else:
+                        data = json.dumps(snap, default=str).encode()
+                        ctype = "application/json"
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)

@@ -31,7 +31,7 @@ import os
 import threading
 import time
 
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, profiling
 from dragonfly2_tpu.utils.metrics import (
     Counter,
     Gauge,
@@ -224,6 +224,16 @@ class TelemetryReporter:
                 logger.warning("telemetry section collection failed: %s", e)
                 sections = {}
             payload.update(sections)
+        try:
+            # dfprof summary: top-K hot stacks over the last minute +
+            # phase totals/shares — the manager folds unknown sections
+            # generically, so this rides every reporter for free. Empty
+            # (quiet process, sampler off) → omitted.
+            prof = profiling.telemetry_section()
+            if prof:
+                payload["prof"] = prof
+        except Exception as e:
+            logger.debug("telemetry prof section failed: %s", e)
         return payload, cur
 
     def push_once(self) -> bool:

@@ -43,6 +43,7 @@ import logging
 import os
 import sys
 import threading
+import time
 
 _state_lock = threading.Lock()
 
@@ -377,17 +378,26 @@ class transfer_tap:
             if getattr(tls, "depth", 0) == 0 and _any_np(x, np):
                 outer.h2d += 1
                 _metric_inc("h2d_transfers")
+                t0 = time.perf_counter()
+                try:
+                    return outer._raw_put(x, *a, **kw)
+                finally:
+                    _phase_observe("device_transfer", time.perf_counter() - t0)
             return outer._raw_put(x, *a, **kw)
 
         def asarray(x, *a, **kw):
-            if isinstance(x, np.ndarray):
+            timed = isinstance(x, np.ndarray)
+            if timed:
                 outer.h2d += 1
                 _metric_inc("h2d_transfers")
+                t0 = time.perf_counter()
             tls.depth = getattr(tls, "depth", 0) + 1
             try:
                 return outer._raw_asarray(x, *a, **kw)
             finally:
                 tls.depth -= 1
+                if timed:
+                    _phase_observe("device_transfer", time.perf_counter() - t0)
 
         jax.device_put = put
         jnp.asarray = asarray
@@ -414,5 +424,20 @@ def _metric_inc(kind: str) -> None:
         return
     if kind == "jit_recompiles":
         M.JIT_RECOMPILES_TOTAL.inc()
+        # count-marker in the dfprof ledger: a moving trainer.jit_compile
+        # count mid-fit IS the retrace storm, visible on /debug/prof
+        _phase_observe("jit_compile", 0.0)
     else:
         M.H2D_TRANSFERS_TOTAL.inc()
+
+
+def _phase_observe(kind: str, seconds: float) -> None:
+    """Attribute device-side time into the dfprof phase ledger
+    (trainer.device_transfer timed per conversion, trainer.jit_compile
+    a count marker) while a tap is armed."""
+    try:
+        from dragonfly2_tpu.trainer import metrics as M
+    except Exception:
+        return
+    ph = M.PH_DEVICE_TRANSFER if kind == "device_transfer" else M.PH_JIT_COMPILE
+    ph.observe(seconds)

@@ -31,17 +31,27 @@ PACKAGE = DEFAULT_PACKAGE
 # (dragonfly_build_info{service,version} — every exporter carries it)
 ALLOWED_SERVICES = (
     "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "flight",
-    "faults", "resilience", "fleet", "build",
+    "faults", "resilience", "fleet", "build", "prof",
 )
 
 # flight-recorder event names are <service>.<what>; the service segment
 # is the ring category — the process roles plus the cross-layer "rpc"
-# (resilience decisions: retries, breaker trips, sheds) and "faults"
-# (injections) rings, which must not evict any role's own history
+# (resilience decisions: retries, breaker trips, sheds), "faults"
+# (injections), and "prof" (sampler lifecycle) rings, which must not
+# evict any role's own history
 EVENT_SERVICES = (
     "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "faults",
-    "fleet",
+    "fleet", "prof",
 )
+
+# the prof.* event namespace is reserved for the continuous profiler —
+# a stray scheduler-side prof-ish event would fork the vocabulary
+# dfdoctor/dfprof key on, so only this module may declare them
+PROF_EVENT_MODULE = "dragonfly2_tpu/utils/profiling.py"
+
+# dfprof phase-ledger names (profiling.phase_type("<service>.<what>"))
+# share the event services' vocabulary: phases belong to a process role
+PHASE_SERVICES = EVENT_SERVICES
 
 # fault-point names are <layer>.<what>; mirrors utils/faults.POINT_LAYERS
 FAULT_LAYERS = ("rpc", "daemon", "scheduler", "trainer", "manager", "kv", "fleet")
@@ -97,8 +107,30 @@ def check(package_dir: Path = PACKAGE) -> list[str]:
     seen_events: dict[str, str] = {}  # event name -> site
     seen_points: dict[str, str] = {}  # fault point -> site
     seen_tfields: dict[str, str] = {}  # telemetry field -> site
+    seen_phases: dict[str, str] = {}  # dfprof phase -> site
     for path in sorted(package_dir.rglob("*.py")):
         rel = path.relative_to(package_dir.parent)
+        for name, _attr, lineno in _literal_attr_calls(path, ("phase_type",)):
+            site = f"{rel}:{lineno}"
+            if not all(c.islower() or c.isdigit() or c in "._" for c in name):
+                failures.append(
+                    f"{site}: dfprof phase {name!r} has characters outside"
+                    " [a-z0-9_.]"
+                )
+            service = name.split(".", 1)[0]
+            if "." not in name or service not in PHASE_SERVICES:
+                failures.append(
+                    f"{site}: dfprof phase {name!r} must be <service>.<what>"
+                    f" with service in {PHASE_SERVICES}"
+                )
+            prev_site = seen_phases.get(name)
+            if prev_site is not None:
+                failures.append(
+                    f"{site}: duplicate dfprof phase registration of {name!r}"
+                    f" (first at {prev_site})"
+                )
+            else:
+                seen_phases[name] = site
         for name, _attr, lineno in _literal_attr_calls(path, ("tfield",)):
             site = f"{rel}:{lineno}"
             if not all(c.islower() or c.isdigit() or c in "._" for c in name):
@@ -165,6 +197,13 @@ def check(package_dir: Path = PACKAGE) -> list[str]:
                 failures.append(
                     f"{site}: event {name!r} uses the reserved slo_ segment;"
                     " SLO events must be manager.slo_<what>"
+                )
+            # the prof.* namespace belongs to the continuous profiler
+            if service == "prof" and str(rel) != PROF_EVENT_MODULE:
+                failures.append(
+                    f"{site}: event {name!r} uses the reserved prof."
+                    f" namespace; prof events are declared in"
+                    f" {PROF_EVENT_MODULE} only"
                 )
             prev_site = seen_events.get(name)
             if prev_site is not None:

@@ -211,7 +211,7 @@ def test_abandoned_consumer_releases_producer(tmp_path):
         alive = [
             t
             for t in threading.enumerate()
-            if t.name.startswith("ingest-decode") and t.name not in before
+            if t.name.startswith("trainer.ingest-decode") and t.name not in before
         ]
         if not alive:
             break
@@ -417,7 +417,7 @@ def test_dispatcher_thread_joined_on_producer_error(tmp_path, monkeypatch):
     """An exception raised out of the packing loop (producer decode
     failure) must still shut the dispatcher thread down via the sentinel
     + join handshake — the trainer service calls stream_train_mlp every
-    round, so a leaked 'ingest-dispatch' thread accumulates."""
+    round, so a leaked 'trainer.ingest-dispatch' thread accumulates."""
     import dragonfly2_tpu.schema.native as N
     from dragonfly2_tpu.trainer.ingest import stream_train_mlp
 
@@ -426,7 +426,7 @@ def test_dispatcher_thread_joined_on_producer_error(tmp_path, monkeypatch):
 
     def _dispatcher_alive():
         return any(
-            t.name == "ingest-dispatch" and t.is_alive()
+            t.name == "trainer.ingest-dispatch" and t.is_alive()
             for t in threading.enumerate()
         )
 
@@ -454,11 +454,11 @@ def test_dispatcher_thread_joined_on_producer_error(tmp_path, monkeypatch):
         stream_train_mlp(p, passes=50, batch_size=16, eval_every=0)
     deadline = time.time() + 5.0
     while time.time() < deadline and any(
-        t.name == "ingest-dispatch" and t.is_alive() for t in threading.enumerate()
+        t.name == "trainer.ingest-dispatch" and t.is_alive() for t in threading.enumerate()
     ):
         time.sleep(0.05)
     leaked = [
         t.name for t in threading.enumerate()
-        if t.name == "ingest-dispatch" and t.is_alive()
+        if t.name == "trainer.ingest-dispatch" and t.is_alive()
     ]
     assert not leaked, f"dispatcher thread leaked: {leaked}"
