@@ -354,6 +354,35 @@ def chaos_soak_bench() -> dict:
     return chaos_soak(downloads=4, piece=16 * 1024, deadline_s=30.0)
 
 
+def serving_bench() -> dict:
+    """The batched scheduler-inference soak (tools/stress.serving_soak)
+    at bench scale: 32 concurrent simulated peers rank candidate sets
+    through the scoring service's deadline-aware micro-batches vs the
+    per-call model dispatch, same model both arms (ROADMAP item 1
+    acceptance, re-proven on every bench run).
+
+    - ``serving_ops_per_s_batched`` / ``serving_ops_per_s_per_call``:
+      aggregate decisions/sec (the fleet soak owns the bare
+      ``schedule_ops_per_s`` key in this artifact).
+    - ``evaluator_batch_occupancy``: candidate rows per scored batch.
+    - ``schedule_decision_p99_us``: batched-path decision latency tail,
+      bounded by the batching window + single-batch service time
+      (``serving_p99_bound_us`` carries the measured bound).
+    """
+    from dragonfly2_tpu.tools.stress import serving_soak
+
+    out = serving_soak(peers=32, decisions_per_peer=15)
+    return {
+        "serving_ops_per_s_batched": out["schedule_ops_per_s"],
+        "serving_ops_per_s_per_call": out["schedule_ops_per_s_per_call"],
+        "evaluator_batch_occupancy": out["evaluator_batch_occupancy"],
+        "schedule_decision_p99_us": out["schedule_decision_p99_us"],
+        "serving_p99_bound_us": out["serving_p99_bound_us"],
+        "serving_backend": out["serving_backend"],
+        "serving_lost": out["serving_lost"],
+    }
+
+
 def fleet_shard_kill_bench() -> dict:
     """The scheduler-fleet failover soak (tools/stress.shard_kill_soak)
     at bench scale: 3 real scheduler shards under KV leases, a
@@ -841,6 +870,21 @@ def main() -> None:
         except Exception as e:
             host_rates["resilience_error"] = str(e)
             _phase(f"resilience bench failed: {e}")
+        # batched-serving soak rides host_rates the same way: aggregate
+        # decisions/sec batched vs per-call, batch occupancy, and the
+        # p99 decision tail land in the artifact on every exit path
+        try:
+            host_rates.update(serving_bench())
+            _phase(
+                f"serving: {host_rates['serving_ops_per_s_batched']:.0f} ops/s"
+                f" batched vs {host_rates['serving_ops_per_s_per_call']:.0f}"
+                f" per-call, occupancy"
+                f" {host_rates['evaluator_batch_occupancy']:.1f} rows/batch,"
+                f" p99 {host_rates['schedule_decision_p99_us'] / 1e3:.1f}ms"
+            )
+        except Exception as e:
+            host_rates["serving_error"] = str(e)
+            _phase(f"serving bench failed: {e}")
         # chaos soak: the canned fault schedule against a real in-process
         # swarm — success rate and hang count ride every exit path
         try:

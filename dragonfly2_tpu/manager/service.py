@@ -459,6 +459,16 @@ class ManagerService:
             except KeyError as e:
                 context.abort(grpc.StatusCode.NOT_FOUND, str(e))
             return self._model(row)
+        if request.state == "inactive":
+            # explicit deactivation is an operator decision the serve
+            # path must honor (the scheduler's refresher withdraws the
+            # model / serving slot on the next poll) — silently ignoring
+            # it left "deactivated" models serving forever
+            try:
+                row = self.models.deactivate(request.model_id, request.version)
+            except KeyError as e:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            return self._model(row)
         row = self.models.get(request.model_id, request.version)
         if row is None:
             context.abort(grpc.StatusCode.NOT_FOUND, f"model {request.model_id} not found")

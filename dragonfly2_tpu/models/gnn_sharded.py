@@ -39,14 +39,12 @@ def pad_rows(a: np.ndarray, multiple: int) -> np.ndarray:
     return padded
 
 
-def pad_graph(graph, num_shards: int):
-    """ProbeGraph → padded arrays sharding-ready over ``num_shards``.
-
-    Padded nodes self-neighbor with zero mask (inert under masked mean);
-    padded edges point at node 0 with zero weight in the loss mask.
-    Returns (node_features, neighbors, neighbor_mask, edge_src, edge_dst,
-    edge_y, edge_w) as numpy arrays.
-    """
+def pad_node_arrays(graph, num_shards: int):
+    """ProbeGraph → padded NODE arrays sharding-ready over ``num_shards``
+    — the serving-side half of :func:`pad_graph` (an embed-at-swap
+    forward has no edge blocks to pad). Padded nodes self-neighbor with
+    zero mask, inert under the masked mean. Returns (node_features,
+    neighbors, neighbor_mask) as numpy arrays."""
     nf = pad_rows(graph.node_features.astype(np.float32), num_shards)
     n_pad = nf.shape[0]
     neighbors = pad_rows(graph.neighbors.astype(np.int32), num_shards)
@@ -55,6 +53,18 @@ def pad_graph(graph, num_shards: int):
         pad_ids = np.arange(graph.num_nodes, n_pad, dtype=np.int32)
         neighbors[graph.num_nodes :] = pad_ids[:, None]
     mask = pad_rows(graph.neighbor_mask.astype(np.float32), num_shards)
+    return nf, neighbors, mask
+
+
+def pad_graph(graph, num_shards: int):
+    """ProbeGraph → padded arrays sharding-ready over ``num_shards``.
+
+    Padded nodes self-neighbor with zero mask (inert under masked mean);
+    padded edges point at node 0 with zero weight in the loss mask.
+    Returns (node_features, neighbors, neighbor_mask, edge_src, edge_dst,
+    edge_y, edge_w) as numpy arrays.
+    """
+    nf, neighbors, mask = pad_node_arrays(graph, num_shards)
 
     src = pad_rows(graph.edge_src.astype(np.int32), num_shards)
     dst = pad_rows(graph.edge_dst.astype(np.int32), num_shards)
@@ -63,19 +73,17 @@ def pad_graph(graph, num_shards: int):
     return nf, neighbors, mask, src, dst, y, w
 
 
-def _forward_local(
+def _embed_local(
     dense: dict,
     embed_shard: jax.Array | None,  # [S, E] or None
     feat_shard: jax.Array,  # [S, F]
     nbr_shard: jax.Array,  # [S, K] global ids
     mask_shard: jax.Array,  # [S, K]
-    src_blk: jax.Array,  # [Eb] global ids
-    dst_blk: jax.Array,  # [Eb]
     axis: str,
     compute_dtype,
 ) -> jax.Array:
-    """Per-device body under shard_map → per-edge log-RTT for this
-    device's edge block."""
+    """Per-device SAGE stack under shard_map → this device's [S, H]
+    L2-normalized embedding rows."""
     h = feat_shard
     if embed_shard is not None:
         h = jnp.concatenate([h, embed_shard], axis=-1)
@@ -93,7 +101,25 @@ def _forward_local(
         )
         h = jax.nn.relu(z + layer["b"].astype(jnp.float32))
     norm = jnp.linalg.norm(h, axis=-1, keepdims=True)
-    h = h / jnp.maximum(norm, 1e-6)
+    return h / jnp.maximum(norm, 1e-6)
+
+
+def _forward_local(
+    dense: dict,
+    embed_shard: jax.Array | None,  # [S, E] or None
+    feat_shard: jax.Array,  # [S, F]
+    nbr_shard: jax.Array,  # [S, K] global ids
+    mask_shard: jax.Array,  # [S, K]
+    src_blk: jax.Array,  # [Eb] global ids
+    dst_blk: jax.Array,  # [Eb]
+    axis: str,
+    compute_dtype,
+) -> jax.Array:
+    """Per-device body under shard_map → per-edge log-RTT for this
+    device's edge block."""
+    h = _embed_local(
+        dense, embed_shard, feat_shard, nbr_shard, mask_shard, axis, compute_dtype
+    )
 
     # one ring rotation serves both endpoints — stacked indices halve the
     # ppermute volume of the hottest collective in the loop
@@ -127,6 +153,34 @@ def make_sharded_forward(mesh, axis: str = "gp", compute_dtype=jnp.bfloat16):
             # shard_map specs are positional — substitute an empty table
             embed = jnp.zeros((feats.shape[0], 0), feats.dtype)
         return fwd(dense, embed, feats, nbrs, mask, src, dst)
+
+    return apply
+
+
+def make_sharded_embed(mesh, axis: str = "gp", compute_dtype=jnp.bfloat16):
+    """→ fn(dense, embed, node_features, neighbors, mask) returning the
+    [N, H] embedding table row-sharded over ``mesh[axis]`` — the
+    serve-time half of the sharded forward. The scoring service embeds
+    ONCE at model-swap time and keeps the (sharded) table resident; per
+    query only edge-endpoint indices move (models.gnn.predict_edge
+    gathers against the global array)."""
+    row2 = P(axis, None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), row2, row2, row2, row2),
+        out_specs=row2,
+        check_vma=False,
+    )
+    def emb(dense, embed, feats, nbrs, mask):
+        return _embed_local(dense, embed, feats, nbrs, mask, axis, compute_dtype)
+
+    def apply(dense, embed, feats, nbrs, mask):
+        feats = jnp.asarray(feats)
+        if embed is None:
+            embed = jnp.zeros((feats.shape[0], 0), feats.dtype)
+        return emb(dense, embed, feats, jnp.asarray(nbrs), jnp.asarray(mask))
 
     return apply
 

@@ -19,11 +19,14 @@ from __future__ import annotations
 
 import math
 import statistics
+import threading
 from typing import Protocol
 
 import numpy as np
 
 from dragonfly2_tpu.rpc import resilience
+from dragonfly2_tpu.scheduler import metrics as M
+from dragonfly2_tpu.scheduler.serving import ServingUnsupported
 from dragonfly2_tpu.schema.features import (
     MLP_FEATURE_DIM,
     location_affinity as offline_location_affinity,
@@ -32,6 +35,10 @@ from dragonfly2_tpu.utils import dflog, flight, profiling, tracing
 from dragonfly2_tpu.utils.dfplugin import registry as plugin_registry
 
 logger = dflog.get("scheduler.evaluator")
+
+# degradation-ladder altitude: serving (batched GNN/MLP) ranks above the
+# per-call MLP, which ranks above the hand-tuned base score
+_RUNG_ORDER = {"serving": 3, "mlp": 2, "base": 1}
 
 # dfprof phase: the per-decision topology-engine lookup leg (one ledger
 # entry per candidate batch, like the batch span below)
@@ -43,6 +50,10 @@ PH_TOPOLOGY_RTT = profiling.phase_type("scheduler.topology_rtt")
 # misplaced-parent postmortem doesn't depend on a sampled trace
 EV_EXPLAIN = flight.event_type("scheduler.evaluate_explain")
 EXPLAIN_TOP_K = 4
+
+# degradation-ladder rung drops (GNN serving → per-call MLP → Base):
+# edge-triggered — one event per transition, not one per decision
+EV_SERVING_FALLBACK = flight.event_type("scheduler.serving_fallback")
 
 from dragonfly2_tpu.scheduler.resource import (
     PEER_STATE_BACK_TO_SOURCE,
@@ -217,11 +228,17 @@ class MLEvaluator(BaseEvaluator):
     # resilience_degraded_mode gauge
     DEGRADED_COMPONENT = "scheduler.evaluator"
 
-    def __init__(self, model=None, gru=None, topology=None):
+    def __init__(self, model=None, gru=None, topology=None, serving=None):
         self._model = model  # ml.scorer.MLPScorer-compatible
         self._gru = gru  # trainer.serving.GRUScorer-compatible
         self._topology = topology  # topology.TopologyEngine-compatible
+        self._serving = serving  # scheduler.serving.ScoringService
         self._degraded = False  # local edge detector: flag flips are rare
+        self._rung = ""  # last ladder rung served (edge detector twin)
+        # serializes rung transitions only: the steady state is one
+        # unlocked string compare; without it two concurrent schedule
+        # threads observing the same flip would both emit the event
+        self._rung_lock = threading.Lock()
         # peer.id -> (piece_count, verdict): is_bad_node runs once per
         # candidate per scheduling attempt (per piece event), and a jit
         # dispatch per call would multiply hot-path latency — the verdict
@@ -235,6 +252,9 @@ class MLEvaluator(BaseEvaluator):
 
     def set_topology(self, topology) -> None:
         self._topology = topology
+
+    def set_serving(self, serving) -> None:
+        self._serving = serving
 
     def _rtt_affinity(self, parent: Peer, child: Peer) -> float:
         """Topology-engine rtt_affinity for the pair, never fatal: an
@@ -294,22 +314,44 @@ class MLEvaluator(BaseEvaluator):
         self._model = model
 
     def _set_degraded(self, reason: "str | None") -> None:
-        """Edge-triggered degraded-mode flag: the ML→base fallback is a
+        """Edge-triggered degraded-mode flag: a ladder fallback is a
         *visible* state (resilience registry → /healthz + gauge + flight
         event), not a silent ranking change. Only flips pay the registry
-        lock; the steady state costs one predicate."""
-        want = reason is not None
-        if want == self._degraded:
+        lock; the steady state costs one predicate. ``_degraded`` holds
+        the current reason so a reason CHANGE (serving-down → model-gone)
+        re-registers instead of being swallowed by a boolean."""
+        if reason == self._degraded or (reason is None and not self._degraded):
             return
-        self._degraded = want
+        self._degraded = reason if reason is not None else False
         resilience.set_degraded(self.DEGRADED_COMPONENT, reason)
+
+    def _note_rung(self, rung: str, reason: "str | None") -> None:
+        """Record which ladder rung served this decision. Edge-triggered:
+        a rung CHANGE emits one flight event (and counts a fallback when
+        moving down), then the registry reason updates — steady state is
+        one unlocked string compare per decision; only transitions pay
+        the lock (and re-check under it, so concurrent schedule threads
+        can't double-emit one flip)."""
+        if rung != self._rung:
+            with self._rung_lock:
+                prev = self._rung
+                if rung != prev:  # re-check: another thread may have won
+                    self._rung = rung
+                    if prev and _RUNG_ORDER.get(rung, 0) < _RUNG_ORDER.get(prev, 0):
+                        M.SERVING_FALLBACK_TOTAL.labels(rung).inc()
+                    EV_SERVING_FALLBACK(
+                        from_rung=prev, to_rung=rung, reason=reason or ""
+                    )
+        self._set_degraded(reason)
 
     def evaluate_parents(
         self, parents: list[Peer], child: Peer, total_piece_count: int
     ) -> list[Peer]:
-        if self._model is None or not parents:
-            if self._model is None:
-                self._set_degraded("no model loaded; base evaluator ranking")
+        serving = self._serving
+        serving_up = serving is not None and serving.available()
+        if (self._model is None and not serving_up) or not parents:
+            if self._model is None and not serving_up:
+                self._note_rung("base", "no model loaded; base evaluator ranking")
             return super().evaluate_parents(parents, child, total_piece_count)
         try:
             if self._topology is not None:
@@ -338,38 +380,85 @@ class MLEvaluator(BaseEvaluator):
                     for p, rtt, la in zip(parents, rtts, loc_aff)
                 ]
             )
-            costs = self._model.predict(feats)  # [P] predicted log piece cost
-            order = np.argsort(costs, kind="stable")
-            if flight.enabled():
-                # top-k explain event: scores + the full feature rows the
-                # model saw (schema order, rtt_affinity last). Guarded so
-                # DF_FLIGHT=0 pays one predicate; the list build is tiny
-                # next to the predict() dispatch above.
-                EV_EXPLAIN(
-                    peer_id=child.id,
-                    task_id=child.task.id,
-                    candidates=len(parents),
-                    feature_dim=int(feats.shape[1]),
-                    top=[
-                        {
-                            "parent_id": parents[int(i)].id,
-                            "predicted_log_cost": round(float(costs[int(i)]), 6),
-                            "rtt_affinity": round(float(feats[int(i), -1]), 6),
-                            "features": [round(float(v), 5) for v in feats[int(i)]],
-                        }
-                        for i in order[:EXPLAIN_TOP_K]
-                    ],
-                )
-            self._set_degraded(None)
-            return [parents[int(i)] for i in order]
         except Exception:
-            # degraded mode: never fail scheduling because of the model —
-            # but say so, or operators can't tell ML scheduling is off
+            # feature build failed: no rung can rank — base, visibly
             logger.warning(
-                "ml evaluator predict failed; using base ranking", exc_info=True
+                "ml evaluator feature build failed; using base ranking",
+                exc_info=True,
             )
-            self._set_degraded("ml predict failed; base evaluator ranking")
+            self._note_rung("base", "feature build failed; base evaluator ranking")
             return super().evaluate_parents(parents, child, total_piece_count)
+
+        # the degradation ladder: batched serving (GNN or resident MLP)
+        # → per-call MLP → Base, each rung absorbing the one above it
+        costs = None
+        per_request = False  # this DECISION skipped serving, not the service
+        if serving_up:
+            try:
+                costs = serving.score(
+                    feats,
+                    pairs=[(child.host.id, p.host.id) for p in parents],
+                    budget_s=resilience.remaining_budget_s(),
+                )
+                self._note_rung("serving", None)
+            except ServingUnsupported as e:
+                # a candidate host the served model can't embed: score
+                # THIS decision a rung down without flipping the
+                # service-level ladder state — a brand-new host would
+                # otherwise flap the edge detector at decision rate
+                # until the next swap embeds it
+                per_request = True
+                logger.debug("serving cannot take this decision (%s)", e)
+            except Exception as e:
+                # expected under faults: one debug line, the
+                # edge-triggered rung change is the operator signal
+                logger.debug("serving score failed (%s); dropping a rung", e)
+        if costs is None and self._model is not None:
+            try:
+                costs = self._model.predict(feats)  # [P] predicted log cost
+                if not per_request:
+                    self._note_rung(
+                        "mlp",
+                        "serving unavailable; per-call mlp ranking"
+                        if serving_up
+                        else None,
+                    )
+            except Exception:
+                # never fail scheduling because of the model — but say
+                # so, or operators can't tell ML scheduling is off
+                logger.warning(
+                    "ml evaluator predict failed; using base ranking",
+                    exc_info=True,
+                )
+        if costs is None:
+            if not per_request:
+                self._note_rung(
+                    "base", "ml predict failed; base evaluator ranking"
+                )
+            return super().evaluate_parents(parents, child, total_piece_count)
+        order = np.argsort(costs, kind="stable")
+        if flight.enabled():
+            # top-k explain event: scores + the full feature rows the
+            # model saw (schema order, rtt_affinity last). Guarded so
+            # DF_FLIGHT=0 pays one predicate; the list build is tiny
+            # next to the predict() dispatch above.
+            EV_EXPLAIN(
+                peer_id=child.id,
+                task_id=child.task.id,
+                candidates=len(parents),
+                feature_dim=int(feats.shape[1]),
+                rung=self._rung,
+                top=[
+                    {
+                        "parent_id": parents[int(i)].id,
+                        "predicted_log_cost": round(float(costs[int(i)]), 6),
+                        "rtt_affinity": round(float(feats[int(i), -1]), 6),
+                        "features": [round(float(v), 5) for v in feats[int(i)]],
+                    }
+                    for i in order[:EXPLAIN_TOP_K]
+                ],
+            )
+        return [parents[int(i)] for i in order]
 
 
 def pair_features(

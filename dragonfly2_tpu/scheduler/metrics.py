@@ -101,6 +101,36 @@ DOWNLOAD_PEER_DURATION_MS = _r.histogram(
 CONCURRENT_SCHEDULE_GAUGE = _r.gauge(
     "scheduler_concurrent_schedule", "Scheduling passes in flight"
 )
+
+# -- batched scoring service (scheduler/serving.py, docs/serving.md) --------
+SERVING_SUBMITTED_TOTAL = _r.counter(
+    "scheduler_serving_submitted_total",
+    "Candidate-matrix score submissions by path",
+    ("path",),  # batched | immediate | overflow
+)
+SERVING_BATCHES_TOTAL = _r.counter(
+    "scheduler_serving_batches_total", "Micro-batches scored by the serving thread"
+)
+SERVING_BATCH_OCCUPANCY = _r.histogram(
+    "scheduler_serving_batch_occupancy",
+    "Candidate feature rows packed per scored micro-batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+SERVING_ERRORS_TOTAL = _r.counter(
+    "scheduler_serving_errors_total", "Serving-path score failures (per request)"
+)
+SERVING_QUEUE_DEPTH = _r.gauge(
+    "scheduler_serving_queue_depth",
+    "Submission queue depth observed at batch pack time",
+)
+SERVING_SWAPS_TOTAL = _r.counter(
+    "scheduler_serving_swaps_total", "Served-model hot swaps", ("kind",)
+)
+SERVING_FALLBACK_TOTAL = _r.counter(
+    "scheduler_serving_fallback_total",
+    "Evaluator degradation-ladder rung drops",
+    ("to",),  # mlp | base
+)
 VERSION_GAUGE = _r.gauge(
     "scheduler_version", "Build info (value is always 1)", ("version",)
 )

@@ -29,7 +29,15 @@ logger = dflog.get("scheduler.model_refresher")
 
 class ModelRefresher:
     """Polls the manager model registry and installs the active MLP model
-    into the evaluator; keeps serving the previous model on any error."""
+    into the evaluator; keeps serving the previous model on any error.
+
+    With a :class:`~dragonfly2_tpu.scheduler.serving.ScoringService`
+    attached, every install also hot-swaps the BATCHED serving slot
+    (in-flight batches finish on the model they snapshotted — the
+    service's swap contract): the active GNN occupies it when one is
+    activated (embeddings computed here, at swap time, from the live
+    probe graph), the MLP otherwise; the per-call MLP stays installed in
+    the evaluator as the next rung down the degradation ladder."""
 
     def __init__(
         self,
@@ -37,13 +45,21 @@ class ModelRefresher:
         evaluator: MLEvaluator,
         scheduler_cluster_id: int = 1,
         interval: float = 60.0,
+        serving=None,  # scheduler.serving.ScoringService
+        networktopology=None,  # probe-graph source for GNN embeddings
     ):
         self.manager = manager_client
         self.evaluator = evaluator
         self.cluster_id = scheduler_cluster_id
         self.interval = interval
+        self.serving = serving
+        self.networktopology = networktopology
         self.loaded_version: tuple[str, int] | None = None  # (model_id, version)
         self.loaded_gru_version: tuple[str, int] | None = None
+        self.loaded_gnn_version: tuple[str, int] | None = None
+        # the installed per-call scorer, kept so a GNN withdrawal can
+        # re-occupy the serving slot through the one install path
+        self._mlp_scorer = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -58,8 +74,10 @@ class ModelRefresher:
             logger.warning("model list poll failed: %s", e)
             return False
 
-        # GRU refresh rides every poll, independent of MLP install state
+        # GRU + GNN refresh ride every poll, independent of MLP install
+        # state (each is best-effort and never blocks the MLP)
         gru_installed = self._refresh_gru(resp)
+        gnn_installed = self._refresh_gnn(resp)
 
         active = [
             m for m in resp.models if m.state == "active" and m.type == "mlp"
@@ -72,7 +90,13 @@ class ModelRefresher:
                 logger.info("active model withdrawn; falling back to base evaluator")
                 self.evaluator.set_model(None)
                 self.loaded_version = None
-            return gru_installed
+                self._mlp_scorer = None
+                if self.serving is not None and self.serving.model_kind() in (
+                    "mlp",
+                    "numpy",
+                ):
+                    self.serving.clear()
+            return gru_installed or gnn_installed
 
         # newest ACTIVATION wins if several MLP models are active (e.g.
         # per-source-host model ids) — updated_at_ns is stamped by the
@@ -81,7 +105,7 @@ class ModelRefresher:
         m = max(active, key=lambda m: (m.updated_at_ns, m.created_at_ns))
         key = (m.model_id, m.version)
         if key == self.loaded_version:
-            return gru_installed
+            return gru_installed or gnn_installed
 
         try:
             w = self.manager.GetModelWeights(
@@ -100,12 +124,93 @@ class ModelRefresher:
             logger.warning(
                 "loading model %s v%d failed (%s); keeping previous", m.model_id, m.version, e
             )
-            return gru_installed
+            return gru_installed or gnn_installed
 
         self.evaluator.set_model(scorer)
         self.loaded_version = key
+        self._mlp_scorer = scorer
+        self._serve_mlp(scorer, key)
         logger.info("installed model %s v%d into ml evaluator", m.model_id, m.version)
         return True
+
+    def _serve_mlp(self, scorer, key) -> None:
+        """Hot-swap the batched serving slot to this MLP — unless a GNN
+        holds it (the GNN is the higher rung; the per-call MLP installed
+        above remains the fallback under it either way)."""
+        if self.serving is None or self.serving.model_kind() == "gnn":
+            return
+        from dragonfly2_tpu.scheduler.serving import MLPServed
+
+        self.serving.install(MLPServed(scorer), version=f"{key[0]}/v{key[1]}")
+
+    def _refresh_gnn(self, resp) -> bool:
+        """Install the newest active GNN as the batched serving model:
+        weights from the registry, embeddings computed HERE (swap time)
+        from the live probe graph and pinned on device next to the
+        topology adjacency. Best-effort — a broken GNN (or a probe graph
+        too small to embed) leaves the MLP serving and never blocks
+        scheduling. Returns True when a GNN was (re)installed."""
+        if self.serving is None:
+            return False
+        active = [m for m in resp.models if m.state == "active" and m.type == "gnn"]
+        if not active:
+            if self.loaded_gnn_version is not None:
+                logger.info("active gnn withdrawn; serving falls back to mlp")
+                self.loaded_gnn_version = None
+                if self.serving.model_kind() == "gnn":
+                    self.serving.clear()
+                    # re-occupy the slot with the loaded MLP, if any —
+                    # through the one install path
+                    if self.loaded_version is not None and self._mlp_scorer is not None:
+                        self._serve_mlp(self._mlp_scorer, self.loaded_version)
+            return False
+        m = max(active, key=lambda m: (m.updated_at_ns, m.created_at_ns))
+        key = (m.model_id, m.version)
+        if key == self.loaded_gnn_version:
+            return False
+        try:
+            w = self.manager.GetModelWeights(
+                manager_pb2.GetModelRequest(model_id=m.model_id, version=m.version)
+            )
+            scorer = self._build_gnn_scorer(deserialize_params_auto(w.weights))
+            if scorer is None:
+                return False
+            from dragonfly2_tpu.scheduler.serving import GNNServed
+
+            self.serving.install(GNNServed(scorer), version=f"{key[0]}/v{key[1]}")
+        except Exception as e:
+            logger.warning(
+                "loading gnn %s v%d failed (%s); keeping previous serving model",
+                m.model_id,
+                m.version,
+                e,
+            )
+            return False
+        self.loaded_gnn_version = key
+        logger.info(
+            "installed gnn %s v%d as the batched serving model", m.model_id, m.version
+        )
+        return True
+
+    def _build_gnn_scorer(self, params):
+        """Probe graph → swap-time-embedded GNNScorer (None when the
+        graph can't embed yet: no topology source or < 2 hosts)."""
+        if self.networktopology is None:
+            logger.info("gnn active but no probe-graph source; not serving it")
+            return None
+        from dragonfly2_tpu.schema.columnar import records_to_columns
+        from dragonfly2_tpu.schema.features import build_probe_graph
+        from dragonfly2_tpu.trainer.serving import GNNScorer
+
+        records = self.networktopology.export_records()
+        graph = build_probe_graph(records_to_columns(records)) if records else None
+        if graph is None or graph.num_nodes < 2:
+            logger.info("probe graph too small to embed; not serving the gnn")
+            return None
+        scorer = GNNScorer(params, graph)
+        # compile + sanity-check at swap time, like the MLP install
+        scorer.predict_rtt_log_ms([graph.node_ids[0]], [graph.node_ids[1]])
+        return scorer
 
     def _refresh_gru(self, resp) -> bool:
         """Install the newest active GRU alongside the MLP (model-based

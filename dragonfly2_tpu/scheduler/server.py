@@ -41,6 +41,12 @@ class SchedulerServerConfig:
     # via the manager registry, base fallback; reference evaluator.go:53)
     algorithm: str = "default"
     model_refresh_interval: float = 60.0
+    # batched scoring service (scheduler/serving.py, docs/serving.md):
+    # concurrent schedule ops micro-batch their model forwards through
+    # one device-resident scorer. Only meaningful with algorithm="ml".
+    serving_enabled: bool = True
+    serving_batch_window_ms: float = 2.0
+    serving_queue_depth: int = 256
     # dataset upload cadence (reference default is 7 DAYS; operators
     # shorten it for fast feedback loops)
     train_interval: float = 7 * 24 * 3600.0
@@ -217,8 +223,23 @@ class SchedulerServer:
 
         # evaluator (+ live model refresh when the manager serves models)
         self.model_refresher = None
+        self.scoring_service = None
         if config.algorithm == "ml":
-            evaluator = MLEvaluator(topology=self.topology_engine)
+            if config.serving_enabled:
+                from dragonfly2_tpu.scheduler.serving import (
+                    ScoringService,
+                    ServingConfig,
+                )
+
+                self.scoring_service = ScoringService(
+                    ServingConfig(
+                        window_s=config.serving_batch_window_ms / 1e3,
+                        queue_depth=config.serving_queue_depth,
+                    )
+                )
+            evaluator = MLEvaluator(
+                topology=self.topology_engine, serving=self.scoring_service
+            )
             if self._manager_channel is not None:
                 from dragonfly2_tpu.manager.service import (
                     SERVICE_NAME as MANAGER_SERVICE,
@@ -230,6 +251,8 @@ class SchedulerServer:
                     evaluator,
                     scheduler_cluster_id=config.cluster_id,
                     interval=config.model_refresh_interval,
+                    serving=self.scoring_service,
+                    networktopology=self.networktopology,
                 )
         else:
             from dragonfly2_tpu.scheduler.evaluator import new_evaluator
@@ -393,6 +416,13 @@ class SchedulerServer:
             )
             self.telemetry_reporter.start()
         self.announcer.serve()
+        if self.scoring_service is not None:
+            # the serving thread must be consuming BEFORE the refresher's
+            # first poll can install a model into it
+            self.scoring_service.start()
+            flight.register_probe(
+                "scheduler.serving", self.scoring_service.snapshot
+            )
         if self.model_refresher is not None:
             self.model_refresher.start()
         if self.job_worker is not None:
@@ -507,6 +537,11 @@ class SchedulerServer:
             self.job_worker.stop()
         if self.model_refresher is not None:
             self.model_refresher.stop()
+        if self.scoring_service is not None:
+            # after the refresher (no further installs) and before the
+            # grpc drain completes: stop() releases every queued waiter,
+            # so an in-flight schedule op falls back a rung, never hangs
+            self.scoring_service.stop()
         self.gc.stop()
         self.announcer.stop()
         if self._grpc is not None:

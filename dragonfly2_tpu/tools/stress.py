@@ -358,6 +358,215 @@ def _faults_injected_total() -> int:
 
 
 # ---------------------------------------------------------------------------
+# serving soak: batched vs per-call scheduler inference (ROADMAP item 1)
+# ---------------------------------------------------------------------------
+
+
+def _serving_swarm(candidates: int, peers: int):
+    """(parents, children, task) — one task with ``candidates`` feedable
+    SUCCEEDED parents and ``peers`` registered children, the state every
+    ml-ranked schedule decision reads."""
+    from dragonfly2_tpu.scheduler import resource as res
+
+    task = res.Task("serving-soak-task", "https://origin/x")
+    task.content_length = 64 * 1024 * 1024
+    task.total_piece_count = 16
+    parents = []
+    for i in range(candidates):
+        h = res.Host(id=f"parent-host-{i}", type=res.HostType.SUPER)
+        h.network.idc = f"idc-{i % 3}"
+        h.network.location = f"r{i % 4}|z{i % 2}"
+        p = res.Peer(f"parent-{i}", task, h)
+        p.fsm.event(res.PEER_EVENT_REGISTER_NORMAL)
+        p.fsm.event(res.PEER_EVENT_DOWNLOAD)
+        p.fsm.event(res.PEER_EVENT_DOWNLOAD_SUCCEEDED)
+        p.finished_pieces |= set(range(i % 16))
+        parents.append(p)
+    children = []
+    for i in range(peers):
+        h = res.Host(id=f"child-host-{i}")
+        h.network.idc = f"idc-{i % 3}"
+        c = res.Peer(f"child-{i}", task, h)
+        c.fsm.event(res.PEER_EVENT_REGISTER_NORMAL)
+        children.append(c)
+    return parents, children, task
+
+
+def _serving_scorer(backend: str):
+    """→ (scorer, backend_name): the jitted MLPScorer when XLA is usable
+    (per-call dispatch cost is what batching amortizes), the numpy
+    fallback otherwise — identical batched API either way."""
+    import jax
+
+    from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
+    from dragonfly2_tpu.trainer import serving as tserving
+
+    if backend in ("auto", "jax"):
+        try:
+            from dragonfly2_tpu.models.mlp import init_mlp
+
+            params = init_mlp(jax.random.PRNGKey(0), [MLP_FEATURE_DIM, 64, 1])
+            scorer = tserving.MLPScorer(
+                tserving.deserialize_params_auto(
+                    tserving.serialize_params(params)
+                )
+            )
+            import numpy as np
+
+            scorer.predict(np.zeros((1, MLP_FEATURE_DIM), np.float32))
+            return scorer, "jax"
+        except Exception as e:
+            if backend == "jax":
+                raise
+            print(f"stress: jax scorer unavailable ({e}); numpy", file=sys.stderr)
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    params = {
+        "layers": [
+            {"w": rng.normal(0, 0.3, (MLP_FEATURE_DIM, 64)).astype(np.float32),
+             "b": np.zeros(64, np.float32)},
+            {"w": rng.normal(0, 0.3, (64, 1)).astype(np.float32),
+             "b": np.zeros(1, np.float32)},
+        ]
+    }
+    from dragonfly2_tpu.trainer.serving import NumpyMLPScorer
+
+    return NumpyMLPScorer(params), "numpy"
+
+
+def serving_soak(
+    peers: int = 32,
+    decisions_per_peer: int = 20,
+    candidates: int = 12,
+    window_ms: float = 2.0,
+    backend: str = "auto",
+) -> dict:
+    """Batched-vs-per-call scheduler inference at ``peers`` concurrency
+    (the ROADMAP item 1 acceptance soak): the SAME model ranks the same
+    candidate sets through (a) a per-decision forward and (b) the
+    scoring service's deadline-aware micro-batches, with per-decision
+    latency sampled throughout.
+
+    Gates (CLI exit / bench re-emission): aggregate ``schedule_ops_per_s``
+    (batched) strictly above ``schedule_ops_per_s_per_call``, zero lost
+    submissions (every decision returns a full ranking), and
+    ``schedule_decision_p99_us`` within the batching window + a few
+    single-batch service times (``serving_p99_bound_us``).
+    """
+    import numpy as np
+
+    from dragonfly2_tpu.scheduler.evaluator import MLEvaluator
+    from dragonfly2_tpu.scheduler.serving import (
+        MLPServed,
+        ScoringService,
+        ServingConfig,
+    )
+    from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
+    from dragonfly2_tpu.trainer.serving import bucket_rows
+
+    scorer, backend_used = _serving_scorer(backend)
+    parents, children, task = _serving_swarm(candidates, peers)
+    total = task.total_piece_count
+
+    # warm EVERY bucket rung a packed batch can reach — the ladder up to
+    # max_rows plus one overshooting request — so the timed arms never
+    # pay a compile (a cold rung mid-arm would stall every queued
+    # decision behind an XLA compile and poison the p99 sample)
+    max_rows = ServingConfig().max_rows
+    top = bucket_rows(max_rows + candidates)
+    rungs = {bucket_rows(n) for n in range(1, top + 1, 1)}
+    for rung in sorted(rungs):
+        scorer.predict(np.zeros((rung, MLP_FEATURE_DIM), np.float32))
+
+    def run_arm(evaluator) -> tuple[float, list, int]:
+        """→ (ops/s, per-decision latencies, completed) across ``peers``
+        worker threads × ``decisions_per_peer`` decisions."""
+        lat: list = []
+        done = [0]
+        lock = threading.Lock()
+        start = threading.Barrier(peers + 1)
+
+        def worker(child):
+            mine = []
+            ok = 0
+            start.wait()
+            for _ in range(decisions_per_peer):
+                t0 = time.perf_counter()
+                ranked = evaluator.evaluate_parents(parents, child, total)
+                mine.append(time.perf_counter() - t0)
+                ok += int(len(ranked) == len(parents))
+            with lock:
+                lat.extend(mine)
+                done[0] += ok
+
+        threads = [
+            threading.Thread(
+                target=worker, args=(children[i],),
+                name=f"stress.serving-{i}", daemon=True,
+            )
+            for i in range(peers)
+        ]
+        for t in threads:
+            t.start()
+        start.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        ops = peers * decisions_per_peer
+        return (ops / wall if wall else 0.0), lat, done[0]
+
+    expected = peers * decisions_per_peer
+
+    # arm 1: per-call — every decision pays its own model dispatch
+    percall_rate, _, percall_done = run_arm(MLEvaluator(scorer))
+
+    # single-batch service time (warm, full bucket): the p99 bound's
+    # second term, measured not assumed
+    feats64 = np.zeros((max_rows, MLP_FEATURE_DIM), np.float32)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        scorer.predict(feats64)
+    batch_service_us = (time.perf_counter() - t0) / 5 * 1e6
+
+    # arm 2: batched — the scoring service micro-batches concurrent ops
+    svc = ScoringService(ServingConfig(window_s=window_ms / 1e3))
+    svc.start()
+    svc.install(MLPServed(scorer, kind=backend_used), version="soak/v1")
+    try:
+        batched_rate, lat, batched_done = run_arm(
+            MLEvaluator(scorer, serving=svc)
+        )
+    finally:
+        occupancy = (
+            svc.rows_scored / svc.batches if svc.batches else 0.0
+        )
+        svc.stop()
+
+    lat.sort()
+    p99_us = _percentile(lat, 0.99) * 1e6
+    # the acceptance bound: batching window + single-batch service time,
+    # with slack for batches queued back-to-back under full concurrency
+    # (a decision can wait out one in-flight batch plus its own) and
+    # for scheduler jitter on a shared container
+    bound_us = window_ms * 1e3 + 4 * batch_service_us + 20_000
+    return {
+        "serving_backend": backend_used,
+        "serving_peers": peers,
+        "serving_candidates": candidates,
+        "serving_window_ms": window_ms,
+        "schedule_ops_per_s": round(batched_rate, 1),
+        "schedule_ops_per_s_per_call": round(percall_rate, 1),
+        "evaluator_batch_occupancy": round(occupancy, 2),
+        "schedule_decision_p99_us": round(p99_us, 1),
+        "serving_batch_service_us": round(batch_service_us, 1),
+        "serving_p99_bound_us": round(bound_us, 1),
+        "serving_lost": (expected - batched_done) + (expected - percall_done),
+    }
+
+
+# ---------------------------------------------------------------------------
 # shard-kill soak: scheduler-fleet failover under simulated announce load
 # ---------------------------------------------------------------------------
 
@@ -757,6 +966,18 @@ def main(argv=None) -> int:
     p.add_argument("--shard-peers", type=int, default=240,
                    help="simulated announce peers for --shard-kill")
     p.add_argument("--shards", type=int, default=3)
+    p.add_argument(
+        "--serving",
+        action="store_true",
+        help="run the batched-vs-per-call scheduler inference soak"
+        " (ROADMAP item 1 acceptance: aggregate schedule_ops_per_s"
+        " strictly above the per-call baseline, zero lost submissions,"
+        " p99 decision latency bounded)",
+    )
+    p.add_argument("--serving-peers", type=int, default=32,
+                   help="concurrent simulated peers for --serving")
+    p.add_argument("--serving-decisions", type=int, default=20,
+                   help="decisions per simulated peer for --serving")
     p.add_argument("--daemon", default="", help="dfdaemon gRPC address (Download path)")
     p.add_argument("--proxy", default="", help="daemon proxy address (HTTP path)")
     p.add_argument("-c", "--connections", type=int, default=8)
@@ -765,6 +986,17 @@ def main(argv=None) -> int:
     p.add_argument("--tag", default="stress")
     p.add_argument("--output", default="", help="per-request CSV path")
     args = p.parse_args(argv)
+    if args.serving:
+        stats = serving_soak(
+            peers=args.serving_peers, decisions_per_peer=args.serving_decisions
+        )
+        print(json.dumps(stats))
+        ok = (
+            stats["schedule_ops_per_s"] > stats["schedule_ops_per_s_per_call"]
+            and stats["serving_lost"] == 0
+            and stats["schedule_decision_p99_us"] <= stats["serving_p99_bound_us"]
+        )
+        return 0 if ok else 1
     if args.chaos and args.shard_kill:
         stats = shard_kill_soak(peers=args.shard_peers, shards=args.shards)
         print(json.dumps(stats))

@@ -49,6 +49,15 @@ EVENT_SERVICES = (
 # dfdoctor/dfprof key on, so only this module may declare them
 PROF_EVENT_MODULE = "dragonfly2_tpu/utils/profiling.py"
 
+# the scheduler.serving_* event segment belongs to the batched scoring
+# plane (ISSUE 13): the service itself plus its evaluator client — a
+# serving-ish event declared elsewhere would fork the vocabulary the
+# serving docs/dfdoctor flows key on (docs/serving.md)
+SERVING_EVENT_MODULES = (
+    "dragonfly2_tpu/scheduler/serving.py",
+    "dragonfly2_tpu/scheduler/evaluator.py",
+)
+
 # dfprof phase-ledger names (profiling.phase_type("<service>.<what>"))
 # share the event services' vocabulary: phases belong to a process role
 PHASE_SERVICES = EVENT_SERVICES
@@ -204,6 +213,17 @@ def check(package_dir: Path = PACKAGE) -> list[str]:
                     f"{site}: event {name!r} uses the reserved prof."
                     f" namespace; prof events are declared in"
                     f" {PROF_EVENT_MODULE} only"
+                )
+            # scheduler.serving_* belongs to the batched scoring plane
+            if (
+                service == "scheduler"
+                and (what == "serving" or what.startswith("serving_"))
+                and str(rel) not in SERVING_EVENT_MODULES
+            ):
+                failures.append(
+                    f"{site}: event {name!r} uses the reserved"
+                    " scheduler.serving_ segment; serving events are"
+                    f" declared in {SERVING_EVENT_MODULES} only"
                 )
             prev_site = seen_events.get(name)
             if prev_site is not None:

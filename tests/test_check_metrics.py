@@ -71,6 +71,27 @@ def test_lint_catches_event_defects(tmp_path):
     assert "scheduler.decision" not in text
 
 
+def test_lint_reserves_serving_event_segment(tmp_path):
+    """The scheduler.serving_* event segment belongs to the batched
+    scoring plane (ISSUE 13): a serving-ish event declared outside
+    scheduler/serving.py / scheduler/evaluator.py fails the census;
+    segment test, not substring — daemon.serving_foo is out of scope
+    and scheduler.serving_unrelated_elsewhere is caught."""
+    pkg = tmp_path / "fakepkg"
+    pkg.mkdir()
+    (pkg / "stray.py").write_text(
+        "from dragonfly2_tpu.utils import flight\n"
+        'EV_STRAY = flight.event_type("scheduler.serving_stray")\n'
+        'EV_OK = flight.event_type("daemon.serving_unscoped")\n'
+        'EV_ALSO_OK = flight.event_type("scheduler.schedule_x")\n'
+    )
+    failures = check_metrics.check(pkg)
+    text = "\n".join(failures)
+    assert "reserved scheduler.serving_ segment" in text
+    assert "daemon.serving_unscoped" not in text
+    assert "scheduler.schedule_x" not in text
+
+
 def test_lint_catches_fault_point_defects(tmp_path):
     """Fault-point registrations (faults.point) ride the census too:
     duplicates, names that aren't <layer>.<what> with a known layer —

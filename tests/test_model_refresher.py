@@ -169,6 +169,143 @@ def test_reactivating_older_model_takes_effect(tmp_path):
         db.close()
 
 
+def test_refresher_hot_swaps_serving_slot(manager):
+    """With a scoring service attached, an MLP activation installs BOTH
+    the per-call scorer (the fallback rung) and the batched serving
+    model; a version flip hot-swaps serving without a restart."""
+    from dragonfly2_tpu.scheduler.serving import ScoringService, ServingConfig
+
+    evaluator = MLEvaluator()
+    svc = ScoringService(ServingConfig(window_s=0.002))
+    svc.start()
+    try:
+        refresher = ModelRefresher(
+            manager, evaluator, scheduler_cluster_id=1, serving=svc
+        )
+        _upload(manager, _mlp_params(0))
+        manager.UpdateModel(
+            manager_pb2.UpdateModelRequest(
+                model_id="mlp-model", version=1, state="active"
+            )
+        )
+        assert refresher.refresh_once()
+        assert svc.available() and svc.model_kind() == "mlp"
+        assert svc.snapshot()["model_version"] == "mlp-model/v1"
+        # the batched path scores through the freshly-installed model
+        feats = np.zeros((3, len(MLP_FEATURE_NAMES)), np.float32)
+        np.testing.assert_allclose(
+            svc.score(feats), evaluator._model.predict(feats), rtol=1e-5
+        )
+
+        # v2 activation hot-swaps the serving slot
+        _upload(manager, _mlp_params(1))
+        manager.UpdateModel(
+            manager_pb2.UpdateModelRequest(
+                model_id="mlp-model", version=2, state="active"
+            )
+        )
+        assert refresher.refresh_once()
+        assert svc.snapshot()["model_version"] == "mlp-model/v2"
+
+        # explicit deactivation withdraws serving too
+        manager.UpdateModel(
+            manager_pb2.UpdateModelRequest(
+                model_id="mlp-model", version=2, state="inactive"
+            )
+        )
+        refresher.refresh_once()
+        assert not svc.available()
+    finally:
+        svc.stop()
+
+
+def test_refresher_gnn_occupies_serving_and_withdraws_to_mlp(manager):
+    """An active GNN takes the batched serving slot (embeddings built at
+    swap time from the live probe graph); withdrawing it falls serving
+    back to the loaded MLP — the ladder's top rung is an operator
+    decision, the rungs below it never vanish."""
+    import jax
+
+    from dragonfly2_tpu.models.gnn import init_graphsage
+    from dragonfly2_tpu.scheduler.networktopology import NetworkTopology, Probe
+    from dragonfly2_tpu.scheduler.resource.host import Host
+    from dragonfly2_tpu.scheduler.resource.managers import HostManager
+    from dragonfly2_tpu.scheduler.serving import ScoringService, ServingConfig
+    from dragonfly2_tpu.schema.features import GNN_NODE_FEATURE_DIM
+    from dragonfly2_tpu.utils.kvstore import KVStore
+
+    # a live probe graph with three hosts: the GNN's swap-time embed source
+    hm = HostManager()
+    for hid in ("h-a", "h-b", "h-c"):
+        hm.store(Host(id=hid, hostname=hid, ip="10.0.0.1", port=1))
+    nt = NetworkTopology(KVStore(), hm, None)
+    ms = 1_000_000
+    nt.enqueue_probe("h-a", Probe("h-b", rtt_ns=2 * ms))
+    nt.enqueue_probe("h-b", Probe("h-c", rtt_ns=5 * ms))
+    nt.enqueue_probe("h-c", Probe("h-a", rtt_ns=9 * ms))
+
+    evaluator = MLEvaluator()
+    svc = ScoringService(ServingConfig(window_s=0.002))
+    svc.start()
+    try:
+        refresher = ModelRefresher(
+            manager,
+            evaluator,
+            scheduler_cluster_id=1,
+            serving=svc,
+            networktopology=nt,
+        )
+        # MLP first: serving starts on the mlp rung
+        _upload(manager, _mlp_params(0))
+        manager.UpdateModel(
+            manager_pb2.UpdateModelRequest(
+                model_id="mlp-model", version=1, state="active"
+            )
+        )
+        assert refresher.refresh_once()
+        assert svc.model_kind() == "mlp"
+
+        # activate a GNN: it takes the serving slot
+        gnn_params = init_graphsage(
+            jax.random.PRNGKey(0), GNN_NODE_FEATURE_DIM, (8,), num_nodes=3
+        )
+        manager.CreateModel(
+            manager_pb2.CreateModelRequest(
+                model_id="gnn-model",
+                type="gnn",
+                weights=serialize_params(gnn_params),
+                evaluation=manager_pb2.ModelEvaluation(mse=0.1),
+                scheduler_cluster_id=1,
+            )
+        )
+        manager.UpdateModel(
+            manager_pb2.UpdateModelRequest(
+                model_id="gnn-model", version=1, state="active"
+            )
+        )
+        assert refresher.refresh_once()
+        assert svc.model_kind() == "gnn"
+        assert refresher.loaded_gnn_version == ("gnn-model", 1)
+        # the GNN scores known-host pairs through the batched API
+        scores = svc.score(
+            np.zeros((2, len(MLP_FEATURE_NAMES)), np.float32),
+            pairs=[("h-a", "h-b"), ("h-a", "h-c")],
+        )
+        assert scores.shape == (2,) and np.isfinite(scores).all()
+
+        # withdraw the GNN: serving falls back to the loaded MLP
+        manager.UpdateModel(
+            manager_pb2.UpdateModelRequest(
+                model_id="gnn-model", version=1, state="inactive"
+            )
+        )
+        refresher.refresh_once()
+        assert svc.model_kind() == "mlp"
+        assert refresher.loaded_gnn_version is None
+    finally:
+        svc.stop()
+
+
 def test_gru_install_and_bad_node(tmp_path):
     """Train→serve for the GRU: a trained next-piece-cost model installs
     through the refresher and drives model-based bad-node detection —
