@@ -354,6 +354,33 @@ def chaos_soak_bench() -> dict:
     return chaos_soak(downloads=4, piece=16 * 1024, deadline_s=30.0)
 
 
+def data_plane_bench() -> dict:
+    """The zero-copy data-plane race (tools/stress.data_plane_race) at
+    bench scale: one upload loop under 256 concurrent simulated child
+    connections, sendfile vs buffered arms alternated best-of-2 on the
+    same workload (ISSUE 14 / ROADMAP item 3 acceptance, re-proven on
+    every bench run).
+
+    - ``data_plane_bytes_per_s`` / ``data_plane_bytes_per_s_buffered``:
+      aggregate serve throughput per arm — zero-copy must be strictly
+      greater.
+    - ``piece_serve_p99_us``: per-piece serve latency tail under load.
+    - ``daemon_rss_mb``: resident set while holding every connection.
+    """
+    from dragonfly2_tpu.tools.stress import data_plane_race
+
+    out = data_plane_race(children=256, duration_s=2.5, repeats=2)
+    return {
+        "data_plane_bytes_per_s": out["data_plane_bytes_per_s"],
+        "data_plane_bytes_per_s_buffered": out["data_plane_bytes_per_s_buffered"],
+        "data_plane_connections": out["data_plane_connections"],
+        "piece_serve_p99_us": out["piece_serve_p99_us"],
+        "daemon_rss_mb": out["daemon_rss_mb"],
+        "data_plane_hangs": out["data_plane_hangs"],
+        "data_plane_errors": out["data_plane_errors"],
+    }
+
+
 def serving_bench() -> dict:
     """The batched scheduler-inference soak (tools/stress.serving_soak)
     at bench scale: 32 concurrent simulated peers rank candidate sets
@@ -885,6 +912,22 @@ def main() -> None:
         except Exception as e:
             host_rates["serving_error"] = str(e)
             _phase(f"serving bench failed: {e}")
+        # data-plane race: sendfile vs buffered piece serving under
+        # hundreds of concurrent children — throughput per arm, the p99
+        # serve tail, and daemon RSS ride every exit path
+        try:
+            host_rates.update(data_plane_bench())
+            _phase(
+                f"data plane: {host_rates['data_plane_bytes_per_s'] / 1e6:.0f} MB/s"
+                f" sendfile vs"
+                f" {host_rates['data_plane_bytes_per_s_buffered'] / 1e6:.0f} MB/s"
+                f" buffered @ {host_rates['data_plane_connections']} children,"
+                f" p99 {host_rates['piece_serve_p99_us'] / 1e3:.1f}ms,"
+                f" rss {host_rates['daemon_rss_mb']:.0f}MB"
+            )
+        except Exception as e:
+            host_rates["data_plane_error"] = str(e)
+            _phase(f"data plane bench failed: {e}")
         # chaos soak: the canned fault schedule against a real in-process
         # swarm — success rate and hang count ride every exit path
         try:

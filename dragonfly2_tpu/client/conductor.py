@@ -31,6 +31,7 @@ from dragonfly2_tpu.rpc import glue, resilience
 from dragonfly2_tpu.scheduler import fleet
 from dragonfly2_tpu.utils import tracing
 
+from dragonfly2_tpu.client import downloader
 from dragonfly2_tpu.client.downloader import PieceDownloadError
 from dragonfly2_tpu.client.synchronizer import PieceTaskSynchronizer
 from dragonfly2_tpu.client.piece_manager import (
@@ -687,6 +688,11 @@ class PeerTaskConductor:
                 list(pool.map(work, todo))
         finally:
             synchronizer.stop()
+            # the piece fetches rode the shared transfer pool's
+            # keep-alive connections; this task is done with these
+            # parents, so let the pool retire the idle sockets (a
+            # 10k-parent swarm must not pin one fd per parent forever)
+            downloader.release_parents(p.upload_addr for p in parents)
 
         if not failed:
             # _complete failure is terminal (pinned-content mismatch),

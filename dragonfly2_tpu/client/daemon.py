@@ -111,6 +111,17 @@ class DaemonConfig:
     # global upload bandwidth budget in bytes/s shared by all child peers
     # (reference upload totalRateLimit); 0 = unlimited
     upload_rate_limit: float = 0.0
+    # zero-copy data plane (docs/data-plane.md): serve piece bodies via
+    # os.sendfile from the piece store (False = buffered fallback, same
+    # event loop — the bench's comparison arm)
+    upload_sendfile: bool = True
+    # content-addressed cross-task piece dedup in the store (same
+    # digest → one physical copy, refcounted); DF_PIECE_DEDUP=0 is the
+    # process-wide kill switch
+    piece_dedup: bool = True
+    # bound on concurrent P2P stream tasks through the proxy/gateway
+    # transport; past it requests shed to direct fetches. 0 = unbounded
+    p2p_max_inflight: int = 512
     # Prometheus /metrics endpoint: -1 = disabled
     metrics_port: int = -1
     metrics_host: str = "127.0.0.1"
@@ -153,7 +164,11 @@ class Daemon:
         # announce to discover a bad config
         _apply_stat_overrides(hostinfo.HostStats(), config.host_stats_override)
         self.host_id = host_id_v2(config.ip, config.hostname)
-        self.storage = StorageManager(config.data_dir, max_bytes=config.storage_max_bytes)
+        self.storage = StorageManager(
+            config.data_dir,
+            max_bytes=config.storage_max_bytes,
+            dedup=config.piece_dedup,
+        )
         self.upload = UploadServer(
             self.storage,
             host=config.upload_host,
@@ -161,6 +176,7 @@ class Daemon:
             delay_s=config.upload_delay_s,
             cold_piece_delay_s=config.upload_cold_piece_delay_s,
             rate_limit_bps=config.upload_rate_limit,
+            use_sendfile=config.upload_sendfile,
         )
         self._selector = None
         self._server = None
@@ -404,7 +420,11 @@ class Daemon:
             if self.cfg.proxy_mitm:
                 issuer = self._load_spoofing_issuer()
             self.proxy = ProxyServer(
-                P2PTransport(self.task_manager, rules=rules),
+                P2PTransport(
+                    self.task_manager,
+                    rules=rules,
+                    max_inflight=self.cfg.p2p_max_inflight,
+                ),
                 mirror=RegistryMirror(self.cfg.registry_mirror),
                 address=self.cfg.proxy_host,
                 port=self.cfg.proxy_port,

@@ -3,12 +3,22 @@
 Role parity: reference client/daemon/peer/piece_downloader.go:165-204 —
 ``GET parent:uploadPort/download/<task>?peerId=&number=`` fetches one
 piece's bytes from the parent's upload server.
+
+Transport: rides the shared readiness-based :mod:`transfer` pool
+(bounded keep-alive connections, one selector thread — a piece fetch no
+longer pays TCP setup/teardown, and thousands of concurrent transfers
+multiplex over a bounded fd set). ``DF_TRANSFER_LOOP=0`` falls back to
+per-request urllib.
 """
+
+# dfanalyze: hot — one call per piece on the child download path
 
 from __future__ import annotations
 
 import urllib.error
 import urllib.request
+
+from dragonfly2_tpu.client import transfer
 
 
 class PieceDownloadError(Exception):
@@ -30,7 +40,38 @@ def download_piece(
 ) -> tuple[bytes, str, str]:
     """Fetch piece ``number`` of ``task_id`` from a parent upload server
     at ``host:port``; returns (bytes, digest, origin_content_type)."""
-    url = f"http://{parent_addr}/download/{task_id}?number={number}&peerId={peer_id}"
+    target = f"/download/{task_id}?number={number}&peerId={peer_id}"
+    pool = transfer.default_pool()
+    if pool is None:
+        return _download_piece_urllib(parent_addr, target, number, timeout)
+    try:
+        status, headers, body = pool.fetch(parent_addr, target, timeout=timeout)
+    except transfer.TransferError as e:
+        raise PieceDownloadError(f"piece {number} from {parent_addr}: {e}") from e
+    if status != 200:
+        raise PieceDownloadError(
+            f"piece {number} from {parent_addr}: HTTP {status}",
+            not_found=status == 404,
+        )
+    return (
+        body,
+        headers.get("x-dragonfly-piece-digest", ""),
+        headers.get("x-dragonfly-origin-content-type", ""),
+    )
+
+
+def release_parents(addrs) -> None:
+    """Task finished: let the pool drop idle keep-alive connections to
+    these parents (bounds steady-state fd usage in big swarms)."""
+    pool = transfer.default_pool()
+    if pool is not None:
+        pool.release_idle(addrs)
+
+
+def _download_piece_urllib(
+    parent_addr: str, target: str, number: int, timeout: float
+) -> tuple[bytes, str, str]:
+    url = f"http://{parent_addr}{target}"
     try:
         with urllib.request.urlopen(url, timeout=timeout) as resp:
             data = resp.read()

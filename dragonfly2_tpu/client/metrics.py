@@ -23,3 +23,26 @@ BACK_TO_SOURCE_TOTAL = _r.counter(
 PROXY_REQUEST_TOTAL = _r.counter(
     "daemon_proxy_request_total", "Proxy requests", ("route",)
 )
+# --- zero-copy data plane (docs/data-plane.md) ---
+CHILD_DISCONNECT_TOTAL = _r.counter(
+    "daemon_child_disconnect_total",
+    "Child peers that dropped the connection mid-response",
+)
+UPLOAD_CONNECTIONS = _r.gauge(
+    "daemon_upload_connections", "Live child connections on the upload loop"
+)
+PIECE_DEDUP_TOTAL = _r.counter(
+    "daemon_piece_dedup_total",
+    "Pieces stored as content-addressed refs instead of a second copy",
+)
+PIECE_DEDUP_BYTES = _r.counter(
+    "daemon_piece_dedup_bytes_total", "Bytes saved by content-addressed dedup"
+)
+PIECE_DEDUP_MIGRATE_TOTAL = _r.counter(
+    "daemon_piece_dedup_migrate_total",
+    "Owner-piece migrations performed by refcount-safe GC",
+)
+P2P_INFLIGHT_SHED_TOTAL = _r.counter(
+    "daemon_p2p_inflight_shed_total",
+    "Transport requests sent direct because the P2P in-flight bound was hit",
+)

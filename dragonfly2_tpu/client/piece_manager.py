@@ -7,6 +7,9 @@ from origin with optional concurrent ranged piece downloads
 EWMA with randomized tie-breaking (reference piece_dispatcher.go:103-149).
 """
 
+# dfanalyze: hot — per-piece fetch/verify/write path + the rate limiter
+# every transfer windows through
+
 from __future__ import annotations
 
 import random
@@ -360,6 +363,34 @@ class RateLimiter:
                     return
                 wait = (need - self.tokens) / self.rate
             time.sleep(min(wait, 0.5))
+
+    def acquire_nowait(self, n: int) -> float:
+        """Non-blocking form for the readiness-based serve loop: debit
+        ``n`` and return 0.0 when the budget allows it now, else return
+        the seconds to wait (nothing debited — the caller parks the
+        connection on a loop timer and retries). Debt-based exactly like
+        :meth:`acquire`, so a window larger than one second's budget
+        still admits once the bucket fills."""
+        with self.lock:
+            self.consumed += n
+            if self.rate <= 0:
+                return 0.0
+            now = time.monotonic()
+            self.tokens = min(self.rate, self.tokens + (now - self.last) * self.rate)
+            self.last = now
+            need = min(float(n), self.rate)
+            if self.tokens >= need:
+                self.tokens -= n
+                return 0.0
+            self.consumed -= n
+            return (need - self.tokens) / self.rate
+
+    def refund(self, n: int) -> None:
+        """Return tokens debited for bytes that never hit the wire (a
+        socket that went write-blocked mid-window)."""
+        with self.lock:
+            self.tokens = min(self.rate, self.tokens + n) if self.rate > 0 else self.tokens
+            self.consumed = max(0, self.consumed - n)
 
     def set_rate(self, rate: float) -> None:
         with self.lock:

@@ -13,6 +13,7 @@ never whole-blob buffers — and upstream status/headers are preserved so
 
 from __future__ import annotations
 
+import os
 import re
 import threading
 import time
@@ -24,6 +25,7 @@ from typing import Iterator
 from dragonfly2_tpu.rpc import gen  # noqa: F401
 import common_pb2  # noqa: E402
 
+from dragonfly2_tpu.client import metrics as M
 from dragonfly2_tpu.client import source
 from dragonfly2_tpu.client.peertask import FileTaskRequest, TaskManager
 from dragonfly2_tpu.utils import dflog
@@ -71,6 +73,33 @@ class TransportResult:
         return b"".join(self.body)
 
 
+class _Permit:
+    """One in-flight P2P slot. Released explicitly when the response
+    body is exhausted; the finalizer is the backstop for a caller that
+    abandons the TransportResult without ever touching the body."""
+
+    __slots__ = ("_sem", "_done")
+
+    def __init__(self, sem: threading.BoundedSemaphore):
+        self._sem = sem
+        self._done = False
+
+    def release(self) -> None:
+        if not self._done:
+            self._done = True
+            self._sem.release()
+
+    def __del__(self):  # pragma: no cover - GC backstop
+        self.release()
+
+
+def _releasing_iter(body: Iterator[bytes], permit: _Permit) -> Iterator[bytes]:
+    try:
+        yield from body
+    finally:
+        permit.release()
+
+
 class P2PTransport:
     """Route a request: matching rule → peer task (P2P swarm + scheduler
     + back-to-source); no match or failure → direct origin fetch."""
@@ -83,6 +112,7 @@ class P2PTransport:
         rules: list[ProxyRule] | None = None,
         default_tag: str = "",
         timeout: float = 300.0,
+        max_inflight: int | None = None,
     ):
         self.tasks = task_manager
         self.rules = rules or []
@@ -90,6 +120,16 @@ class P2PTransport:
         self.timeout = timeout
         self._no_range: dict[str, float] = {}
         self._no_range_lock = threading.Lock()
+        # bound on concurrent P2P stream tasks: each one costs piece
+        # workers + an announce stream, so an unbounded proxy fan-in
+        # would amplify 10k client requests into 40k threads. At the
+        # bound, new requests shed to a DIRECT fetch (graceful
+        # degradation, counted) instead of queueing behind the swarm.
+        if max_inflight is None:
+            max_inflight = int(os.environ.get("DF_P2P_MAX_INFLIGHT", "512"))
+        self._inflight = (
+            threading.BoundedSemaphore(max_inflight) if max_inflight > 0 else None
+        )
 
     def match_rule(self, url: str) -> ProxyRule | None:
         for rule in self.rules:
@@ -157,11 +197,23 @@ class P2PTransport:
                     )
                 if range_refused:
                     return self._direct(target, headers, head)
+        permit = None
+        if self._inflight is not None:
+            if not self._inflight.acquire(blocking=False):
+                # at the in-flight bound: shed to a direct fetch —
+                # bounded degradation beats queueing behind the swarm
+                M.P2P_INFLIGHT_SHED_TOTAL.inc()
+                logger.warning("p2p in-flight bound hit for %s; going direct", url)
+                return self._direct(target, headers, head)
+            permit = _Permit(self._inflight)
         try:
             return self._via_p2p(
-                target, headers, digest, byte_range=byte_range, tag_salt=tag_salt
+                target, headers, digest, byte_range=byte_range,
+                tag_salt=tag_salt, permit=permit,
             )
         except Exception as e:
+            if permit is not None:
+                permit.release()
             # P2P failure degrades to a direct fetch, never a user error
             # (reference transport.go back-source fallback)
             logger.warning("p2p round-trip for %s failed (%s); going direct", url, e)
@@ -186,6 +238,7 @@ class P2PTransport:
         digest: str = "",
         byte_range: str = "",
         tag_salt: str = "",
+        permit: "_Permit | None" = None,
     ) -> TransportResult:
         # the digest participates in the task id: rewritten content gets a
         # fresh task identity instead of serving stale cached bytes. For
@@ -223,7 +276,7 @@ class P2PTransport:
             # replay persisted origin headers (Content-Type) so registry
             # clients get proper metadata on P2P-served responses
             headers=origin_headers,
-            body=body,
+            body=body if permit is None else _releasing_iter(body, permit),
             content_length=content_length,
             via_p2p=True,
             task_id=task_id,

@@ -90,12 +90,28 @@ def _fake_serving_bench():
     }
 
 
+def _fake_data_plane_bench():
+    # the real race holds 2×256 live sockets for ~10s; emission tests
+    # only assert the KEYS ride the artifact — the race itself is
+    # covered end-to-end by tests/test_data_plane.py + the CLI soak
+    return {
+        "data_plane_bytes_per_s": 500e6,
+        "data_plane_bytes_per_s_buffered": 430e6,
+        "data_plane_connections": 256,
+        "piece_serve_p99_us": 40000.0,
+        "daemon_rss_mb": 40.0,
+        "data_plane_hangs": 0,
+        "data_plane_errors": 0,
+    }
+
+
 def _run_main(monkeypatch, capfd, fit_stub):
     monkeypatch.setattr(bench, "synthesize_dataset", _fake_synthesize)
     monkeypatch.setattr(bench, "synthesize_dataset_binary", _fake_synthesize_binary)
     monkeypatch.setattr(bench, "chaos_soak_bench", _fake_chaos_soak)
     monkeypatch.setattr(bench, "fleet_shard_kill_bench", _fake_fleet_soak)
     monkeypatch.setattr(bench, "serving_bench", _fake_serving_bench)
+    monkeypatch.setattr(bench, "data_plane_bench", _fake_data_plane_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", fit_stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     monkeypatch.delenv("DF_BENCH_CPU_FALLBACK", raising=False)
@@ -227,6 +243,35 @@ def test_emits_recorder_overhead(monkeypatch, capfd):
     assert rec["recorder_overhead_pct"] >= 0.0
     assert 0.0 < rec["recorder_emit_us"] < 50.0
     assert rec["schedule_op_with_recorder_us"] > 0
+
+
+def test_emits_data_plane_keys(monkeypatch, capfd):
+    """The artifact must carry the data-plane race (ISSUE 14: zero-copy
+    serve throughput strictly above the buffered arm, the p99 serve
+    tail, and daemon RSS are measured facts on every bench run)."""
+
+    def stub(paths, **kw):
+        return None, _stats(1000)
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "data_plane_error" not in rec
+    assert rec["data_plane_bytes_per_s"] > rec["data_plane_bytes_per_s_buffered"]
+    assert rec["piece_serve_p99_us"] > 0
+    assert rec["daemon_rss_mb"] > 0
+    assert rec["data_plane_hangs"] == 0
+
+
+def test_data_plane_keys_survive_warmup_failure(monkeypatch, capfd):
+    """host_rates (data-plane numbers included) ride every exit path —
+    a dead device link must not discard the serve-side race."""
+
+    def stub(paths, **kw):
+        raise RuntimeError("link died in compile")
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "warmup fit failed" in rec["error"]
+    assert rec["data_plane_bytes_per_s"] > 0
+    assert rec["data_plane_bytes_per_s_buffered"] > 0
 
 
 def test_recorder_overhead_survives_warmup_failure(monkeypatch, capfd):
