@@ -158,6 +158,8 @@ def _phase(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+
+
 def topology_bench(hosts: int = 64, probes: int = 2048, queries: int = 1024) -> dict:
     """Topology-engine soak: probe deltas through flush + est_rtt
     queries against the resident adjacency (scheduler-side path, no
@@ -477,6 +479,79 @@ def jit_hygiene_bench(
         "jit_recompiles_per_fit": ct.count,
         "h2d_transfers_per_superbatch": round(tt.h2d / superbatches, 3),
     }
+
+
+def multichip_scaling_bench(
+    dps=(1, 2, 4, 8), mb: int = 10, seconds: float = 6.0
+) -> dict:
+    """The dp=1/2/4/8 data-parallel ingest-fit curve as a STANDING bench
+    key (ISSUE 15 / ROADMAP item 5): each dp width runs the full
+    streamed fit — per-device sharded puts, replicated params, donated
+    step state, scan+dp layout — in a fresh subprocess with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``, so the
+    multichip code path is re-proven on every bench run even in a
+    CPU-only image (tools/multichip_fit.py).
+
+    - ``multichip_scaling``: records/s per dp width. HONESTLY labeled
+      (``multichip_platform``): forced host devices share this host's
+      cores, so dp>1 here measures the sharding machinery's cost shape,
+      not ICI speedup — on a real slice the same path scales with chips.
+    - ``mesh_h2d_per_shard``: worst observed H2D-per-superbatch-per-
+      device-shard across the dp>1 runs — the jit-witness gate that the
+      sharded put uploads each row shard exactly once (no double upload
+      via resharding); must stay 1.0.
+    - ``mesh_pack_thread_transfers``: device feeds witnessed on the
+      packing thread across all runs; must stay 0 (the device leg lives
+      on the transfer/step stages).
+    """
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    curve: dict = {}
+    per_shard: list = []
+    pack_transfers = 0
+    for dp in dps:
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        )
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "dragonfly2_tpu.tools.multichip_fit",
+                "--dp",
+                str(dp),
+                "--mb",
+                str(mb),
+                "--time-budget-s",
+                str(seconds),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=60 + 30 * seconds,
+            env=env,
+            cwd=root,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"multichip_fit dp={dp} rc={proc.returncode}:"
+                f" {proc.stderr.strip()[-300:]}"
+            )
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        curve[str(dp)] = rec["records_per_s"]
+        if "h2d_per_shard" in rec and dp > 1:
+            per_shard.append(rec["h2d_per_shard"])
+        pack_transfers += rec.get("pack_thread_transfers", 0)
+    out = {
+        "multichip_scaling": curve,
+        "multichip_platform": "cpu-forced-host-devices",
+        "mesh_pack_thread_transfers": pack_transfers,
+    }
+    if per_shard:
+        out["mesh_h2d_per_shard"] = max(per_shard)
+    return out
 
 
 def telemetry_overhead_bench(iters: int = 200, trials: int = 5) -> dict:
@@ -885,6 +960,24 @@ def main() -> None:
         except Exception as e:
             host_rates["jit_hygiene_error"] = str(e)
             _phase(f"jit hygiene bench failed: {e}")
+        # multichip scaling curve rides host_rates the same way: the
+        # dp=1/2/4/8 data-parallel fit (forced host devices) is a
+        # standing key, with the sharded-put witness gates alongside
+        try:
+            host_rates.update(multichip_scaling_bench())
+            _phase(
+                "multichip scaling (forced-host devices): "
+                + " ".join(
+                    f"dp{d}={r / 1e3:.1f}k/s"
+                    for d, r in host_rates["multichip_scaling"].items()
+                )
+                + f", h2d/shard {host_rates.get('mesh_h2d_per_shard', 0):.2f},"
+                f" pack-thread feeds"
+                f" {host_rates['mesh_pack_thread_transfers']}"
+            )
+        except Exception as e:
+            host_rates["multichip_error"] = str(e)
+            _phase(f"multichip scaling bench failed: {e}")
         # resilience-layer overhead rides host_rates the same way: the
         # fault-free pre-flight (breaker/budget/deadline) must stay < 2%
         # of the scheduling hot-path wall
@@ -1058,6 +1151,13 @@ def main() -> None:
                         # bounded THIS run (decoders vs the device leg)
                         "decode_wait_s": round(stats.decode_wait_s, 2),
                         "buffer_wait_s": round(stats.buffer_wait_s, 2),
+                        # device-leg split per stage thread: h2d on the
+                        # transfer stage (with the portion hidden behind
+                        # steps), step dispatch+confirm on the step
+                        # stage — the full per-superbatch attribution
+                        "h2d_s": round(stats.h2d_s, 2),
+                        "h2d_overlap_s": round(stats.h2d_overlap_s, 2),
+                        "step_s": round(stats.step_s, 2),
                         # producer-side split (summed over the pool):
                         # read / cast / enqueue — names the next
                         # bottleneck when decode_wait_s is nonzero
@@ -1089,6 +1189,7 @@ def main() -> None:
                     "steps": best[2].steps,
                     "wall_s": round(best[1], 2),
                     "host_cores": ncpu,
+                    "h2d_overlap_pct": best[2].h2d_overlap_pct,
                     "run_rates": list(run_rates),
                     **host_rates,
                     **({"truncated": True} if best[2].truncated else {}),
@@ -1110,6 +1211,9 @@ def main() -> None:
             return
         rec_per_sec_per_chip, dt, stats = best
     extra = {"truncated": True} if stats.truncated else {}
+    # fraction of H2D wall the overlapped pipeline hid behind steps on
+    # the best run — the tentpole's direct measure, next to the curve
+    extra["h2d_overlap_pct"] = stats.h2d_overlap_pct
     extra.update(host_rates)
     if run_error:
         extra["run_error"] = run_error  # partial repeats: cause on record

@@ -40,3 +40,19 @@ def make_mesh(devices=None, **axes: int) -> Mesh:
 
 def mesh_shape(mesh: Mesh) -> dict[str, int]:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def auto_dp_mesh() -> "Mesh | None":
+    """The production-default data-parallel fit mesh: a pure ``dp`` mesh
+    over every addressable device when more than one chip is present,
+    ``None`` on a single-device host (the plain ``jnp.asarray`` feed
+    path — a 1-wide mesh would only add sharding bookkeeping).
+
+    ``Training`` calls this at construction (ISSUE 15: the ``mesh=``
+    plumbing is a first-class, continuously-exercised path, not a
+    dormant parameter), so the dp>1 code — sharded puts, replicated
+    params, donation, scan+dp layout — runs wherever >1 device is
+    addressable, including CI's forced-host-platform 8-device CPU.
+    """
+    n = len(jax.devices())
+    return make_mesh(dp=n) if n > 1 else None

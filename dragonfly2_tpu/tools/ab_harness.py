@@ -45,6 +45,13 @@ class ABConfig:
     # evaluator's ranking (not the client dispatcher) decides outcomes
     candidate_parent_limit: int = 2
     seed: int = 7
+    # phase 2 rides the BATCHED scoring service (scheduler/serving.py)
+    # instead of the per-call evaluator — the production serve path
+    # (ROADMAP item 1's A/B leftover). "jax" serves the refresher's
+    # jitted MLPScorer; "numpy" swaps the identical-API numpy scorer
+    # into the serving slot (what tier-1 exercises); "off" keeps the
+    # per-call path for ablation.
+    serving_backend: str = "jax"
     # loaded hosts announce this much cpu/memory pressure
     slow_stats: dict = field(
         default_factory=lambda: {"cpu.percent": 92.0, "memory.used_percent": 85.0}
@@ -327,19 +334,63 @@ def run_ab(cfg: ABConfig | None = None, workdir: str | None = None) -> dict:
         c1.stop()
 
     # ---- phase 2: ml evaluator fed through the real serving loop ----
-    logger.info("phase 2: ml evaluator (model via manager registry)")
-    evaluator = MLEvaluator()
-    refresher = ModelRefresher(client, evaluator, scheduler_cluster_id=1)
-    installed = refresher.refresh_once()
-    if not installed:
-        raise RuntimeError("model refresh failed — serving loop not closed")
-    c2 = _Cluster(cfg, evaluator, os.path.join(workdir, "phase-ml"))
+    # The model rides the BATCHED scoring service (scheduler/serving.py)
+    # unless serving_backend == "off": the ModelRefresher installs into
+    # BOTH the per-call slot and the serving slot, and the evaluator's
+    # top rung scores through the service's micro-batches — the
+    # production serve path, measured under real swarm traffic (the
+    # ROADMAP item 1 leftover this harness closes).
+    logger.info(
+        "phase 2: ml evaluator (model via manager registry, serving=%s)",
+        cfg.serving_backend,
+    )
+    svc = None
+    serving_snap: dict = {}
+    # one outer finally owns the serving thread + manager plumbing: a
+    # failed refresh (or cluster construction) must not leak the
+    # scheduler.serving drain thread or the manager server
     try:
-        ml_result = _run_workload(c2, cfg, origins)
+        if cfg.serving_backend != "off":
+            from dragonfly2_tpu.scheduler.serving import ScoringService, ServingConfig
+
+            svc = ScoringService(ServingConfig())
+            svc.start()
+        evaluator = MLEvaluator(serving=svc)
+        refresher = ModelRefresher(
+            client, evaluator, scheduler_cluster_id=1, serving=svc
+        )
+        installed = refresher.refresh_once()
+        if not installed:
+            raise RuntimeError("model refresh failed — serving loop not closed")
+        if svc is not None and cfg.serving_backend == "numpy":
+            # the identical-API numpy scorer through the same slot — the
+            # batched submit/pack/score/return machinery without an XLA
+            # dispatch, which is what tier-1 runs
+            from dragonfly2_tpu.scheduler.serving import MLPServed
+            from dragonfly2_tpu.trainer.serving import NumpyMLPScorer
+
+            svc.install(
+                MLPServed(NumpyMLPScorer(refresher._mlp_scorer._params), kind="numpy"),
+                version="ab-numpy",
+            )
+        c2 = _Cluster(cfg, evaluator, os.path.join(workdir, "phase-ml"))
+        try:
+            ml_result = _run_workload(c2, cfg, origins)
+        finally:
+            c2.stop()
     finally:
-        c2.stop()
+        if svc is not None:
+            serving_snap = svc.snapshot()
+            svc.stop()
         mgr_channel.close()
         mgr_server.stop(0)
+    if svc is not None and not serving_snap.get("batches"):
+        # an idle service means phase 2 silently fell back to the
+        # per-call rung — the comparison would no longer measure the
+        # production serve path
+        raise RuntimeError(
+            f"batched scoring service unused in phase 2: {serving_snap}"
+        )
 
     out = {
         "p50_default_ms": round(default_result.p50_ms, 3),
@@ -353,6 +404,11 @@ def run_ab(cfg: ABConfig | None = None, workdir: str | None = None) -> dict:
         "mlp_eval_mse": round(metrics.get("mse", 0.0), 4),
         "ml_wins": ml_result.p50_ms < default_result.p50_ms,
     }
+    if serving_snap:
+        out["serving_backend"] = serving_snap.get("model_kind", "")
+        out["serving_batches"] = serving_snap.get("batches", 0)
+        out["serving_rows_scored"] = serving_snap.get("rows_scored", 0)
+        out["evaluator_batch_occupancy"] = serving_snap.get("batch_occupancy", 0.0)
     return out
 
 

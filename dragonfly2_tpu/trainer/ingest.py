@@ -16,17 +16,21 @@ sniffed from the file's magic bytes:
   (ctypes releases the GIL during native parsing).
 
 Either way the consumer packs pair shards into fixed-size minibatches
-and hands full superbatches to a dedicated dispatcher thread that runs
-transfer + jitted train step — decode, H2D, and device compute all
-overlap (XLA dispatch is async; the dispatcher absorbs the device
-link's transfer latency so it never stalls packing or decode). Multiple
-dataset files decode in parallel, one producer thread per span.
+and hands full superbatches to a two-stage device leg — a TRANSFER
+thread issuing the H2D put and a STEP thread driving the jitted
+(buffer-donating) train step — so decode, H2D, and device compute all
+overlap: superbatch N+1's transfer is issued while step N executes,
+and the hidden transfer wall is measured per run
+(``StreamStats.h2d_overlap_s``). With a multi-chip ``mesh`` the put is
+a per-device sharded upload (each chip receives only its row shard).
+Multiple dataset files decode in parallel, one producer thread per
+span.
 
 Memory bound: the shard queue holds ≤ ``queue_depth`` chunks of decoded
-pairs (~chunk_bytes of CSV each) plus a three-buffer packing pool
-(3 × batch_size·steps_per_call superbatches: one packing, one in
-transfer/step, one awaiting confirmation) and a capped eval holdout —
-independent of file size.
+pairs (~chunk_bytes of CSV each) plus a six-buffer packing pool
+(6 × batch_size·steps_per_call superbatches: one packing, up to three
+queued/in-transfer, up to two staged for the step, one awaiting
+confirmation) and a capped eval holdout — independent of file size.
 """
 
 # dfanalyze: device-hot — the dispatcher thread drives the jitted train
@@ -84,12 +88,18 @@ class StreamStats:
     # carries the bottleneck, not a guess.
     decode_wait_s: float = 0.0
     buffer_wait_s: float = 0.0
-    # dispatcher-side split, per superbatch (single writer — the
-    # dispatcher thread): h2d_s — host→device transfer dispatch;
-    # step_s — compiled-step dispatch + the prior step's confirmation
-    # wait (the device-compute leg as the host observes it)
+    # device-leg split, per superbatch, one field per pipeline stage
+    # (each with a single writer thread): h2d_s — host→device transfer
+    # wall, recorded on the TRANSFER stage; step_s — compiled-step
+    # dispatch + the prior step's confirmation wait, recorded on the
+    # STEP stage. The stages overlap (that's the point), so h2d_s no
+    # longer serializes into the superbatch wall:
+    # h2d_overlap_s — the portion of h2d_s spent while the step stage
+    # was busy, i.e. transfer wall HIDDEN behind device compute
+    # (h2d_overlap_s / h2d_s is bench.py's h2d_overlap_pct)
     h2d_s: float = 0.0
     step_s: float = 0.0
+    h2d_overlap_s: float = 0.0
     # producer-side per-stage split, summed across the worker pool (so
     # with W workers the totals can exceed wall time): read_s — I/O +
     # block decode + checksum (binary) / fused read+parse (CSV, where
@@ -109,6 +119,16 @@ class StreamStats:
     @property
     def records_per_s(self) -> float:
         return self.download_records / self.wall_s if self.wall_s else 0.0
+
+    @property
+    def h2d_overlap_pct(self) -> float:
+        """Percentage of the H2D wall hidden behind device steps — the
+        overlapped pipeline's direct measure, shared by every artifact
+        that reports it (bench.py, soak_ingest, multichip_fit) so the
+        key can never drift between them."""
+        return (
+            round(100.0 * self.h2d_overlap_s / self.h2d_s, 1) if self.h2d_s else 0.0
+        )
 
 
 _LOSS_KEEP = 1024
@@ -347,7 +367,18 @@ def _get_step(learning_rate: float, weight_decay: float, warmup_steps: int = 64)
     The schedule is linear warmup → constant: the streaming horizon is
     unknown up front (records arrive as bytes decode), so the batch
     path's cosine decay has no defined endpoint here; warmup covers the
-    same early-drift window (train.py warmup_fraction)."""
+    same early-drift window (train.py warmup_fraction).
+
+    Everything host-side the feed once did lives INSIDE the jit now —
+    the staging-dtype upcast, the feature/label split — and the carried
+    state (params, opt_state) is donated: XLA writes each step's updates
+    into the SAME HBM buffers instead of allocating a fresh copy per
+    dispatch, and the donated inputs are invalidated (re-reading them
+    raises — the dp>1 test pins this). The xy superbatch is deliberately
+    NOT donated: no output shares its [.., F+1] shape, so XLA could
+    never alias it — donating it would only emit a "donated buffer not
+    usable" warning per compile while the buffer frees at its last use
+    regardless."""
     key = (learning_rate, weight_decay, warmup_steps)
     if key in _step_cache:
         return _step_cache[key]
@@ -357,12 +388,11 @@ def _get_step(learning_rate: float, weight_decay: float, warmup_steps: int = 64)
     optimizer, loss_fn = _optimizer_and_loss(learning_rate, weight_decay, warmup_steps)
     import optax
 
-    @jax.jit
     def step(params, opt_state, xy):
         # one fused [B, F+1] transfer per batch (features ‖ label column):
         # H2D calls have per-call cost, and the upcast from the reduced
         # transfer dtype is free device-side (XLA fuses it into the first
-        # matmul's bf16 cast)
+        # matmul's compute-dtype cast)
         xy = xy.astype(jnp.float32)
         xb, yb = xy[:, :MLP_FEATURE_DIM], xy[:, MLP_FEATURE_DIM]
         loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
@@ -370,6 +400,7 @@ def _get_step(learning_rate: float, weight_decay: float, warmup_steps: int = 64)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
+    step = jax.jit(step, donate_argnums=(0, 1))
     _step_cache[key] = (optimizer, step)
     return optimizer, step
 
@@ -393,7 +424,6 @@ def _get_scan_step(
 
     optimizer, loss_fn = _optimizer_and_loss(learning_rate, weight_decay, warmup_steps)
 
-    @jax.jit
     def scan_step(params, opt_state, xy):
         xy = xy.astype(jnp.float32)
 
@@ -407,6 +437,9 @@ def _get_scan_step(
         (params, opt_state), losses = lax.scan(body, (params, opt_state), xy)
         return params, opt_state, losses[-1]
 
+    # same donation contract as _get_step: the carried state updates in
+    # place; the [k, B, F+1] superbatch is shape-unaliasable (see above)
+    scan_step = jax.jit(scan_step, donate_argnums=(0, 1))
     _step_cache[key] = (optimizer, scan_step)
     return optimizer, scan_step
 
@@ -494,18 +527,28 @@ def stream_train_mlp(
         params = replicate(mesh, params)
     opt_state = None  # initialized at the first shard (after bias warm-start)
 
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    if mesh is not None and batch_size % mesh.shape["dp"] == 0:
+        from dragonfly2_tpu.parallel.sharding import shard_superbatch
 
-        # rows shard over dp; the superbatch's leading scan axis (k>1)
-        # stays unsharded — each scan step is one dp-parallel batch
-        xy_sharding = NamedSharding(
-            mesh, P("dp", None) if k == 1 else P(None, "dp", None)
-        )
+        # rows shard over dp via per-device puts — each chip receives
+        # ONLY its row shard (parallel.sharding.shard_superbatch; the
+        # jit-witness mesh gate pins dp transfers per superbatch). The
+        # superbatch's leading scan axis (k>1) stays unsharded — each
+        # scan step is one dp-parallel batch.
+        batch_dim = 0 if k == 1 else 1
 
         def put(buf):
-            return jax.device_put(buf, xy_sharding)
+            return shard_superbatch(mesh, buf, batch_dim=batch_dim)
     else:
+        if mesh is not None:
+            # a batch that doesn't divide the dp axis can't shard evenly;
+            # feed replicated rather than fail the fit (the degenerate
+            # twin of the ragged tiny-dataset rule below)
+            logger.warning(
+                "batch_size %d not divisible by dp=%d; feeding unsharded",
+                batch_size,
+                mesh.shape["dp"],
+            )
 
         def put(buf):
             return jnp.asarray(buf)
@@ -538,33 +581,39 @@ def stream_train_mlp(
         "trainer.decode_wait", floor_s=0.5, on_stall=_on_stall, event=EV_STALL
     )
     # Pipelined packing: fixed [batch_size·k, F+1] (features ‖ label)
-    # buffers cycle through a free pool → packing → a dispatcher thread
-    # that runs transfer + step. A DEDICATED dispatcher thread matters on
-    # a host whose device link has variable latency (tunneled/remote
-    # chips): H2D transfer time under decode contention was measured at
-    # 100-600 ms per superbatch, and paying that on the packing thread
-    # stalls the decode pipeline behind it — measured 110k → 200k
-    # records/s on a 1-core host by moving dispatch off-thread. Three
-    # buffers = one packing + one in transfer/step + one awaiting
-    # confirmation. A buffer is reused only after the step that read it
-    # has materialized its loss: the CPU backend's asarray/device_put can
-    # be ZERO-COPY, so the asynchronously dispatched step may still read
-    # the numpy buffer after dispatch returns (a real TPU always copies
-    # on H2D, but correctness can't depend on the backend's copy
-    # behavior).
+    # buffers cycle through a free pool → packing → a TRANSFER stage →
+    # a STEP stage, each stage its own thread. Dedicated device-leg
+    # threads matter on a host whose device link has variable latency
+    # (tunneled/remote chips): H2D transfer time under decode contention
+    # was measured at 100-600 ms per superbatch, and paying that on the
+    # packing thread stalls the decode pipeline behind it — measured
+    # 110k → 200k records/s on a 1-core host by moving dispatch
+    # off-thread. Splitting transfer from step (ISSUE 15) removes the
+    # last serial bubble: the H2D for superbatch N+1 is issued WHILE
+    # step N executes on device, so transfer wall hides behind compute
+    # (measured per run as stats.h2d_overlap_s). A buffer is reused only
+    # after the step that read it has materialized its loss: the CPU
+    # backend's asarray/device_put can be ZERO-COPY, so the
+    # asynchronously dispatched step may still read the numpy buffer
+    # after dispatch returns (a real TPU always copies on H2D, but
+    # correctness can't depend on the backend's copy behavior) — and the
+    # in-flight transfer extends the same rule: a staged device array is
+    # consumed (donated) by exactly one step before its host buffer
+    # recycles.
     rows_per_call = batch_size * k
     free_bufs: "queue.Queue" = queue.Queue()
-    # Five buffers / filled depth 3 (was 3 / 1): one packing + up to
-    # three queued-or-in-transfer + one awaiting step confirmation. The
-    # device link's throughput is bursty (tunneled chips measured
-    # 75 MB/s–1.5 GB/s within one run); extra in-flight superbatches let
-    # decode run ahead through a slow patch instead of stalling behind
-    # one delayed transfer. Memory cost: 5 × k·B·(F+1) half-words
-    # (~100 MB at the bench shape) — bounded and config-independent of
-    # file size, same as before.
-    for _ in range(5):
+    # Six buffers / filled depth 3 / staged depth 2: one packing + up to
+    # three queued-or-in-transfer + up to two transferred-awaiting-step
+    # + one awaiting step confirmation. The device link's throughput is
+    # bursty (tunneled chips measured 75 MB/s–1.5 GB/s within one run);
+    # in-flight superbatches let decode run ahead through a slow patch
+    # instead of stalling behind one delayed transfer. Memory cost:
+    # 6 × k·B·(F+1) half-words (~126 MB at the bench shape) — bounded
+    # and config-independent of file size.
+    for _ in range(6):
         free_bufs.put(np.empty((rows_per_call, MLP_FEATURE_DIM + 1), transfer_dtype))
     filled_bufs: "queue.Queue" = queue.Queue(maxsize=3)
+    staged_bufs: "queue.Queue" = queue.Queue(maxsize=2)
     disp_errors: list[BaseException] = []
     buf = free_bufs.get()
     fill = 0
@@ -577,22 +626,55 @@ def stream_train_mlp(
     loss_ring: "collections.deque" = collections.deque(maxlen=_LOSS_KEEP)
     t0 = time.perf_counter()
 
-    # Dispatcher thread: owns params/opt_state from its start to its
-    # join; runs transfer + step per filled buffer, confirms the
-    # previous step before recycling that step's buffer (the reuse rule
-    # above). Single consumer of filled_bufs, single producer of
-    # free_bufs recycles; stats.steps/loss_ring writes are GIL-atomic
-    # with a single writer. On error it keeps draining filled buffers
-    # until the None sentinel so the packing thread never deadlocks.
+    # Two-stage device leg, one thread per stage, started together at
+    # the first full superbatch:
+    #
+    #   transfer stage — consumes filled_bufs, issues the H2D put, hands
+    #     (device array, host buffer, h2d wall) to staged_bufs. Because
+    #     this runs on its own thread, superbatch N+1's transfer
+    #     overlaps step N's execution; the overlap actually achieved is
+    #     measured per put against the step stage's busy flag
+    #     (stats.h2d_overlap_s).
+    #   step stage — owns params/opt_state from its start to its join;
+    #     dispatches the jitted (donating) step per staged superbatch
+    #     and confirms the PREVIOUS step before recycling that step's
+    #     host buffer (the reuse rule above).
+    #
+    # Each stage records ITS OWN wall (h2d on transfer, step on step) so
+    # /debug/prof phases and the EV_SUPERBATCH event never double-count
+    # one superbatch's wall; EV_SUPERBATCH is emitted once per
+    # superbatch by the step stage, carrying the transfer stage's h2d
+    # measurement forwarded through staged_bufs. stats.steps/loss_ring
+    # writes are GIL-atomic with a single writer. On error either stage
+    # keeps draining its input queue to the None sentinel (recycling
+    # buffers) so the packing thread never deadlocks.
     state: dict = {}
-    disp_thread: threading.Thread | None = None
+    stage_threads: "list[threading.Thread]" = []
+    # step-stage busy CLOCK (single writer: the step thread): "total"
+    # accumulates completed busy intervals, "since" is nonzero while a
+    # step is in flight. The transfer stage reads the clock at both
+    # edges of each put and credits only the INTERSECTION of the put's
+    # wall with step-busy time as overlap — an all-or-nothing edge
+    # sample would credit a 600 ms transfer as fully hidden behind a
+    # 5 ms step. Unlocked reads are safe: each field is written by one
+    # thread and read whole under the GIL; a torn total/since pair can
+    # only skew one put's credit, and the delta is clamped to [0, dt_h].
+    step_busy = {"total": 0.0, "since": 0.0}
 
-    def _dispatch_loop():
-        prev_loss = prev_buf = None
+    def _step_busy_clock() -> float:
+        t = step_busy["total"]
+        since = step_busy["since"]
+        if since:
+            t += time.perf_counter() - since
+        return t
+
+    fn = step if k == 1 else scan_step
+
+    def _transfer_loop():
         saw_sentinel = False
         # the owning fit span activates on this thread too (contextvars
-        # don't cross threads), so the superbatch flight events and any
-        # stall verdict carry the fit's trace_id
+        # don't cross threads), so the transfer-side histograms carry
+        # the fit's trace_id exemplars
         span_cm = tracing.use_span(_owner)
         try:
             span_cm.__enter__()
@@ -601,27 +683,71 @@ def stream_train_mlp(
                 if b is None:
                     saw_sentinel = True
                     break
+                if disp_errors:
+                    # dead step stage: recycle so the packer unblocks,
+                    # keep draining to the sentinel
+                    free_bufs.put(b)
+                    continue
                 arg = b if k == 1 else b.reshape(k, batch_size, -1)
-                fn = step if k == 1 else scan_step
+                busy0 = _step_busy_clock()
                 t_h = time.perf_counter()
                 dev = put(arg)
-                t_s = time.perf_counter()
-                dt_h = t_s - t_h
+                dt_h = time.perf_counter() - t_h
                 stats.h2d_s += dt_h
+                # overlap = step-busy seconds elapsed DURING this put —
+                # the transfer wall genuinely hidden behind device
+                # compute, not an edge sample
+                stats.h2d_overlap_s += min(
+                    max(_step_busy_clock() - busy0, 0.0), dt_h
+                )
                 M.INGEST_H2D_SECONDS.observe(dt_h, exemplar=trace_exemplar)
                 PH_H2D.observe(dt_h)
-                state["params"], state["opt_state"], loss = fn(
-                    state["params"], state["opt_state"], dev
-                )
-                loss_ring.append(loss)
-                stats.steps += k
-                if prev_loss is not None:
-                    jax.block_until_ready(prev_loss)
-                    free_bufs.put(prev_buf)
-                # step split = this dispatch + the prior step's
-                # confirmation wait: how long the device leg held the
-                # pipeline for one superbatch, as the host sees it
-                dt_s = time.perf_counter() - t_s
+                staged_bufs.put((dev, b, dt_h))
+        except BaseException as e:
+            disp_errors.append(e)
+            while not saw_sentinel:
+                b = filled_bufs.get()
+                if b is None:
+                    break
+                free_bufs.put(b)
+        finally:
+            # ALWAYS forward the shutdown downstream — the step stage's
+            # only sentinel source is this stage
+            staged_bufs.put(None)
+            span_cm.__exit__(None, None, None)
+
+    def _step_loop():
+        prev_loss = prev_buf = None
+        saw_sentinel = False
+        span_cm = tracing.use_span(_owner)
+        try:
+            span_cm.__enter__()
+            while True:
+                item = staged_bufs.get()
+                if item is None:
+                    saw_sentinel = True
+                    break
+                dev, b, dt_h = item
+                t_s = time.perf_counter()
+                step_busy["since"] = t_s
+                try:
+                    state["params"], state["opt_state"], loss = fn(
+                        state["params"], state["opt_state"], dev
+                    )
+                    loss_ring.append(loss)
+                    stats.steps += k
+                    if prev_loss is not None:
+                        jax.block_until_ready(prev_loss)
+                        free_bufs.put(prev_buf)
+                    # step split = this dispatch + the prior step's
+                    # confirmation wait: how long the device leg held
+                    # the pipeline for one superbatch, as the host sees
+                    # it — the h2d wall is NOT in here (it ran on the
+                    # transfer stage, possibly concurrently)
+                    dt_s = time.perf_counter() - t_s
+                finally:
+                    step_busy["total"] += time.perf_counter() - step_busy["since"]
+                    step_busy["since"] = 0.0
                 stats.step_s += dt_s
                 M.INGEST_STEP_SECONDS.observe(dt_s, exemplar=trace_exemplar)
                 PH_STEP.observe(dt_s)
@@ -637,17 +763,17 @@ def stream_train_mlp(
             disp_errors.append(e)
             if prev_buf is not None:
                 free_bufs.put(prev_buf)
-            # drain to the sentinel so the packing thread never blocks on
-            # the pool — but only if the sentinel hasn't been consumed
-            # yet: a failure in the post-sentinel tail (e.g. the final
-            # block_until_ready raising on a dropped device link) must
-            # not wait for a second sentinel that will never come while
-            # the packer sits in join()
+            # drain to the sentinel so the transfer stage never blocks
+            # on staged_bufs — but only if the sentinel hasn't been
+            # consumed yet: a failure in the post-sentinel tail (e.g.
+            # the final block_until_ready raising on a dropped device
+            # link) must not wait for a second sentinel that will never
+            # come while the packer sits in join()
             while not saw_sentinel:
-                b = filled_bufs.get()
-                if b is None:
+                item = staged_bufs.get()
+                if item is None:
                     break
-                free_bufs.put(b)
+                free_bufs.put(item[1])
         finally:
             span_cm.__exit__(None, None, None)
 
@@ -738,17 +864,22 @@ def stream_train_mlp(
                 fill += take
                 off += take
                 if fill == rows_per_call:
-                    # hand the full buffer to the dispatcher thread and keep
+                    # hand the full buffer to the device-leg stages and keep
                     # packing: transfer + step latency (large and variable on
                     # a tunneled device link) never stalls the decode pipeline
-                    if disp_thread is None:
+                    if not stage_threads:
                         state["params"], state["opt_state"] = params, opt_state
-                        disp_thread = threading.Thread(
-                            target=_dispatch_loop,
-                            name="trainer.ingest-dispatch",
-                            daemon=True,
-                        )
-                        disp_thread.start()
+                        for target, role in (
+                            (_transfer_loop, "transfer"),
+                            (_step_loop, "step"),
+                        ):
+                            t = threading.Thread(
+                                target=target,
+                                name=f"trainer.ingest-{role}",
+                                daemon=True,
+                            )
+                            t.start()
+                            stage_threads.append(t)
                     w0 = time.perf_counter()
                     filled_bufs.put(buf)  # may block at queue depth
                     buf = free_bufs.get()
@@ -764,14 +895,25 @@ def stream_train_mlp(
                     if disp_errors:
                         break
     finally:
-        if disp_thread is not None:
+        if stage_threads:
+            # one sentinel into the head of the pipeline; the transfer
+            # stage forwards it (its finally), so joining in order
+            # drains both stages
             filled_bufs.put(None)
-            disp_thread.join()
+            for t in stage_threads:
+                t.join()
             params, opt_state = state["params"], state["opt_state"]
     if disp_errors:
         raise disp_errors[0]
     stats.eval_pairs = eval_collected
-    if stats.steps == 0 and fill > 0:
+
+    # Post-stream tail, in NAMED functions on purpose: the jit-witness
+    # crosscheck fails any device feed attributed to stream_train_mlp's
+    # own frame (the packing loop must never dispatch device work — it
+    # would stall decode behind the device link), and these two run
+    # once AFTER the pipeline drained, where a boundary conversion on
+    # this thread is exactly right.
+    def _ragged_tail(params, opt_state):
         # tiny dataset (< one batch): one ragged step so the fit is real.
         # Replicated (plain asarray), not dp-sharded — the ragged length
         # rarely divides the mesh axis, and one degenerate step doesn't
@@ -783,6 +925,10 @@ def stream_train_mlp(
         )
         loss_ring.append(pending_loss)
         stats.steps += 1
+        return params, opt_state
+
+    if stats.steps == 0 and fill > 0:
+        params, opt_state = _ragged_tail(params, opt_state)
     stats.losses = [float(jax.block_until_ready(v)) for v in loss_ring]
     stats.wall_s = time.perf_counter() - t0
     # round milestone: the whole run's decode/transfer/compute split in
@@ -795,6 +941,7 @@ def stream_train_mlp(
         decode_wait_s=round(stats.decode_wait_s, 3),
         buffer_wait_s=round(stats.buffer_wait_s, 3),
         h2d_s=round(stats.h2d_s, 3),
+        h2d_overlap_s=round(stats.h2d_overlap_s, 3),
         step_s=round(stats.step_s, 3),
         read_s=round(stats.read_s, 3),
         cast_s=round(stats.cast_s, 3),
@@ -803,7 +950,7 @@ def stream_train_mlp(
         stalls=step_watch.stalls + decode_watch.stalls,
     )
 
-    if eval_x:
+    def _eval_holdout():
         xe = np.concatenate(eval_x)
         ye = np.concatenate(eval_y)
         # the fit-end eval rides the shared memoized jit: a fresh
@@ -816,4 +963,7 @@ def stream_train_mlp(
             "mse": float(np.mean(err**2)),
             "mae": float(np.mean(np.abs(err))),
         }
+
+    if eval_x:
+        _eval_holdout()
     return params, stats

@@ -38,6 +38,11 @@ class TrainerServerConfig:
     incremental: bool = False
     streaming: bool = True
     streaming_workers: int = 1
+    # data-parallel fit mesh over every addressable chip when >1 is
+    # present (TrainingConfig.auto_mesh; parallel.mesh.auto_dp_mesh) —
+    # the ICI data-parallel fit is the production default, disable only
+    # to pin a deploy to single-device fits
+    auto_mesh: bool = True
     # on-demand jax.profiler capture: a non-empty dir writes one XLA
     # trace per fit under <profile_dir>/<model> (view with TensorBoard);
     # settable per-deploy via config file or DF_TRAINER_PROFILE_DIR
@@ -103,6 +108,7 @@ class TrainerServer:
                 clear_after_train=not config.incremental,
                 streaming=config.streaming,
                 streaming_workers=config.streaming_workers,
+                auto_mesh=config.auto_mesh,
                 profile_dir=config.profile_dir,
                 checkpoint_dir=config.checkpoint_dir,
             ),

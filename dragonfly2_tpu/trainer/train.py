@@ -93,6 +93,12 @@ def _shard_arrays(mesh, *arrays, axis: str = "dp"):
         # jitted epoch is an implicit per-epoch transfer the jit witness
         # (rightly) flags; the cost is identical, the site is visible
         return tuple(jnp.asarray(a) for a in arrays)
+    if arrays and arrays[0].shape[1] % mesh.shape[axis]:
+        # _batch_steps clamps the batch to tiny shards, and a clamped
+        # batch rarely divides the dp axis — feed replicated rather
+        # than fail the fit (the auto-mesh default must be safe for
+        # every dataset size; one small fit doesn't need parallelism)
+        return tuple(jnp.asarray(a) for a in arrays)
     s = NamedSharding(mesh, P(None, axis))  # [steps, batch, ...] — batch dim sharded
     return tuple(jax.device_put(a, s) for a in arrays)
 

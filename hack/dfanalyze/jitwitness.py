@@ -61,7 +61,10 @@ _compiles: dict[str, dict] = {}
 # DISTINCT functions' wrappers at one line, one each — site-only keying
 # would sum them into a false churn verdict against the helper itself
 _wrapper_sites: dict[tuple[str, str], int] = {}
-# (file, fn, line, target, explicit) -> count
+# (file, fn, line, target, explicit, thread) -> count. Thread names are
+# part of the record so the report can enforce WHERE a transfer ran —
+# the ingest pipeline's contract is that every device feed lives on the
+# dedicated transfer stage, never the packing thread (ISSUE 15)
 _transfers: dict[tuple, int] = {}
 
 # a function compiled for hundreds of shapes only needs enough recorded
@@ -130,7 +133,7 @@ def _note_transfer(target: str, explicit: bool) -> None:
     if frame is None:
         return
     rel, fn, line = frame
-    key = (rel, fn, line, target, explicit)
+    key = (rel, fn, line, target, explicit, threading.current_thread().name)
     with _state_lock:
         _transfers[key] = _transfers.get(key, 0) + 1
 
@@ -288,9 +291,10 @@ def snapshot() -> dict:
                     "line": line,
                     "target": target,
                     "explicit": explicit,
+                    "thread": thread,
                     "count": n,
                 }
-                for (rel, fn, line, target, explicit), n in sorted(
+                for (rel, fn, line, target, explicit, thread), n in sorted(
                     _transfers.items()
                 )
             ],
@@ -356,10 +360,20 @@ class transfer_tap:
     """``with transfer_tap() as t: ...`` → ``t.h2d`` host→device
     conversions (``jax.device_put`` / ``jnp.asarray`` called with a
     numpy array) in the region — the H2D count as the package dispatches
-    it, one increment per superbatch on the steady-state ingest path."""
+    it, one increment per superbatch on the steady-state single-device
+    ingest path, one per DEVICE SHARD on the mesh path (the
+    per-device sharded put). ``t.by_thread`` attributes each conversion
+    to the thread that issued it, so the multichip harness can pin
+    the no-device-work-on-the-packing-thread contract."""
 
     def __init__(self):
         self.h2d = 0
+        self.by_thread: dict[str, int] = {}
+
+    def _note(self):
+        self.h2d += 1
+        name = threading.current_thread().name
+        self.by_thread[name] = self.by_thread.get(name, 0) + 1
 
     def __enter__(self):
         import jax
@@ -376,7 +390,7 @@ class transfer_tap:
 
         def put(x, *a, **kw):
             if getattr(tls, "depth", 0) == 0 and _any_np(x, np):
-                outer.h2d += 1
+                outer._note()
                 _metric_inc("h2d_transfers")
                 t0 = time.perf_counter()
                 try:
@@ -388,7 +402,7 @@ class transfer_tap:
         def asarray(x, *a, **kw):
             timed = isinstance(x, np.ndarray)
             if timed:
-                outer.h2d += 1
+                outer._note()
                 _metric_inc("h2d_transfers")
                 t0 = time.perf_counter()
             tls.depth = getattr(tls, "depth", 0) + 1

@@ -639,7 +639,42 @@ def witness_crosscheck(package_dir: Path, report_path: Path) -> PassResult:
             )
         )
 
+    # ingest post-stream tail functions that legitimately convert on the
+    # caller's thread — they run once AFTER the pipeline drained, where
+    # a boundary feed cannot stall decode (named functions on purpose so
+    # this exemption is exact; see trainer/ingest.py)
+    _INGEST_TAIL_FNS = {"_ragged_tail", "_eval_holdout"}
+
     for t in data.get("transfers", []):
+        # the ingest packing thread must never dispatch device work
+        # itself (ISSUE 15): every per-superbatch H2D lives on the
+        # dedicated transfer/step stage threads so the decode pipeline
+        # never stalls behind the device link. Keyed on the RECORDED
+        # THREAD (transfers carry it since this rule landed), not the
+        # frame name: a regression that moves `put(arg)` back into the
+        # packing loop still attributes to the `put` closure's frame,
+        # but its thread is the caller's, not trainer.ingest-*.
+        if (
+            t.get("file", "") == "dragonfly2_tpu/trainer/ingest.py"
+            and t.get("fn", "") not in _INGEST_TAIL_FNS
+            and not str(t.get("thread", "")).startswith("trainer.ingest-")
+        ):
+            findings.append(
+                Finding(
+                    WITNESS_ID,
+                    f"pack-transfer:{t.get('fn', '?')}:{t.get('target', '?')}",
+                    t.get("file", ""),
+                    int(t.get("line", 0)),
+                    f"host→device transfer ({t.get('target', '?')}) witnessed"
+                    f" outside the ingest stage threads"
+                    f" (fn {t.get('fn', '?')}, thread {t.get('thread', '?')},"
+                    f" {t.get('count', 1)}× recorded) — the device leg"
+                    " belongs on the trainer.ingest-transfer/-step stages;"
+                    " a put on the packing thread stalls decode behind the"
+                    " device link",
+                )
+            )
+            continue
         if t.get("explicit"):
             continue
         file = t.get("file", "")

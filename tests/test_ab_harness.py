@@ -42,6 +42,28 @@ def test_ml_evaluator_beats_default_p50(tmp_path):
     assert last["p50_ml_ms"] < last["p50_default_ms"], last
 
 
+def test_phase2_rides_batched_scoring_service_numpy(tmp_path):
+    """ISSUE 15 satellite (ROADMAP item 1's A/B leftover): the harness's
+    ml phase drives the BATCHED scoring service — here with the numpy
+    scorer, so tier-1 exercises the full submit/pack/score/return
+    machinery without an XLA dispatch. The p50 quality gates stay with
+    the slow tests; this pins the serve-path plumbing: the service must
+    have scored real batches, and run_ab must fail loudly if phase 2
+    silently fell back to the per-call rung (asserted inside run_ab)."""
+    cfg = ABConfig(
+        n_daemons=4,
+        n_slow=2,
+        n_tasks=2,
+        pieces_per_task=2,
+        serving_backend="numpy",
+    )
+    out = run_ab(cfg, workdir=str(tmp_path))
+    assert out["serving_backend"] == "numpy"
+    assert out["serving_batches"] > 0
+    assert out["serving_rows_scored"] > 0
+    assert out["pieces_default"] == out["pieces_ml"] > 0
+
+
 @pytest.mark.slow
 def test_gru_bad_node_beats_statistics_on_degrading_parent(tmp_path):
     """Round-4 verdict #6: the GRU-attributable scenario. Both arms share

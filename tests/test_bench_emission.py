@@ -90,6 +90,18 @@ def _fake_serving_bench():
     }
 
 
+def _fake_multichip_bench():
+    # the real curve spawns 4 fresh-interpreter subprocesses (~1 min);
+    # emission tests only assert the KEYS ride the artifact — the
+    # harness itself is covered by tests/test_multichip_ingest.py
+    return {
+        "multichip_scaling": {"1": 40000.0, "2": 21000.0, "4": 11000.0, "8": 6000.0},
+        "multichip_platform": "cpu-forced-host-devices",
+        "mesh_h2d_per_shard": 1.0,
+        "mesh_pack_thread_transfers": 0,
+    }
+
+
 def _fake_data_plane_bench():
     # the real race holds 2×256 live sockets for ~10s; emission tests
     # only assert the KEYS ride the artifact — the race itself is
@@ -112,6 +124,7 @@ def _run_main(monkeypatch, capfd, fit_stub):
     monkeypatch.setattr(bench, "fleet_shard_kill_bench", _fake_fleet_soak)
     monkeypatch.setattr(bench, "serving_bench", _fake_serving_bench)
     monkeypatch.setattr(bench, "data_plane_bench", _fake_data_plane_bench)
+    monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", fit_stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     monkeypatch.delenv("DF_BENCH_CPU_FALLBACK", raising=False)
@@ -457,6 +470,7 @@ def test_chaos_soak_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "chaos_soak_bench", broken_soak)
     monkeypatch.setattr(bench, "fleet_shard_kill_bench", _fake_fleet_soak)
     monkeypatch.setattr(bench, "serving_bench", _fake_serving_bench)
+    monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -484,6 +498,7 @@ def test_fleet_soak_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "chaos_soak_bench", _fake_chaos_soak)
     monkeypatch.setattr(bench, "fleet_shard_kill_bench", broken_fleet)
     monkeypatch.setattr(bench, "serving_bench", _fake_serving_bench)
+    monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -528,6 +543,70 @@ def test_jit_hygiene_bench_steady_state():
     out = bench.jit_hygiene_bench(batch=256, steps_per_call=2, superbatches=3)
     assert out["jit_recompiles_per_fit"] == 0
     assert out["h2d_transfers_per_superbatch"] == 1.0
+
+
+def test_emits_multichip_scaling_and_overlap_keys(monkeypatch, capfd):
+    """ISSUE 15: the artifact carries the standing dp=1/2/4/8 scaling
+    curve (honestly platform-labeled), the sharded-put witness gates,
+    and the h2d_overlap_pct of the best timed run — plus the full
+    per-split device-leg attribution inside run_details."""
+
+    def stub(paths, **kw):
+        s = _stats(1000)
+        s.h2d_s = 0.5
+        s.h2d_overlap_s = 0.4
+        s.step_s = 2.0
+        return None, s
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "multichip_error" not in rec
+    assert set(rec["multichip_scaling"]) == {"1", "2", "4", "8"}
+    assert rec["multichip_platform"] == "cpu-forced-host-devices"
+    assert rec["mesh_h2d_per_shard"] == 1.0
+    assert rec["mesh_pack_thread_transfers"] == 0
+    assert rec["h2d_overlap_pct"] == 80.0
+    for detail in rec["run_details"]:
+        assert {"h2d_s", "h2d_overlap_s", "step_s"} <= set(detail)
+
+
+def test_multichip_keys_survive_warmup_failure(monkeypatch, capfd):
+    """host_rates (the multichip curve included) ride every exit path —
+    a dead device link must not discard the standing scaling curve."""
+
+    def stub(paths, **kw):
+        raise RuntimeError("link died in compile")
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "warmup fit failed" in rec["error"]
+    assert set(rec["multichip_scaling"]) == {"1", "2", "4", "8"}
+
+
+def test_multichip_bench_failure_rides_exit_path(monkeypatch, capfd):
+    """A multichip curve that can't run (no subprocess spawn in a
+    sandbox) must degrade to a ``multichip_error`` key on the one JSON
+    line, leaving its siblings intact."""
+
+    def stub(paths, **kw):
+        return None, _stats(1000)
+
+    def broken_multichip():
+        raise RuntimeError("spawn blocked by sandbox")
+
+    monkeypatch.setattr(bench, "synthesize_dataset", _fake_synthesize)
+    monkeypatch.setattr(bench, "synthesize_dataset_binary", _fake_synthesize_binary)
+    monkeypatch.setattr(bench, "chaos_soak_bench", _fake_chaos_soak)
+    monkeypatch.setattr(bench, "fleet_shard_kill_bench", _fake_fleet_soak)
+    monkeypatch.setattr(bench, "serving_bench", _fake_serving_bench)
+    monkeypatch.setattr(bench, "data_plane_bench", _fake_data_plane_bench)
+    monkeypatch.setattr(bench, "multichip_scaling_bench", broken_multichip)
+    monkeypatch.setattr(ingest, "stream_train_mlp", stub)
+    monkeypatch.setenv("DF_BENCH_REPEATS", "3")
+    bench.main()
+    lines = [l for l in capfd.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert "spawn blocked" in rec["multichip_error"]
+    assert rec["chaos_success_rate"] == 1.0  # siblings still ran
 
 
 def test_emits_telemetry_overhead(monkeypatch, capfd):

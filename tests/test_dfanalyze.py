@@ -807,6 +807,65 @@ ranked = jax.jit(wfz_fn)
     assert "transfer:fakepkg/plane.py:rank" in keys
 
 
+def test_jitwitness_crosscheck_flags_packing_thread_transfer(fakepkg, tmp_path):
+    """ISSUE 15 gate: an ingest.py transfer on any thread OTHER than the
+    trainer.ingest-* stages fails the crosscheck regardless of
+    explicitness or frame name — notably the realistic regression where
+    `put(arg)` moves back into the packing loop (fn is still "put", but
+    the thread is the caller's). The sanctioned stage threads and the
+    named post-stream tail functions stay clean."""
+    dump = {
+        "compiles": {},
+        "wrapper_sites": [],
+        "transfers": [
+            {  # inline device work in the packing body
+                "file": "dragonfly2_tpu/trainer/ingest.py",
+                "fn": "stream_train_mlp",
+                "line": 700,
+                "target": "device_put",
+                "explicit": True,
+                "thread": "MainThread",
+                "count": 3,
+            },
+            {  # the realistic regression: put() called from the packer
+                "file": "dragonfly2_tpu/trainer/ingest.py",
+                "fn": "put",
+                "line": 544,
+                "target": "device_put",
+                "explicit": True,
+                "thread": "trainer.fit",
+                "count": 7,
+            },
+            {  # the transfer stage's put: sanctioned
+                "file": "dragonfly2_tpu/trainer/ingest.py",
+                "fn": "put",
+                "line": 544,
+                "target": "device_put",
+                "explicit": True,
+                "thread": "trainer.ingest-transfer",
+                "count": 100,
+            },
+            {  # the named post-stream tail: sanctioned
+                "file": "dragonfly2_tpu/trainer/ingest.py",
+                "fn": "_ragged_tail",
+                "line": 890,
+                "target": "device_put",
+                "explicit": True,
+                "thread": "MainThread",
+                "count": 1,
+            },
+        ],
+    }
+    report = tmp_path / "jit-witness.json"
+    report.write_text(json.dumps(dump))
+    res = jaxhygiene.witness_crosscheck(fakepkg, report)
+    keys = {f.key for f in res.findings}
+    assert keys == {
+        "pack-transfer:stream_train_mlp:device_put",
+        "pack-transfer:put:device_put",
+    }, [f.message for f in res.findings]
+
+
 def test_jitwitness_crosscheck_ignores_foreign_and_quiet_functions(
     fakepkg, tmp_path
 ):

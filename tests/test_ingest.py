@@ -413,11 +413,15 @@ def test_steps_per_call_matches_single_step_math(tmp_path):
         )
 
 
+_STAGE_THREADS = ("trainer.ingest-transfer", "trainer.ingest-step")
+
+
 def test_dispatcher_thread_joined_on_producer_error(tmp_path, monkeypatch):
     """An exception raised out of the packing loop (producer decode
-    failure) must still shut the dispatcher thread down via the sentinel
-    + join handshake — the trainer service calls stream_train_mlp every
-    round, so a leaked 'trainer.ingest-dispatch' thread accumulates."""
+    failure) must still shut BOTH device-leg stage threads down via the
+    sentinel + join handshake — the trainer service calls
+    stream_train_mlp every round, so a leaked 'trainer.ingest-transfer'
+    or 'trainer.ingest-step' thread accumulates."""
     import dragonfly2_tpu.schema.native as N
     from dragonfly2_tpu.trainer.ingest import stream_train_mlp
 
@@ -426,7 +430,7 @@ def test_dispatcher_thread_joined_on_producer_error(tmp_path, monkeypatch):
 
     def _dispatcher_alive():
         return any(
-            t.name == "trainer.ingest-dispatch" and t.is_alive()
+            t.name in _STAGE_THREADS and t.is_alive()
             for t in threading.enumerate()
         )
 
@@ -453,12 +457,10 @@ def test_dispatcher_thread_joined_on_producer_error(tmp_path, monkeypatch):
     with pytest.raises(RuntimeError, match="decode failed"):
         stream_train_mlp(p, passes=50, batch_size=16, eval_every=0)
     deadline = time.time() + 5.0
-    while time.time() < deadline and any(
-        t.name == "trainer.ingest-dispatch" and t.is_alive() for t in threading.enumerate()
-    ):
+    while time.time() < deadline and _dispatcher_alive():
         time.sleep(0.05)
     leaked = [
         t.name for t in threading.enumerate()
-        if t.name == "trainer.ingest-dispatch" and t.is_alive()
+        if t.name in _STAGE_THREADS and t.is_alive()
     ]
-    assert not leaked, f"dispatcher thread leaked: {leaked}"
+    assert not leaked, f"stage threads leaked: {leaked}"

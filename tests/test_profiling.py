@@ -456,8 +456,8 @@ class TestStallDumpWindow:
         prof_section = meta.get("profile")
         assert prof_section, "dump carries no dfprof window"
         assert prof_section["window_s"] > 0
-        # the hot frame: the dispatcher thread wedged inside its loop
-        assert "trainer.ingest._dispatch_loop" in prof_section["collapsed"], (
+        # the hot frame: the step-stage thread wedged inside its loop
+        assert "trainer.ingest._step_loop" in prof_section["collapsed"], (
             prof_section["collapsed"]
         )
         # the ledger rode along with the live ingest legs accounted
