@@ -412,6 +412,36 @@ def serving_bench() -> dict:
     }
 
 
+def wave_bench() -> dict:
+    """The wave-scheduling soak (tools/stress.wave_soak) at bench
+    scale: 16 concurrent simulated peers push decisions through the
+    scoring service wave-packed (W decisions per fused dispatch) vs
+    per-op-batched, same model both arms (the device-resident wave
+    acceptance, re-proven on every bench run).
+
+    - ``wave_decisions_per_s`` / ``wave_decisions_per_s_per_op``:
+      aggregate decisions/sec per arm — wave-packed must be strictly
+      greater.
+    - ``wave_occupancy_rows``: candidate rows (Σ wave sizes) per scored
+      wave batch.
+    - ``wave_unpack_p99_us``: segment-rank unpack tail per wave request.
+    - ``wave_rankings_match``: 1 when wave rankings crosschecked
+      bit-identical to the per-peer path.
+    """
+    from dragonfly2_tpu.tools.stress import wave_soak
+
+    out = wave_soak(peers=16, decisions_per_peer=12, wave_width=8)
+    return {
+        "wave_decisions_per_s": out["wave_decisions_per_s"],
+        "wave_decisions_per_s_per_op": out["wave_decisions_per_s_per_op"],
+        "wave_occupancy_rows": out["wave_occupancy_rows"],
+        "wave_unpack_p99_us": out["wave_unpack_p99_us"],
+        "wave_rankings_match": out["wave_rankings_match"],
+        "wave_lost": out["wave_lost"],
+        "serving_backend": out["serving_backend"],
+    }
+
+
 def fleet_shard_kill_bench() -> dict:
     """The scheduler-fleet failover soak (tools/stress.shard_kill_soak)
     at bench scale: 3 real scheduler shards under KV leases, a
@@ -1005,6 +1035,21 @@ def main() -> None:
         except Exception as e:
             host_rates["serving_error"] = str(e)
             _phase(f"serving bench failed: {e}")
+        # wave-scheduling soak rides host_rates the same way: wave-packed
+        # vs per-op-batched decisions/sec, wave occupancy rows, and the
+        # segment-unpack p99 land in the artifact on every exit path
+        try:
+            host_rates.update(wave_bench())
+            _phase(
+                f"wave: {host_rates['wave_decisions_per_s']:.0f} decisions/s"
+                f" packed vs {host_rates['wave_decisions_per_s_per_op']:.0f}"
+                f" per-op, occupancy"
+                f" {host_rates['wave_occupancy_rows']:.1f} rows/wave,"
+                f" unpack p99 {host_rates['wave_unpack_p99_us']:.1f}us"
+            )
+        except Exception as e:
+            host_rates["wave_error"] = str(e)
+            _phase(f"wave bench failed: {e}")
         # data-plane race: sendfile vs buffered piece serving under
         # hundreds of concurrent children — throughput per arm, the p99
         # serve tail, and daemon RSS ride every exit path

@@ -26,6 +26,7 @@ import numpy as np
 
 from dragonfly2_tpu.rpc import resilience
 from dragonfly2_tpu.scheduler import metrics as M
+from dragonfly2_tpu.scheduler import wave as wavelib
 from dragonfly2_tpu.scheduler.serving import ServingUnsupported
 from dragonfly2_tpu.schema.features import (
     MLP_FEATURE_DIM,
@@ -101,6 +102,13 @@ class Evaluator(Protocol):
     def evaluate_parents(
         self, parents: list[Peer], child: Peer, total_piece_count: int
     ) -> list[Peer]: ...
+
+    def evaluate_wave(
+        self,
+        children: "list[Peer]",
+        candidate_sets: "list[list[Peer]]",
+        total_piece_counts: "list[int]",
+    ) -> "list[list[Peer]]": ...
 
     def is_bad_node(self, peer: Peer) -> bool: ...
 
@@ -184,6 +192,20 @@ class BaseEvaluator:
             key=lambda p: self.evaluate(p, child, total_piece_count),
             reverse=True,
         )
+
+    def evaluate_wave(
+        self,
+        children: "list[Peer]",
+        candidate_sets: "list[list[Peer]]",
+        total_piece_counts: "list[int]",
+    ) -> "list[list[Peer]]":
+        """Rank each decision's candidate set. The base score has no
+        batch dispatch to amortize, so the wave is just the per-decision
+        loop — the API exists so wave callers degrade uniformly."""
+        return [
+            self.evaluate_parents(ps, c, t)
+            for c, ps, t in zip(children, candidate_sets, total_piece_counts)
+        ]
 
     def is_bad_node(self, peer: Peer) -> bool:
         if peer.fsm.is_state(*_BAD_STATES):
@@ -347,76 +369,115 @@ class MLEvaluator(BaseEvaluator):
     def evaluate_parents(
         self, parents: list[Peer], child: Peer, total_piece_count: int
     ) -> list[Peer]:
+        # the degenerate W=1 wave: per-peer and wave rankings are
+        # bit-identical BY CONSTRUCTION — one code path, not two kept
+        # in sync (the wave tests still pin the equality)
+        return self.evaluate_wave([child], [parents], [total_piece_count])[0]
+
+    def evaluate_wave(
+        self,
+        children: "list[Peer]",
+        candidate_sets: "list[list[Peer]]",
+        total_piece_counts: "list[int]",
+    ) -> "list[list[Peer]]":
+        """Rank W decisions' candidate sets in ONE fused dispatch: the
+        feature join packs every (child, candidate) pair into a single
+        rung-padded ``(rows, F)`` matrix (rtt_affinity gathered from the
+        HBM adjacency in one kernel, not per-pair lock round-trips), the
+        scoring service scores it as one batch, and per-decision
+        rankings come back as a segment-grouped index permutation — no
+        per-child host sort of C floats. The GNN → MLP → Base ladder
+        applies PER DECISION: one unembeddable host inside a wave drops
+        only that decision a rung."""
+        W = len(children)
+        if W == 0:
+            return []
         serving = self._serving
         serving_up = serving is not None and serving.available()
-        if (self._model is None and not serving_up) or not parents:
-            if self._model is None and not serving_up:
-                self._note_rung("base", "no model loaded; base evaluator ranking")
-            return super().evaluate_parents(parents, child, total_piece_count)
-        try:
-            if self._topology is not None:
-                # one span over the whole batch of per-pair engine
-                # lookups (a span per pair would dominate the hot path)
-                with tracing.maybe_span(
-                    "scheduler", "topology.rtt_affinity", pairs=len(parents)
-                ):
-                    with PH_TOPOLOGY_RTT:
-                        rtts = [self._rtt_affinity(p, child) for p in parents]
-            else:
-                rtts = [0.0] * len(parents)
-            # one vectorized location-affinity call for the whole
-            # candidate set: the per-pair form built two 1-element
-            # string arrays per parent per schedule op, which the
-            # numpy-fallback path pays on every decision
-            loc_aff = offline_location_affinity(
-                np.array([child.host.network.location] * len(parents)),
-                np.array([p.host.network.location for p in parents]),
-            )
-            feats = np.stack(
-                [
-                    pair_features(
-                        p, child, total_piece_count, rtt, loc_affinity=float(la)
+        if self._model is None and not serving_up:
+            self._note_rung("base", "no model loaded; base evaluator ranking")
+            base_rank = super().evaluate_parents
+            return [
+                base_rank(ps, c, t)
+                for c, ps, t in zip(children, candidate_sets, total_piece_counts)
+            ]
+        counts = [len(ps) for ps in candidate_sets]
+        live = [j for j in range(W) if counts[j] > 0]
+        results: "list" = [[] for _ in range(W)]
+        if not live:
+            return results
+        with wavelib.PH_WAVE_PACK:
+            try:
+                feats, pairs = self._pack_wave(
+                    children, candidate_sets, total_piece_counts
+                )
+            except Exception:
+                # feature build failed: no rung can rank — base, visibly
+                logger.warning(
+                    "wave feature build failed; using base ranking",
+                    exc_info=True,
+                )
+                self._note_rung(
+                    "base", "feature build failed; base evaluator ranking"
+                )
+                base_rank = super().evaluate_parents
+                for j in live:
+                    results[j] = base_rank(
+                        candidate_sets[j], children[j], total_piece_counts[j]
                     )
-                    for p, rtt, la in zip(parents, rtts, loc_aff)
-                ]
-            )
-        except Exception:
-            # feature build failed: no rung can rank — base, visibly
-            logger.warning(
-                "ml evaluator feature build failed; using base ranking",
-                exc_info=True,
-            )
-            self._note_rung("base", "feature build failed; base evaluator ranking")
-            return super().evaluate_parents(parents, child, total_piece_count)
+                return results
+        live_counts = [counts[j] for j in live]
+        # offsets of each live decision's rows in the packed matrix
+        offs = np.concatenate(([0], np.cumsum(live_counts)))
 
-        # the degradation ladder: batched serving (GNN or resident MLP)
-        # → per-call MLP → Base, each rung absorbing the one above it
-        costs = None
-        per_request = False  # this DECISION skipped serving, not the service
+        # the degradation ladder, PER DECISION: batched serving (GNN or
+        # resident MLP) → per-call MLP → Base. ``scored[i]`` is the
+        # (costs, ranking) pair for live decision i, or None while a
+        # lower rung still owes it a ranking.
+        scored: "list" = [None] * len(live)
+        per_request = False  # decisions skipped serving, not the service
         if serving_up:
             try:
-                costs = serving.score(
-                    feats,
-                    pairs=[(child.host.id, p.host.id) for p in parents],
-                    budget_s=resilience.remaining_budget_s(),
-                )
+                with wavelib.PH_WAVE_SCORE:
+                    scored = serving.score_wave(
+                        feats,
+                        pairs,
+                        live_counts,
+                        budget_s=resilience.remaining_budget_s(),
+                    )
                 self._note_rung("serving", None)
+                if any(r is None for r in scored):
+                    # the served GNN couldn't embed SOME decisions'
+                    # hosts: those drop a rung per-request (the service
+                    # itself is healthy — no ladder flip)
+                    per_request = True
             except ServingUnsupported as e:
-                # a candidate host the served model can't embed: score
-                # THIS decision a rung down without flipping the
+                # NO decision in the wave can take the served model:
+                # score the wave a rung down without flipping the
                 # service-level ladder state — a brand-new host would
                 # otherwise flap the edge detector at decision rate
                 # until the next swap embeds it
                 per_request = True
-                logger.debug("serving cannot take this decision (%s)", e)
+                logger.debug("serving cannot take this wave (%s)", e)
             except Exception as e:
                 # expected under faults: one debug line, the
                 # edge-triggered rung change is the operator signal
-                logger.debug("serving score failed (%s); dropping a rung", e)
-        if costs is None and self._model is not None:
+                logger.debug("serving wave score failed (%s); dropping a rung", e)
+        demoted = [i for i, r in enumerate(scored) if r is None]
+        served_any = len(demoted) < len(live)
+        if demoted and self._model is not None:
             try:
-                costs = self._model.predict(feats)  # [P] predicted log cost
-                if not per_request:
+                dem_counts = [live_counts[i] for i in demoted]
+                dem_feats = np.concatenate(
+                    [feats[offs[i] : offs[i + 1]] for i in demoted]
+                )
+                dem_costs = np.asarray(self._model.predict(dem_feats))
+                dem_orders = wavelib.rank_segments(dem_costs, dem_counts)
+                off = 0
+                for i, c, rk in zip(demoted, dem_counts, dem_orders):
+                    scored[i] = (dem_costs[off : off + c], rk)
+                    off += c
+                if not per_request and not served_any:
                     self._note_rung(
                         "mlp",
                         "serving unavailable; per-call mlp ranking"
@@ -430,35 +491,118 @@ class MLEvaluator(BaseEvaluator):
                     "ml evaluator predict failed; using base ranking",
                     exc_info=True,
                 )
-        if costs is None:
-            if not per_request:
-                self._note_rung(
-                    "base", "ml predict failed; base evaluator ranking"
+        if any(r is None for r in scored) and not per_request and not served_any:
+            self._note_rung("base", "ml predict failed; base evaluator ranking")
+
+        sampled = tracing.is_sampling() or flight.dump_armed()
+        base_rank = super().evaluate_parents
+        for i, j in enumerate(live):
+            ps = candidate_sets[j]
+            if scored[i] is None:
+                results[j] = base_rank(ps, children[j], total_piece_counts[j])
+                continue
+            costs, order = scored[i]
+            results[j] = [ps[int(k)] for k in order]
+            if flight.enabled():
+                # per-decision explain event. The top-k payload (scores
+                # + the full feature rows the model saw, schema order,
+                # rtt_affinity last) is built ONLY when this decision's
+                # trace is sampled or a flight dump is armed — at wave
+                # rate the W×k list builds would dominate the pack.
+                sub = feats[offs[i] : offs[i + 1]]
+                EV_EXPLAIN(
+                    peer_id=children[j].id,
+                    task_id=children[j].task.id,
+                    candidates=len(ps),
+                    feature_dim=int(sub.shape[1]),
+                    rung=self._rung,
+                    top=[
+                        {
+                            "parent_id": ps[int(k)].id,
+                            "predicted_log_cost": round(float(costs[int(k)]), 6),
+                            "rtt_affinity": round(float(sub[int(k), -1]), 6),
+                            "features": [round(float(v), 5) for v in sub[int(k)]],
+                        }
+                        for k in order[:EXPLAIN_TOP_K]
+                    ]
+                    if sampled
+                    else [],
                 )
-            return super().evaluate_parents(parents, child, total_piece_count)
-        order = np.argsort(costs, kind="stable")
-        if flight.enabled():
-            # top-k explain event: scores + the full feature rows the
-            # model saw (schema order, rtt_affinity last). Guarded so
-            # DF_FLIGHT=0 pays one predicate; the list build is tiny
-            # next to the predict() dispatch above.
-            EV_EXPLAIN(
-                peer_id=child.id,
-                task_id=child.task.id,
-                candidates=len(parents),
-                feature_dim=int(feats.shape[1]),
-                rung=self._rung,
-                top=[
-                    {
-                        "parent_id": parents[int(i)].id,
-                        "predicted_log_cost": round(float(costs[int(i)]), 6),
-                        "rtt_affinity": round(float(feats[int(i), -1]), 6),
-                        "features": [round(float(v), 5) for v in feats[int(i)]],
-                    }
-                    for i in order[:EXPLAIN_TOP_K]
-                ],
-            )
-        return [parents[int(i)] for i in order]
+        wavelib.EV_WAVE(
+            decisions=W,
+            rows=int(feats.shape[0]),
+            demoted=len(demoted),
+            rung=self._rung,
+        )
+        return results
+
+    def _pack_wave(self, children, candidate_sets, total_piece_counts):
+        """The on-device feature join: flatten the wave's (child,
+        candidate) pairs, gather ``rtt_affinity`` for ALL of them in one
+        rung-padded kernel dispatch, vectorize ``location_affinity``
+        over the whole wave, then assemble the schema-ordered feature
+        rows. Returns ``(feats [rows, F], pairs [(child, parent) ids])``
+        with rows in decision order."""
+        src, dst = [], []
+        child_locs, parent_locs = [], []
+        for c, ps in zip(children, candidate_sets):
+            for p in ps:
+                src.append(c.host.id)
+                dst.append(p.host.id)
+                child_locs.append(c.host.network.location)
+                parent_locs.append(p.host.network.location)
+        rtts = self._wave_rtt(src, dst)
+        # one vectorized location-affinity call for the whole wave: the
+        # per-pair form built two 1-element string arrays per candidate
+        # per schedule op, which the numpy-fallback path paid per decision
+        loc_aff = offline_location_affinity(
+            np.array(child_locs), np.array(parent_locs)
+        )
+        rows = []
+        k = 0
+        for c, ps, t in zip(children, candidate_sets, total_piece_counts):
+            for p in ps:
+                rows.append(
+                    pair_features(
+                        p, c, t, float(rtts[k]), loc_affinity=float(loc_aff[k])
+                    )
+                )
+                k += 1
+        return np.stack(rows), list(zip(src, dst))
+
+    def _wave_rtt(self, src: "list[str]", dst: "list[str]") -> np.ndarray:
+        """[N] child→parent host-id pairs → [N] rtt_affinity in one
+        engine batch (one lock hold + one HBM gather), never fatal: an
+        engine hiccup degrades the feature to its missing-value, not the
+        schedule. Stub topologies without the batch join fall back to
+        the scalar per-pair lookup."""
+        if self._topology is None or not src:
+            return np.zeros(len(src), np.float32)
+        # one span over the whole wave of engine lookups (a span per
+        # pair would dominate the hot path)
+        with tracing.maybe_span(
+            "scheduler", "topology.rtt_affinity", pairs=len(src)
+        ):
+            with PH_TOPOLOGY_RTT:
+                batch = getattr(self._topology, "rtt_affinity_pairs", None)
+                if batch is not None:
+                    try:
+                        return np.asarray(batch(src, dst), np.float32)
+                    except Exception:
+                        logger.warning(
+                            "topology rtt_affinity_pairs failed;"
+                            " per-pair fallback",
+                            exc_info=True,
+                        )
+                out = np.zeros(len(src), np.float32)
+                for i, (s, d) in enumerate(zip(src, dst)):
+                    try:
+                        out[i] = self._topology.rtt_affinity(s, d)
+                    except Exception:
+                        logger.warning(
+                            "topology rtt_affinity failed", exc_info=True
+                        )
+                return out
 
 
 def pair_features(

@@ -48,6 +48,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from dragonfly2_tpu.scheduler import metrics as M
+from dragonfly2_tpu.scheduler import wave as wavelib
 from dragonfly2_tpu.trainer.serving import bucket_rows  # noqa: F401 (re-export)
 from dragonfly2_tpu.utils import dflog, faults, flight, profiling
 
@@ -122,6 +123,17 @@ class MLPServed:
     def score(self, features: np.ndarray, pairs) -> np.ndarray:
         return np.asarray(self._scorer.predict(features))
 
+    def score_ranked(self, features: np.ndarray, pairs, seg_ids):
+        """(scores, segment-grouped rank permutation) for a packed wave
+        batch. Fused on device when the scorer has ``predict_ranked``
+        (MLPScorer/NumpyMLPScorer); otherwise one forward plus one host
+        lexsort — same contract, same orders."""
+        pr = getattr(self._scorer, "predict_ranked", None)
+        if pr is not None:
+            return pr(features, seg_ids)
+        scores = self.score(features, pairs)
+        return scores, wavelib.rank_order(scores, seg_ids)
+
 
 class GNNServed:
     """Host-pair rung: ranks (child → parent) pairs by GNN-predicted
@@ -146,14 +158,20 @@ class GNNServed:
         dst = [b for _, b in pairs]
         return np.asarray(self._scorer.predict_rtt_log_ms(src, dst))
 
+    def score_ranked(self, features: np.ndarray, pairs, seg_ids):
+        # the GNN head returns host scores (index-vector dispatch); the
+        # wave unpack is the vectorized host lexsort
+        scores = self.score(features, pairs)
+        return scores, wavelib.rank_order(scores, seg_ids)
+
 
 class _Request:
     __slots__ = (
         "features", "pairs", "rows", "done", "scores", "error",
-        "t_submit", "abandoned",
+        "t_submit", "abandoned", "counts", "rankings",
     )
 
-    def __init__(self, features: np.ndarray, pairs):
+    def __init__(self, features: np.ndarray, pairs, counts=None):
         self.features = features
         self.pairs = pairs
         self.rows = features.shape[0]
@@ -161,6 +179,11 @@ class _Request:
         self.scores = None
         self.error: "Exception | None" = None
         self.t_submit = time.perf_counter()
+        # wave request: per-decision candidate counts (Σ counts == rows)
+        # — the batch loop ranks each decision's segment and hands back
+        # per-decision index orders alongside the flat scores
+        self.counts: "list[int] | None" = counts
+        self.rankings: "list[np.ndarray] | None" = None
         # set by a caller whose wait timed out: the serving thread skips
         # abandoned requests at pack time — the caller already re-scored
         # those rows a rung down, and burning batch capacity on results
@@ -188,6 +211,11 @@ class ScoringService:
         # bench/stress without walking the Prometheus registry
         self.batches = 0
         self.rows_scored = 0
+        self.waves = 0
+        self.wave_rows = 0
+        # recent per-wave unpack walls (µs) for bench percentiles;
+        # bounded so a long soak never grows it past two pages
+        self.wave_unpack_us: "list[float]" = []
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -296,7 +324,118 @@ class ScoringService:
             raise ServingError(str(req.error)) from req.error
         return req.scores
 
+    def score_wave(
+        self,
+        features: np.ndarray,
+        pairs,
+        counts,
+        budget_s: "float | None" = None,
+    ) -> "list":
+        """Packed wave: [R, F] rows for W decisions whose per-decision
+        candidate counts are ``counts`` (Σ counts == R) → a W-long list
+        of ``(scores_j, ranking_j)`` — scores_j the decision's flat cost
+        slice, ranking_j its stable ascending candidate order as INDICES
+        (``wave.rank_segments`` contract). An entry is ``None`` when the
+        served GNN cannot embed that decision's hosts: that decision
+        alone drops a rung, the rest of the wave still packs. Raises
+        :class:`ServingUnsupported` only when NO decision is servable,
+        :class:`ServingError` on service failure — same ladder semantics
+        as :meth:`score`."""
+        served = self._served
+        if served is None or not self.running():
+            raise ServingError("scoring service has no model installed")
+        model = served[0]
+        counts = [int(c) for c in counts]
+        features = np.asarray(features, np.float32)
+        dropped: "list[int]" = []
+        kept = list(range(len(counts)))
+        eff_counts = counts
+        if model.kind == "gnn":
+            # per-decision support BEFORE queueing: one unembeddable
+            # host inside a wave drops only that decision a rung
+            kept, dropped = [], []
+            sub_feats, sub_pairs, sub_counts = [], [], []
+            off = 0
+            for j, c in enumerate(counts):
+                p = pairs[off : off + c]
+                if model.supports(p):
+                    kept.append(j)
+                    sub_feats.append(features[off : off + c])
+                    sub_pairs.extend(p)
+                    sub_counts.append(c)
+                else:
+                    dropped.append(j)
+                off += c
+            if not kept:
+                raise ServingUnsupported(
+                    "gnn cannot embed any decision in this wave"
+                )
+            if dropped:
+                features = np.concatenate(sub_feats)
+                pairs = sub_pairs
+                eff_counts = sub_counts
+        cfg = self.cfg
+        if budget_s is not None and budget_s <= cfg.window_s + cfg.immediate_floor_s:
+            M.WAVE_DECISIONS_TOTAL.labels("immediate").inc(len(eff_counts))
+            out = self._wave_now(model, features, pairs, eff_counts)
+        else:
+            req = _Request(features, pairs, counts=eff_counts)
+            try:
+                self._queue.put_nowait(req)
+            except queue.Full:
+                M.WAVE_DECISIONS_TOTAL.labels("overflow").inc(len(eff_counts))
+                out = self._wave_now(model, features, pairs, eff_counts)
+            else:
+                M.WAVE_DECISIONS_TOTAL.labels("batched").inc(len(eff_counts))
+                wait_s = cfg.window_s + cfg.service_grace_s
+                if budget_s is not None:
+                    wait_s = min(
+                        wait_s, max(budget_s - cfg.immediate_floor_s / 2, 0.001)
+                    )
+                if not req.done.wait(timeout=wait_s):
+                    req.abandoned = True
+                    raise ServingError(
+                        f"serving did not answer within {wait_s:.3f}s"
+                    )
+                PH_SERVING_WAIT.observe(time.perf_counter() - req.t_submit)
+                if req.error is not None:
+                    if isinstance(req.error, ServingError):
+                        raise req.error
+                    raise ServingError(str(req.error)) from req.error
+                out = []
+                off = 0
+                for c, rk in zip(eff_counts, req.rankings):
+                    out.append((req.scores[off : off + c], rk))
+                    off += c
+        if not dropped:
+            return out
+        full: "list" = [None] * len(counts)
+        for j, res in zip(kept, out):
+            full[j] = res
+        return full
+
     # -- internals -----------------------------------------------------
+    def _wave_now(self, model, features, pairs, counts) -> "list":
+        """Immediate/overflow escape for a wave: one bucketed forward,
+        host segment rank — same orders as the fused path."""
+        scores = self._score_now(model, features, pairs)
+        t0 = time.perf_counter()
+        rankings = wavelib.rank_segments(scores, counts)
+        self._note_unpack(time.perf_counter() - t0)
+        out = []
+        off = 0
+        for c, rk in zip(counts, rankings):
+            out.append((scores[off : off + c], rk))
+            off += c
+        return out
+
+    def _note_unpack(self, dt_s: float) -> None:
+        M.WAVE_UNPACK_SECONDS.observe(dt_s)
+        us = self.wave_unpack_us
+        us.append(dt_s * 1e6)
+        if len(us) > 4096:
+            del us[:2048]
+
     def _score_now(self, model, features, pairs) -> np.ndarray:
         FP_SCORE()
         scores = model.score(np.asarray(features, np.float32), pairs)
@@ -378,6 +517,7 @@ class ScoringService:
                 if not batch:
                     return
                 rows = sum(r.rows for r in batch)
+            has_wave = any(r.counts is not None for r in batch)
             try:
                 FP_SCORE()
                 if len(batch) == 1:
@@ -390,7 +530,31 @@ class ScoringService:
                         if any(r.pairs for r in batch)
                         else None
                     )
-                scores = model.score(feats, pairs)
+                order = None
+                if has_wave:
+                    # one GLOBAL segment vector over the packed matrix:
+                    # each wave decision is its own segment, each plain
+                    # request one singleton segment — the fused forward
+                    # returns scores AND the segment-grouped rank
+                    # permutation in the same dispatch (score_ranked),
+                    # so no per-decision host sort ever happens
+                    seg_parts = []
+                    seg_off = 0
+                    for r in batch:
+                        cs = r.counts if r.counts is not None else [r.rows]
+                        seg_parts.append(wavelib.segment_ids(cs) + seg_off)
+                        seg_off += len(cs)
+                    seg = np.concatenate(seg_parts)
+                    sr = getattr(model, "score_ranked", None)
+                    if sr is not None:
+                        scores, order = sr(feats, pairs, seg)
+                        scores = np.asarray(scores)
+                        order = np.asarray(order)
+                    else:
+                        scores = np.asarray(model.score(feats, pairs))
+                        order = wavelib.rank_order(scores, seg)
+                else:
+                    scores = model.score(feats, pairs)
                 if scores.shape[0] != rows:
                     raise ServingError(
                         f"served model returned {scores.shape[0]} scores"
@@ -407,9 +571,21 @@ class ScoringService:
             M.SERVING_BATCH_OCCUPANCY.observe(rows)
             self.batches += 1
             self.rows_scored += rows
+            if has_wave:
+                M.WAVE_OCCUPANCY_ROWS.observe(rows)
+                self.waves += 1
+                self.wave_rows += rows
             off = 0
             for req in batch:
                 req.scores = scores[off : off + req.rows]
+                if req.counts is not None:
+                    # the request's rows are one contiguous run of
+                    # segments, so its slice of the global permutation
+                    # is already its local segment-grouped order
+                    t0 = time.perf_counter()
+                    local = order[off : off + req.rows] - off
+                    req.rankings = wavelib.split_order(local, req.counts)
+                    self._note_unpack(time.perf_counter() - t0)
                 off += req.rows
                 req.done.set()
 
@@ -427,5 +603,10 @@ class ScoringService:
             "rows_scored": self.rows_scored,
             "batch_occupancy": (
                 round(self.rows_scored / self.batches, 2) if self.batches else 0.0
+            ),
+            "waves": self.waves,
+            "wave_rows": self.wave_rows,
+            "wave_occupancy_rows": (
+                round(self.wave_rows / self.waves, 2) if self.waves else 0.0
             ),
         }

@@ -1,0 +1,82 @@
+"""Wave scheduling: pack/unpack helpers for scoring W decisions × C
+candidates in one dispatch.
+
+A *wave* is a batch of scheduling decisions evaluated together: each
+decision is one (child, candidate-parent set) pair, and the wave
+flattens the ragged ``(W, C_j)`` candidate sets into one row matrix
+(rows = Σ wave sizes) that rides the serving ``BUCKET_LADDER`` —
+steady-state waves dispatch at ladder shapes only, so the scoring
+forward never retraces.
+
+The unpack side is segment-grouped ranking: from the flat score vector
+and the per-decision segment structure, every decision's stable
+ascending-cost candidate order comes back as INDICES in one vectorized
+lexsort — never a per-child host sort of C floats. When the served
+scorer exposes a fused forward (``MLPScorer.predict_ranked``), the
+lexsort runs on device inside the same dispatch as the forward and only
+the permutation returns to host.
+
+Ranking contract (the bit-identity the wave tests pin): sorting by
+(segment, score, row index) is exactly a per-segment
+``np.argsort(kind="stable")`` — the same order the per-peer evaluator
+path has always produced.
+"""
+
+# dfanalyze: hot — pack/unpack run once per scheduled wave
+# dfanalyze: device-hot — the fused rank twin dispatches per wave;
+# retraces or per-wave host sorts multiply here
+
+from __future__ import annotations
+
+import numpy as np
+
+from dragonfly2_tpu.utils import flight, profiling
+
+# dfprof phases: the wave feature pack (id intern + rtt gather + column
+# assembly) and the wave score leg (submit → scores+rankings in hand)
+PH_WAVE_PACK = profiling.phase_type("scheduler.wave_pack")
+PH_WAVE_SCORE = profiling.phase_type("scheduler.wave_score")
+
+# flight event: one record per evaluated wave (never per decision — a
+# wave IS the batch; per-decision records stay with scheduler.schedule
+# and the evaluator's explain event)
+EV_WAVE = flight.event_type("scheduler.wave_evaluated")
+
+
+def segment_ids(counts) -> np.ndarray:
+    """[Σ counts] non-decreasing segment id per flattened row."""
+    return np.repeat(
+        np.arange(len(counts), dtype=np.int32),
+        np.asarray(counts, dtype=np.int64),
+    )
+
+
+def rank_order(scores, seg) -> np.ndarray:
+    """Global sort permutation of flat ``scores`` grouped by segment:
+    primary key segment, then score ascending, then original row index
+    (the stable tie-break). Rows of segment k occupy output positions
+    [seg_start_k, seg_start_k + count_k) — the property ``split_order``
+    unpacks by."""
+    scores = np.asarray(scores)
+    return np.lexsort((np.arange(scores.shape[0]), scores, np.asarray(seg)))
+
+
+def split_order(order, counts) -> "list[np.ndarray]":
+    """Segment-grouped permutation → per-decision LOCAL rankings:
+    decision j's slice of ``order`` holds flat row indices; subtracting
+    its segment offset yields indices into its own candidate set."""
+    out = []
+    off = 0
+    order = np.asarray(order)
+    for c in counts:
+        c = int(c)
+        out.append(order[off : off + c] - off)
+        off += c
+    return out
+
+
+def rank_segments(scores, counts) -> "list[np.ndarray]":
+    """Flat scores + per-decision counts → per-decision stable
+    ascending rankings (the host twin of the fused device rank; same
+    lexsort contract, bit-identical orders)."""
+    return split_order(rank_order(scores, segment_ids(counts)), counts)

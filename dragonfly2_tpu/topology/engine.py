@@ -34,6 +34,7 @@ from dragonfly2_tpu.topology import metrics as TM
 from dragonfly2_tpu.topology.csr import NS_PER_MS, AdjacencyStore
 from dragonfly2_tpu.topology.delta import DeltaQueue, EdgeDelta
 from dragonfly2_tpu.topology.kernels import INF_MS, make_kernels
+from dragonfly2_tpu.trainer.serving import bucket_rows, pad_batch
 from dragonfly2_tpu.utils import dflog, flight
 
 logger = dflog.get("topology.engine")
@@ -402,30 +403,95 @@ class TopologyEngine:
             return 0.0
         return float(np.log1p(rtt / NS_PER_MS) / 10.0)
 
+    def rtt_affinity_pairs(self, src_ids, dst_ids) -> np.ndarray:
+        """[N] src (child) host ids × [N] dst (parent) host ids → [N]
+        rtt_affinity in ONE lock hold and ONE rung-padded gather
+        dispatch — the wave-join form of :meth:`rtt_affinity`.
+        Per-pair resolution order matches the scalar path (self →
+        direct fresh edge → landmark inference → 0.0 missing-value);
+        what it skips is the per-pair machinery (inference cache,
+        EV_INFERENCE ring, per-query metrics) — a W×C wave would flood
+        all three, and the scalar path remains the provenance story.
+        The pair arrays ride the serving BUCKET_LADDER so steady-state
+        waves never retrace the gather kernel."""
+        n = len(src_ids)
+        out = np.zeros(n, dtype=np.float32)
+        if n == 0:
+            return out
+        need_src = np.zeros(n, dtype=np.int32)
+        need_dst = np.zeros(n, dtype=np.int32)
+        known = np.zeros(n, dtype=bool)
+        direct_ms = np.zeros(n, dtype=np.float32)
+        has_direct = np.zeros(n, dtype=bool)
+        with self._lock:
+            index = self.store.index
+            edges = self.store.edges
+            D = self._D  # immutable snapshot: _swap installs new arrays
+            for i in range(n):
+                src, dst = src_ids[i], dst_ids[i]
+                if src == dst:
+                    # self pair: a 0 ms direct edge ⇒ affinity 0.0
+                    known[i] = has_direct[i] = True
+                    continue
+                s = index.get(src)
+                d = index.get(dst)
+                if s is None or d is None:
+                    continue
+                known[i] = True
+                edge = edges.get((s, d)) or edges.get((d, s))
+                if edge is not None:
+                    has_direct[i] = True
+                    direct_ms[i] = edge[0] / NS_PER_MS
+                else:
+                    need_src[i] = s
+                    need_dst[i] = d
+        if D is None or not bool(np.any(known & ~has_direct)):
+            # nothing to infer: direct-only affinity, no kernel dispatch
+            m = known & has_direct
+            out[m] = np.log1p(direct_ms[m]) / np.float32(10.0)
+            return out
+        rows = bucket_rows(n)
+        dev = self._to_backend(
+            {
+                "src": pad_batch(need_src, rows),
+                "dst": pad_batch(need_dst, rows),
+                "direct_ms": pad_batch(direct_ms, rows),
+                "has_direct": pad_batch(has_direct.astype(np.float32), rows),
+                "known": pad_batch(known.astype(np.float32), rows),
+            }
+        )
+        padded = self.kernels.gather_rtt_affinity(
+            D,
+            dev["src"],
+            dev["dst"],
+            dev["direct_ms"],
+            dev["has_direct"],
+            dev["known"],
+        )
+        # whole-rung D2H then host slice (allowlisted host-pull): a
+        # device [:n] would retrace a dynamic_slice per distinct n
+        aff = np.asarray(padded)[:n]
+        return aff.astype(np.float32, copy=False)
+
     def rtt_affinity_batch(
         self, child_ids: np.ndarray, parent_ids: np.ndarray
     ) -> np.ndarray:
         """[N] child host ids × [N, P] parent host ids → [N, P]
         rtt_affinity — the block-encode-time join (scheduler Storage)
         that puts the same feature distribution into the training data
-        the live evaluator feeds the model. Memoizes per distinct pair:
-        a record batch has far fewer distinct host pairs than slots."""
+        the live evaluator feeds the model. One flattened
+        :meth:`rtt_affinity_pairs` gather for the whole block — the
+        per-distinct-pair scalar loop paid one engine lock round-trip
+        per pair; empty ids resolve to the 0.0 missing-value either
+        way."""
         child_ids = np.asarray(child_ids)
         parent_ids = np.asarray(parent_ids)
-        out = np.zeros(parent_ids.shape, dtype=np.float32)
-        memo: dict[tuple[str, str], float] = {}
-        for i in range(parent_ids.shape[0]):
-            c = child_ids[i]
-            for j in range(parent_ids.shape[1]):
-                p = parent_ids[i, j]
-                if not p or not c:
-                    continue
-                key = (c, p)
-                v = memo.get(key)
-                if v is None:
-                    v = memo[key] = self.rtt_affinity(c, p)
-                out[i, j] = v
-        return out
+        if parent_ids.size == 0:
+            return np.zeros(parent_ids.shape, dtype=np.float32)
+        n, p = parent_ids.shape
+        src = [str(c) for c in np.repeat(child_ids, p)]
+        dst = [str(q) for q in parent_ids.reshape(-1)]
+        return self.rtt_affinity_pairs(src, dst).reshape(n, p)
 
     def centrality(self, candidates: list[str] | None = None) -> list[dict]:
         """Mean inferred RTT from every live host to each candidate,

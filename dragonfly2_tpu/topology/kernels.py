@@ -100,6 +100,26 @@ class NumpyKernels:
         """est[i] = min_l D[src_i, l] + D[dst_i, l]  (linear ms)."""
         return np.min(D[src_idx] + D[dst_idx], axis=-1)
 
+    def gather_rtt_affinity(
+        self, D, src_idx, dst_idx, direct_ms, has_direct, known
+    ):
+        """[N] rtt_affinity gathered straight from the resident
+        adjacency — the wave-join feature column in one dispatch:
+        landmark min-plus estimate per (src, dst) index pair, direct
+        probe EWMAs (``direct_ms``, linear ms, masked by
+        ``has_direct``) winning over inference, log1p-ms/10 normalized
+        with the schema's 0.0 missing-value for unknown hosts
+        (``known`` ≤ 0) and no-path pairs. A self pair is encoded by
+        the caller as a 0 ms direct edge (affinity 0.0)."""
+        D = np.asarray(D, np.float32)
+        est_ms = np.min(D[src_idx] + D[dst_idx], axis=-1)
+        ms = np.where(has_direct > 0, direct_ms, est_ms)
+        miss = (np.asarray(known) <= 0) | (
+            (np.asarray(has_direct) <= 0) & (est_ms >= np.float32(INF_MS / 2))
+        )
+        aff = np.log1p(np.maximum(ms, np.float32(0.0))) / np.float32(10.0)
+        return np.where(miss, np.float32(0.0), aff).astype(np.float32)
+
 
 _jit_cache: dict = {}
 
@@ -164,7 +184,15 @@ def _jitted_kernels():
     def est(D, src_idx, dst_idx):
         return jnp.min(D[src_idx] + D[dst_idx], axis=-1)
 
-    fns = _jit_cache["kernels"] = (decay, khop, landmarks, est)
+    @jax.jit
+    def gather_aff(D, src_idx, dst_idx, direct_ms, has_direct, known):
+        est_ms = jnp.min(D[src_idx] + D[dst_idx], axis=-1)
+        ms = jnp.where(has_direct > 0, direct_ms, est_ms)
+        miss = (known <= 0) | ((has_direct <= 0) & (est_ms >= INF_MS / 2))
+        aff = jnp.log1p(jnp.maximum(ms, 0.0)) / 10.0
+        return jnp.where(miss, 0.0, aff).astype(jnp.float32)
+
+    fns = _jit_cache["kernels"] = (decay, khop, landmarks, est, gather_aff)
     return fns
 
 
@@ -175,7 +203,13 @@ class JaxKernels:
     backend = "jax"
 
     def __init__(self):
-        self._decay, self._khop, self._landmarks, self._est = _jitted_kernels()
+        (
+            self._decay,
+            self._khop,
+            self._landmarks,
+            self._est,
+            self._gather_aff,
+        ) = _jitted_kernels()
 
     def decay_weights(self, age_s, valid, half_life_s: float):
         return self._decay(age_s, valid, half_life_s=float(half_life_s))
@@ -196,6 +230,23 @@ class JaxKernels:
 
     def est_from_landmarks(self, D, src_idx, dst_idx):
         return self._est(D, src_idx, dst_idx)
+
+    def gather_rtt_affinity(
+        self, D, src_idx, dst_idx, direct_ms, has_direct, known
+    ):
+        import jax.numpy as jnp
+
+        # explicit boundary conversion (no-op for resident arrays): the
+        # engine hands device copies, but direct callers (tests, tools)
+        # pass numpy — make the transfer visible, not implicit in jit
+        return self._gather_aff(
+            jnp.asarray(D),
+            jnp.asarray(src_idx),
+            jnp.asarray(dst_idx),
+            jnp.asarray(direct_ms),
+            jnp.asarray(has_direct),
+            jnp.asarray(known),
+        )
 
 
 def make_kernels(backend: str = "auto"):

@@ -119,6 +119,24 @@ def deserialize_params_auto(blob: bytes) -> Any:
     return listify(tree)
 
 
+def _score_ranked(params, packed):
+    """Fused forward + segment-grouped rank: scores AND the lexsort
+    permutation (segment primary, score ascending, row index as the
+    stable tie-break — scheduler.wave.rank_order's contract) leave the
+    device in one dispatch. ``packed`` is [rows, F+1]: the feature
+    matrix with the segment-id vector as a trailing float column, so
+    the whole wave rides ONE host→device upload (the jit-witness
+    one-feature-upload-per-wave contract)."""
+    import jax.numpy as jnp
+
+    from dragonfly2_tpu.models.mlp import score_parents
+
+    x = packed[:, :-1]
+    seg = packed[:, -1]
+    s = score_parents(params, x)
+    return s, jnp.lexsort((jnp.arange(s.shape[0]), s, seg))
+
+
 class MLPScorer:
     """Jitted parent scorer around trained MLP params — the object the
     scheduler's MLEvaluator calls ``predict`` on."""
@@ -128,6 +146,7 @@ class MLPScorer:
 
         self._params = _device_params(params)
         self._fn = _jit_once(score_parents)
+        self._ranked = _jit_once(_score_ranked)
 
     @property
     def feature_dim(self) -> int:
@@ -144,6 +163,36 @@ class MLPScorer:
         n = features.shape[0]
         padded = pad_batch(np.asarray(features, np.float32), bucket_rows(n))
         return np.asarray(self._fn(self._params, jnp.asarray(padded)))[:n]
+
+    def predict_ranked(
+        self, features: np.ndarray, seg_ids: np.ndarray
+    ) -> "tuple[np.ndarray, np.ndarray]":
+        """Wave scoring: [n, F] flattened candidate rows whose
+        non-decreasing ``seg_ids`` mark decision boundaries → (scores
+        [n], segment-grouped rank permutation [n]) from ONE fused
+        dispatch — the wave unpack never host-sorts C floats per child.
+        Bucketed like ``predict``: pad rows ride a sentinel segment
+        that sorts strictly last and is sliced off, so the fused
+        executable compiles once per ladder rung. The segment vector is
+        packed as a trailing float column on the padded matrix — one
+        upload per wave, not two (float32 holds segment ids exactly up
+        to 2^24; a wave is bounded far below that)."""
+        import jax.numpy as jnp
+
+        n = features.shape[0]
+        rows = bucket_rows(n)
+        sentinel = int(seg_ids[-1]) + 1 if n else 0
+        packed = np.full(
+            (rows, features.shape[1] + 1), 0.0, np.float32
+        )
+        packed[:n, :-1] = np.asarray(features, np.float32)
+        packed[:, -1] = sentinel
+        packed[:n, -1] = np.asarray(seg_ids, np.float32)
+        s, order = self._ranked(self._params, jnp.asarray(packed))
+        # whole-rung D2H then host slice: a device-side [:n] would
+        # compile one dynamic_slice per distinct n — the retrace class
+        # the ladder exists to kill (allowlisted host-pull, like predict)
+        return np.asarray(s)[:n], np.asarray(order)[:n]
 
 
 def _np_gelu(x: np.ndarray) -> np.ndarray:
@@ -181,6 +230,18 @@ class NumpyMLPScorer:
             if i != last:
                 h = _np_gelu(h)
         return np.ascontiguousarray(h[:n, 0])
+
+    def predict_ranked(
+        self, features: np.ndarray, seg_ids: np.ndarray
+    ) -> "tuple[np.ndarray, np.ndarray]":
+        """Numpy twin of :meth:`MLPScorer.predict_ranked`: same
+        (scores, segment-grouped permutation) contract, same lexsort
+        keys, so the service's wave unpack is backend-independent."""
+        scores = self.predict(features)
+        order = np.lexsort(
+            (np.arange(scores.shape[0]), scores, np.asarray(seg_ids))
+        )
+        return scores, order
 
 
 class GNNScorer:

@@ -108,6 +108,14 @@ def enabled() -> bool:
     return _enabled
 
 
+def dump_armed() -> bool:
+    """True when a flight dump could actually land somewhere —
+    ``DF_DIAG_DIR`` is set (``dump`` is a no-op without it). Hot paths
+    use this to skip building payloads that exist only to be dumped:
+    one getenv, no allocation."""
+    return bool(os.environ.get("DF_DIAG_DIR"))
+
+
 def set_enabled(on: bool) -> None:
     global _enabled
     _enabled = bool(on)

@@ -58,6 +58,16 @@ SERVING_EVENT_MODULES = (
     "dragonfly2_tpu/scheduler/evaluator.py",
 )
 
+# the scheduler.wave_* event segment belongs to the wave-scheduling
+# plane (docs/serving.md "wave scheduling"): the pack/unpack module plus
+# its evaluator and scoring-service clients — a wave-ish event declared
+# elsewhere would fork the vocabulary the wave census keys on
+WAVE_EVENT_MODULES = (
+    "dragonfly2_tpu/scheduler/wave.py",
+    "dragonfly2_tpu/scheduler/evaluator.py",
+    "dragonfly2_tpu/scheduler/serving.py",
+)
+
 # dfprof phase-ledger names (profiling.phase_type("<service>.<what>"))
 # share the event services' vocabulary: phases belong to a process role
 PHASE_SERVICES = EVENT_SERVICES
@@ -224,6 +234,17 @@ def check(package_dir: Path = PACKAGE) -> list[str]:
                     f"{site}: event {name!r} uses the reserved"
                     " scheduler.serving_ segment; serving events are"
                     f" declared in {SERVING_EVENT_MODULES} only"
+                )
+            # scheduler.wave_* belongs to the wave-scheduling plane
+            if (
+                service == "scheduler"
+                and (what == "wave" or what.startswith("wave_"))
+                and str(rel) not in WAVE_EVENT_MODULES
+            ):
+                failures.append(
+                    f"{site}: event {name!r} uses the reserved"
+                    " scheduler.wave_ segment; wave events are"
+                    f" declared in {WAVE_EVENT_MODULES} only"
                 )
             prev_site = seen_events.get(name)
             if prev_site is not None:

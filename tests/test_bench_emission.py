@@ -90,6 +90,21 @@ def _fake_serving_bench():
     }
 
 
+def _fake_wave_bench():
+    # the real soak runs two evaluator arms over a live scoring service
+    # (~5s); emission tests only assert the KEYS ride the artifact — the
+    # soak itself is covered end-to-end by tests/test_stress_tool.py
+    return {
+        "wave_decisions_per_s": 3300.0,
+        "wave_decisions_per_s_per_op": 2000.0,
+        "wave_occupancy_rows": 80.0,
+        "wave_unpack_p99_us": 90.0,
+        "wave_rankings_match": 1,
+        "wave_lost": 0,
+        "serving_backend": "jax",
+    }
+
+
 def _fake_multichip_bench():
     # the real curve spawns 4 fresh-interpreter subprocesses (~1 min);
     # emission tests only assert the KEYS ride the artifact — the
@@ -123,6 +138,7 @@ def _run_main(monkeypatch, capfd, fit_stub):
     monkeypatch.setattr(bench, "chaos_soak_bench", _fake_chaos_soak)
     monkeypatch.setattr(bench, "fleet_shard_kill_bench", _fake_fleet_soak)
     monkeypatch.setattr(bench, "serving_bench", _fake_serving_bench)
+    monkeypatch.setattr(bench, "wave_bench", _fake_wave_bench)
     monkeypatch.setattr(bench, "data_plane_bench", _fake_data_plane_bench)
     monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", fit_stub)
@@ -743,6 +759,7 @@ def test_serving_bench_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "chaos_soak_bench", _fake_chaos_soak)
     monkeypatch.setattr(bench, "fleet_shard_kill_bench", _fake_fleet_soak)
     monkeypatch.setattr(bench, "serving_bench", broken_serving)
+    monkeypatch.setattr(bench, "wave_bench", _fake_wave_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -752,3 +769,65 @@ def test_serving_bench_failure_rides_exit_path(monkeypatch, capfd):
     assert "no threads in sandbox" in rec["serving_error"]
     assert rec["chaos_success_rate"] == 1.0  # siblings unharmed
     assert rec["fleet_success_rate"] == 1.0
+    assert rec["wave_decisions_per_s"] > 0  # the wave soak still rode
+
+
+def test_emits_wave_keys(monkeypatch, capfd):
+    """The artifact carries the wave-scheduling soak numbers (ISSUE 16:
+    wave-packed vs per-op-batched decisions/sec, wave occupancy rows,
+    and the segment-unpack p99 are measured facts), riding host_rates
+    like every prior gate."""
+
+    def stub(paths, **kw):
+        return None, _stats(1000)
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "wave_error" not in rec
+    assert rec["wave_decisions_per_s"] > 0
+    assert rec["wave_decisions_per_s_per_op"] > 0
+    assert rec["wave_occupancy_rows"] > 0
+    assert rec["wave_unpack_p99_us"] > 0
+    assert rec["wave_rankings_match"] == 1
+    assert rec["wave_lost"] == 0
+
+
+def test_wave_keys_survive_warmup_failure(monkeypatch, capfd):
+    """host_rates (wave numbers included) ride every exit path — a dead
+    device link must not discard the scheduler-side wave soak."""
+
+    def stub(paths, **kw):
+        raise RuntimeError("link died in compile")
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "warmup fit failed" in rec["error"]
+    assert rec["wave_decisions_per_s"] > 0
+    assert rec["wave_occupancy_rows"] > 0
+
+
+def test_wave_bench_failure_rides_exit_path(monkeypatch, capfd):
+    """A wave soak that can't run must degrade to a ``wave_error`` key
+    on the one JSON line, leaving its siblings intact."""
+
+    def stub(paths, **kw):
+        return None, _stats(1000)
+
+    def broken_wave():
+        raise RuntimeError("no wave threads in sandbox")
+
+    monkeypatch.setattr(bench, "synthesize_dataset", _fake_synthesize)
+    monkeypatch.setattr(bench, "synthesize_dataset_binary", _fake_synthesize_binary)
+    monkeypatch.setattr(bench, "chaos_soak_bench", _fake_chaos_soak)
+    monkeypatch.setattr(bench, "fleet_shard_kill_bench", _fake_fleet_soak)
+    monkeypatch.setattr(bench, "serving_bench", _fake_serving_bench)
+    monkeypatch.setattr(bench, "wave_bench", broken_wave)
+    monkeypatch.setattr(bench, "data_plane_bench", _fake_data_plane_bench)
+    monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
+    monkeypatch.setattr(ingest, "stream_train_mlp", stub)
+    monkeypatch.setenv("DF_BENCH_REPEATS", "3")
+    bench.main()
+    lines = [l for l in capfd.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert "no wave threads in sandbox" in rec["wave_error"]
+    assert rec["serving_ops_per_s_batched"] > 0  # siblings unharmed
+    assert rec["chaos_success_rate"] == 1.0

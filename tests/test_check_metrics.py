@@ -92,6 +92,27 @@ def test_lint_reserves_serving_event_segment(tmp_path):
     assert "scheduler.schedule_x" not in text
 
 
+def test_lint_reserves_wave_event_segment(tmp_path):
+    """The scheduler.wave_* event segment belongs to the wave-scheduling
+    plane (ISSUE 16): wave.py, evaluator.py, serving.py. A wave-ish
+    event declared anywhere else fails the census; segment test —
+    daemon.wave_x is out of scope, scheduler.wavefront is a different
+    word, scheduler.wave_stray elsewhere is caught."""
+    pkg = tmp_path / "fakepkg"
+    pkg.mkdir()
+    (pkg / "stray.py").write_text(
+        "from dragonfly2_tpu.utils import flight\n"
+        'EV_STRAY = flight.event_type("scheduler.wave_stray")\n'
+        'EV_OK = flight.event_type("daemon.wave_unscoped")\n'
+        'EV_ALSO_OK = flight.event_type("scheduler.wavefront")\n'
+    )
+    failures = check_metrics.check(pkg)
+    text = "\n".join(failures)
+    assert "reserved scheduler.wave_ segment" in text
+    assert "daemon.wave_unscoped" not in text
+    assert "scheduler.wavefront" not in text
+
+
 def test_lint_catches_fault_point_defects(tmp_path):
     """Fault-point registrations (faults.point) ride the census too:
     duplicates, names that aren't <layer>.<what> with a known layer —

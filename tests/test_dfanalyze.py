@@ -222,6 +222,89 @@ class BadFleet:
     assert "BadFleet._ring_lock" in cycles[0].message
 
 
+WAVE_PACK_SHAPE_FIXTURE = '''
+import threading
+
+class Topo:
+    """The engine side of the wave join: ONE lock hold snapshots the
+    host index, the gather kernel dispatches AFTER release."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def rtt_affinity_pairs(self):
+        with self._lock:
+            snap = 1  # index/edges/D snapshot only
+        return snap  # kernel dispatch outside the lock
+
+
+class WaveEvaluator:
+    """The evaluator side: pack (topology lock inside, released before
+    scoring), then rung notes under _rung_lock — no chain ever holds
+    Topo._lock and WaveEvaluator._rung_lock together."""
+
+    def __init__(self, topo):
+        self._rung_lock = threading.Lock()
+        self.topo = topo
+
+    def evaluate_wave(self):
+        feats = self.topo.rtt_affinity_pairs()
+        self._note_rung()
+        return feats
+
+    def _note_rung(self):
+        with self._rung_lock:
+            pass
+'''
+
+
+def test_lockorder_wave_pack_shape_is_clean(fakepkg):
+    """The wave-pack lock model (ISSUE 16): the topology snapshot lock
+    releases before the gather dispatch and before any rung-note lock —
+    this fixture names the intended shape so a nesting regression shows
+    up against a baseline."""
+    (fakepkg / "wave.py").write_text(WAVE_PACK_SHAPE_FIXTURE)
+    res = lockorder.run(fakepkg)
+    assert res.findings == [], [f.message for f in res.findings]
+
+
+def test_lockorder_catches_a_wave_pack_nesting_regression(fakepkg):
+    """The defect the clean shape guards against: a pack that gathers
+    UNDER the rung lock while a topology callback notes the rung under
+    its own lock — the ABBA the wave plane must never grow."""
+    (fakepkg / "wave_bad.py").write_text(
+        '''
+import threading
+
+class BadWave:
+    def __init__(self):
+        self._rung_lock = threading.Lock()
+        self._topo_lock = threading.Lock()
+
+    def evaluate_wave(self):
+        with self._rung_lock:
+            self._gather()  # rung -> topo: pack under the rung lock
+
+    def _gather(self):
+        with self._topo_lock:
+            pass
+
+    def on_flush(self):
+        with self._topo_lock:
+            self._note_rung()  # topo -> rung: the inversion
+
+    def _note_rung(self):
+        with self._rung_lock:
+            pass
+'''
+    )
+    res = lockorder.run(fakepkg)
+    cycles = [f for f in res.findings if f.key.startswith("cycle:")]
+    assert cycles, [f.message for f in res.findings]
+    assert "BadWave._rung_lock" in cycles[0].message
+    assert "BadWave._topo_lock" in cycles[0].message
+
+
 def test_blocking_catches_calls_under_lock(fakepkg):
     (fakepkg / "svc.py").write_text(
         """

@@ -1,0 +1,600 @@
+"""Device-resident wave scheduling (ISSUE 16): ``evaluate_wave`` packs
+W decisions × C candidates into ONE fused candidate→feature→score
+dispatch on rung-padded HBM tensors. Covered here: wave == per-peer
+ranking bit-identical across ragged / rung-straddling shapes, the
+per-decision degradation ladder (one unembeddable host drops only that
+decision a rung), the jit-witness acceptance (zero steady-state
+retraces, exactly ONE host→device upload per wave), the HBM
+rtt_affinity gather kernel (numpy twin == jax), the engine batch join
+== scalar lookups, and the explain-payload gating (top-k built only
+when a trace is sampled or a flight dump is armed)."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.rpc import resilience
+from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler import wave as wavelib
+from dragonfly2_tpu.scheduler.evaluator import MLEvaluator
+from dragonfly2_tpu.scheduler.serving import (
+    GNNServed,
+    MLPServed,
+    ScoringService,
+    ServingConfig,
+)
+from dragonfly2_tpu.schema.features import MLP_FEATURE_DIM
+from dragonfly2_tpu.topology import TopologyConfig, TopologyEngine
+from dragonfly2_tpu.topology.kernels import INF_MS, NumpyKernels
+from dragonfly2_tpu.trainer.serving import NumpyMLPScorer, bucket_rows
+from dragonfly2_tpu.utils import faults, flight
+
+MS = 1_000_000  # ns per ms
+
+
+@pytest.fixture
+def clean_state():
+    faults.clear()
+    resilience.reset()
+    yield
+    faults.clear()
+    resilience.reset()
+
+
+def _numpy_scorer(seed: int = 0) -> NumpyMLPScorer:
+    rng = np.random.default_rng(seed)
+    return NumpyMLPScorer(
+        {
+            "layers": [
+                {
+                    "w": rng.normal(0, 0.3, (MLP_FEATURE_DIM, 32)).astype(
+                        np.float32
+                    ),
+                    "b": np.zeros(32, np.float32),
+                },
+                {
+                    "w": rng.normal(0, 0.3, (32, 1)).astype(np.float32),
+                    "b": np.zeros(1, np.float32),
+                },
+            ]
+        }
+    )
+
+
+def _swarm(candidates: int = 6, children: int = 1):
+    task = res.Task("wave-test-task", "https://origin/x")
+    task.content_length = 64 * 1024 * 1024
+    task.total_piece_count = 16
+    parents = []
+    for i in range(candidates):
+        h = res.Host(id=f"parent-host-{i}", type=res.HostType.SUPER)
+        h.network.idc = f"idc-{i % 2}"
+        p = res.Peer(f"parent-{i}", task, h)
+        p.fsm.event(res.PEER_EVENT_REGISTER_NORMAL)
+        p.fsm.event(res.PEER_EVENT_DOWNLOAD)
+        p.fsm.event(res.PEER_EVENT_DOWNLOAD_SUCCEEDED)
+        p.finished_pieces |= set(range(i + 1))
+        parents.append(p)
+    kids = []
+    for i in range(children):
+        c = res.Peer(f"child-{i}", task, res.Host(id=f"child-host-{i}"))
+        c.fsm.event(res.PEER_EVENT_REGISTER_NORMAL)
+        kids.append(c)
+    return parents, kids, task
+
+
+def _service(**cfg_kw) -> ScoringService:
+    svc = ScoringService(ServingConfig(**cfg_kw))
+    svc.start()
+    return svc
+
+
+def _ragged_wave(parents, kids, widths):
+    """W decisions over rotated candidate-set slices, sized ``widths``
+    — ragged on purpose, and sized so the packed row total straddles a
+    bucket rung when the caller wants it to."""
+    sets = []
+    for j, w in enumerate(widths):
+        rolled = parents[j % len(parents) :] + parents[: j % len(parents)]
+        sets.append(rolled[:w])
+    children = [kids[j % len(kids)] for j in range(len(widths))]
+    return children, sets
+
+
+# ---------------------------------------------------------------------------
+# rank helpers: the lexsort contract
+# ---------------------------------------------------------------------------
+
+
+def test_rank_helpers_match_per_segment_stable_argsort():
+    """``rank_segments`` (one flat lexsort) must equal per-segment
+    stable argsort — the exact order the per-peer path produced —
+    including ties, which stability resolves by row index."""
+    rng = np.random.default_rng(7)
+    counts = [3, 1, 8, 5]
+    scores = rng.normal(size=sum(counts)).astype(np.float32)
+    scores[4] = scores[5] = scores[3]  # ties inside segment 2
+    seg = wavelib.segment_ids(counts)
+    assert seg.tolist() == [0] * 3 + [1] + [2] * 8 + [3] * 5
+    orders = wavelib.rank_segments(scores, counts)
+    off = 0
+    for c, got in zip(counts, orders):
+        want = np.argsort(scores[off : off + c], kind="stable")
+        assert np.array_equal(got, want)
+        off += c
+    # split_order round-trips the flat permutation
+    flat = wavelib.rank_order(scores, seg)
+    assert [o.tolist() for o in wavelib.split_order(flat, counts)] == [
+        o.tolist() for o in orders
+    ]
+
+
+# ---------------------------------------------------------------------------
+# wave == per-peer, bit-identical
+# ---------------------------------------------------------------------------
+
+
+def test_wave_matches_per_peer_bit_identical_ragged(clean_state):
+    """The tentpole contract: one fused wave ranks every decision
+    EXACTLY as W separate per-peer calls would — ragged counts, with
+    the packed row total straddling a bucket rung (3+7+12+1+9 = 32
+    rows: decisions land either side of the 16→32 boundary)."""
+    parents, kids, task = _swarm(candidates=12, children=3)
+    widths = [3, 7, 12, 1, 9]
+    children, sets = _ragged_wave(parents, kids, widths)
+    scorer = _numpy_scorer()
+    ev = MLEvaluator(scorer)
+    got = ev.evaluate_wave(
+        children, sets, [task.total_piece_count] * len(widths)
+    )
+    assert [len(r) for r in got] == widths
+    for c, ps, rk in zip(children, sets, got):
+        want = MLEvaluator(_numpy_scorer()).evaluate_parents(
+            ps, c, task.total_piece_count
+        )
+        assert [p.id for p in rk] == [p.id for p in want]
+
+
+def test_wave_matches_per_peer_through_serving(clean_state):
+    """Same bit-identity with the scoring service in the loop: the
+    fused device ranking a wave rides (lexsort on the packed segment
+    column) must equal the per-peer batched path."""
+    parents, kids, task = _swarm(candidates=10, children=2)
+    widths = [4, 10, 2, 6]
+    children, sets = _ragged_wave(parents, kids, widths)
+    scorer = _numpy_scorer()
+    svc = _service(window_s=0.001)
+    svc.install(MLPServed(scorer), version="mlp/v1")
+    try:
+        ev = MLEvaluator(scorer, serving=svc)
+        got = ev.evaluate_wave(
+            children, sets, [task.total_piece_count] * len(widths)
+        )
+        assert ev._rung == "serving"
+        for c, ps, rk in zip(children, sets, got):
+            want = MLEvaluator(_numpy_scorer()).evaluate_parents(
+                ps, c, task.total_piece_count
+            )
+            assert [p.id for p in rk] == [p.id for p in want]
+    finally:
+        svc.stop()
+
+
+def test_evaluate_parents_is_the_w1_wave(clean_state):
+    """Per-peer IS the degenerate W=1 wave — one code path, so the
+    bit-identity above can never rot apart."""
+    parents, (child,), task = _swarm(candidates=5)
+    ev = MLEvaluator(_numpy_scorer())
+    one = ev.evaluate_parents(parents, child, task.total_piece_count)
+    wave = ev.evaluate_wave([child], [parents], [task.total_piece_count])[0]
+    assert [p.id for p in one] == [p.id for p in wave]
+
+
+def test_wave_empty_and_mixed_decisions(clean_state):
+    """Empty candidate sets rank to [] without disturbing siblings."""
+    parents, (child,), task = _swarm(candidates=6)
+    ev = MLEvaluator(_numpy_scorer())
+    got = ev.evaluate_wave(
+        [child, child, child],
+        [parents[:4], [], parents],
+        [task.total_piece_count] * 3,
+    )
+    assert got[1] == []
+    want0 = MLEvaluator(_numpy_scorer()).evaluate_parents(
+        parents[:4], child, task.total_piece_count
+    )
+    assert [p.id for p in got[0]] == [p.id for p in want0]
+    assert len(got[2]) == len(parents)
+    assert ev.evaluate_wave([], [], []) == []
+
+
+# ---------------------------------------------------------------------------
+# the per-decision ladder
+# ---------------------------------------------------------------------------
+
+
+def _gnn_scorer(host_ids):
+    import jax
+
+    from dragonfly2_tpu.models.gnn import init_graphsage
+    from dragonfly2_tpu.schema.features import ProbeGraph
+    from dragonfly2_tpu.trainer.serving import GNNScorer
+
+    n = len(host_ids)
+    rng = np.random.default_rng(0)
+    graph = ProbeGraph(
+        node_ids=list(host_ids),
+        node_features=rng.random((n, 4)).astype(np.float32),
+        neighbors=np.tile(np.arange(n, dtype=np.int32), (n, 1))[:, :2],
+        neighbor_mask=np.ones((n, 2), np.float32),
+        edge_src=np.zeros(1, np.int32),
+        edge_dst=np.ones(1, np.int32),
+        edge_rtt_log_ms=np.zeros(1, np.float32),
+    )
+    params = init_graphsage(jax.random.PRNGKey(0), 4, (8,), num_nodes=n)
+    return GNNScorer(params, graph)
+
+
+def test_gnn_wave_drops_only_the_unembeddable_decision(clean_state):
+    """One wave, three decisions, one containing a host the served GNN
+    never embedded: THAT decision ranks through the per-call MLP
+    (matching a serving-free evaluator bit-for-bit), its siblings keep
+    the GNN order, the rung stays ``serving``, and nothing registers
+    degraded — the ladder is per decision, not per wave."""
+    parents, (child,), task = _swarm(candidates=4)
+    known = [child.host.id] + [p.host.id for p in parents[:2]]
+    gnn = _gnn_scorer(known)  # parents 2,3 unknown to the graph
+    mlp = _numpy_scorer()
+    svc = _service(window_s=0.001)
+    svc.install(GNNServed(gnn), version="gnn/v1")
+    try:
+        ev = MLEvaluator(mlp, serving=svc)
+        got = ev.evaluate_wave(
+            [child, child, child],
+            [parents[:2], parents, parents[1:2]],
+            [task.total_piece_count] * 3,
+        )
+        # embeddable decisions: the GNN's own RTT ranking
+        pred = gnn.predict_rtt_log_ms(
+            [child.host.id] * 2, [p.host.id for p in parents[:2]]
+        )
+        want_gnn = [parents[int(i)].id for i in np.argsort(pred, kind="stable")]
+        assert [p.id for p in got[0]] == want_gnn
+        assert [p.id for p in got[2]] == [parents[1].id]
+        # the unembeddable decision: per-call MLP, bit-for-bit
+        want_mlp = MLEvaluator(_numpy_scorer()).evaluate_parents(
+            parents, child, task.total_piece_count
+        )
+        assert [p.id for p in got[1]] == [p.id for p in want_mlp]
+        assert ev._rung == "serving"
+        assert MLEvaluator.DEGRADED_COMPONENT not in resilience.degraded()
+    finally:
+        svc.stop()
+
+
+def test_wave_without_model_or_serving_uses_base(clean_state):
+    """No model, no service: every decision ranks through the base
+    evaluator, same as per-peer."""
+    parents, (child,), task = _swarm(candidates=5)
+    ev = MLEvaluator()
+    got = ev.evaluate_wave(
+        [child, child], [parents[:3], parents], [task.total_piece_count] * 2
+    )
+    base = MLEvaluator()
+    assert [p.id for p in got[0]] == [
+        p.id
+        for p in base.evaluate_parents(parents[:3], child, task.total_piece_count)
+    ]
+    assert len(got[1]) == len(parents)
+    assert ev._rung == "base"
+
+
+# ---------------------------------------------------------------------------
+# jit witness: zero steady-state retraces, ONE upload per wave
+# ---------------------------------------------------------------------------
+
+
+def _jax_scorer():
+    import jax
+
+    from dragonfly2_tpu.models.mlp import init_mlp
+    from dragonfly2_tpu.trainer.serving import MLPScorer
+
+    return MLPScorer(init_mlp(jax.random.PRNGKey(0), [MLP_FEATURE_DIM, 16, 1]))
+
+
+def test_fused_ranking_zero_retraces_across_ragged_waves(clean_state):
+    """Varying ragged wave shapes inside warmed bucket rungs dispatch
+    ONE compiled fused executable — the steady state retraces zero
+    (the DF_JIT_WITNESS acceptance, measured with the same tap)."""
+    pytest.importorskip("jax")
+    from hack.dfanalyze import jitwitness
+
+    scorer = _jax_scorer()
+    rng = np.random.default_rng(0)
+
+    def wave(counts):
+        n = sum(counts)
+        feats = rng.random((n, MLP_FEATURE_DIM)).astype(np.float32)
+        return scorer.predict_ranked(feats, wavelib.segment_ids(counts))
+
+    wave([3, 2])  # warm rung 8
+    wave([5, 4, 3])  # warm rung 16
+    with jitwitness.compile_tap() as tap:
+        for counts in ([4, 1], [2, 2, 2], [8], [6, 5], [1] * 7, [9, 4, 3], [5]):
+            scores, order = wave(counts)
+            assert scores.shape[0] == sum(counts)
+            # the permutation stays segment-grouped and complete
+            off = 0
+            for c in counts:
+                local = order[off : off + c] - off
+                assert np.array_equal(np.sort(local), np.arange(c))
+                off += c
+    assert tap.count == 0, tap.names
+
+
+def test_fused_ranking_one_h2d_upload_per_wave(clean_state):
+    """The wave's segment ids ride the padded feature matrix as a
+    trailing column: the fused forward takes exactly ONE host→device
+    transfer per wave — no second upload for the segment vector."""
+    pytest.importorskip("jax")
+    from hack.dfanalyze import jitwitness
+
+    scorer = _jax_scorer()
+    rng = np.random.default_rng(0)
+    counts = [4, 7, 2]
+    feats = rng.random((sum(counts), MLP_FEATURE_DIM)).astype(np.float32)
+    seg = wavelib.segment_ids(counts)
+    scorer.predict_ranked(feats, seg)  # warm
+    with jitwitness.transfer_tap() as tap:
+        for _ in range(3):
+            scorer.predict_ranked(feats, seg)
+    assert tap.h2d == 3, tap.by_thread
+
+
+def test_fused_ranking_matches_numpy_twin(clean_state):
+    """The jax fused rank and the numpy fallback produce the same
+    permutation — deployments without XLA see identical schedules."""
+    pytest.importorskip("jax")
+    import jax
+
+    jax_scorer = _jax_scorer()
+    host_params = jax.tree_util.tree_map(np.asarray, jax_scorer._params)
+    np_scorer = NumpyMLPScorer(host_params)
+    rng = np.random.default_rng(3)
+    for counts in ([5, 3], [1], [12, 9, 11]):
+        feats = rng.normal(size=(sum(counts), MLP_FEATURE_DIM)).astype(
+            np.float32
+        )
+        seg = wavelib.segment_ids(counts)
+        s_jax, o_jax = jax_scorer.predict_ranked(feats, seg)
+        s_np, o_np = np_scorer.predict_ranked(feats, seg)
+        assert np.allclose(s_jax, s_np, atol=1e-4)
+        assert np.array_equal(o_jax, o_np)
+
+
+# ---------------------------------------------------------------------------
+# the HBM rtt_affinity gather
+# ---------------------------------------------------------------------------
+
+
+def test_gather_kernel_numpy_twin_matches_jax():
+    pytest.importorskip("jax")
+    from dragonfly2_tpu.topology.kernels import JaxKernels
+
+    rng = np.random.default_rng(0)
+    n_nodes, L, N = 12, 4, 40
+    D = rng.uniform(1, 50, (n_nodes, L)).astype(np.float32)
+    D[3] = INF_MS  # node with no landmark path
+    src = rng.integers(0, n_nodes, N).astype(np.int32)
+    dst = rng.integers(0, n_nodes, N).astype(np.int32)
+    direct = rng.uniform(1, 20, N).astype(np.float32)
+    has_direct = (rng.random(N) < 0.4).astype(np.float32)
+    known = (rng.random(N) < 0.8).astype(np.float32)
+    a = NumpyKernels().gather_rtt_affinity(D, src, dst, direct, has_direct, known)
+    b = np.asarray(
+        JaxKernels().gather_rtt_affinity(D, src, dst, direct, has_direct, known)
+    )
+    assert np.allclose(a, b, atol=1e-6)
+    # semantics spot checks on the numpy twin
+    one = NumpyKernels().gather_rtt_affinity(
+        D,
+        np.array([0, 3, 0], np.int32),
+        np.array([1, 3, 1], np.int32),
+        np.array([10.0, 0.0, 0.0], np.float32),
+        np.array([1.0, 0.0, 0.0], np.float32),
+        np.array([1.0, 0.0, 1.0], np.float32),
+    )
+    assert one[0] == pytest.approx(np.log1p(10.0) / 10.0)  # direct wins
+    assert one[1] == 0.0  # unknown host → schema missing-value
+    est = float(np.min(D[0] + D[1]))
+    assert one[2] == pytest.approx(np.log1p(est) / 10.0)  # landmark est
+
+
+def _engine(**kw) -> TopologyEngine:
+    kw.setdefault("backend", "numpy")
+    kw.setdefault("flush_threshold", 10**9)
+    kw.setdefault("num_landmarks", 4)
+    return TopologyEngine(TopologyConfig(**kw))
+
+
+def _feed_star(eng, spokes=5, at=1000.0):
+    for i in range(1, spokes + 1):
+        eng.enqueue("h0", f"h{i}", rtt_ns=5 * i * MS, created_at=at)
+        eng.enqueue(f"h{i}", "h0", rtt_ns=5 * i * MS, created_at=at)
+
+
+@pytest.mark.parametrize("backend", ["numpy", "auto"])
+def test_rtt_affinity_pairs_matches_scalar_lookups(backend):
+    """The wave join's ONE batched gather returns exactly what N scalar
+    ``rtt_affinity`` calls return — self pairs, direct edges, landmark
+    inference, and unknown hosts alike — on both backends."""
+    eng = _engine(backend=backend)
+    _feed_star(eng)
+    eng.flush(now=1001.0)
+    src = ["h0", "h0", "h1", "h2", "nope", "h3"]
+    dst = ["h0", "h1", "h2", "h1", "h1", "ghost"]
+    batch = eng.rtt_affinity_pairs(src, dst)
+    scalar = np.array(
+        [eng.rtt_affinity(s, d) for s, d in zip(src, dst)], np.float32
+    )
+    assert batch.shape == (6,)
+    assert np.allclose(batch, scalar, atol=1e-5)
+    assert batch[0] == 0.0  # self
+    assert batch[4] == 0.0 and batch[5] == 0.0  # unknown hosts
+    assert batch[2] > 0.0  # spoke↔spoke only exists via landmarks
+
+
+def test_rtt_affinity_batch_is_the_pair_join_reshaped():
+    eng = _engine()
+    _feed_star(eng, spokes=3)
+    eng.flush(now=1001.0)
+    children = ["h1", "h2"]
+    parents = [["h0", "h3"], ["h3", "h1"]]
+    grid = eng.rtt_affinity_batch(np.array(children), np.array(parents))
+    assert grid.shape == (2, 2)
+    for i, c in enumerate(children):
+        for j, p in enumerate(parents[i]):
+            assert grid[i, j] == pytest.approx(eng.rtt_affinity(c, p), abs=1e-5)
+
+
+def test_wave_rtt_falls_back_per_pair_without_batch_join(clean_state):
+    """A plugin topology exposing only scalar ``rtt_affinity`` still
+    feeds the wave join (satellite: the non-serving path's batch call
+    degrades to the old per-pair loop, never fails)."""
+
+    class ScalarOnly:
+        def rtt_affinity(self, s, d):
+            return 0.25 if (s, d) == ("child-host-0", "parent-host-1") else 0.0
+
+    parents, (child,), task = _swarm(candidates=3)
+    ev = MLEvaluator(_numpy_scorer(), topology=ScalarOnly())
+    rtts = ev._wave_rtt(
+        [child.host.id] * 3, [p.host.id for p in parents]
+    )
+    assert rtts.tolist() == [0.0, 0.25, 0.0]
+    # and a full wave through it still ranks every decision
+    got = ev.evaluate_wave([child], [parents], [task.total_piece_count])
+    assert len(got[0]) == 3
+
+
+# ---------------------------------------------------------------------------
+# explain events: payload gated on sampling / armed dumps
+# ---------------------------------------------------------------------------
+
+
+def _explain_events(since_ns: int):
+    evs = flight.snapshot(["scheduler"]).get("scheduler", [])
+    return [
+        e
+        for e in evs
+        if e["type"] == "scheduler.evaluate_explain" and e["ts_ns"] > since_ns
+    ]
+
+
+def test_explain_payload_built_only_when_armed(clean_state, monkeypatch):
+    """Satellite: the per-decision explain event always lands in the
+    ring, but its top-k feature payload (the W×k list builds) is built
+    ONLY when a trace is sampled or a flight dump is armed."""
+    import time
+
+    from dragonfly2_tpu.utils import tracing
+
+    parents, (child,), task = _swarm(candidates=5)
+    ev = MLEvaluator(_numpy_scorer())
+
+    # neither signal armed: no sampled root span possible, no diag dir
+    monkeypatch.setattr(tracing, "_sample_ratio", 0.0)
+    monkeypatch.delenv("DF_DIAG_DIR", raising=False)
+    t0 = time.time_ns()
+    ev.evaluate_wave([child], [parents], [task.total_piece_count])
+    cold = _explain_events(t0)
+    assert cold and all(e["top"] == [] for e in cold)
+
+    monkeypatch.setenv("DF_DIAG_DIR", "/tmp/df-diag-test")
+    t1 = time.time_ns()
+    ev.evaluate_wave([child], [parents], [task.total_piece_count])
+    hot = _explain_events(t1)
+    assert hot
+    top = hot[-1]["top"]
+    assert 0 < len(top) <= 4
+    assert {"parent_id", "predicted_log_cost", "rtt_affinity", "features"} <= set(
+        top[0]
+    )
+    assert len(top[0]["features"]) == MLP_FEATURE_DIM
+    # the payload's first entry IS the ranked winner
+    ranked = ev.evaluate_parents(parents, child, task.total_piece_count)
+    assert top[0]["parent_id"] == ranked[0].id
+
+
+def test_wave_event_carries_shape_and_demotions(clean_state):
+    import time
+
+    parents, (child,), task = _swarm(candidates=4)
+    ev = MLEvaluator(_numpy_scorer())
+    t0 = time.time_ns()
+    ev.evaluate_wave(
+        [child, child], [parents, parents[:2]], [task.total_piece_count] * 2
+    )
+    evs = [
+        e
+        for e in flight.snapshot(["scheduler"]).get("scheduler", [])
+        if e["type"] == "scheduler.wave_evaluated" and e["ts_ns"] > t0
+    ]
+    assert evs
+    assert evs[-1]["decisions"] == 2
+    assert evs[-1]["rows"] == 6
+    # no serving installed: every decision rode the per-call MLP rung,
+    # so the whole wave counts as demoted-from-serving
+    assert evs[-1]["demoted"] == 2
+
+
+# ---------------------------------------------------------------------------
+# service wave accounting
+# ---------------------------------------------------------------------------
+
+
+def test_score_wave_occupancy_counts_rows(clean_state):
+    svc = _service(window_s=0.001)
+    svc.install(MLPServed(_numpy_scorer()), version="mlp/v1")
+    try:
+        rng = np.random.default_rng(0)
+        for counts in ([3, 5], [2, 2, 2], [7]):
+            n = sum(counts)
+            feats = rng.random((n, MLP_FEATURE_DIM)).astype(np.float32)
+            pairs = [("c", f"p{i}") for i in range(n)]
+            got = svc.score_wave(feats, pairs, counts)
+            assert len(got) == len(counts)
+            for c, (costs, order) in zip(counts, got):
+                assert costs.shape == (c,)
+                assert np.array_equal(np.sort(order), np.arange(c))
+        snap = svc.snapshot()
+        assert snap["waves"] == 3
+        assert snap["wave_rows"] == 21
+        assert snap["wave_occupancy_rows"] == pytest.approx(21 / 3)
+    finally:
+        svc.stop()
+
+
+def test_wave_straddling_rung_boundary_through_service(clean_state):
+    """A wave whose padded row count crosses the top of one rung packs
+    into the next rung without splitting decisions — rankings stay
+    bit-identical to per-peer either side of the boundary."""
+    parents, kids, task = _swarm(candidates=12, children=2)
+    for widths in ([12, 4], [12, 5], [8, 8, 8, 8, 1]):  # 16 / 17 / 33 rows
+        children, sets = _ragged_wave(parents, kids, widths)
+        scorer = _numpy_scorer()
+        svc = _service(window_s=0.001)
+        svc.install(MLPServed(scorer), version="mlp/v1")
+        try:
+            ev = MLEvaluator(scorer, serving=svc)
+            got = ev.evaluate_wave(
+                children, sets, [task.total_piece_count] * len(widths)
+            )
+            assert bucket_rows(sum(widths)) >= sum(widths)
+            for c, ps, rk in zip(children, sets, got):
+                want = MLEvaluator(_numpy_scorer()).evaluate_parents(
+                    ps, c, task.total_piece_count
+                )
+                assert [p.id for p in rk] == [p.id for p in want]
+        finally:
+            svc.stop()
