@@ -442,6 +442,30 @@ def wave_bench() -> dict:
     }
 
 
+def preheat_bench() -> dict:
+    """The predictive-preheat soak (tools/stress.preheat_soak) at bench
+    scale: a forecasted-hot workload run twice, preheat plane armed vs
+    off (the ISSUE 17 acceptance, re-proven on every bench run).
+
+    - ``preheat_cold_p50_ms`` / ``preheat_cold_p50_ms_nopreheat``:
+      first-access latency median per arm — armed must be strictly
+      lower (the forecast→place loop's whole point).
+    - ``preheat_hit_ratio``: fraction of forecast-hot tasks seed-held
+      by rush time.
+    - ``forecast_rate``: per-task demand forecasts served per second in
+      steady state (compiled executables, one H2D per sweep).
+    """
+    from dragonfly2_tpu.tools.stress import preheat_soak
+
+    out = preheat_soak(tasks=12, hot=6, epochs=4, steady_sweeps=2)
+    return {
+        "preheat_cold_p50_ms": out["preheat_cold_p50_ms"],
+        "preheat_cold_p50_ms_nopreheat": out["preheat_cold_p50_ms_nopreheat"],
+        "preheat_hit_ratio": out["preheat_hit_ratio"],
+        "forecast_rate": out["forecast_rate"],
+    }
+
+
 def fleet_shard_kill_bench() -> dict:
     """The scheduler-fleet failover soak (tools/stress.shard_kill_soak)
     at bench scale: 3 real scheduler shards under KV leases, a
@@ -1050,6 +1074,20 @@ def main() -> None:
         except Exception as e:
             host_rates["wave_error"] = str(e)
             _phase(f"wave bench failed: {e}")
+        # predictive-preheat soak rides host_rates the same way: armed vs
+        # off cold-start p50, the seed hit ratio, and the steady-state
+        # forecast rate land in the artifact on every exit path
+        try:
+            host_rates.update(preheat_bench())
+            _phase(
+                f"preheat: cold p50 {host_rates['preheat_cold_p50_ms']:.2f}ms"
+                f" armed vs {host_rates['preheat_cold_p50_ms_nopreheat']:.2f}ms"
+                f" off, hit ratio {host_rates['preheat_hit_ratio']:.2f},"
+                f" {host_rates['forecast_rate']:.0f} forecasts/s"
+            )
+        except Exception as e:
+            host_rates["preheat_error"] = str(e)
+            _phase(f"preheat bench failed: {e}")
         # data-plane race: sendfile vs buffered piece serving under
         # hundreds of concurrent children — throughput per arm, the p99
         # serve tail, and daemon RSS ride every exit path

@@ -27,9 +27,17 @@ from urllib.parse import urlsplit, urlunsplit
 
 from dragonfly2_tpu.client.transport import P2PTransport, ProxyRule
 from dragonfly2_tpu.client import metrics as M
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, flight
 
 logger = dflog.get("client.proxy")
+
+# registry layer fetch observed through the proxy — the preheat demand
+# window consumes these as per-layer-digest demand signal
+EV_LAYER_DEMAND = flight.event_type("daemon.layer_demand")
+
+# `/v2/<name>/blobs/<digest>` — the layer-blob GET shape every OCI
+# registry dialect shares
+_BLOB_PATH_RX = re.compile(r"/v2/[^?#]+/blobs/([a-z0-9]+:[a-f0-9]+)")
 
 _HOP_HEADERS = {
     # accept-encoding is stripped so origins reply identity-encoded — the
@@ -107,6 +115,10 @@ class ProxyServer:
         self.mirror = mirror or RegistryMirror()
         self.issuer = issuer
         self.intercept = [re.compile(rx) for rx in intercept] if intercept else None
+        # optional callable(digest, url) fired per layer-blob GET served —
+        # the scheduler's preheat demand window subscribes here so layer
+        # pulls count as demand even before a DownloadRecord lands
+        self.on_layer_demand = None
         self._ssl_ctx_cache: dict[str, ssl.SSLContext] = {}
         self._ssl_lock = threading.Lock()
         outer = self
@@ -177,6 +189,7 @@ class ProxyServer:
             )
             handler.send_header("Content-Length", str(len(body)))
         M.PROXY_REQUEST_TOTAL.labels("p2p" if result.via_p2p else "direct").inc()
+        self._note_layer_demand(url, head=head)
         handler.send_header("X-Dragonfly-Via-P2P", "1" if result.via_p2p else "0")
         if result.task_id:
             handler.send_header("X-Dragonfly-Task-Id", result.task_id)
@@ -186,6 +199,22 @@ class ProxyServer:
             # buffered whole per request
             for chunk in result.body:
                 handler.wfile.write(chunk)
+
+    def _note_layer_demand(self, url: str, head: bool = False) -> None:
+        """Emit the per-layer-digest demand signal for a served blob GET
+        (HEADs are existence probes, not demand). Advisory: a raising
+        subscriber must never fail the response path."""
+        if head or self.on_layer_demand is None:
+            return
+        m = _BLOB_PATH_RX.search(urlsplit(url).path)
+        if m is None:
+            return
+        digest = m.group(1)
+        EV_LAYER_DEMAND(digest=digest)
+        try:
+            self.on_layer_demand(digest, url)
+        except Exception:
+            logger.exception("layer-demand subscriber failed")
 
     # ------------------------------------------------------------------
     def _should_intercept(self, host: str) -> bool:

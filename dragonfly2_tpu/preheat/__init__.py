@@ -1,0 +1,18 @@
+"""Predictive preheat plane — demand forecasting drives seed placement.
+
+The reference system's reason to exist is pre-positioning content before
+the rush (manager/scheduler preheat jobs over Redis machinery); here the
+loop closes end to end inside the scheduler process:
+
+- ``demand``: fold download records (and registry layer pulls) into
+  bounded per-task demand time series,
+- ``forecast``: GRU next-horizon demand forecaster over those series —
+  the same ``lax.scan`` recurrence the trainer plane already compiles,
+- ``planner``: rank forecast-hot tasks against what seed peers already
+  hold, pick RTT-central seeds, and enqueue budget-capped ``preheat``
+  jobs through the scheduler's JobWorker.
+
+Like ``scheduler/``, this package ``__init__`` stays import-light: the
+modules pull in numpy/jax and the scheduler metrics registry, and the
+planner is only constructed when a server arms the plane.
+"""

@@ -16,12 +16,24 @@ import threading
 from dragonfly2_tpu.rpc import gen  # noqa: F401
 import manager_pb2  # noqa: E402
 
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, tracing
 from dragonfly2_tpu.utils.idgen import task_id_v1, URLMeta
 
 logger = dflog.get("scheduler.job")
 
 DEFAULT_POLL_INTERVAL = 5.0
+
+
+class _LocalJob:
+    """Duck-typed stand-in for a manager job row on the inline
+    (``execute_now``) path — ``_execute`` only reads these fields."""
+
+    __slots__ = ("id", "type", "args_json")
+
+    def __init__(self, type: str, args_json: str):
+        self.id = 0
+        self.type = type
+        self.args_json = args_json
 
 
 class JobWorker:
@@ -94,6 +106,12 @@ class JobWorker:
                 logger.warning("posting result for job %d failed: %s", job.id, e)
         return len(resp.jobs)
 
+    def execute_now(self, job_type: str, args: dict) -> tuple[str, dict]:
+        """Execute one job inline, bypassing the manager lease — the
+        preheat planner's path on schedulers running without a manager
+        (the same dispatch the leased path runs)."""
+        return self._execute(_LocalJob(type=job_type, args_json=json.dumps(args)))
+
     def _execute(self, job) -> tuple[str, dict]:
         try:
             args = json.loads(job.args_json or "{}")
@@ -126,28 +144,40 @@ class JobWorker:
         url_range = args.get("range", "")
         digest = args.get("digest", "")
         triggered = []
-        for url in urls:
-            # the full meta participates in the task id — a preheat that
-            # dropped filter/range would seed a task no client ever matches
-            meta = URLMeta(
-                tag=tag,
-                application=application,
-                filter=url_filter,
-                range=url_range,
-                digest=digest,
-            )
-            task_id = task_id_v1(url, meta)
-            if self.seed_client.trigger(
-                task_id,
-                url,
-                tag=tag,
-                application=application,
-                digest=digest,
-                url_filter=url_filter,
-                url_range=url_range,
-            ):
-                triggered.append(task_id)
-        return "succeeded", {"triggered": triggered, "count": len(triggered)}
+        # child of whatever sweep/job span is current — inline preheat
+        # (planner → JobWorker) renders as one forecast→plan→job→seed
+        # timeline in dftrace
+        with tracing.maybe_span("scheduler", "preheat.seed_trigger", urls=len(urls)):
+            for url in urls:
+                # the full meta participates in the task id — a preheat that
+                # dropped filter/range would seed a task no client ever matches
+                meta = URLMeta(
+                    tag=tag,
+                    application=application,
+                    filter=url_filter,
+                    range=url_range,
+                    digest=digest,
+                )
+                task_id = task_id_v1(url, meta)
+                if self.seed_client.trigger(
+                    task_id,
+                    url,
+                    tag=tag,
+                    application=application,
+                    digest=digest,
+                    url_filter=url_filter,
+                    url_range=url_range,
+                ):
+                    triggered.append(task_id)
+        failed = len(urls) - len(triggered)
+        out = {"triggered": triggered, "count": len(triggered), "failed": failed}
+        if not triggered:
+            # every trigger refused (seed hosts raced away, per-URL seed
+            # capacity): reporting "succeeded" with count 0 buried real
+            # failures in green job results
+            out["error"] = f"0 of {len(urls)} urls triggered"
+            return "failed", out
+        return "succeeded", out
 
     def _preheat_image(self, args: dict) -> tuple[str, dict]:
         """Image preheat: resolve a registry manifest URL into its layer

@@ -150,6 +150,49 @@ WAVE_UNPACK_SECONDS = _r.histogram(
     "Segment-rank unpack wall per wave request",
     buckets=(1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 2e-2),
 )
+# -- predictive preheat plane (dragonfly2_tpu/preheat/, docs/preheat.md):
+# demand folding, forecast sweeps, planned tasks and the jobs they ride --
+PREHEAT_SWEEPS_TOTAL = _r.counter(
+    "scheduler_preheat_sweeps_total",
+    "Planner sweeps by outcome",
+    ("outcome",),  # planned | empty | error
+)
+PREHEAT_JOBS_TOTAL = _r.counter(
+    "scheduler_preheat_jobs_total",
+    "Preheat jobs submitted by the planner, by outcome",
+    ("outcome",),  # succeeded | failed
+)
+PREHEAT_TASKS_PLANNED_TOTAL = _r.counter(
+    "scheduler_preheat_tasks_planned_total",
+    "Forecast-hot tasks picked for seed placement",
+)
+PREHEAT_FORECASTS_TOTAL = _r.counter(
+    "scheduler_preheat_forecasts_total",
+    "Per-task demand forecasts served by the GRU forecaster",
+)
+PREHEAT_SKIPPED_TOTAL = _r.counter(
+    "scheduler_preheat_skipped_total",
+    "Forecast-hot tasks the planner declined",
+    ("reason",),  # held | inflight | cooldown | budget | no_url
+)
+PREHEAT_DEMAND_TASKS = _r.gauge(
+    "scheduler_preheat_demand_tasks", "Task series resident in the demand window"
+)
+PREHEAT_DEMAND_OBSERVED_TOTAL = _r.counter(
+    "scheduler_preheat_demand_observed_total",
+    "Demand observations folded into the window, by source",
+    ("source",),  # record | layer
+)
+PREHEAT_DEMAND_DROPPED_TOTAL = _r.counter(
+    "scheduler_preheat_demand_dropped_total",
+    "Demand arrivals refused at the window's task cap",
+)
+PREHEAT_SWEEP_SECONDS = _r.histogram(
+    "scheduler_preheat_sweep_seconds",
+    "Whole planner sweep wall (forecast + plan + job submit)",
+    buckets=(1e-3, 5e-3, 0.02, 0.1, 0.5, 2.0, 10.0),
+)
+
 VERSION_GAUGE = _r.gauge(
     "scheduler_version", "Build info (value is always 1)", ("version",)
 )

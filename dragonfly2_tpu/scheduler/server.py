@@ -52,6 +52,16 @@ class SchedulerServerConfig:
     train_interval: float = 7 * 24 * 3600.0
     keepalive_interval: float = 30.0
     job_poll_interval: float = 5.0
+    # predictive preheat plane (dragonfly2_tpu/preheat/, docs/preheat.md):
+    # fold download records into per-task demand series, GRU-forecast the
+    # next horizon, and seed forecast-hot tasks ahead of the rush
+    preheat: bool = False
+    preheat_interval: float = 30.0
+    preheat_bucket_s: float = 10.0
+    preheat_window_buckets: int = 32
+    preheat_horizon: int = 3
+    preheat_budget: int = 4
+    preheat_max_tasks: int = 1024
     # cluster telemetry push cadence (utils/telemetry.py → the manager's
     # ReportTelemetry; docs/telemetry.md); <= 0 disables the reporter
     telemetry_interval: float = 15.0
@@ -315,6 +325,58 @@ class SchedulerServer:
                 poll_interval=config.job_poll_interval,
             )
 
+        # predictive preheat plane: demand window fed off the record sink,
+        # GRU forecaster, and the planner closing the forecast→place loop
+        self.preheat_planner = None
+        if config.preheat:
+            from dragonfly2_tpu.preheat.demand import DemandWindow
+            from dragonfly2_tpu.preheat.forecast import DemandForecaster
+            from dragonfly2_tpu.preheat.planner import PreheatPlanner
+            from dragonfly2_tpu.scheduler.resource.seed_peer import SeedPeerClient
+
+            demand = DemandWindow(
+                bucket_s=config.preheat_bucket_s,
+                window_buckets=config.preheat_window_buckets,
+                max_tasks=config.preheat_max_tasks,
+            )
+            self.storage.on_download = demand.observe_record
+            forecaster = DemandForecaster(
+                window_buckets=config.preheat_window_buckets,
+                horizon=config.preheat_horizon,
+            )
+            if self.job_worker is not None:
+                seed_client = self.job_worker.seed_client
+                job_worker = self.job_worker
+                manager_client = self.job_worker.manager
+            else:
+                # standalone scheduler: an unstarted worker executes
+                # planner jobs inline (execute_now), no manager queue
+                from dragonfly2_tpu.scheduler.job import JobWorker
+
+                seed_client = SeedPeerClient(self.resource.host_manager)
+                job_worker = JobWorker(
+                    None,
+                    self.resource,
+                    seed_client=seed_client,
+                    networktopology=self.networktopology,
+                    hostname=config.hostname,
+                    ip=config.advertise_ip,
+                    cluster_id=config.cluster_id,
+                )
+                manager_client = None
+            self.preheat_planner = PreheatPlanner(
+                demand,
+                forecaster,
+                resource=self.resource,
+                job_worker=job_worker,
+                manager_client=manager_client,
+                topology=self.networktopology,
+                seed_client=seed_client,
+                cluster_id=config.cluster_id,
+                interval_s=config.preheat_interval,
+                budget_per_sweep=config.preheat_budget,
+            )
+
         self._grpc = None
         self.port: int | None = None
         self.fleet = None
@@ -427,6 +489,11 @@ class SchedulerServer:
             self.model_refresher.start()
         if self.job_worker is not None:
             self.job_worker.start()
+        if self.preheat_planner is not None:
+            # after the job worker: the planner's first sweep may submit
+            # through it the moment demand warrants
+            self.preheat_planner.start()
+            flight.register_probe("preheat", self.preheat_planner.stats)
         self.gc.start()
         from dragonfly2_tpu.utils.metrics import set_build_info
 
@@ -533,6 +600,10 @@ class SchedulerServer:
                 self.fleet.kv.close()  # the heartbeat's own RESP socket
         if self.telemetry_reporter is not None:
             self.telemetry_reporter.stop()
+        if self.preheat_planner is not None:
+            # before the job worker (reverse of start): no sweep may
+            # submit into a worker already torn down
+            self.preheat_planner.stop()
         if self.job_worker is not None:
             self.job_worker.stop()
         if self.model_refresher is not None:

@@ -25,7 +25,9 @@ from dragonfly2_tpu.schema.columnar import RotatingBlockWriter, RotatingCSVWrite
 from dragonfly2_tpu.scheduler.resource import Peer
 from dragonfly2_tpu.scheduler.resource.host import Host
 from dragonfly2_tpu.scheduler.resource.task import Task
-from dragonfly2_tpu.utils import profiling
+from dragonfly2_tpu.utils import dflog, profiling
+
+logger = dflog.get("scheduler.storage")
 
 NS_PER_S = 1_000_000_000
 
@@ -130,6 +132,10 @@ class Storage:
             else None
         )
         self._lock = threading.Lock()
+        # optional same-thread observer for each download record written
+        # (the preheat demand window folds arrivals through this); called
+        # OUTSIDE self._lock so a slow observer never stalls record writes
+        self.on_download = None
         # blocks-off-era detection: the CSV sink ALWAYS runs while the
         # block sink is optional, so CSV ⊇ blocks — records written by a
         # previous process with write_blocks=False exist ONLY as CSV. If
@@ -157,6 +163,12 @@ class Storage:
                 self._download.create(rec)
                 if self._blocks_download is not None:
                     self._blocks_download.create(rec)
+            if self.on_download is not None:
+                try:
+                    self.on_download(rec)
+                except Exception:
+                    # demand folding is advisory; the record sink is not
+                    logger.exception("download observer failed")
 
     def create_network_topology(self, rec: R.NetworkTopologyRecord) -> None:
         with self._lock:

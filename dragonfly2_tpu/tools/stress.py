@@ -40,6 +40,14 @@ length-checked, and the sendfile arm is raced against the buffered
 fallback best-of-2 — gates on zero hangs, zero bad responses, and
 zero-copy strictly above buffered, with aggregate bytes/s, p99 piece
 serve latency, and daemon RSS reported.
+
+Sixth mode: ``--preheat`` runs the predictive-preheat acceptance soak
+(docs/preheat.md): a forecasted-hot workload twice, preheat plane armed
+vs off. The armed arm's real planner sweeps (GRU demand forecast →
+budget-capped plan → preheat job → seed triggers) must produce a
+measured cold-start p50 strictly below the no-preheat arm, with zero
+lost downloads, the whole sweep linked into one dftrace timeline, and
+zero steady-state retraces on the forecast path.
 """
 
 from __future__ import annotations
@@ -1073,6 +1081,210 @@ def wave_soak(
 
 
 # ---------------------------------------------------------------------------
+# predictive preheat soak: forecasted-hot workload, armed vs off
+# ---------------------------------------------------------------------------
+
+
+class _PreheatSeedStub:
+    """Seed-peer client double for the preheat soak: every trigger
+    lands (records the URL as seed-held), nothing is ever inflight."""
+
+    def __init__(self):
+        self.held_urls: set = set()
+        self.triggers = 0
+
+    def seed_hosts(self):
+        return ["seed-host"]
+
+    def is_inflight(self, task_id: str) -> bool:
+        return False
+
+    def trigger(self, task_id: str, url: str, **kw) -> bool:
+        self.triggers += 1
+        self.held_urls.add(url)
+        return True
+
+
+class _PreheatResourceStub:
+    """Resource double: no task is ever already seed-held."""
+
+    class _Tasks:
+        def load(self, task_id):
+            return None
+
+    task_manager = _Tasks()
+
+
+def preheat_soak(
+    tasks: int = 18,
+    hot: int = 8,
+    window_buckets: int = 16,
+    bucket_s: float = 1.0,
+    horizon: int = 3,
+    epochs: int = 6,
+    budget: int = 10,
+    min_score: float = 1.0,
+    steady_sweeps: int = 3,
+    hit_ms: float = 0.2,
+    miss_ms: float = 5.0,
+    seed: int = 0,
+) -> dict:
+    """The predictive-preheat acceptance soak (docs/preheat.md): a
+    forecasted-hot workload run twice — once with the preheat plane
+    armed, once with it off.
+
+    A demand window is fed ``window_buckets`` of synthetic history:
+    ``hot`` tasks ramp steeply, the rest stay near-idle. The armed arm
+    runs real planner sweeps (GRU fit → forecast → plan → preheat job →
+    seed triggers, all through the production ``PreheatPlanner`` +
+    ``JobWorker`` inline path), then a consumer rush measures each hot
+    task's FIRST-access latency: a seed-held task serves at cache speed
+    (``hit_ms``), anything else pays the back-to-source cold start
+    (``miss_ms``). The off arm runs the same rush with no planner, so
+    every first access is cold.
+
+    Gates (CLI exit / bench re-emission): ``preheat_cold_p50_ms``
+    strictly below ``preheat_cold_p50_ms_nopreheat``, zero lost
+    downloads, the sweep's forecast→plan→job→seed-trigger spans linked
+    into ONE dftrace timeline, and zero steady-state retraces on the
+    forecast path (measured with the same compile tap bench.py uses).
+    """
+    from dragonfly2_tpu.preheat.demand import DemandWindow
+    from dragonfly2_tpu.preheat.forecast import DemandForecaster
+    from dragonfly2_tpu.preheat.planner import PreheatPlanner
+    from dragonfly2_tpu.scheduler.job import JobWorker
+    from dragonfly2_tpu.utils import tracing
+
+    try:  # the runtime jit witness lives in the repo's hack/ toolbox
+        from hack.dfanalyze import jitwitness
+    except ImportError:  # installed-package runs: no retrace witness
+        jitwitness = None
+
+    now0 = 1_000_000.0
+    hot_urls = [f"http://origin/blobs/hot{i:02d}" for i in range(hot)]
+    cold_urls = [f"http://origin/blobs/cold{i:02d}" for i in range(tasks - hot)]
+
+    def feed(window: DemandWindow) -> None:
+        """Ramping demand on the hot tasks, sparse trickle on the rest."""
+        for step in range(window_buckets):
+            ts = now0 + step * bucket_s
+            for i, url in enumerate(hot_urls):
+                window.observe(
+                    f"hot{i:02d}", url=url, ts=ts, count=float(3 + step + i)
+                )
+            for i, url in enumerate(cold_urls):
+                if step % 5 == 0:
+                    window.observe(f"cold{i:02d}", url=url, ts=ts, count=0.25)
+
+    def rush(held_urls: set) -> tuple[list, int]:
+        """First-access latency per hot task (ms), measured: a held task
+        is a cache hit, a miss pays the back-to-source cold start."""
+        lats, hits = [], 0
+        for url in hot_urls:
+            t0 = time.perf_counter()
+            if url in held_urls:
+                time.sleep(hit_ms / 1e3)
+                hits += 1
+            else:
+                time.sleep(miss_ms / 1e3)
+            lats.append((time.perf_counter() - t0) * 1e3)
+        return lats, hits
+
+    # -- armed arm ----------------------------------------------------------
+    demand = DemandWindow(
+        bucket_s=bucket_s, window_buckets=window_buckets, max_tasks=4 * tasks
+    )
+    feed(demand)
+    forecaster = DemandForecaster(
+        window_buckets=window_buckets,
+        horizon=horizon,
+        epochs=epochs,
+        min_examples=4,
+        seed=seed,
+    )
+    seed_client = _PreheatSeedStub()
+    worker = JobWorker(None, _PreheatResourceStub(), seed_client=seed_client)
+    planner = PreheatPlanner(
+        demand,
+        forecaster,
+        resource=_PreheatResourceStub(),
+        job_worker=worker,
+        seed_client=seed_client,
+        interval_s=3600.0,
+        budget_per_sweep=budget,
+        min_score=min_score,
+        refit_every=10_000,  # steady sweeps must witness the serve path only
+    )
+    sweep_now = now0 + window_buckets * bucket_s
+    first = planner.sweep_once(now=sweep_now)
+    lost = 0
+    if first["jobs"] and not first["triggered"]:
+        lost += first["planned"]  # the job was submitted and went nowhere
+
+    # one timeline: the sweep's forecast/plan/job spans (preheat tracer)
+    # and the JobWorker's seed-trigger span (scheduler tracer) must share
+    # the sweep's trace id
+    linked = 0
+    for sweep_span in tracing.get("preheat").finished:
+        if sweep_span.name != "preheat.sweep":
+            continue
+        names = {
+            s.name
+            for ring in (tracing.get("preheat"), tracing.get("scheduler"))
+            for s in ring.finished
+            if s.trace_id == sweep_span.trace_id
+        }
+        if {
+            "preheat.sweep",
+            "preheat.forecast",
+            "preheat.plan",
+            "preheat.job",
+            "preheat.seed_trigger",
+        } <= names:
+            linked = 1
+            break
+
+    # steady state: same window shape sweep over sweep — the forecast
+    # path must dispatch already-compiled executables (zero retraces)
+    # with one H2D upload per sweep
+    forecasts0 = forecaster.forecasts
+    t0 = time.perf_counter()
+    if jitwitness is not None:
+        with jitwitness.compile_tap() as ct, jitwitness.transfer_tap() as tt:
+            for k in range(steady_sweeps):
+                planner.sweep_once(now=sweep_now + (k + 1) * bucket_s)
+        retraces, h2d = ct.count, tt.h2d
+    else:
+        for k in range(steady_sweeps):
+            planner.sweep_once(now=sweep_now + (k + 1) * bucket_s)
+        retraces, h2d = 0, 0
+    steady_wall = time.perf_counter() - t0
+    forecast_rate = (forecaster.forecasts - forecasts0) / max(steady_wall, 1e-9)
+
+    armed_lats, hits = rush(seed_client.held_urls)
+
+    # -- off arm: the same rush, nothing preheated --------------------------
+    off_lats, _ = rush(set())
+
+    return {
+        "preheat_cold_p50_ms": round(_percentile(sorted(armed_lats), 0.5), 3),
+        "preheat_cold_p50_ms_nopreheat": round(_percentile(sorted(off_lats), 0.5), 3),
+        "preheat_hit_ratio": round(hits / max(hot, 1), 3),
+        "forecast_rate": round(forecast_rate, 1),
+        "preheat_lost": lost,
+        "preheat_trace_linked": linked,
+        "preheat_retraces": retraces,
+        "preheat_h2d_per_sweep": round(
+            h2d / steady_sweeps if steady_sweeps else 0.0, 2
+        ),
+        "preheat_backend": forecaster.backend,
+        "preheat_tasks": tasks,
+        "preheat_planned": first["planned"],
+        "preheat_triggers": seed_client.triggers,
+    }
+
+
+# ---------------------------------------------------------------------------
 # shard-kill soak: scheduler-fleet failover under simulated announce load
 # ---------------------------------------------------------------------------
 
@@ -1506,6 +1718,19 @@ def main(argv=None) -> int:
     )
     p.add_argument("--wave-width", type=int, default=8,
                    help="decisions packed per wave for --wave")
+    p.add_argument(
+        "--preheat",
+        action="store_true",
+        help="run the predictive-preheat soak: forecasted-hot workload"
+        " twice (preheat plane armed vs off); the armed arm's measured"
+        " cold-start p50 must fall strictly below the no-preheat arm,"
+        " with zero lost downloads, one forecast→plan→job→seed-trigger"
+        " trace timeline, and zero steady-state forecast retraces",
+    )
+    p.add_argument("--preheat-tasks", type=int, default=18,
+                   help="demand-window task count for --preheat")
+    p.add_argument("--preheat-hot", type=int, default=8,
+                   help="forecast-hot tasks in the --preheat workload")
     p.add_argument("--daemon", default="", help="dfdaemon gRPC address (Download path)")
     p.add_argument("--proxy", default="", help="daemon proxy address (HTTP path)")
     p.add_argument("-c", "--connections", type=int, default=8)
@@ -1527,6 +1752,16 @@ def main(argv=None) -> int:
             and stats["data_plane_connections"] >= args.data_plane_children
             and stats["data_plane_bytes_per_s"]
             > stats["data_plane_bytes_per_s_buffered"]
+        )
+        return 0 if ok else 1
+    if args.preheat:
+        stats = preheat_soak(tasks=args.preheat_tasks, hot=args.preheat_hot)
+        print(json.dumps(stats))
+        ok = (
+            stats["preheat_cold_p50_ms"] < stats["preheat_cold_p50_ms_nopreheat"]
+            and stats["preheat_lost"] == 0
+            and stats["preheat_trace_linked"] == 1
+            and stats["preheat_retraces"] == 0
         )
         return 0 if ok else 1
     if args.serving and args.wave:

@@ -244,6 +244,47 @@ class NumpyMLPScorer:
         return scores, order
 
 
+def _np_sigmoid(x: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_predict_next_cost(params: Any, x: np.ndarray, lengths=None) -> np.ndarray:
+    """Pure-numpy twin of ``models.gru.predict_next_cost`` — the same
+    masked GRU recurrence and gelu head on ``[B, T, F]`` histories, so
+    GRU-backed serving (bad-node detection, the preheat demand
+    forecaster) has the same CI-parity fallback NumpyMLPScorer gives the
+    MLP path. Accepts numpy or device params (leaves are converted)."""
+    wz, uz, bz = (np.asarray(params[k], np.float32) for k in ("wz", "uz", "bz"))
+    wr, ur, br = (np.asarray(params[k], np.float32) for k in ("wr", "ur", "br"))
+    wh, uh, bh = (np.asarray(params[k], np.float32) for k in ("wh", "uh", "bh"))
+    x = np.asarray(x, np.float32)
+    b, t, _ = x.shape
+    if lengths is None:
+        lengths = np.full((b,), t, np.int32)
+    else:
+        lengths = np.asarray(lengths, np.int32)
+    h = np.zeros((b, uz.shape[0]), np.float32)
+    for step in range(t):
+        xt = x[:, step, :]
+        z = _np_sigmoid(xt @ wz + h @ uz + bz)
+        r = _np_sigmoid(xt @ wr + h @ ur + br)
+        n = np.tanh(xt @ wh + (r * h) @ uh + bh)
+        h_new = (1.0 - z) * n + z * h
+        # state stops updating past a sequence's length, exactly like
+        # the scan's keep mask: the final hidden is the last REAL step
+        h = np.where((step < lengths)[:, None], h_new, h)
+    layers = params["head"]["layers"]
+    out = h
+    last = len(layers) - 1
+    for i, layer in enumerate(layers):
+        out = out @ np.asarray(layer["w"], np.float32) + np.asarray(
+            layer["b"], np.float32
+        )
+        if i != last:
+            out = _np_gelu(out)
+    return out[:, 0]
+
+
 class GNNScorer:
     """Edge-RTT predictor over a fixed probe graph: scores (src, dst) host
     pairs by predicted RTT (for seed placement / cross-host ranking, and

@@ -66,7 +66,9 @@ INJECTED_TOTAL = _r.counter(
 
 # the layers a point name may start with — the same census discipline as
 # metric/event names (hack/check_metrics.py lints registrations)
-POINT_LAYERS = ("rpc", "daemon", "scheduler", "trainer", "manager", "kv", "fleet")
+POINT_LAYERS = (
+    "rpc", "daemon", "scheduler", "trainer", "manager", "kv", "fleet", "preheat",
+)
 
 ACTIONS = ("error", "delay", "truncate", "corrupt", "kill_conn", "abort")
 

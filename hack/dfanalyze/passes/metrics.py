@@ -31,7 +31,7 @@ PACKAGE = DEFAULT_PACKAGE
 # (dragonfly_build_info{service,version} — every exporter carries it)
 ALLOWED_SERVICES = (
     "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "flight",
-    "faults", "resilience", "fleet", "build", "prof",
+    "faults", "resilience", "fleet", "build", "prof", "preheat",
 )
 
 # flight-recorder event names are <service>.<what>; the service segment
@@ -41,7 +41,7 @@ ALLOWED_SERVICES = (
 # evict any role's own history
 EVENT_SERVICES = (
     "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "faults",
-    "fleet", "prof",
+    "fleet", "prof", "preheat",
 )
 
 # the prof.* event namespace is reserved for the continuous profiler —
@@ -68,12 +68,24 @@ WAVE_EVENT_MODULES = (
     "dragonfly2_tpu/scheduler/serving.py",
 )
 
+# the preheat.* event namespace (its own flight ring) belongs to the
+# predictive preheat plane: demand folding, forecasting, planning — a
+# preheat-ish event declared elsewhere would fork the vocabulary the
+# preheat census and docs/preheat.md key on
+PREHEAT_EVENT_MODULES = (
+    "dragonfly2_tpu/preheat/demand.py",
+    "dragonfly2_tpu/preheat/forecast.py",
+    "dragonfly2_tpu/preheat/planner.py",
+)
+
 # dfprof phase-ledger names (profiling.phase_type("<service>.<what>"))
 # share the event services' vocabulary: phases belong to a process role
 PHASE_SERVICES = EVENT_SERVICES
 
 # fault-point names are <layer>.<what>; mirrors utils/faults.POINT_LAYERS
-FAULT_LAYERS = ("rpc", "daemon", "scheduler", "trainer", "manager", "kv", "fleet")
+FAULT_LAYERS = (
+    "rpc", "daemon", "scheduler", "trainer", "manager", "kv", "fleet", "preheat",
+)
 
 # telemetry aggregate fields are <scope>.<what>; mirrors
 # utils/telemetry.TELEMETRY_SCOPES (the manager-derived fields dfstat
@@ -245,6 +257,13 @@ def check(package_dir: Path = PACKAGE) -> list[str]:
                     f"{site}: event {name!r} uses the reserved"
                     " scheduler.wave_ segment; wave events are"
                     f" declared in {WAVE_EVENT_MODULES} only"
+                )
+            # the preheat.* ring belongs to the predictive preheat plane
+            if service == "preheat" and str(rel) not in PREHEAT_EVENT_MODULES:
+                failures.append(
+                    f"{site}: event {name!r} uses the reserved preheat."
+                    f" namespace; preheat events are declared in"
+                    f" {PREHEAT_EVENT_MODULES} only"
                 )
             prev_site = seen_events.get(name)
             if prev_site is not None:

@@ -132,6 +132,19 @@ def _fake_data_plane_bench():
     }
 
 
+def _fake_preheat_bench():
+    # the real soak trains a GRU forecaster and runs planner sweeps
+    # (~10s); emission tests only assert the KEYS ride the artifact —
+    # the soak itself is covered end-to-end by tests/test_preheat.py
+    # and the CLI soak
+    return {
+        "preheat_cold_p50_ms": 0.3,
+        "preheat_cold_p50_ms_nopreheat": 5.1,
+        "preheat_hit_ratio": 1.0,
+        "forecast_rate": 8000.0,
+    }
+
+
 def _run_main(monkeypatch, capfd, fit_stub):
     monkeypatch.setattr(bench, "synthesize_dataset", _fake_synthesize)
     monkeypatch.setattr(bench, "synthesize_dataset_binary", _fake_synthesize_binary)
@@ -141,6 +154,7 @@ def _run_main(monkeypatch, capfd, fit_stub):
     monkeypatch.setattr(bench, "wave_bench", _fake_wave_bench)
     monkeypatch.setattr(bench, "data_plane_bench", _fake_data_plane_bench)
     monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
+    monkeypatch.setattr(bench, "preheat_bench", _fake_preheat_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", fit_stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     monkeypatch.delenv("DF_BENCH_CPU_FALLBACK", raising=False)
@@ -487,6 +501,7 @@ def test_chaos_soak_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "fleet_shard_kill_bench", _fake_fleet_soak)
     monkeypatch.setattr(bench, "serving_bench", _fake_serving_bench)
     monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
+    monkeypatch.setattr(bench, "preheat_bench", _fake_preheat_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -515,6 +530,7 @@ def test_fleet_soak_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "fleet_shard_kill_bench", broken_fleet)
     monkeypatch.setattr(bench, "serving_bench", _fake_serving_bench)
     monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
+    monkeypatch.setattr(bench, "preheat_bench", _fake_preheat_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -615,6 +631,7 @@ def test_multichip_bench_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "serving_bench", _fake_serving_bench)
     monkeypatch.setattr(bench, "data_plane_bench", _fake_data_plane_bench)
     monkeypatch.setattr(bench, "multichip_scaling_bench", broken_multichip)
+    monkeypatch.setattr(bench, "preheat_bench", _fake_preheat_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -760,6 +777,7 @@ def test_serving_bench_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "fleet_shard_kill_bench", _fake_fleet_soak)
     monkeypatch.setattr(bench, "serving_bench", broken_serving)
     monkeypatch.setattr(bench, "wave_bench", _fake_wave_bench)
+    monkeypatch.setattr(bench, "preheat_bench", _fake_preheat_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -822,6 +840,7 @@ def test_wave_bench_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "wave_bench", broken_wave)
     monkeypatch.setattr(bench, "data_plane_bench", _fake_data_plane_bench)
     monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
+    monkeypatch.setattr(bench, "preheat_bench", _fake_preheat_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -830,4 +849,66 @@ def test_wave_bench_failure_rides_exit_path(monkeypatch, capfd):
     rec = json.loads(lines[0])
     assert "no wave threads in sandbox" in rec["wave_error"]
     assert rec["serving_ops_per_s_batched"] > 0  # siblings unharmed
+    assert rec["chaos_success_rate"] == 1.0
+
+
+def test_emits_preheat_keys(monkeypatch, capfd):
+    """The artifact carries the predictive-preheat soak numbers
+    (ISSUE 17: armed vs no-preheat cold-start p50, the seed hit ratio,
+    and the steady-state forecast rate are measured facts), riding
+    host_rates like every prior gate."""
+
+    def stub(paths, **kw):
+        return None, _stats(1000)
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "preheat_error" not in rec
+    assert rec["preheat_cold_p50_ms"] > 0
+    assert rec["preheat_cold_p50_ms_nopreheat"] > rec["preheat_cold_p50_ms"]
+    assert 0.0 <= rec["preheat_hit_ratio"] <= 1.0
+    assert rec["forecast_rate"] > 0
+
+
+def test_preheat_keys_survive_warmup_failure(monkeypatch, capfd):
+    """host_rates (preheat numbers included) ride every exit path — a
+    dead device link must not discard the forecast→place soak."""
+
+    def stub(paths, **kw):
+        raise RuntimeError("link died in compile")
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "warmup fit failed" in rec["error"]
+    assert rec["preheat_cold_p50_ms"] > 0
+    assert rec["preheat_cold_p50_ms_nopreheat"] > 0
+    assert rec["forecast_rate"] > 0
+
+
+def test_preheat_bench_failure_rides_exit_path(monkeypatch, capfd):
+    """A preheat soak that can't run must degrade to a
+    ``preheat_error`` key on the one JSON line, leaving its siblings
+    intact."""
+
+    def stub(paths, **kw):
+        return None, _stats(1000)
+
+    def broken_preheat():
+        raise RuntimeError("no forecaster in sandbox")
+
+    monkeypatch.setattr(bench, "synthesize_dataset", _fake_synthesize)
+    monkeypatch.setattr(bench, "synthesize_dataset_binary", _fake_synthesize_binary)
+    monkeypatch.setattr(bench, "chaos_soak_bench", _fake_chaos_soak)
+    monkeypatch.setattr(bench, "fleet_shard_kill_bench", _fake_fleet_soak)
+    monkeypatch.setattr(bench, "serving_bench", _fake_serving_bench)
+    monkeypatch.setattr(bench, "wave_bench", _fake_wave_bench)
+    monkeypatch.setattr(bench, "data_plane_bench", _fake_data_plane_bench)
+    monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
+    monkeypatch.setattr(bench, "preheat_bench", broken_preheat)
+    monkeypatch.setattr(ingest, "stream_train_mlp", stub)
+    monkeypatch.setenv("DF_BENCH_REPEATS", "3")
+    bench.main()
+    lines = [l for l in capfd.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert "no forecaster in sandbox" in rec["preheat_error"]
+    assert rec["wave_decisions_per_s"] > 0  # siblings unharmed
     assert rec["chaos_success_rate"] == 1.0

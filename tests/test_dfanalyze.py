@@ -1123,3 +1123,101 @@ def test_check_metrics_shim_still_works():
         assert check_metrics.check() == []
     finally:
         sys.path.remove(str(REPO / "hack"))
+
+
+PREHEAT_PLANNER_SHAPE_FIXTURE = '''
+import threading
+
+class Window:
+    """Demand side of the preheat sweep: its lock covers only the
+    series dict; snapshots copy out before anything else runs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._series = {}
+
+    def observe(self, task_id, count):
+        with self._lock:
+            self._series[task_id] = self._series.get(task_id, 0.0) + count
+
+    def series_batch(self):
+        with self._lock:
+            return dict(self._series)
+
+
+class Planner:
+    """Planner side: _lock guards ONLY the recently-planned map and is
+    never held across the window, the forecaster, or the resource
+    model — each snapshot/forecast happens before the lock, bookkeeping
+    after."""
+
+    def __init__(self, window):
+        self._lock = threading.Lock()
+        self._planned_at = {}
+        self.window = window
+
+    def sweep_once(self, now):
+        snapshot = self.window.series_batch()  # window lock, then released
+        picked = [t for t in snapshot if not self._covered(t, now)]
+        with self._lock:
+            for task_id in picked:
+                self._planned_at[task_id] = now
+        return picked
+
+    def _covered(self, task_id, now):
+        with self._lock:
+            at = self._planned_at.get(task_id)
+        return at is not None and now - at < 120.0
+
+    def stats(self):
+        with self._lock:
+            return {"cooling": len(self._planned_at)}
+'''
+
+
+def test_lockorder_preheat_planner_shape_is_clean(fakepkg):
+    """The preheat planner's lock model (Planner._lock for cooldown
+    bookkeeping only, Window._lock for the series dict, no hold across
+    the other) must analyze clean — the named baseline for the sweep's
+    lock shape."""
+    (fakepkg / "preheat_planner.py").write_text(PREHEAT_PLANNER_SHAPE_FIXTURE)
+    res = lockorder.run(fakepkg)
+    assert res.findings == [], [f.message for f in res.findings]
+
+
+def test_lockorder_catches_a_preheat_nesting_regression(fakepkg):
+    """The regression the clean shape guards against: a sweep that
+    snapshots the window while holding the planner lock, while the
+    window notifies the planner under its own lock — the ABBA a demand
+    observer callback could grow."""
+    (fakepkg / "preheat_bad.py").write_text(
+        '''
+import threading
+
+class BadPlanner:
+    def __init__(self):
+        self._lock = threading.Lock()        # cooldown bookkeeping
+        self._demand_lock = threading.Lock() # series dict
+
+    def sweep_once(self):
+        with self._lock:
+            self._snapshot()  # planner -> demand: held across the window
+
+    def _snapshot(self):
+        with self._demand_lock:
+            return {}
+
+    def observe(self):
+        with self._demand_lock:
+            self._note_planned()  # demand -> planner: the inversion
+
+    def _note_planned(self):
+        with self._lock:
+            pass
+'''
+    )
+    res = lockorder.run(fakepkg)
+    cycles = [f for f in res.findings if f.key.startswith("cycle:")]
+    assert cycles, [f.message for f in res.findings]
+    assert "BadPlanner._lock" in cycles[0].message
+    assert "BadPlanner._demand_lock" in cycles[0].message

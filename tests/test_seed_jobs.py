@@ -238,3 +238,59 @@ def test_stale_lease_result_rejected(manager_env):
         )
     )
     assert done.state == "succeeded"
+
+
+class _RefusingSeedClient:
+    """Seed-client double whose triggers refuse after ``accept`` urls —
+    the shape JobWorker._preheat must account for honestly."""
+
+    def __init__(self, accept: int = 0):
+        self.accept = accept
+        self.calls = 0
+
+    def seed_hosts(self):
+        return ["seed-host"]
+
+    def trigger(self, task_id, url, **kw):
+        self.calls += 1
+        return self.calls <= self.accept
+
+
+def test_preheat_zero_triggered_reports_failed():
+    """Every seed trigger refused → the job is FAILED, not a green
+    result with count 0 (the silent-failure bug this release fixes)."""
+    worker = JobWorker(None, res.Resource(), seed_client=_RefusingSeedClient(0))
+    state, result = worker.execute_now(
+        "preheat", {"urls": ["file:///a", "file:///b", "file:///c"]}
+    )
+    assert state == "failed"
+    assert result["count"] == 0
+    assert result["failed"] == 3
+    assert "0 of 3 urls triggered" in result["error"]
+
+
+def test_preheat_partial_success_reports_failed_count():
+    """Partial trigger success stays succeeded but says how many of N
+    were refused, so operators see the gap without diffing url lists."""
+    worker = JobWorker(None, res.Resource(), seed_client=_RefusingSeedClient(2))
+    state, result = worker.execute_now(
+        "preheat", {"urls": ["file:///a", "file:///b", "file:///c"]}
+    )
+    assert state == "succeeded"
+    assert result["count"] == 2
+    assert result["failed"] == 1
+    assert len(result["triggered"]) == 2
+    assert "error" not in result
+
+
+def test_execute_now_runs_inline_without_manager():
+    """The planner's managerless path: execute_now dispatches through
+    the same _execute the leased path runs."""
+    resource = res.Resource()
+    resource.host_manager.store(res.Host(id="h9", hostname="a", ip="9.9.9.9"))
+    worker = JobWorker(None, resource)
+    state, result = worker.execute_now("sync_peers", {})
+    assert state == "succeeded"
+    assert result["hosts"][0]["id"] == "h9"
+    state, result = worker.execute_now("nope", {})
+    assert state == "failed"
