@@ -115,9 +115,12 @@ class ProxyServer:
         self.mirror = mirror or RegistryMirror()
         self.issuer = issuer
         self.intercept = [re.compile(rx) for rx in intercept] if intercept else None
-        # optional callable(digest, url) fired per layer-blob GET served —
-        # the scheduler's preheat demand window subscribes here so layer
-        # pulls count as demand even before a DownloadRecord lands
+        # optional callable(digest, url, task_id="", meta=None) fired per
+        # layer-blob GET served WITHOUT riding P2P — the scheduler's
+        # preheat demand window subscribes here so direct-served layer
+        # pulls still count as demand (P2P-served pulls fold through the
+        # scheduler's own DownloadRecord sink; emitting here too would
+        # double-count them)
         self.on_layer_demand = None
         self._ssl_ctx_cache: dict[str, ssl.SSLContext] = {}
         self._ssl_lock = threading.Lock()
@@ -189,7 +192,7 @@ class ProxyServer:
             )
             handler.send_header("Content-Length", str(len(body)))
         M.PROXY_REQUEST_TOTAL.labels("p2p" if result.via_p2p else "direct").inc()
-        self._note_layer_demand(url, head=head)
+        self._note_layer_demand(url, result, head=head)
         handler.send_header("X-Dragonfly-Via-P2P", "1" if result.via_p2p else "0")
         if result.task_id:
             handler.send_header("X-Dragonfly-Task-Id", result.task_id)
@@ -200,19 +203,33 @@ class ProxyServer:
             for chunk in result.body:
                 handler.wfile.write(chunk)
 
-    def _note_layer_demand(self, url: str, head: bool = False) -> None:
+    def _note_layer_demand(self, url: str, result, head: bool = False) -> None:
         """Emit the per-layer-digest demand signal for a served blob GET
-        (HEADs are existence probes, not demand). Advisory: a raising
-        subscriber must never fail the response path."""
+        (HEADs are existence probes, not demand). Only successful (2xx)
+        pulls count — repeated 404/401 probes of a missing layer must not
+        rank it forecast-hot — and only pulls that did NOT ride P2P emit:
+        a P2P ride lands a DownloadRecord at the scheduler, which folds
+        the same pull there (emitting both would double-count it). When
+        the transport can resolve the swarm identity the pull WOULD ride
+        (task id + tag), it rides along so the preheat loop seeds the
+        exact task demanded clients join. Advisory: a raising subscriber
+        must never fail the response path."""
         if head or self.on_layer_demand is None:
+            return
+        if not 200 <= result.status < 300 or result.via_p2p:
             return
         m = _BLOB_PATH_RX.search(urlsplit(url).path)
         if m is None:
             return
         digest = m.group(1)
-        EV_LAYER_DEMAND(digest=digest)
+        task_id, target, meta = "", url, None
+        ctx = self.transport.p2p_task_context(url)
+        if ctx is not None:
+            task_id, target, tag = ctx
+            meta = {"tag": tag} if tag else {}
+        EV_LAYER_DEMAND(digest=digest, task_id=task_id)
         try:
-            self.on_layer_demand(digest, url)
+            self.on_layer_demand(digest, target, task_id=task_id, meta=meta)
         except Exception:
             logger.exception("layer-demand subscriber failed")
 

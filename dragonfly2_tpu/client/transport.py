@@ -137,6 +137,21 @@ class P2PTransport:
                 return rule
         return None
 
+    def p2p_task_context(self, url: str) -> "tuple[str, str, str] | None":
+        """(task_id, target_url, tag) of the swarm an unranged GET of
+        ``url`` joins under this transport's routing — the identity a
+        preheat must reproduce for its seeded content to be findable —
+        or None when the request would go direct (no rule / direct
+        rule), where no swarm exists to preheat into."""
+        rule = self.match_rule(url)
+        if rule is None or rule.direct or self.tasks is None:
+            return None
+        target = rule.rewrite(url)
+        task_id = self.tasks.task_id_for(
+            target, common_pb2.UrlMeta(tag=self.default_tag)
+        )
+        return task_id, target, self.default_tag
+
     def round_trip(
         self,
         url: str,

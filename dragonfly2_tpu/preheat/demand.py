@@ -24,6 +24,7 @@ import numpy as np
 
 from dragonfly2_tpu.scheduler import metrics as M
 from dragonfly2_tpu.utils import flight
+from dragonfly2_tpu.utils.idgen import URL_FILTER_SEPARATOR
 
 EV_TASK_DROPPED = flight.event_type("preheat.task_dropped")
 
@@ -37,12 +38,17 @@ SOURCE_LAYER = "layer"
 
 
 class _Series:
-    """One task's bucketed demand counts (sparse: bucket index → count)."""
+    """One task's bucketed demand counts (sparse: bucket index → count)
+    plus the trigger context — the URL and URLMeta fields (tag,
+    application, filter, range, digest) the demanded task's id was
+    derived from. The preheat job replays exactly this context so the
+    seeded content joins the swarm demanded clients actually join."""
 
-    __slots__ = ("url", "counts", "last_bucket")
+    __slots__ = ("url", "meta", "counts", "last_bucket")
 
     def __init__(self, url: str):
         self.url = url
+        self.meta: dict[str, str] = {}
         self.counts: dict[int, float] = {}
         self.last_bucket = 0
 
@@ -77,9 +83,13 @@ class DemandWindow:
         ts: "float | None" = None,
         count: float = 1.0,
         source: str = SOURCE_RECORD,
+        meta: "dict | None" = None,
     ) -> bool:
         """Fold one demand observation; False when the task cap refused
-        a new series (existing tasks always fold)."""
+        a new series (existing tasks always fold). ``meta`` is the
+        demanded task's URLMeta context (tag/application/filter/range/
+        digest) — carried so a preheat of this series seeds the very
+        task id demanded clients compute, not a planner-private one."""
         bucket = int((time.time() if ts is None else ts) / self.bucket_s)
         with self._lock:
             s = self._series.get(task_id)
@@ -96,6 +106,8 @@ class DemandWindow:
                 s = self._series[task_id] = _Series(url)
             elif url:
                 s.url = url  # keep the freshest URL for the preheat job
+            if meta:
+                s.meta = {k: v for k, v in meta.items() if v}
             s.counts[bucket] = s.counts.get(bucket, 0.0) + count
             if bucket > s.last_bucket:
                 s.last_bucket = bucket
@@ -106,24 +118,49 @@ class DemandWindow:
         M.PREHEAT_DEMAND_OBSERVED_TOTAL.labels(source).inc()
         return True
 
-    def observe_record(self, rec) -> None:
+    def observe_record(self, rec, task=None) -> None:
         """Fold a scheduler ``DownloadRecord`` (the storage.on_download
         hook shape): one download of the record's task at its creation
-        time."""
-        task = rec.task
+        time, keyed by the task's REAL id. When the live resource
+        ``task`` is supplied its full URLMeta context (tag, application,
+        filter, range, digest) rides along, so a preheat of this series
+        reproduces the demanded task id exactly; the record alone only
+        carries tag/application."""
+        if task is not None:
+            meta = {
+                "tag": task.tag,
+                "application": task.application,
+                "filter": URL_FILTER_SEPARATOR.join(task.filters),
+                "range": task.url_range,
+                "digest": task.digest,
+            }
+            url = task.url or rec.task.url
+        else:
+            meta = {"tag": rec.tag, "application": rec.application}
+            url = rec.task.url
         self.observe(
-            task.id,
-            url=task.url,
+            rec.task.id,
+            url=url,
             ts=rec.created_at / 1e9 if rec.created_at else None,
             source=SOURCE_RECORD,
+            meta=meta,
         )
 
-    def observe_layer(self, digest: str, url: str, ts: "float | None" = None) -> None:
+    def observe_layer(
+        self,
+        digest: str,
+        url: str,
+        ts: "float | None" = None,
+        task_id: str = "",
+        meta: "dict | None" = None,
+    ) -> None:
         """Fold a registry layer pull (the client proxy's per-layer-digest
-        demand signal): layer demand is content-addressed, so the digest
-        is the task key — every client pulling the same layer folds into
-        one series regardless of registry host."""
-        self.observe(digest, url=url, ts=ts, source=SOURCE_LAYER)
+        demand signal). When the proxy can resolve the P2P task identity
+        the pull would ride (``task_id`` + its URLMeta context), that id
+        keys the series so the preheat loop places content into the very
+        swarm demanded clients join; otherwise the layer digest keys it
+        (content-addressed fallback — same layer, one series)."""
+        self.observe(task_id or digest, url=url, ts=ts, source=SOURCE_LAYER, meta=meta)
 
     # -- reads -------------------------------------------------------------
     def series_batch(
@@ -160,6 +197,14 @@ class DemandWindow:
             del self._series[tid]
         if dead and len(self._series) < self.max_tasks:
             self._overflowed = False  # capacity is back; re-arm the marker
+
+    def meta_for(self, task_id: str) -> dict:
+        """The URLMeta context captured for ``task_id``'s series (empty
+        when the source carried none) — the planner attaches this to the
+        preheat job so the seed derives the demanded task id."""
+        with self._lock:
+            s = self._series.get(task_id)
+            return dict(s.meta) if s is not None else {}
 
     def task_count(self) -> int:
         with self._lock:

@@ -31,9 +31,12 @@ import time
 from dragonfly2_tpu.rpc import gen  # noqa: F401
 import manager_pb2  # noqa: E402
 
+import re
+
 from dragonfly2_tpu.scheduler import metrics as M
 from dragonfly2_tpu.scheduler.seed_placement import recommend_seeds_by_rtt
 from dragonfly2_tpu.utils import dflog, faults, flight, profiling, tracing
+from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
 
 logger = dflog.get("preheat.planner")
 
@@ -47,6 +50,12 @@ PH_SWEEP = profiling.phase_type("preheat.sweep")
 PH_FORECAST = profiling.phase_type("preheat.forecast")
 PH_PLAN = profiling.phase_type("preheat.plan")
 PH_FIT = profiling.phase_type("preheat.fit")
+
+# a demand-series key that IS a v1 task id (sha256 hex) — record-sourced
+# and p2p-layer-sourced series are keyed on the demanded task's real id;
+# anything else (e.g. a bare layer digest) needs the id derived from the
+# series' url + meta, exactly as the seed daemon will derive it
+_TASK_ID_RX = re.compile(r"^[0-9a-f]{64}$")
 
 DEFAULT_INTERVAL_S = 30.0
 DEFAULT_BUDGET = 4
@@ -179,11 +188,12 @@ class PreheatPlanner:
                 if not url:
                     self._skip(out, "no_url")
                     continue
-                reason = self._already_covered(task_id, now)
+                spec = self._trigger_spec(task_id, url)
+                reason = self._already_covered(task_id, spec["task_id"], now)
                 if reason:
                     self._skip(out, reason)
                     continue
-                picked.append((score, task_id, url))
+                picked.append((score, task_id, spec))
             seeds = self._rank_seeds() if picked else []
             out["planned"] = len(picked)
             span.set(planned=len(picked), seeds=len(seeds))
@@ -201,12 +211,39 @@ class PreheatPlanner:
                 M.PREHEAT_TASKS_PLANNED_TOTAL.inc(len(picked))
         return [{"picked": picked, "seeds": seeds}] if picked else []
 
-    def _already_covered(self, task_id: str, now: float) -> str:
-        """Non-empty reason when preheating ``task_id`` would waste the
+    def _trigger_spec(self, series_key: str, url: str) -> dict:
+        """The exact trigger the preheat job must replay for this series:
+        the demanded task's id plus the URLMeta context it was derived
+        from. Record- and p2p-layer-sourced series are keyed on the real
+        task id already; anything else (bare layer digest) derives it
+        from url + meta exactly as the seed daemon will — a preheat that
+        recomputed the id under planner-private tag/application would
+        seed a swarm no demanded client ever joins."""
+        meta = self.demand.meta_for(series_key)
+        if _TASK_ID_RX.fullmatch(series_key):
+            task_id = series_key
+        else:
+            task_id = task_id_v1(
+                url,
+                URLMeta(
+                    tag=meta.get("tag", ""),
+                    application=meta.get("application", ""),
+                    filter=meta.get("filter", ""),
+                    range=meta.get("range", ""),
+                    digest=meta.get("digest", ""),
+                ),
+            )
+        return {"task_id": task_id, "url": url, **meta}
+
+    def _already_covered(self, series_key: str, task_id: str, now: float) -> str:
+        """Non-empty reason when preheating this series would waste the
         budget: a seed peer already holds it, a seed download is in
-        flight, or this planner placed it within the cooldown."""
+        flight, or this planner placed it within the cooldown. The
+        inflight/held lookups use ``task_id`` — the id the preheat job
+        actually triggers (and the seed registers) under — while the
+        cooldown keys on the demand series."""
         with self._lock:
-            at = self._planned_at.get(task_id)
+            at = self._planned_at.get(series_key)
         if at is not None and now - at < self.cooldown_s:
             return "cooldown"
         if self.seed_client is not None and self.seed_client.is_inflight(task_id):
@@ -236,10 +273,12 @@ class PreheatPlanner:
         through the JobWorker."""
         picked = plan[0]["picked"]
         seeds = plan[0]["seeds"]
+        # per-task trigger specs carry the DEMANDED task's id + URLMeta
+        # context — tag/application participate in task_id_v1, so a
+        # planner-stamped tag would seed a swarm no demanded client joins
         args = {
-            "urls": [url for _, _, url in picked],
-            "tag": "preheat",
-            "application": "preheat-planner",
+            "tasks": [spec for _, _, spec in picked],
+            "urls": [spec["url"] for _, _, spec in picked],
             "seed_ranking": seeds,
             "scores": {tid: round(s, 4) for s, tid, _ in picked},
         }

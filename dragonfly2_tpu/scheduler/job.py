@@ -133,49 +133,67 @@ class JobWorker:
 
     # -- preheat (reference scheduler/job preheat → seed download) ------
     def _preheat(self, args: dict) -> tuple[str, dict]:
-        urls = args.get("urls") or ([args["url"]] if args.get("url") else [])
-        if not urls:
-            return "failed", {"error": "preheat needs urls"}
+        # two arg shapes: per-task trigger specs (the preheat planner —
+        # each carries the DEMANDED task's id + its own URLMeta context)
+        # or a plain url list sharing the job-level meta (manager-driven
+        # preheat, reference job.go)
+        entries = [dict(t) for t in args.get("tasks") or [] if t.get("url")]
+        if not entries:
+            urls = args.get("urls") or ([args["url"]] if args.get("url") else [])
+            entries = [
+                {
+                    "url": url,
+                    "tag": args.get("tag", ""),
+                    "application": args.get("application", ""),
+                    "filter": args.get("filter", ""),
+                    "range": args.get("range", ""),
+                    "digest": args.get("digest", ""),
+                }
+                for url in urls
+            ]
+        if not entries:
+            # zero urls is a malformed job, distinct from N urls all
+            # refusing to trigger below
+            return "failed", {"error": "no urls in job args"}
         if self.seed_client is None or not self.seed_client.seed_hosts():
             return "failed", {"error": "no seed peers available"}
-        tag = args.get("tag", "")
-        application = args.get("application", "")
-        url_filter = args.get("filter", "")
-        url_range = args.get("range", "")
-        digest = args.get("digest", "")
         triggered = []
         # child of whatever sweep/job span is current — inline preheat
         # (planner → JobWorker) renders as one forecast→plan→job→seed
         # timeline in dftrace
-        with tracing.maybe_span("scheduler", "preheat.seed_trigger", urls=len(urls)):
-            for url in urls:
+        with tracing.maybe_span("scheduler", "preheat.seed_trigger", urls=len(entries)):
+            for e in entries:
+                url = e["url"]
                 # the full meta participates in the task id — a preheat that
                 # dropped filter/range would seed a task no client ever matches
                 meta = URLMeta(
-                    tag=tag,
-                    application=application,
-                    filter=url_filter,
-                    range=url_range,
-                    digest=digest,
+                    tag=e.get("tag", ""),
+                    application=e.get("application", ""),
+                    filter=e.get("filter", ""),
+                    range=e.get("range", ""),
+                    digest=e.get("digest", ""),
                 )
-                task_id = task_id_v1(url, meta)
+                # an explicit task_id (planner spec) wins: it is the id the
+                # demanded download was observed under, and the trigger's
+                # inflight bookkeeping must match the planner's dedupe key
+                task_id = e.get("task_id") or task_id_v1(url, meta)
                 if self.seed_client.trigger(
                     task_id,
                     url,
-                    tag=tag,
-                    application=application,
-                    digest=digest,
-                    url_filter=url_filter,
-                    url_range=url_range,
+                    tag=meta.tag,
+                    application=meta.application,
+                    digest=meta.digest,
+                    url_filter=meta.filter,
+                    url_range=meta.range,
                 ):
                     triggered.append(task_id)
-        failed = len(urls) - len(triggered)
+        failed = len(entries) - len(triggered)
         out = {"triggered": triggered, "count": len(triggered), "failed": failed}
         if not triggered:
             # every trigger refused (seed hosts raced away, per-URL seed
             # capacity): reporting "succeeded" with count 0 buried real
             # failures in green job results
-            out["error"] = f"0 of {len(urls)} urls triggered"
+            out["error"] = f"0 of {len(entries)} urls triggered"
             return "failed", out
         return "succeeded", out
 

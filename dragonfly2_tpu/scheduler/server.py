@@ -339,7 +339,16 @@ class SchedulerServer:
                 window_buckets=config.preheat_window_buckets,
                 max_tasks=config.preheat_max_tasks,
             )
-            self.storage.on_download = demand.observe_record
+            # fold with the live task resolved so the series captures the
+            # demanded task's full URLMeta context (tag/application/
+            # filter/range/digest) — the preheat job replays it to seed
+            # the exact swarm demanded clients join
+            def _observe_download(rec, _demand=demand, _resource=self.resource):
+                _demand.observe_record(
+                    rec, task=_resource.task_manager.load(rec.task.id)
+                )
+
+            self.storage.on_download = _observe_download
             forecaster = DemandForecaster(
                 window_buckets=config.preheat_window_buckets,
                 horizon=config.preheat_horizon,

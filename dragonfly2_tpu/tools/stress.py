@@ -1087,10 +1087,14 @@ def wave_soak(
 
 class _PreheatSeedStub:
     """Seed-peer client double for the preheat soak: every trigger
-    lands (records the URL as seed-held), nothing is ever inflight."""
+    lands, nothing is ever inflight. Held content is keyed by TASK ID —
+    the rush looks tasks up under the id a demanding client computes, so
+    a planner that seeds under a different identity (e.g. recomputed
+    with planner-private tag/application) registers as a cold miss here
+    instead of a silent false hit."""
 
     def __init__(self):
-        self.held_urls: set = set()
+        self.held_ids: set = set()
         self.triggers = 0
 
     def seed_hosts(self):
@@ -1101,7 +1105,7 @@ class _PreheatSeedStub:
 
     def trigger(self, task_id: str, url: str, **kw) -> bool:
         self.triggers += 1
-        self.held_urls.add(url)
+        self.held_ids.add(task_id)
         return True
 
 
@@ -1154,6 +1158,7 @@ def preheat_soak(
     from dragonfly2_tpu.preheat.planner import PreheatPlanner
     from dragonfly2_tpu.scheduler.job import JobWorker
     from dragonfly2_tpu.utils import tracing
+    from dragonfly2_tpu.utils.idgen import task_id_v1
 
     try:  # the runtime jit witness lives in the repo's hack/ toolbox
         from hack.dfanalyze import jitwitness
@@ -1176,13 +1181,16 @@ def preheat_soak(
                 if step % 5 == 0:
                     window.observe(f"cold{i:02d}", url=url, ts=ts, count=0.25)
 
-    def rush(held_urls: set) -> tuple[list, int]:
+    def rush(held_ids: set) -> tuple[list, int]:
         """First-access latency per hot task (ms), measured: a held task
-        is a cache hit, a miss pays the back-to-source cold start."""
+        is a cache hit, a miss pays the back-to-source cold start. The
+        lookup key is the task id a demanding client derives from the
+        URL (``task_id_v1``) — preheated content only counts if it lives
+        in the swarm that client actually joins."""
         lats, hits = [], 0
         for url in hot_urls:
             t0 = time.perf_counter()
-            if url in held_urls:
+            if task_id_v1(url) in held_ids:
                 time.sleep(hit_ms / 1e3)
                 hits += 1
             else:
@@ -1261,7 +1269,7 @@ def preheat_soak(
     steady_wall = time.perf_counter() - t0
     forecast_rate = (forecaster.forecasts - forecasts0) / max(steady_wall, 1e-9)
 
-    armed_lats, hits = rush(seed_client.held_urls)
+    armed_lats, hits = rush(seed_client.held_ids)
 
     # -- off arm: the same rush, nothing preheated --------------------------
     off_lats, _ = rush(set())

@@ -23,6 +23,7 @@ from dragonfly2_tpu.scheduler.job import JobWorker
 from dragonfly2_tpu.schema import records as R
 from dragonfly2_tpu.trainer.serving import bucket_rows
 from dragonfly2_tpu.utils import faults, tracing
+from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
 
 
 @pytest.fixture
@@ -90,6 +91,55 @@ def test_observe_record_and_layer_sources():
     assert ids == ["sha256:abcd", "task-9"]
     assert urls[1] == "http://origin/blob"
     assert counts[:, -1].tolist() == [1.0, 1.0]
+
+
+class _LiveTask:
+    """Resource-task double with the URLMeta fields observe_record folds."""
+
+    url = "http://origin/blob?sig=x"
+    tag = "ml"
+    application = "batch"
+    filters = ["sig"]
+    url_range = ""
+    digest = "sha256:beef"
+
+
+def test_observe_record_captures_live_task_meta():
+    """With the live resource task resolved, the series carries the
+    demanded task's full URLMeta context — what the preheat job replays
+    so the seed derives the demanded task id, not a planner-private one."""
+    w = DemandWindow(bucket_s=10.0, window_buckets=4)
+    rec = R.DownloadRecord(
+        id="d1",
+        task=R.TaskRecord(id="task-9", url="http://origin/blob"),
+        created_at=int(2000.0 * 1e9),
+    )
+    w.observe_record(rec, task=_LiveTask())
+    assert w.meta_for("task-9") == {
+        "tag": "ml",
+        "application": "batch",
+        "filter": "sig",
+        "digest": "sha256:beef",
+    }
+    _, urls, _ = w.series_batch(now=2000.0)
+    assert urls == ["http://origin/blob?sig=x"]
+
+
+def test_observe_layer_keys_on_task_id_when_known():
+    """A layer pull whose P2P swarm identity is known folds under that
+    task id (the id a demanding client joins), digest only as fallback."""
+    w = DemandWindow(bucket_s=10.0, window_buckets=4)
+    w.observe_layer(
+        "sha256:abcd",
+        "http://mirror/v2/img/blobs/sha256:abcd",
+        ts=3000.0,
+        task_id="a" * 64,
+        meta={"tag": "registry"},
+    )
+    ids, _, _ = w.series_batch(now=3000.0)
+    assert ids == ["a" * 64]
+    assert w.meta_for("a" * 64) == {"tag": "registry"}
+    assert w.meta_for("unknown") == {}
 
 
 # ---------------------------------------------------------------------------
@@ -230,6 +280,8 @@ class _SeedStub:
         self.inflight = set()
         self.refuse = False
         self.triggered = []
+        self.triggered_ids = []
+        self.trigger_kwargs = []
 
     def seed_hosts(self):
         return ["seed-a"]
@@ -241,6 +293,8 @@ class _SeedStub:
         if self.refuse:
             return False
         self.triggered.append(url)
+        self.triggered_ids.append(task_id)
+        self.trigger_kwargs.append(kw)
         return True
 
 
@@ -331,9 +385,12 @@ def test_skip_reasons_held_inflight_cooldown(clean_faults):
     now = 700.0
     _feed(demand, ["held", "inflight", "fresh"], now)
     resource = _ResourceStub()
-    resource.held.add("held")
+    # held/inflight state lives under the id the preheat actually
+    # triggers (derived from the series' url + meta, as the seed daemon
+    # derives it) — the demand key alone would never match
+    resource.held.add(task_id_v1("http://o/held"))
     seed = _SeedStub()
-    seed.inflight.add("inflight")
+    seed.inflight.add(task_id_v1("http://o/inflight"))
     planner, seed = _planner(demand, seed=seed, resource=resource, budget_per_sweep=4)
     out = planner.sweep_once(now=now)
     assert out["planned"] == 1 and out["skipped"] == 2
@@ -363,6 +420,50 @@ def test_failed_job_releases_cooldown_for_retry(clean_faults):
     seed.refuse = False
     out2 = planner.sweep_once(now=now + 1)
     assert out2["triggered"] == 1 and seed.triggered == ["http://o/t1"]
+
+
+def test_preheat_triggers_under_demanded_task_identity(clean_faults):
+    """THE identity contract (the bug this release fixes): a series
+    observed under a real task id with its URLMeta context must be
+    preheated under exactly that id and meta — a planner-stamped
+    tag/application would seed a swarm no demanded client joins."""
+    demand = DemandWindow(bucket_s=1.0, window_buckets=4)
+    now = 1000.0
+    url = "http://origin/model.bin"
+    meta = {"tag": "ml", "application": "batch"}
+    demanded_id = task_id_v1(url, URLMeta(tag="ml", application="batch"))
+    demand.observe(demanded_id, url=url, ts=now, count=5.0, meta=meta)
+    planner, seed = _planner(demand, budget_per_sweep=4)
+    out = planner.sweep_once(now=now)
+    assert out["triggered"] == 1
+    assert seed.triggered_ids == [demanded_id]
+    kw = seed.trigger_kwargs[0]
+    assert kw["tag"] == "ml" and kw["application"] == "batch"
+
+
+def test_layer_series_without_task_id_derives_client_identity(clean_faults):
+    """A digest-keyed layer series (no swarm id resolved at observe
+    time) is preheated under the id a demanding client would derive
+    from the URL + captured meta — never under the digest string or a
+    planner-private identity."""
+    demand = DemandWindow(bucket_s=1.0, window_buckets=4)
+    now = 1100.0
+    url = "http://mirror/v2/img/blobs/sha256:abcd"
+    demand.observe_layer("sha256:abcd", url, ts=now, meta={"tag": "registry"})
+    # make it forecast-hot enough to plan
+    demand.observe("sha256:abcd", ts=now, count=4.0)
+    planner, seed = _planner(demand, budget_per_sweep=4)
+    out = planner.sweep_once(now=now)
+    assert out["triggered"] == 1
+    assert seed.triggered_ids == [task_id_v1(url, URLMeta(tag="registry"))]
+    assert seed.trigger_kwargs[0]["tag"] == "registry"
+    # dedupe consults the DERIVED id: with that id inflight, the next
+    # sweep skips instead of re-preheating past the cooldown forever
+    seed.inflight.add(task_id_v1(url, URLMeta(tag="registry")))
+    later = now + planner.cooldown_s + 1
+    demand.observe("sha256:abcd", url=url, ts=later, count=4.0)
+    out2 = planner.sweep_once(now=later)
+    assert out2["planned"] == 0 and out2["skipped"] == 1
 
 
 def test_plan_fault_lands_in_error_outcome(clean_faults):

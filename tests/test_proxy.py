@@ -363,3 +363,42 @@ def test_range_refusing_origin_is_negatively_cached(tmp_path):
     t.round_trip("http://o/x.bin", headers={"Range": "bytes=0-9"})
     t.round_trip("http://o/x.bin", headers={"Range": "bytes=10-19"})
     assert calls["p2p"] == 1  # one failure, then the negative cache
+
+
+def test_layer_demand_signal_gates_and_carries_swarm_identity():
+    """The preheat demand signal fires only for successful (2xx) blob
+    GETs that did NOT ride P2P — a P2P ride lands a DownloadRecord at
+    the scheduler and folds there; emitting both would double-count one
+    pull — and it carries the swarm identity (task id + tag) a demanding
+    client computes, so preheat seeds the task clients actually join."""
+    import dataclasses
+
+    from dragonfly2_tpu.client.proxy import ProxyServer
+    from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
+
+    class _TM:
+        def task_id_for(self, url, url_meta):
+            return task_id_v1(url, URLMeta(tag=url_meta.tag))
+
+    t = P2PTransport(_TM(), rules=[ProxyRule(regex=r"/v2/")], default_tag="reg")
+    proxy = ProxyServer(t, port=0)
+    seen = []
+    proxy.on_layer_demand = (
+        lambda digest, url, task_id="", meta=None: seen.append(
+            (digest, url, task_id, meta)
+        )
+    )
+    url = "http://r/v2/lib/img/blobs/sha256:00ff"
+    ok = TransportResult(status=200, headers={}, body=iter(()))
+    try:
+        proxy._note_layer_demand(url, dataclasses.replace(ok, via_p2p=True))
+        proxy._note_layer_demand(url, dataclasses.replace(ok, status=404))
+        proxy._note_layer_demand(url, dataclasses.replace(ok, status=502))
+        proxy._note_layer_demand(url, ok, head=True)  # HEAD is a probe
+        proxy._note_layer_demand("http://r/v2/lib/img/manifests/latest", ok)
+        proxy._note_layer_demand(url, ok)  # the one real demand signal
+    finally:
+        proxy._server.server_close()
+    assert seen == [
+        ("sha256:00ff", url, task_id_v1(url, URLMeta(tag="reg")), {"tag": "reg"})
+    ]

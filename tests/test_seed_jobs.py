@@ -247,12 +247,14 @@ class _RefusingSeedClient:
     def __init__(self, accept: int = 0):
         self.accept = accept
         self.calls = 0
+        self.triggered = []
 
     def seed_hosts(self):
         return ["seed-host"]
 
     def trigger(self, task_id, url, **kw):
         self.calls += 1
+        self.triggered.append((task_id, url, kw))
         return self.calls <= self.accept
 
 
@@ -281,6 +283,47 @@ def test_preheat_partial_success_reports_failed_count():
     assert result["failed"] == 1
     assert len(result["triggered"]) == 2
     assert "error" not in result
+
+
+def test_preheat_task_specs_trigger_demanded_identity():
+    """The planner's per-task specs: an explicit task_id (the id the
+    demand was observed under) and per-url meta ride through to the seed
+    trigger verbatim — the job must never recompute a different identity
+    from job-level tag/application."""
+    from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
+
+    seed = _RefusingSeedClient(2)
+    worker = JobWorker(None, res.Resource(), seed_client=seed)
+    demanded = task_id_v1("file:///a", URLMeta(tag="ml"))
+    state, result = worker.execute_now(
+        "preheat",
+        {
+            "tasks": [
+                {"task_id": demanded, "url": "file:///a", "tag": "ml"},
+                {"url": "file:///b", "tag": "reg", "application": "pull"},
+            ],
+            # job-level meta must NOT leak into per-task triggers
+            "tag": "planner-private",
+        },
+    )
+    assert state == "succeeded"
+    assert result["count"] == 2 and result["failed"] == 0
+    tid_a, url_a, kw_a = seed.triggered[0]
+    assert tid_a == demanded and url_a == "file:///a" and kw_a["tag"] == "ml"
+    tid_b, _, kw_b = seed.triggered[1]
+    # no explicit id: derived from the entry's own url + meta, exactly
+    # as the seed daemon will derive it
+    assert tid_b == task_id_v1("file:///b", URLMeta(tag="reg", application="pull"))
+    assert kw_b["tag"] == "reg" and kw_b["application"] == "pull"
+
+
+def test_preheat_empty_args_is_a_distinct_failure():
+    """Zero urls is a malformed job ('no urls in job args'), distinct
+    from N urls all refusing to trigger ('0 of N urls triggered')."""
+    worker = JobWorker(None, res.Resource(), seed_client=_RefusingSeedClient(0))
+    state, result = worker.execute_now("preheat", {"urls": []})
+    assert state == "failed"
+    assert result["error"] == "no urls in job args"
 
 
 def test_execute_now_runs_inline_without_manager():
