@@ -399,7 +399,7 @@ class Daemon:
                 glue.ServiceClient(self._manager_channel, glue.TELEMETRY_SERVICE),
                 service="daemon",
                 instance=f"{self.cfg.ip}:{self.port}",
-                prefixes=("dragonfly_daemon_",),
+                prefixes=("dragonfly_daemon_", "dragonfly_flow_"),
                 interval=self.cfg.telemetry_interval,
                 collect_sections=_sections,
             )
@@ -541,9 +541,13 @@ class Daemon:
         content's swarm."""
         import io
 
+        from dragonfly2_tpu.utils import flows
         from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
 
         task_id = task_id_v1(url, URLMeta(digest=digest))
+        # seed-on-write tasks belong to the object plane: later uploads
+        # of these pieces to child peers attribute there
+        flows.set_task_plane(task_id, "object")
         if self.storage.find_completed_task(task_id) is not None:
             return
         self.task_manager.import_completed_task(

@@ -25,13 +25,21 @@ from __future__ import annotations
 import hashlib
 import json
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dragonfly2_tpu.manager.objectstorage import ObjectStorage
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, flight, flows, profiling
 
 logger = dflog.get("client.objectstorage")
+
+# dfprof phase: one gateway op (route + backend/transport leg)
+PH_OBJECT_OP = profiling.phase_type("daemon.object_op")
+
+# provenance anomaly: an object GET that should have ridden P2P but
+# fell back to a direct fetch — carries the swallowed cause
+EV_OBJECT_FALLBACK = flight.event_type("daemon.object_fallback")
 
 # replication modes (reference objectstorage.go WriteBack / AsyncWriteBack)
 MODE_BACKEND_ONLY = 0
@@ -81,6 +89,12 @@ class ObjectStorageGateway:
     ):
         self.backend = backend
         self.transport = transport
+        self.plane = "object"
+        if transport is not None:
+            # the gateway IS the object plane front: stamp its transport
+            # so piece-level flow attribution agrees with the gateway's
+            # own request-level accounting
+            transport.plane = self.plane
         self.importer = importer
         self.url_for = url_for
         outer = self
@@ -121,25 +135,8 @@ class ObjectStorageGateway:
     # ------------------------------------------------------------------
     def _route(self, h: BaseHTTPRequestHandler, method: str) -> None:
         try:
-            parts = urllib.parse.urlsplit(h.path)
-            segs = [s for s in parts.path.split("/") if s]
-            query = dict(urllib.parse.parse_qsl(parts.query))
-            if len(segs) >= 1 and segs[0] == "buckets":
-                if len(segs) == 2 and method == "PUT":
-                    return self._create_bucket(h, segs[1])
-                if len(segs) == 3 and segs[2] == "objects" and method == "GET":
-                    return self._list_objects(h, segs[1], query.get("prefix", ""))
-                if len(segs) >= 4 and segs[2] == "objects":
-                    key = "/".join(segs[3:])
-                    if method == "PUT":
-                        return self._put_object(h, segs[1], key, query)
-                    if method == "GET":
-                        return self._get_object(h, segs[1], key)
-                    if method == "HEAD":
-                        return self._head_object(h, segs[1], key)
-                    if method == "DELETE":
-                        return self._delete_object(h, segs[1], key)
-            h.send_error(404, "no such route")
+            with PH_OBJECT_OP:
+                return self._route_inner(h, method)
         except FileNotFoundError:
             h.send_error(404, "object not found")
         except Exception as e:
@@ -148,6 +145,27 @@ class ObjectStorageGateway:
                 h.send_error(500, str(e))
             except Exception:
                 pass
+
+    def _route_inner(self, h: BaseHTTPRequestHandler, method: str) -> None:
+        parts = urllib.parse.urlsplit(h.path)
+        segs = [s for s in parts.path.split("/") if s]
+        query = dict(urllib.parse.parse_qsl(parts.query))
+        if len(segs) >= 1 and segs[0] == "buckets":
+            if len(segs) == 2 and method == "PUT":
+                return self._create_bucket(h, segs[1])
+            if len(segs) == 3 and segs[2] == "objects" and method == "GET":
+                return self._list_objects(h, segs[1], query.get("prefix", ""))
+            if len(segs) >= 4 and segs[2] == "objects":
+                key = "/".join(segs[3:])
+                if method == "PUT":
+                    return self._put_object(h, segs[1], key, query)
+                if method == "GET":
+                    return self._get_object(h, segs[1], key)
+                if method == "HEAD":
+                    return self._head_object(h, segs[1], key)
+                if method == "DELETE":
+                    return self._delete_object(h, segs[1], key)
+        h.send_error(404, "no such route")
 
     # ------------------------------------------------------------------
     def _create_bucket(self, h, bucket: str) -> None:
@@ -209,6 +227,7 @@ class ObjectStorageGateway:
                     h.send_header("Content-Length", "0")
                     h.end_headers()
                     return
+        t0 = time.monotonic()
         if self.transport is not None and self.url_for is not None:
             # client Range rides through the transport, which serves it
             # as a P2P ranged task or goes direct. A whole-object digest
@@ -222,6 +241,16 @@ class ObjectStorageGateway:
                 headers={"Range": rng} if rng else None,
                 digest=self._digest_of(bucket, key),
             )
+            if result.fallback_cause:
+                # the P2P leg failed and the transport went direct —
+                # name the cause instead of swallowing it
+                logger.warning(
+                    "object get %s/%s skipped the swarm: %s",
+                    bucket, key, result.fallback_cause,
+                )
+                EV_OBJECT_FALLBACK(
+                    bucket=bucket, key=key, cause=result.fallback_cause
+                )
             if result.status == 404:
                 raise FileNotFoundError(key)
             if result.status not in (200, 206):
@@ -262,8 +291,23 @@ class ObjectStorageGateway:
                 h.send_header("X-Dragonfly-Task-Id", result.task_id)
             h.end_headers()
             # stream — multi-GB objects must not be buffered per request
+            served = 0
             for chunk in body:
                 h.wfile.write(chunk)
+                served += len(chunk)
+            # flow ledger: P2P rides were attributed at the piece write;
+            # local reuse and direct responses are acquired here
+            if result.via_p2p and not result.local_cache:
+                provenance = "parent"
+            elif result.local_cache:
+                provenance = "local_cache"
+            else:
+                provenance = "origin"
+            if served:
+                flows.serve(self.plane, served)
+                if provenance != "parent":
+                    flows.account(self.plane, provenance, served)
+            flows.request(self.plane, provenance, latency_s=time.monotonic() - t0)
             return
         body = self.backend.get_object(bucket, key)
         if rr:
@@ -278,6 +322,11 @@ class ObjectStorageGateway:
         h.send_header("X-Dragonfly-Via-P2P", "0")
         h.end_headers()
         h.wfile.write(body)
+        if body:
+            # no transport: bytes come straight off the backend (origin)
+            flows.serve(self.plane, len(body))
+            flows.account(self.plane, "origin", len(body))
+        flows.request(self.plane, "origin", latency_s=time.monotonic() - t0)
 
     def _head_object(self, h, bucket: str, key: str) -> None:
         if not self.backend.head_object(bucket, key):

@@ -21,7 +21,7 @@ from dataclasses import dataclass, field
 from dragonfly2_tpu.client import downloader, source
 from dragonfly2_tpu.client.pieces import PieceRange, compute_piece_length, piece_ranges
 from dragonfly2_tpu.client.storage import StorageError, TaskStorage
-from dragonfly2_tpu.utils import dflog, faults, flight, profiling
+from dragonfly2_tpu.utils import dflog, faults, flight, flows, profiling
 
 logger = dflog.get("client.piece")
 
@@ -167,6 +167,13 @@ class PieceManager:
             raise downloader.PieceDownloadError(
                 f"piece {pr.number} from {parent.peer_id}: {e}"
             ) from e
+        # flow ledger: one request per parent piece fetch, attributed
+        # like the bytes were (a ref hit is a dedup request)
+        flows.request(
+            flows.task_plane(ts.meta.task_id),
+            "dedup" if pm.ref_task else "parent",
+            latency_s=dt,
+        )
         return PieceResult(pm.number, pm.offset, pm.length, pm.digest, pm.traffic_type, pm.cost_ns, parent.peer_id)
 
     # ------------------------------------------------------------------
@@ -264,6 +271,7 @@ class PieceManager:
                 bytes=content_length,
                 wall_s=round(time.monotonic() - t_start, 3),
             )
+            self._account_source_request(ts, time.monotonic() - t_start)
             return content_length
 
         # sequential stream → pieces (write offsets are slice-relative)
@@ -318,7 +326,16 @@ class PieceManager:
             bytes=write_off,
             wall_s=round(time.monotonic() - t_start, 3),
         )
+        self._account_source_request(ts, time.monotonic() - t_start)
         return write_off
+
+    @staticmethod
+    def _account_source_request(ts: TaskStorage, wall_s: float) -> None:
+        flows.request(
+            flows.task_plane(ts.meta.task_id),
+            "preheat" if flows.is_preheat(ts.meta.task_id) else "origin",
+            latency_s=wall_s,
+        )
 
 
 @dataclass

@@ -21,19 +21,32 @@ import socket
 import ssl
 import tempfile
 import threading
+import time
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlsplit, urlunsplit
 
 from dragonfly2_tpu.client.transport import P2PTransport, ProxyRule
 from dragonfly2_tpu.client import metrics as M
-from dragonfly2_tpu.utils import dflog, flight
+from dragonfly2_tpu.utils import dflog, faults, flight, flows, profiling, tracing
 
 logger = dflog.get("client.proxy")
 
 # registry layer fetch observed through the proxy — the preheat demand
 # window consumes these as per-layer-digest demand signal
 EV_LAYER_DEMAND = flight.event_type("daemon.layer_demand")
+
+# provenance anomaly: a P2P-capable pull that skipped the swarm — the
+# event carries the swallowed cause so dfdoctor incidents can name WHY
+# a layer went to the origin (satellite: no more silent fallbacks)
+EV_PROXY_FALLBACK = flight.event_type("daemon.proxy_fallback")
+
+# dfprof phase: one registry-proxy pull end to end (route + transfer)
+PH_PROXY_PULL = profiling.phase_type("daemon.proxy_pull")
+
+# fault point: the proxy pull path — chaos schedules model a wedged
+# proxy front here (deterministic 502, never a hang)
+FP_PROXY_PULL = faults.point("daemon.proxy_pull")
 
 # `/v2/<name>/blobs/<digest>` — the layer-blob GET shape every OCI
 # registry dialect shares
@@ -110,8 +123,14 @@ class ProxyServer:
         port: int = 0,
         issuer=None,  # utils.issuer.SpoofingIssuer → enables HTTPS MITM
         intercept: list[str] | None = None,  # host regexes; None = all hosts
+        plane: str = "image",
     ):
         self.transport = transport
+        # the proxy IS the registry plane front: stamp its transport so
+        # piece-level flow attribution and the proxy's own request-level
+        # accounting agree on the plane
+        self.plane = plane
+        transport.plane = plane
         self.mirror = mirror or RegistryMirror()
         self.issuer = issuer
         self.intercept = [re.compile(rx) for rx in intercept] if intercept else None
@@ -172,36 +191,84 @@ class ProxyServer:
             k: v for k, v in handler.headers.items() if k.lower() not in _HOP_HEADERS
         }
         try:
-            result = self.transport.round_trip(url, headers, head=head)
-        except Exception as e:
-            handler.send_error(502, f"upstream fetch failed: {e}")
+            FP_PROXY_PULL()
+        except faults.InjectedFault as e:
+            handler.send_error(502, f"proxy pull fault: {e}")
             return
-        handler.send_response(result.status)
-        # forward upstream headers (Content-Type matters to registry
-        # clients); hop-by-hop and length/encoding are re-derived here
-        for k, v in result.headers.items():
-            if k.lower() not in _HOP_HEADERS and k.lower() != "content-length":
-                handler.send_header(k, v)
-        if result.content_length >= 0:
-            handler.send_header("Content-Length", str(result.content_length))
-        else:
-            # unknown length: fall back to buffering this response
-            body = result.read_all()
-            result = dataclasses.replace(
-                result, body=iter([body]), content_length=len(body)
-            )
-            handler.send_header("Content-Length", str(len(body)))
-        M.PROXY_REQUEST_TOTAL.labels("p2p" if result.via_p2p else "direct").inc()
-        self._note_layer_demand(url, result, head=head)
-        handler.send_header("X-Dragonfly-Via-P2P", "1" if result.via_p2p else "0")
-        if result.task_id:
-            handler.send_header("X-Dragonfly-Task-Id", result.task_id)
-        handler.end_headers()
-        if not head:
-            # stream chunk-by-chunk — a multi-GB layer must not be
-            # buffered whole per request
-            for chunk in result.body:
-                handler.wfile.write(chunk)
+        # continue the caller's trace through the proxy hop; the span's
+        # own context rides the outbound headers, so a direct origin
+        # fetch carries it upstream (trace-context propagation)
+        parent_ctx = tracing.parse_traceparent(
+            handler.headers.get(tracing.TRACEPARENT_HEADER)
+        )
+        t0 = time.monotonic()
+        with tracing.get("daemon").span(
+            "daemon.proxy_pull", parent=parent_ctx, url=url, head=head
+        ) as sp, PH_PROXY_PULL:
+            headers[tracing.TRACEPARENT_HEADER] = tracing.format_traceparent(sp)
+            try:
+                result = self.transport.round_trip(url, headers, head=head)
+            except Exception as e:
+                handler.send_error(502, f"upstream fetch failed: {e}")
+                return
+            if result.fallback_cause:
+                # the P2P leg failed and the transport degraded to a
+                # direct fetch — name the cause instead of swallowing it
+                ctx = self.transport.p2p_task_context(url)
+                logger.warning(
+                    "proxy pull %s skipped the swarm: %s", url, result.fallback_cause
+                )
+                EV_PROXY_FALLBACK(
+                    url=url,
+                    cause=result.fallback_cause,
+                    task_id=ctx[0] if ctx is not None else "",
+                )
+            handler.send_response(result.status)
+            # forward upstream headers (Content-Type matters to registry
+            # clients); hop-by-hop and length/encoding are re-derived here
+            for k, v in result.headers.items():
+                if k.lower() not in _HOP_HEADERS and k.lower() != "content-length":
+                    handler.send_header(k, v)
+            if result.content_length >= 0:
+                handler.send_header("Content-Length", str(result.content_length))
+            else:
+                # unknown length: fall back to buffering this response
+                body = result.read_all()
+                result = dataclasses.replace(
+                    result, body=iter([body]), content_length=len(body)
+                )
+                handler.send_header("Content-Length", str(len(body)))
+            M.PROXY_REQUEST_TOTAL.labels("p2p" if result.via_p2p else "direct").inc()
+            self._note_layer_demand(url, result, head=head)
+            handler.send_header("X-Dragonfly-Via-P2P", "1" if result.via_p2p else "0")
+            if result.task_id:
+                handler.send_header("X-Dragonfly-Task-Id", result.task_id)
+            handler.end_headers()
+            served = 0
+            if not head:
+                # stream chunk-by-chunk — a multi-GB layer must not be
+                # buffered whole per request
+                for chunk in result.body:
+                    handler.wfile.write(chunk)
+                    served += len(chunk)
+            # flow ledger: a P2P ride's bytes were already attributed at
+            # the piece write (origin/parent/dedup); the request-level
+            # cases — completed-task local reuse and direct origin
+            # responses — are acquired here, where the bytes move
+            if result.via_p2p and not result.local_cache:
+                provenance = "parent"
+            elif result.local_cache:
+                provenance = "local_cache"
+            else:
+                provenance = "origin"
+            if served:
+                flows.serve(self.plane, served)
+                if provenance != "parent":
+                    flows.account(self.plane, provenance, served)
+            if 200 <= result.status < 400:
+                flows.request(
+                    self.plane, provenance, latency_s=time.monotonic() - t0
+                )
 
     def _note_layer_demand(self, url: str, result, head: bool = False) -> None:
         """Emit the per-layer-digest demand signal for a served blob GET

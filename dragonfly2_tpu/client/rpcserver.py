@@ -18,7 +18,7 @@ import dfdaemon_pb2  # noqa: E402
 
 from dragonfly2_tpu.client.peertask import FileTaskRequest, TaskManager
 from dragonfly2_tpu.client.storage import StorageManager
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, flows
 
 logger = dflog.get("client.rpc")
 
@@ -49,9 +49,24 @@ class DfdaemonService:
             disable_back_source=request.disable_back_source,
             need_back_to_source=request.need_back_to_source,
         )
+        if request.need_back_to_source:
+            # the preheat plane is the only caller that forces
+            # back-to-source over this RPC (scheduler seed trigger) —
+            # mark the task so the ledger attributes its origin bytes
+            # to "preheat" seeding, not demand
+            flows.mark_preheat(
+                self.tasks.task_id_for(request.url, request.url_meta)
+            )
         task_id, peer_id, conductor = self.tasks.start_file_task(req)
         if conductor is None:  # reuse path — start_file_task already stored
             ts = self.storage.load(task_id)
+            if ts.meta.content_length > 0:
+                flows.serve(flows.task_plane(task_id), ts.meta.content_length)
+                flows.account(
+                    flows.task_plane(task_id),
+                    "local_cache",
+                    ts.meta.content_length,
+                )
             yield dfdaemon_pb2.DownloadResult(
                 task_id=task_id,
                 peer_id=peer_id,
@@ -80,6 +95,8 @@ class DfdaemonService:
                 output=request.output,
             )
             if p.done:
+                if p.completed_length > 0:
+                    flows.serve(flows.task_plane(task_id), p.completed_length)
                 return
 
     # ------------------------------------------------------------------

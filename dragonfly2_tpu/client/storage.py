@@ -36,7 +36,7 @@ import time
 from dataclasses import asdict, dataclass, field
 
 from dragonfly2_tpu.client import metrics as M
-from dragonfly2_tpu.utils import dflog, flight
+from dragonfly2_tpu.utils import dflog, flight, flows
 from dragonfly2_tpu.utils.digest import md5_from_bytes
 
 logger = dflog.get("client.storage")
@@ -327,6 +327,25 @@ class TaskStorage:
             if self._dirty_pieces >= self.PERSIST_EVERY:
                 self._dirty_pieces = 0
                 self.persist()
+        # Flow-ledger attribution (outside the task lock): this is the
+        # single acquisition choke point, and the classes are exclusive
+        # — a piece is a dedup ref, a parent transfer, or an origin
+        # read, never two — which is what makes per-plane byte
+        # conservation checkable. "local_peer" imports are skipped: the
+        # bytes were already on this host, nothing was acquired.
+        if data and traffic_type != "local_peer":
+            if holder is not None:
+                prov = "dedup"
+            elif traffic_type == "remote_peer":
+                prov = "parent"
+            elif traffic_type == "back_to_source":
+                prov = (
+                    "preheat" if flows.is_preheat(self.meta.task_id) else "origin"
+                )
+            else:
+                prov = ""
+            if prov:
+                flows.account(flows.task_plane(self.meta.task_id), prov, len(data))
         return pm
 
     # ------------------------------------------------------------------

@@ -28,7 +28,7 @@ import common_pb2  # noqa: E402
 from dragonfly2_tpu.client import metrics as M
 from dragonfly2_tpu.client import source
 from dragonfly2_tpu.client.peertask import FileTaskRequest, TaskManager
-from dragonfly2_tpu.utils import dflog
+from dragonfly2_tpu.utils import dflog, flows
 
 logger = dflog.get("client.transport")
 
@@ -68,6 +68,12 @@ class TransportResult:
     content_length: int = -1
     via_p2p: bool = False
     task_id: str = ""
+    # the task was already complete in local storage — bytes stream from
+    # disk with no new acquisition (flow provenance "local_cache")
+    local_cache: bool = False
+    # non-empty when this is a direct response produced by a P2P
+    # failure: the swallowed cause, surfaced for logs + flight events
+    fallback_cause: str = ""
 
     def read_all(self) -> bytes:
         return b"".join(self.body)
@@ -113,11 +119,16 @@ class P2PTransport:
         default_tag: str = "",
         timeout: float = 300.0,
         max_inflight: int | None = None,
+        plane: str = "file",
     ):
         self.tasks = task_manager
         self.rules = rules or []
         self.default_tag = default_tag
         self.timeout = timeout
+        # flow-ledger traffic plane every task started through this
+        # transport belongs to ("image" for the registry proxy,
+        # "object" for the dfstore gateway)
+        self.plane = plane
         self._no_range: dict[str, float] = {}
         self._no_range_lock = threading.Lock()
         # bound on concurrent P2P stream tasks: each one costs piece
@@ -243,7 +254,9 @@ class P2PTransport:
                             u: t for u, t in self._no_range.items() if t > now
                         }
                     self._no_range[target] = now + self.NO_RANGE_TTL
-            return self._direct(target, headers, head)
+            res = self._direct(target, headers, head)
+            res.fallback_cause = f"{type(e).__name__}: {e}"
+            return res
 
     # ------------------------------------------------------------------
     def _via_p2p(
@@ -261,13 +274,15 @@ class P2PTransport:
         # identity versioning without slice-verification semantics.
         fwd = {k: v for k, v in (headers or {}).items() if k.lower() != "range"}
         tag = f"{self.default_tag}|{tag_salt}" if tag_salt else self.default_tag
-        req = FileTaskRequest(
-            url=url,
-            url_meta=common_pb2.UrlMeta(
-                tag=tag, digest=digest, range=byte_range
-            ),
-            headers=fwd,
-        )
+        url_meta = common_pb2.UrlMeta(tag=tag, digest=digest, range=byte_range)
+        req = FileTaskRequest(url=url, url_meta=url_meta, headers=fwd)
+        # stamp the task's traffic plane BEFORE the task starts so the
+        # first pieces never race to the implicit "file" plane; the
+        # completed-task check tells the caller the bytes come from
+        # local storage with no new acquisition
+        task_id = self.tasks.task_id_for(url, url_meta)
+        flows.set_task_plane(task_id, self.plane)
+        local_reuse = self.tasks.storage.find_completed_task(task_id) is not None
         # stream frontend: the response starts at first byte, not last —
         # a multi-GB layer pull begins flowing while later pieces are
         # still in flight (reference peertask_stream.go)
@@ -295,6 +310,7 @@ class P2PTransport:
             content_length=content_length,
             via_p2p=True,
             task_id=task_id,
+            local_cache=local_reuse,
         )
 
     def _direct(self, url: str, headers: dict | None, head: bool) -> TransportResult:

@@ -35,7 +35,7 @@ from dragonfly2_tpu.client import metrics as M
 from dragonfly2_tpu.client.piece_manager import RateLimiter
 from dragonfly2_tpu.client.storage import StorageError, StorageManager
 from dragonfly2_tpu.client.transfer import EventLoop
-from dragonfly2_tpu.utils import dflog, flight, profiling
+from dragonfly2_tpu.utils import dflog, flight, flows, profiling
 
 logger = dflog.get("client.upload")
 
@@ -62,7 +62,7 @@ class _Conn:
     __slots__ = (
         "sock", "peer", "buf", "head", "spans", "span_file", "span_off",
         "span_left", "body_done", "close_after", "serving_piece",
-        "serve_t0", "writing", "zero_left", "pending",
+        "serve_t0", "flow_plane", "writing", "zero_left", "pending",
     )
 
     def __init__(self, sock: socket.socket, peer):
@@ -81,6 +81,7 @@ class _Conn:
         self.close_after = False
         self.serving_piece = False  # counts toward piece metrics/phases
         self.serve_t0 = 0.0
+        self.flow_plane = "file"  # demanded plane of the piece's task
         self.writing = False
         # a response parked on a delay timer: requests pipelined behind
         # it must wait (HTTP/1.1 ordering), and the timer must find the
@@ -334,6 +335,7 @@ class UploadServer:
                 extra.append(("X-Dragonfly-Origin-Content-Type", ct))
             conn.serving_piece = True
             conn.serve_t0 = time.perf_counter()
+            conn.flow_plane = flows.task_plane(task_id)
             self._start_response(
                 conn, 200, [(path, off, length)], length, extra
             )
@@ -545,6 +547,7 @@ class UploadServer:
         conn.span_left -= n
         if conn.serving_piece:
             M.PIECE_UPLOAD_BYTES.inc(n)
+            flows.upload(conn.flow_plane, n)
         if conn.span_left == 0 and not conn.spans and conn.zero_left == 0:
             conn.body_done = True
         return n

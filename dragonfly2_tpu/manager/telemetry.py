@@ -45,10 +45,15 @@ from dragonfly2_tpu.utils import dflog, flight
 # (utils/telemetry.py, linted by dfanalyze) so producer and consumers
 # (dfstat, the soak's manager-view check) can never drift apart
 from dragonfly2_tpu.utils.telemetry import (
+    F_CLUSTER_FLOW_BYTES,
+    F_CLUSTER_P2P_EFFICIENCY,
     F_CLUSTER_PEERS,
     F_CLUSTER_SCHEDULE_OPS,
     F_CLUSTER_TASKS,
     F_DAEMON_BACK_TO_SOURCE,
+    F_DAEMON_FLOW_BYTES,
+    F_DAEMON_FLOW_ORIGIN_BYTES,
+    F_DAEMON_FLOW_P2P_BYTES,
     F_DAEMON_PIECE_BYTES,
     F_SHARD_ANNOUNCE_OPS,
     F_SHARD_DECISION_P99,
@@ -347,6 +352,20 @@ def default_slos() -> "list[SLOSpec]":
             threshold_s=14 * 24 * 3600.0,  # 2× the default train interval
             description="the parent-scorer fit is recent",
         ),
+        SLOSpec(
+            name="p2p_efficiency",
+            kind="ratio",
+            objective=0.5,
+            service="daemon",
+            # flow-ledger rollups (utils/flows): "good" bytes never
+            # touched the origin (parent + dedup + local_cache), "bad"
+            # bytes did (demand back-to-source + preheat seeding); the
+            # ratio error_rate is the origin fraction, so burn > 1 ⇔
+            # p2p efficiency below the 0.5 objective
+            good_series="dragonfly_flow_p2p_bytes_total",
+            bad_series="dragonfly_flow_origin_bytes_total",
+            description="bytes are served from the swarm, not the origin",
+        ),
     ]
 
 
@@ -581,6 +600,9 @@ class TelemetryPlane:
         daemons = []
         cluster_ops = {w: 0.0 for w in WINDOWS_S}
         cluster_peers = cluster_tasks = 0.0
+        cluster_flow = {w: 0.0 for w in WINDOWS_S}
+        cluster_flow_p2p = {w: 0.0 for w in WINDOWS_S}
+        cluster_flow_origin = {w: 0.0 for w in WINDOWS_S}
         for r in reps:
             stale = r.stale(now)
             services.append(
@@ -673,6 +695,14 @@ class TelemetryPlane:
                     }
                 )
             elif r.service == "daemon":
+                flow = rates(r, "dragonfly_flow_bytes_total")
+                flow_p2p = rates(r, "dragonfly_flow_p2p_bytes_total")
+                flow_origin = rates(r, "dragonfly_flow_origin_bytes_total")
+                if not stale:
+                    for w in cluster_flow:
+                        cluster_flow[w] += flow[w]
+                        cluster_flow_p2p[w] += flow_p2p[w]
+                        cluster_flow_origin[w] += flow_origin[w]
                 daemons.append(
                     {
                         "instance": r.instance,
@@ -683,6 +713,12 @@ class TelemetryPlane:
                         F_DAEMON_BACK_TO_SOURCE: rates(
                             r, "dragonfly_daemon_back_to_source_total"
                         ),
+                        F_DAEMON_FLOW_BYTES: flow,
+                        F_DAEMON_FLOW_P2P_BYTES: flow_p2p,
+                        F_DAEMON_FLOW_ORIGIN_BYTES: flow_origin,
+                        # per-plane provenance rollup as reported by the
+                        # daemon's own ledger (utils/flows section)
+                        "flows": r.sections.get("flows", {}),
                     }
                 )
         return {
@@ -701,6 +737,23 @@ class TelemetryPlane:
                 },
                 F_CLUSTER_PEERS: cluster_peers,
                 F_CLUSTER_TASKS: cluster_tasks,
+                F_CLUSTER_FLOW_BYTES: {
+                    w: round(v, 2) for w, v in cluster_flow.items()
+                },
+                # good-byte fraction per window; None while the ledger
+                # has moved nothing in that window
+                F_CLUSTER_P2P_EFFICIENCY: {
+                    w: (
+                        round(
+                            cluster_flow_p2p[w]
+                            / (cluster_flow_p2p[w] + cluster_flow_origin[w]),
+                            4,
+                        )
+                        if (cluster_flow_p2p[w] + cluster_flow_origin[w]) > 0
+                        else None
+                    )
+                    for w in WINDOWS_S
+                },
             },
             "slos": [
                 {

@@ -27,10 +27,14 @@ import urllib.request
 # same constants the manager's snapshot builder keys on, so this view
 # and the plane can never drift apart
 from dragonfly2_tpu.utils.telemetry import (
+    F_CLUSTER_P2P_EFFICIENCY,
     F_CLUSTER_PEERS,
     F_CLUSTER_SCHEDULE_OPS,
     F_CLUSTER_TASKS,
     F_DAEMON_BACK_TO_SOURCE,
+    F_DAEMON_FLOW_BYTES,
+    F_DAEMON_FLOW_ORIGIN_BYTES,
+    F_DAEMON_FLOW_P2P_BYTES,
     F_DAEMON_PIECE_BYTES,
     F_SHARD_ANNOUNCE_OPS,
     F_SHARD_DECISION_P99,
@@ -77,10 +81,12 @@ def render(snap: dict, window: str = "1m") -> str:
     lines: list[str] = []
     cluster = snap.get("cluster", {})
     ops = cluster.get(F_CLUSTER_SCHEDULE_OPS, {})
+    eff = (cluster.get(F_CLUSTER_P2P_EFFICIENCY) or {}).get(window)
     lines.append(
         f"dragonfly cluster  peers={cluster.get(F_CLUSTER_PEERS, 0):.0f}"
         f"  tasks={cluster.get(F_CLUSTER_TASKS, 0):.0f}"
         f"  schedule_ops/s[{window}]={ops.get(window, 0.0)}"
+        f"  p2p_eff[{window}]={'-' if eff is None else f'{eff:.2f}'}"
         f"  services={len(snap.get('services', []))}"
     )
 
@@ -174,11 +180,52 @@ def render(snap: dict, window: str = "1m") -> str:
                 "stale" if d.get("stale") else "live",
                 f"{d.get(F_DAEMON_PIECE_BYTES, {}).get(window, 0.0)}",
                 f"{d.get(F_DAEMON_BACK_TO_SOURCE, {}).get(window, 0.0)}",
+                f"{d.get(F_DAEMON_FLOW_P2P_BYTES, {}).get(window, 0.0)}",
+                f"{d.get(F_DAEMON_FLOW_ORIGIN_BYTES, {}).get(window, 0.0)}",
             ]
             for d in daemons
         ]
         lines += _table(
-            rows, ["daemon", "state", f"piece B/s[{window}]", f"b2s/s[{window}]"]
+            rows,
+            ["daemon", "state", f"piece B/s[{window}]", f"b2s/s[{window}]",
+             f"p2p B/s[{window}]", f"origin B/s[{window}]"],
+        )
+
+    # traffic planes: the flow ledger's per-plane provenance split,
+    # summed across the daemons' reported "flows" sections
+    planes: dict[str, dict] = {}
+    for d in daemons:
+        for plane, row in (d.get("flows", {}) or {}).get("planes", {}).items():
+            agg = planes.setdefault(
+                plane,
+                {"origin": 0, "parent": 0, "dedup": 0, "local_cache": 0,
+                 "preheat": 0, "served": 0, "upload": 0},
+            )
+            for prov, n in (row.get("bytes", {}) or {}).items():
+                if prov in agg:
+                    agg[prov] += int(n)
+            agg["served"] += int(row.get("served_bytes", 0))
+            agg["upload"] += int(row.get("upload_bytes", 0))
+    if planes:
+        lines.append("")
+        lines.append("traffic planes (cumulative bytes by provenance)")
+        rows = []
+        for plane in sorted(planes):
+            a = planes[plane]
+            total = a["origin"] + a["parent"] + a["dedup"] + a["local_cache"] + a["preheat"]
+            good = a["parent"] + a["dedup"] + a["local_cache"]
+            rows.append(
+                [
+                    plane,
+                    a["origin"], a["parent"], a["dedup"], a["local_cache"],
+                    a["preheat"], a["served"], a["upload"],
+                    f"{good / total:.2f}" if total else "-",
+                ]
+            )
+        lines += _table(
+            rows,
+            ["plane", "origin", "parent", "dedup", "local$", "preheat",
+             "served", "upload", "p2p_eff"],
         )
     return "\n".join(lines) + "\n"
 

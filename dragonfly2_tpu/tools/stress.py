@@ -48,6 +48,15 @@ budget-capped plan → preheat job → seed triggers) must produce a
 measured cold-start p50 strictly below the no-preheat arm, with zero
 lost downloads, the whole sweep linked into one dftrace timeline, and
 zero steady-state retraces on the forecast path.
+
+Seventh mode: ``--registry`` runs the flow-ledger acceptance soak
+(docs/observability.md): two image tags sharing layer blobs are pulled
+through two daemons' registry proxies, then a dfstore import/GET round
+drives the object plane. The byte-provenance ledger (utils/flows) must
+show content-addressed dedup on the second tag (``layer_dedup_ratio``
+> 0), a second-tag ``p2p_efficiency`` above 0.5, and exact per-plane
+byte conservation — bytes served at each plane edge equal the sum of
+that plane's provenance cells.
 """
 
 from __future__ import annotations
@@ -1672,6 +1681,278 @@ def shard_kill_soak(
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def registry_soak(
+    shared_layers: int = 2,
+    unique_layers: int = 1,
+    piece: int = 16 * 1024,
+    pieces_per_layer: int = 3,
+    object_bytes: int = 48 * 1024,
+) -> dict:
+    """Registry + object-storage acceptance soak for the flow ledger
+    (utils/flows): two daemons front an in-memory blob origin through
+    their registry proxies; two image tags share ``shared_layers``
+    identical layer blobs (same digest, different ``/v2/<repo>/blobs/``
+    paths — distinct swarm tasks, identical content) plus
+    ``unique_layers`` per-tag blobs. Pull order lights every provenance:
+
+      tag app-a via daemon A  ->  origin   (back-to-source acquisition)
+      tag app-a via daemon B  ->  parent   (P2P from A)
+      tag app-b via daemon A  ->  dedup shared + origin unique
+      tag app-b via daemon B  ->  dedup shared + parent unique
+
+    then a dfstore round (PUT mode=1 import on A, double GET through B)
+    lights the object plane's parent and local_cache cells. Gates: every
+    body byte-exact, ``layer_dedup_ratio`` > 0, the second tag's
+    ``p2p_efficiency`` delta > 0.5, and per-plane byte conservation —
+    bytes served at each plane edge equal the sum of that plane's
+    provenance cells.
+    """
+    import http.server
+    import shutil
+    import urllib.request
+
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.rpc.glue import serve
+    from dragonfly2_tpu.scheduler import resource as res
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+    from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+    from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
+    from dragonfly2_tpu.scheduler.storage import Storage
+    from dragonfly2_tpu.utils import flows
+
+    layer_len = piece * pieces_per_layer
+    shared = [os.urandom(layer_len) for _ in range(shared_layers)]
+    uniques = {
+        repo: [os.urandom(layer_len) for _ in range(unique_layers)]
+        for repo in ("app-a", "app-b")
+    }
+    # blob namespace mirrors a registry: shared layers appear under BOTH
+    # repo paths with the same digest name (that is what "two tags share
+    # a layer" looks like on the wire — same digest, different repo URL)
+    blobs: dict = {}
+    for repo in ("app-a", "app-b"):
+        for i, data in enumerate(shared):
+            blobs[f"/v2/{repo}/blobs/sha256:shared-{i}"] = data
+        for i, data in enumerate(uniques[repo]):
+            blobs[f"/v2/{repo}/blobs/sha256:{repo}-{i}"] = data
+
+    class BlobHandler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _blob(self):
+            return blobs.get(self.path.split("?", 1)[0])
+
+        def do_HEAD(self):
+            data = self._blob()
+            if data is None:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.send_header("Accept-Ranges", "bytes")
+            self.end_headers()
+
+        def do_GET(self):
+            data = self._blob()
+            if data is None:
+                self.send_error(404)
+                return
+            rng = self.headers.get("Range", "")
+            if rng.startswith("bytes="):
+                start_s, _, end_s = rng[6:].partition("-")
+                if not start_s:
+                    start = max(0, len(data) - int(end_s))
+                    end = len(data) - 1
+                else:
+                    start = int(start_s)
+                    end = int(end_s) if end_s else len(data) - 1
+                chunk = data[start : end + 1]
+                self.send_response(206)
+                self.send_header("Content-Length", str(len(chunk)))
+                self.send_header(
+                    "Content-Range", f"bytes {start}-{end}/{len(data)}"
+                )
+                self.end_headers()
+                self.wfile.write(chunk)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    tmp = tempfile.mkdtemp(prefix="dfregistry-")
+    t_start = time.perf_counter()
+    origin = server = None
+    daemons: list = []
+    latencies: list = []
+    bad = 0
+
+    def pull(d, repo) -> int:
+        """One tag pull through a daemon's proxy: every blob of the repo."""
+        nonlocal bad
+        pulled = 0
+        for path, data in sorted(blobs.items()):
+            if f"/v2/{repo}/" not in path:
+                continue
+            req = urllib.request.Request(f"{origin_url}{path}")
+            req.set_proxy(f"127.0.0.1:{d.proxy.port}", "http")
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = resp.read()
+            latencies.append(time.perf_counter() - t0)
+            bad += int(body != data)
+            pulled += 1
+        return pulled
+
+    def plane_row(snap, plane):
+        return snap["planes"][plane]
+
+    def settled_snapshot() -> dict:
+        """The handler's trailing ``flows`` calls run AFTER the client
+        sees the last body byte — poll until the ledger stops moving so
+        snapshots never race a request's own accounting."""
+        snap = flows.snapshot()
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+            nxt = flows.snapshot()
+            if nxt == snap:
+                return nxt
+            snap = nxt
+        return snap
+
+    try:
+        origin = http.server.ThreadingHTTPServer(("127.0.0.1", 0), BlobHandler)
+        threading.Thread(target=origin.serve_forever, daemon=True).start()
+        origin_url = f"http://127.0.0.1:{origin.server_address[1]}"
+
+        service = SchedulerService(
+            res.Resource(),
+            Scheduling(
+                BaseEvaluator(),
+                SchedulingConfig(retry_interval=0.0, retry_back_to_source_limit=2),
+            ),
+            storage=Storage(os.path.join(tmp, "sched"), buffer_size=1),
+        )
+        server, port = serve({SERVICE_NAME: service})
+        # the object backend is SHARED: both gateways see the same
+        # bucket files and build the same file:// origin URL, so the
+        # object lands in ONE swarm task with A as the imported seed
+        obj_root = os.path.join(tmp, "objects")
+        for name in ("a", "b"):
+            d = Daemon(
+                DaemonConfig(
+                    data_dir=os.path.join(tmp, f"daemon-{name}"),
+                    scheduler_address=f"127.0.0.1:{port}",
+                    hostname=f"registry-{name}",
+                    ip="127.0.0.1",
+                    piece_length=piece,
+                    announce_interval=0.5,
+                    schedule_timeout=5.0,
+                    proxy_port=0,
+                    proxy_rules=[{"regex": r"/v2/.+/blobs/"}],
+                    object_storage_port=0,
+                    object_storage_dir=obj_root,
+                )
+            )
+            d.start()
+            daemons.append(d)
+        a, b = daemons
+
+        flows.reset()
+        pulls = pull(a, "app-a") + pull(b, "app-a")
+        snap1 = settled_snapshot()
+        pulls += pull(a, "app-b") + pull(b, "app-b")
+        snap2 = settled_snapshot()
+
+        # second tag in isolation: the delta between the snapshots
+        d_p2p = snap2["p2p_bytes"] - snap1["p2p_bytes"]
+        d_total = snap2["total_bytes"] - snap1["total_bytes"]
+        second_tag_eff = (d_p2p / d_total) if d_total else 0.0
+
+        # dfstore round: import on A, double GET through B
+        obj = os.urandom(object_bytes)
+        ga = f"http://127.0.0.1:{a.object_gateway.port}"
+        gb = f"http://127.0.0.1:{b.object_gateway.port}"
+        opener = urllib.request.build_opener(
+            urllib.request.ProxyHandler({})  # gateways are origins, not proxies
+        )
+        req = urllib.request.Request(f"{ga}/buckets/soak", method="PUT")
+        opener.open(req, timeout=10).close()
+        req = urllib.request.Request(
+            f"{ga}/buckets/soak/objects/blob.bin?mode=1", data=obj, method="PUT"
+        )
+        opener.open(req, timeout=10).close()
+        with opener.open(
+            f"{gb}/buckets/soak/objects/blob.bin", timeout=30
+        ) as resp:
+            bad += int(resp.read() != obj)
+        # wait for B's stream task to COMPLETE locally before the reuse
+        # GET: a re-GET against a still-finishing task joins the live
+        # swarm and serves already-written pieces with no new
+        # acquisition — legal, but it muddies the exact conservation
+        # check this soak gates on (the conductor's finish handshake
+        # trails the last body byte)
+        import hashlib as _hashlib
+
+        from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
+
+        obj_task = task_id_v1(
+            f"file://{obj_root}/soak/blob.bin",
+            URLMeta(digest="sha256:" + _hashlib.sha256(obj).hexdigest()),
+        )
+        deadline = time.monotonic() + 5.0
+        while (
+            b.task_manager.storage.find_completed_task(obj_task) is None
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        with opener.open(
+            f"{gb}/buckets/soak/objects/blob.bin", timeout=30
+        ) as resp:
+            bad += int(resp.read() != obj)
+        snap3 = settled_snapshot()
+
+        img = plane_row(snap3, "image")
+        dedup_bytes = img["bytes"]["dedup"]
+        image_total = sum(img["bytes"].values())
+        conserved = all(
+            sum(plane_row(snap3, pl)["bytes"].values())
+            == plane_row(snap3, pl)["served_bytes"]
+            for pl in ("image", "object")
+        )
+        latencies.sort()
+        return {
+            "registry_pulls": pulls,
+            "registry_bad_bytes": bad,
+            "proxy_pull_p50_ms": round(_percentile(latencies, 0.50) * 1e3, 2),
+            "layer_dedup_ratio": round(
+                dedup_bytes / image_total if image_total else 0.0, 4
+            ),
+            "p2p_efficiency": round(second_tag_eff, 4),
+            "flow_conserved": int(conserved),
+            "object_p2p_bytes": plane_row(snap3, "object")["bytes"]["parent"],
+            "object_cache_bytes": plane_row(snap3, "object")["bytes"]["local_cache"],
+            "registry_wall_s": round(time.perf_counter() - t_start, 2),
+        }
+    finally:
+        for d in daemons:
+            try:
+                d.stop()
+            except Exception as e:
+                print(f"stress: daemon stop during teardown failed: {e}", file=sys.stderr)
+        if server is not None:
+            try:
+                server.stop(0)
+            except Exception:
+                pass
+        if origin is not None:
+            origin.shutdown()
+            origin.server_close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="df-stress", description=__doc__)
     p.add_argument("--url", help="target url; {i} varies per request")
@@ -1739,6 +2020,19 @@ def main(argv=None) -> int:
                    help="demand-window task count for --preheat")
     p.add_argument("--preheat-hot", type=int, default=8,
                    help="forecast-hot tasks in the --preheat workload")
+    p.add_argument(
+        "--registry",
+        action="store_true",
+        help="run the registry/object-storage flow-ledger soak: two tags"
+        " sharing layer blobs pulled through two daemons' proxies plus a"
+        " dfstore import/GET round; gates on byte-exact bodies,"
+        " layer_dedup_ratio > 0, second-tag p2p_efficiency > 0.5, and"
+        " per-plane byte conservation (served == sum of provenances)",
+    )
+    p.add_argument("--registry-shared", type=int, default=2,
+                   help="layer blobs shared between the two tags")
+    p.add_argument("--registry-unique", type=int, default=1,
+                   help="per-tag unique layer blobs")
     p.add_argument("--daemon", default="", help="dfdaemon gRPC address (Download path)")
     p.add_argument("--proxy", default="", help="daemon proxy address (HTTP path)")
     p.add_argument("-c", "--connections", type=int, default=8)
@@ -1747,6 +2041,19 @@ def main(argv=None) -> int:
     p.add_argument("--tag", default="stress")
     p.add_argument("--output", default="", help="per-request CSV path")
     args = p.parse_args(argv)
+    if args.registry:
+        stats = registry_soak(
+            shared_layers=args.registry_shared,
+            unique_layers=args.registry_unique,
+        )
+        print(json.dumps(stats))
+        ok = (
+            stats["registry_bad_bytes"] == 0
+            and stats["layer_dedup_ratio"] > 0
+            and stats["p2p_efficiency"] > 0.5
+            and stats["flow_conserved"] == 1
+        )
+        return 0 if ok else 1
     if args.data_plane:
         stats = data_plane_race(
             children=args.data_plane_children,

@@ -9,9 +9,12 @@ paths need lock-cheap increments more than they need a client library.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_logger = logging.getLogger("dragonfly.metrics")
 
 _DEFAULT_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
@@ -270,6 +273,24 @@ class Registry:
         self.namespace = namespace
         self._metrics: dict[str, _Metric] = {}
         self._lock = threading.Lock()
+        self._sync_hooks: list = []
+
+    def on_sync(self, fn) -> None:
+        """Register a zero-arg callable run before every exposition or
+        registry snapshot — the flight-recorder discipline for series
+        whose hot path must not touch a counter lock (the flow ledger):
+        deltas flush here, once per read, instead of per event."""
+        with self._lock:
+            self._sync_hooks.append(fn)
+
+    def sync(self) -> None:
+        """Run the sync hooks; reader-side, so a failing hook must not
+        take the scrape down with it."""
+        for fn in list(self._sync_hooks):
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — scrape survives a bad hook
+                _logger.debug("metric sync hook %r failed: %s", fn, e)
 
     def _register(self, metric: _Metric) -> _Metric:
         with self._lock:
@@ -295,6 +316,7 @@ class Registry:
         )
 
     def expose(self) -> str:
+        self.sync()
         with self._lock:
             metrics = [self._metrics[n] for n in sorted(self._metrics)]
         lines: list[str] = []
@@ -306,6 +328,7 @@ class Registry:
         """OpenMetrics text exposition: the format that carries
         exemplars (trace_id on histogram buckets). Served by
         MetricsServer when the scraper negotiates it via Accept."""
+        self.sync()
         with self._lock:
             metrics = [self._metrics[n] for n in sorted(self._metrics)]
         lines: list[str] = []
@@ -531,6 +554,46 @@ class MetricsServer:
                         ctype = "application/json"
                     self.send_response(200)
                     self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if url.path == "/debug/flows":
+                    import json
+
+                    # lazy import: flows registers its own series in
+                    # this module's default registry at import time
+                    from dragonfly2_tpu.utils import flows
+
+                    params = parse_qs(url.query, keep_blank_values=True)
+                    unknown = set(params) - {"window"}
+                    window = 60.0
+                    err = ""
+                    if unknown:
+                        err = f"unknown parameters: {sorted(unknown)}"
+                    elif "window" in params:
+                        import math
+
+                        try:
+                            window = float(params["window"][0])
+                        except ValueError:
+                            window = -1.0
+                        if not math.isfinite(window) or window <= 0:
+                            err = "window must be a positive finite number"
+                    if err:
+                        data = json.dumps({"error": err}).encode()
+                        self.send_response(400)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                        return
+                    snap = flows.snapshot()
+                    snap["window_s"] = window
+                    snap["window_rates"] = flows.window_rates(window)
+                    data = json.dumps(snap, default=str).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)

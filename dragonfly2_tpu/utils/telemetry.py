@@ -97,6 +97,12 @@ F_TRAINER_FIT_FRESHNESS = TFIELDS.tfield("trainer.fit_freshness_s")
 # per-daemon data-plane view
 F_DAEMON_PIECE_BYTES = TFIELDS.tfield("daemon.piece_bytes_per_s")
 F_DAEMON_BACK_TO_SOURCE = TFIELDS.tfield("daemon.back_to_source_per_s")
+# flow-ledger rollups (utils/flows: byte provenance x traffic plane)
+F_DAEMON_FLOW_BYTES = TFIELDS.tfield("daemon.flow_bytes_per_s")
+F_DAEMON_FLOW_P2P_BYTES = TFIELDS.tfield("daemon.flow_p2p_bytes_per_s")
+F_DAEMON_FLOW_ORIGIN_BYTES = TFIELDS.tfield("daemon.flow_origin_bytes_per_s")
+F_CLUSTER_FLOW_BYTES = TFIELDS.tfield("cluster.flow_bytes_per_s")
+F_CLUSTER_P2P_EFFICIENCY = TFIELDS.tfield("cluster.p2p_efficiency")
 # SLO engine outputs (manager/telemetry.py)
 F_SLO_BURN_FAST = TFIELDS.tfield("slo.burn_rate_fast")
 F_SLO_BURN_SLOW = TFIELDS.tfield("slo.burn_rate_slow")
@@ -122,6 +128,7 @@ def registry_snapshot(
     deploys) share one default registry, and each reporter must not
     claim its siblings' series."""
     registry = registry or default_registry
+    registry.sync()  # lazily-synced series (flow ledger) flush first
     with registry._lock:
         metrics = list(registry._metrics.values())
     counters: dict[str, float] = {}
@@ -234,6 +241,17 @@ class TelemetryReporter:
                 payload["prof"] = prof
         except Exception as e:
             logger.debug("telemetry prof section failed: %s", e)
+        try:
+            # flow ledger: per-plane byte-provenance rollup (utils/flows)
+            # — same generic-section ride as prof; quiet processes (no
+            # bytes ever accounted) omit it
+            from dragonfly2_tpu.utils import flows
+
+            fl = flows.telemetry_section()
+            if fl:
+                payload["flows"] = fl
+        except Exception as e:
+            logger.debug("telemetry flows section failed: %s", e)
         return payload, cur
 
     def push_once(self) -> bool:

@@ -31,7 +31,7 @@ PACKAGE = DEFAULT_PACKAGE
 # (dragonfly_build_info{service,version} — every exporter carries it)
 ALLOWED_SERVICES = (
     "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "flight",
-    "faults", "resilience", "fleet", "build", "prof", "preheat",
+    "faults", "resilience", "fleet", "build", "prof", "preheat", "flow",
 )
 
 # flight-recorder event names are <service>.<what>; the service segment
@@ -67,6 +67,13 @@ WAVE_EVENT_MODULES = (
     "dragonfly2_tpu/scheduler/evaluator.py",
     "dragonfly2_tpu/scheduler/serving.py",
 )
+
+# the daemon.proxy_* and daemon.object_* event segments belong to the
+# registry-proxy and object-storage traffic planes (docs/observability.md
+# "flow ledger"): a proxy-ish or object-ish event declared elsewhere
+# would fork the vocabulary the traffic-plane census and dfdoctor key on
+PROXY_EVENT_MODULE = "dragonfly2_tpu/client/proxy.py"
+OBJECT_EVENT_MODULE = "dragonfly2_tpu/client/objectstorage.py"
 
 # the preheat.* event namespace (its own flight ring) belongs to the
 # predictive preheat plane: demand folding, forecasting, planning — a
@@ -257,6 +264,28 @@ def check(package_dir: Path = PACKAGE) -> list[str]:
                     f"{site}: event {name!r} uses the reserved"
                     " scheduler.wave_ segment; wave events are"
                     f" declared in {WAVE_EVENT_MODULES} only"
+                )
+            # daemon.proxy_* belongs to the registry proxy plane
+            if (
+                service == "daemon"
+                and (what == "proxy" or what.startswith("proxy_"))
+                and str(rel) != PROXY_EVENT_MODULE
+            ):
+                failures.append(
+                    f"{site}: event {name!r} uses the reserved"
+                    " daemon.proxy_ segment; proxy events are declared in"
+                    f" {PROXY_EVENT_MODULE} only"
+                )
+            # daemon.object_* belongs to the object-storage gateway plane
+            if (
+                service == "daemon"
+                and (what == "object" or what.startswith("object_"))
+                and str(rel) != OBJECT_EVENT_MODULE
+            ):
+                failures.append(
+                    f"{site}: event {name!r} uses the reserved"
+                    " daemon.object_ segment; object-storage events are"
+                    f" declared in {OBJECT_EVENT_MODULE} only"
                 )
             # the preheat.* ring belongs to the predictive preheat plane
             if service == "preheat" and str(rel) not in PREHEAT_EVENT_MODULES:

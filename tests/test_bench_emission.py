@@ -145,6 +145,29 @@ def _fake_preheat_bench():
     }
 
 
+def _fake_registry_bench():
+    # the real soak spawns two daemons + proxies + gateways (~1s);
+    # emission tests only assert the KEYS ride the artifact — the soak
+    # itself is covered end-to-end by tests/test_flows.py and the CLI
+    # soak (stress --registry)
+    return {
+        "proxy_pull_p50_ms": 9.5,
+        "layer_dedup_ratio": 0.33,
+        "p2p_efficiency": 0.83,
+        "flow_conserved": 1,
+        "registry_bad_bytes": 0,
+        "registry_wall_s": 0.4,
+    }
+
+
+def _fake_flow_overhead_bench():
+    return {
+        "flow_accounting_overhead_pct": 1.1,
+        "flow_account_us": 0.4,
+        "schedule_op_flow_us": 33.0,
+    }
+
+
 def _run_main(monkeypatch, capfd, fit_stub):
     monkeypatch.setattr(bench, "synthesize_dataset", _fake_synthesize)
     monkeypatch.setattr(bench, "synthesize_dataset_binary", _fake_synthesize_binary)
@@ -155,6 +178,8 @@ def _run_main(monkeypatch, capfd, fit_stub):
     monkeypatch.setattr(bench, "data_plane_bench", _fake_data_plane_bench)
     monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
     monkeypatch.setattr(bench, "preheat_bench", _fake_preheat_bench)
+    monkeypatch.setattr(bench, "registry_bench", _fake_registry_bench)
+    monkeypatch.setattr(bench, "flow_overhead_bench", _fake_flow_overhead_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", fit_stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     monkeypatch.delenv("DF_BENCH_CPU_FALLBACK", raising=False)
@@ -502,6 +527,8 @@ def test_chaos_soak_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "serving_bench", _fake_serving_bench)
     monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
     monkeypatch.setattr(bench, "preheat_bench", _fake_preheat_bench)
+    monkeypatch.setattr(bench, "registry_bench", _fake_registry_bench)
+    monkeypatch.setattr(bench, "flow_overhead_bench", _fake_flow_overhead_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -531,6 +558,8 @@ def test_fleet_soak_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "serving_bench", _fake_serving_bench)
     monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
     monkeypatch.setattr(bench, "preheat_bench", _fake_preheat_bench)
+    monkeypatch.setattr(bench, "registry_bench", _fake_registry_bench)
+    monkeypatch.setattr(bench, "flow_overhead_bench", _fake_flow_overhead_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -632,6 +661,8 @@ def test_multichip_bench_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "data_plane_bench", _fake_data_plane_bench)
     monkeypatch.setattr(bench, "multichip_scaling_bench", broken_multichip)
     monkeypatch.setattr(bench, "preheat_bench", _fake_preheat_bench)
+    monkeypatch.setattr(bench, "registry_bench", _fake_registry_bench)
+    monkeypatch.setattr(bench, "flow_overhead_bench", _fake_flow_overhead_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -778,6 +809,8 @@ def test_serving_bench_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "serving_bench", broken_serving)
     monkeypatch.setattr(bench, "wave_bench", _fake_wave_bench)
     monkeypatch.setattr(bench, "preheat_bench", _fake_preheat_bench)
+    monkeypatch.setattr(bench, "registry_bench", _fake_registry_bench)
+    monkeypatch.setattr(bench, "flow_overhead_bench", _fake_flow_overhead_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -841,6 +874,8 @@ def test_wave_bench_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "data_plane_bench", _fake_data_plane_bench)
     monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
     monkeypatch.setattr(bench, "preheat_bench", _fake_preheat_bench)
+    monkeypatch.setattr(bench, "registry_bench", _fake_registry_bench)
+    monkeypatch.setattr(bench, "flow_overhead_bench", _fake_flow_overhead_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -903,6 +938,8 @@ def test_preheat_bench_failure_rides_exit_path(monkeypatch, capfd):
     monkeypatch.setattr(bench, "data_plane_bench", _fake_data_plane_bench)
     monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
     monkeypatch.setattr(bench, "preheat_bench", broken_preheat)
+    monkeypatch.setattr(bench, "registry_bench", _fake_registry_bench)
+    monkeypatch.setattr(bench, "flow_overhead_bench", _fake_flow_overhead_bench)
     monkeypatch.setattr(ingest, "stream_train_mlp", stub)
     monkeypatch.setenv("DF_BENCH_REPEATS", "3")
     bench.main()
@@ -912,3 +949,95 @@ def test_preheat_bench_failure_rides_exit_path(monkeypatch, capfd):
     assert "no forecaster in sandbox" in rec["preheat_error"]
     assert rec["wave_decisions_per_s"] > 0  # siblings unharmed
     assert rec["chaos_success_rate"] == 1.0
+
+
+def test_emits_flow_ledger_keys(monkeypatch, capfd):
+    """The artifact carries the flow-ledger soak numbers (ISSUE 18:
+    proxy pull p50, the second tag's dedup ratio and p2p efficiency,
+    per-plane byte conservation, and the accounting overhead are
+    measured facts), riding host_rates like every prior gate."""
+
+    def stub(paths, **kw):
+        return None, _stats(1000)
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "registry_error" not in rec
+    assert rec["proxy_pull_p50_ms"] > 0
+    assert rec["layer_dedup_ratio"] > 0
+    assert rec["p2p_efficiency"] > 0.5
+    assert rec["flow_conserved"] == 1
+    assert rec["registry_bad_bytes"] == 0
+    assert "flow_error" not in rec
+    assert rec["flow_accounting_overhead_pct"] >= 0.0
+    assert rec["flow_account_us"] > 0
+
+
+def test_flow_ledger_keys_survive_warmup_failure(monkeypatch, capfd):
+    """host_rates (flow-ledger numbers included) ride every exit path —
+    a dead device link must not discard the traffic-plane soak."""
+
+    def stub(paths, **kw):
+        raise RuntimeError("link died in compile")
+
+    rec = _run_main(monkeypatch, capfd, stub)
+    assert "warmup fit failed" in rec["error"]
+    assert rec["layer_dedup_ratio"] > 0
+    assert rec["p2p_efficiency"] > 0.5
+    assert rec["flow_accounting_overhead_pct"] >= 0.0
+
+
+def test_registry_soak_failure_rides_exit_path(monkeypatch, capfd):
+    """A registry soak that can't run must degrade to a
+    ``registry_error`` key on the one JSON line, leaving its siblings
+    intact."""
+
+    def stub(paths, **kw):
+        return None, _stats(1000)
+
+    def broken_registry():
+        raise RuntimeError("no proxies in sandbox")
+
+    monkeypatch.setattr(bench, "synthesize_dataset", _fake_synthesize)
+    monkeypatch.setattr(bench, "synthesize_dataset_binary", _fake_synthesize_binary)
+    monkeypatch.setattr(bench, "chaos_soak_bench", _fake_chaos_soak)
+    monkeypatch.setattr(bench, "fleet_shard_kill_bench", _fake_fleet_soak)
+    monkeypatch.setattr(bench, "serving_bench", _fake_serving_bench)
+    monkeypatch.setattr(bench, "wave_bench", _fake_wave_bench)
+    monkeypatch.setattr(bench, "data_plane_bench", _fake_data_plane_bench)
+    monkeypatch.setattr(bench, "multichip_scaling_bench", _fake_multichip_bench)
+    monkeypatch.setattr(bench, "preheat_bench", _fake_preheat_bench)
+    monkeypatch.setattr(bench, "registry_bench", broken_registry)
+    monkeypatch.setattr(bench, "flow_overhead_bench", _fake_flow_overhead_bench)
+    monkeypatch.setattr(ingest, "stream_train_mlp", stub)
+    monkeypatch.setenv("DF_BENCH_REPEATS", "3")
+    bench.main()
+    lines = [l for l in capfd.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert "no proxies in sandbox" in rec["registry_error"]
+    assert rec["flow_account_us"] > 0  # its sibling still rode
+    assert rec["chaos_success_rate"] == 1.0
+
+
+def test_flow_accounting_overhead_under_two_percent_or_abs_floor():
+    """Acceptance bar (ISSUE 18, same recalibrated form as ISSUE 13):
+    the per-piece flow-ledger attribution costs < 2% of the scheduling
+    hot-path wall OR under the absolute floor. Best-of-3 bench calls so
+    container CPU contention can't fail a genuinely-cheap path."""
+    runs = [bench.flow_overhead_bench() for _ in range(3)]
+    ok = any(
+        r["flow_accounting_overhead_pct"] < 2.0
+        or r["flow_account_us"] < OVERHEAD_ABS_FLOOR_US
+        for r in runs
+    )
+    assert ok, f"flow accounting overhead too high: {runs}"
+
+
+def test_flow_overhead_bench_resets_ledger():
+    """The microbench pumps fake bytes through the ledger; a bench run
+    must leave the module counters clean for whatever runs next."""
+    from dragonfly2_tpu.utils import flows
+
+    bench.flow_overhead_bench(iters=50, trials=1)
+    assert flows.snapshot()["total_bytes"] == 0
+    assert flows.task_plane("bench-task") == "file"

@@ -471,7 +471,17 @@ def test_transport_sheds_to_direct_at_inflight_bound(tmp_path):
     started = threading.Event()
     release = threading.Event()
 
+    class _NoStore:
+        @staticmethod
+        def find_completed_task(task_id):
+            return None
+
     class SlowTM:
+        storage = _NoStore()
+
+        def task_id_for(self, url, url_meta):
+            return "tid"
+
         def start_stream_task(self, req, timeout=None):
             started.set()
 

@@ -1221,3 +1221,66 @@ class BadPlanner:
     assert cycles, [f.message for f in res.findings]
     assert "BadPlanner._lock" in cycles[0].message
     assert "BadPlanner._demand_lock" in cycles[0].message
+
+
+FLOW_LEDGER_SHAPE_FIXTURE = '''
+import threading
+
+_lock = threading.Lock()
+_cells = {}
+_ring = []
+
+
+def account(plane, prov, n):
+    """The utils/flows.py shape: ONE short module-lock hold per call —
+    bump the cell and append the ring tuple, nothing else inside."""
+    with _lock:
+        _cells[(plane, prov)] = _cells.get((plane, prov), 0) + n
+        _ring.append((plane, prov, n))
+
+
+def snapshot():
+    with _lock:
+        cells = dict(_cells)
+    # derived math (efficiency rollups) happens OUTSIDE the lock
+    return {"total": sum(cells.values())}
+'''
+
+
+def test_lockorder_flow_ledger_shape_is_clean(fakepkg):
+    """The flow ledger's lock model (one module-level Lock, every
+    account()/snapshot() a single non-nesting hold, rollup math outside)
+    must analyze clean — the named baseline for the hot-tagged
+    utils/flows.py accounting path."""
+    (fakepkg / "flows.py").write_text(FLOW_LEDGER_SHAPE_FIXTURE)
+    res = lockorder.run(fakepkg)
+    assert res.findings == [], [f.message for f in res.findings]
+
+
+def test_lockorder_catches_a_flow_ledger_reentry_regression(fakepkg):
+    """The regression the clean shape guards against: a rollup helper
+    that re-acquires the ledger lock from inside account() — a plain
+    Lock, so the first piece write would deadlock the daemon."""
+    (fakepkg / "flows_bad.py").write_text(
+        '''
+import threading
+
+_lock = threading.Lock()
+_cells = {}
+
+
+def account(plane, prov, n):
+    with _lock:
+        _cells[(plane, prov)] = _cells.get((plane, prov), 0) + n
+        _efficiency()  # rollup under the hold: re-enters below
+
+
+def _efficiency():
+    with _lock:
+        return sum(_cells.values())
+'''
+    )
+    res = lockorder.run(fakepkg)
+    assert any(f.key.startswith("self:") for f in res.findings), [
+        f.message for f in res.findings
+    ]

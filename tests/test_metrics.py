@@ -358,6 +358,7 @@ def test_documented_series_exist():
     import dragonfly2_tpu.trainer.metrics  # noqa: F401
     import dragonfly2_tpu.utils.faults  # noqa: F401 — faults_* series
     import dragonfly2_tpu.utils.flight  # noqa: F401 — flight_* series
+    import dragonfly2_tpu.utils.flows  # noqa: F401 — flow_* series
     import dragonfly2_tpu.utils.profiling  # noqa: F401 — prof_* series
     from dragonfly2_tpu.rpc import glue
     from dragonfly2_tpu.utils.metrics import default_registry

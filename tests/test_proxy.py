@@ -343,7 +343,17 @@ def test_range_refusing_origin_is_negatively_cached(tmp_path):
 
     calls = {"p2p": 0}
 
+    class _Storage:
+        @staticmethod
+        def find_completed_task(task_id):
+            return None
+
     class TM:
+        storage = _Storage()
+
+        def task_id_for(self, url, url_meta):
+            return "t-ranged"
+
         def start_stream_task(self, req, timeout=None):
             calls["p2p"] += 1
             raise RuntimeError("origin does not support ranges: x")
@@ -402,3 +412,113 @@ def test_layer_demand_signal_gates_and_carries_swarm_identity():
     assert seen == [
         ("sha256:00ff", url, task_id_v1(url, URLMeta(tag="reg")), {"tag": "reg"})
     ]
+
+
+def test_p2p_refusal_names_its_cause(proxy_cluster, monkeypatch):
+    """A swarm failure behind the proxy must not be swallowed silently:
+    the pull degrades to a direct origin fetch (correct bytes, 200) AND
+    the cause lands in a daemon.proxy_fallback flight event an operator
+    can read off /debug/ring."""
+    from dragonfly2_tpu.utils import flight
+
+    da = proxy_cluster["daemons"][0]
+    url = proxy_cluster["origin"] + "/blob.bin"
+
+    def boom(*a, **kw):
+        raise RuntimeError("swarm refused by test")
+
+    monkeypatch.setattr(da.proxy.transport, "_via_p2p", boom)
+    body, headers = _proxy_get(da.proxy.port, url)
+    assert body == BLOB
+    assert headers["X-Dragonfly-Via-P2P"] == "0"
+
+    events = [
+        e
+        for e in flight.snapshot(["daemon"]).get("daemon", [])
+        if e["type"] == "daemon.proxy_fallback"
+        and "swarm refused by test" in e.get("cause", "")
+    ]
+    assert events, "fallback left no daemon.proxy_fallback flight event"
+    assert events[-1]["url"].endswith("/blob.bin")
+
+
+def test_fallback_propagates_origin_4xx(proxy_cluster, monkeypatch):
+    """When the swarm leg fails AND the origin says 404, the client must
+    see the origin's answer — not a 502 masking it."""
+    import urllib.error
+
+    da = proxy_cluster["daemons"][0]
+    # missing path that still matches the P2P rule, so the swarm is tried
+    url = proxy_cluster["origin"] + "/nope/blob.bin"
+
+    def boom(*a, **kw):
+        raise RuntimeError("no peers")
+
+    monkeypatch.setattr(da.proxy.transport, "_via_p2p", boom)
+    req = urllib.request.Request(url)
+    req.set_proxy(f"127.0.0.1:{da.proxy.port}", "http")
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(req, timeout=10)
+    assert exc_info.value.code == 404
+
+
+def test_proxy_pull_fault_injection_returns_502(proxy_cluster):
+    """DF_FAULTS on daemon.proxy_pull turns every proxied GET into a
+    deterministic 502 — the chaos hook for registry-path drills."""
+    import urllib.error
+
+    from dragonfly2_tpu.utils import faults
+
+    da = proxy_cluster["daemons"][0]
+    url = proxy_cluster["origin"] + "/blob.bin"
+    faults.configure("daemon.proxy_pull=error")
+    try:
+        req = urllib.request.Request(url)
+        req.set_proxy(f"127.0.0.1:{da.proxy.port}", "http")
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc_info.value.code == 502
+        assert b"proxy pull fault" in exc_info.value.read()
+    finally:
+        faults.clear()
+
+
+def test_proxy_propagates_trace_context():
+    """The proxy hop continues the caller's trace: the origin sees a
+    traceparent with the SAME trace id but a fresh span id (the
+    daemon.proxy_pull span's own context)."""
+    from dragonfly2_tpu.client.proxy import ProxyServer
+    from dragonfly2_tpu.utils import tracing
+
+    seen = {}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            seen["traceparent"] = self.headers.get(tracing.TRACEPARENT_HEADER)
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *a):
+            pass
+
+    origin = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=origin.serve_forever, daemon=True).start()
+    proxy = ProxyServer(P2PTransport(task_manager=None, rules=[]), port=0)
+    proxy.start()
+    incoming = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    try:
+        url = f"http://127.0.0.1:{origin.server_address[1]}/x"
+        req = urllib.request.Request(url, headers={"traceparent": incoming})
+        req.set_proxy(f"127.0.0.1:{proxy.port}", "http")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.read() == b"ok"
+    finally:
+        proxy.stop()
+        origin.shutdown()
+        origin.server_close()
+    tp = seen["traceparent"]
+    assert tp and tp != incoming
+    assert tp.split("-")[1] == "ab" * 16  # trace id preserved
+    assert tp.split("-")[2] != "cd" * 8  # new span for the proxy hop
