@@ -556,6 +556,66 @@ def flow_overhead_bench(iters: int = 1000, trials: int = 5) -> dict:
     }
 
 
+def swarm_overhead_bench(iters: int = 1000, trials: int = 5) -> dict:
+    """Swarm-observatory cost on the scheduling hot path.
+
+    Same discipline as the flow/recorder benches: the exact per-piece
+    accounting sequence the observatory hangs on the hot path
+    (``swarm.on_piece`` — one short module-lock hold, a monotone max, a
+    rolling-rate window append) runs in a tight loop and is charged
+    against the measured scheduling op. The snapshot read side is timed
+    separately — it is a debug-endpoint cost, not a hot-path one, but
+    dfswarm polls it so it must stay bounded.
+
+    - ``swarm_account_us``: tight-loop cost of one on_piece hook.
+    - ``swarm_account_overhead_pct``: that cost over the schedule-op
+      wall; acceptance bar < 2% (or the sub-3 µs absolute floor, same
+      shared-container recalibration as the flow bench).
+    - ``swarm_snapshot_us``: one full ``snapshot()`` materialisation
+      over the bench swarm.
+    """
+    from dragonfly2_tpu.scheduler import swarm
+
+    sched, child = _scheduling_microbench()
+    best_op = float("inf")
+    for _ in range(iters // 5):  # warm
+        sched.schedule_candidate_parents(child, set())
+    for _ in range(max(trials, 1)):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            sched.schedule_candidate_parents(child, set())
+        best_op = min(best_op, (time.perf_counter() - t0) / iters)
+
+    account_iters = 50_000
+    best_account = float("inf")
+    best_snap = float("inf")
+    try:
+        swarm.reset()
+        swarm.on_peer("bench-task", "bench-seed", seed=True, total_pieces=16)
+        swarm.on_peer("bench-task", "bench-peer", total_pieces=16)
+        swarm.on_primary_parent("bench-task", "bench-peer", "bench-seed")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for i in range(account_iters):
+                swarm.on_piece("bench-task", "bench-peer", i % 16, 16)
+            best_account = min(
+                best_account, (time.perf_counter() - t0) / account_iters
+            )
+        for _ in range(max(trials, 1) * 20):
+            t0 = time.perf_counter()
+            swarm.snapshot()
+            best_snap = min(best_snap, time.perf_counter() - t0)
+    finally:
+        swarm.reset()
+    overhead_pct = best_account / best_op * 100.0 if best_op else 0.0
+    return {
+        "swarm_account_overhead_pct": round(overhead_pct, 2),
+        "swarm_account_us": round(best_account * 1e6, 3),
+        "swarm_snapshot_us": round(best_snap * 1e6, 2),
+        "schedule_op_swarm_us": round(best_op * 1e6, 2),
+    }
+
+
 def jit_hygiene_bench(
     batch: int = 1024, steps_per_call: int = 4, superbatches: int = 4
 ) -> dict:
@@ -1235,6 +1295,20 @@ def main() -> None:
         except Exception as e:
             host_rates["flow_error"] = str(e)
             _phase(f"flow overhead bench failed: {e}")
+        # swarm-observatory accounting overhead rides host_rates the
+        # same way: the per-piece snapshot bookkeeping must stay < 2%
+        # of the scheduling hot-path wall (or under the absolute floor)
+        try:
+            host_rates.update(swarm_overhead_bench())
+            _phase(
+                f"swarm: account {host_rates['swarm_account_us']:.2f} us ="
+                f" {host_rates['swarm_account_overhead_pct']:.2f}% of"
+                f" schedule wall ({host_rates['schedule_op_swarm_us']:.1f} us/op),"
+                f" snapshot {host_rates['swarm_snapshot_us']:.1f} us"
+            )
+        except Exception as e:
+            host_rates["swarm_error"] = str(e)
+            _phase(f"swarm overhead bench failed: {e}")
         _phase(
             f"host split: decode(binary) {decode_only_rate_binary / 1e3:.1f}k/s,"
             f" decode(csv) {host_rates.get('decode_only_rate_csv', 0) / 1e3:.1f}k/s,"

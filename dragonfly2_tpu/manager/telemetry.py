@@ -59,6 +59,10 @@ from dragonfly2_tpu.utils.telemetry import (
     F_SHARD_DECISION_P99,
     F_SHARD_PEERS,
     F_SHARD_SCHEDULE_OPS,
+    F_SHARD_SWARM_DEPTHS,
+    F_SHARD_SWARM_PEERS,
+    F_SHARD_SWARM_STRAGGLERS,
+    F_SHARD_SWARM_TASKS,
     F_SHARD_TASKS,
     F_SLO_BREACHED,
     F_SWARM_DONE_PIECES,
@@ -635,20 +639,31 @@ class TelemetryPlane:
                     ),
                     0.99,
                 )
-                shards.append(
-                    {
-                        "shard": r.shard or r.instance,
-                        "instance": r.instance,
-                        "stale": stale,
-                        F_SHARD_SCHEDULE_OPS: ops,
-                        F_SHARD_DECISION_P99: round(p99 * 1e3, 2),
-                        F_SHARD_ANNOUNCE_OPS: rates(
-                            r, "dragonfly_scheduler_announce_peer_total"
-                        ),
-                        F_SHARD_PEERS: peers,
-                        F_SHARD_TASKS: tasks,
-                    }
-                )
+                shard_row = {
+                    "shard": r.shard or r.instance,
+                    "instance": r.instance,
+                    "stale": stale,
+                    F_SHARD_SCHEDULE_OPS: ops,
+                    F_SHARD_DECISION_P99: round(p99 * 1e3, 2),
+                    F_SHARD_ANNOUNCE_OPS: rates(
+                        r, "dragonfly_scheduler_announce_peer_total"
+                    ),
+                    F_SHARD_PEERS: peers,
+                    F_SHARD_TASKS: tasks,
+                }
+                # swarm-observatory rollup: folded per shard so one
+                # dfstat call shows swarm shape across the fleet
+                rollup = r.sections.get("swarm_rollup") or {}
+                if rollup:
+                    shard_row[F_SHARD_SWARM_TASKS] = int(rollup.get("tasks", 0))
+                    shard_row[F_SHARD_SWARM_PEERS] = int(rollup.get("peers", 0))
+                    shard_row[F_SHARD_SWARM_DEPTHS] = dict(
+                        rollup.get("depth_hist", {})
+                    )
+                    shard_row[F_SHARD_SWARM_STRAGGLERS] = int(
+                        rollup.get("stragglers", 0)
+                    ) + int(rollup.get("stuck", 0))
+                shards.append(shard_row)
                 if stale:
                     continue  # a dead shard's last swarm view is history
                 for swarm in r.sections.get("swarms", []) or []:

@@ -49,6 +49,8 @@ EV_SKIP = flight.event_type("preheat.skip")
 PH_SWEEP = profiling.phase_type("preheat.sweep")
 PH_FORECAST = profiling.phase_type("preheat.forecast")
 PH_PLAN = profiling.phase_type("preheat.plan")
+PH_RANK = profiling.phase_type("preheat.rank")
+PH_PLACE = profiling.phase_type("preheat.place")
 PH_FIT = profiling.phase_type("preheat.fit")
 
 # a demand-series key that IS a v1 task id (sha256 hex) — record-sourced
@@ -101,8 +103,14 @@ class PreheatPlanner:
         self.sweeps = 0
         self.jobs = 0
         self.tasks_planned = 0
+        self.refits_async = 0
+        self.refits_skipped = 0
         self._planned_at: dict[str, float] = {}  # task -> last plan time
         self._lock = threading.Lock()
+        # single-flight guard for the off-thread refit: at most one fit
+        # in flight; a sweep that finds it busy skips (the next refit
+        # boundary retrains on fresher data anyway)
+        self._refit_flight = threading.Lock()
         self._stop = threading.Event()
         self._thread: "threading.Thread | None" = None
 
@@ -160,17 +168,43 @@ class PreheatPlanner:
         """Demand snapshot → [(score, task_id, url)], hottest first."""
         with PH_FORECAST, tracer.span("preheat.forecast") as span:
             ids, urls, series = self.demand.series_batch(now=now)
-            if (
-                len(ids) >= self.forecaster.min_examples
-                and (not self.forecaster.ready or self.sweeps % self.refit_every == 0)
-            ):
-                with PH_FIT:
-                    self.forecaster.fit(series)
+            if len(ids) >= self.forecaster.min_examples:
+                if not self.forecaster.ready:
+                    # the FIRST fit stays inline: the forecast below
+                    # needs a model, and a cold planner has no forecast
+                    # quality to protect from the fit's latency
+                    with PH_FIT:
+                        self.forecaster.fit(series)
+                elif self.sweeps % self.refit_every == 0:
+                    # periodic refits move off the sweep thread: a slow
+                    # fit must not delay a sweep tick (the forecaster
+                    # swaps params atomically under its own lock)
+                    self._refit_async(series)
             scores = self.forecaster.forecast_demand(series)
             out["forecast"] = len(ids)
             span.set(tasks=len(ids), ready=self.forecaster.ready)
         ranked = sorted(zip(scores, ids, urls), key=lambda r: -float(r[0]))
         return [(float(s), tid, url) for s, tid, url in ranked]
+
+    def _refit_async(self, series) -> None:
+        """Single-flight off-thread refit; a sweep finding one already
+        in flight skips rather than queueing (bounded work, and the
+        next boundary's snapshot is fresher)."""
+        if not self._refit_flight.acquire(blocking=False):
+            self.refits_skipped += 1
+            return
+
+        def run() -> None:
+            try:
+                with PH_FIT:
+                    self.forecaster.fit(series)
+            except Exception as e:
+                logger.warning("preheat refit failed: %s", e)
+            finally:
+                self._refit_flight.release()
+
+        self.refits_async += 1
+        threading.Thread(target=run, name="preheat.refit", daemon=True).start()
 
     def _plan(self, tracer, scored: list, now: float, out: dict) -> list:
         """Budget-capped pick of forecast-hot tasks no seed already
@@ -261,11 +295,12 @@ class PreheatPlanner:
         engine = getattr(self.topology, "engine", None) if self.topology else None
         if engine is None:
             return []
-        try:
-            return recommend_seeds_by_rtt(engine, k=self.seed_k)
-        except Exception as e:
-            logger.debug("seed ranking unavailable: %s", e)
-            return []
+        with PH_RANK:
+            try:
+                return recommend_seeds_by_rtt(engine, k=self.seed_k)
+            except Exception as e:
+                logger.debug("seed ranking unavailable: %s", e)
+                return []
 
     def _submit(self, tracer, plan: list, out: dict) -> None:
         """One ``preheat`` job per sweep carrying the whole pick, through
@@ -282,7 +317,7 @@ class PreheatPlanner:
             "seed_ranking": seeds,
             "scores": {tid: round(s, 4) for s, tid, _ in picked},
         }
-        with tracer.span("preheat.job", urls=len(args["urls"])) as span:
+        with PH_PLACE, tracer.span("preheat.job", urls=len(args["urls"])) as span:
             if self.manager is not None:
                 outcome = self._submit_manager(args, span)
             elif self.job_worker is not None:
@@ -338,6 +373,8 @@ class PreheatPlanner:
             "sweeps": self.sweeps,
             "jobs": self.jobs,
             "tasks_planned": self.tasks_planned,
+            "refits_async": self.refits_async,
+            "refits_skipped": self.refits_skipped,
             "cooling": cooling,
             "interval_s": self.interval_s,
             "budget_per_sweep": self.budget_per_sweep,

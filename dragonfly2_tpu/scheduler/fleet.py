@@ -54,6 +54,13 @@ EV_MEMBER_JOIN = flight.event_type("fleet.member_join")
 EV_MEMBER_LEAVE = flight.event_type("fleet.member_leave")
 EV_REBALANCE = flight.event_type("fleet.rebalance")
 EV_WRONG_SHARD = flight.event_type("fleet.wrong_shard")
+# scheduler-ring membership transitions in the SCHEDULER timeline: the
+# fleet.* ring above narrates the KV/ring mechanics, these place the
+# join/leave/reconcile next to the scheduling events so a dfdoctor
+# timeline shows failovers instead of inferring them from gaps
+EV_FLEET_JOIN = flight.event_type("scheduler.fleet_join")
+EV_FLEET_LEAVE = flight.event_type("scheduler.fleet_leave")
+EV_FLEET_RECONCILE = flight.event_type("scheduler.fleet_reconcile")
 
 MEMBERS_GAUGE = _r.gauge(
     "fleet_members", "Live scheduler-fleet members in this process's view"
@@ -68,6 +75,11 @@ WRONG_SHARD_TOTAL = _r.counter(
     "Announces refused (scheduler side) or re-picked (daemon side) for"
     " landing on the wrong shard",
     ("side",),
+)
+FLEET_TRANSITIONS_TOTAL = _r.counter(
+    "scheduler_fleet_transitions_total",
+    "Fleet membership transitions observed by this process",
+    ("transition",),
 )
 BLACKOUT_MS = _r.histogram(
     "fleet_blackout_milliseconds",
@@ -199,6 +211,8 @@ class FleetMembership:
         self._renew_once()  # fail loudly at serve time, not on a timer
         self.reconcile()
         EV_MEMBER_JOIN(addr=self.self_addr, members=list(self._members))
+        EV_FLEET_JOIN(addr=self.self_addr, members=len(self._members))
+        FLEET_TRANSITIONS_TOTAL.labels("join").inc()
         logger.info(
             "fleet join %s (ttl=%.1fs, %d members)",
             self.self_addr, self.cfg.lease_ttl, len(self._members),
@@ -222,6 +236,8 @@ class FleetMembership:
         except Exception as e:
             logger.warning("fleet leave delete failed (ttl will clear it): %s", e)
         EV_MEMBER_LEAVE(addr=self.self_addr)
+        EV_FLEET_LEAVE(addr=self.self_addr)
+        FLEET_TRANSITIONS_TOTAL.labels("leave").inc()
 
     def abandon(self) -> None:
         """Stop heartbeating WITHOUT deleting the lease — the crash/
@@ -268,9 +284,11 @@ class FleetMembership:
             current = self._members
             if members == current:
                 return False
-            for addr in set(members) - set(current):
+            joined = sorted(set(members) - set(current))
+            left = sorted(set(current) - set(members))
+            for addr in joined:
                 self.ring.add(addr)
-            for addr in set(current) - set(members):
+            for addr in left:
                 self.ring.remove(addr)
             self._members = members
             self._ring_changed_at = time.monotonic()
@@ -282,6 +300,13 @@ class FleetMembership:
             members=list(members),
             ring_version=version,
         )
+        EV_FLEET_RECONCILE(
+            addr=self.self_addr,
+            joined=joined,
+            left=left,
+            ring_version=version,
+        )
+        FLEET_TRANSITIONS_TOTAL.labels("reconcile").inc()
         logger.info(
             "fleet membership now %s (ring v%d)", list(members), version
         )
@@ -366,6 +391,8 @@ class FleetWatcher:
             MEMBERS_GAUGE.set(len(members))
             REBALANCE_TOTAL.labels("daemon").inc()
             EV_REBALANCE(members=list(members))
+            EV_FLEET_RECONCILE(members=list(members), side="watcher")
+            FLEET_TRANSITIONS_TOTAL.labels("watch").inc()
             try:
                 self.on_members(list(members))
             except Exception:

@@ -12,6 +12,7 @@ import threading
 import time
 from dataclasses import dataclass
 
+from dragonfly2_tpu.scheduler import swarm
 from dragonfly2_tpu.scheduler.resource.host import Host
 from dragonfly2_tpu.scheduler.resource.peer import (
     PEER_EVENT_LEAVE,
@@ -47,6 +48,11 @@ class PeerManager:
             self._peers[peer.id] = peer
         peer.task.store_peer(peer)
         peer.host.store_peer(peer)
+        swarm.on_peer(
+            peer.task.id, peer.id,
+            seed=peer.host.type.is_seed,
+            total_pieces=peer.task.total_piece_count,
+        )
 
     def load_or_store(self, peer: Peer) -> tuple[Peer, bool]:
         with self._lock:
@@ -56,6 +62,11 @@ class PeerManager:
             self._peers[peer.id] = peer
         peer.task.store_peer(peer)
         peer.host.store_peer(peer)
+        swarm.on_peer(
+            peer.task.id, peer.id,
+            seed=peer.host.type.is_seed,
+            total_pieces=peer.task.total_piece_count,
+        )
         return peer, False
 
     def delete(self, peer_id: str) -> None:
@@ -64,6 +75,7 @@ class PeerManager:
         if peer is not None:
             peer.task.delete_peer(peer_id)
             peer.host.delete_peer(peer_id)
+            swarm.on_peer_gone(peer.task.id, peer_id)
 
     def all(self) -> list[Peer]:
         with self._lock:
@@ -109,6 +121,7 @@ class TaskManager:
     def delete(self, task_id: str) -> None:
         with self._lock:
             self._tasks.pop(task_id, None)
+        swarm.on_task_gone(task_id)
 
     def all(self) -> list[Task]:
         with self._lock:

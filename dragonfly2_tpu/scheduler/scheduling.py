@@ -31,6 +31,7 @@ from dragonfly2_tpu.scheduler.resource import (
     Peer,
 )
 from dragonfly2_tpu.scheduler import metrics as M
+from dragonfly2_tpu.scheduler import swarm
 from dragonfly2_tpu.utils import dflog, faults, flight, profiling, tracing
 
 logger = dflog.get("scheduling")
@@ -209,6 +210,7 @@ class Scheduling:
 
             # re-schedule from a clean slate: drop existing parent edges
             peer.task.delete_peer_in_edges(peer.id)
+            swarm.on_reschedule(peer.task.id, peer.id)
 
             candidate_parents, found = self.find_candidate_parents(peer, blocklist)
             if not found:
@@ -248,6 +250,11 @@ class Scheduling:
                     peer.task.add_peer_edge(parent, peer)
                 except Exception as e:
                     logger.warning("peer %s add edge failed: %s", peer.id, e)
+            # the first ranked candidate is the decision's primary
+            # parent — the tree edge the swarm observatory tracks
+            swarm.on_primary_parent(
+                peer.task.id, peer.id, candidate_parents[0].id
+            )
             return
 
     # -- finders ----------------------------------------------------------

@@ -417,6 +417,11 @@ class SchedulerServer:
                 "hosts": len(self.resource.host_manager.all()),
             },
         )
+        # swarm shape at crash time: dfdoctor timelines carry the
+        # observatory rollup next to the resource counts
+        from dragonfly2_tpu.scheduler import swarm as _swarm
+
+        flight.register_probe("scheduler.swarm", _swarm.summary)
         from dragonfly2_tpu.rpc.diagnose import DiagnoseService
         from dragonfly2_tpu.rpc.glue import DIAGNOSE_SERVICE
 
@@ -481,7 +486,11 @@ class SchedulerServer:
                 service="scheduler",
                 instance=f"{cfg.advertise_ip}:{cfg.advertise_port or self.port}",
                 shard=f"{cfg.advertise_ip}:{cfg.advertise_port or self.port}",
-                prefixes=("dragonfly_scheduler_", "dragonfly_fleet_"),
+                prefixes=(
+                    "dragonfly_scheduler_",
+                    "dragonfly_fleet_",
+                    "dragonfly_swarm_",
+                ),
                 interval=cfg.telemetry_interval,
                 collect_sections=self._telemetry_sections,
             )
@@ -521,58 +530,28 @@ class SchedulerServer:
 
     def _telemetry_sections(self) -> dict:
         """The scheduler's structured telemetry sections: the live
-        per-task swarm table (peer/seeder counts, piece completion,
-        stragglers) plus identity/endpoints. Gauges are refreshed first
-        so the pushed registry snapshot is as current as the table."""
+        per-task swarm table and the shard-wide observatory rollup
+        (both from scheduler/swarm — the same ledger /debug/swarm and
+        the flight probe read) plus identity/endpoints. Gauges are
+        refreshed first so the pushed registry snapshot is as current
+        as the table."""
         from dragonfly2_tpu.scheduler import metrics as _M
-        from dragonfly2_tpu.scheduler import resource as res
+        from dragonfly2_tpu.scheduler import swarm as _swarm
         from dragonfly2_tpu.version import __version__
 
         _M.refresh_resource_gauges(self.resource)
-        by_task: dict[str, list] = {}
-        for p in self.resource.peer_manager.all():
-            by_task.setdefault(p.task.id, []).append(p)
-        swarms = []
-        for task_id, peers in sorted(by_task.items())[:256]:
-            active = [
-                p
-                for p in peers
-                if not p.fsm.is_state(res.PEER_STATE_FAILED, res.PEER_STATE_LEAVE)
-            ]
-            seeders = sum(
-                1
-                for p in active
-                if p.host.type.is_seed or p.fsm.is_state(res.PEER_STATE_SUCCEEDED)
-            )
-            done = {p.id: p.finished_piece_count() for p in active}
-            running = [p for p in active if p.fsm.is_state(res.PEER_STATE_RUNNING)]
-            # stragglers: running peers at less than half the swarm's
-            # best progress — the tail the operator wants named
-            best = max((done[p.id] for p in running), default=0)
-            stragglers = sorted(
-                p.id for p in running if best >= 2 and done[p.id] * 2 < best
-            )[:5]
-            total = max(
-                int(peers[0].task.total_piece_count or 0), 0
-            ) if peers else 0
-            swarms.append(
-                {
-                    "task_id": task_id,
-                    "peers": len(active),
-                    "seeders": seeders,
-                    "done_pieces": int(sum(done.values())),
-                    "total_pieces": total,
-                    "stragglers": stragglers,
-                }
-            )
-        return {
-            "swarms": swarms,
+        sections = {
+            "swarms": _swarm.telemetry_section(),
             "build": {"service": "scheduler", "version": __version__},
             "endpoints": {
                 "rpc": f"{self.cfg.advertise_ip}:{self.cfg.advertise_port or self.port}",
                 "metrics": getattr(self, "metrics_addr", "") or "",
             },
         }
+        rollup = _swarm.telemetry_rollup()
+        if rollup:
+            sections["swarm_rollup"] = rollup
+        return sections
 
     def _register_with_manager(self) -> None:
         """Register with the manager before serving traffic (reference

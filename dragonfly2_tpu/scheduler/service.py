@@ -33,6 +33,7 @@ from dragonfly2_tpu.scheduler.scheduling import (
 )
 from dragonfly2_tpu.scheduler.storage import Storage, build_download_record
 from dragonfly2_tpu.scheduler import metrics as M
+from dragonfly2_tpu.scheduler import swarm
 from dragonfly2_tpu.utils import dflog
 from dragonfly2_tpu.utils.idgen import URLMeta, task_id_v1
 
@@ -320,6 +321,9 @@ class SchedulerService:
                 peer.task.content_length = fin.content_length
             if peer.task.total_piece_count < 0:
                 peer.task.total_piece_count = fin.piece_count
+            # the observatory's last on_piece predates this learn — a
+            # back-to-source task would read coverage 0 forever without it
+            swarm.on_total(peer.task.id, peer.task.total_piece_count)
             if peer.task.fsm.can(res.TASK_EVENT_DOWNLOAD_SUCCEEDED):
                 peer.task.fsm.event(res.TASK_EVENT_DOWNLOAD_SUCCEEDED)
             self._write_download_record(peer)
@@ -532,6 +536,7 @@ class SchedulerService:
             task.content_length = request.content_length
         if request.pieces and task.total_piece_count < 0:
             task.total_piece_count = len(request.pieces)
+            swarm.on_total(task.id, task.total_piece_count)
 
         peer = res.Peer(request.peer_id, task, host, tag=meta.tag, application=meta.application)
         peer, _ = self.resource.peer_manager.load_or_store(peer)

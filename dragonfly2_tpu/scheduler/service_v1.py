@@ -27,6 +27,7 @@ from dragonfly2_tpu.rpc.glue import SCHEDULER_V1_SERVICE
 from dragonfly2_tpu.scheduler import resource as res
 from dragonfly2_tpu.scheduler.fleet import WrongShardError
 from dragonfly2_tpu.scheduler import metrics as M
+from dragonfly2_tpu.scheduler import swarm
 from dragonfly2_tpu.scheduler.scheduling import (
     NeedBackToSourceResponse,
     NormalTaskResponse,
@@ -429,6 +430,9 @@ class SchedulerServiceV1:
                 peer.task.content_length = request.content_length
             if peer.task.total_piece_count < 0:
                 peer.task.total_piece_count = request.total_piece_count
+            # observatory learns the total too — its last on_piece
+            # predates this report (see service.py's twin site)
+            swarm.on_total(peer.task.id, peer.task.total_piece_count)
             if peer.task.fsm.can(res.TASK_EVENT_DOWNLOAD_SUCCEEDED):
                 peer.task.fsm.event(res.TASK_EVENT_DOWNLOAD_SUCCEEDED)
             self._write_download_record(peer)
@@ -554,6 +558,7 @@ class SchedulerServiceV1:
                 task.content_length = request.piece_packet.content_length
             if task.total_piece_count < 0:
                 task.total_piece_count = request.piece_packet.total_piece
+            swarm.on_total(task.id, task.total_piece_count)
             if task.fsm.can(res.TASK_EVENT_DOWNLOAD_SUCCEEDED):
                 task.fsm.event(res.TASK_EVENT_DOWNLOAD_SUCCEEDED)
 

@@ -40,6 +40,10 @@ from dragonfly2_tpu.utils.telemetry import (
     F_SHARD_DECISION_P99,
     F_SHARD_PEERS,
     F_SHARD_SCHEDULE_OPS,
+    F_SHARD_SWARM_DEPTHS,
+    F_SHARD_SWARM_PEERS,
+    F_SHARD_SWARM_STRAGGLERS,
+    F_SHARD_SWARM_TASKS,
     F_SHARD_TASKS,
     F_SWARM_DONE_PIECES,
     F_SWARM_PEERS,
@@ -128,6 +132,30 @@ def render(snap: dict, window: str = "1m") -> str:
             rows,
             ["shard", "state", f"sched/s[{window}]", f"ann/s[{window}]",
              "p99_ms", "peers", "tasks"],
+        )
+
+    # per-shard swarm-observatory rollup (only shards that reported one)
+    swarm_shards = [sh for sh in shards if F_SHARD_SWARM_TASKS in sh]
+    if swarm_shards:
+        lines.append("")
+        lines.append("shard swarms (observatory rollup)")
+        rows = []
+        for sh in swarm_shards:
+            depths = sh.get(F_SHARD_SWARM_DEPTHS, {}) or {}
+            hist = (
+                " ".join(f"{d}:{n}" for d, n in sorted(depths.items())) or "-"
+            )
+            rows.append(
+                [
+                    _short(sh.get("shard", "")),
+                    f"{sh.get(F_SHARD_SWARM_TASKS, 0)}",
+                    f"{sh.get(F_SHARD_SWARM_PEERS, 0)}",
+                    hist,
+                    f"{sh.get(F_SHARD_SWARM_STRAGGLERS, 0)}",
+                ]
+            )
+        lines += _table(
+            rows, ["shard", "tasks", "peers", "depth_hist", "stragglers"]
         )
 
     swarms = snap.get("swarms", [])

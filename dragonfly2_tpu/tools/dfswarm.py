@@ -1,0 +1,156 @@
+"""dfswarm — render a task's live swarm tree from the observatory.
+
+The scheduler's MetricsServer exposes the swarm observatory at
+``GET /debug/swarm[?task=]`` (scheduler/swarm.py): per-peer FSM state,
+primary parent, depth, piece progress, and the straggler/stuck flags.
+dfswarm fetches that snapshot and draws each task's parent tree —
+roots (seeds / back-to-source peers) at the top, children indented
+under their primary parent, stragglers and stuck peers flagged inline
+— the "who is feeding whom, and who is dragging" view a flat peer
+table can't give.
+
+Usage:
+    python -m dragonfly2_tpu.tools.dfswarm --scheduler HOST:METRICS_PORT
+        [--task TASK_ID] [--once] [--interval S]
+
+Without ``--once`` the view refreshes every ``--interval`` seconds,
+clearing the screen between frames like dfstat.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+
+def fetch(scheduler: str, task: "str | None" = None, timeout: float = 5.0) -> dict:
+    """GET the observatory snapshot; ``scheduler`` is host:port of the
+    scheduler's METRICS listener (or a full http:// URL)."""
+    base = scheduler if "://" in scheduler else f"http://{scheduler}"
+    url = f"{base.rstrip('/')}/debug/swarm"
+    if task:
+        url += f"?task={urllib.parse.quote(task)}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _short(s: str, n: int = 28) -> str:
+    return s if len(s) <= n else s[: n - 1] + "…"
+
+
+def _flags(peer: dict) -> str:
+    out = []
+    if peer.get("seed"):
+        out.append("seed")
+    if peer.get("straggler"):
+        out.append("STRAGGLER")
+    if peer.get("stuck"):
+        out.append("STUCK")
+    return f" [{','.join(out)}]" if out else ""
+
+
+def _peer_line(pid: str, peer: dict, prefix: str) -> str:
+    rate = peer.get("rate")
+    rate_s = f" {rate:.2f}p/s" if isinstance(rate, (int, float)) else ""
+    return (
+        f"{prefix}{_short(pid)}  {peer.get('state', '?')}"
+        f"  pieces={peer.get('pieces', 0)}{rate_s}{_flags(peer)}"
+    )
+
+
+def render_task(task_id: str, view: dict) -> str:
+    """One task's tree as a string (pure — tests assert on it)."""
+    lines = [
+        f"task {_short(task_id, 48)}  peers={view.get('peer_count', 0)}"
+        f"  edges={view.get('edges', 0)}  roots={view.get('roots', 0)}"
+        f"  coverage={view.get('coverage', 0.0):.2f}"
+        f" ({view.get('done_pieces', 0)}/{view.get('total_pieces', 0) or '?'})"
+        f"  b2s={view.get('back_to_source', 0)}"
+        f"  resched={view.get('reschedules', 0)}"
+        + ("" if view.get("consistent", True) else "  !INCONSISTENT")
+    ]
+    peers = view.get("peers", {})
+    children: dict[str, list[str]] = {}
+    roots = []
+    for pid, p in peers.items():
+        parent = p.get("parent")
+        if parent is None or parent not in peers:
+            roots.append(pid)
+        else:
+            children.setdefault(parent, []).append(pid)
+
+    def walk(pid: str, depth: int, seen: set) -> None:
+        if pid in seen:  # defensive: a torn snapshot must not hang the CLI
+            lines.append("  " * depth + f"{_short(pid)}  (cycle)")
+            return
+        seen.add(pid)
+        prefix = "  " * depth + ("└─ " if depth else "")
+        lines.append(_peer_line(pid, peers[pid], prefix))
+        for child in sorted(children.get(pid, [])):
+            walk(child, depth + 1, seen)
+
+    seen: set = set()
+    for pid in sorted(roots):
+        walk(pid, 0, seen)
+    # orphans whose parent chain never reached a root (mid-reschedule)
+    for pid in sorted(peers):
+        if pid not in seen:
+            walk(pid, 0, seen)
+    return "\n".join(lines) + "\n"
+
+
+def render(snap: dict) -> str:
+    """The full frame: every task's tree plus the ledger totals."""
+    tasks = snap.get("tasks", {})
+    if not tasks:
+        return "dfswarm: no tasks tracked\n"
+    frames = [render_task(tid, view) for tid, view in sorted(tasks.items())]
+    footer = (
+        f"tasks={snap.get('task_count', 0)}  peers={snap.get('peer_count', 0)}"
+        f"  edges={snap.get('edges', 0)}  stragglers={snap.get('stragglers', 0)}"
+        f"  stuck={snap.get('stuck', 0)}"
+        f"  consistent={snap.get('consistent', True)}\n"
+    )
+    return "\n".join(frames) + footer
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dfswarm",
+        description="live swarm-tree view from a scheduler's /debug/swarm",
+    )
+    p.add_argument(
+        "--scheduler", required=True, metavar="HOST:PORT",
+        help="scheduler metrics address (or full http:// URL)",
+    )
+    p.add_argument("--task", default=None, help="limit to one task id")
+    p.add_argument("--once", action="store_true", help="one frame, no refresh")
+    p.add_argument("--interval", type=float, default=2.0)
+    args = p.parse_args(argv)
+    while True:
+        try:
+            frame = render(fetch(args.scheduler, args.task))
+        except Exception as e:
+            if args.once:
+                print(
+                    f"dfswarm: {args.scheduler} unreachable: {e}", file=sys.stderr
+                )
+                return 1
+            frame = f"dfswarm: {args.scheduler} unreachable: {e}  (retrying)\n"
+        if args.once:
+            sys.stdout.write(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame)
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -265,7 +265,33 @@ def chaos_soak(
     from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
     from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
     from dragonfly2_tpu.scheduler.storage import Storage
+    from dragonfly2_tpu.scheduler import swarm
     from dragonfly2_tpu.utils import faults
+
+    # swarm-observatory conservation check: the scheduler runs
+    # in-process, so the module-global ledger is visible here. Sampled
+    # after every download and once more after the midpoint restart —
+    # per task the primary-parent identity (edges == peers − roots,
+    # surfaced as the snapshot's "consistent" flag) must hold and
+    # coverage must stay a monotone fraction in [0, 1], or the
+    # observatory tore under churn.
+    swarm_samples = [0]
+    swarm_violations: list = []
+    coverage_high: dict = {}
+
+    def _sample_swarm():
+        snap = swarm.snapshot()
+        swarm_samples[0] += 1
+        if not snap.get("consistent", False):
+            swarm_violations.append("conservation")
+        for tid, view in snap.get("tasks", {}).items():
+            cov = view.get("coverage", 0.0)
+            if not 0.0 <= cov <= 1.0:
+                swarm_violations.append(f"coverage-range:{tid}")
+            if cov < coverage_high.get(tid, 0.0) - 1e-9:
+                swarm_violations.append(f"coverage-monotone:{tid}")
+            coverage_high[tid] = max(coverage_high.get(tid, 0.0), cov)
+        return snap
 
     def _scheduler(root, port=0):
         service = SchedulerService(
@@ -279,10 +305,12 @@ def chaos_soak(
         return serve({SERVICE_NAME: service}, address=f"127.0.0.1:{port}")
 
     tmp = tempfile.mkdtemp(prefix="dfchaos-")
+    swarm.reset()  # the soak judges its own swarm, not process leftovers
     injected_before = _faults_injected_total()
     t_start = time.perf_counter()
     successes = hangs = 0
     server = daemons = None
+    final_swarm: dict = {}
     try:
         server, port = _scheduler(os.path.join(tmp, "rec"))
         daemons = []
@@ -314,6 +342,7 @@ def chaos_soak(
         out0 = os.path.join(tmp, "seed.bin")
         dfget.download(f"127.0.0.1:{a.port}", payloads[0][0], out0)
         successes += int(open(out0, "rb").read() == payloads[0][1])
+        _sample_swarm()
 
         # arm the canned schedule: seeded wire errors on every send path,
         # PLUS a deterministic pair early on — the zero-copy data plane
@@ -335,6 +364,10 @@ def chaos_soak(
                     server, _ = _scheduler(
                         os.path.join(tmp, "rec2"), port=port
                     )
+                    # the ledger survives the restart (module state);
+                    # the identity must still hold over whatever the
+                    # fresh scheduler re-registers on top of it
+                    _sample_swarm()
             url, data = payloads[i]
             out = os.path.join(tmp, f"out-{i}.bin")
             result: dict = {}
@@ -357,6 +390,8 @@ def chaos_soak(
                 continue
             if result.get("ok") and open(out, "rb").read() == data:
                 successes += 1
+            _sample_swarm()
+        final_swarm = _sample_swarm()
     finally:
         faults.clear()
         for d in daemons or []:
@@ -376,6 +411,11 @@ def chaos_soak(
         "chaos_hangs": hangs,
         "chaos_faults_injected": _faults_injected_total() - injected_before,
         "chaos_wall_s": round(time.perf_counter() - t_start, 2),
+        "chaos_swarm_samples": swarm_samples[0],
+        "chaos_swarm_consistent": int(not swarm_violations),
+        "chaos_swarm_violations": sorted(set(swarm_violations)),
+        "chaos_swarm_tasks": int(final_swarm.get("task_count", 0)),
+        "chaos_swarm_peers": int(final_swarm.get("peer_count", 0)),
     }
 
 

@@ -598,6 +598,34 @@ class MetricsServer:
                     self.end_headers()
                     self.wfile.write(data)
                     return
+                if url.path == "/debug/swarm":
+                    import json
+
+                    # lazy import: the observatory registers its series
+                    # in this module's default registry at import time,
+                    # and only scheduler processes ever populate it
+                    from dragonfly2_tpu.scheduler import swarm
+
+                    params = parse_qs(url.query, keep_blank_values=True)
+                    unknown = set(params) - {"task"}
+                    if unknown:
+                        data = json.dumps(
+                            {"error": f"unknown parameters: {sorted(unknown)}"}
+                        ).encode()
+                        self.send_response(400)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                        return
+                    task = params.get("task", [None])[0] or None
+                    data = json.dumps(swarm.snapshot(task), default=str).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 if url.path == "/debug/faults":
                     import json
 

@@ -90,6 +90,12 @@ F_SHARD_DECISION_P99 = TFIELDS.tfield("shard.decision_p99_ms")
 F_SHARD_ANNOUNCE_OPS = TFIELDS.tfield("shard.announce_ops_per_s")
 F_SHARD_PEERS = TFIELDS.tfield("shard.peers")
 F_SHARD_TASKS = TFIELDS.tfield("shard.tasks")
+# per-shard swarm-observatory rollup (scheduler/swarm telemetry_rollup,
+# folded by the manager so one dfstat shows swarm health per shard)
+F_SHARD_SWARM_TASKS = TFIELDS.tfield("shard.swarm_tasks")
+F_SHARD_SWARM_PEERS = TFIELDS.tfield("shard.swarm_peers")
+F_SHARD_SWARM_DEPTHS = TFIELDS.tfield("shard.swarm_depth_hist")
+F_SHARD_SWARM_STRAGGLERS = TFIELDS.tfield("shard.swarm_stragglers")
 # per-trainer ingest/fit view
 F_TRAINER_INGEST_RECORDS = TFIELDS.tfield("trainer.ingest_records_per_s")
 F_TRAINER_DATASET_BYTES = TFIELDS.tfield("trainer.dataset_bytes_per_s")

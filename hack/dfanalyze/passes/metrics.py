@@ -32,6 +32,7 @@ PACKAGE = DEFAULT_PACKAGE
 ALLOWED_SERVICES = (
     "scheduler", "trainer", "daemon", "manager", "topology", "rpc", "flight",
     "faults", "resilience", "fleet", "build", "prof", "preheat", "flow",
+    "swarm",
 )
 
 # flight-recorder event names are <service>.<what>; the service segment
@@ -84,6 +85,18 @@ PREHEAT_EVENT_MODULES = (
     "dragonfly2_tpu/preheat/forecast.py",
     "dragonfly2_tpu/preheat/planner.py",
 )
+
+# the scheduler.swarm_* event segment belongs to the swarm observatory
+# (docs/observability.md "swarm observatory"): straggler/stuck flags are
+# detected against the observatory's own snapshot state — a swarm-ish
+# event declared elsewhere would fork the vocabulary dfdoctor and the
+# swarm census key on
+SWARM_EVENT_MODULE = "dragonfly2_tpu/scheduler/swarm.py"
+
+# the scheduler.fleet_* event segment belongs to the membership plane:
+# join/leave/reconcile transitions come from the hash-ring bookkeeping
+# alone, so the transition counter and the flight timeline can't drift
+FLEET_EVENT_MODULE = "dragonfly2_tpu/scheduler/fleet.py"
 
 # dfprof phase-ledger names (profiling.phase_type("<service>.<what>"))
 # share the event services' vocabulary: phases belong to a process role
@@ -286,6 +299,28 @@ def check(package_dir: Path = PACKAGE) -> list[str]:
                     f"{site}: event {name!r} uses the reserved"
                     " daemon.object_ segment; object-storage events are"
                     f" declared in {OBJECT_EVENT_MODULE} only"
+                )
+            # scheduler.swarm_* belongs to the swarm observatory
+            if (
+                service == "scheduler"
+                and (what == "swarm" or what.startswith("swarm_"))
+                and str(rel) != SWARM_EVENT_MODULE
+            ):
+                failures.append(
+                    f"{site}: event {name!r} uses the reserved"
+                    " scheduler.swarm_ segment; swarm-observatory events"
+                    f" are declared in {SWARM_EVENT_MODULE} only"
+                )
+            # scheduler.fleet_* belongs to the membership plane
+            if (
+                service == "scheduler"
+                and (what == "fleet" or what.startswith("fleet_"))
+                and str(rel) != FLEET_EVENT_MODULE
+            ):
+                failures.append(
+                    f"{site}: event {name!r} uses the reserved"
+                    " scheduler.fleet_ segment; fleet-membership events"
+                    f" are declared in {FLEET_EVENT_MODULE} only"
                 )
             # the preheat.* ring belongs to the predictive preheat plane
             if service == "preheat" and str(rel) not in PREHEAT_EVENT_MODULES:

@@ -113,6 +113,68 @@ def test_lint_reserves_wave_event_segment(tmp_path):
     assert "scheduler.wavefront" not in text
 
 
+def test_lint_reserves_swarm_event_segment(tmp_path):
+    """The scheduler.swarm_* event segment belongs to the swarm
+    observatory (ISSUE 19): scheduler/swarm.py alone declares the
+    straggler/stuck events. Segment test — daemon.swarm_x is out of
+    scope, scheduler.swarming is a different word, scheduler.swarm_stray
+    elsewhere is caught."""
+    pkg = tmp_path / "fakepkg"
+    pkg.mkdir()
+    (pkg / "stray.py").write_text(
+        "from dragonfly2_tpu.utils import flight\n"
+        'EV_STRAY = flight.event_type("scheduler.swarm_stray")\n'
+        'EV_OK = flight.event_type("daemon.swarm_unscoped")\n'
+        'EV_ALSO_OK = flight.event_type("scheduler.swarming")\n'
+    )
+    failures = check_metrics.check(pkg)
+    text = "\n".join(failures)
+    assert "reserved scheduler.swarm_ segment" in text
+    assert "daemon.swarm_unscoped" not in text
+    assert "scheduler.swarming" not in text
+
+
+def test_lint_reserves_fleet_event_segment(tmp_path):
+    """The scheduler.fleet_* membership events (join/leave/reconcile)
+    belong to scheduler/fleet.py — a stray declaration elsewhere would
+    fork the vocabulary the transition counter keys on. The fleet.*
+    service ring itself stays open (it predates ISSUE 19)."""
+    pkg = tmp_path / "fakepkg"
+    pkg.mkdir()
+    (pkg / "stray.py").write_text(
+        "from dragonfly2_tpu.utils import flight\n"
+        'EV_STRAY = flight.event_type("scheduler.fleet_stray")\n'
+        'EV_OK = flight.event_type("fleet.ring_rebuilt")\n'
+        'EV_ALSO_OK = flight.event_type("scheduler.fleeting")\n'
+    )
+    failures = check_metrics.check(pkg)
+    text = "\n".join(failures)
+    assert "reserved scheduler.fleet_ segment" in text
+    assert "fleet.ring_rebuilt" not in text
+    assert "scheduler.fleeting" not in text
+
+
+def test_swarm_and_fleet_events_allowed_in_their_modules(tmp_path):
+    """The real declaration sites pass: a fakepkg mirroring the
+    package layout declares swarm events in scheduler/swarm.py and
+    fleet events in scheduler/fleet.py — no reserved-segment failure."""
+    pkg = tmp_path / "dragonfly2_tpu"
+    sched = pkg / "scheduler"
+    sched.mkdir(parents=True)
+    (sched / "swarm.py").write_text(
+        "from dragonfly2_tpu.utils import flight\n"
+        'EV_S = flight.event_type("scheduler.swarm_straggler")\n'
+    )
+    (sched / "fleet.py").write_text(
+        "from dragonfly2_tpu.utils import flight\n"
+        'EV_J = flight.event_type("scheduler.fleet_join")\n'
+    )
+    failures = check_metrics.check(pkg)
+    text = "\n".join(failures)
+    assert "reserved scheduler.swarm_ segment" not in text
+    assert "reserved scheduler.fleet_ segment" not in text
+
+
 def test_lint_catches_fault_point_defects(tmp_path):
     """Fault-point registrations (faults.point) ride the census too:
     duplicates, names that aren't <layer>.<what> with a known layer —

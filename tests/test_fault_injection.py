@@ -985,3 +985,8 @@ def test_chaos_soak_acceptance(clean_resilience, tmp_path):
     assert stats["chaos_success_rate"] == 1.0, stats
     assert stats["chaos_hangs"] == 0, stats
     assert stats["chaos_faults_injected"] >= 1, stats
+    # ISSUE 19: the swarm observatory's conservation identity
+    # (edges == peers − roots) and coverage monotonicity held across
+    # every sample, including the one straight after the restart
+    assert stats["chaos_swarm_samples"] >= 3, stats
+    assert stats["chaos_swarm_consistent"] == 1, stats

@@ -502,3 +502,71 @@ def test_planner_start_stop_runs_in_background(clean_faults):
     planner.stop()
     assert planner.sweeps >= 1
     assert seed.triggered == ["http://o/t1"]
+
+
+def test_refit_moves_off_the_sweep_thread_single_flight(clean_faults):
+    """ISSUE 19 satellite: periodic refits run on a single-flight
+    worker thread — a sweep that finds one in flight skips instead of
+    queueing, and the sweep itself never blocks on the fit."""
+    demand = DemandWindow(bucket_s=1.0, window_buckets=4)
+    planner, _ = _planner(demand)
+
+    started = threading.Event()
+    release = threading.Event()
+    fits = []
+
+    class _SlowFit:
+        def fit(self, series):
+            fits.append(series)
+            started.set()
+            assert release.wait(5.0)
+
+    planner.forecaster = _SlowFit()
+    planner._refit_async([[1.0]])
+    assert started.wait(5.0)
+    # second refit while the first is in flight: skipped, not queued
+    planner._refit_async([[2.0]])
+    assert planner.refits_async == 1
+    assert planner.refits_skipped == 1
+    release.set()
+    # once the worker drains, the next boundary refits again
+    deadline = time.time() + 5.0
+    while planner._refit_flight.locked() and time.time() < deadline:
+        time.sleep(0.01)
+    started.clear()
+    planner._refit_async([[3.0]])
+    assert started.wait(5.0)  # release already set: the fit completes
+    assert planner.refits_async == 2
+    assert len(fits) == 2  # the skipped series never reached the fit
+
+
+def test_sweep_refit_boundary_is_asynchronous(clean_faults):
+    """At a refit boundary (sweeps % refit_every == 0) with a ready
+    forecaster, the sweep returns while the fit is still running."""
+    demand = DemandWindow(bucket_s=1.0, window_buckets=4)
+    now = 990.0
+    _feed(demand, ["t1", "t2"], now)
+    planner, _ = _planner(demand, refit_every=1)
+
+    release = threading.Event()
+
+    class _ReadySlow:
+        min_examples = 1
+        ready = True
+
+        def forecast_demand(self, series):
+            return series.sum(axis=1)
+
+        def fit(self, series):
+            assert release.wait(5.0)
+
+        def stats(self):
+            return {"backend": "stub"}
+
+    planner.forecaster = _ReadySlow()
+    out = planner.sweep_once(now=now)  # must not block on the held fit
+    assert out["outcome"] == "planned"
+    assert planner.refits_async == 1
+    release.set()
+    s = planner.stats()
+    assert s["refits_async"] == 1

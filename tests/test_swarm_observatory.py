@@ -1,0 +1,461 @@
+"""Swarm observatory (ISSUE 19): incremental per-task swarm snapshots,
+the conservation identity under concurrent churn, straggler/stuck
+detection with edge-triggered cooldown-limited flight events, the
+``GET /debug/swarm`` endpoint, the telemetry rollup the manager folds,
+the dfswarm tree renderer, and the fleet membership transition events.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dragonfly2_tpu.scheduler import swarm
+from dragonfly2_tpu.tools import dfswarm
+from dragonfly2_tpu.utils import flight
+
+
+@pytest.fixture(autouse=True)
+def clean_swarm():
+    swarm.reset()
+    yield
+    swarm.reset()
+
+
+def _swarm_events(kind):
+    ring = flight.snapshot(["scheduler"]).get("scheduler", [])
+    return [e for e in ring if e["type"] == f"scheduler.swarm_{kind}"]
+
+
+# ---------------------------------------------------------------------------
+# graph accounting
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_tracks_tree_and_coverage():
+    swarm.on_peer("t1", "seed", seed=True, total_pieces=8)
+    swarm.on_peer("t1", "p1")
+    swarm.on_peer("t1", "p2")
+    swarm.on_primary_parent("t1", "p1", "seed")
+    swarm.on_primary_parent("t1", "p2", "p1")
+    swarm.on_state("t1", "p1", "Running")
+    swarm.on_piece("t1", "p1", 3, 8)
+
+    snap = swarm.snapshot()
+    view = snap["tasks"]["t1"]
+    assert view["peer_count"] == 3
+    assert view["edges"] == 2 and view["roots"] == 1
+    assert view["consistent"] is True
+    assert view["seeders"] == 1
+    assert view["peers"]["p1"]["parent"] == "seed"
+    assert view["peers"]["p1"]["depth"] == 1
+    assert view["peers"]["p2"]["depth"] == 2
+    assert view["depth_hist"] == {"0": 1, "1": 1, "2": 1}
+    assert view["done_pieces"] == 3 and view["total_pieces"] == 8
+    assert view["coverage"] == pytest.approx(3 / 8)
+    assert snap["consistent"] is True
+    assert snap["peer_count"] == 3 and snap["edges"] == 2
+
+
+def test_coverage_is_monotone_max_over_peers():
+    swarm.on_peer("t1", "a", total_pieces=10)
+    swarm.on_peer("t1", "b")
+    swarm.on_piece("t1", "a", 7, 10)
+    swarm.on_piece("t1", "b", 2, 10)  # a slower peer never lowers it
+    view = swarm.snapshot()["tasks"]["t1"]
+    assert view["done_pieces"] == 7
+    assert view["coverage"] == pytest.approx(0.7)
+
+
+def test_reschedule_and_peer_gone_keep_the_identity():
+    swarm.on_peer("t1", "seed", seed=True)
+    for p in ("a", "b", "c"):
+        swarm.on_peer("t1", p)
+        swarm.on_primary_parent("t1", p, "seed")
+    swarm.on_primary_parent("t1", "c", "a")  # re-placement, edge count flat
+    assert swarm.snapshot()["tasks"]["t1"]["edges"] == 3
+
+    swarm.on_reschedule("t1", "b")  # parent dropped: b is a root again
+    view = swarm.snapshot()["tasks"]["t1"]
+    assert view["edges"] == 2 and view["roots"] == 2
+    assert view["consistent"] is True
+    assert view["reschedules"] == 1
+
+    # deleting a parent orphans its children without tearing the identity
+    swarm.on_peer_gone("t1", "a")
+    view = swarm.snapshot()["tasks"]["t1"]
+    assert view["peer_count"] == 3  # seed, b, c
+    assert view["peers"]["c"]["parent"] is None
+    assert view["consistent"] is True
+
+    swarm.on_task_gone("t1")
+    snap = swarm.snapshot()
+    assert snap["task_count"] == 0 and snap["peer_count"] == 0
+
+
+def test_on_total_backfills_coverage_after_the_fact():
+    """A back-to-source download reports every piece before the
+    scheduler learns the task's true total (download_peer_finished),
+    so the last on_piece carries total=-1 and the finished task would
+    read coverage 0 forever. on_total adopts the late-learned total."""
+    swarm.on_peer("t1", "p1")
+    swarm.on_piece("t1", "p1", 3, -1)
+    assert swarm.snapshot()["tasks"]["t1"]["coverage"] == 0.0
+    swarm.on_total("t1", 3)
+    view = swarm.snapshot()["tasks"]["t1"]
+    assert view["total_pieces"] == 3
+    assert view["coverage"] == pytest.approx(1.0)
+    # non-positive updates are ignored; a late smaller total never shrinks
+    swarm.on_total("t1", 0)
+    swarm.on_total("t1", -1)
+    assert swarm.snapshot()["tasks"]["t1"]["total_pieces"] == 3
+
+
+def test_back_to_source_churn_is_counted():
+    swarm.on_peer("t1", "a")
+    swarm.on_state("t1", "a", "BackToSource")
+    swarm.on_state("t1", "a", "Running")
+    swarm.on_state("t1", "a", "BackToSource")
+    view = swarm.snapshot()["tasks"]["t1"]
+    assert view["back_to_source"] == 2
+    assert swarm.snapshot()["back_to_source"] == 2
+
+
+def test_caps_drop_and_account_instead_of_growing():
+    swarm.configure()  # defaults
+    for i in range(swarm._TASK_CAP):
+        swarm.on_peer(f"cap-{i}", "p")
+    swarm.on_peer("one-too-many", "p")
+    snap = swarm.snapshot()
+    assert snap["task_count"] == swarm._TASK_CAP
+    assert snap["dropped"]["tasks"] == 1
+
+
+def test_self_healing_hooks_rebuild_after_reset():
+    """A restarted scheduler re-registers into the surviving ledger:
+    bare hook calls (state/piece) recreate the views they reference."""
+    swarm.on_state("t1", "a", "Running")
+    swarm.on_piece("t1", "b", 2, 4)
+    view = swarm.snapshot()["tasks"]["t1"]
+    assert set(view["peers"]) == {"a", "b"}
+    assert view["consistent"] is True
+
+
+# ---------------------------------------------------------------------------
+# concurrent churn: the identity holds in every snapshot
+# ---------------------------------------------------------------------------
+
+
+def test_identity_holds_under_concurrent_churn():
+    stop = threading.Event()
+    errors = []
+
+    def churn(tid, n):
+        try:
+            i = 0
+            while not stop.is_set():
+                p = f"{tid}-p{i % 7}"
+                swarm.on_peer(tid, p, total_pieces=16)
+                swarm.on_primary_parent(tid, p, f"{tid}-p{(i + 1) % 7}")
+                swarm.on_piece(tid, p, i % 16, 16)
+                swarm.on_state(tid, p, "Running")
+                if i % 5 == 0:
+                    swarm.on_reschedule(tid, p)
+                if i % 11 == 0:
+                    swarm.on_peer_gone(tid, p)
+                i += 1
+        except Exception as e:  # pragma: no cover - the assertion payload
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=churn, args=(f"task-{t}", t), daemon=True)
+        for t in range(4)
+    ]
+    for t in threads:
+        t.start()
+    coverage_high: dict = {}
+    try:
+        for _ in range(200):
+            snap = swarm.snapshot()
+            # no torn reads: the incremental edge counter always agrees
+            # with the map scan, for every task and in the rollup
+            assert snap["consistent"] is True, snap
+            for tid, view in snap["tasks"].items():
+                assert view["consistent"] is True, (tid, view)
+                cov = view["coverage"]
+                assert 0.0 <= cov <= 1.0
+                assert cov >= coverage_high.get(tid, 0.0)
+                coverage_high[tid] = cov
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+    assert not errors, errors
+
+
+# ---------------------------------------------------------------------------
+# straggler / stuck detection
+# ---------------------------------------------------------------------------
+
+
+def _rated_swarm(window=0.05):
+    """Three fast Running peers and one slow one, rates established
+    over one real window."""
+    swarm.configure(rate_window_s=window, straggler_min_peers=3,
+                    cooldown_s=0.0, stuck_after_s=3600.0)
+    for p in ("f1", "f2", "f3", "slow"):
+        swarm.on_peer("t1", p, total_pieces=100)
+        swarm.on_state("t1", p, "Running")
+    time.sleep(window * 1.5)
+    for p in ("f1", "f2", "f3"):
+        swarm.on_piece("t1", p, 50, 100)
+    swarm.on_piece("t1", "slow", 1, 100)
+
+
+def test_straggler_detect_flag_and_clear():
+    _rated_swarm()
+    view = swarm.snapshot()["tasks"]["t1"]
+    assert view["stragglers"] == ["slow"]
+    assert view["peers"]["slow"]["straggler"] is True
+    evs = _swarm_events("straggler")
+    assert any(e["peer_id"] == "slow" and e["task_id"] == "t1" for e in evs)
+
+    # the slow peer catches up: the flag clears on the next detection
+    time.sleep(0.08)
+    swarm.on_piece("t1", "slow", 90, 100)
+    view = swarm.snapshot()["tasks"]["t1"]
+    assert view["stragglers"] == []
+    assert view["peers"]["slow"]["straggler"] is False
+
+
+def test_straggler_events_are_edge_triggered_with_cooldown():
+    _rated_swarm()
+    before = len(_swarm_events("straggler"))
+    swarm.configure(cooldown_s=3600.0)
+    swarm.snapshot()  # flags slow; emits once
+    swarm.snapshot()  # still slow; flag already set, no second event
+    mid = len(_swarm_events("straggler"))
+    assert mid == before + 1
+
+    # clear, then drag again: re-flagged, but the cooldown mutes the event
+    time.sleep(0.08)
+    swarm.on_piece("t1", "slow", 90, 100)
+    swarm.snapshot()
+    time.sleep(0.08)
+    for p in ("f1", "f2", "f3"):
+        swarm.on_piece("t1", p, 100, 100)
+    swarm.snapshot()
+    assert len(_swarm_events("straggler")) == mid
+
+
+def test_median_needs_enough_rated_peers():
+    swarm.configure(rate_window_s=0.02, straggler_min_peers=3)
+    for p in ("a", "b"):
+        swarm.on_peer("t1", p, total_pieces=10)
+        swarm.on_state("t1", p, "Running")
+    time.sleep(0.04)
+    swarm.on_piece("t1", "a", 9, 10)
+    swarm.on_piece("t1", "b", 1, 10)
+    # two rated peers < straggler_min_peers: nobody is flagged
+    assert swarm.snapshot()["tasks"]["t1"]["stragglers"] == []
+
+
+def test_stuck_detect_and_clear():
+    swarm.configure(stuck_after_s=0.05, cooldown_s=0.0)
+    swarm.on_peer("t1", "a")
+    swarm.on_state("t1", "a", "Pending")
+    swarm.on_peer("t1", "done")
+    swarm.on_state("t1", "done", "Succeeded")  # terminal: never stuck
+    time.sleep(0.1)
+    view = swarm.snapshot()["tasks"]["t1"]
+    assert view["stuck"] == ["a"]
+    evs = _swarm_events("stuck")
+    assert any(e["peer_id"] == "a" for e in evs)
+
+    swarm.on_piece("t1", "a", 1, 4)  # progress un-sticks it
+    view = swarm.snapshot()["tasks"]["t1"]
+    assert view["stuck"] == []
+
+
+# ---------------------------------------------------------------------------
+# exposure: /debug/swarm, telemetry shapes, dfswarm renderer
+# ---------------------------------------------------------------------------
+
+
+class TestDebugSwarmEndpoint:
+    @pytest.fixture()
+    def server(self):
+        from dragonfly2_tpu.utils.metrics import MetricsServer, Registry
+
+        srv = MetricsServer(Registry("t_swarm"))
+        addr = srv.start()
+        yield addr
+        srv.stop()
+
+    def test_200_full_and_per_task(self, server):
+        swarm.on_peer("t1", "seed", seed=True, total_pieces=4)
+        swarm.on_peer("t2", "other")
+        body = json.loads(
+            urllib.request.urlopen(f"http://{server}/debug/swarm").read()
+        )
+        assert set(body["tasks"]) == {"t1", "t2"}
+        assert body["consistent"] is True
+        body = json.loads(
+            urllib.request.urlopen(
+                f"http://{server}/debug/swarm?task=t1"
+            ).read()
+        )
+        assert set(body["tasks"]) == {"t1"}
+        assert body["tasks"]["t1"]["seeders"] == 1
+
+    def test_unknown_param_400(self, server):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"http://{server}/debug/swarm?bogus=1")
+        assert exc.value.code == 400
+        assert "error" in json.loads(exc.value.read())
+
+
+def test_telemetry_rollup_and_summary_shapes():
+    assert swarm.telemetry_rollup() == {}
+    assert swarm.summary() == {"tasks": 0, "peers": 0}
+
+    swarm.on_peer("t1", "seed", seed=True, total_pieces=4)
+    swarm.on_peer("t1", "a")
+    swarm.on_primary_parent("t1", "a", "seed")
+    roll = swarm.telemetry_rollup()
+    assert roll["tasks"] == 1 and roll["peers"] == 2
+    assert roll["edges"] == 1 and roll["roots"] == 1
+    assert roll["depth_hist"] == {"0": 1, "1": 1}
+    assert swarm.summary() == roll
+
+
+def test_telemetry_section_rows():
+    swarm.on_peer("t1", "seed", seed=True, total_pieces=8)
+    swarm.on_peer("t1", "a")
+    swarm.on_state("t1", "a", "Leave")  # not a live peer
+    swarm.on_piece("t1", "seed", 8, 8)
+    rows = swarm.telemetry_section()
+    assert rows == [
+        {
+            "task_id": "t1",
+            "peers": 1,
+            "seeders": 1,
+            "done_pieces": 8,
+            "total_pieces": 8,
+            "stragglers": [],
+        }
+    ]
+
+
+def test_dfswarm_renders_the_tree():
+    swarm.on_peer("t1", "seed", seed=True, total_pieces=4)
+    swarm.on_peer("t1", "child")
+    swarm.on_primary_parent("t1", "child", "seed")
+    swarm.on_piece("t1", "child", 2, 4)
+    out = dfswarm.render(swarm.snapshot())
+    lines = out.splitlines()
+    assert lines[0].startswith("task t1")
+    assert "coverage=0.50" in lines[0]
+    assert "seed  Pending" in out and "[seed]" in out
+    assert "└─ child" in out  # child indented under its primary parent
+    assert "tasks=1" in lines[-1] and "consistent=True" in lines[-1]
+
+
+def test_dfswarm_flags_stragglers_and_handles_empty():
+    assert dfswarm.render(swarm.snapshot()) == "dfswarm: no tasks tracked\n"
+    _rated_swarm()
+    out = dfswarm.render(swarm.snapshot())
+    assert "[STRAGGLER]" in out
+
+
+def test_dfswarm_render_survives_a_torn_cycle():
+    """Defensive: a hand-built snapshot with a parent cycle must render
+    (with a cycle marker), not hang the CLI."""
+    view = {
+        "peer_count": 2, "edges": 2, "roots": 0, "coverage": 0.0,
+        "done_pieces": 0, "total_pieces": 0, "back_to_source": 0,
+        "reschedules": 0, "consistent": False,
+        "peers": {
+            "a": {"state": "Running", "parent": "b", "pieces": 0},
+            "b": {"state": "Running", "parent": "a", "pieces": 0},
+        },
+    }
+    out = dfswarm.render_task("t-cycle", view)
+    assert "!INCONSISTENT" in out
+    assert "(cycle)" in out
+
+
+def test_summary_rides_a_flight_probe():
+    """scheduler/server.py registers ``swarm.summary`` as the
+    scheduler.swarm probe; the summary must serialize through the
+    runtime-state path Diagnose dumps use."""
+    flight.register_probe("scheduler.swarm", swarm.summary)
+    swarm.on_peer("t1", "a")
+    state = flight._recorder.runtime_state(include_stacks=False)
+    probe = state["probes"]["scheduler.swarm"]
+    assert probe["tasks"] == 1 and probe["peers"] == 1
+    json.dumps(probe)  # Diagnose/dump payloads are JSON
+
+
+# ---------------------------------------------------------------------------
+# series sync
+# ---------------------------------------------------------------------------
+
+
+def test_sync_series_flushes_gauges_and_counters():
+    swarm.on_peer("t1", "a")
+    swarm.on_state("t1", "a", "Running")
+    swarm.on_primary_parent("t1", "a", "ghost")
+    swarm.on_reschedule("t1", "a")
+    before = swarm.SWARM_RESCHEDULES_TOTAL.value
+    swarm.sync_series()
+    assert swarm.SWARM_TASKS.value == 1
+    assert swarm.SWARM_PEERS.labels("Running").value == 1
+    assert swarm.SWARM_RESCHEDULES_TOTAL.value == before + 1
+    # the delta flushed once: a second sync with no churn adds nothing
+    swarm.sync_series()
+    assert swarm.SWARM_RESCHEDULES_TOTAL.value == before + 1
+    # a state that empties zeroes its gauge child instead of going stale
+    swarm.on_state("t1", "a", "Succeeded")
+    swarm.sync_series()
+    assert swarm.SWARM_PEERS.labels("Running").value == 0
+    assert swarm.SWARM_PEERS.labels("Succeeded").value == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet membership transitions (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_membership_transitions_emit_events_and_counter():
+    from dragonfly2_tpu.scheduler import fleet
+    from dragonfly2_tpu.scheduler.fleet import FleetConfig, FleetMembership
+    from dragonfly2_tpu.utils.kvstore import KVStore
+
+    kv = KVStore()
+    m = FleetMembership(
+        kv, "127.0.0.1:41", FleetConfig(lease_ttl=30.0, poll_interval=3600.0)
+    )
+    joins = fleet.FLEET_TRANSITIONS_TOTAL.labels("join").value
+    leaves = fleet.FLEET_TRANSITIONS_TOTAL.labels("leave").value
+    recons = fleet.FLEET_TRANSITIONS_TOTAL.labels("reconcile").value
+    m.join()
+    try:
+        assert fleet.FLEET_TRANSITIONS_TOTAL.labels("join").value == joins + 1
+        assert (
+            fleet.FLEET_TRANSITIONS_TOTAL.labels("reconcile").value
+            == recons + 1
+        )
+    finally:
+        m.leave()
+    assert fleet.FLEET_TRANSITIONS_TOTAL.labels("leave").value == leaves + 1
+
+    ring = flight.snapshot(["scheduler"]).get("scheduler", [])
+    types = [e["type"] for e in ring]
+    assert "scheduler.fleet_join" in types
+    assert "scheduler.fleet_leave" in types
+    recon = [e for e in ring if e["type"] == "scheduler.fleet_reconcile"]
+    assert any(e.get("joined") == ["127.0.0.1:41"] for e in recon)
