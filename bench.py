@@ -473,7 +473,13 @@ def fleet_shard_kill_bench() -> dict:
     ``fleet_success_rate`` must be 1.0 with zero hangs and
     ``fleet_blackout_ms`` bounded by one lease TTL + one membership poll
     — the fleet's acceptance check, re-proven on every bench run, with
-    aggregate ``schedule_ops_per_s`` as the scale-out headline."""
+    aggregate ``schedule_ops_per_s`` as the scale-out headline. The
+    ISSUE 20 two-arm comparison rides the same dict:
+    ``fleet_blackout_ms_replicated`` (kill → first recognized resume of
+    an in-flight victim peer with swarm replication armed) must sit
+    strictly below ``fleet_blackout_ms_rebuild`` (replication off, the
+    successor rebuilds from re-registrations), with ``swarm_adopt_ms``
+    as the successor's fetch+gate+seed cost."""
     from dragonfly2_tpu.tools.stress import shard_kill_soak
 
     return shard_kill_soak(peers=150, shards=3, workers=12)
@@ -1254,7 +1260,8 @@ def main() -> None:
             host_rates["chaos_error"] = str(e)
             _phase(f"chaos soak failed: {e}")
         # fleet shard-kill soak: 3 scheduler shards under KV leases, one
-        # SIGKILL'd mid announce load — success rate, blackout ms, and
+        # SIGKILL'd mid announce load — success rate, blackout ms, the
+        # two-arm replicated-vs-rebuild comparison, adopt latency, and
         # aggregate schedule ops/s ride every exit path
         try:
             host_rates.update(fleet_shard_kill_bench())
@@ -1262,6 +1269,9 @@ def main() -> None:
                 f"fleet shard-kill: success {host_rates['fleet_success_rate']:.2f}"
                 f" hangs {host_rates['fleet_hangs']}"
                 f" blackout {host_rates['fleet_blackout_ms']:.0f}ms"
+                f" replicated {host_rates['fleet_blackout_ms_replicated']:.0f}ms"
+                f" vs rebuild {host_rates['fleet_blackout_ms_rebuild']:.0f}ms"
+                f" adopt {host_rates['swarm_adopt_ms']:.1f}ms"
                 f" ({host_rates['schedule_ops_per_s']:.0f} schedule ops/s)"
             )
         except Exception as e:

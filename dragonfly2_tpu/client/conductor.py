@@ -343,11 +343,23 @@ class PeerTaskConductor:
                     self._wrong_shard_retries = 0
                     if self._outage_started:
                         # announce plane recovered: the blackout is the
-                        # gap from first stream error to this decision
+                        # gap from first stream error to this decision,
+                        # and the decision's KIND says whether the
+                        # failover was lossless — a parent assignment
+                        # means the successor recognized this peer, a
+                        # back-to-source means its swarm state was lost
                         fleet.BLACKOUT_MS.observe(
                             (time.monotonic() - self._outage_started) * 1e3
                         )
                         self._outage_started = 0.0
+                        kind = (
+                            "recognized"
+                            if which in ("normal_task", "small_task")
+                            else "fallback"
+                            if which == "need_back_to_source"
+                            else "other"
+                        )
+                        fleet.FAILOVER_RESUME_TOTAL.labels(kind).inc()
                 elif not self._outage_started:
                     self._outage_started = time.monotonic()
             except queue.Empty:

@@ -81,6 +81,14 @@ FLEET_TRANSITIONS_TOTAL = _r.counter(
     "Fleet membership transitions observed by this process",
     ("transition",),
 )
+FAILOVER_RESUME_TOTAL = _r.counter(
+    "fleet_failover_resume_total",
+    "First decision after an announce-plane outage, by kind:"
+    " 'recognized' (normal/small-task decision — the successor adopted"
+    " the swarm and resumed the peer) vs 'fallback'"
+    " (need_back_to_source — the swarm state was lost and rebuilt)",
+    ("kind",),
+)
 BLACKOUT_MS = _r.histogram(
     "fleet_blackout_milliseconds",
     "Announce-plane disruption per failover: from first stream error to"
@@ -131,6 +139,15 @@ class WrongShardError(Exception):
 # O(hosts²) edge keys; a per-second KEYS walk would stall unrelated ops
 # under the store lock at swarm scale)
 FLEET_INDEX_KEY = "fleet:index"
+
+# fleet generation counter, shared through the KV: bumped (INCR) by any
+# member that applies a membership change, read back on every poll so
+# all members converge on the settled value within one poll interval.
+# Replica snapshots are stamped with the writer's settled epoch; an
+# adopting successor refuses replicas stamped before its own pre-change
+# settled epoch (the "adoption floor") — leftovers from an older fleet
+# generation never seed a swarm.
+FLEET_EPOCH_KEY = "fleet:epoch"
 
 
 def write_lease(kv, address: str, ttl_seconds: float) -> None:
@@ -205,6 +222,18 @@ class FleetMembership:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._renew_failures = 0
+        self._epoch = 0  # settled fleet generation (KV-read cache)
+        self._epoch_floor = 0  # pre-change settled epoch: adoption gate
+        self._observers: list = []
+
+    def add_observer(self, fn) -> None:
+        """Register a membership-change observer, fired AFTER a change
+        is applied, outside the fleet lock, with a dict of ``joined`` /
+        ``left`` / ``members`` / ``ring_version`` / ``epoch_floor``.
+        The replication plane uses this to sweep for adoptable swarms
+        the moment a member dies."""
+        with self._lock:
+            self._observers.append(fn)
 
     # -- lifecycle -----------------------------------------------------
     def join(self) -> None:
@@ -281,8 +310,20 @@ class FleetMembership:
         must not stall owner checks on the announce path."""
         members = tuple(read_members(self.kv))
         with self._lock:
+            peek_changed = members != self._members
+        # epoch I/O stays outside the lock like the membership read: a
+        # change bumps the shared generation counter, a quiet poll just
+        # converges the cache on the settled value
+        epoch_now = self._read_epoch()
+        if peek_changed:
+            try:
+                epoch_now = int(self.kv.incr(FLEET_EPOCH_KEY))
+            except Exception as e:
+                logger.warning("fleet epoch bump failed: %s", e)
+        with self._lock:
             current = self._members
             if members == current:
+                self._epoch = epoch_now
                 return False
             joined = sorted(set(members) - set(current))
             left = sorted(set(current) - set(members))
@@ -293,6 +334,12 @@ class FleetMembership:
             self._members = members
             self._ring_changed_at = time.monotonic()
             version = self.ring.version
+            # the floor is this member's last SETTLED view — the epoch
+            # the victim was stamping replicas with before it died
+            self._epoch_floor = self._epoch
+            self._epoch = epoch_now
+            floor = self._epoch_floor
+            observers = list(self._observers)
         MEMBERS_GAUGE.set(len(members))
         REBALANCE_TOTAL.labels("scheduler").inc()
         EV_REBALANCE(
@@ -308,9 +355,41 @@ class FleetMembership:
         )
         FLEET_TRANSITIONS_TOTAL.labels("reconcile").inc()
         logger.info(
-            "fleet membership now %s (ring v%d)", list(members), version
+            "fleet membership now %s (ring v%d, epoch %d)",
+            list(members), version, epoch_now,
         )
+        for fn in observers:
+            try:
+                fn({
+                    "joined": joined,
+                    "left": left,
+                    "members": list(members),
+                    "ring_version": version,
+                    "epoch_floor": floor,
+                })
+            except Exception:
+                logger.exception("fleet membership observer failed")
         return True
+
+    def _read_epoch(self) -> int:
+        try:
+            v = self.kv.get(FLEET_EPOCH_KEY)
+            return int(v) if v else 0
+        except Exception:
+            with self._lock:
+                return self._epoch
+
+    def epoch(self) -> int:
+        """This member's settled view of the fleet generation — the
+        stamp the replicator writes into every snapshot."""
+        with self._lock:
+            return self._epoch
+
+    def epoch_floor(self) -> int:
+        """Minimum acceptable replica epoch for adoption: the settled
+        generation before this member's latest membership change."""
+        with self._lock:
+            return self._epoch_floor
 
     def members(self) -> list[str]:
         with self._lock:
@@ -323,6 +402,8 @@ class FleetMembership:
                 "self": self.self_addr,
                 "members": list(self._members),
                 "ring_version": self.ring.version,
+                "epoch": self._epoch,
+                "epoch_floor": self._epoch_floor,
                 "renew_failures": self._renew_failures,
                 "in_grace": time.monotonic()
                 < self._ring_changed_at + self.cfg.grace_s,

@@ -102,6 +102,17 @@ class SchedulerServerConfig:
     fleet_renew_interval: float = 1.0
     fleet_poll_interval: float = 1.0
     fleet_grace_s: float = 10.0
+    # swarm replication plane (scheduler/swarm_replication.py,
+    # docs/fleet.md failover section): journal per-task swarm snapshots
+    # through the shared KV so a successor shard ADOPTS a dead member's
+    # swarms — peers resume with state intact — instead of rebuilding
+    # them from re-registration. Starts with the fleet (fleet_enabled);
+    # replication without sharding has no successor to hand to.
+    swarm_replication: bool = True
+    swarm_replication_interval: float = 0.25
+    swarm_replication_max_tasks: int = 64
+    swarm_replication_backlog_cap: int = 1024
+    swarm_replication_ttl_s: float = 600.0
     # address other fleet members/daemons reach this scheduler at;
     # 0 = advertise_ip:<bound port>
     advertise_port: int = 0
@@ -389,6 +400,7 @@ class SchedulerServer:
         self._grpc = None
         self.port: int | None = None
         self.fleet = None
+        self.replication = None
         self.telemetry_reporter = None
 
     # ------------------------------------------------------------------
@@ -465,6 +477,38 @@ class SchedulerServer:
             self.service.fleet = self.fleet
             self.service_v1.fleet = self.fleet
             flight.register_probe("scheduler.fleet", self.fleet.snapshot)
+            if cfg.swarm_replication:
+                from dragonfly2_tpu.scheduler.swarm_replication import (
+                    ReplicationConfig,
+                    SwarmReplicator,
+                )
+
+                # like the heartbeat, the flush burst gets its OWN
+                # connection when remote: a multi-task pipelined write
+                # must not hold the announce path's socket lock
+                repl_kv = (
+                    kvstore.RemoteKVStore(cfg.kv_address, secret=cfg.kv_secret)
+                    if cfg.kv_address
+                    else self.kvstore
+                )
+                self.replication = SwarmReplicator(
+                    repl_kv,
+                    f"{cfg.advertise_ip}:{cfg.advertise_port or self.port}",
+                    self.resource,
+                    fleet=self.fleet,
+                    config=ReplicationConfig(
+                        interval_s=cfg.swarm_replication_interval,
+                        max_tasks_per_flush=cfg.swarm_replication_max_tasks,
+                        backlog_cap=cfg.swarm_replication_backlog_cap,
+                        replica_ttl_s=cfg.swarm_replication_ttl_s,
+                    ),
+                )
+                self.replication.start()
+                self.service.replication = self.replication
+                self.service_v1.replication = self.replication
+                flight.register_probe(
+                    "scheduler.swarm_replication", self.replication.stats
+                )
         if self.topology_engine is not None:
             try:
                 # restart recovery: adopt the durable KV graph into the
@@ -580,6 +624,12 @@ class SchedulerServer:
         # storage → gc → announcer → clients → graceful grpc stop
         if getattr(self, "_metrics", None) is not None:
             self._metrics.stop()
+        if self.replication is not None:
+            # before the fleet leave: the final flush stamps the current
+            # epoch while this member is still a voting reader of it
+            self.replication.stop()
+            if self.replication.kv is not self.kvstore:
+                self.replication.kv.close()
         if self.fleet is not None:
             # graceful leave FIRST: peers stop routing new shards here
             # while the grpc grace period drains in-flight streams

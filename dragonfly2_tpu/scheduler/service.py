@@ -209,12 +209,14 @@ class SchedulerService:
         storage: Storage | None = None,
         networktopology: NetworkTopology | None = None,
         fleet=None,  # scheduler.fleet.FleetMembership; None = no sharding
+        replication=None,  # scheduler.swarm_replication.SwarmReplicator
     ):
         self.resource = resource
         self.scheduling = scheduling
         self.storage = storage
         self.networktopology = networktopology
         self.fleet = fleet
+        self.replication = replication
 
     # ------------------------------------------------------------------
     # AnnouncePeer bidi stream
@@ -348,10 +350,23 @@ class SchedulerService:
             # WRONG_SHARD status (raises through the pump); tasks this
             # member already serves drain behind the rebalance grace
             existing = self.resource.task_manager.load(task_id)
-            self.fleet.check_owner(
-                task_id,
-                task_in_flight=existing is not None and existing.peer_count() > 0,
-            )
+            try:
+                self.fleet.check_owner(
+                    task_id,
+                    task_in_flight=existing is not None and existing.peer_count() > 0,
+                )
+            except WrongShardError as e:
+                # hand the swarm over with the refusal: the replica
+                # (handoff-marked) reaches the KV before the daemon's
+                # re-pick reaches the new owner
+                if existing is not None and self.replication is not None:
+                    self.replication.migrate(task_id, e.owner)
+                raise
+            if existing is None and self.replication is not None:
+                # first sighting of a task this shard owns: a dead
+                # member's replica may be waiting — adopt it so the
+                # registering peer is recognized instead of rebuilt
+                self.replication.adopt_task(task_id)
         host = self.resource.host_manager.load(req.host_id)
         if host is None:
             logger.warning("register from unannounced host %s", req.host_id)

@@ -131,12 +131,14 @@ class SchedulerServiceV1:
         storage: Storage | None = None,
         networktopology=None,
         fleet=None,  # scheduler.fleet.FleetMembership; None = no sharding
+        replication=None,  # scheduler.swarm_replication.SwarmReplicator
     ):
         self.resource = resource
         self.scheduling = scheduling
         self.storage = storage
         self.networktopology = networktopology
         self.fleet = fleet
+        self.replication = replication
 
     # ------------------------------------------------------------------
     # RegisterPeerTask (unary, size-scope dispatch)
@@ -157,10 +159,19 @@ class SchedulerServiceV1:
         task_id = request.task_id or task_id_v1(request.url, meta)
         if self.fleet is not None:
             existing = self.resource.task_manager.load(task_id)
-            self.fleet.check_owner(
-                task_id,
-                task_in_flight=existing is not None and existing.peer_count() > 0,
-            )
+            try:
+                self.fleet.check_owner(
+                    task_id,
+                    task_in_flight=existing is not None and existing.peer_count() > 0,
+                )
+            except WrongShardError as e:
+                # migrate the replica with the refusal (v2 parity): the
+                # new owner adopts it inside the grace window
+                if existing is not None and self.replication is not None:
+                    self.replication.migrate(task_id, e.owner)
+                raise
+            if existing is None and self.replication is not None:
+                self.replication.adopt_task(task_id)
         host = self._store_host(request.peer_host)
         task, _ = load_or_create_task(
             self.resource, request.url, meta, task_id, request.task_type

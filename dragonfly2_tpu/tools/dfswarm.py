@@ -15,6 +15,16 @@ Usage:
 
 Without ``--once`` the view refreshes every ``--interval`` seconds,
 clearing the screen between frames like dfstat.
+
+Failover forensics (``--diff``): after a shard death, the successor's
+adoption receipt (``swarm:adopt:<task>``) carries the victim's last
+replica export verbatim, and the successor re-journals the adopted
+swarm under its own ownership. ``--diff --kv HOST:PORT [--task ID]``
+compares the two and names every missing, torn, or orphaned peer —
+the "did the swarm survive the kill intact" question, answered
+peer-by-peer instead of by a single counter:
+
+    python -m dragonfly2_tpu.tools.dfswarm --diff --kv 127.0.0.1:6379
 """
 
 from __future__ import annotations
@@ -118,19 +128,174 @@ def render(snap: dict) -> str:
     return "\n".join(frames) + footer
 
 
+# ---------------------------------------------------------------------------
+# --diff: adopted snapshot vs the victim's last replica export
+# ---------------------------------------------------------------------------
+
+
+def diff_replicas(old: dict, new: dict) -> dict:
+    """Compare two swarm replica payloads (the victim's last export
+    ``old`` against the successor's re-journaled snapshot ``new``) and
+    name what did not survive. Pure — the shard-kill soak and the tests
+    call this on raw payload dicts.
+
+    Failure classes: ``missing_peers`` (in old, gone from new),
+    ``torn_peers`` (piece progress regressed, or state fell back to
+    Pending), ``orphaned`` (had a parent, now has none — its feed edge
+    was lost). ``moved`` (parent changed to a different live parent —
+    a legal reschedule) and ``extra_peers`` (new arrivals) are
+    informational. ``conserved`` checks the successor snapshot's own
+    integrity identity (edges == peers − roots); ``clean`` is the
+    adoption verdict the soak gates on."""
+    old_peers = (old.get("obs") or {}).get("peers", {}) if old else {}
+    new_peers = (new.get("obs") or {}).get("peers", {}) if new else {}
+    missing, torn, orphaned, moved = [], [], [], []
+    for pid, op in old_peers.items():
+        np = new_peers.get(pid)
+        if np is None:
+            missing.append(pid)
+            continue
+        if int(np.get("pieces", 0)) < int(op.get("pieces", 0)) or (
+            np.get("state") == "Pending" and op.get("state") != "Pending"
+        ):
+            torn.append(pid)
+        if op.get("parent") is not None:
+            if np.get("parent") is None:
+                orphaned.append(pid)
+            elif np.get("parent") != op.get("parent"):
+                moved.append(pid)
+    extra = [pid for pid in new_peers if pid not in old_peers]
+    roots = sum(1 for p in new_peers.values() if p.get("parent") is None)
+    conserved = int((new.get("obs") or {}).get("edges", -1)) == len(new_peers) - roots
+    return {
+        "missing_peers": sorted(missing),
+        "torn_peers": sorted(torn),
+        "orphaned": sorted(orphaned),
+        "moved": sorted(moved),
+        "extra_peers": sorted(extra),
+        "conserved": conserved,
+        "clean": conserved and not (missing or torn or orphaned),
+    }
+
+
+def render_diff(task_id: str, receipt: dict, new_owner: "str | None",
+                d: dict) -> str:
+    """One task's adoption diff as a string (pure — tests assert on it)."""
+    old = receipt.get("payload") or {}
+    old_peers = (old.get("obs") or {}).get("peers", {})
+    lines = [
+        f"adopt {_short(task_id, 48)}"
+        f"  victim={receipt.get('victim', '?')}"
+        f"  adopted_by={receipt.get('adopted_by', '?')}"
+        f"  epoch={receipt.get('epoch', '?')} seq={receipt.get('seq', '?')}"
+        f"  adopt_ms={receipt.get('adopt_ms', '?')}"
+        f"  outcome={receipt.get('outcome', '?')}",
+        f"  replica now owned by {new_owner or '(not re-journaled)'}",
+        f"  peers: old={len(old_peers)}"
+        f"  missing={len(d['missing_peers'])} torn={len(d['torn_peers'])}"
+        f"  orphaned={len(d['orphaned'])} moved={len(d['moved'])}"
+        f"  extra={len(d['extra_peers'])}",
+    ]
+    for pid in d["missing_peers"]:
+        op = old_peers.get(pid, {})
+        lines.append(
+            f"  missing peer {_short(pid)}  (was {op.get('state', '?')}"
+            f" pieces={op.get('pieces', 0)} parent={op.get('parent')})"
+        )
+    for pid in d["torn_peers"]:
+        lines.append(f"  torn peer {_short(pid)}  (progress regressed)")
+    for pid in d["orphaned"]:
+        op = old_peers.get(pid, {})
+        lines.append(
+            f"  orphaned peer {_short(pid)}  (parent {op.get('parent')} -> none)"
+        )
+    for pid in d["moved"]:
+        lines.append(f"  moved peer {_short(pid)}  (rescheduled parent)")
+    lines.append(
+        "  conservation: " + ("OK" if d["conserved"] else "VIOLATED")
+    )
+    lines.append("  verdict: " + ("CLEAN" if d["clean"] else "TORN"))
+    return "\n".join(lines) + "\n"
+
+
+def run_diff(kv_addr: str, task: "str | None") -> int:
+    """Fetch receipts + current replicas from the KV and diff them.
+    Exit 0 only when every diffed adoption is clean."""
+    from dragonfly2_tpu.utils.kvstore import (
+        SWARM_REPLICA_INDEX_KEY,
+        RemoteKVStore,
+        make_swarm_adopt_key,
+        make_swarm_replica_key,
+    )
+
+    kv = RemoteKVStore(kv_addr)
+    try:
+        if task:
+            tids = [task]
+        else:
+            tids = sorted((kv.hgetall(SWARM_REPLICA_INDEX_KEY) or {}).keys())
+        rc = shown = 0
+        for tid in tids:
+            raw = kv.get(make_swarm_adopt_key(tid))
+            if not raw:
+                if task:
+                    print(
+                        f"dfswarm: no adoption receipt for {tid}",
+                        file=sys.stderr,
+                    )
+                    return 1
+                continue
+            receipt = json.loads(raw)
+            row = kv.hmget(make_swarm_replica_key(tid), ["owner", "data"])
+            current = None
+            if row and row[1]:
+                try:
+                    current = json.loads(row[1])
+                except ValueError:
+                    current = None
+            d = diff_replicas(receipt.get("payload") or {}, current or {})
+            sys.stdout.write(
+                render_diff(tid, receipt, row[0] if row else None, d)
+            )
+            shown += 1
+            if not d["clean"]:
+                rc = 1
+        if not shown:
+            print("dfswarm: no adoption receipts to diff", file=sys.stderr)
+            return 1
+        return rc
+    finally:
+        kv.close()
+
+
 def main(argv: "list[str] | None" = None) -> int:
     p = argparse.ArgumentParser(
         prog="dfswarm",
         description="live swarm-tree view from a scheduler's /debug/swarm",
     )
     p.add_argument(
-        "--scheduler", required=True, metavar="HOST:PORT",
+        "--scheduler", default=None, metavar="HOST:PORT",
         help="scheduler metrics address (or full http:// URL)",
     )
     p.add_argument("--task", default=None, help="limit to one task id")
     p.add_argument("--once", action="store_true", help="one frame, no refresh")
     p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument(
+        "--diff", action="store_true",
+        help="diff adopted swarm snapshots against their victims' last"
+        " replica exports (reads the KV, not the scheduler)",
+    )
+    p.add_argument(
+        "--kv", default=None, metavar="HOST:PORT",
+        help="KV address for --diff (the fleet's shared store)",
+    )
     args = p.parse_args(argv)
+    if args.diff:
+        if not args.kv:
+            p.error("--diff requires --kv")
+        return run_diff(args.kv, args.task)
+    if not args.scheduler:
+        p.error("--scheduler is required (unless --diff)")
     while True:
         try:
             frame = render(fetch(args.scheduler, args.task))

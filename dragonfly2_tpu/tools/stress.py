@@ -253,6 +253,14 @@ def chaos_soak(
     Returns the chaos-soak statistics bench.py re-emits:
     ``chaos_success_rate`` (correct-bytes completions / downloads),
     ``chaos_hangs``, ``chaos_faults_injected``, ``chaos_wall_s``.
+
+    The registry scenario rides the same chaos: both daemons front an
+    in-memory blob origin through their registry proxies, and two image
+    tags sharing a layer are pulled — the first tag before the midpoint
+    (wire faults armed), the second THROUGH the scheduler restart and
+    killed parent. Gated on the flow ledger's byte-conservation
+    identity (``chaos_flow_conserved``) and ``chaos_layer_dedup_ratio``
+    > 0 — chaos must not tear the provenance accounting.
     """
     import shutil
 
@@ -267,6 +275,7 @@ def chaos_soak(
     from dragonfly2_tpu.scheduler.storage import Storage
     from dragonfly2_tpu.scheduler import swarm
     from dragonfly2_tpu.utils import faults
+    from dragonfly2_tpu.utils import flows
 
     # swarm-observatory conservation check: the scheduler runs
     # in-process, so the module-global ledger is visible here. Sampled
@@ -304,14 +313,26 @@ def chaos_soak(
         )
         return serve({SERVICE_NAME: service}, address=f"127.0.0.1:{port}")
 
+    # registry scenario riding the chaos: two tags sharing one layer
+    # blob (same digest under both repo paths) plus one unique each
+    layer_len = piece * 2
+    blob_shared = os.urandom(layer_len)
+    blobs = {}
+    for repo in ("app-a", "app-b"):
+        blobs[f"/v2/{repo}/blobs/sha256:shared-0"] = blob_shared
+        blobs[f"/v2/{repo}/blobs/sha256:{repo}-0"] = os.urandom(layer_len)
+
     tmp = tempfile.mkdtemp(prefix="dfchaos-")
     swarm.reset()  # the soak judges its own swarm, not process leftovers
     injected_before = _faults_injected_total()
     t_start = time.perf_counter()
     successes = hangs = 0
-    server = daemons = None
+    registry_pulls = registry_bad = 0
+    server = daemons = origin = None
     final_swarm: dict = {}
+    flow_snap: dict = {"planes": {"image": {"bytes": {"dedup": 0}, "served_bytes": 0}}}
     try:
+        origin, origin_url = _blob_origin(blobs)
         server, port = _scheduler(os.path.join(tmp, "rec"))
         daemons = []
         for name in ("a", "b"):
@@ -320,9 +341,12 @@ def chaos_soak(
                     data_dir=os.path.join(tmp, f"daemon-{name}"),
                     scheduler_address=f"127.0.0.1:{port}",
                     hostname=f"chaos-{name}",
+                    ip="127.0.0.1",
                     piece_length=piece,
                     announce_interval=0.5,
                     schedule_timeout=5.0,
+                    proxy_port=0,
+                    proxy_rules=[{"regex": r"/v2/.+/blobs/"}],
                 )
             )
             d.start()
@@ -353,6 +377,15 @@ def chaos_soak(
             f"seed={seed};rpc.unary_send=error:UNAVAILABLE@{rpc_error_rate}"
             ";rpc.unary_send=error:UNAVAILABLE#2+2"
         )
+
+        # first tag pulls under the armed wire faults, before the
+        # midpoint; the flow ledger starts clean so conservation is
+        # judged over exactly this soak's traffic
+        flows.reset()
+        for d in (a, b):
+            n, nbad = _proxy_pull(d.proxy.port, origin_url, blobs, "app-a")
+            registry_pulls += n
+            registry_bad += nbad
 
         for i in range(1, downloads):
             if i == max(1, downloads // 2):
@@ -391,6 +424,15 @@ def chaos_soak(
             if result.get("ok") and open(out, "rb").read() == data:
                 successes += 1
             _sample_swarm()
+
+        # second tag THROUGH the wreckage: scheduler restarted, parent
+        # upload dead, wire faults still armed — the shared layer must
+        # dedup, the ledger must still conserve
+        for d in (a, b):
+            n, nbad = _proxy_pull(d.proxy.port, origin_url, blobs, "app-b")
+            registry_pulls += n
+            registry_bad += nbad
+        flow_snap = _settled_flows()
         final_swarm = _sample_swarm()
     finally:
         faults.clear()
@@ -404,7 +446,12 @@ def chaos_soak(
                 server.stop(0)
             except Exception:
                 pass
+        if origin is not None:
+            origin.shutdown()
+            origin.server_close()
         shutil.rmtree(tmp, ignore_errors=True)
+    img = flow_snap["planes"]["image"]
+    image_total = sum(img["bytes"].values())
     return {
         "chaos_downloads": downloads,
         "chaos_success_rate": round(successes / downloads, 4),
@@ -416,6 +463,14 @@ def chaos_soak(
         "chaos_swarm_violations": sorted(set(swarm_violations)),
         "chaos_swarm_tasks": int(final_swarm.get("task_count", 0)),
         "chaos_swarm_peers": int(final_swarm.get("peer_count", 0)),
+        "chaos_registry_pulls": registry_pulls,
+        "chaos_registry_bad_bytes": registry_bad,
+        "chaos_layer_dedup_ratio": round(
+            img["bytes"]["dedup"] / image_total if image_total else 0.0, 4
+        ),
+        "chaos_flow_conserved": int(
+            sum(img["bytes"].values()) == img["served_bytes"]
+        ),
     }
 
 
@@ -1348,12 +1403,16 @@ def preheat_soak(
 
 def _spawn_scheduler(workdir: str, kv_addr: str, lease_ttl: float,
                      renew: float, poll: float, manager_addr: str = "",
-                     telemetry_interval: float = 0.5):
+                     telemetry_interval: float = 0.5,
+                     replication: bool = True,
+                     replication_interval: float = 0.1):
     """One real scheduler process joined to the fleet; returns
     (Popen, addr). Killed with SIGKILL later — which is the point.
     With ``manager_addr`` the shard also registers with the manager and
     pushes telemetry every ``telemetry_interval`` — the soak then checks
-    the manager's view of the kill against the measured blackout."""
+    the manager's view of the kill against the measured blackout.
+    ``replication=False`` is the rebuild-baseline arm: the shard runs
+    without the swarm replication plane, so a successor knows nothing."""
     import subprocess
 
     repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -1372,6 +1431,8 @@ def _spawn_scheduler(workdir: str, kv_addr: str, lease_ttl: float,
         "--set", f"fleet_renew_interval={renew}",
         "--set", f"fleet_poll_interval={poll}",
         "--set", "fleet_grace_s=2.0",
+        "--set", f"swarm_replication={'true' if replication else 'false'}",
+        "--set", f"swarm_replication_interval={replication_interval}",
         # the soak drives the announce plane, not the topology/ML
         # planes — keep shard boot light and jax out of the children
         "--set", "topology_backend=off",
@@ -1425,7 +1486,184 @@ def _spawn_scheduler(workdir: str, kv_addr: str, lease_ttl: float,
     return proc, addr
 
 
-def shard_kill_soak(
+# ---------------------------------------------------------------------------
+# victim-cohort drill: a real swarm built on the victim shard over the
+# wire (seed completes back-to-source, children download from it and
+# stay in flight), then resumed on the ring successor after the SIGKILL.
+# The resume decision KIND is the whole point: a recognized peer gets a
+# normal_task (parents intact — the successor adopted the replica), a
+# forgotten one gets need_back_to_source (swarm state lost, rebuild).
+# ---------------------------------------------------------------------------
+
+
+def _drill_announce(client, task_id: str, url: str, host_id: str,
+                    peer_id: str, need_back_to_source: bool = False,
+                    timeout: float = 60.0):
+    """Open one AnnouncePeer stream and register; returns
+    (send_queue, responses, first_response). The stream stays open —
+    callers either keep feeding it (in-flight child) or close it with
+    ``q.put(None)`` and a drain."""
+    import queue as _queue
+
+    from dragonfly2_tpu.rpc import gen  # noqa: F401
+    import common_pb2  # noqa: E402
+    import scheduler_pb2  # noqa: E402
+
+    q: "_queue.Queue" = _queue.Queue()
+    q.put(
+        scheduler_pb2.AnnouncePeerRequest(
+            host_id=host_id, task_id=task_id, peer_id=peer_id,
+            register_peer=scheduler_pb2.RegisterPeerRequest(
+                task_id=task_id, peer_id=peer_id, url=url,
+                url_meta=common_pb2.UrlMeta(),
+                need_back_to_source=need_back_to_source,
+            ),
+        )
+    )
+    responses = client.AnnouncePeer(iter(q.get, None), timeout=timeout)
+    try:
+        first = next(responses)
+    except BaseException:
+        # release gRPC's request-sender thread before propagating
+        q.put(None)
+        raise
+    return q, responses, first
+
+
+def _drill_seed(client, task_id: str, url: str, host_id: str,
+                peer_id: str, piece_len: int, piece_count: int) -> None:
+    """One complete back-to-source acquisition over the announce
+    stream: register (demanding the source), report every piece, finish.
+    Leaves a Succeeded peer holding all pieces — the swarm's seed."""
+    from dragonfly2_tpu.rpc import gen  # noqa: F401
+    import common_pb2  # noqa: E402
+    import scheduler_pb2  # noqa: E402
+
+    q, responses, first = _drill_announce(
+        client, task_id, url, host_id, peer_id, need_back_to_source=True
+    )
+    kind = first.WhichOneof("response")
+    if kind != "need_back_to_source":
+        q.put(None)
+        for _ in responses:
+            pass
+        raise RuntimeError(f"seed drill: expected need_back_to_source, got {kind}")
+    q.put(
+        scheduler_pb2.AnnouncePeerRequest(
+            host_id=host_id, task_id=task_id, peer_id=peer_id,
+            download_peer_back_to_source_started=(
+                scheduler_pb2.DownloadPeerBackToSourceStartedRequest()
+            ),
+        )
+    )
+    for n in range(piece_count):
+        q.put(
+            scheduler_pb2.AnnouncePeerRequest(
+                host_id=host_id, task_id=task_id, peer_id=peer_id,
+                download_piece_finished=scheduler_pb2.DownloadPieceFinishedRequest(
+                    piece=common_pb2.PieceInfo(
+                        number=n, offset=n * piece_len, length=piece_len,
+                        traffic_type="back_to_source", cost_ns=1_000_000,
+                    )
+                ),
+            )
+        )
+    q.put(
+        scheduler_pb2.AnnouncePeerRequest(
+            host_id=host_id, task_id=task_id, peer_id=peer_id,
+            download_peer_finished=scheduler_pb2.DownloadPeerFinishedRequest(
+                content_length=piece_len * piece_count,
+                piece_count=piece_count, cost_ns=5_000_000,
+            ),
+        )
+    )
+    q.put(None)
+    for _ in responses:
+        pass
+
+
+def _drill_child(client, task_id: str, url: str, host_id: str,
+                 peer_id: str, piece_len: int, pieces_done: int):
+    """One in-flight child: register, take the scheduled parent, report
+    ``pieces_done`` pieces from it, and LEAVE THE STREAM OPEN — the
+    SIGKILL must catch this peer mid-download. Returns (decision_kind,
+    open_stream_handle_or_None)."""
+    from dragonfly2_tpu.rpc import gen  # noqa: F401
+    import common_pb2  # noqa: E402
+    import scheduler_pb2  # noqa: E402
+
+    q, responses, first = _drill_announce(client, task_id, url, host_id, peer_id)
+    kind = first.WhichOneof("response")
+    if kind != "normal_task" or not first.normal_task.candidate_parents:
+        q.put(None)
+        for _ in responses:
+            pass
+        return kind, None
+    parent = first.normal_task.candidate_parents[0].peer_id
+    q.put(
+        scheduler_pb2.AnnouncePeerRequest(
+            host_id=host_id, task_id=task_id, peer_id=peer_id,
+            download_peer_started=scheduler_pb2.DownloadPeerStartedRequest(),
+        )
+    )
+    for n in range(pieces_done):
+        q.put(
+            scheduler_pb2.AnnouncePeerRequest(
+                host_id=host_id, task_id=task_id, peer_id=peer_id,
+                download_piece_finished=scheduler_pb2.DownloadPieceFinishedRequest(
+                    piece=common_pb2.PieceInfo(
+                        number=n, offset=n * piece_len, length=piece_len,
+                        parent_id=parent, traffic_type="remote_peer",
+                        cost_ns=1_000_000,
+                    )
+                ),
+            )
+        )
+    return kind, (q, responses)
+
+
+def _drill_close(handle) -> None:
+    """Tear down an open drill stream, tolerating a dead server (the
+    victim was SIGKILL'd while the stream was live — that's the drill)."""
+    if not handle:
+        return
+    q, responses = handle
+    try:
+        q.put(None)
+        for _ in responses:
+            pass
+    except Exception:
+        pass
+
+
+def _wait_fresh_renewal(kv, addr: str, timeout_s: float = 3.0) -> None:
+    """Block until the member's lease is renewed ONCE more, so a SIGKILL
+    issued right after leaves a near-full TTL residual — both soak arms
+    then pay the same lease drain and the blackout comparison measures
+    the rebuild cost, not renewal-phase luck."""
+    from dragonfly2_tpu.scheduler import fleet  # noqa: F401
+    from dragonfly2_tpu.utils.kvstore import make_fleet_member_key
+
+    key = make_fleet_member_key(addr)
+
+    def renewed_at():
+        try:
+            return json.loads(kv.get(key) or "{}").get("renewed_at", 0.0)
+        except Exception:
+            return None
+
+    base = renewed_at()
+    if base is None:
+        return
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        cur = renewed_at()
+        if cur is None or cur != base:
+            return
+        time.sleep(0.02)
+
+
+def _shard_kill_arm(
     peers: int = 240,
     shards: int = 3,
     workers: int = 12,
@@ -1435,10 +1673,13 @@ def shard_kill_soak(
     op_deadline_s: float = 25.0,
     wall_deadline_s: float = 180.0,
     telemetry: bool = True,
+    replication: bool = True,
+    drill_children: int = 3,
+    reannounce_delay_s: float = 0.5,
 ) -> dict:
-    """The fleet-failover acceptance soak: ``shards`` real scheduler
-    processes under KV leases, ``peers`` simulated announce ops riding
-    the consistent-hash ring, one shard SIGKILL'd mid-load.
+    """One arm of the fleet-failover acceptance soak: ``shards`` real
+    scheduler processes under KV leases, ``peers`` simulated announce
+    ops riding the consistent-hash ring, one shard SIGKILL'd mid-load.
 
     Each op is one AnnouncePeer register→decision round trip pinned to
     the task's ring owner, retried through WRONG_SHARD refusals and dead
@@ -1457,6 +1698,20 @@ def shard_kill_soak(
     blackout, not assumed. Telemetry failures degrade to a
     ``fleet_telemetry_error`` key; the failover gates never depend on
     the observability plane being up.
+
+    The victim-cohort drill rides every arm: a real swarm (seed +
+    ``drill_children`` in-flight children) is built on the victim over
+    the wire BEFORE the kill, and the children re-register on the ring
+    successor with the SAME peer ids after it. With ``replication``
+    (the default) the successor adopts the victim's replicated swarm —
+    every child's first decision must carry parents
+    (``fleet_victim_fallbacks`` == 0) and ``fleet_cohort_blackout_ms``
+    measures kill → first parent-bearing resume. Without it (the
+    rebuild-baseline arm) the successor knows nothing: the first resume
+    falls back to source, the seed has to re-register after a modeled
+    ``reannounce_delay_s`` daemon announce delay, and only then do the
+    children get parents — the structurally slower number the
+    replicated arm must beat.
     """
     import queue as _queue
     import shutil
@@ -1509,6 +1764,7 @@ def shard_kill_soak(
                 os.path.join(tmp, f"sched-{i}"), kv_addr,
                 lease_ttl, renew_interval, poll_interval,
                 manager_addr=manager_grpc_addr,
+                replication=replication,
             )
             procs.append(proc)
             addrs.append(addr)
@@ -1598,6 +1854,60 @@ def shard_kill_soak(
             if sel.addr_for_task(f"shardkill-probe-{i}") == victim_addr
         )
 
+        # -- victim cohort: a real swarm whose owner is about to die ----
+        drill_piece, drill_total = 4096, 4
+        drill_task = next(
+            t for t in (f"shardkill-drill-{i}" for i in range(10_000))
+            if sel.addr_for_task(t) == victim_addr
+        )
+        drill_url = f"http://soak/{drill_task}"
+        seed_host, seed_peer = "host-drill-seed", f"{drill_task}-seed"
+        _, drill_client = sel.resolve_for_task(drill_task)
+        _drill_seed(
+            drill_client, drill_task, drill_url, seed_host, seed_peer,
+            drill_piece, drill_total,
+        )
+        cohort: list = []
+        open_streams: list = []
+        drill_setup_ok = 1
+        for c in range(drill_children):
+            hid, pid = f"host-drill-c{c}", f"{drill_task}-child-{c}"
+            kind, handle = _drill_child(
+                drill_client, drill_task, drill_url, hid, pid,
+                drill_piece, 2,
+            )
+            cohort.append((hid, pid))
+            if handle is not None:
+                open_streams.append(handle)
+            if kind != "normal_task":
+                drill_setup_ok = 0
+
+        # replicated arm: don't pull the trigger until the victim's
+        # journal has the whole cohort at the settled fleet epoch —
+        # the drill proves adoption, not a flush race
+        replica_settled = 0
+        if replication:
+            want_epoch = int(watcher_kv.get(fleet.FLEET_EPOCH_KEY) or 0)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                row = watcher_kv.hmget(
+                    kvstore.make_swarm_replica_key(drill_task),
+                    ["epoch", "data"],
+                )
+                if row and row[1]:
+                    try:
+                        peers_map = (
+                            json.loads(row[1]).get("obs") or {}
+                        ).get("peers", {})
+                    except ValueError:
+                        peers_map = {}
+                    if int(row[0] or 0) >= want_epoch and all(
+                        pid in peers_map for _, pid in cohort
+                    ):
+                        replica_settled = 1
+                        break
+                time.sleep(0.05)
+
         next_op = [0]
 
         def worker() -> None:
@@ -1625,6 +1935,10 @@ def shard_kill_soak(
             if done >= max(peers // 3, 1):
                 break
             time.sleep(0.05)
+        # sync the kill to a just-observed lease renewal: both arms then
+        # pay a near-full TTL residual, so the blackout DELTA between
+        # them is rebuild cost, not renewal-phase luck
+        _wait_fresh_renewal(watcher_kv, victim_addr)
         procs[victim_idx].kill()  # SIGKILL: no graceful leave, lease stays
         t_kill = time.monotonic()
 
@@ -1634,6 +1948,79 @@ def shard_kill_soak(
         blackout_ms = -1.0
         if announce_op(probe_key, 999_999, op_deadline_s):
             blackout_ms = (time.monotonic() - t_kill) * 1e3
+
+        # -- cohort resume: same peer ids, ring successor ---------------
+        # (runs BEFORE the manager-telemetry wait: the staleness window is
+        # several seconds and only the replicated arm runs telemetry, so
+        # waiting first would floor THIS arm's cohort blackout and invert
+        # the replicated-vs-rebuild comparison)
+        for h in open_streams:
+            _drill_close(h)  # victim is dead; drain the broken streams
+
+        def resume_child(hid: str, pid: str, deadline_s: float):
+            """Re-register pid through the ring; the FIRST decision that
+            lands is the verdict (recognized vs fallback)."""
+            avoid: set = set()
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                try:
+                    addr, client = sel.resolve_for_task(drill_task, avoid=avoid)
+                except Exception:
+                    time.sleep(0.1)
+                    continue
+                try:
+                    q, responses, first = _drill_announce(
+                        client, drill_task, drill_url, hid, pid, timeout=15.0
+                    )
+                except grpc.RpcError as e:
+                    if fleet.parse_wrong_shard(str(e)) is not None:
+                        sel.refresh_membership()
+                    else:
+                        avoid.add(addr)
+                    time.sleep(0.05)
+                    continue
+                kind = first.WhichOneof("response")
+                _drill_close((q, responses))
+                return kind
+            return None
+
+        cohort_blackout_ms = -1.0
+        recognized = fallbacks = storms = 0
+        resume_deadline = time.monotonic() + op_deadline_s
+        for hid, pid in cohort:
+            while time.monotonic() < resume_deadline:
+                kind = resume_child(
+                    hid, pid, resume_deadline - time.monotonic()
+                )
+                if kind in ("normal_task", "small_task"):
+                    recognized += 1
+                    if cohort_blackout_ms < 0:
+                        cohort_blackout_ms = (
+                            time.monotonic() - t_kill
+                        ) * 1e3
+                    break
+                if kind == "need_back_to_source":
+                    # the successor forgot the swarm: model the rebuild
+                    # storm ONCE — the seed daemon re-announces after
+                    # its announce delay, then the children try again
+                    fallbacks += 1
+                    if storms == 0:
+                        storms = 1
+                        time.sleep(reannounce_delay_s)
+                        try:
+                            _, cl = sel.resolve_for_task(drill_task)
+                            _drill_seed(
+                                cl, drill_task, drill_url, seed_host,
+                                f"{seed_peer}-re", drill_piece,
+                                drill_total,
+                            )
+                        except Exception as e:
+                            print(
+                                f"stress: rebuild re-seed failed: {e}",
+                                file=sys.stderr,
+                            )
+                    continue
+                break  # None (timed out) or an unexpected kind
 
         # the manager's view of the same kill: the victim's telemetry
         # pushes stop, so its shard row flips stale at /api/v1/telemetry
@@ -1668,6 +2055,46 @@ def shard_kill_soak(
             except Exception as e:
                 telemetry_error = telemetry_error or f"manager view failed: {e}"
 
+        # -- adoption receipt + replica diff (replicated arm) -----------
+        swarm_adopt_ms = -1.0
+        adopt_outcome = ""
+        diff_missing = diff_torn = diff_orphaned = diff_clean = -1
+        if replication:
+            receipt: dict = {}
+            try:
+                raw = watcher_kv.get(kvstore.make_swarm_adopt_key(drill_task))
+                if raw:
+                    receipt = json.loads(raw)
+            except Exception:
+                receipt = {}
+            swarm_adopt_ms = float(receipt.get("adopt_ms", -1.0))
+            adopt_outcome = str(receipt.get("outcome", "missing"))
+            # the successor re-journals the adopted swarm under its own
+            # ownership; the victim's last export (riding the receipt)
+            # must survive into it intact
+            succ_payload = None
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                row = watcher_kv.hmget(
+                    kvstore.make_swarm_replica_key(drill_task),
+                    ["owner", "data"],
+                )
+                if row and row[0] and row[0] != victim_addr and row[1]:
+                    try:
+                        succ_payload = json.loads(row[1])
+                    except ValueError:
+                        succ_payload = None
+                    break
+                time.sleep(0.1)
+            if receipt.get("payload") and succ_payload:
+                from dragonfly2_tpu.tools.dfswarm import diff_replicas
+
+                d = diff_replicas(receipt["payload"], succ_payload)
+                diff_missing = len(d["missing_peers"])
+                diff_torn = len(d["torn_peers"])
+                diff_orphaned = len(d["orphaned"])
+                diff_clean = int(d["clean"])
+
         hangs = 0
         hard_deadline = t_start + wall_deadline_s
         for t in threads:
@@ -1689,7 +2116,21 @@ def shard_kill_soak(
             "fleet_wrong_shard_retries": wrong_shard,
             "schedule_ops_per_s": round(ok / wall, 1) if wall else 0.0,
             "fleet_wall_s": round(wall, 2),
+            "fleet_victim_cohort": len(cohort),
+            "fleet_victim_recognized": recognized,
+            "fleet_victim_fallbacks": fallbacks,
+            "fleet_cohort_blackout_ms": round(cohort_blackout_ms, 1),
+            "fleet_drill_setup_ok": drill_setup_ok,
+            "swarm_replication_on": int(replication),
+            "swarm_replica_settled": replica_settled,
         }
+        if replication:
+            stats["swarm_adopt_ms"] = round(swarm_adopt_ms, 1)
+            stats["swarm_adopt_outcome"] = adopt_outcome
+            stats["swarm_replica_diff_missing_peers"] = diff_missing
+            stats["swarm_replica_diff_torn_peers"] = diff_torn
+            stats["swarm_replica_diff_orphaned"] = diff_orphaned
+            stats["swarm_replica_diff_clean"] = diff_clean
         if manager is not None or telemetry_error:
             stats["fleet_manager_shards"] = manager_shards
             stats["fleet_manager_blackout_ms"] = round(manager_blackout_ms, 1)
@@ -1721,60 +2162,56 @@ def shard_kill_soak(
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def registry_soak(
-    shared_layers: int = 2,
-    unique_layers: int = 1,
-    piece: int = 16 * 1024,
-    pieces_per_layer: int = 3,
-    object_bytes: int = 48 * 1024,
+def shard_kill_soak(
+    peers: int = 240,
+    shards: int = 3,
+    workers: int = 12,
+    lease_ttl: float = 2.0,
+    renew_interval: float = 0.5,
+    poll_interval: float = 0.4,
+    op_deadline_s: float = 25.0,
+    wall_deadline_s: float = 180.0,
+    telemetry: bool = True,
+    baseline_peers: int = 0,
 ) -> dict:
-    """Registry + object-storage acceptance soak for the flow ledger
-    (utils/flows): two daemons front an in-memory blob origin through
-    their registry proxies; two image tags share ``shared_layers``
-    identical layer blobs (same digest, different ``/v2/<repo>/blobs/``
-    paths — distinct swarm tasks, identical content) plus
-    ``unique_layers`` per-tag blobs. Pull order lights every provenance:
+    """The two-arm fleet-failover soak. The replicated arm (swarm
+    replication on, full load, manager telemetry) provides every
+    historical key plus the victim-cohort verdict; a smaller
+    rebuild-baseline arm (replication off, no telemetry) measures what
+    the same SIGKILL costs when the successor has to rebuild the swarm
+    from re-registrations. The headline comparison:
+    ``fleet_blackout_ms_replicated`` (kill → first recognized,
+    parent-bearing resume of an in-flight victim peer) must sit strictly
+    below ``fleet_blackout_ms_rebuild`` — lossless failover is only
+    worth its journal if it beats just-re-register."""
+    stats = _shard_kill_arm(
+        peers=peers, shards=shards, workers=workers,
+        lease_ttl=lease_ttl, renew_interval=renew_interval,
+        poll_interval=poll_interval, op_deadline_s=op_deadline_s,
+        wall_deadline_s=wall_deadline_s, telemetry=telemetry,
+        replication=True,
+    )
+    rebuild = _shard_kill_arm(
+        peers=baseline_peers or max(60, peers // 4),
+        shards=shards, workers=workers,
+        lease_ttl=lease_ttl, renew_interval=renew_interval,
+        poll_interval=poll_interval, op_deadline_s=op_deadline_s,
+        wall_deadline_s=wall_deadline_s, telemetry=False,
+        replication=False,
+    )
+    stats["fleet_blackout_ms_replicated"] = stats["fleet_cohort_blackout_ms"]
+    stats["fleet_blackout_ms_rebuild"] = rebuild["fleet_cohort_blackout_ms"]
+    stats["fleet_rebuild_fallbacks"] = rebuild["fleet_victim_fallbacks"]
+    stats["fleet_rebuild_wall_s"] = rebuild["fleet_wall_s"]
+    return stats
 
-      tag app-a via daemon A  ->  origin   (back-to-source acquisition)
-      tag app-a via daemon B  ->  parent   (P2P from A)
-      tag app-b via daemon A  ->  dedup shared + origin unique
-      tag app-b via daemon B  ->  dedup shared + parent unique
 
-    then a dfstore round (PUT mode=1 import on A, double GET through B)
-    lights the object plane's parent and local_cache cells. Gates: every
-    body byte-exact, ``layer_dedup_ratio`` > 0, the second tag's
-    ``p2p_efficiency`` delta > 0.5, and per-plane byte conservation —
-    bytes served at each plane edge equal the sum of that plane's
-    provenance cells.
-    """
+def _blob_origin(blobs: dict):
+    """An in-memory registry blob origin (HEAD/GET with Range support)
+    over a ``path -> bytes`` map; returns (ThreadingHTTPServer,
+    base_url). Shared by the registry soak and the chaos soak's
+    registry-pull scenario."""
     import http.server
-    import shutil
-    import urllib.request
-
-    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
-    from dragonfly2_tpu.rpc.glue import serve
-    from dragonfly2_tpu.scheduler import resource as res
-    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
-    from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
-    from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
-    from dragonfly2_tpu.scheduler.storage import Storage
-    from dragonfly2_tpu.utils import flows
-
-    layer_len = piece * pieces_per_layer
-    shared = [os.urandom(layer_len) for _ in range(shared_layers)]
-    uniques = {
-        repo: [os.urandom(layer_len) for _ in range(unique_layers)]
-        for repo in ("app-a", "app-b")
-    }
-    # blob namespace mirrors a registry: shared layers appear under BOTH
-    # repo paths with the same digest name (that is what "two tags share
-    # a layer" looks like on the wire — same digest, different repo URL)
-    blobs: dict = {}
-    for repo in ("app-a", "app-b"):
-        for i, data in enumerate(shared):
-            blobs[f"/v2/{repo}/blobs/sha256:shared-{i}"] = data
-        for i, data in enumerate(uniques[repo]):
-            blobs[f"/v2/{repo}/blobs/sha256:{repo}-{i}"] = data
 
     class BlobHandler(http.server.BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -1821,6 +2258,109 @@ def registry_soak(
             self.end_headers()
             self.wfile.write(data)
 
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), BlobHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _proxy_pull(proxy_port: int, origin_url: str, blobs: dict, repo: str,
+                latencies: "list | None" = None,
+                timeout: float = 30.0) -> tuple:
+    """One tag pull through a daemon's registry proxy: every blob of the
+    repo, byte-checked. Returns (pulled, bad) — a failed request counts
+    as bad, never raises."""
+    import urllib.request
+
+    pulled = bad = 0
+    for path, data in sorted(blobs.items()):
+        if f"/v2/{repo}/" not in path:
+            continue
+        req = urllib.request.Request(f"{origin_url}{path}")
+        req.set_proxy(f"127.0.0.1:{proxy_port}", "http")
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                body = resp.read()
+        except Exception:
+            body = None
+        if latencies is not None:
+            latencies.append(time.perf_counter() - t0)
+        bad += int(body != data)
+        pulled += 1
+    return pulled, bad
+
+
+def _settled_flows() -> dict:
+    """The proxy handler's trailing ``flows`` calls run AFTER the client
+    sees the last body byte — poll until the ledger stops moving so
+    snapshots never race a request's own accounting."""
+    from dragonfly2_tpu.utils import flows
+
+    snap = flows.snapshot()
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
+        nxt = flows.snapshot()
+        if nxt == snap:
+            return nxt
+        snap = nxt
+    return snap
+
+
+def registry_soak(
+    shared_layers: int = 2,
+    unique_layers: int = 1,
+    piece: int = 16 * 1024,
+    pieces_per_layer: int = 3,
+    object_bytes: int = 48 * 1024,
+) -> dict:
+    """Registry + object-storage acceptance soak for the flow ledger
+    (utils/flows): two daemons front an in-memory blob origin through
+    their registry proxies; two image tags share ``shared_layers``
+    identical layer blobs (same digest, different ``/v2/<repo>/blobs/``
+    paths — distinct swarm tasks, identical content) plus
+    ``unique_layers`` per-tag blobs. Pull order lights every provenance:
+
+      tag app-a via daemon A  ->  origin   (back-to-source acquisition)
+      tag app-a via daemon B  ->  parent   (P2P from A)
+      tag app-b via daemon A  ->  dedup shared + origin unique
+      tag app-b via daemon B  ->  dedup shared + parent unique
+
+    then a dfstore round (PUT mode=1 import on A, double GET through B)
+    lights the object plane's parent and local_cache cells. Gates: every
+    body byte-exact, ``layer_dedup_ratio`` > 0, the second tag's
+    ``p2p_efficiency`` delta > 0.5, and per-plane byte conservation —
+    bytes served at each plane edge equal the sum of that plane's
+    provenance cells.
+    """
+    import shutil
+    import urllib.request
+
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.rpc.glue import serve
+    from dragonfly2_tpu.scheduler import resource as res
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+    from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+    from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
+    from dragonfly2_tpu.scheduler.storage import Storage
+    from dragonfly2_tpu.utils import flows
+
+    layer_len = piece * pieces_per_layer
+    shared = [os.urandom(layer_len) for _ in range(shared_layers)]
+    uniques = {
+        repo: [os.urandom(layer_len) for _ in range(unique_layers)]
+        for repo in ("app-a", "app-b")
+    }
+    # blob namespace mirrors a registry: shared layers appear under BOTH
+    # repo paths with the same digest name (that is what "two tags share
+    # a layer" looks like on the wire — same digest, different repo URL)
+    blobs: dict = {}
+    for repo in ("app-a", "app-b"):
+        for i, data in enumerate(shared):
+            blobs[f"/v2/{repo}/blobs/sha256:shared-{i}"] = data
+        for i, data in enumerate(uniques[repo]):
+            blobs[f"/v2/{repo}/blobs/sha256:{repo}-{i}"] = data
+
     tmp = tempfile.mkdtemp(prefix="dfregistry-")
     t_start = time.perf_counter()
     origin = server = None
@@ -1831,41 +2371,19 @@ def registry_soak(
     def pull(d, repo) -> int:
         """One tag pull through a daemon's proxy: every blob of the repo."""
         nonlocal bad
-        pulled = 0
-        for path, data in sorted(blobs.items()):
-            if f"/v2/{repo}/" not in path:
-                continue
-            req = urllib.request.Request(f"{origin_url}{path}")
-            req.set_proxy(f"127.0.0.1:{d.proxy.port}", "http")
-            t0 = time.perf_counter()
-            with urllib.request.urlopen(req, timeout=30) as resp:
-                body = resp.read()
-            latencies.append(time.perf_counter() - t0)
-            bad += int(body != data)
-            pulled += 1
+        pulled, pull_bad = _proxy_pull(
+            d.proxy.port, origin_url, blobs, repo, latencies=latencies
+        )
+        bad += pull_bad
         return pulled
 
     def plane_row(snap, plane):
         return snap["planes"][plane]
 
-    def settled_snapshot() -> dict:
-        """The handler's trailing ``flows`` calls run AFTER the client
-        sees the last body byte — poll until the ledger stops moving so
-        snapshots never race a request's own accounting."""
-        snap = flows.snapshot()
-        deadline = time.monotonic() + 2.0
-        while time.monotonic() < deadline:
-            time.sleep(0.05)
-            nxt = flows.snapshot()
-            if nxt == snap:
-                return nxt
-            snap = nxt
-        return snap
+    settled_snapshot = _settled_flows
 
     try:
-        origin = http.server.ThreadingHTTPServer(("127.0.0.1", 0), BlobHandler)
-        threading.Thread(target=origin.serve_forever, daemon=True).start()
-        origin_url = f"http://127.0.0.1:{origin.server_address[1]}"
+        origin, origin_url = _blob_origin(blobs)
 
         service = SchedulerService(
             res.Resource(),
@@ -2150,6 +2668,16 @@ def main(argv=None) -> int:
             stats["fleet_success_rate"] == 1.0
             and not stats["fleet_hangs"]
             and stats["fleet_blackout_ms"] >= 0
+            # lossless-failover gates: the successor adopted the
+            # victim's replicated swarm, every in-flight victim peer
+            # resumed with parents (zero back-to-source fallbacks),
+            # the adopted snapshot survived intact, and the replicated
+            # blackout beat the rebuild-from-reregistration baseline
+            and stats["swarm_adopt_outcome"] == "adopted"
+            and stats["fleet_victim_fallbacks"] == 0
+            and stats["swarm_replica_diff_clean"] == 1
+            and 0 <= stats["fleet_blackout_ms_replicated"]
+            < stats["fleet_blackout_ms_rebuild"]
         )
         return 0 if ok else 1
     if args.chaos:
@@ -2159,7 +2687,17 @@ def main(argv=None) -> int:
             seed=args.chaos_seed,
         )
         print(json.dumps(stats))
-        return 0 if stats["chaos_success_rate"] == 1.0 and not stats["chaos_hangs"] else 1
+        ok = (
+            stats["chaos_success_rate"] == 1.0
+            and not stats["chaos_hangs"]
+            # registry-under-chaos gates: byte-exact pulls, the shared
+            # layer deduped, and the flow ledger's conservation identity
+            # held through the restart + wire faults
+            and stats["chaos_registry_bad_bytes"] == 0
+            and stats["chaos_layer_dedup_ratio"] > 0
+            and stats["chaos_flow_conserved"] == 1
+        )
+        return 0 if ok else 1
     if not args.url:
         p.error("--url is required (unless --chaos)")
     if args.requests <= 0 and args.duration <= 0:

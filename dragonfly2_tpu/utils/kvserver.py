@@ -20,7 +20,7 @@ what the reference stores — probes.go marshals JSON into Redis lists).
 
 Commands implemented (the subset the system uses, plus introspection):
 AUTH PING ECHO SET (PX/EX) GET MGET DEL EXISTS EXPIRE PEXPIRE INCR
-INCRBY HSET HGET HDEL HGETALL RPUSH LPOP LLEN LRANGE KEYS SCAN
+INCRBY HSET HGET HMGET HDEL HGETALL RPUSH LPOP LLEN LRANGE KEYS SCAN
 FLUSHALL. Unknown commands get -ERR, never a dropped connection.
 
 Hardening: the server binds loopback by default (network exposure is an
@@ -230,6 +230,10 @@ class KVRequestHandler(socketserver.BaseRequestHandler):
         if op == "HGET" and len(args) == 2:
             v = kv.hget(args[0], args[1])
             return _bulk(None if v is None else v)
+        if op == "HMGET" and len(args) >= 2:
+            # batched hash read (the swarm-replication adoption fetch):
+            # results align with the requested fields, missing → nil
+            return _array(kv.hmget(args[0], list(args[1:])))
         if op == "HDEL" and len(args) >= 2:
             return _int(kv.hdel(args[0], *args[1:]))
         if op == "HGETALL" and len(args) == 1:

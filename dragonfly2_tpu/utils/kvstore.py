@@ -148,6 +148,15 @@ class KVStore:
             h = self._data.get(key) if self._alive(key) else None
             return dict(h) if isinstance(h, dict) else {}
 
+    def hmget(self, key: str, fields: list[str]) -> list[Any]:
+        """Batched HGET over one hash (redis HMGET): results align with
+        ``fields``, missing fields (or a missing/expired hash) → None."""
+        with self._lock:
+            h = self._data.get(key) if self._alive(key) else None
+            if not isinstance(h, dict):
+                return [None] * len(fields)
+            return [h.get(f) for f in fields]
+
     # -- lists (bounded probe queues) ------------------------------------
     def rpush(self, key: str, *values: Any) -> int:
         with self._lock:
@@ -407,6 +416,54 @@ class RemoteKVStore:
                 self._drop_connection()
                 raise ConnectionError(f"kv pipeline reply lost ({e})") from e
 
+    def hmget(self, key: str, fields: list[str]) -> list:
+        """Batched HGET over one hash — one HMGET round-trip; results
+        align with ``fields`` (nil → None)."""
+        if not fields:
+            return []
+        return list(self._call("HMGET", key, *fields) or [])
+
+    def hset_batch(
+        self, writes: list[tuple[str, dict[str, Any]]], ttl_ms: "int | None" = None
+    ) -> None:
+        """Pipelined HSET: one write burst, N replies — the replication
+        flush would otherwise pay a round-trip per dirty task. With
+        ``ttl_ms`` a PEXPIRE frame rides per key in the same burst
+        (replica hygiene without extra round-trips). Same wire
+        discipline as ``hget_batch``: send-phase retry-once on a fresh
+        connection (partial frames never execute), read-phase no-resend
+        (HSET is not idempotent against concurrent HDEL)."""
+        if not writes:
+            return
+        replies = 0
+        with self._lock:
+            out = b""
+            for key, mapping in writes:
+                cmds = [["HSET", key]]
+                for f, v in mapping.items():
+                    cmds[0].append(f)
+                    cmds[0].append(v)
+                if ttl_ms is not None:
+                    cmds.append(["PEXPIRE", key, max(1, int(ttl_ms))])
+                for parts in cmds:
+                    frame = b"*" + str(len(parts)).encode() + _CRLF
+                    for p in parts:
+                        data = p if isinstance(p, bytes) else str(p).encode()
+                        frame += b"$" + str(len(data)).encode() + _CRLF + data + _CRLF
+                    out += frame
+                    replies += 1
+            try:
+                self._connect().sendall(out)
+            except (ConnectionError, OSError):
+                self._drop_connection()
+                self._connect().sendall(out)
+            try:
+                for _ in range(replies):
+                    self._read_reply()
+            except (ConnectionError, OSError) as e:
+                self._drop_connection()
+                raise ConnectionError(f"kv pipeline reply lost ({e})") from e
+
     def hgetall(self, key: str) -> dict[str, str]:
         flat = self._call("HGETALL", key) or []
         return dict(zip(flat[::2], flat[1::2]))
@@ -466,3 +523,17 @@ def make_fleet_member_key(address: str) -> str:
     """Scheduler-fleet lease key (scheduler/fleet.py): one leased key per
     live scheduler, expiring when its heartbeat stops."""
     return make_namespace("fleet", "member", address)
+
+
+# swarm replication plane (scheduler/swarm_replication.py): one hash per
+# replicated task, one index hash so sweeps never KEYS-scan, one receipt
+# per adoption preserving the victim's last export for dfswarm --diff
+SWARM_REPLICA_INDEX_KEY = make_namespace("swarm", "replica", "index")
+
+
+def make_swarm_replica_key(task_id: str) -> str:
+    return make_namespace("swarm", "replica", task_id)
+
+
+def make_swarm_adopt_key(task_id: str) -> str:
+    return make_namespace("swarm", "adopt", task_id)

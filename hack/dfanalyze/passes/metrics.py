@@ -93,6 +93,20 @@ PREHEAT_EVENT_MODULES = (
 # swarm census key on
 SWARM_EVENT_MODULE = "dragonfly2_tpu/scheduler/swarm.py"
 
+# ...EXCEPT the scheduler.swarm_adopt_* sub-segment, which belongs to
+# the replication plane (docs/fleet.md "failover protocol"): adoption
+# verdicts (ok/refused/migrate) are decided against the replicated
+# snapshot's epoch and conservation gates, which only the replicator
+# sees — an adopt-ish event declared elsewhere (including swarm.py
+# itself) would fork the failover timeline dfdoctor keys on
+SWARM_ADOPT_EVENT_MODULE = "dragonfly2_tpu/scheduler/swarm_replication.py"
+
+# the swarm_replication_* metric family is the replication plane's own
+# census surface (journal flushes, adoption outcomes, backlog): it is
+# declared in the replicator module only, so docs/metrics.md and the
+# soak gates can key on one site
+SWARM_REPLICATION_METRIC_MODULE = "dragonfly2_tpu/scheduler/swarm_replication.py"
+
 # the scheduler.fleet_* event segment belongs to the membership plane:
 # join/leave/reconcile transitions come from the hash-ring bookkeeping
 # alone, so the transition counter and the flight timeline can't drift
@@ -300,8 +314,20 @@ def check(package_dir: Path = PACKAGE) -> list[str]:
                     " daemon.object_ segment; object-storage events are"
                     f" declared in {OBJECT_EVENT_MODULE} only"
                 )
-            # scheduler.swarm_* belongs to the swarm observatory
+            # scheduler.swarm_adopt_* belongs to the replication plane
+            # (checked before the broader swarm_ rule it carves out of)
             if (
+                service == "scheduler"
+                and (what == "swarm_adopt" or what.startswith("swarm_adopt_"))
+            ):
+                if str(rel) != SWARM_ADOPT_EVENT_MODULE:
+                    failures.append(
+                        f"{site}: event {name!r} uses the reserved"
+                        " scheduler.swarm_adopt_ segment; adoption events"
+                        f" are declared in {SWARM_ADOPT_EVENT_MODULE} only"
+                    )
+            # scheduler.swarm_* belongs to the swarm observatory
+            elif (
                 service == "scheduler"
                 and (what == "swarm" or what.startswith("swarm_"))
                 and str(rel) != SWARM_EVENT_MODULE
@@ -356,6 +382,17 @@ def check(package_dir: Path = PACKAGE) -> list[str]:
                 failures.append(
                     f"{site}: counter {name!r} must end in _total"
                     " (OpenMetrics counter naming)"
+                )
+            # swarm_replication_* belongs to the replication plane
+            if (
+                name == "swarm_replication"
+                or name.startswith("swarm_replication_")
+            ) and str(rel) != SWARM_REPLICATION_METRIC_MODULE:
+                failures.append(
+                    f"{site}: metric {name!r} uses the reserved"
+                    " swarm_replication_ prefix; replication-plane metrics"
+                    f" are declared in {SWARM_REPLICATION_METRIC_MODULE}"
+                    " only"
                 )
             prev = seen.get(name)
             if prev is not None:

@@ -72,6 +72,17 @@ def _fake_fleet_soak():
         "fleet_wrong_shard_retries": 42,
         "schedule_ops_per_s": 55.0,
         "fleet_wall_s": 0.1,
+        # ISSUE 20 two-arm failover comparison + adoption verdict
+        "fleet_blackout_ms_replicated": 2300.0,
+        "fleet_blackout_ms_rebuild": 4100.0,
+        "fleet_rebuild_fallbacks": 3,
+        "fleet_rebuild_wall_s": 0.1,
+        "swarm_adopt_ms": 4.2,
+        "swarm_adopt_outcome": "adopted",
+        "fleet_victim_cohort": 3,
+        "fleet_victim_recognized": 3,
+        "fleet_victim_fallbacks": 0,
+        "swarm_replica_diff_clean": 1,
     }
 
 
@@ -504,6 +515,12 @@ def test_emits_resilience_overhead_and_chaos_keys(monkeypatch, capfd):
     assert rec["fleet_hangs"] == 0
     assert rec["fleet_blackout_ms"] > 0
     assert rec["schedule_ops_per_s"] > 0
+    # the ISSUE 20 two-arm failover keys ride the same artifact
+    assert 0 < rec["fleet_blackout_ms_replicated"] < rec["fleet_blackout_ms_rebuild"]
+    assert rec["swarm_adopt_ms"] > 0
+    assert rec["swarm_adopt_outcome"] == "adopted"
+    assert rec["fleet_victim_fallbacks"] == 0
+    assert rec["swarm_replica_diff_clean"] == 1
 
 
 def test_resilience_and_chaos_keys_survive_warmup_failure(monkeypatch, capfd):
@@ -518,6 +535,8 @@ def test_resilience_and_chaos_keys_survive_warmup_failure(monkeypatch, capfd):
     assert rec["resilience_overhead_pct"] >= 0.0
     assert rec["chaos_success_rate"] == 1.0
     assert rec["fleet_blackout_ms"] > 0  # fleet soak keys ride it too
+    assert rec["fleet_blackout_ms_replicated"] > 0
+    assert rec["swarm_adopt_ms"] > 0
 
 
 def test_chaos_soak_failure_rides_exit_path(monkeypatch, capfd):

@@ -305,6 +305,104 @@ class BadWave:
     assert "BadWave._topo_lock" in cycles[0].message
 
 
+REPLICATION_SHAPE_FIXTURE = '''
+import threading
+
+class Ledger:
+    """The scheduler/swarm.py shape: every observatory hook is one
+    short hold on the ledger lock; nothing under it calls out of the
+    module."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dirty = set()
+
+    def on_piece(self, tid):
+        with self._lock:
+            self._dirty.add(tid)
+
+    def drain_dirty(self):
+        with self._lock:
+            drained = set(self._dirty)
+            self._dirty.clear()
+            return drained
+
+    def export_task(self, tid):
+        with self._lock:
+            return {"id": tid}
+
+
+class Replicator:
+    """The scheduler/swarm_replication.py shape: every ledger call
+    happens OUTSIDE the replicator lock — the dirty drain before the
+    hold, the payload exports after release — so the two locks never
+    nest in either direction."""
+
+    def __init__(self, ledger):
+        self._lock = threading.Lock()
+        self.ledger = ledger
+        self._pending = {}
+
+    def flush_once(self):
+        dirty = self.ledger.drain_dirty()  # ledger lock, alone
+        with self._lock:  # replicator lock, alone
+            for tid in dirty:
+                self._pending[tid] = None
+            batch = list(self._pending)
+            self._pending.clear()
+        return [self.ledger.export_task(t) for t in batch]
+'''
+
+
+def test_lockorder_replication_shape_is_clean(fakepkg):
+    """The replication plane's lock model (ISSUE 20): the replicator
+    drains the observatory's dirty set before taking its own lock and
+    exports payloads after releasing it, so Replicator._lock and
+    Ledger._lock never nest — this fixture names the intended shape so
+    a regression that nests them shows up against a baseline."""
+    (fakepkg / "replication.py").write_text(REPLICATION_SHAPE_FIXTURE)
+    res = lockorder.run(fakepkg)
+    assert res.findings == [], [f.message for f in res.findings]
+
+
+def test_lockorder_catches_a_replication_nesting_regression(fakepkg):
+    """The defect the clean shape guards against: a flush that exports
+    UNDER the replicator lock while an observatory hook notifies the
+    replicator under the ledger lock — the ABBA the one-way
+    replicator→ledger rule forbids."""
+    (fakepkg / "replication_bad.py").write_text(
+        '''
+import threading
+
+class BadReplicator:
+    def __init__(self):
+        self._lock = threading.Lock()         # replicator backlog
+        self._ledger_lock = threading.Lock()  # observatory ledger
+
+    def flush_once(self):
+        with self._lock:
+            self._export()  # replicator -> ledger: export under the hold
+
+    def _export(self):
+        with self._ledger_lock:
+            pass
+
+    def on_piece(self):
+        with self._ledger_lock:
+            self._mark_dirty()  # ledger -> replicator: the inversion
+
+    def _mark_dirty(self):
+        with self._lock:
+            pass
+'''
+    )
+    res = lockorder.run(fakepkg)
+    cycles = [f for f in res.findings if f.key.startswith("cycle:")]
+    assert cycles, [f.message for f in res.findings]
+    assert "BadReplicator._lock" in cycles[0].message
+    assert "BadReplicator._ledger_lock" in cycles[0].message
+
+
 def test_blocking_catches_calls_under_lock(fakepkg):
     (fakepkg / "svc.py").write_text(
         """

@@ -355,6 +355,7 @@ def test_documented_series_exist():
     import dragonfly2_tpu.rpc.resilience  # noqa: F401 — rpc_retries_* etc.
     import dragonfly2_tpu.scheduler.fleet  # noqa: F401 — fleet_* series
     import dragonfly2_tpu.scheduler.metrics  # noqa: F401 — incl. serving_*
+    import dragonfly2_tpu.scheduler.swarm_replication  # noqa: F401 — swarm_replication_* series
     import dragonfly2_tpu.trainer.metrics  # noqa: F401
     import dragonfly2_tpu.utils.faults  # noqa: F401 — faults_* series
     import dragonfly2_tpu.utils.flight  # noqa: F401 — flight_* series

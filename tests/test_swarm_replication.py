@@ -1,0 +1,461 @@
+"""Swarm replication plane (ISSUE 20): KV-journaled per-task swarm
+snapshots and successor adoption (scheduler/swarm_replication.py,
+docs/fleet.md failover section).
+
+Covers the acceptance drills: a serialize → replicate → adopt
+round-trip under concurrent churn (conservation identity intact, piece
+progress and parent edges preserved), a stale-epoch replica refused at
+the adoption floor, a torn replica refused by the conservation gate,
+the flush loop's coalescing/backlog-cap accounting, and a WRONG_SHARD
+handoff over real gRPC where the migrated replica lets the new owner
+recognize the in-flight peer with its piece progress end-to-end.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.scheduler import fleet, resource as res, swarm
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.fleet import FleetConfig, FleetMembership
+from dragonfly2_tpu.scheduler.resource.host import Host, HostType
+from dragonfly2_tpu.scheduler.resource.peer import Peer
+from dragonfly2_tpu.scheduler.resource.task import Task
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import SERVICE_NAME, SchedulerService
+from dragonfly2_tpu.scheduler.storage import Storage
+from dragonfly2_tpu.scheduler.swarm_replication import (
+    REPL_ADOPTIONS_TOTAL,
+    REPL_DROPPED_TOTAL,
+    ReplicationConfig,
+    SwarmReplicator,
+)
+from dragonfly2_tpu.tools.dfswarm import diff_replicas
+from dragonfly2_tpu.utils import flight
+from dragonfly2_tpu.utils.kvstore import (
+    SWARM_REPLICA_INDEX_KEY,
+    KVStore,
+    make_swarm_adopt_key,
+    make_swarm_replica_key,
+)
+
+PIECE = 1024
+
+
+@pytest.fixture(autouse=True)
+def clean_swarm():
+    swarm.reset()
+    yield
+    swarm.reset()
+
+
+def _adoptions(outcome: str) -> float:
+    return sum(
+        c.value
+        for labels, c in REPL_ADOPTIONS_TOTAL._snapshot()
+        if labels == (outcome,)
+    )
+
+
+def _adopt_events(kind: str, task_id: str) -> list:
+    ring = flight.snapshot(["scheduler"]).get("scheduler", [])
+    return [
+        e
+        for e in ring
+        if e["type"] == f"scheduler.swarm_adopt_{kind}"
+        and e.get("task_id") == task_id
+    ]
+
+
+class _FakeFleet:
+    """Epoch/floor stub: the replicator only reads the settled epoch on
+    writes and the adoption floor on reads."""
+
+    def __init__(self, epoch: int = 0, floor: int = 0):
+        self._epoch = epoch
+        self._floor = floor
+        self.observers: list = []
+
+    def epoch(self) -> int:
+        return self._epoch
+
+    def epoch_floor(self) -> int:
+        return self._floor
+
+    def owner_of(self, task_id: str):
+        return None
+
+    def add_observer(self, fn) -> None:
+        self.observers.append(fn)
+
+
+def _victim_swarm(resource, task_id: str, children=("c1", "c2", "c3")):
+    """One seed + N in-flight children on distinct hosts, mirrored into
+    both the resource model and the observatory — the state a victim
+    scheduler would hold mid-download."""
+    task = Task(task_id, url=f"http://origin/{task_id}.bin", piece_length=PIECE)
+    task.content_length = 8 * PIECE
+    task.total_piece_count = 8
+    task.fsm.force("Running")
+    task, _ = resource.task_manager.load_or_store(task)
+
+    def host(hid, port):
+        h = Host(
+            id=hid, type=HostType.NORMAL, hostname=hid,
+            ip="127.0.0.1", port=port, download_port=port + 1,
+        )
+        return resource.host_manager.load_or_store(h)[0]
+
+    seed = Peer("p-seed", task, host("h-seed", 4000))
+    seed, _ = resource.peer_manager.load_or_store(seed)
+    seed.fsm.force("Succeeded")
+    for n in range(8):
+        seed.finished_pieces.add(n)
+    swarm.on_peer(task_id, "p-seed", seed=True, total_pieces=8)
+    swarm.on_state(task_id, "p-seed", "Succeeded")
+    swarm.on_piece(task_id, "p-seed", 8, 8)
+
+    for i, pid in enumerate(children):
+        child = Peer(pid, task, host(f"h-{pid}", 5000 + 10 * i))
+        child, _ = resource.peer_manager.load_or_store(child)
+        child.fsm.force("Running")
+        for n in range(2):
+            child.finished_pieces.add(n)
+        swarm.on_peer(task_id, pid)
+        swarm.on_primary_parent(task_id, pid, "p-seed")
+        swarm.on_state(task_id, pid, "Running")
+        swarm.on_piece(task_id, pid, 2, 8)
+    return task
+
+
+# ---------------------------------------------------------------------------
+# round-trip: serialize → replicate → adopt, with churn in flight
+# ---------------------------------------------------------------------------
+
+
+def test_replicate_adopt_round_trip_under_concurrent_churn():
+    """Flushes race live swarm mutation; the final replica must still
+    adopt clean — peers, parent edges, and finished pieces intact, the
+    conservation identity holding on the successor's ledger."""
+    kv = KVStore()
+    resource_a = res.Resource()
+    tid = "rt-churn"
+    _victim_swarm(resource_a, tid)
+    repl_a = SwarmReplicator(
+        kv, "127.0.0.1:1", resource_a,
+        config=ReplicationConfig(interval_s=0.01),
+    )
+
+    stop = threading.Event()
+    errors: list = []
+
+    def churn():
+        try:
+            c1 = resource_a.peer_manager.load("c1")
+            for n in range(2, 8):
+                c1.finished_pieces.add(n)
+                swarm.on_piece(tid, "c1", len(c1.finished_pieces), 8)
+                # a mid-flight re-placement: c2 moves under c1
+                swarm.on_primary_parent(tid, "c2", "c1" if n % 2 else "p-seed")
+                time.sleep(0.005)
+            swarm.on_state(tid, "c1", "Succeeded")
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    def flush():
+        while not stop.is_set():
+            try:
+                repl_a.flush_once()
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+                return
+            time.sleep(0.002)
+
+    threads = [
+        threading.Thread(target=churn, daemon=True),
+        threading.Thread(target=flush, daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    threads[0].join(5.0)
+    stop.set()
+    threads[1].join(5.0)
+    assert not errors, errors
+    repl_a.flush_once()  # settle: the journal carries the final state
+    victim_payload = repl_a.export_payload(tid)
+    victim_obs = victim_payload["obs"]
+    assert victim_obs["peers"]["c1"]["pieces"] == 8
+
+    # successor: empty observatory, empty resource model
+    swarm.reset()
+    resource_b = res.Resource()
+    repl_b = SwarmReplicator(kv, "127.0.0.1:2", resource_b)
+    adopted_before = _adoptions("adopted")
+    assert repl_b.adopt_task(tid) is True
+    assert _adoptions("adopted") == adopted_before + 1
+    assert repl_b.adopt_task(tid) is False  # idempotent: seeded once
+
+    task_b = resource_b.task_manager.load(tid)
+    assert task_b is not None
+    assert task_b.total_piece_count == 8 and task_b.piece_length == PIECE
+    for pid in ("p-seed", "c1", "c2", "c3"):
+        peer_b = resource_b.peer_manager.load(pid)
+        assert peer_b is not None, pid
+        peer_a = resource_a.peer_manager.load(pid)
+        assert peer_b.finished_pieces == peer_a.finished_pieces, pid
+
+    obs = swarm.export_task(tid)
+    assert obs is not None
+    assert set(obs["peers"]) == set(victim_obs["peers"])
+    for pid, view in victim_obs["peers"].items():
+        assert obs["peers"][pid]["parent"] == view["parent"], pid
+        assert obs["peers"][pid]["pieces"] == view["pieces"], pid
+    roots = sum(1 for p in obs["peers"].values() if p["parent"] is None)
+    assert obs["edges"] == len(obs["peers"]) - roots
+
+    # the successor's own re-journal diffs clean against the victim's
+    d = diff_replicas(victim_payload, repl_b.export_payload(tid))
+    assert d["clean"], d
+
+    receipt = json.loads(kv.get(make_swarm_adopt_key(tid)))
+    assert receipt["outcome"] == "adopted"
+    assert receipt["victim"] == "127.0.0.1:1"
+    assert receipt["adopted_by"] == "127.0.0.1:2"
+    assert receipt["payload"]["obs"]["peers"].keys() == obs["peers"].keys()
+    assert _adopt_events("ok", tid)
+
+
+# ---------------------------------------------------------------------------
+# adoption gates: stale epoch, torn payload, missing replica
+# ---------------------------------------------------------------------------
+
+
+def test_stale_epoch_replica_is_refused_at_the_floor():
+    """A replica stamped by an older fleet generation must not seed the
+    successor: epoch 3 against floor 5 → refused, nothing materialized,
+    a refusal receipt and flight event left behind."""
+    kv = KVStore()
+    resource_a = res.Resource()
+    tid = "rt-stale"
+    _victim_swarm(resource_a, tid)
+    repl_a = SwarmReplicator(
+        kv, "127.0.0.1:1", resource_a, fleet=_FakeFleet(epoch=3, floor=3)
+    )
+    assert repl_a.flush_once() == 1
+
+    swarm.reset()
+    resource_b = res.Resource()
+    repl_b = SwarmReplicator(
+        kv, "127.0.0.1:2", resource_b, fleet=_FakeFleet(epoch=5, floor=5)
+    )
+    stale_before = _adoptions("stale")
+    assert repl_b.adopt_task(tid) is False
+    assert _adoptions("stale") == stale_before + 1
+    assert resource_b.task_manager.load(tid) is None
+    assert swarm.export_task(tid) is None
+    receipt = json.loads(kv.get(make_swarm_adopt_key(tid)))
+    assert receipt["outcome"] == "stale"
+    events = _adopt_events("refused", tid)
+    assert events and events[-1]["reason"] == "stale"
+    assert events[-1]["floor"] == 5 and events[-1]["epoch"] == 3
+
+
+def test_torn_replica_fails_the_conservation_gate():
+    """A replica whose edge count disagrees with its peer map (torn
+    write, corrupted payload) is discarded — adopting wrong is worse
+    than rebuilding."""
+    kv = KVStore()
+    resource_a = res.Resource()
+    tid = "rt-torn"
+    _victim_swarm(resource_a, tid)
+    repl_a = SwarmReplicator(kv, "127.0.0.1:1", resource_a)
+    assert repl_a.flush_once() == 1
+
+    key = make_swarm_replica_key(tid)
+    payload = json.loads(kv.hmget(key, ["data"])[0])
+    payload["obs"]["edges"] += 1  # identity now violated
+    kv.hset(key, {"data": json.dumps(payload)})
+
+    swarm.reset()
+    resource_b = res.Resource()
+    repl_b = SwarmReplicator(kv, "127.0.0.1:2", resource_b)
+    torn_before = _adoptions("torn")
+    assert repl_b.adopt_task(tid) is False
+    assert _adoptions("torn") == torn_before + 1
+    assert resource_b.task_manager.load(tid) is None
+    assert swarm.export_task(tid) is None
+    assert json.loads(kv.get(make_swarm_adopt_key(tid)))["outcome"] == "torn"
+    events = _adopt_events("refused", tid)
+    assert events and events[-1]["reason"] == "torn"
+
+
+def test_missing_replica_is_counted_not_crashed():
+    kv = KVStore()
+    repl = SwarmReplicator(kv, "127.0.0.1:2", res.Resource())
+    missing_before = _adoptions("missing")
+    assert repl.adopt_task("never-replicated") is False
+    assert _adoptions("missing") == missing_before + 1
+
+
+# ---------------------------------------------------------------------------
+# journal mechanics: coalescing, backlog cap, tombstones
+# ---------------------------------------------------------------------------
+
+
+def test_flush_coalesces_dirty_tasks_and_caps_the_backlog():
+    kv = KVStore()
+    repl = SwarmReplicator(
+        kv, "127.0.0.1:1", res.Resource(),
+        config=ReplicationConfig(backlog_cap=2, max_tasks_per_flush=1),
+    )
+    for tid in ("bk-1", "bk-2", "bk-3"):
+        swarm.on_peer(tid, "p", seed=True)
+    dropped_before = REPL_DROPPED_TOTAL.value
+    assert repl.flush_once() == 1  # three dirty → cap 2 → batch of 1
+    assert REPL_DROPPED_TOTAL.value == dropped_before + 1
+    assert repl.stats()["backlog"] == 1
+    assert repl.flush_once() == 1  # the carried-over task drains next
+    assert repl.stats()["backlog"] == 0
+
+    # re-dirtying a journaled task coalesces to one pending entry
+    swarm.on_piece("bk-2", "p", 1, 4)
+    swarm.on_piece("bk-2", "p", 2, 4)
+    assert repl.flush_once() == 1
+
+
+def test_task_gone_turns_into_a_replica_delete():
+    kv = KVStore()
+    resource = res.Resource()
+    tid = "rt-gone"
+    _victim_swarm(resource, tid)
+    repl = SwarmReplicator(kv, "127.0.0.1:1", resource)
+    assert repl.flush_once() == 1
+    assert kv.hmget(make_swarm_replica_key(tid), ["data"])[0] is not None
+
+    swarm.on_task_gone(tid)  # eviction marks dirty; export finds nothing
+    assert repl.flush_once() == 0
+    assert kv.hmget(make_swarm_replica_key(tid), ["data"])[0] is None
+    assert kv.hmget(SWARM_REPLICA_INDEX_KEY, [tid])[0] is None
+
+
+# ---------------------------------------------------------------------------
+# WRONG_SHARD handoff over real gRPC: migrate → adopt → recognize
+# ---------------------------------------------------------------------------
+
+
+def _repl_scheduler(tmp_path, name, kv, cfg, join=True):
+    from dragonfly2_tpu.rpc.glue import serve
+
+    resource = res.Resource()
+    service = SchedulerService(
+        resource,
+        Scheduling(
+            BaseEvaluator(),
+            SchedulingConfig(retry_interval=0.0, retry_back_to_source_limit=2),
+        ),
+        storage=Storage(tmp_path / f"rec-{name}", buffer_size=1),
+    )
+    server, bound = serve({SERVICE_NAME: service}, address="127.0.0.1:0")
+    addr = f"127.0.0.1:{bound}"
+    membership = FleetMembership(kv, addr, cfg)
+    if join:
+        membership.join()
+    replication = SwarmReplicator(kv, addr, resource, fleet=membership)
+    service.fleet = membership
+    service.replication = replication
+    return {
+        "resource": resource, "server": server, "addr": addr,
+        "fleet": membership, "service": service, "replication": replication,
+    }
+
+
+def test_wrong_shard_handoff_preserves_in_flight_piece_progress(tmp_path):
+    """End-to-end over real gRPC: a seed and an in-flight child build a
+    swarm on the owner; a join remaps the task; the owner's WRONG_SHARD
+    refusal migrates the replica with the refusal; the child's
+    re-register at the new owner adopts it and is RECOGNIZED — scheduled
+    a parent immediately, finished pieces intact — instead of being sent
+    back to source as a stranger."""
+    from dragonfly2_tpu.rpc.glue import ConsistentHashRing, SchedulerSelector
+    from dragonfly2_tpu.tools.stress import (
+        _drill_announce,
+        _drill_child,
+        _drill_close,
+        _drill_seed,
+    )
+
+    kv = KVStore()
+    cfg = FleetConfig(
+        lease_ttl=5.0, renew_interval=1.0, poll_interval=0.5, grace_s=0.0
+    )
+    s1 = _repl_scheduler(tmp_path, "one", kv, cfg)
+    s2 = _repl_scheduler(tmp_path, "two", kv, cfg, join=False)
+    s1["fleet"].reconcile()
+    sel = SchedulerSelector([s1["addr"], s2["addr"]])
+    handle = None
+    try:
+        # a task that will remap to s2 once it joins — while s1 is the
+        # sole member it owns everything, so the swarm builds on s1
+        ring = ConsistentHashRing([s1["addr"], s2["addr"]])
+        tid = next(
+            t for t in (f"handoff-{i}" for i in range(200))
+            if ring.pick(t) == s2["addr"]
+        )
+        url = f"http://origin/{tid}.bin"
+        c1 = sel.client_for(s1["addr"])
+        _drill_seed(c1, tid, url, "h-seed", "p-seed", PIECE, 4)
+        kind, handle = _drill_child(c1, tid, url, "h-child", "p-child", PIECE, 2)
+        assert kind == "normal_task"
+        _drill_close(handle)
+        handle = None
+        assert s1["replication"].flush_once() >= 1
+
+        s2["fleet"].join()
+        s1["fleet"].reconcile()
+        s2["fleet"].reconcile()
+        assert s1["fleet"].owner_of(tid) == s2["addr"]
+
+        # re-announce at the old owner: typed refusal + synchronous
+        # replica migration stamped with the settled post-join epoch
+        with pytest.raises(Exception) as exc:
+            _drill_announce(c1, tid, url, "h-child", "p-child", timeout=10.0)
+        parsed = fleet.parse_wrong_shard(str(exc.value))
+        assert parsed is not None and parsed[0] == s2["addr"]
+        index_meta = json.loads(kv.hmget(SWARM_REPLICA_INDEX_KEY, [tid])[0])
+        assert index_meta["handoff_to"] == s2["addr"]
+
+        # the child follows the owner hint: first sighting on s2 adopts
+        # the migrated replica, so the very first decision is a
+        # re-schedule with the seed as parent — not back-to-source
+        q, responses, first = _drill_announce(
+            sel.client_for(s2["addr"]), tid, url, "h-child", "p-child",
+            timeout=10.0,
+        )
+        handle = (q, responses)
+        assert first.WhichOneof("response") == "normal_task"
+        parents = {p.peer_id for p in first.normal_task.candidate_parents}
+        assert "p-seed" in parents
+
+        child = s2["resource"].peer_manager.load("p-child")
+        assert child is not None
+        assert child.finished_pieces == {0, 1}
+        seed = s2["resource"].peer_manager.load("p-seed")
+        assert seed is not None and len(seed.finished_pieces) == 4
+        receipt = json.loads(kv.get(make_swarm_adopt_key(tid)))
+        assert receipt["outcome"] == "adopted"
+        assert receipt["victim"] == s1["addr"]
+        assert receipt["adopted_by"] == s2["addr"]
+        obs = swarm.export_task(tid)
+        roots = sum(1 for p in obs["peers"].values() if p["parent"] is None)
+        assert obs["edges"] == len(obs["peers"]) - roots
+    finally:
+        _drill_close(handle)
+        sel.close()
+        for s in (s2, s1):
+            try:
+                s["fleet"].abandon()
+                s["server"].stop(0)
+            except Exception:
+                pass
